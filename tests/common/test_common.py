@@ -1,0 +1,91 @@
+import numpy as np
+
+from thrill_tpu.common.config import Config, parse_si_iec_units, round_up_pow2
+from thrill_tpu.common.hashing import np_mix64, stable_host_hash
+from thrill_tpu.common.sampling import ReservoirSamplingGrow, hypergeometric_split
+from thrill_tpu.common.stats import Aggregate, StatsTimer
+
+
+def test_parse_units():
+    assert parse_si_iec_units("100") == 100
+    assert parse_si_iec_units("64K") == 64 * 1024
+    assert parse_si_iec_units("2GB") == 2 * 10 ** 9
+    assert parse_si_iec_units("1Gi") == 1024 ** 3
+
+
+def test_round_up_pow2():
+    assert [round_up_pow2(n) for n in (0, 1, 2, 3, 5, 8, 1000)] == \
+        [1, 1, 2, 4, 8, 8, 1024]
+
+
+def test_config_env(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_WORKERS", "4")
+    monkeypatch.setenv("THRILL_TPU_RAM", "1Gi")
+    cfg = Config.from_env()
+    assert cfg.num_workers == 4
+    assert cfg.ram == 1024 ** 3
+
+
+def test_aggregate():
+    a = Aggregate()
+    for x in [1.0, 2.0, 3.0, 4.0]:
+        a.add(x)
+    assert a.count == 4 and a.min == 1.0 and a.max == 4.0
+    assert abs(a.mean - 2.5) < 1e-12
+    b = Aggregate()
+    b.add(10.0)
+    a += b
+    assert a.count == 5 and a.max == 10.0
+
+
+def test_stats_timer():
+    t = StatsTimer(start=True)
+    t.stop()
+    assert t.seconds >= 0
+
+
+def test_mix64_distribution():
+    xs = np_mix64(np.arange(10000, dtype=np.uint64))
+    assert len(np.unique(xs)) == 10000
+    # rough uniformity of the top bit
+    assert 4000 < int((xs >> np.uint64(63)).sum()) < 6000
+
+
+def test_stable_host_hash():
+    assert stable_host_hash("abc") == stable_host_hash("abc")
+    assert stable_host_hash("abc") != stable_host_hash("abd")
+    assert stable_host_hash((1, "a")) != stable_host_hash((1, "b"))
+    assert stable_host_hash(5) != stable_host_hash(6)
+
+
+def test_reservoir_grow():
+    rng = np.random.default_rng(0)
+    rs = ReservoirSamplingGrow(rng, min_size=8, max_size=64)
+    rs.add_batch(range(10000))
+    assert 8 <= len(rs.samples) <= 64
+    assert all(0 <= s < 10000 for s in rs.samples)
+
+
+def test_hypergeometric_split():
+    rng = np.random.default_rng(0)
+    counts = np.array([100, 0, 50, 1000])
+    out = hypergeometric_split(rng, 70, counts)
+    assert out.sum() == 70
+    assert out[1] == 0
+    assert np.all(out <= counts)
+
+
+def test_local_flow_empty_and_initial():
+    from thrill_tpu.net import LocalFlowControl
+    f = LocalFlowControl(0)
+    excl, total = f.ex_prefix_sum_total([], initial=7)
+    assert (excl, total) == ([], 7)
+    f2 = LocalFlowControl(3)
+    excl, total = f2.ex_prefix_sum_total([1, 2, 3], initial=0)
+    assert excl == [0, 1, 3] and total == 6
+
+
+def test_stable_host_hash_big_ints():
+    assert stable_host_hash(2 ** 63) != stable_host_hash(2 ** 63 + 1)
+    assert isinstance(stable_host_hash(-2 ** 63 - 1), int)
+    assert stable_host_hash(2 ** 64 + 5) == stable_host_hash(5)

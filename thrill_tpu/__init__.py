@@ -1,0 +1,23 @@
+"""thrill_tpu — a TPU-native distributed batch-processing framework.
+
+A ground-up redesign of the capabilities of Thrill (reference:
+https://github.com/thrill/thrill, C++14/TCP/MPI) for TPUs: DIA
+(Distributed Immutable Array) pipelines whose local operation chains are
+fused by XLA tracing instead of C++ template stacks, whose shuffles are
+all-to-all collectives over the ICI mesh instead of socket streams, and
+whose hot operator phases (sample sort, reduce aggregation) run as
+jitted/Pallas device programs over HBM-resident columnar blocks.
+
+64-bit note: a data-processing framework needs 64-bit keys, sizes and
+hashes end-to-end, so importing thrill_tpu enables JAX x64 mode. Device
+kernels specify narrow dtypes (bf16/int32) explicitly where it matters
+for MXU/VPU throughput.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from . import common, mem, net  # noqa: E402,F401
+
+__version__ = "0.1.0"

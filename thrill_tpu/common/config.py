@@ -1,0 +1,95 @@
+"""Runtime configuration.
+
+The reference framework is configured exclusively through environment
+variables parsed at startup (reference: thrill/api/context.cpp:204-272,
+1023-1093 — THRILL_NET, THRILL_RAM, THRILL_BLOCK_SIZE, THRILL_LOG, ...).
+We keep the same model under the ``THRILL_TPU_`` namespace, plus
+TPU-specific knobs (exchange mode, device platform).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else default
+
+
+def _env_str(name: str, default: Optional[str]) -> Optional[str]:
+    v = os.environ.get(name)
+    return v if v not in (None, "") else default
+
+
+def parse_si_iec_units(s: str) -> int:
+    """Parse '100', '64K', '1Gi', '2GB' style size strings to bytes.
+
+    Mirrors the semantics of tlx's parse_si_iec_units used by THRILL_RAM
+    (reference: thrill/api/context.cpp:1027).
+    """
+    s = s.strip()
+    mult = 1
+    low = s.lower()
+    for suffix, m in (
+        ("kib", 1024), ("mib", 1024 ** 2), ("gib", 1024 ** 3), ("tib", 1024 ** 4),
+        ("kb", 1000), ("mb", 1000 ** 2), ("gb", 1000 ** 3), ("tb", 1000 ** 4),
+        ("ki", 1024), ("mi", 1024 ** 2), ("gi", 1024 ** 3), ("ti", 1024 ** 4),
+        ("k", 1024), ("m", 1024 ** 2), ("g", 1024 ** 3), ("t", 1024 ** 4),
+        ("b", 1),
+    ):
+        if low.endswith(suffix):
+            mult = m
+            s = s[: -len(suffix)]
+            break
+    return int(float(s.strip()) * mult)
+
+
+@dataclasses.dataclass
+class Config:
+    """Host-level runtime configuration (one per HostContext)."""
+
+    # Number of logical workers. 0 = one per local accelerator device.
+    num_workers: int = 0
+    # Preferred storage for ambiguous sources: 'device' or 'host'.
+    default_storage: str = "device"
+    # Exchange implementation: 'dense' (padded all_to_all; works on all
+    # platforms) or 'ragged' (lax.ragged_all_to_all; TPU-only fast path).
+    exchange: str = "dense"
+    # Item-capacity granularity for device block padding (power of two).
+    block_items: int = 1024
+    # Bytes of device memory the block pool may use (0 = autodetect).
+    ram: int = 0
+    # JSON event-log path pattern (None = disabled).
+    log_path: Optional[str] = None
+    # Directory for host-side spill files.
+    spill_dir: str = "/tmp"
+    # Enable periodic profiling.
+    profile: bool = False
+
+    @staticmethod
+    def from_env() -> "Config":
+        ram = os.environ.get("THRILL_TPU_RAM")
+        return Config(
+            num_workers=_env_int("THRILL_TPU_WORKERS", 0),
+            default_storage=_env_str("THRILL_TPU_STORAGE", "device"),
+            exchange=_env_str("THRILL_TPU_EXCHANGE", "dense"),
+            block_items=_env_int("THRILL_TPU_BLOCK_ITEMS", 1024),
+            ram=parse_si_iec_units(ram) if ram else 0,
+            log_path=_env_str("THRILL_TPU_LOG", None),
+            spill_dir=_env_str("THRILL_TPU_SPILL_DIR", "/tmp"),
+            profile=bool(_env_int("THRILL_TPU_PROFILE", 0)),
+        )
+
+
+def round_up_pow2(n: int) -> int:
+    """Smallest power of two >= max(n, 1)."""
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def round_up(n: int, granularity: int) -> int:
+    return ((n + granularity - 1) // granularity) * granularity

@@ -1,0 +1,101 @@
+"""Hash functions for partitioning and sketches.
+
+Equivalent of the reference's hash utilities
+(reference: thrill/common/hash.hpp — CRC32-based tabulation hashing used
+by the reduce tables and HyperLogLog). On the device path we use a
+splitmix64-style finalizer over packed 64-bit key words — multiplicative
+mixing maps well onto the TPU VPU, unlike table lookups.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _require_jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# splitmix64 finalizer constants
+_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_C2 = np.uint64(0x94D049BB133111EB)
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def mix64(x):
+    """splitmix64 finalizer on a uint64 array (jnp or np)."""
+    jnp = _require_jnp()
+    x = x.astype(jnp.uint64)
+    if x.dtype != jnp.uint64:  # x64 disabled would silently truncate
+        raise RuntimeError("thrill_tpu requires JAX x64 mode for 64-bit hashing")
+    x = x ^ (x >> np.uint64(30))
+    x = x * _C1
+    x = x ^ (x >> np.uint64(27))
+    x = x * _C2
+    x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def hash_combine64(h, x):
+    """Combine a new uint64 word into a running hash (boost-style)."""
+    jnp = _require_jnp()
+    h = h.astype(jnp.uint64)
+    return mix64(h ^ (x.astype(jnp.uint64) + _GOLDEN + (h << np.uint64(6)) + (h >> np.uint64(2))))
+
+
+def hash_key_words(words) -> "object":
+    """Hash a list of equally-shaped uint64 arrays into one uint64 array."""
+    jnp = _require_jnp()
+    assert len(words) >= 1
+    h = mix64(words[0].astype(jnp.uint64) + _GOLDEN)
+    for w in words[1:]:
+        h = hash_combine64(h, w)
+    return h
+
+
+def np_mix64(x: np.ndarray) -> np.ndarray:
+    """NumPy version of mix64 (host path)."""
+    with np.errstate(over="ignore"):
+        x = x.astype(np.uint64)
+        x = x ^ (x >> np.uint64(30))
+        x = x * _C1
+        x = x ^ (x >> np.uint64(27))
+        x = x * _C2
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def stable_host_hash(obj) -> int:
+    """Deterministic 64-bit hash of a Python object (host path).
+
+    Strings/bytes hash by content (FNV-1a); ints by splitmix64; tuples
+    combine recursively. Unlike builtin ``hash``, not salted per process,
+    so multi-host partitioning is consistent.
+    """
+    if isinstance(obj, bytes):
+        return _fnv1a(obj)
+    if isinstance(obj, str):
+        return _fnv1a(obj.encode("utf-8"))
+    if isinstance(obj, bool):
+        return int(np_mix64(np.uint64(int(obj) + 0x9E37)))
+    if isinstance(obj, (int, np.integer)):
+        return int(np_mix64(np.uint64(int(obj) & 0xFFFFFFFFFFFFFFFF)))
+    if isinstance(obj, float):
+        return int(np_mix64(np.float64(obj).view(np.uint64)))
+    if isinstance(obj, tuple):
+        h = np.uint64(0x9E3779B97F4A7C15)
+        for el in obj:
+            with np.errstate(over="ignore"):
+                h = np_mix64(h ^ np.uint64(stable_host_hash(el)))
+        return int(h)
+    # Fallback: repr bytes (slow but total).
+    return _fnv1a(repr(obj).encode("utf-8"))
+
+
+def _fnv1a(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h

@@ -1,0 +1,85 @@
+"""Structured JSON event logging.
+
+Equivalent of the reference's JsonLogger/JsonLine
+(reference: thrill/common/json_logger.hpp:69,119): every Context and DIA
+node can emit timestamped JSON events (node creation, stage execution,
+push-data timing, profile samples) into a per-host JSON-lines file, which
+``tools/json2profile.py`` renders into an HTML timeline report.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Optional
+
+
+class JsonLogger:
+    """Append-only JSON-lines event log.
+
+    Thread-safe; each `line()` call emits one JSON object with a
+    microsecond timestamp ``ts`` and any caller-supplied fields. Loggers
+    can be chained: child loggers inherit common fields from the parent
+    (like the reference's JsonLogger(parent, key, value) constructor).
+    """
+
+    def __init__(self, path: Optional[str] = None,
+                 parent: Optional["JsonLogger"] = None,
+                 **common: Any) -> None:
+        self.parent = parent
+        self.common = dict(parent.common) if parent else {}
+        self.common.update(common)
+        if parent is not None:
+            self._file = parent._file
+            self._lock = parent._lock
+        else:
+            self._lock = threading.Lock()
+            self._file = open(path, "a", buffering=1) if path else None
+
+    @property
+    def enabled(self) -> bool:
+        return self._file is not None
+
+    def line(self, **fields: Any) -> None:
+        if self._file is None:
+            return
+        rec = {"ts": int(time.time() * 1e6)}
+        rec.update(self.common)
+        rec.update(fields)
+        with self._lock:
+            self._file.write(json.dumps(rec, default=_json_default) + "\n")
+
+    def close(self) -> None:
+        if self._file is not None and self.parent is None:
+            self._file.close()
+        self._file = None
+
+
+def _json_default(o: Any) -> Any:
+    try:
+        import numpy as np
+        if isinstance(o, np.integer):
+            return int(o)
+        if isinstance(o, np.floating):
+            return float(o)
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except ImportError:
+        pass
+    return str(o)
+
+
+def default_log_path(pattern: Optional[str], host_rank: int) -> Optional[str]:
+    """Expand a THRILL_TPU_LOG pattern to a per-host path.
+
+    Mirrors the reference's per-host log naming
+    (reference: thrill/api/context.cpp:1154-1174).
+    """
+    if not pattern:
+        return None
+    if "{}" in pattern:
+        return pattern.format(host_rank)
+    base, ext = os.path.splitext(pattern)
+    return f"{base}-host{host_rank}{ext or '.json'}"

@@ -1,0 +1,1 @@
+from .manager import MemoryManager, MemoryConfig  # noqa: F401

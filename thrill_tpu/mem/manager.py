@@ -1,0 +1,78 @@
+"""Hierarchical memory accounting.
+
+Equivalent of the reference's mem::Manager
+(reference: thrill/mem/manager.hpp:28) and the RAM-splitting MemoryConfig
+(reference: thrill/api/context.cpp:1082-1093, which splits total RAM into
+1/3 BlockPool, 1/3 DIA operation workspace, 1/3 floating heap).
+
+On TPU the scarce resource is HBM: the block pool budget governs how many
+device-resident DIA blocks may stay pinned before cold blocks are spilled
+to host DRAM (the analog of the reference's foxxll disk spill).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+
+class MemoryManager:
+    """Thread-safe byte counter forming a tree of subsystems."""
+
+    def __init__(self, parent: Optional["MemoryManager"] = None,
+                 name: str = "root", limit: int = 0) -> None:
+        self.parent = parent
+        self.name = name
+        self.limit = limit  # 0 = unlimited
+        self.total = 0
+        self.peak = 0
+        self.allocs = 0
+        self._lock = threading.Lock()
+
+    def add(self, nbytes: int) -> None:
+        with self._lock:
+            self.total += nbytes
+            self.allocs += 1
+            if self.total > self.peak:
+                self.peak = self.total
+        if self.parent is not None:
+            self.parent.add(nbytes)
+
+    def subtract(self, nbytes: int) -> None:
+        with self._lock:
+            self.total -= nbytes
+        if self.parent is not None:
+            self.parent.subtract(nbytes)
+
+    @property
+    def exceeded(self) -> bool:
+        """Analog of malloc_tracker's memory_exceeded flag
+        (reference: thrill/mem/malloc_tracker.hpp:36-43) which operators
+        consult to trigger spilling (e.g. api/sort.hpp:679)."""
+        return self.limit > 0 and self.total > self.limit
+
+
+@dataclasses.dataclass
+class MemoryConfig:
+    """RAM split between the block pool, operator workspace and float heap.
+
+    Reference: thrill/api/context.cpp:1082-1093 (1/3 each).
+    """
+
+    ram: int
+    ram_block_pool_hard: int
+    ram_block_pool_soft: int
+    ram_workers: int
+    ram_floating: int
+
+    @staticmethod
+    def split(total_ram: int) -> "MemoryConfig":
+        third = total_ram // 3
+        return MemoryConfig(
+            ram=total_ram,
+            ram_block_pool_hard=third,
+            ram_block_pool_soft=int(third * 0.9),
+            ram_workers=third,
+            ram_floating=total_ram - 2 * third,
+        )

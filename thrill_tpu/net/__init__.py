@@ -1,0 +1,3 @@
+from .group import Group, Connection  # noqa: F401
+from .mock import MockNetwork  # noqa: F401
+from .flow import FlowControlChannel, LocalFlowControl  # noqa: F401

@@ -1,0 +1,182 @@
+"""Abstract point-to-point group and generic collective algorithms.
+
+Equivalent of the reference's net::Group / net::Connection and the
+templated collectives implemented generically over connections
+(reference: thrill/net/group.hpp:47, net/connection.hpp:49,
+net/collective.hpp:52-579). Like the reference, collective algorithms are
+implemented *in the framework*, generically over any transport backend
+(mock in-process queues now; TCP across hosts later), and auto-select by
+group size: dissemination prefix-sum, binomial-tree broadcast,
+recursive-doubling all-gather and hypercube all-reduce.
+
+These host-level collectives form the *control plane* — small values,
+blocking semantics. The bulk data plane on TPU is XLA collectives inside
+jitted programs (see net/xla.py); this layer coordinates the Python hosts
+around those device programs (multi-host bootstrap, scalar agreement,
+barriers), the role MPI plays for jax.distributed.
+"""
+
+from __future__ import annotations
+
+import abc
+import operator
+from typing import Any, Callable, List, Optional
+
+
+class Connection(abc.ABC):
+    """Reliable ordered duplex message channel to one peer."""
+
+    @abc.abstractmethod
+    def send(self, obj: Any) -> None: ...
+
+    @abc.abstractmethod
+    def recv(self) -> Any: ...
+
+
+class Group(abc.ABC):
+    """A p-way clique of connections; my_rank in [0, num_hosts)."""
+
+    def __init__(self, my_rank: int, num_hosts: int) -> None:
+        self.my_rank = my_rank
+        self._num_hosts = num_hosts
+
+    @property
+    def num_hosts(self) -> int:
+        return self._num_hosts
+
+    @abc.abstractmethod
+    def connection(self, peer: int) -> Connection: ...
+
+    def send_to(self, peer: int, obj: Any) -> None:
+        self.connection(peer).send(obj)
+
+    def recv_from(self, peer: int) -> Any:
+        return self.connection(peer).recv()
+
+    # ------------------------------------------------------------------
+    # collectives (generic over connections; reference net/collective.hpp)
+    # ------------------------------------------------------------------
+
+    def prefix_sum(self, value: Any, op: Callable = operator.add) -> Any:
+        """Dissemination ("doubling") inclusive prefix sum.
+
+        Reference: PrefixSumDoubling, net/collective.hpp:52. O(log p)
+        rounds; each round r exchanges with rank +/- 2^r.
+        """
+        p = self.num_hosts
+        r = self.my_rank
+        acc = value        # running sum of [r - 2^k + 1 .. r]
+        d = 1
+        while d < p:
+            if r + d < p:
+                self.send_to(r + d, acc)
+            if r - d >= 0:
+                received = self.recv_from(r - d)
+                acc = op(received, acc)
+            d <<= 1
+        return acc
+
+    def _shift_right(self, incl: Any, op: Callable, initial: Any) -> Any:
+        """Turn an inclusive scan result into exclusive by sending the
+        inclusive value to rank+1 (ring shift). The result folds in
+        ``initial`` like the reference's ExPrefixSum: rank 0 returns
+        ``initial``, rank r returns op(initial, incl[r-1])."""
+        p = self.num_hosts
+        r = self.my_rank
+        if r + 1 < p:
+            self.send_to(r + 1, incl)
+        if r > 0:
+            received = self.recv_from(r - 1)
+            return received if initial is None else op(initial, received)
+        return initial
+
+    def ex_prefix_sum(self, value: Any, op: Callable = operator.add,
+                      initial: Any = 0) -> Any:
+        """Exclusive prefix sum (reference: ExPrefixSum, net/collective.hpp:165)."""
+        incl = self.prefix_sum(value, op)
+        return self._shift_right(incl, op, initial)
+
+    def broadcast(self, value: Any, origin: int = 0) -> Any:
+        """Binomial-tree broadcast (reference: BroadcastBinomialTree,
+        net/collective.hpp:205)."""
+        p = self.num_hosts
+        if p == 1:
+            return value
+        # rotate ranks so origin is 0
+        vr = (self.my_rank - origin) % p
+        # binomial tree: parent = vr - lowbit(vr); children = vr + d for
+        # powers of two d < lowbit(vr) (root: all d < p)
+        lowbit = vr & -vr if vr != 0 else p
+        if vr != 0:
+            value = self.recv_from(((vr - lowbit) + origin) % p)
+        d = 1
+        while d < lowbit and vr + d < p:
+            self.send_to((vr + d + origin) % p, value)
+            d <<= 1
+        return value
+
+    def all_gather(self, value: Any) -> List[Any]:
+        """Bruck-style all-gather returning the list ordered by rank.
+
+        Reference: AllGatherRecursiveDoublingPowerOfTwo / AllGatherBruck,
+        net/collective.hpp:260,279. We implement Bruck (works for any p).
+        """
+        p = self.num_hosts
+        r = self.my_rank
+        items: List[Any] = [value]
+        d = 1
+        while len(items) < p:
+            cnt = min(d, p - len(items))
+            self.send_to((r - d) % p, items[:cnt])
+            items.extend(self.recv_from((r + d) % p))
+            d <<= 1
+        # Bruck leaves items rotated: items[i] belongs to rank (r + i) % p.
+        out: List[Any] = [None] * p
+        for i, it in enumerate(items):
+            out[(r + i) % p] = it
+        return out
+
+    def reduce(self, value: Any, op: Callable = operator.add, root: int = 0) -> Optional[Any]:
+        """Binomial-tree reduction to ``root``
+        (reference: Reduce, net/collective.hpp:331)."""
+        p = self.num_hosts
+        vr = (self.my_rank - root) % p
+        acc = value
+        d = 1
+        while d < p:
+            if (vr & d) != 0:
+                self.send_to(((vr - d) + root) % p, acc)
+                return None
+            if vr + d < p:
+                other = self.recv_from(((vr + d) + root) % p)
+                acc = op(acc, other)
+            d <<= 1
+        return acc if vr == 0 else None
+
+    def all_reduce(self, value: Any, op: Callable = operator.add) -> Any:
+        """All-reduce; hypercube for powers of two, reduce+broadcast
+        otherwise (reference: AllReduceHypercube / AllReduceAtRoot +
+        select, net/collective.hpp:414,382,551)."""
+        p = self.num_hosts
+        if p & (p - 1) == 0:
+            acc = value
+            r = self.my_rank
+            d = 1
+            while d < p:
+                peer = r ^ d
+                # symmetric exchange; deterministic order avoids deadlock
+                if r < peer:
+                    self.send_to(peer, acc)
+                    other = self.recv_from(peer)
+                else:
+                    other = self.recv_from(peer)
+                    self.send_to(peer, acc)
+                # keep rank order as operand order for non-commutative ops
+                acc = op(acc, other) if r < peer else op(other, acc)
+                d <<= 1
+            return acc
+        res = self.reduce(value, op, root=0)
+        return self.broadcast(res, origin=0)
+
+    def barrier(self) -> None:
+        self.all_reduce(0, operator.add)

@@ -1,0 +1,66 @@
+"""In-process mock network backend.
+
+Equivalent of the reference's net/mock backend
+(reference: thrill/net/mock/group.hpp:41,116,171): connections enqueue
+messages directly into the peer's queue — always available, no sockets,
+used by the in-process virtual-cluster test harness the same way the
+reference uses mock groups for RunLocalTests on platforms without
+socketpairs.
+"""
+
+from __future__ import annotations
+
+import queue
+from typing import Any, List
+
+from .group import Connection, Group
+
+
+class _MockConnection(Connection):
+    def __init__(self, out_q: "queue.Queue[Any]", in_q: "queue.Queue[Any]") -> None:
+        self._out = out_q
+        self._in = in_q
+
+    def send(self, obj: Any) -> None:
+        self._out.put(obj)
+
+    def recv(self) -> Any:
+        return self._in.get()
+
+
+class MockGroup(Group):
+    def __init__(self, my_rank: int, num_hosts: int,
+                 queues: List[List["queue.Queue[Any]"]]) -> None:
+        super().__init__(my_rank, num_hosts)
+        # queues[src][dst] carries messages src -> dst
+        self._conns = [
+            _MockConnection(queues[my_rank][peer], queues[peer][my_rank])
+            for peer in range(num_hosts)
+        ]
+
+    def connection(self, peer: int) -> Connection:
+        if peer == self.my_rank:
+            raise ValueError("no connection to self")
+        return self._conns[peer]
+
+
+class MockNetwork:
+    """Factory building a full mesh of MockGroups for p in-process hosts.
+
+    Reference analog: mock::Group::ConstructLoopbackMesh
+    (thrill/net/mock/group.hpp) used by ConstructLoopbackHostContexts
+    (thrill/api/context.cpp:92-131).
+    """
+
+    def __init__(self, num_hosts: int) -> None:
+        self.num_hosts = num_hosts
+        self._queues = [[queue.Queue() for _ in range(num_hosts)]
+                        for _ in range(num_hosts)]
+
+    def group(self, rank: int) -> MockGroup:
+        return MockGroup(rank, self.num_hosts, self._queues)
+
+    @staticmethod
+    def construct(num_hosts: int) -> List[MockGroup]:
+        net = MockNetwork(num_hosts)
+        return [net.group(r) for r in range(num_hosts)]
