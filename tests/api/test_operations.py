@@ -1,0 +1,315 @@
+"""API operation tests over RunLocalTests sweeps.
+
+Mirrors the reference's tests/api/operations_test.cpp: every LOp, SOp,
+DOp and Action asserted for algorithmic correctness on several virtual
+cluster sizes in one process.
+"""
+
+import numpy as np
+import pytest
+
+from thrill_tpu.api import (Concat, InnerJoin, Merge, RunLocalTests, Union,
+                            Zip)
+
+SIZES = (1, 2, 5, 8)
+
+
+def sweep(job):
+    res = RunLocalTests(job, worker_counts=SIZES)
+    assert len(res) == len(SIZES)
+    return res
+
+
+def test_generate_map_filter_size_allgather():
+    def job(ctx):
+        d = ctx.Generate(1000)
+        assert d.Keep().Size() == 1000
+        m = d.Map(lambda x: x * 3).Filter(lambda x: x % 2 == 0)
+        got = [int(x) for x in m.AllGather()]
+        assert got == [i * 3 for i in range(1000) if (i * 3) % 2 == 0]
+    sweep(job)
+
+
+def test_generate_with_fn_and_sum():
+    def job(ctx):
+        d = ctx.Generate(500, fn=lambda i: i * 2)
+        assert int(d.Keep().Sum()) == 2 * (499 * 500 // 2)
+        assert int(d.Keep().Min()) == 0
+        assert int(d.Keep().Max()) == 998
+    sweep(job)
+
+
+def test_distribute_roundtrip():
+    def job(ctx):
+        vals = np.arange(100, dtype=np.int64) * 7
+        d = ctx.Distribute(vals)
+        assert [int(x) for x in d.AllGather()] == vals.tolist()
+    sweep(job)
+
+
+def test_host_storage_strings():
+    def job(ctx):
+        d = ctx.Distribute(["a", "bb", "ccc", "dddd"], storage="host")
+        assert d.Keep().Map(len).AllGather() == [1, 2, 3, 4]
+        assert d.Filter(lambda s: len(s) > 2).AllGather() == ["ccc", "dddd"]
+    sweep(job)
+
+
+def test_flatmap_host_and_device():
+    def job(ctx):
+        d = ctx.Generate(10, storage="host").FlatMap(lambda x: [x, -x])
+        assert sorted(d.AllGather()) == sorted(
+            [x for i in range(10) for x in (i, -i)])
+
+        import jax.numpy as jnp
+        dev = ctx.Generate(10).FlatMap(
+            lambda x: [x, -x],
+            device_fn=lambda xs: (jnp.stack([xs, -xs], axis=1),
+                                  jnp.ones((xs.shape[0], 2), bool)),
+            factor=2)
+        assert sorted(int(v) for v in dev.AllGather()) == sorted(
+            [x for i in range(10) for x in (i, -i)])
+    sweep(job)
+
+
+def test_reduce_by_key_device():
+    def job(ctx):
+        rng = np.random.default_rng(1)
+        vals = rng.integers(0, 50, 2000).astype(np.int64)
+        d = ctx.Distribute(vals)
+        out = d.Map(lambda x: (x, 1)).ReducePair(lambda a, b: a + b)
+        got = {int(k): int(v) for k, v in out.AllGather()}
+        want = {}
+        for v in vals.tolist():
+            want[v] = want.get(v, 0) + 1
+        assert got == want
+    sweep(job)
+
+
+def test_reduce_by_key_host_strings():
+    def job(ctx):
+        words = ["apple", "banana", "apple", "cherry", "banana", "apple"]
+        d = ctx.Distribute(words, storage="host")
+        out = d.Map(lambda w: (w, 1)).ReduceByKey(
+            lambda kv: kv[0], lambda a, b: (a[0], a[1] + b[1]))
+        got = dict(out.AllGather())
+        assert got == {"apple": 3, "banana": 2, "cherry": 1}
+    sweep(job)
+
+
+def test_reduce_to_index():
+    def job(ctx):
+        vals = np.arange(200, dtype=np.int64)
+        out = ctx.Distribute(vals).ReduceToIndex(
+            lambda x: x % 10, lambda a, b: a + b, 10, neutral=0)
+        got = np.array([int(x) for x in out.AllGather()])
+        want = np.zeros(10, dtype=np.int64)
+        for v in vals:
+            want[v % 10] += v
+        assert np.array_equal(got, want)
+    sweep(job)
+
+
+def test_sort_random_and_duplicates():
+    def job(ctx):
+        rng = np.random.default_rng(2)
+        vals = rng.integers(0, 100, 3000).astype(np.int64)  # many dups
+        out = ctx.Distribute(vals).Sort()
+        assert [int(x) for x in out.AllGather()] == sorted(vals.tolist())
+    sweep(job)
+
+
+def test_sort_stable_pairs():
+    def job(ctx):
+        rng = np.random.default_rng(3)
+        keys = rng.integers(0, 5, 500).astype(np.int64)
+        vals = np.arange(500, dtype=np.int64)
+        d = ctx.Distribute(keys).ZipWithIndex(lambda k, i: (k, i))
+        out = d.SortStable(key_fn=lambda kv: kv[0])
+        got = [(int(k), int(v)) for k, v in out.AllGather()]
+        want = sorted(zip(keys.tolist(), vals.tolist()), key=lambda kv: kv[0])
+        assert got == want  # python sort is stable -> exact match required
+    sweep(job)
+
+
+def test_prefix_sums():
+    def job(ctx):
+        vals = np.arange(1, 101, dtype=np.int64)
+        incl = ctx.Distribute(vals).PrefixSum()
+        assert [int(x) for x in incl.AllGather()] == \
+            np.cumsum(vals).tolist()
+        excl = ctx.Distribute(vals).ExPrefixSum(initial=100)
+        assert [int(x) for x in excl.AllGather()] == \
+            (100 + np.cumsum(np.concatenate([[0], vals]))[:-1]).tolist()
+    sweep(job)
+
+
+def test_zip_modes():
+    def job(ctx):
+        a = ctx.Generate(30)
+        b = ctx.Generate(30, fn=lambda i: i * 10)
+        z = Zip(a, b, zip_fn=lambda x, y: x + y)
+        assert [int(v) for v in z.AllGather()] == [11 * i for i in range(30)]
+        # cut mode with unequal sizes
+        c = ctx.Generate(50)
+        d = ctx.Generate(20, fn=lambda i: i * 2)
+        zc = Zip(c, d, zip_fn=lambda x, y: y - x, mode="cut")
+        assert [int(v) for v in zc.AllGather()] == [i for i in range(20)]
+    sweep(job)
+
+
+def test_zip_with_index():
+    def job(ctx):
+        d = ctx.Distribute(np.array([9, 8, 7, 6], dtype=np.int64))
+        out = d.ZipWithIndex()
+        assert [(int(a), int(b)) for a, b in out.AllGather()] == \
+            [(9, 0), (8, 1), (7, 2), (6, 3)]
+    sweep(job)
+
+
+def test_window():
+    def job(ctx):
+        d = ctx.Generate(20, storage="host")
+        w = d.Window(3, lambda i, win: sum(win))
+        assert w.AllGather() == [sum(range(i, i + 3)) for i in range(18)]
+
+        import jax.numpy as jnp
+        dev = ctx.Generate(20).Window(
+            3, lambda i, win: sum(win),
+            device_fn=lambda wins: jnp.sum(wins, axis=1))
+        assert [int(v) for v in dev.AllGather()] == \
+            [sum(range(i, i + 3)) for i in range(18)]
+    sweep(job)
+
+
+def test_disjoint_window():
+    def job(ctx):
+        d = ctx.Generate(20, storage="host")
+        w = d.DisjointWindow(5, lambda i, win: max(win))
+        assert w.AllGather() == [4, 9, 14, 19]
+    sweep(job)
+
+
+def test_concat_and_rebalance():
+    def job(ctx):
+        a = ctx.Generate(25)
+        b = ctx.Generate(10, fn=lambda i: i + 1000)
+        c = Concat(a, b)
+        assert [int(v) for v in c.AllGather()] == \
+            list(range(25)) + [1000 + i for i in range(10)]
+        # rebalance after skewing filter
+        r = ctx.Generate(100).Filter(lambda x: x < 20).Rebalance()
+        assert [int(v) for v in r.AllGather()] == list(range(20))
+    sweep(job)
+
+
+def test_union():
+    def job(ctx):
+        a = ctx.Generate(10)
+        b = ctx.Generate(5, fn=lambda i: i + 100)
+        u = Union(a, b)
+        assert sorted(int(v) for v in u.AllGather()) == sorted(
+            list(range(10)) + [100 + i for i in range(5)])
+    sweep(job)
+
+
+def test_merge_sorted():
+    def job(ctx):
+        a = ctx.Distribute(np.arange(0, 40, 2).astype(np.int64))   # evens
+        b = ctx.Distribute(np.arange(1, 40, 2).astype(np.int64))   # odds
+        m = Merge(a, b)
+        assert [int(v) for v in m.AllGather()] == list(range(40))
+    sweep(job)
+
+
+def test_group_by_key():
+    def job(ctx):
+        vals = np.arange(100, dtype=np.int64)
+        out = ctx.Distribute(vals).GroupByKey(
+            lambda x: x % 7, lambda k, items: (int(k), len(list(items))))
+        got = dict(out.AllGather())
+        want = {}
+        for v in vals.tolist():
+            want[v % 7] = want.get(v % 7, 0) + 1
+        assert got == want
+    sweep(job)
+
+
+def test_group_to_index():
+    def job(ctx):
+        vals = np.arange(30, dtype=np.int64)
+        out = ctx.Distribute(vals).GroupToIndex(
+            lambda x: x % 5, lambda i, items: sum(int(x) for x in items),
+            5, neutral=-1)
+        got = out.AllGather()
+        want = [sum(v for v in range(30) if v % 5 == i) for i in range(5)]
+        assert got == want
+    sweep(job)
+
+
+def test_inner_join_device():
+    def job(ctx):
+        left = ctx.Distribute(np.arange(50, dtype=np.int64)).Map(
+            lambda x: (x % 10, x))
+        right = ctx.Distribute(np.arange(10, dtype=np.int64)).Map(
+            lambda x: (x, x * 100))
+        j = InnerJoin(left, right,
+                      lambda kv: kv[0], lambda kv: kv[0],
+                      lambda l, r: (l[1], r[1]))
+        got = sorted((int(a), int(b)) for a, b in j.AllGather())
+        want = sorted((x, (x % 10) * 100) for x in range(50))
+        assert got == want
+    sweep(job)
+
+
+def test_inner_join_host():
+    def job(ctx):
+        l = ctx.Distribute([("a", 1), ("b", 2), ("a", 3)], storage="host")
+        r = ctx.Distribute([("a", 10), ("c", 30)], storage="host")
+        j = InnerJoin(l, r, lambda kv: kv[0], lambda kv: kv[0],
+                      lambda lv, rv: (lv[0], lv[1], rv[1]))
+        assert sorted(j.AllGather()) == [("a", 1, 10), ("a", 3, 10)]
+    sweep(job)
+
+
+def test_sample_and_bernoulli():
+    def job(ctx):
+        d = ctx.Generate(1000)
+        s = d.Keep().Sample(100)
+        items = [int(x) for x in s.AllGather()]
+        assert len(items) == 100 and len(set(items)) == 100
+        assert all(0 <= x < 1000 for x in items)
+        b = d.BernoulliSample(0.3, seed=7)
+        n = b.Size()
+        assert 150 < n < 450  # loose 3-sigma-ish bounds
+    sweep(job)
+
+
+def test_hyperloglog():
+    def job(ctx):
+        d = ctx.Generate(20000, fn=lambda i: i % 5000)
+        est = d.HyperLogLog(precision=12)
+        assert 4500 < est < 5500
+    sweep(job)
+
+
+def test_cache_and_collapse():
+    def job(ctx):
+        d = ctx.Generate(100).Map(lambda x: x + 1).Cache()
+        assert d.Keep().Size() == 100
+        assert int(d.Keep().Sum()) == sum(range(1, 101))
+        c = ctx.Generate(10).Filter(lambda x: x % 2 == 0).Collapse()
+        assert [int(v) for v in c.AllGather()] == [0, 2, 4, 6, 8]
+    sweep(job)
+
+
+def test_execute_and_dispose_semantics():
+    def job(ctx):
+        d = ctx.Generate(50).Map(lambda x: x * 2).Cache()
+        d.Execute()
+        assert d.node.state == "EXECUTED"
+        assert d.Keep().Size() == 50
+        d.Dispose()
+        with pytest.raises(RuntimeError):
+            d.Size()
+    sweep(job)

@@ -89,3 +89,12 @@ def test_stable_host_hash_big_ints():
     assert stable_host_hash(2 ** 63) != stable_host_hash(2 ** 63 + 1)
     assert isinstance(stable_host_hash(-2 ** 63 - 1), int)
     assert stable_host_hash(2 ** 64 + 5) == stable_host_hash(5)
+
+
+def test_stable_host_hash_numeric_tower():
+    # equal values must hash equal (dict-partitioning consistency)
+    assert stable_host_hash(True) == stable_host_hash(1)
+    assert stable_host_hash(False) == stable_host_hash(0)
+    assert stable_host_hash(5.0) == stable_host_hash(5)
+    assert stable_host_hash(-0.0) == stable_host_hash(0.0)
+    assert stable_host_hash(2.5) != stable_host_hash(2)

@@ -33,12 +33,17 @@ def run_group(num_hosts, job):
                for i, g in enumerate(groups)]
     for t in threads:
         t.start()
+    stuck = []
     for t in threads:
         t.join(timeout=15)
-        assert not t.is_alive(), "collective deadlocked"
+        if t.is_alive():
+            stuck.append(t)
+    # surface real worker exceptions before the deadlock verdict: a
+    # raising worker leaves its peers blocked, which is not a deadlock
     for e in errors:
         if e is not None:
             raise e
+    assert not stuck, "collective deadlocked"
     return results
 
 

@@ -1,0 +1,2 @@
+from .context import Context, Run, RunLocalMock, RunLocalTests  # noqa: F401
+from .dia import DIA, Concat, InnerJoin, Merge, Union, Zip, ZipWindow  # noqa: F401

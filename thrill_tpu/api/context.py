@@ -1,0 +1,139 @@
+"""Context, runtime bootstrap and the local test harness.
+
+Equivalent of the reference's Context/HostContext/Run machinery
+(reference: thrill/api/context.hpp:90-448, context.cpp:336-341,947-1013):
+``Run`` bootstraps a runtime and hands the user job a Context; the job
+builds and executes DIA pipelines against it.
+
+Single-controller translation: one Context drives all W logical workers
+(one per mesh device). ``RunLocalTests`` replicates the reference's
+in-process virtual-cluster sweep — the same job body runs on meshes of
+several sizes over XLA host-platform devices, no cluster needed.
+
+Multi-host: call ``thrill_tpu.api.Run`` after ``jax.distributed``
+initialization and the mesh spans all hosts' devices; each host runs the
+same single-controller program (standard JAX multi-controller SPMD).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from ..common.config import Config
+from ..common.logger import JsonLogger, default_log_path
+from ..mem.manager import MemoryManager
+from ..net.flow import LocalFlowControl
+from ..parallel.mesh import MeshExec
+
+
+class Context:
+    """Runtime handle passed to user jobs; owns the mesh and services."""
+
+    def __init__(self, mesh_exec: Optional[MeshExec] = None,
+                 config: Optional[Config] = None, seed: int = 0) -> None:
+        self.config = config or Config.from_env()
+        self.mesh_exec = mesh_exec or MeshExec(
+            num_workers=self.config.num_workers)
+        self.flow = LocalFlowControl(self.num_workers)
+        self.logger = JsonLogger(
+            default_log_path(self.config.log_path, host_rank=0),
+            program="thrill_tpu", workers=self.num_workers)
+        self.mem = MemoryManager(name="context")
+        self.rng = np.random.default_rng(seed)
+        self._nodes: List[Any] = []
+
+    # -- identity -------------------------------------------------------
+    @property
+    def num_workers(self) -> int:
+        return self.mesh_exec.num_workers
+
+    def _register_node(self, node) -> int:
+        self._nodes.append(node)
+        return len(self._nodes) - 1
+
+    # -- sources (created lazily like every DIA op) ---------------------
+    def Generate(self, size: int, fn: Optional[Callable] = None,
+                 storage: Optional[str] = None):
+        from .ops import sources
+        return sources.Generate(self, size, fn, storage)
+
+    def Distribute(self, items, storage: Optional[str] = None):
+        from .ops import sources
+        return sources.Distribute(self, items, storage)
+
+    def EqualToDIA(self, items, storage: Optional[str] = None):
+        """Every-worker-identical local data -> DIA (reference:
+        api/equal_to_dia.hpp:30; here identical by construction)."""
+        from .ops import sources
+        return sources.Distribute(self, items, storage)
+
+    def ConcatToDIA(self, per_worker_items, storage: Optional[str] = None):
+        from .ops import sources
+        return sources.ConcatToDIA(self, per_worker_items, storage)
+
+    def ReadLines(self, path_or_glob: str):
+        from .ops import read_write
+        return read_write.ReadLines(self, path_or_glob)
+
+    def ReadBinary(self, path_or_glob: str, dtype, record_shape=()):
+        from .ops import read_write
+        return read_write.ReadBinary(self, path_or_glob, dtype, record_shape)
+
+    def close(self) -> None:
+        self.logger.close()
+
+
+# ----------------------------------------------------------------------
+# runtime bootstrap
+# ----------------------------------------------------------------------
+
+def Run(job: Callable[[Context], Any], config: Optional[Config] = None,
+        devices: Optional[Sequence[Any]] = None, seed: int = 0) -> Any:
+    """Run a job on all (or the configured number of) local devices."""
+    mex = MeshExec(devices=devices,
+                   num_workers=(config or Config.from_env()).num_workers)
+    ctx = Context(mex, config, seed)
+    try:
+        return job(ctx)
+    finally:
+        ctx.close()
+
+
+def RunLocalMock(job: Callable[[Context], Any], workers: int,
+                 config: Optional[Config] = None, seed: int = 0) -> Any:
+    """Run on a fixed-size virtual CPU mesh (reference: RunLocalMock)."""
+    cpus = jax.devices("cpu")
+    if workers > len(cpus):
+        raise ValueError(
+            f"need {workers} CPU devices; set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={workers}")
+    mex = MeshExec(devices=cpus[:workers])
+    ctx = Context(mex, config, seed)
+    try:
+        return job(ctx)
+    finally:
+        ctx.close()
+
+
+def RunLocalTests(job: Callable[[Context], Any],
+                  worker_counts: Sequence[int] = (1, 2, 5, 8),
+                  config: Optional[Config] = None) -> List[Any]:
+    """Sweep the job over several virtual cluster sizes in-process.
+
+    The single most valuable testing harness of the reference
+    (api::RunLocalTests, thrill/api/context.cpp:336-341, sweeping mock
+    clusters of {1,2,5,8} hosts x {1,3} workers).
+    """
+    cpus = jax.devices("cpu")
+    max_w = int(os.environ.get("THRILL_TPU_MAX_MOCK_WORKERS", "64"))
+    results = []
+    for w in worker_counts:
+        if w > len(cpus) or w > max_w:
+            continue
+        results.append(RunLocalMock(job, w, config))
+    return results

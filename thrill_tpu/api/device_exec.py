@@ -1,0 +1,48 @@
+"""Device-side execution helpers shared by operator implementations."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.shards import DeviceShards, compact_valid
+from .stack import Stack, apply_stack_traced, stack_cache_token
+
+
+def apply_stack_device(shards: DeviceShards, stack: Stack) -> DeviceShards:
+    """Apply an LOp stack to device shards as one fused jitted program.
+
+    Compacts valid items to the front and refreshes per-worker counts
+    (one tiny device->host transfer for the counts).
+    """
+    if not stack:
+        return shards
+    mex = shards.mesh_exec
+    cap = shards.cap
+    leaves, treedef = jax.tree.flatten(shards.tree)
+    key = ("stack", stack_cache_token(stack), cap, treedef,
+           tuple((l.dtype, l.shape[2:]) for l in leaves))
+    holder = {}
+
+    def build():
+        def f(counts_dev, *ls):
+            count = counts_dev[0, 0]
+            mask = jnp.arange(cap) < count
+            tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+            tree, mask = apply_stack_traced(tree, mask, stack)
+            tree, new_count = compact_valid(tree, mask)
+            out_leaves, out_treedef = jax.tree.flatten(tree)
+            holder["treedef"] = out_treedef
+            return (new_count[None, None].astype(jnp.int32),
+                    *[l[None] for l in out_leaves])
+
+        return mex.smap(f, 1 + len(leaves)), holder
+
+    fn, h = mex.cached(key, build)
+    out = fn(shards.counts_device(), *leaves)
+    new_counts = np.asarray(out[0]).reshape(-1).astype(np.int64)
+    tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
+    return DeviceShards(mex, tree, new_counts)

@@ -1,0 +1,239 @@
+"""The DIA handle: a lazily evaluated distributed immutable array.
+
+Equivalent of the reference's ``DIA<ValueType, Stack>``
+(reference: thrill/api/dia.hpp:141): a cheap handle = node pointer +
+stack of fused local operations. Chaining ``Map``/``Filter``/``FlatMap``
+never touches data — it extends the stack; distributed operations cut
+the stack by constructing a new DAG node; actions trigger execution.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from .dia_base import DIABase, ParentLink
+from .stack import Stack, StackOp
+
+
+class DIA:
+    def __init__(self, node: DIABase, stack: Stack = ()) -> None:
+        self.node = node
+        self.stack = stack
+
+    @property
+    def context(self):
+        return self.node.context
+
+    def _link(self) -> ParentLink:
+        return ParentLink(self.node, self.stack)
+
+    # ------------------------------------------------------------------
+    # local ops (stack pushes; reference api/dia.hpp:358,405,458)
+    # ------------------------------------------------------------------
+    def Map(self, fn: Callable) -> "DIA":
+        return DIA(self.node, self.stack + (StackOp("map", fn),))
+
+    def Filter(self, fn: Callable) -> "DIA":
+        return DIA(self.node, self.stack + (StackOp("filter", fn),))
+
+    def FlatMap(self, fn: Callable, device_fn: Optional[Callable] = None,
+                factor: int = 1) -> "DIA":
+        """Host: ``fn(item) -> iterable``. Device storage additionally
+        needs the batched form ``device_fn(tree) -> (tree[n,k,...],
+        valid[n,k])`` with static ``factor`` k; without it the pipeline
+        falls back to host storage at this point."""
+        from .ops import lop_nodes
+        if device_fn is not None:
+            return DIA(self.node, self.stack +
+                       (StackOp("flat_map", device_fn, factor),))
+        return lop_nodes.flat_map_host(self, fn)
+
+    def BernoulliSample(self, p: float, seed: int = 0) -> "DIA":
+        from .ops import sample
+        return sample.BernoulliSample(self, p, seed)
+
+    # ------------------------------------------------------------------
+    # distributed ops
+    # ------------------------------------------------------------------
+    def ReduceByKey(self, key_fn: Callable, reduce_fn: Callable) -> "DIA":
+        from .ops import reduce as _r
+        return _r.ReduceByKey(self, key_fn, reduce_fn)
+
+    def ReducePair(self, reduce_fn: Callable) -> "DIA":
+        """Items are (key, value) pairs; reduce_fn combines values."""
+        from .ops import reduce as _r
+        return _r.ReducePair(self, reduce_fn)
+
+    def ReduceToIndex(self, index_fn: Callable, reduce_fn: Callable,
+                      size: int, neutral: Any = None) -> "DIA":
+        from .ops import reduce as _r
+        return _r.ReduceToIndex(self, index_fn, reduce_fn, size, neutral)
+
+    def GroupByKey(self, key_fn: Callable, group_fn: Callable) -> "DIA":
+        from .ops import groupby
+        return groupby.GroupByKey(self, key_fn, group_fn)
+
+    def GroupToIndex(self, index_fn: Callable, group_fn: Callable,
+                     size: int, neutral: Any = None) -> "DIA":
+        from .ops import groupby
+        return groupby.GroupToIndex(self, index_fn, group_fn, size, neutral)
+
+    def Sort(self, key_fn: Optional[Callable] = None,
+             compare_fn: Optional[Callable] = None) -> "DIA":
+        from .ops import sort as _s
+        return _s.Sort(self, key_fn, compare_fn, stable=False)
+
+    def SortStable(self, key_fn: Optional[Callable] = None,
+                   compare_fn: Optional[Callable] = None) -> "DIA":
+        from .ops import sort as _s
+        return _s.Sort(self, key_fn, compare_fn, stable=True)
+
+    def PrefixSum(self, fn: Callable = None, initial: Any = 0) -> "DIA":
+        from .ops import prefix_sum as _p
+        return _p.PrefixSum(self, fn, initial, inclusive=True)
+
+    def ExPrefixSum(self, fn: Callable = None, initial: Any = 0) -> "DIA":
+        from .ops import prefix_sum as _p
+        return _p.PrefixSum(self, fn, initial, inclusive=False)
+
+    def ZipWithIndex(self, zip_fn: Callable = None) -> "DIA":
+        from .ops import zip_ as _z
+        return _z.ZipWithIndex(self, zip_fn)
+
+    def Window(self, k: int, fn: Callable,
+               device_fn: Optional[Callable] = None) -> "DIA":
+        from .ops import window as _w
+        return _w.Window(self, k, fn, device_fn, disjoint=False)
+
+    def FlatWindow(self, k: int, fn: Callable) -> "DIA":
+        from .ops import window as _w
+        return _w.FlatWindow(self, k, fn)
+
+    def DisjointWindow(self, k: int, fn: Callable,
+                       device_fn: Optional[Callable] = None) -> "DIA":
+        from .ops import window as _w
+        return _w.Window(self, k, fn, device_fn, disjoint=True)
+
+    def Concat(self, other: "DIA") -> "DIA":
+        from .ops import concat as _c
+        return _c.Concat(self, other)
+
+    def Union(self, *others: "DIA") -> "DIA":
+        from .ops import union as _u
+        return _u.Union(self, *others)
+
+    def Rebalance(self) -> "DIA":
+        from .ops import rebalance as _rb
+        return _rb.Rebalance(self)
+
+    def Sample(self, k: int, seed: int = 0) -> "DIA":
+        from .ops import sample as _sm
+        return _sm.Sample(self, k, seed)
+
+    # ------------------------------------------------------------------
+    # consume control / materialization nodes
+    # ------------------------------------------------------------------
+    def Keep(self, n: int = 1) -> "DIA":
+        self.node.keep(n)
+        return self
+
+    def Cache(self) -> "DIA":
+        from .ops import cache as _ca
+        return _ca.Cache(self)
+
+    def Collapse(self) -> "DIA":
+        from .ops import cache as _ca
+        return _ca.Collapse(self)
+
+    def Execute(self) -> "DIA":
+        self.node.materialize()
+        return self
+
+    def Dispose(self) -> None:
+        self.node.dispose()
+
+    # ------------------------------------------------------------------
+    # actions
+    # ------------------------------------------------------------------
+    def Size(self) -> int:
+        from .ops import actions
+        return actions.Size(self)
+
+    def AllGather(self) -> list:
+        from .ops import actions
+        return actions.AllGather(self)
+
+    def Gather(self, root: int = 0) -> list:
+        from .ops import actions
+        return actions.Gather(self, root)
+
+    def Print(self, label: str = "", limit: int = 100) -> "DIA":
+        from .ops import actions
+        actions.Print(self, label, limit)
+        return self
+
+    def AllReduce(self, fn: Callable, initial: Any = None) -> Any:
+        from .ops import actions
+        return actions.AllReduce(self, fn, initial)
+
+    def Sum(self, fn: Callable = None, initial: Any = 0) -> Any:
+        from .ops import actions
+        return actions.Sum(self, initial)
+
+    def Min(self) -> Any:
+        from .ops import actions
+        return actions.MinMax(self, is_min=True)
+
+    def Max(self) -> Any:
+        from .ops import actions
+        return actions.MinMax(self, is_min=False)
+
+    def HyperLogLog(self, precision: int = 14) -> float:
+        from .ops import hll
+        return hll.HyperLogLog(self, precision)
+
+    def WriteLines(self, path_pattern: str) -> None:
+        from .ops import read_write
+        read_write.WriteLines(self, path_pattern)
+
+    def WriteLinesOne(self, path: str) -> None:
+        from .ops import read_write
+        read_write.WriteLinesOne(self, path)
+
+    def WriteBinary(self, path_pattern: str) -> None:
+        from .ops import read_write
+        read_write.WriteBinary(self, path_pattern)
+
+
+# ----------------------------------------------------------------------
+# free functions over multiple DIAs
+# ----------------------------------------------------------------------
+
+def Zip(*dias: DIA, zip_fn: Callable = None, mode: str = "strict") -> DIA:
+    from .ops import zip_ as _z
+    return _z.Zip(list(dias), zip_fn, mode)
+
+def ZipWindow(window: tuple, *dias: DIA, zip_fn: Callable = None) -> DIA:
+    from .ops import zip_ as _z
+    return _z.ZipWindowOp(list(dias), window, zip_fn)
+
+
+def Merge(*dias: DIA, key_fn: Callable = None) -> DIA:
+    from .ops import merge as _m
+    return _m.Merge(list(dias), key_fn)
+
+
+def Concat(*dias: DIA) -> DIA:
+    from .ops import concat as _c
+    return _c.ConcatMany(list(dias))
+
+
+def Union(*dias: DIA) -> DIA:
+    from .ops import union as _u
+    return _u.UnionMany(list(dias))
+
+
+def InnerJoin(left: DIA, right: DIA, left_key_fn: Callable,
+              right_key_fn: Callable, join_fn: Callable) -> DIA:
+    from .ops import join as _j
+    return _j.InnerJoin(left, right, left_key_fn, right_key_fn, join_fn)

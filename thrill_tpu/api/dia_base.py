@@ -1,0 +1,106 @@
+"""DIA graph nodes and the stage driver.
+
+Equivalent of the reference's DIABase / DIANode / StageBuilder
+(reference: thrill/api/dia_base.hpp:87 states NEW/EXECUTED/DISPOSED,
+dia_base.cpp:302-442 FindStages + toposort + Execute/PushData per stage,
+dia_node.hpp:123-177 RunPushData / consume counters).
+
+Single-controller translation: an action triggers ``materialize()`` on
+its parents, which recursively executes ancestor nodes in deterministic
+node-id order (the recursion *is* the reference's BFS-up + toposort,
+since ids increase in construction order and parents always precede
+children). Results cache on the node (state EXECUTED) until disposed;
+``Keep()`` raises the consume budget exactly like the reference's
+consume counters, so memory can be reclaimed mid-pipeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+from ..data.shards import DeviceShards, HostShards
+from .stack import Stack, apply_stack_host_list, stack_cache_token
+
+Shards = Union[DeviceShards, HostShards]
+
+NEW = "NEW"
+EXECUTED = "EXECUTED"
+DISPOSED = "DISPOSED"
+
+
+@dataclasses.dataclass
+class ParentLink:
+    """A DOp's link to a parent node plus the LOp stack fused on the edge."""
+    node: "DIABase"
+    stack: Stack
+
+    def pull(self, consume: bool = False) -> Shards:
+        shards = self.node.materialize(consume=consume)
+        if not self.stack:
+            return shards
+        if isinstance(shards, HostShards):
+            return HostShards(shards.num_workers,
+                              [apply_stack_host_list(l, self.stack)
+                               for l in shards.lists])
+        from .device_exec import apply_stack_device
+        return apply_stack_device(shards, self.stack)
+
+    def cache_token(self) -> Tuple:
+        return (self.node.id, stack_cache_token(self.stack))
+
+
+class DIABase:
+    """A node of the DIA dataflow DAG."""
+
+    def __init__(self, ctx, label: str,
+                 parents: Sequence[ParentLink] = ()) -> None:
+        self.context = ctx
+        self.label = label
+        self.parents: List[ParentLink] = list(parents)
+        self.id = ctx._register_node(self)
+        self.state = NEW
+        self._shards: Optional[Shards] = None
+        # number of remaining consuming pulls before data may be freed;
+        # reference: consume counters, api/dia_base.hpp:226-250
+        self.consume_budget = 0
+
+    # -- overridables ---------------------------------------------------
+    def compute(self) -> Shards:
+        """Produce this node's output shards (the DOp main op + push)."""
+        raise NotImplementedError
+
+    # -- driver ---------------------------------------------------------
+    def materialize(self, consume: bool = False) -> Shards:
+        if self.state == DISPOSED:
+            raise RuntimeError(
+                f"DIA node {self.label}#{self.id} was consumed/disposed; "
+                f"call .Keep() before reusing a DIA")
+        if self._shards is None:
+            log = self.context.logger
+            if log.enabled:
+                log.line(event="node_execute_start", node=self.label,
+                         dia_id=self.id)
+            self._shards = self.compute()
+            self.state = EXECUTED
+            if log.enabled:
+                log.line(event="node_execute_done", node=self.label,
+                         dia_id=self.id,
+                         items=int(self._shards.counts.sum()))
+        result = self._shards
+        if consume:
+            self.consume_budget -= 1
+            if self.consume_budget <= 0:
+                self._shards = None
+                self.state = DISPOSED
+        return result
+
+    def keep(self, n: int = 1) -> None:
+        self.consume_budget += n
+
+    def dispose(self) -> None:
+        self._shards = None
+        self.state = DISPOSED
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.label}#{self.id} {self.state}>"

@@ -1,0 +1,127 @@
+"""Actions: DAG sinks that trigger execution and return results.
+
+Reference: thrill/api/size.hpp:28 (local count + AllReduce),
+all_gather.hpp:28, gather.hpp:28, all_reduce.hpp:28, sum.hpp, min.hpp,
+max.hpp, print.hpp. On the device path reductions run as one jitted
+SPMD program (masked local fold + psum/pmax/pmin over the mesh axis) —
+the analog of local fold + FlowControlChannel::AllReduce.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ...data.shards import DeviceShards, HostShards
+from ...parallel.mesh import AXIS
+
+
+def _pull(dia, consume: bool = False):
+    return dia._link().pull(consume)
+
+
+def Size(dia) -> int:
+    shards = _pull(dia)
+    return int(shards.counts.sum())
+
+
+def AllGather(dia) -> list:
+    shards = _pull(dia)
+    if isinstance(shards, DeviceShards):
+        shards = shards.to_host_shards()
+    return [it for l in shards.lists for it in l]
+
+
+def Gather(dia, root: int = 0) -> list:
+    return AllGather(dia)
+
+
+def Print(dia, label: str = "", limit: int = 100) -> None:
+    items = AllGather(dia)
+    head = items[:limit]
+    suffix = f" ... (+{len(items) - limit} more)" if len(items) > limit else ""
+    print(f"[{label or 'DIA'}] n={len(items)}: {head}{suffix}")
+
+
+def _device_reduce(shards: DeviceShards, mode: str):
+    """One SPMD program: masked local fold + cross-worker collective."""
+    mex = shards.mesh_exec
+    cap = shards.cap
+    leaves, treedef = jax.tree.flatten(shards.tree)
+    key = ("reduce_action", mode, cap, treedef,
+           tuple((l.dtype, l.shape[2:]) for l in leaves))
+
+    def build():
+        def f(counts_dev, *ls):
+            mask = jnp.arange(cap) < counts_dev[0, 0]
+            outs = []
+            for l in ls:
+                x = l[0]
+                m = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+                if mode == "sum":
+                    local = jnp.sum(jnp.where(m, x, 0), axis=0)
+                    outs.append(lax.psum(local, AXIS))
+                elif mode == "min":
+                    big = _dtype_max(x.dtype)
+                    local = jnp.min(jnp.where(m, x, big), axis=0)
+                    outs.append(lax.pmin(local, AXIS))
+                else:
+                    small = _dtype_min(x.dtype)
+                    local = jnp.max(jnp.where(m, x, small), axis=0)
+                    outs.append(lax.pmax(local, AXIS))
+            return tuple(outs)
+
+        from jax.sharding import PartitionSpec as P
+        return mex.smap(f, 1 + len(leaves), out_specs=P())
+
+    fn = mex.cached(key, build)
+    out = fn(shards.counts_device(), *leaves)
+    vals = [np.asarray(o) for o in out]
+    vals = [v.item() if v.ndim == 0 else v for v in vals]
+    return jax.tree.unflatten(treedef, vals)
+
+
+def _dtype_max(dt):
+    return jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).max
+
+
+def _dtype_min(dt):
+    return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min
+
+
+def Sum(dia, initial: Any = 0) -> Any:
+    shards = _pull(dia)
+    if isinstance(shards, DeviceShards):
+        if shards.total == 0:
+            return initial
+        return _device_reduce(shards, "sum")
+    items = [it for l in shards.lists for it in l]
+    return functools.reduce(lambda a, b: a + b, items, initial)
+
+
+def MinMax(dia, is_min: bool) -> Any:
+    shards = _pull(dia)
+    if shards.total == 0:
+        raise ValueError("Min/Max of empty DIA")
+    if isinstance(shards, DeviceShards):
+        return _device_reduce(shards, "min" if is_min else "max")
+    items = [it for l in shards.lists for it in l]
+    return min(items) if is_min else max(items)
+
+
+def AllReduce(dia, fn: Callable, initial: Any = None) -> Any:
+    """Generic associative fold over all items (any storage)."""
+    items = AllGather(dia)
+    if not items:
+        if initial is None:
+            raise ValueError("AllReduce of empty DIA without initial")
+        return initial
+    acc = items[0] if initial is None else fn(initial, items[0])
+    for it in items[1:]:
+        acc = fn(acc, it)
+    return acc

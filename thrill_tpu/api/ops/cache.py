@@ -1,0 +1,38 @@
+"""Cache and Collapse nodes.
+
+Reference: thrill/api/cache.hpp:32 (materialize items for reuse) and
+collapse.hpp:29 (fold a non-empty LOp stack into a plain DIA<T>, e.g.
+for loop variables whose type must not depend on the stack).
+"""
+
+from __future__ import annotations
+
+from ..dia import DIA
+from ..dia_base import DIABase
+
+
+class CacheNode(DIABase):
+    def __init__(self, ctx, link) -> None:
+        super().__init__(ctx, "Cache", [link])
+
+    def compute(self):
+        return self.parents[0].pull()
+
+
+class CollapseNode(DIABase):
+    """Same materialization behavior; semantically folds the stack so
+    the handle is a plain DIA (loop-variable pattern)."""
+
+    def __init__(self, ctx, link) -> None:
+        super().__init__(ctx, "Collapse", [link])
+
+    def compute(self):
+        return self.parents[0].pull()
+
+
+def Cache(dia: DIA) -> DIA:
+    return DIA(CacheNode(dia.context, dia._link()))
+
+
+def Collapse(dia: DIA) -> DIA:
+    return DIA(CollapseNode(dia.context, dia._link()))

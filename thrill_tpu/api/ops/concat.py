@@ -1,0 +1,197 @@
+"""Concat and Rebalance: even redistribution preserving global order.
+
+Reference: thrill/api/concat.hpp:35 (globally rebalanced concatenation)
+and rebalance.hpp:30 (even redistribution after skew, e.g. Filter).
+
+Device path: items carry their target global index; the exchange routes
+them to the worker owning that index under an even split, and a local
+sort by carried index restores order (the analog of the reference's
+CatStream rank-ordered concatenation). This is the same halo-free
+"sequence re-sharding" primitive that long-sequence pipelines use to
+re-balance 1-D sharded token streams.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data import exchange
+from ...data.shards import DeviceShards, HostShards
+from ..dia import DIA
+from ..dia_base import DIABase
+
+
+def rebalance_to_even(mex, parts: List[DeviceShards], token) -> DeviceShards:
+    """Concatenate device shard groups in order, evenly re-split.
+
+    Each part keeps its internal worker-major order; parts concatenate in
+    list order. One carrier exchange + one order-restoring local sort.
+    """
+    W = mex.num_workers
+    # global index base for each (part, worker)
+    n_total = 0
+    carriers = []
+    for pi, p in enumerate(parts):
+        offs = np.concatenate([[0], np.cumsum(p.counts)])[:-1] + n_total
+        n_total += p.total
+        cap = p.cap
+        leaves, treedef = jax.tree.flatten(p.tree)
+        key = ("concat_tag", token, pi, cap, treedef,
+               tuple((l.dtype, l.shape[2:]) for l in leaves))
+        holder = {}
+
+        def build(cap=cap, treedef=treedef, holder=holder, nleaves=len(leaves)):
+            def f(off, *ls):
+                g = off[0, 0] + jnp.arange(cap, dtype=jnp.int64)
+                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+                out = {"__gidx": g, "tree": tree}
+                out_leaves, out_td = jax.tree.flatten(out)
+                holder["treedef"] = out_td
+                return tuple(l[None] for l in out_leaves)
+            return mex.smap(f, 1 + nleaves), holder
+
+        fn, h = mex.cached(key, build)
+        out = fn(mex.put(offs.astype(np.int64)[:, None]), *leaves)
+        tree = jax.tree.unflatten(h["treedef"], list(out))
+        carriers.append(DeviceShards(mex, tree, p.counts.copy()))
+
+    merged = _local_concat(carriers) if len(carriers) > 1 else carriers[0]
+
+    bounds = np.array([(w * n_total) // W for w in range(W + 1)],
+                      dtype=np.int64)
+    bdev = jnp.asarray(bounds[1:])
+
+    def dest(tree, mask, widx):
+        g = tree["__gidx"]
+        return jnp.searchsorted(bdev, g, side="right").astype(jnp.int32)
+
+    merged = exchange.exchange(merged, dest, ("concat_dest", token, W))
+
+    # restore order by global index, then drop the index column
+    cap = merged.cap
+    leaves, treedef = jax.tree.flatten(merged.tree)
+    key = ("concat_order", token, cap, treedef,
+           tuple((l.dtype, l.shape[2:]) for l in leaves))
+    holder2 = {}
+
+    def build2():
+        def f(counts_dev, *ls):
+            count = counts_dev[0, 0]
+            valid = jnp.arange(cap) < count
+            tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+            g = tree["__gidx"].astype(jnp.uint64)
+            g = jnp.where(valid, g, jnp.uint64(2 ** 63))
+            order = jnp.argsort(g)
+            out_tree = jax.tree.map(lambda l: jnp.take(l, order, axis=0),
+                                    tree["tree"])
+            out_leaves, out_td = jax.tree.flatten(out_tree)
+            holder2["treedef"] = out_td
+            return tuple(l[None] for l in out_leaves)
+
+        return mex.smap(f, 1 + len(leaves)), holder2
+
+    fn2, h2 = mex.cached(key, build2)
+    out = fn2(merged.counts_device(), *leaves)
+    tree = jax.tree.unflatten(h2["treedef"], list(out))
+    return DeviceShards(mex, tree, merged.counts.copy())
+
+
+def _local_concat(parts: List[DeviceShards]) -> DeviceShards:
+    """Per-worker concatenation (valid items compacted to the front)."""
+    mex = parts[0].mesh_exec
+    caps = [p.cap for p in parts]
+    treedefs = [jax.tree.structure(p.tree) for p in parts]
+    assert all(td == treedefs[0] for td in treedefs), \
+        "Concat/Union requires matching schemas"
+    total_cap = sum(caps)
+    all_leaves = [jax.tree.flatten(p.tree)[0] for p in parts]
+    key = ("local_concat", tuple(caps),
+           tuple((l.dtype, l.shape[2:]) for l in all_leaves[0]))
+
+    def build():
+        def f(*flat):
+            k = len(all_leaves[0])
+            counts = flat[:len(parts)]
+            trees = []
+            i = len(parts)
+            for caps_i in caps:
+                trees.append([x[0] for x in flat[i:i + k]])
+                i += k
+            outs = []
+            for li in range(k):
+                segs = []
+                pos = []
+                offset = jnp.int64(0)
+                for pi, cap_i in enumerate(caps):
+                    c = counts[pi][0, 0]
+                    idx = jnp.arange(cap_i, dtype=jnp.int64)
+                    valid = idx < c
+                    p_ = jnp.where(valid, offset + idx, total_cap)
+                    segs.append(trees[pi][li])
+                    pos.append(p_)
+                    offset = offset + c
+                leaf0 = segs[0]
+                buf = jnp.zeros((total_cap + 1,) + leaf0.shape[1:],
+                                leaf0.dtype)
+                for s, p_ in zip(segs, pos):
+                    buf = buf.at[p_].set(s)
+                outs.append(buf[:total_cap][None])
+            return tuple(outs)
+
+        return mex.smap(f, len(parts) * (1 + len(all_leaves[0])))
+
+    # args: counts for each part, then leaves of each part
+    fn = mex.cached(key, build)
+    args = [p.counts_device() for p in parts]
+    for ls in all_leaves:
+        args.extend(ls)
+    out = fn(*args)
+    tree = jax.tree.unflatten(treedefs[0], list(out))
+    counts = np.sum([p.counts for p in parts], axis=0).astype(np.int64)
+    return DeviceShards(mex, tree, counts)
+
+
+class ConcatNode(DIABase):
+    def __init__(self, ctx, links) -> None:
+        super().__init__(ctx, "Concat", links)
+
+    def compute(self):
+        pulls = [l.pull() for l in self.parents]
+        if any(isinstance(p, HostShards) for p in pulls):
+            pulls = [p.to_host_shards() if isinstance(p, DeviceShards)
+                     else p for p in pulls]
+            W = pulls[0].num_workers
+            flat = [it for p in pulls for l in p.lists for it in l]
+            bounds = [(w * len(flat)) // W for w in range(W + 1)]
+            return HostShards(W, [flat[bounds[w]:bounds[w + 1]]
+                                  for w in range(W)])
+        return rebalance_to_even(self.context.mesh_exec, pulls, (self.id,))
+
+
+class RebalanceNode(DIABase):
+    def __init__(self, ctx, link) -> None:
+        super().__init__(ctx, "Rebalance", [link])
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        if isinstance(shards, HostShards):
+            W = shards.num_workers
+            flat = [it for l in shards.lists for it in l]
+            bounds = [(w * len(flat)) // W for w in range(W + 1)]
+            return HostShards(W, [flat[bounds[w]:bounds[w + 1]]
+                                  for w in range(W)])
+        return rebalance_to_even(self.context.mesh_exec, [shards],
+                                 (self.id,))
+
+
+def Concat(a: DIA, b: DIA) -> DIA:
+    return DIA(ConcatNode(a.context, [a._link(), b._link()]))
+
+
+def ConcatMany(dias: List[DIA]) -> DIA:
+    assert dias
+    return DIA(ConcatNode(dias[0].context, [d._link() for d in dias]))

@@ -1,0 +1,115 @@
+"""GroupByKey / GroupToIndex.
+
+Reference: thrill/api/group_by_key.hpp:47 — hash-partition shuffle, local
+sort (with spill + multiway merge), then the user function over each
+key's iterator. The group function is inherently per-group and arbitrary
+(it sees all values of one key), so after a device-side exchange + sort
+the per-group application runs on the host — the device handles the
+communication-heavy phases, Python the sequential group fold. Vectorized
+aggregations should use ReduceByKey, which stays fully on device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ...common import hashing
+from ...core import keys as keymod
+from ...data import exchange
+from ...data.shards import DeviceShards, HostShards
+from ..dia import DIA
+from ..dia_base import DIABase
+
+
+class GroupByKeyNode(DIABase):
+    def __init__(self, ctx, link, key_fn: Callable, group_fn: Callable
+                 ) -> None:
+        super().__init__(ctx, "GroupByKey", [link])
+        self.key_fn = key_fn
+        self.group_fn = group_fn
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        W = self.context.num_workers
+        key_fn = self.key_fn
+        if isinstance(shards, DeviceShards):
+            # device exchange by key hash, then group on host
+            if W > 1:
+                import jax.numpy as jnp
+
+                def dest(tree, mask, widx):
+                    words = keymod.encode_key_words(key_fn(tree))
+                    h = hashing.hash_key_words(words)
+                    return (h % jnp.uint64(W)).astype(jnp.int32)
+
+                shards = exchange.exchange(
+                    shards, dest, ("groupby_dest", id(key_fn), W))
+            shards = shards.to_host_shards()
+        else:
+            shards = exchange.host_exchange(
+                shards, lambda it: hashing.stable_host_hash(key_fn(it)))
+        out = []
+        for items in shards.lists:
+            groups = {}
+            for it in items:
+                groups.setdefault(_hashable(key_fn(it)), []).append(it)
+            out.append([self.group_fn(k, vs) for k, vs in groups.items()])
+        return HostShards(W, out)
+
+
+def _hashable(k: Any):
+    if isinstance(k, np.ndarray):
+        return tuple(k.tolist())
+    if isinstance(k, np.generic):
+        return k.item()
+    if isinstance(k, tuple):
+        return tuple(_hashable(x) for x in k)
+    return k
+
+
+class GroupToIndexNode(DIABase):
+    """Index-range variant (reference: api/group_to_index.hpp:42)."""
+
+    def __init__(self, ctx, link, index_fn, group_fn, size, neutral) -> None:
+        super().__init__(ctx, "GroupToIndex", [link])
+        self.index_fn = index_fn
+        self.group_fn = group_fn
+        self.size = int(size)
+        self.neutral = neutral
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        if isinstance(shards, DeviceShards):
+            shards = shards.to_host_shards()
+        W = self.context.num_workers
+        n = self.size
+        bounds = [(w * n) // W for w in range(W + 1)]
+        buckets = [dict() for _ in range(W)]
+        for items in shards.lists:
+            for it in items:
+                i = int(self.index_fn(it))
+                if not 0 <= i < n:
+                    continue
+                w = int(np.searchsorted(bounds[1:], i, side="right"))
+                buckets[w].setdefault(i, []).append(it)
+        out = []
+        for w in range(W):
+            lst = []
+            for i in range(bounds[w], bounds[w + 1]):
+                if i in buckets[w]:
+                    lst.append(self.group_fn(i, buckets[w][i]))
+                else:
+                    lst.append(self.neutral)
+            out.append(lst)
+        return HostShards(W, out)
+
+
+def GroupByKey(dia: DIA, key_fn, group_fn) -> DIA:
+    return DIA(GroupByKeyNode(dia.context, dia._link(), key_fn, group_fn))
+
+
+def GroupToIndex(dia: DIA, index_fn, group_fn, size, neutral=None) -> DIA:
+    return DIA(GroupToIndexNode(dia.context, dia._link(), index_fn,
+                                group_fn, size, neutral))

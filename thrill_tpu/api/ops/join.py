@@ -1,0 +1,207 @@
+"""InnerJoin.
+
+Reference: thrill/api/inner_join.hpp:61 — hash-partition both sides,
+local merge-join after sorting spilled files (optional LocationDetection
+to skip shipping unmatched keys).
+
+Device path: both sides exchange by the same key hash, then one jitted
+local sort-merge-join per worker: sort left and right by key words,
+count per-right-item match runs, a host capacity agreement sizes the
+pair expansion, and a second jitted program gathers the (left, right)
+pairs and applies ``join_fn`` batched. The expansion indices come from
+searchsorted over the pair-offset cumsum — branch-free, static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...common import hashing
+from ...core import keys as keymod
+from ...core import segmented
+from ...data import exchange
+from ...data.shards import DeviceShards, HostShards
+from ...common.config import round_up_pow2
+from ..dia import DIA
+from ..dia_base import DIABase
+
+
+class InnerJoinNode(DIABase):
+    def __init__(self, ctx, llink, rlink, lkey, rkey, join_fn) -> None:
+        super().__init__(ctx, "InnerJoin", [llink, rlink])
+        self.lkey = lkey
+        self.rkey = rkey
+        self.join_fn = join_fn
+
+    def compute(self):
+        left = self.parents[0].pull()
+        right = self.parents[1].pull()
+        if isinstance(left, HostShards) or isinstance(right, HostShards):
+            return self._compute_host(left, right)
+        return self._compute_device(left, right)
+
+    # -- host path ------------------------------------------------------
+    def _compute_host(self, left, right):
+        if isinstance(left, DeviceShards):
+            left = left.to_host_shards()
+        if isinstance(right, DeviceShards):
+            right = right.to_host_shards()
+        W = left.num_workers
+        lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
+        lx = exchange.host_exchange(
+            left, lambda it: hashing.stable_host_hash(_h(lkey(it))))
+        rx = exchange.host_exchange(
+            right, lambda it: hashing.stable_host_hash(_h(rkey(it))))
+        out = []
+        for litems, ritems in zip(lx.lists, rx.lists):
+            table = {}
+            for it in litems:
+                table.setdefault(_h(lkey(it)), []).append(it)
+            pairs = []
+            for rt in ritems:
+                for lt in table.get(_h(rkey(rt)), ()):
+                    pairs.append(jfn(lt, rt))
+            out.append(pairs)
+        return HostShards(W, out)
+
+    # -- device path ----------------------------------------------------
+    def _compute_device(self, left: DeviceShards, right: DeviceShards):
+        mex = left.mesh_exec
+        W = mex.num_workers
+        lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
+        token = (id(lkey), id(rkey), id(jfn))
+
+        if W > 1:
+            def mk_dest(key_fn):
+                def dest(tree, mask, widx):
+                    words = keymod.encode_key_words(key_fn(tree))
+                    h = hashing.hash_key_words(words)
+                    return (h % jnp.uint64(W)).astype(jnp.int32)
+                return dest
+
+            left = exchange.exchange(left, mk_dest(lkey),
+                                     ("join_l", token, W))
+            right = exchange.exchange(right, mk_dest(rkey),
+                                      ("join_r", token, W))
+
+        lcap, rcap = left.cap, right.cap
+        lleaves, ltd = jax.tree.flatten(left.tree)
+        rleaves, rtd = jax.tree.flatten(right.tree)
+
+        # phase 1: sort both sides, count pairs per right item
+        key1 = ("join_count", token, lcap, rcap, ltd, rtd,
+                tuple((l.dtype, l.shape[2:]) for l in lleaves),
+                tuple((l.dtype, l.shape[2:]) for l in rleaves))
+        nl = len(lleaves)
+
+        def build1():
+            def f(lc, rc, *ls):
+                ltree = jax.tree.unflatten(ltd, [x[0] for x in ls[:nl]])
+                rtree = jax.tree.unflatten(rtd, [x[0] for x in ls[nl:]])
+                lvalid = jnp.arange(lcap) < lc[0, 0]
+                rvalid = jnp.arange(rcap) < rc[0, 0]
+                lw = keymod.encode_key_words(lkey(ltree))
+                rw = keymod.encode_key_words(rkey(rtree))
+                lw, ltree_s, lvalid, _ = segmented.sort_by_key_words(
+                    lw, ltree, lvalid)
+                rw, rtree_s, rvalid, _ = segmented.sort_by_key_words(
+                    rw, rtree, rvalid)
+                lo, hi = _run_bounds(lw, lvalid, rw, rvalid)
+                matches = jnp.where(rvalid, hi - lo, 0)  # [rcap]
+                total = jnp.sum(matches)
+                return (total[None, None].astype(jnp.int64),
+                        matches[None], lo[None],
+                        *[x[None] for x in jax.tree.leaves(ltree_s)],
+                        *[x[None] for x in jax.tree.leaves(rtree_s)])
+
+            return mex.smap(f, 2 + nl + len(rleaves))
+
+        f1 = mex.cached(key1, build1)
+        out1 = f1(left.counts_device(), right.counts_device(),
+                  *lleaves, *rleaves)
+        totals = np.asarray(out1[0]).reshape(-1).astype(np.int64)
+        matches_dev, lo_dev = out1[1], out1[2]
+        lsorted = list(out1[3:3 + nl])
+        rsorted = list(out1[3 + nl:])
+
+        out_cap = round_up_pow2(max(int(totals.max()), 1))
+
+        # phase 2: expand pairs and apply join_fn
+        key2 = ("join_expand", token, lcap, rcap, out_cap, ltd, rtd,
+                tuple((l.dtype, l.shape[2:]) for l in lleaves),
+                tuple((l.dtype, l.shape[2:]) for l in rleaves))
+        holder = {}
+
+        def build2():
+            def f(matches, lo, *ls):
+                m = matches[0]                       # [rcap] pair counts
+                lo_ = lo[0]                          # [rcap] left run start
+                ltree = jax.tree.unflatten(ltd, [x[0] for x in ls[:nl]])
+                rtree = jax.tree.unflatten(rtd, [x[0] for x in ls[nl:]])
+                ends = jnp.cumsum(m)                 # [rcap]
+                total = ends[-1] if m.shape[0] else jnp.int64(0)
+                p = jnp.arange(out_cap, dtype=jnp.int64)
+                ridx = jnp.searchsorted(ends, p, side="right")
+                ridx = jnp.clip(ridx, 0, rcap - 1)
+                starts = ends - m
+                lidx = lo_[ridx] + (p - starts[ridx])
+                lidx = jnp.clip(lidx, 0, lcap - 1)
+                lsel = jax.tree.map(lambda x: jnp.take(x, lidx, axis=0),
+                                    ltree)
+                rsel = jax.tree.map(lambda x: jnp.take(x, ridx, axis=0),
+                                    rtree)
+                out = jfn(lsel, rsel)
+                out_leaves, out_td = jax.tree.flatten(out)
+                holder["treedef"] = out_td
+                return tuple(x[None] for x in out_leaves)
+
+            return mex.smap(f, 2 + nl + len(rleaves))
+
+        f2 = mex.cached(key2, build2)
+        out2 = f2(matches_dev, lo_dev, *lsorted, *rsorted)
+        tree = jax.tree.unflatten(holder["treedef"], list(out2))
+        return DeviceShards(mex, tree, totals)
+
+
+def _run_bounds(lw, lvalid, rw, rvalid):
+    """For each right item: [lo, hi) bounds of equal-key run in sorted
+    left words (lexicographic multi-word searchsorted via pairwise
+    comparisons against the sorted left arrays)."""
+    lcap = lw[0].shape[0]
+    # left items: invalid -> +inf words so they sort conceptually last
+    maxw = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    lws = [jnp.where(lvalid, w, maxw) for w in lw]
+
+    def lex_less(a_words, b_words):
+        """a < b elementwise-broadcast: a [L,1] vs b [1,R] -> [L,R]"""
+        lt = jnp.zeros((a_words[0].shape[0], b_words[0].shape[1]), bool)
+        eq = jnp.ones_like(lt)
+        for aw, bw in zip(a_words, b_words):
+            lt = lt | (eq & (aw < bw))
+            eq = eq & (aw == bw)
+        return lt, eq
+
+    a = [w[:, None] for w in lws]
+    b = [w[None, :] for w in rw]
+    lt, eq = lex_less(a, b)            # [lcap, rcap]
+    lo = jnp.sum(lt, axis=0)           # #left strictly below each right
+    hi = lo + jnp.sum(eq, axis=0)      # + equals
+    return lo.astype(jnp.int64), hi.astype(jnp.int64)
+
+
+def _h(k):
+    if isinstance(k, np.ndarray):
+        return tuple(k.tolist())
+    if isinstance(k, np.generic):
+        return k.item()
+    return k
+
+
+def InnerJoin(left: DIA, right: DIA, left_key_fn, right_key_fn,
+              join_fn) -> DIA:
+    return DIA(InnerJoinNode(left.context, left._link(), right._link(),
+                             left_key_fn, right_key_fn, join_fn))

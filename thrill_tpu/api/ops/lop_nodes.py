@@ -1,0 +1,68 @@
+"""Helper nodes for local ops that must leave the device path."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ...data.shards import DeviceShards, HostShards
+from ..dia import DIA
+from ..dia_base import DIABase, ParentLink
+
+
+class HostFlatMapNode(DIABase):
+    """Generic (variable-arity) FlatMap: falls back to host item lists.
+
+    The device path only supports fixed-factor flat_map (static shapes);
+    the reference's fully general FlatMap semantics
+    (api/dia.hpp:458) live here.
+    """
+
+    def __init__(self, ctx, link: ParentLink, fn: Callable) -> None:
+        super().__init__(ctx, "FlatMapHost", [link])
+        self.fn = fn
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        if isinstance(shards, DeviceShards):
+            shards = shards.to_host_shards()
+        out = []
+        for items in shards.lists:
+            lst = []
+            for it in items:
+                lst.extend(self.fn(it))
+            out.append(lst)
+        return HostShards(shards.num_workers, out)
+
+
+def flat_map_host(dia: DIA, fn: Callable) -> DIA:
+    return DIA(HostFlatMapNode(dia.context, dia._link(), fn))
+
+
+class ToHostNode(DIABase):
+    def __init__(self, ctx, link: ParentLink) -> None:
+        super().__init__(ctx, "ToHost", [link])
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        if isinstance(shards, DeviceShards):
+            return shards.to_host_shards()
+        return shards
+
+
+class ToDeviceNode(DIABase):
+    def __init__(self, ctx, link: ParentLink) -> None:
+        super().__init__(ctx, "ToDevice", [link])
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        if isinstance(shards, HostShards):
+            return shards.to_device(self.context.mesh_exec)
+        return shards
+
+
+def to_host(dia: DIA) -> DIA:
+    return DIA(ToHostNode(dia.context, dia._link()))
+
+
+def to_device(dia: DIA) -> DIA:
+    return DIA(ToDeviceNode(dia.context, dia._link()))

@@ -1,0 +1,55 @@
+"""Merge of sorted DIAs.
+
+Reference: thrill/api/merge.hpp:76 — distributed multi-sequence
+selection (iterative pivot search over the sorted inputs) to find
+balanced split points, then stream exchange + local k-way merge.
+
+Device translation: a concatenation that tags items with (input index,
+position) followed by the sample-sort machinery keyed on the user key
+degenerates to exactly the merge semantics — inputs are already sorted,
+so splitter sampling is cheap and the final local sort is a near-sorted
+bitonic pass. Equal keys order by input index then original position
+(the reference's tie ordering).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import heapq
+
+from ...data.shards import DeviceShards, HostShards
+from ..dia import DIA
+from ..dia_base import DIABase
+from .sort import _device_sample_sort
+
+
+class MergeNode(DIABase):
+    def __init__(self, ctx, links, key_fn: Optional[Callable]) -> None:
+        super().__init__(ctx, "Merge", links)
+        self.key_fn = key_fn or (lambda x: x)
+
+    def compute(self):
+        pulls = [l.pull() for l in self.parents]
+        if any(isinstance(p, HostShards) for p in pulls):
+            pulls = [p.to_host_shards() if isinstance(p, DeviceShards)
+                     else p for p in pulls]
+            W = pulls[0].num_workers
+            seqs = [[it for lst in p.lists for it in lst] for p in pulls]
+            merged = list(heapq.merge(*seqs, key=self.key_fn))
+            bounds = [(w * len(merged)) // W for w in range(W + 1)]
+            return HostShards(W, [merged[bounds[w]:bounds[w + 1]]
+                                  for w in range(W)])
+        # device: order-preserving concat (keeps input-rank global order
+        # as the stability tiebreak), then stable sample sort
+        from .concat import rebalance_to_even
+        combined = rebalance_to_even(pulls[0].mesh_exec, pulls,
+                                     ("merge", self.id))
+        return _device_sample_sort(combined, self.key_fn,
+                                   ("merge", id(self.key_fn)))
+
+
+def Merge(dias: List[DIA], key_fn=None) -> DIA:
+    assert dias
+    return DIA(MergeNode(dias[0].context, [d._link() for d in dias],
+                         key_fn))

@@ -1,0 +1,97 @@
+"""PrefixSum / ExPrefixSum.
+
+Reference: thrill/api/prefix_sum.hpp:28 — local sum, net.ExPrefixSum of
+partials, re-emit. Device path: one SPMD program doing a masked local
+cumulative sum plus a cross-worker exclusive offset via all_gather of
+local totals (the FlowControlChannel step become an XLA collective).
+Generic (non-additive) functions run on the host path sequentially.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ...data.shards import DeviceShards, HostShards
+from ...parallel.mesh import AXIS
+from ..dia import DIA
+from ..dia_base import DIABase
+
+
+class PrefixSumNode(DIABase):
+    def __init__(self, ctx, link, fn: Optional[Callable], initial: Any,
+                 inclusive: bool) -> None:
+        super().__init__(ctx, "PrefixSum" if inclusive else "ExPrefixSum",
+                         [link])
+        self.fn = fn
+        self.initial = initial
+        self.inclusive = inclusive
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        if isinstance(shards, HostShards) or self.fn is not None:
+            if isinstance(shards, DeviceShards):
+                shards = shards.to_host_shards()
+            return self._compute_host(shards)
+        return self._compute_device(shards)
+
+    def _compute_host(self, shards: HostShards):
+        fn = self.fn or (lambda a, b: a + b)
+        out = []
+        acc = self.initial
+        for items in shards.lists:
+            lst = []
+            for it in items:
+                if self.inclusive:
+                    acc = fn(acc, it)
+                    lst.append(acc)
+                else:
+                    lst.append(acc)
+                    acc = fn(acc, it)
+            out.append(lst)
+        return HostShards(shards.num_workers, out)
+
+    def _compute_device(self, shards: DeviceShards):
+        mex = shards.mesh_exec
+        cap = shards.cap
+        leaves, treedef = jax.tree.flatten(shards.tree)
+        initial = self.initial
+        key = ("prefix_sum", self.inclusive, cap, treedef,
+               tuple((l.dtype, l.shape[2:]) for l in leaves))
+
+        def build():
+            def f(counts_dev, *ls):
+                mask = jnp.arange(cap) < counts_dev[0, 0]
+                outs = []
+                for l in ls:
+                    x = l[0]
+                    m = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+                    xm = jnp.where(m, x, 0)
+                    incl = jnp.cumsum(xm, axis=0, dtype=x.dtype)
+                    local_total = incl[-1]
+                    totals = lax.all_gather(local_total, AXIS)  # [W, ...]
+                    widx = lax.axis_index(AXIS)
+                    prev = jnp.where(
+                        (jnp.arange(totals.shape[0]) < widx
+                         ).reshape((-1,) + (1,) * (totals.ndim - 1)),
+                        totals, 0).sum(axis=0)
+                    scan = incl if self.inclusive else incl - xm
+                    outs.append((scan + prev + jnp.asarray(initial)
+                                 .astype(x.dtype))[None])
+                return tuple(outs)
+
+            return mex.smap(f, 1 + len(leaves))
+
+        fn = mex.cached(key, build)
+        out = fn(shards.counts_device(), *leaves)
+        tree = jax.tree.unflatten(treedef, list(out))
+        return DeviceShards(mex, tree, shards.counts.copy())
+
+
+def PrefixSum(dia: DIA, fn=None, initial: Any = 0, inclusive=True) -> DIA:
+    return DIA(PrefixSumNode(dia.context, dia._link(), fn, initial,
+                             inclusive))

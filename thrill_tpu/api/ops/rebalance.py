@@ -1,0 +1,10 @@
+"""Rebalance op wrapper (node lives in concat.py)."""
+
+from __future__ import annotations
+
+from ..dia import DIA
+from .concat import RebalanceNode
+
+
+def Rebalance(dia: DIA) -> DIA:
+    return DIA(RebalanceNode(dia.context, dia._link()))

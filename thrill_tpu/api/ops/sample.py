@@ -1,0 +1,124 @@
+"""BernoulliSample and Sample(k).
+
+Reference: thrill/api/bernoulli_sample.hpp:27 (per-item coin flips; the
+reference uses geometric skips, on device a vectorized uniform draw is
+the natural equivalent) and api/sample.hpp:50 (distributed uniform
+sample of fixed size k: the global budget is split over workers by the
+multivariate hypergeometric distribution, then each worker samples
+locally without replacement — exactly the reference's scheme).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...common.sampling import hypergeometric_split
+from ...data.shards import DeviceShards, HostShards, compact_valid
+from ..dia import DIA
+from ..dia_base import DIABase
+
+
+class BernoulliSampleNode(DIABase):
+    def __init__(self, ctx, link, p: float, seed: int) -> None:
+        super().__init__(ctx, f"BernoulliSample({p})", [link])
+        self.p = float(p)
+        self.seed = seed
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        if isinstance(shards, HostShards):
+            rng = np.random.default_rng(self.seed)
+            return HostShards(shards.num_workers,
+                              [[it for it in items
+                                if rng.random() < self.p]
+                               for items in shards.lists])
+        mex = shards.mesh_exec
+        cap = shards.cap
+        p = self.p
+        seed = self.seed
+        leaves, treedef = jax.tree.flatten(shards.tree)
+        key = ("bernoulli", p, seed, cap, treedef,
+               tuple((l.dtype, l.shape[2:]) for l in leaves))
+
+        def build():
+            def f(counts_dev, *ls):
+                widx = jax.lax.axis_index("w")
+                k = jax.random.fold_in(jax.random.PRNGKey(seed), widx)
+                mask = jnp.arange(cap) < counts_dev[0, 0]
+                keep = jax.random.uniform(k, (cap,)) < p
+                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+                tree, cnt = compact_valid(tree, mask & keep)
+                return (cnt[None, None].astype(jnp.int32),
+                        *[l[None] for l in jax.tree.leaves(tree)])
+
+            return mex.smap(f, 1 + len(leaves))
+
+        fn = mex.cached(key, build)
+        out = fn(shards.counts_device(), *leaves)
+        counts = np.asarray(out[0]).reshape(-1).astype(np.int64)
+        tree = jax.tree.unflatten(treedef, list(out[1:]))
+        return DeviceShards(mex, tree, counts)
+
+
+class SampleNode(DIABase):
+    def __init__(self, ctx, link, k: int, seed: int) -> None:
+        super().__init__(ctx, f"Sample({k})", [link])
+        self.k = int(k)
+        self.seed = seed
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        rng = np.random.default_rng(self.seed)
+        takes = hypergeometric_split(rng, self.k, shards.counts)
+        if isinstance(shards, HostShards):
+            out = []
+            for items, t in zip(shards.lists, takes):
+                idx = rng.choice(len(items), size=int(t), replace=False) \
+                    if len(items) else np.array([], dtype=np.int64)
+                idx.sort()
+                out.append([items[i] for i in idx])
+            return HostShards(shards.num_workers, out)
+
+        mex = shards.mesh_exec
+        cap = shards.cap
+        seed = self.seed
+        leaves, treedef = jax.tree.flatten(shards.tree)
+        key = ("sample_k", seed, cap, treedef,
+               tuple((l.dtype, l.shape[2:]) for l in leaves))
+
+        def build():
+            def f(counts_dev, takes_dev, *ls):
+                widx = jax.lax.axis_index("w")
+                kk = jax.random.fold_in(jax.random.PRNGKey(seed ^ 0x5A), widx)
+                count = counts_dev[0, 0]
+                t = takes_dev[0, 0]
+                mask = jnp.arange(cap) < count
+                # random scores; invalid items pushed last, take first t
+                scores = jax.random.uniform(kk, (cap,))
+                scores = jnp.where(mask, scores, 2.0)
+                order = jnp.argsort(scores)
+                keep_sorted = jnp.arange(cap) < t
+                keep = jnp.zeros(cap, bool).at[order].set(keep_sorted)
+                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+                tree, cnt = compact_valid(tree, keep & mask)
+                return (cnt[None, None].astype(jnp.int32),
+                        *[l[None] for l in jax.tree.leaves(tree)])
+
+            return mex.smap(f, 2 + len(leaves))
+
+        fn = mex.cached(key, build)
+        out = fn(shards.counts_device(),
+                 mex.put(takes.astype(np.int64)[:, None]), *leaves)
+        counts = np.asarray(out[0]).reshape(-1).astype(np.int64)
+        tree = jax.tree.unflatten(treedef, list(out[1:]))
+        return DeviceShards(mex, tree, counts)
+
+
+def BernoulliSample(dia: DIA, p: float, seed: int = 0) -> DIA:
+    return DIA(BernoulliSampleNode(dia.context, dia._link(), p, seed))
+
+
+def Sample(dia: DIA, k: int, seed: int = 0) -> DIA:
+    return DIA(SampleNode(dia.context, dia._link(), k, seed))

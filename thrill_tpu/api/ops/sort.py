@@ -1,0 +1,213 @@
+"""Distributed sample sort.
+
+Reference: thrill/api/sort.hpp:64 — PreOp reservoir-samples while
+spilling; MainOp gathers samples on worker 0, picks p-1 splitters,
+classifies every item down a branchless splitter tree into per-worker
+stream writers (tie-break by global index for balance on equal keys,
+api/sort.hpp:487-502); receivers sort runs and multiway-merge.
+
+TPU-native design, three bulk-synchronous device programs:
+ 1. sample:   local XLA sort + quantile sampling of (key words, global
+              index) pairs -> tiny host gather (the worker-0 splitter
+              step collapses to the single controller).
+ 2. exchange: destination = lexicographic rank among splitters
+              ((words, index) compare, so duplicate keys spread evenly
+              across workers exactly like the reference's tie-break),
+              then the padded all-to-all shuffle.
+ 3. merge:    one local XLA sort of the received items (stable by
+              original index) — the analog of sort-runs + multiway
+              merge, executed as a single bitonic sort on-device.
+
+The result is globally sorted across worker ranks and stable: equal
+keys keep their original global order, making Sort and SortStable one
+code path (the reference needs a separate CatStream variant).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import keys as keymod
+from ...core import segmented
+from ...data import exchange
+from ...data.shards import DeviceShards, HostShards
+from ..dia import DIA
+from ..dia_base import DIABase
+
+OVERSAMPLE = 32  # samples per worker; splitter error ~ 1/OVERSAMPLE
+
+
+class SortNode(DIABase):
+    def __init__(self, ctx, link, key_fn: Optional[Callable],
+                 compare_fn: Optional[Callable], stable: bool) -> None:
+        super().__init__(ctx, "Sort", [link])
+        self.key_fn = key_fn or (lambda x: x)
+        self.compare_fn = compare_fn
+        self.stable = stable
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        if isinstance(shards, HostShards):
+            return self._compute_host(shards)
+        if self.compare_fn is not None:
+            return self._compute_host(shards.to_host_shards())
+        return _device_sample_sort(shards, self.key_fn,
+                                   (id(self.key_fn),))
+
+    def _compute_host(self, shards: HostShards):
+        import functools
+        W = shards.num_workers
+        items = [it for l in shards.lists for it in l]
+        if self.compare_fn is not None:
+            items.sort(key=functools.cmp_to_key(
+                lambda a, b: -1 if self.compare_fn(a, b)
+                else (1 if self.compare_fn(b, a) else 0)))
+        else:
+            items.sort(key=self.key_fn)
+        n = len(items)
+        bounds = [(w * n) // W for w in range(W + 1)]
+        return HostShards(W, [items[bounds[w]:bounds[w + 1]]
+                              for w in range(W)])
+
+
+def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
+                        token) -> DeviceShards:
+    mex = shards.mesh_exec
+    W = mex.num_workers
+    cap = shards.cap
+    leaves, treedef = jax.tree.flatten(shards.tree)
+    total = shards.total
+    if total == 0:
+        return shards
+
+    # global index offsets (host-known counts -> exclusive prefix)
+    offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
+
+    # ---- phase 1: local sort + quantile samples ----------------------
+    key1 = ("sort_sample", token, cap, treedef,
+            tuple((l.dtype, l.shape[2:]) for l in leaves))
+    holder = {}
+
+    def build1():
+        def f(counts_dev, offset_dev, *ls):
+            count = counts_dev[0, 0]
+            valid = jnp.arange(cap) < count
+            tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+            gidx = offset_dev[0, 0] + jnp.arange(cap, dtype=jnp.int64)
+            words = keymod.encode_key_words(key_fn(tree))
+            holder["nwords"] = len(words)
+            words, tree, valid, extra = segmented.sort_by_key_words(
+                words, tree, valid, [gidx.astype(jnp.uint64)])
+            gidx_sorted = extra[0]
+            # quantile positions over the valid prefix
+            count_f = jnp.maximum(count, 1)
+            qpos = ((jnp.arange(OVERSAMPLE, dtype=jnp.int64) * 2 + 1)
+                    * count_f // (2 * OVERSAMPLE))
+            qpos = jnp.clip(qpos, 0, cap - 1)
+            sample_words = jnp.stack(
+                [jnp.take(w, qpos) for w in words], axis=1)  # [S, nw]
+            sample_idx = jnp.take(gidx_sorted, qpos)         # [S]
+            sample_valid = qpos < count
+            out_leaves = jax.tree.leaves(tree)
+            return (jnp.stack(words, 1)[None],
+                    gidx_sorted[None],
+                    sample_words[None], sample_idx[None], sample_valid[None],
+                    *[l[None] for l in out_leaves])
+
+        return mex.smap(f, 2 + len(leaves)), holder
+
+    f1, h1 = mex.cached(key1, build1)
+    out1 = f1(shards.counts_device(),
+              mex.put(offsets.astype(np.int64)[:, None]), *leaves)
+    words_mat, gidx_s, s_words, s_idx, s_valid = out1[:5]
+    sorted_leaves = list(out1[5:])
+    nwords = h1["nwords"]
+
+    # ---- host: choose splitters (the "worker 0" step) ----------------
+    sw = np.asarray(s_words).reshape(W * OVERSAMPLE, nwords)
+    si = np.asarray(s_idx).reshape(W * OVERSAMPLE)
+    sv = np.asarray(s_valid).reshape(W * OVERSAMPLE)
+    samples = [(tuple(int(x) for x in sw[i]), int(si[i]))
+               for i in range(len(sv)) if sv[i]]
+    samples.sort()
+    splitters = np.zeros((max(W - 1, 1), nwords + 1), dtype=np.uint64)
+    if samples and W > 1:
+        for j in range(1, W):
+            s = samples[min(len(samples) - 1, (j * len(samples)) // W)]
+            splitters[j - 1, :nwords] = np.array(s[0], dtype=np.uint64)
+            splitters[j - 1, nwords] = np.uint64(s[1])
+
+    if W == 1:
+        tree = jax.tree.unflatten(treedef, sorted_leaves)
+        return DeviceShards(mex, tree, shards.counts.copy())
+
+    # ---- phase 2: classify + exchange --------------------------------
+    # destination = number of splitters strictly below (words, gidx)
+    spl = jnp.asarray(splitters)  # [W-1, nwords+1]
+
+    sorted_tree_full = {
+        "__words": words_mat, "__gidx": gidx_s,
+        "tree": jax.tree.unflatten(treedef, sorted_leaves),
+    }
+    carrier = DeviceShards(mex, sorted_tree_full, shards.counts.copy())
+
+    def dest(tree, mask, widx):
+        wm = tree["__words"]            # [cap, nwords]
+        gi = tree["__gidx"].astype(jnp.uint64)
+        d = jnp.zeros(wm.shape[0], dtype=jnp.int32)
+        for j in range(W - 1):
+            gt = _lex_greater(wm, gi, spl[j])
+            d = d + gt.astype(jnp.int32)
+        return d
+
+    carrier = exchange.exchange(carrier, dest,
+                                ("sort_dest", token, W, cap))
+
+    # ---- phase 3: final local merge (stable by global index) ---------
+    cap3 = carrier.cap
+    leaves3, treedef3 = jax.tree.flatten(carrier.tree)
+    key3 = ("sort_final", token, cap3, treedef3,
+            tuple((l.dtype, l.shape[2:]) for l in leaves3))
+
+    def build3():
+        def f(counts_dev, *ls):
+            count = counts_dev[0, 0]
+            valid = jnp.arange(cap3) < count
+            tree = jax.tree.unflatten(treedef3, [l[0] for l in ls])
+            wm = tree["__words"]
+            gi = tree["__gidx"]
+            words = [wm[:, i] for i in range(nwords)]
+            words, t_sorted, valid, extra = segmented.sort_by_key_words(
+                words, tree["tree"], valid, [gi.astype(jnp.uint64)])
+            out_leaves = jax.tree.leaves(t_sorted)
+            return tuple(l[None] for l in out_leaves)
+
+        return mex.smap(f, 1 + len(leaves3))
+
+    f3 = mex.cached(key3, build3)
+    out3 = f3(carrier.counts_device(), *leaves3)
+    tree = jax.tree.unflatten(treedef, list(out3))
+    return DeviceShards(mex, tree, carrier.counts.copy())
+
+
+def _lex_greater(words_mat: jnp.ndarray, gidx: jnp.ndarray,
+                 splitter: jnp.ndarray) -> jnp.ndarray:
+    """(words, gidx) > splitter lexicographically; [cap] bool."""
+    nw = words_mat.shape[1]
+    gt = jnp.zeros(words_mat.shape[0], dtype=bool)
+    eq = jnp.ones(words_mat.shape[0], dtype=bool)
+    for i in range(nw):
+        w = words_mat[:, i]
+        gt = gt | (eq & (w > splitter[i]))
+        eq = eq & (w == splitter[i])
+    gt = gt | (eq & (gidx.astype(jnp.uint64) > splitter[nw]))
+    return gt
+
+
+def Sort(dia: DIA, key_fn=None, compare_fn=None, stable=False) -> DIA:
+    return DIA(SortNode(dia.context, dia._link(), key_fn, compare_fn,
+                        stable))

@@ -1,0 +1,151 @@
+"""Source operations: Generate, Distribute/EqualToDIA, ConcatToDIA.
+
+Reference: thrill/api/generate.hpp:37 (index range -> item lambda, local
+range split), equal_to_dia.hpp:30, concat_to_dia.hpp:30,
+distribute.hpp:33.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data.shards import DeviceShards, HostShards
+from ..dia import DIA
+from ..dia_base import DIABase
+from ..stack import _broadcast_outputs
+
+
+class GenerateNode(DIABase):
+    """size indices [0, size) split evenly; fn maps index -> item."""
+
+    def __init__(self, ctx, size: int, fn: Optional[Callable],
+                 storage: str) -> None:
+        super().__init__(ctx, "Generate")
+        self.size = int(size)
+        self.fn = fn
+        self.storage = storage
+
+    def compute(self):
+        W = self.context.num_workers
+        n = self.size
+        bounds = [(w * n) // W for w in range(W + 1)]
+        if self.storage == "host":
+            fn = self.fn or (lambda i: i)
+            return HostShards(W, [[fn(i) for i in range(bounds[w], bounds[w + 1])]
+                                  for w in range(W)])
+        mex = self.context.mesh_exec
+        counts = np.array([bounds[w + 1] - bounds[w] for w in range(W)],
+                          dtype=np.int64)
+        cap = max(1, 1 << (int(counts.max()) - 1).bit_length()) \
+            if counts.max() > 0 else 1
+        starts = mex.put(np.array(bounds[:W], dtype=np.int64)[:, None])
+        fn = self.fn
+        holder = {}
+        key = ("generate", n, cap, id(fn) if fn else None)
+
+        def build():
+            def f(start):
+                idx = start[0, 0] + jnp.arange(cap, dtype=jnp.int64)
+                tree = idx if fn is None else _broadcast_outputs(fn(idx), cap)
+                leaves, treedef = jax.tree.flatten(tree)
+                holder["treedef"] = treedef
+                return tuple(l[None] for l in leaves)
+            return mex.smap(f, 1), holder
+
+        f, h = mex.cached(key, build)
+        out = f(starts)
+        tree = jax.tree.unflatten(h["treedef"], list(out))
+        return DeviceShards(mex, tree, counts)
+
+
+class DistributeNode(DIABase):
+    """Global collection split evenly across workers, order preserved."""
+
+    def __init__(self, ctx, items, storage: Optional[str]) -> None:
+        super().__init__(ctx, "Distribute")
+        self.items = items
+        self.storage = storage or _infer_storage(ctx, items)
+
+    def compute(self):
+        W = self.context.num_workers
+        if self.storage == "host":
+            items = list(self.items) if not isinstance(self.items, list) \
+                else self.items
+            n = len(items)
+            bounds = [(w * n) // W for w in range(W + 1)]
+            return HostShards(W, [items[bounds[w]:bounds[w + 1]]
+                                  for w in range(W)])
+        tree = _columnarize(self.items)
+        return DeviceShards.from_global_numpy(self.context.mesh_exec, tree)
+
+
+class ConcatToDIANode(DIABase):
+    """Per-worker lists placed exactly on their worker."""
+
+    def __init__(self, ctx, per_worker, storage: Optional[str]) -> None:
+        super().__init__(ctx, "ConcatToDIA")
+        self.per_worker = per_worker
+        self.storage = storage or "host"
+
+    def compute(self):
+        W = self.context.num_workers
+        lists = [list(l) for l in self.per_worker]
+        if len(lists) < W:
+            lists += [[] for _ in range(W - len(lists))]
+        elif len(lists) > W:
+            # fold extras into the last worker, preserving order
+            extra = [it for l in lists[W:] for it in l]
+            lists = lists[:W - 1] + [lists[W - 1] + extra] if W > 0 else lists
+            lists = lists[:W]
+        shards = HostShards(W, lists)
+        if self.storage == "device":
+            return shards.to_device(self.context.mesh_exec)
+        return shards
+
+
+def _infer_storage(ctx, items) -> str:
+    if isinstance(items, np.ndarray) or hasattr(items, "dtype"):
+        return "device"
+    probe = None
+    for it in items:
+        probe = it
+        break
+    if probe is None:
+        return ctx.config.default_storage
+    leaves = jax.tree.leaves(probe)
+    if all(isinstance(l, (int, float, bool, np.generic, np.ndarray))
+           for l in leaves) and leaves:
+        return "device"
+    return "host"
+
+
+def _columnarize(items):
+    """list of item pytrees (or a global array) -> columnar pytree."""
+    if isinstance(items, np.ndarray) or hasattr(items, "dtype"):
+        return np.asarray(items)
+    items = list(items)
+    if not items:
+        raise ValueError("cannot infer schema of empty device DIA; "
+                         "use storage='host'")
+    treedef = jax.tree.structure(items[0])
+    nleaves = treedef.num_leaves
+    cols = [np.asarray([jax.tree.leaves(it)[i] for it in items])
+            for i in range(nleaves)]
+    return jax.tree.unflatten(treedef, cols)
+
+
+def Generate(ctx, size, fn=None, storage=None) -> DIA:
+    storage = storage or "device"
+    return DIA(GenerateNode(ctx, size, fn, storage))
+
+
+def Distribute(ctx, items, storage=None) -> DIA:
+    return DIA(DistributeNode(ctx, items, storage))
+
+
+def ConcatToDIA(ctx, per_worker, storage=None) -> DIA:
+    return DIA(ConcatToDIANode(ctx, per_worker, storage))

@@ -1,0 +1,41 @@
+"""Union: combine DIAs without order guarantees.
+
+Reference: thrill/api/union.hpp:53 — concatenates local pieces, no
+communication. Device path: per-worker compacting concatenation only.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ...data.shards import DeviceShards, HostShards
+from ..dia import DIA
+from ..dia_base import DIABase
+from .concat import _local_concat
+
+
+class UnionNode(DIABase):
+    def __init__(self, ctx, links) -> None:
+        super().__init__(ctx, "Union", links)
+
+    def compute(self):
+        pulls = [l.pull() for l in self.parents]
+        if any(isinstance(p, HostShards) for p in pulls):
+            pulls = [p.to_host_shards() if isinstance(p, DeviceShards)
+                     else p for p in pulls]
+            W = pulls[0].num_workers
+            return HostShards(W, [[it for p in pulls for it in p.lists[w]]
+                                  for w in range(W)])
+        if len(pulls) == 1:
+            return pulls[0]
+        return _local_concat(pulls)
+
+
+def Union(a: DIA, *others: DIA) -> DIA:
+    return DIA(UnionNode(a.context, [a._link()] +
+                         [o._link() for o in others]))
+
+
+def UnionMany(dias: List[DIA]) -> DIA:
+    assert dias
+    return DIA(UnionNode(dias[0].context, [d._link() for d in dias]))

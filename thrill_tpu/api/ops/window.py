@@ -1,0 +1,148 @@
+"""Window / FlatWindow / DisjointWindow.
+
+Reference: thrill/api/window.hpp:32 — overlapping k-windows fetch the
+k-1 predecessor items from the previous worker via
+FlowControlChannel::Predecessor (net/flow_control_channel.hpp:653).
+
+Device path: the predecessor fetch is a **ppermute halo exchange** over
+the mesh axis — each worker passes its last k-1 items to its successor,
+the 1-D sharded-sequence pattern that generalizes to ring-style
+sequence parallelism (this is where the long-context halo primitive
+lives in this framework). Window functions are applied batched over
+[n_windows, k] stacks. Workers with fewer than k-1 items (rare,
+tiny inputs) fall back to the host path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ...data.shards import DeviceShards, HostShards, compact_valid
+from ...parallel.mesh import AXIS
+from ..dia import DIA
+from ..dia_base import DIABase
+
+
+class WindowNode(DIABase):
+    def __init__(self, ctx, link, k: int, fn: Optional[Callable],
+                 device_fn: Optional[Callable], disjoint: bool) -> None:
+        super().__init__(ctx, "DisjointWindow" if disjoint else "Window",
+                         [link])
+        self.k = int(k)
+        self.fn = fn
+        self.device_fn = device_fn
+        self.disjoint = disjoint
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        k = self.k
+        if isinstance(shards, DeviceShards) and self.device_fn is not None \
+                and not self.disjoint \
+                and bool(np.all(shards.counts[:-1] >= k - 1)):
+            return self._compute_device(shards)
+        if isinstance(shards, DeviceShards):
+            shards = shards.to_host_shards()
+        return self._compute_host(shards)
+
+    def _compute_host(self, shards: HostShards):
+        k = self.k
+        fn = self.fn
+        flat = [it for l in shards.lists for it in l]
+        if self.disjoint:
+            wins = [flat[i:i + k] for i in range(0, len(flat) - k + 1, k)]
+        else:
+            wins = [flat[i:i + k] for i in range(len(flat) - k + 1)]
+        out = [fn(i * (k if self.disjoint else 1), w)
+               for i, w in enumerate(wins)]
+        W = shards.num_workers
+        bounds = [(w * len(out)) // W for w in range(W + 1)]
+        return HostShards(W, [out[bounds[w]:bounds[w + 1]]
+                              for w in range(W)])
+
+    def _compute_device(self, shards: DeviceShards):
+        mex = shards.mesh_exec
+        W = mex.num_workers
+        k = self.k
+        cap = shards.cap
+        offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
+        leaves, treedef = jax.tree.flatten(shards.tree)
+        fn = self.device_fn
+        key = ("window_dev", k, id(fn), cap, treedef,
+               tuple((l.dtype, l.shape[2:]) for l in leaves))
+        holder = {}
+
+        def build():
+            def f(counts_dev, off_dev, *ls):
+                count = counts_dev[0, 0]
+                off = off_dev[0, 0]
+                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+
+                # halo: my last k-1 items -> successor (ppermute ring step)
+                def halo_of(leaf):
+                    idx = jnp.clip(count - (k - 1) + jnp.arange(k - 1), 0,
+                                   cap - 1)
+                    h = jnp.take(leaf, idx, axis=0)
+                    perm = [(i, i + 1) for i in range(W - 1)]
+                    return lax.ppermute(h, AXIS, perm) if W > 1 else \
+                        jnp.zeros_like(h)
+
+                halo = jax.tree.map(halo_of, tree)
+                ext = jax.tree.map(
+                    lambda h, x: jnp.concatenate([h, x], axis=0), halo, tree)
+                # window ending at local item j = ext[j : j + k]
+                widx_mat = jnp.arange(cap)[:, None] + jnp.arange(k)[None, :]
+                windows = jax.tree.map(
+                    lambda e: jnp.take(e, widx_mat, axis=0), ext)
+                out = fn(windows)            # batched [cap, ...]
+                g_end = off + jnp.arange(cap, dtype=jnp.int64)
+                valid = (jnp.arange(cap) < count) & (g_end >= k - 1)
+                out, cnt = compact_valid(out, valid)
+                out_leaves, out_td = jax.tree.flatten(out)
+                holder["treedef"] = out_td
+                return (cnt[None, None].astype(jnp.int32),
+                        *[l[None] for l in out_leaves])
+
+            return mex.smap(f, 2 + len(leaves)), holder
+
+        f, h = mex.cached(key, build)
+        out = f(shards.counts_device(),
+                mex.put(offsets.astype(np.int64)[:, None]), *leaves)
+        counts = np.asarray(out[0]).reshape(-1).astype(np.int64)
+        tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
+        return DeviceShards(mex, tree, counts)
+
+
+class FlatWindowNode(DIABase):
+    """fn(index, window) -> iterable of outputs (host path)."""
+
+    def __init__(self, ctx, link, k: int, fn: Callable) -> None:
+        super().__init__(ctx, "FlatWindow", [link])
+        self.k = int(k)
+        self.fn = fn
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        if isinstance(shards, DeviceShards):
+            shards = shards.to_host_shards()
+        flat = [it for l in shards.lists for it in l]
+        out = []
+        for i in range(len(flat) - self.k + 1):
+            out.extend(self.fn(i, flat[i:i + self.k]))
+        W = shards.num_workers
+        bounds = [(w * len(out)) // W for w in range(W + 1)]
+        return HostShards(W, [out[bounds[w]:bounds[w + 1]]
+                              for w in range(W)])
+
+
+def Window(dia: DIA, k: int, fn, device_fn=None, disjoint=False) -> DIA:
+    return DIA(WindowNode(dia.context, dia._link(), k, fn, device_fn,
+                          disjoint))
+
+
+def FlatWindow(dia: DIA, k: int, fn) -> DIA:
+    return DIA(FlatWindowNode(dia.context, dia._link(), k, fn))

@@ -1,0 +1,256 @@
+"""Zip / ZipWithIndex / ZipWindow.
+
+Reference: thrill/api/zip.hpp:77 (size prefix sums per partition,
+Stream::Scatter realignment of misaligned partitions, Cut/Pad variants),
+zip_with_index.hpp:38, zip_window.hpp:175.
+
+Device path: realignment is an index-range exchange — every item's
+destination is the worker owning its global index under the target
+partition (the first DIA's partition, like the reference which scatters
+the other DIAs to align with the first), then a fused local zip.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...data import exchange
+from ...data.shards import DeviceShards, HostShards
+from ..dia import DIA
+from ..dia_base import DIABase
+
+
+def _realign_device(shards: DeviceShards, target_bounds: np.ndarray,
+                    n_out: int, token) -> DeviceShards:
+    """Move items so worker w holds global indices
+    [target_bounds[w], target_bounds[w+1]) of this DIA (items beyond
+    n_out are dropped). Order within workers is preserved because the
+    exchange is stable and sources arrive rank-ordered."""
+    mex = shards.mesh_exec
+    W = mex.num_workers
+    offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
+    bounds_dev = jnp.asarray(target_bounds[1:])  # upper edges [W]
+
+    def dest(tree, mask, widx):
+        leaves = jax.tree.leaves(tree)
+        cap = leaves[0].shape[0]
+        off = jnp.asarray(offsets)[widx]
+        g = off + jnp.arange(cap, dtype=jnp.int64)
+        d = jnp.searchsorted(bounds_dev, g, side="right").astype(jnp.int32)
+        # drop items past n_out by sending them nowhere (mask them out)
+        d = jnp.where(g < n_out, d, W)
+        return d
+
+    # dest == W marks dropped items; exchange clips dest, so pre-mask:
+    return exchange.exchange(_mask_tail(shards, n_out), dest,
+                             ("realign", token, W))
+
+
+def _mask_tail(shards: DeviceShards, n_out: int) -> DeviceShards:
+    """Trim counts so only the first n_out global items stay valid."""
+    offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
+    new_counts = np.clip(n_out - offsets, 0, shards.counts)
+    return DeviceShards(shards.mesh_exec, shards.tree,
+                        new_counts.astype(np.int64))
+
+
+class ZipNode(DIABase):
+    def __init__(self, ctx, links, zip_fn: Optional[Callable],
+                 mode: str) -> None:
+        super().__init__(ctx, "Zip", links)
+        self.zip_fn = zip_fn
+        self.mode = mode
+
+    def compute(self):
+        pulls = [l.pull() for l in self.parents]
+        if any(isinstance(p, HostShards) for p in pulls):
+            pulls = [p.to_host_shards() if isinstance(p, DeviceShards) else p
+                     for p in pulls]
+            return self._compute_host(pulls)
+        return self._compute_device(pulls)
+
+    def _out_size(self, totals: List[int]) -> int:
+        if self.mode == "cut":
+            return min(totals)
+        if self.mode == "pad":
+            return max(totals)
+        if len(set(totals)) != 1:
+            raise ValueError(
+                f"Zip: unequal sizes {totals}; use mode='cut' or 'pad'")
+        return totals[0]
+
+    def _compute_device(self, pulls: List[DeviceShards]):
+        mex = pulls[0].mesh_exec
+        W = mex.num_workers
+        totals = [p.total for p in pulls]
+        n_out = self._out_size(totals)
+        if self.mode == "pad" and max(totals) != min(totals):
+            return self._compute_host([p.to_host_shards() for p in pulls])
+        # target partition = first DIA's distribution truncated to n_out
+        c0 = np.clip(pulls[0].counts,
+                     0, None)
+        tb = np.concatenate([[0], np.cumsum(c0)])
+        tb = np.clip(tb, 0, n_out)
+        aligned = []
+        for i, p in enumerate(pulls):
+            off = np.concatenate([[0], np.cumsum(p.counts)])
+            same = (len(off) == len(tb) and np.array_equal(
+                np.clip(off, 0, n_out), tb))
+            if same:
+                aligned.append(_mask_tail(p, n_out))
+            else:
+                aligned.append(_realign_device(p, tb, n_out,
+                                               (self.id, i)))
+        counts = (tb[1:] - tb[:-1]).astype(np.int64)
+        # fused local zip
+        cap = max(a.cap for a in aligned)
+        aligned = [_repad(a, cap) for a in aligned]
+        trees = [a.tree for a in aligned]
+        all_leaves = []
+        treedefs = []
+        for t in trees:
+            ls, td = jax.tree.flatten(t)
+            all_leaves.append(ls)
+            treedefs.append(td)
+        zip_fn = self.zip_fn
+        nums = [len(ls) for ls in all_leaves]
+        key = ("zip_fuse", id(zip_fn) if zip_fn else None, cap,
+               tuple(treedefs), tuple(tuple((l.dtype, l.shape[2:])
+                                            for l in ls)
+                                      for ls in all_leaves))
+        holder = {}
+
+        def build():
+            def f(*flat):
+                trees_in = []
+                i = 0
+                for td, k in zip(treedefs, nums):
+                    trees_in.append(jax.tree.unflatten(
+                        td, [x[0] for x in flat[i:i + k]]))
+                    i += k
+                out = zip_fn(*trees_in) if zip_fn else tuple(trees_in)
+                out_leaves, out_td = jax.tree.flatten(out)
+                holder["treedef"] = out_td
+                return tuple(l[None] for l in out_leaves)
+
+            return mex.smap(f, sum(nums)), holder
+
+        fn, h = mex.cached(key, build)
+        out = fn(*[l for ls in all_leaves for l in ls])
+        tree = jax.tree.unflatten(h["treedef"], list(out))
+        return DeviceShards(mex, tree, counts)
+
+    def _compute_host(self, pulls: List[HostShards]):
+        W = pulls[0].num_workers
+        lists = [[it for l in p.lists for it in l] for p in pulls]
+        totals = [len(l) for l in lists]
+        n_out = self._out_size(totals)
+        if self.mode == "pad":
+            pads = [l[-1] if l else None for l in lists]
+            lists = [l + [pads[i]] * (n_out - len(l))
+                     for i, l in enumerate(lists)]
+        zf = self.zip_fn or (lambda *xs: tuple(xs))
+        zipped = [zf(*vals) for vals in zip(*[l[:n_out] for l in lists])]
+        bounds = [(w * n_out) // W for w in range(W + 1)]
+        return HostShards(W, [zipped[bounds[w]:bounds[w + 1]]
+                              for w in range(W)])
+
+
+def _repad(shards: DeviceShards, cap: int) -> DeviceShards:
+    if shards.cap == cap:
+        return shards
+    pad = cap - shards.cap
+    tree = jax.tree.map(
+        lambda l: jnp.pad(l, [(0, 0), (0, pad)] + [(0, 0)] * (l.ndim - 2)),
+        shards.tree)
+    return DeviceShards(shards.mesh_exec, tree, shards.counts)
+
+
+class ZipWithIndexNode(DIABase):
+    """zip_fn(item, global_index) (reference: api/zip_with_index.hpp:38)."""
+
+    def __init__(self, ctx, link, zip_fn: Optional[Callable]) -> None:
+        super().__init__(ctx, "ZipWithIndex", [link])
+        self.zip_fn = zip_fn
+
+    def compute(self):
+        shards = self.parents[0].pull()
+        zf = self.zip_fn or (lambda it, i: (it, i))
+        if isinstance(shards, HostShards):
+            out, g = [], 0
+            for items in shards.lists:
+                lst = []
+                for it in items:
+                    lst.append(zf(it, g))
+                    g += 1
+                out.append(lst)
+            return HostShards(shards.num_workers, out)
+
+        mex = shards.mesh_exec
+        cap = shards.cap
+        offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
+        leaves, treedef = jax.tree.flatten(shards.tree)
+        key = ("zip_index", id(self.zip_fn) if self.zip_fn else None,
+               cap, treedef, tuple((l.dtype, l.shape[2:]) for l in leaves))
+        holder = {}
+
+        def build():
+            def f(off, *ls):
+                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+                g = off[0, 0] + jnp.arange(cap, dtype=jnp.int64)
+                out = zf(tree, g)
+                out_leaves, out_td = jax.tree.flatten(out)
+                holder["treedef"] = out_td
+                return tuple(l[None] for l in out_leaves)
+
+            return mex.smap(f, 1 + len(leaves)), holder
+
+        fn, h = mex.cached(key, build)
+        out = fn(mex.put(offsets.astype(np.int64)[:, None]), *leaves)
+        tree = jax.tree.unflatten(h["treedef"], list(out))
+        return DeviceShards(mex, tree, shards.counts.copy())
+
+
+def Zip(dias: List[DIA], zip_fn=None, mode: str = "strict") -> DIA:
+    assert len(dias) >= 2
+    return DIA(ZipNode(dias[0].context, [d._link() for d in dias],
+                       zip_fn, mode))
+
+
+def ZipWithIndex(dia: DIA, zip_fn=None) -> DIA:
+    return DIA(ZipWithIndexNode(dia.context, dia._link(), zip_fn))
+
+
+class ZipWindowNode(DIABase):
+    """Zip fixed-size windows across DIAs
+    (reference: api/zip_window.hpp:175): DIA i is consumed in chunks of
+    window[i] items; output item j is the tuple of chunk j from each."""
+
+    def __init__(self, ctx, links, window, zip_fn) -> None:
+        super().__init__(ctx, "ZipWindow", links)
+        self.window = tuple(int(w) for w in window)
+        self.zip_fn = zip_fn
+
+    def compute(self):
+        pulls = [l.pull() for l in self.parents]
+        pulls = [p.to_host_shards() if isinstance(p, DeviceShards) else p
+                 for p in pulls]
+        W = pulls[0].num_workers
+        flats = [[it for l in p.lists for it in l] for p in pulls]
+        n_out = min(len(f) // w for f, w in zip(flats, self.window))
+        zf = self.zip_fn or (lambda *chunks: tuple(chunks))
+        out = [zf(*[flats[i][j * w:(j + 1) * w]
+                    for i, w in enumerate(self.window)])
+               for j in range(n_out)]
+        bounds = [(w * n_out) // W for w in range(W + 1)]
+        return HostShards(W, [out[bounds[w]:bounds[w + 1]]
+                              for w in range(W)])
+
+
+def ZipWindowOp(dias: List[DIA], window, zip_fn=None) -> DIA:
+    return DIA(ZipWindowNode(dias[0].context, [d._link() for d in dias],
+                             window, zip_fn))

@@ -1,0 +1,112 @@
+"""Lazily-fused local operation (LOp) stacks.
+
+The reference fuses chained Map/Filter/FlatMap lambdas into the consuming
+distributed op at *compile time* via template function stacks
+(reference: thrill/api/dia.hpp:358-387 stack push, tlx::FunctionStack),
+so no per-item virtual call happens. The TPU-native equivalent: a DIA
+handle carries a tuple of StackOps which are *traced* into the consuming
+operator's jitted program — XLA fusion replaces template fusion, and the
+whole chain becomes one device kernel between materialization points.
+
+Semantics of user functions:
+* host storage  — ``fn`` is applied per item (Thrill-style).
+* device storage — ``fn`` is applied to **batched columns**: each leaf of
+  the item pytree carries a leading item axis. For elementwise lambdas
+  (``lambda x: x * 2``, ``lambda kv: (kv[0], kv[1] + 1)``) this is
+  identical to per-item semantics; scalar outputs are broadcast to the
+  item axis automatically. Items whose leaves are themselves arrays
+  (fixed-width byte strings) must index with an explicit trailing axis
+  (``x[:, 3]``), the one divergence from per-item code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class StackOp:
+    kind: str                      # 'map' | 'filter' | 'flat_map'
+    fn: Callable                   # see module docstring for semantics
+    # device flat_map only: static expansion factor k; fn returns
+    # (tree [n, k, ...], valid [n, k]) in batched form.
+    factor: int = 1
+
+    def cache_token(self) -> Tuple:
+        return (self.kind, id(self.fn), self.factor)
+
+
+Stack = Tuple[StackOp, ...]
+
+
+def stack_cache_token(stack: Stack) -> Tuple:
+    return tuple(op.cache_token() for op in stack)
+
+
+def _broadcast_outputs(tree: Any, n: int) -> Any:
+    """Broadcast scalar leaves to the item axis after a map fn."""
+    def fix(leaf):
+        arr = jnp.asarray(leaf)
+        if arr.ndim == 0 or arr.shape[0] != n:
+            arr = jnp.broadcast_to(arr, (n,) + arr.shape)
+        return arr
+    return jax.tree.map(fix, tree)
+
+
+def apply_stack_traced(tree: Any, mask: jnp.ndarray, stack: Stack):
+    """Apply a stack inside a traced program. Returns (tree, mask).
+
+    The item count may grow only through flat_map (factor-k static
+    expansion); mask tracks validity, compaction happens once at the
+    consumer's boundary.
+    """
+    for op in stack:
+        n = mask.shape[0]
+        if op.kind == "map":
+            tree = _broadcast_outputs(op.fn(tree), n)
+        elif op.kind == "filter":
+            keep = jnp.asarray(op.fn(tree))
+            mask = mask & keep.astype(bool)
+        elif op.kind == "flat_map":
+            out_tree, out_valid = op.fn(tree)
+            k = op.factor
+            out_valid = jnp.asarray(out_valid)
+            assert out_valid.shape[:2] == (n, k), (
+                f"flat_map valid mask must be [n, {k}], got {out_valid.shape}")
+            tree = jax.tree.map(
+                lambda leaf: jnp.reshape(leaf, (n * k,) + leaf.shape[2:]),
+                out_tree)
+            mask = (mask[:, None] & out_valid.astype(bool)).reshape(n * k)
+        else:  # pragma: no cover
+            raise ValueError(op.kind)
+    return tree, mask
+
+
+def apply_stack_host_item(item: Any, stack: Stack, emit: Callable) -> None:
+    """Apply a stack to one host item, calling ``emit`` per output item."""
+    if not stack:
+        emit(item)
+        return
+    op, rest = stack[0], stack[1:]
+    if op.kind == "map":
+        apply_stack_host_item(op.fn(item), rest, emit)
+    elif op.kind == "filter":
+        if op.fn(item):
+            apply_stack_host_item(item, rest, emit)
+    elif op.kind == "flat_map":
+        for out in op.fn(item):
+            apply_stack_host_item(out, rest, emit)
+    else:  # pragma: no cover
+        raise ValueError(op.kind)
+
+
+def apply_stack_host_list(items, stack: Stack) -> list:
+    out: list = []
+    append = out.append
+    for it in items:
+        apply_stack_host_item(it, stack, append)
+    return out

@@ -77,12 +77,18 @@ def stable_host_hash(obj) -> int:
         return _fnv1a(obj)
     if isinstance(obj, str):
         return _fnv1a(obj.encode("utf-8"))
-    if isinstance(obj, bool):
-        return int(np_mix64(np.uint64(int(obj) + 0x9E37)))
+    # numeric tower: values that compare equal must hash equal
+    # (True == 1, 5.0 == 5, -0.0 == 0.0), like Python's own hash contract
+    if isinstance(obj, (bool, np.bool_)):
+        obj = int(obj)
+    if isinstance(obj, (float, np.floating)):
+        f = float(obj) + 0.0          # normalizes -0.0 -> +0.0
+        if f.is_integer() and abs(f) < 2.0 ** 63:
+            obj = int(f)
+        else:
+            return int(np_mix64(np.float64(f).view(np.uint64)))
     if isinstance(obj, (int, np.integer)):
         return int(np_mix64(np.uint64(int(obj) & 0xFFFFFFFFFFFFFFFF)))
-    if isinstance(obj, float):
-        return int(np_mix64(np.float64(obj).view(np.uint64)))
     if isinstance(obj, tuple):
         h = np.uint64(0x9E3779B97F4A7C15)
         for el in obj:

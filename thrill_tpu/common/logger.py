@@ -40,10 +40,10 @@ class JsonLogger:
 
     @property
     def enabled(self) -> bool:
-        return self._file is not None
+        return self._file is not None and not self._file.closed
 
     def line(self, **fields: Any) -> None:
-        if self._file is None:
+        if self._file is None or self._file.closed:
             return
         rec = {"ts": int(time.time() * 1e6)}
         rec.update(self.common)

@@ -56,8 +56,8 @@ class ReservoirSamplingGrow(Generic[T]):
         if j < size:
             if len(self.samples) < size:
                 self.samples.append(item)
-            else:
-                self.samples[j % len(self.samples)] = item
+            else:  # len == size here, so j indexes in range
+                self.samples[j] = item
 
     def add_batch(self, items) -> None:
         for it in items:

@@ -1,0 +1,96 @@
+"""Sort-based segmented aggregation: the device reduce engine.
+
+The reference aggregates with linear-probing hash tables
+(reference: thrill/core/reduce_pre_phase.hpp:94,
+reduce_by_hash_post_phase.hpp:44, reduce_probing_hash_table.hpp:77).
+Hash tables are a pointer-chasing CPU idiom; the TPU-native equivalent
+is sort + segmented reduction: XLA's bitonic sort groups equal keys into
+runs, a segmented associative scan combines each run with the user's
+reduce function, and run representatives are compacted out. Everything
+is static-shaped, branch-free and VPU/MXU friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sort_by_key_words(words: List[jnp.ndarray], tree: Any, valid: jnp.ndarray,
+                      extra_words: List[jnp.ndarray] = ()):
+    """Stable sort of (words, tree, valid) with invalid items last.
+
+    Returns (sorted_words, sorted_tree, sorted_valid). ``extra_words``
+    sort after the key words (e.g. global index for stability).
+    """
+    invalid_first_word = (~valid).astype(jnp.uint64)  # valid(0) < invalid(1)
+    sort_keys = [invalid_first_word] + list(words) + list(extra_words)
+    perm = _argsort_multi(sort_keys)
+    take = lambda x: jnp.take(x, perm, axis=0)
+    return ([take(w) for w in words],
+            jax.tree.map(take, tree),
+            take(valid),
+            [take(w) for w in extra_words])
+
+
+def _argsort_multi(keys: List[jnp.ndarray]) -> jnp.ndarray:
+    """Stable argsort by multiple uint64 key arrays (lexicographic)."""
+    n = keys[0].shape[0]
+    iota = jnp.arange(n, dtype=jnp.uint64)
+    res = jax.lax.sort(tuple(keys) + (iota,), dimension=0,
+                       num_keys=len(keys), is_stable=True)
+    return res[-1].astype(jnp.int32)
+
+
+def segment_boundaries(words: List[jnp.ndarray], valid: jnp.ndarray
+                       ) -> jnp.ndarray:
+    """starts[i] = True iff item i begins a new key run (valid items,
+    assumed key-sorted with invalid last)."""
+    n = valid.shape[0]
+    idx = jnp.arange(n)
+    diff = jnp.zeros(n, dtype=bool).at[0].set(True)
+    neq = jnp.zeros(n, dtype=bool)
+    for w in words:
+        neq = neq | (w != jnp.roll(w, 1))
+    diff = diff | neq
+    return diff & valid
+
+
+def segmented_reduce(words: List[jnp.ndarray], tree: Any,
+                     valid: jnp.ndarray, reduce_fn: Callable
+                     ) -> Tuple[List[jnp.ndarray], Any, jnp.ndarray]:
+    """Combine each equal-key run into one item.
+
+    Inputs must be key-sorted with invalid items last. Returns
+    (words, tree, rep_mask): ``rep_mask`` marks one surviving item per
+    run, whose tree value is the fold of the whole run. The fold uses a
+    segmented inclusive scan, so ``reduce_fn`` must be associative
+    (same contract as the reference's reduce function).
+    """
+    n = valid.shape[0]
+    starts = segment_boundaries(words, valid)
+
+    def combine(a, b):
+        tree_a, flag_a = a
+        tree_b, flag_b = b
+        merged = reduce_fn(tree_a, tree_b)
+        keep_b = jax.tree.map(
+            lambda m, vb: jnp.where(_bshape(flag_b, m), vb, m),
+            merged, tree_b)
+        return keep_b, flag_a | flag_b
+
+    scanned, _ = jax.lax.associative_scan(combine, (tree, starts), axis=0)
+    # representative = last item of each run = position before next start,
+    # or the last valid item overall
+    next_start = jnp.roll(starts, -1).at[-1].set(True)
+    count = jnp.sum(valid.astype(jnp.int32))
+    is_last_valid = jnp.arange(n) == count - 1
+    rep = valid & (next_start | is_last_valid)
+    return words, scanned, rep
+
+
+def _bshape(flag, leaf):
+    """Broadcast [n] flag against leaf [n, ...]."""
+    return flag.reshape(flag.shape + (1,) * (leaf.ndim - 1))

@@ -1,0 +1,175 @@
+"""Distributed item storage: the DIA data plane.
+
+The reference stores DIA data as serialized byte Blocks in a BlockPool
+with spill-to-disk (reference: thrill/data/block.hpp:52,
+block_pool.hpp:42, file.hpp:56). The TPU-native design replaces
+serialized row storage with **columnar struct-of-arrays**: a pytree of
+arrays with leading shape ``[W, cap]`` sharded over the worker mesh axis,
+plus per-worker valid-item counts. Static ``cap`` keeps XLA shapes
+static; ragged per-worker sizes (the essence of DIA partitions, e.g.
+after Filter) live in the counts.
+
+Two storage classes implement one concept:
+
+* ``DeviceShards`` — HBM-resident columnar blocks (the hot path).
+* ``HostShards``   — per-worker Python lists for arbitrary objects
+  (strings, tuples of variable length...), the analog of the
+  reference's host-side serialized Files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.config import round_up, round_up_pow2
+from ..parallel.mesh import MeshExec
+
+
+def tree_leaves(tree):
+    return jax.tree.leaves(tree)
+
+
+def tree_map(fn, *trees):
+    return jax.tree.map(fn, *trees)
+
+
+@dataclasses.dataclass
+class DeviceShards:
+    """Columnar device storage: leaves [W, cap, ...], sharded on axis 0."""
+
+    mesh_exec: MeshExec
+    tree: Any                  # pytree of jax arrays [W, cap, *]
+    counts: np.ndarray         # host copy of per-worker valid counts [W]
+
+    @property
+    def num_workers(self) -> int:
+        return self.mesh_exec.num_workers
+
+    @property
+    def cap(self) -> int:
+        return tree_leaves(self.tree)[0].shape[1]
+
+    @property
+    def total(self) -> int:
+        return int(self.counts.sum())
+
+    def counts_device(self) -> jax.Array:
+        """Counts as a sharded [W, 1] device array (one scalar per shard)."""
+        return self.mesh_exec.put(self.counts.astype(np.int32)[:, None])
+
+    # -- conversion -----------------------------------------------------
+    @staticmethod
+    def from_worker_arrays(mesh_exec: MeshExec, per_worker: Sequence[Any],
+                           cap: int = 0) -> "DeviceShards":
+        """Build from W per-worker pytrees of numpy arrays (item axis 0)."""
+        W = mesh_exec.num_workers
+        assert len(per_worker) == W
+        counts = np.array(
+            [np.shape(tree_leaves(t)[0])[0] if tree_leaves(t) else 0
+             for t in per_worker], dtype=np.int64)
+        if cap <= 0:
+            cap = max(1, round_up_pow2(int(counts.max()) if len(counts) else 1))
+
+        def pad_stack(*leaves):
+            out = []
+            for leaf in leaves:
+                leaf = np.asarray(leaf)
+                pad = [(0, cap - leaf.shape[0])] + [(0, 0)] * (leaf.ndim - 1)
+                out.append(np.pad(leaf, pad))
+            return np.stack(out)
+
+        host_tree = tree_map(pad_stack, *per_worker)
+        return DeviceShards(mesh_exec, mesh_exec.put_tree(host_tree), counts)
+
+    @staticmethod
+    def from_global_numpy(mesh_exec: MeshExec, tree: Any) -> "DeviceShards":
+        """Evenly range-split one global pytree (item axis 0) across workers."""
+        W = mesh_exec.num_workers
+        leaves = tree_leaves(tree)
+        n = leaves[0].shape[0] if leaves else 0
+        bounds = [(w * n) // W for w in range(W + 1)]
+        per_worker = [tree_map(lambda a: np.asarray(a)[bounds[w]:bounds[w + 1]], tree)
+                      for w in range(W)]
+        return DeviceShards.from_worker_arrays(mesh_exec, per_worker)
+
+    def to_worker_arrays(self) -> List[Any]:
+        """Fetch to host: W pytrees of numpy arrays trimmed to counts."""
+        host_tree = jax.tree.map(np.asarray, self.tree)
+        out = []
+        for w in range(self.num_workers):
+            c = int(self.counts[w])
+            out.append(tree_map(lambda a: a[w, :c], host_tree))
+        return out
+
+    def to_global_numpy(self) -> Any:
+        """Concatenate all workers' valid items in worker-rank order."""
+        per_worker = self.to_worker_arrays()
+        return tree_map(lambda *leaves: np.concatenate(leaves, axis=0),
+                        *per_worker)
+
+    def to_host_shards(self) -> "HostShards":
+        """Itemize into per-worker Python lists (scalars unboxed)."""
+        lists: List[List[Any]] = []
+        for tree in self.to_worker_arrays():
+            leaves, treedef = jax.tree.flatten(tree)
+            n = leaves[0].shape[0] if leaves else 0
+            items = []
+            for i in range(n):
+                vals = [leaf[i] if leaf.ndim > 1 else leaf[i].item()
+                        for leaf in leaves]
+                items.append(jax.tree.unflatten(treedef, vals))
+            lists.append(items)
+        return HostShards(self.num_workers, lists)
+
+
+@dataclasses.dataclass
+class HostShards:
+    """Per-worker Python item lists (the generic fallback storage)."""
+
+    num_workers: int
+    lists: List[List[Any]]
+
+    @property
+    def counts(self) -> np.ndarray:
+        return np.array([len(l) for l in self.lists], dtype=np.int64)
+
+    @property
+    def total(self) -> int:
+        return sum(len(l) for l in self.lists)
+
+    def to_device(self, mesh_exec: MeshExec) -> DeviceShards:
+        """Columnarize (requires items be fixed-shape pytrees of numbers)."""
+        per_worker = []
+        for items in self.lists:
+            if items:
+                treedef = jax.tree.structure(items[0])
+                cols = [np.asarray([jax.tree.leaves(it)[i] for it in items])
+                        for i in range(treedef.num_leaves)]
+                per_worker.append(jax.tree.unflatten(treedef, cols))
+            else:
+                per_worker.append(None)
+        # empty workers: borrow structure from a non-empty one
+        template = next((t for t in per_worker if t is not None), None)
+        if template is None:
+            raise ValueError("cannot infer schema of an entirely empty DIA")
+        empty = tree_map(lambda a: a[:0], template)
+        per_worker = [t if t is not None else empty for t in per_worker]
+        return DeviceShards.from_worker_arrays(mesh_exec, per_worker)
+
+
+def compact_valid(tree, mask):
+    """Inside-jit compaction: move valid items to the front, stably.
+
+    tree leaves: [n, ...]; mask: [n] bool. Returns (tree, count).
+    Uses a stable argsort on the inverted mask — O(n log n) but maps to
+    a single XLA sort, which the TPU executes as a fast bitonic pass.
+    """
+    n = mask.shape[0]
+    order = jnp.argsort(~mask, stable=True)
+    out = tree_map(lambda leaf: jnp.take(leaf, order, axis=0), tree)
+    return out, jnp.sum(mask.astype(jnp.int32))

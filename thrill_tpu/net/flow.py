@@ -56,9 +56,8 @@ class FlowControlChannel:
         the workhorse of Sort/Zip size negotiation.
         """
         excl = self.group.ex_prefix_sum(value, op, initial)
-        incl = op(excl, value) if self.num_workers > 1 else op(initial, value)
-        total = self.group.broadcast(
-            incl, origin=self.num_workers - 1)
+        incl = op(excl, value)
+        total = self.group.broadcast(incl, origin=self.num_workers - 1)
         return excl, total
 
     def broadcast(self, value: Any, origin: int = 0) -> Any:
@@ -131,8 +130,13 @@ class LocalFlowControl:
     def all_gather(self, values: Sequence[Any]) -> List[Any]:
         return list(values)
 
-    def all_reduce(self, values: Sequence[Any], op: Callable = operator.add) -> Any:
-        acc = values[0]
+    def all_reduce(self, values: Sequence[Any], op: Callable = operator.add,
+                   initial: Any = None) -> Any:
+        if not values:
+            if initial is None:
+                raise ValueError("all_reduce over zero workers needs initial")
+            return initial
+        acc = values[0] if initial is None else op(initial, values[0])
         for v in values[1:]:
             acc = op(acc, v)
         return acc
