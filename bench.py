@@ -1,0 +1,95 @@
+"""Benchmark: TeraSort record throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The north-star workload (BASELINE.md) is TeraSort — 100-byte records
+with 10-byte keys through the full DIA Sort pipeline. The reference
+C++ framework cannot be built in this image (extlib submodules tlx/
+foxxll are not checked out and there is no network), so ``vs_baseline``
+compares against the strongest available host-side proxy measured in
+the same run: numpy's lexsort-based TeraSort of the identical records
+on the host CPU (argsort via np.lexsort over the packed key words +
+payload gather). vs_baseline = device_throughput / host_throughput.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _host_terasort(keys: np.ndarray, values: np.ndarray):
+    """numpy proxy baseline: pack key words, lexsort, gather."""
+    w0 = np.zeros(len(keys), dtype=np.uint64)
+    w1 = np.zeros(len(keys), dtype=np.uint64)
+    for i in range(8):
+        w0 = (w0 << np.uint64(8)) | keys[:, i].astype(np.uint64)
+    for i in range(8, 10):
+        w1 = (w1 << np.uint64(8)) | keys[:, i].astype(np.uint64)
+    w1 <<= np.uint64(48)
+    perm = np.lexsort((w1, w0))
+    return keys[perm], values[perm]
+
+
+def _key_fn(r):
+    """Module-level key extractor: stable identity -> the Sort executable
+    compiles once and is reused across timed iterations (a fresh lambda
+    per run would miss the program cache and re-pay TPU compile time)."""
+    return r["key"]
+
+
+def main():
+    import os
+
+    import jax
+
+    import thrill_tpu  # noqa: F401  (enables x64)
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    platform = jax.default_backend()
+    default_n = 1 << 21 if platform != "cpu" else 1 << 18
+    n = int(os.environ.get("THRILL_TPU_BENCH_N", default_n))
+
+    rng = np.random.default_rng(0)
+    recs = {
+        "key": rng.integers(0, 256, size=(n, 10)).astype(np.uint8),
+        "value": rng.integers(0, 256, size=(n, 90)).astype(np.uint8),
+    }
+
+    mex = MeshExec()  # all local devices (1 real TPU chip under axon)
+    ctx = Context(mex)
+
+    def run_once():
+        out = ctx.Distribute(recs).Sort(key_fn=_key_fn)
+        shards = out.node.materialize()
+        jax.block_until_ready(jax.tree.leaves(shards.tree))
+        return shards
+
+    run_once()                      # warmup + compile
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_once()
+    dt = (time.perf_counter() - t0) / iters
+
+    # host proxy baseline on identical data
+    t0 = time.perf_counter()
+    _host_terasort(recs["key"], recs["value"])
+    host_dt = time.perf_counter() - t0
+
+    mrec_s = n / dt / 1e6
+    host_mrec_s = n / host_dt / 1e6
+    print(json.dumps({
+        "metric": "terasort_throughput",
+        "value": round(mrec_s, 3),
+        "unit": "Mrecords/s",
+        "vs_baseline": round(mrec_s / host_mrec_s, 3),
+    }))
+    ctx.close()
+
+
+if __name__ == "__main__":
+    main()

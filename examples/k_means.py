@@ -1,0 +1,94 @@
+"""k-means clustering: classify + ReducePair + Collapse loop.
+
+Reference: /root/reference/examples/k-means/k-means.hpp:176-259 —
+points classified to the nearest center, per-center sums reduced
+(ReduceByKey on center index), new centers broadcast, loop with
+Collapse'd DIAs.
+
+TPU-native: points are a device [n, dim] column; classification is a
+batched distance matmul (MXU work!), the per-center reduction is
+ReduceToIndex, and centers travel to the next iteration as a small host
+array (the reference's AllReduce/broadcast step).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from thrill_tpu.api import Context
+
+
+def k_means(ctx: Context, points: np.ndarray, k: int, iterations: int = 10,
+            seed: int = 0):
+    """points: [n, dim] float64. Returns (centers [k, dim], labels DIA)."""
+    import jax.numpy as jnp
+
+    n, dim = points.shape
+    rng = np.random.default_rng(seed)
+    centers = points[rng.choice(n, size=k, replace=False)].copy()
+
+    pts = ctx.Distribute(points.astype(np.float64)).Cache() \
+        .Keep(2 * iterations + 1)
+
+    for _ in range(iterations):
+        c = jnp.asarray(centers)            # [k, dim] replicated constant
+
+        def classify(x):                    # x: [n_local, dim] batched
+            d2 = (jnp.sum(x * x, axis=1, keepdims=True)
+                  - 2.0 * x @ c.T
+                  + jnp.sum(c * c, axis=1)[None, :])
+            return jnp.argmin(d2, axis=1).astype(jnp.int64)
+
+        labeled = pts.Map(lambda x: {"i": classify(x), "x": x,
+                                     "cnt": x[:, 0] * 0 + 1.0})
+        sums = labeled.ReduceToIndex(
+            lambda t: t["i"],
+            lambda a, b: {"i": a["i"], "x": a["x"] + b["x"],
+                          "cnt": a["cnt"] + b["cnt"]},
+            k, neutral={"i": 0, "x": np.zeros(dim), "cnt": 0.0})
+        agg = sums.AllGather()
+        new_centers = np.stack([np.asarray(t["x"]) for t in agg])
+        cnts = np.array([float(t["cnt"]) for t in agg])
+        nonzero = cnts > 0
+        new_centers[nonzero] /= cnts[nonzero, None]
+        new_centers[~nonzero] = centers[~nonzero]
+        centers = new_centers
+
+    return centers
+
+
+def k_means_dense(points: np.ndarray, centers0: np.ndarray,
+                  iterations: int) -> np.ndarray:
+    centers = centers0.copy()
+    for _ in range(iterations):
+        d2 = ((points[:, None, :] - centers[None, :, :]) ** 2).sum(-1)
+        lab = d2.argmin(1)
+        for j in range(len(centers)):
+            sel = points[lab == j]
+            if len(sel):
+                centers[j] = sel.mean(0)
+    return centers
+
+
+def main():
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--points", type=int, default=10000)
+    parser.add_argument("--dim", type=int, default=8)
+    parser.add_argument("--clusters", type=int, default=10)
+    parser.add_argument("--iters", type=int, default=10)
+    args = parser.parse_args()
+
+    from thrill_tpu.api import Run
+
+    def job(ctx):
+        rng = np.random.default_rng(1)
+        pts = rng.normal(size=(args.points, args.dim))
+        centers = k_means(ctx, pts, args.clusters, args.iters)
+        print(centers)
+
+    Run(job)
+
+
+if __name__ == "__main__":
+    main()

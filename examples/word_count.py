@@ -1,0 +1,74 @@
+"""WordCount: the canonical FlatMap + ReduceByKey pipeline.
+
+Reference: /root/reference/examples/word_count/word_count.hpp:35-57
+(FlatMap split + ReduceByKey sum). Two variants:
+
+* ``word_count``     — faithful text pipeline (host storage for strings)
+* ``word_count_fixed`` — TPU-native: words packed into fixed-width byte
+  vectors on device, the whole aggregation running as jitted programs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from thrill_tpu.api import Context
+
+
+def word_count(ctx: Context, path_or_lines):
+    """Returns a DIA of (word, count) pairs from text."""
+    if isinstance(path_or_lines, str):
+        lines = ctx.ReadLines(path_or_lines)
+    else:
+        lines = ctx.Distribute(list(path_or_lines), storage="host")
+    return (lines
+            .FlatMap(lambda line: line.split())
+            .Map(lambda w: (w, 1))
+            .ReduceByKey(lambda kv: kv[0],
+                         lambda a, b: (a[0], a[1] + b[1])))
+
+
+MAX_WORD = 16   # device variant: words truncated/padded to 16 bytes
+
+
+def pack_words(words) -> np.ndarray:
+    """Pack a list of strings into [n, MAX_WORD] uint8 (zero padded)."""
+    arr = np.zeros((len(words), MAX_WORD), dtype=np.uint8)
+    for i, w in enumerate(words):
+        b = w.encode("utf-8")[:MAX_WORD]
+        arr[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return arr
+
+
+def word_count_fixed(ctx: Context, packed: np.ndarray):
+    """Device WordCount over pre-packed fixed-width words.
+
+    The reduce runs fully on device: key = the byte vector itself
+    (encoded to uint64 words), value = count.
+    """
+    d = ctx.Distribute({"w": packed,
+                        "c": np.ones(len(packed), dtype=np.int64)})
+    return d.ReduceByKey(lambda t: t["w"],
+                         lambda a, b: {"w": a["w"], "c": a["c"] + b["c"]})
+
+
+def main():
+    import argparse
+    parser = argparse.ArgumentParser(description="thrill_tpu WordCount")
+    parser.add_argument("input", help="text file/glob")
+    parser.add_argument("--top", type=int, default=10)
+    args = parser.parse_args()
+
+    from thrill_tpu.api import Run
+
+    def job(ctx):
+        counts = word_count(ctx, args.input).AllGather()
+        counts.sort(key=lambda kv: -kv[1])
+        for w, c in counts[:args.top]:
+            print(f"{c:8d}  {w}")
+
+    Run(job)
+
+
+if __name__ == "__main__":
+    main()

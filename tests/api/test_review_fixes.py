@@ -1,0 +1,58 @@
+"""Regression tests for review findings."""
+
+import numpy as np
+import pytest
+
+from thrill_tpu.api import RunLocalMock, Zip
+
+
+def test_sum_with_fn_and_initial():
+    def job(ctx):
+        d = ctx.Generate(10)
+        # custom fold function must be honored
+        assert ctx.Generate(10).Sum(fn=lambda a, b: max(a, b)) == 9
+        # device-path initial must be folded in
+        assert int(ctx.Generate(10).Sum(initial=100)) == 145
+        h = ctx.Generate(10, storage="host").Sum(initial=100)
+        assert h == 145
+    RunLocalMock(job, 4)
+
+
+def test_distribute_generator_not_truncated():
+    def job(ctx):
+        d = ctx.Distribute(x for x in range(10))
+        got = sorted(int(v) for v in d.AllGather())
+        assert got == list(range(10))
+    RunLocalMock(job, 4)
+
+
+def test_zip_pad_uses_default_items():
+    def job(ctx):
+        a = ctx.Distribute(list(range(5)), storage="host")
+        b = ctx.Distribute([10, 20], storage="host")
+        z = Zip(a, b, zip_fn=lambda x, y: (x, y), mode="pad")
+        got = z.AllGather()
+        assert got == [(0, 10), (1, 20), (2, 0), (3, 0), (4, 0)]
+    RunLocalMock(job, 3)
+
+
+def test_consume_semantics_reclaim_and_error():
+    def job(ctx):
+        d = ctx.Generate(100).Cache()
+        assert d.Keep().Size() == 100          # budget 2 -> 1
+        assert d.Size() == 100                  # budget 1 -> 0, disposed
+        with pytest.raises(RuntimeError, match="consume budget"):
+            d.Size()
+    RunLocalMock(job, 2)
+
+
+def test_executable_cache_pins_functions():
+    # freed lambdas must not alias cached executables
+    def job(ctx):
+        outs = []
+        for mult in (2, 3):
+            d = ctx.Generate(50).Map(lambda x, m=mult: x * m)
+            outs.append([int(v) for v in d.AllGather()])
+        assert outs[0] == [i * 2 for i in range(50)]
+        assert outs[1] == [i * 3 for i in range(50)]
+    RunLocalMock(job, 2)

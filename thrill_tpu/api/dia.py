@@ -178,6 +178,8 @@ class DIA:
 
     def Sum(self, fn: Callable = None, initial: Any = 0) -> Any:
         from .ops import actions
+        if fn is not None:
+            return actions.AllReduce(self, fn, initial)
         return actions.Sum(self, initial)
 
     def Min(self) -> Any:

@@ -35,7 +35,7 @@ class ParentLink:
     node: "DIABase"
     stack: Stack
 
-    def pull(self, consume: bool = False) -> Shards:
+    def pull(self, consume: bool = True) -> Shards:
         shards = self.node.materialize(consume=consume)
         if not self.stack:
             return shards
@@ -61,9 +61,10 @@ class DIABase:
         self.id = ctx._register_node(self)
         self.state = NEW
         self._shards: Optional[Shards] = None
-        # number of remaining consuming pulls before data may be freed;
-        # reference: consume counters, api/dia_base.hpp:226-250
-        self.consume_budget = 0
+        # number of remaining consuming pulls before data is freed; every
+        # node's data may be used once, .Keep(n) allows n more uses
+        # (reference: consume counters, api/dia_base.hpp:226-250)
+        self.consume_budget = 1
 
     # -- overridables ---------------------------------------------------
     def compute(self) -> Shards:
@@ -74,8 +75,9 @@ class DIABase:
     def materialize(self, consume: bool = False) -> Shards:
         if self.state == DISPOSED:
             raise RuntimeError(
-                f"DIA node {self.label}#{self.id} was consumed/disposed; "
-                f"call .Keep() before reusing a DIA")
+                f"DIA node {self.label}#{self.id} was consumed/disposed "
+                f"(consume budget exhausted); call .Keep() before reusing "
+                f"a DIA in more than one operation")
         if self._shards is None:
             log = self.context.logger
             if log.enabled:

@@ -21,7 +21,7 @@ from ...data.shards import DeviceShards, HostShards
 from ...parallel.mesh import AXIS
 
 
-def _pull(dia, consume: bool = False):
+def _pull(dia, consume: bool = True):
     return dia._link().pull(consume)
 
 
@@ -99,7 +99,15 @@ def Sum(dia, initial: Any = 0) -> Any:
     if isinstance(shards, DeviceShards):
         if shards.total == 0:
             return initial
-        return _device_reduce(shards, "sum")
+        reduced = _device_reduce(shards, "sum")
+        if initial is None or (np.isscalar(initial) and initial == 0):
+            return reduced
+        # fold the initial value like the host path does; accept either
+        # a matching pytree or a scalar broadcast over all leaves
+        try:
+            return jax.tree.map(lambda r, i: r + i, reduced, initial)
+        except ValueError:
+            return jax.tree.map(lambda r: r + initial, reduced)
     items = [it for l in shards.lists for it in l]
     return functools.reduce(lambda a, b: a + b, items, initial)
 

@@ -45,7 +45,7 @@ class GroupByKeyNode(DIABase):
                     return (h % jnp.uint64(W)).astype(jnp.int32)
 
                 shards = exchange.exchange(
-                    shards, dest, ("groupby_dest", id(key_fn), W))
+                    shards, dest, ("groupby_dest", key_fn, W))
             shards = shards.to_host_shards()
         else:
             shards = exchange.host_exchange(

@@ -73,7 +73,7 @@ class InnerJoinNode(DIABase):
         mex = left.mesh_exec
         W = mex.num_workers
         lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
-        token = (id(lkey), id(rkey), id(jfn))
+        token = (lkey, rkey, jfn)
 
         if W > 1:
             def mk_dest(key_fn):
@@ -168,29 +168,48 @@ class InnerJoinNode(DIABase):
 
 
 def _run_bounds(lw, lvalid, rw, rvalid):
-    """For each right item: [lo, hi) bounds of equal-key run in sorted
-    left words (lexicographic multi-word searchsorted via pairwise
-    comparisons against the sorted left arrays)."""
+    """For each right item: [lo, hi) bounds of its equal-key run among
+    the sorted valid left items.
+
+    O((L+R) log(L+R)): both sides' key words are sorted together with a
+    side flag. With right sorting *after* equal left keys, a right item
+    at combined position p has (p - #rights before) = #lefts with key
+    <= its key = ``hi``; flipping the flag gives #lefts with key < its
+    key = ``lo``. Invalid items carry max-words so they sort last and
+    never perturb valid bounds.
+    """
     lcap = lw[0].shape[0]
-    # left items: invalid -> +inf words so they sort conceptually last
+    rcap = rw[0].shape[0]
     maxw = jnp.uint64(0xFFFFFFFFFFFFFFFF)
     lws = [jnp.where(lvalid, w, maxw) for w in lw]
+    rws = [jnp.where(rvalid, w, maxw) for w in rw]
 
-    def lex_less(a_words, b_words):
-        """a < b elementwise-broadcast: a [L,1] vs b [1,R] -> [L,R]"""
-        lt = jnp.zeros((a_words[0].shape[0], b_words[0].shape[1]), bool)
-        eq = jnp.ones_like(lt)
-        for aw, bw in zip(a_words, b_words):
-            lt = lt | (eq & (aw < bw))
-            eq = eq & (aw == bw)
-        return lt, eq
+    def counts_below(right_after: bool):
+        side_l = jnp.zeros(lcap, jnp.uint64) if right_after else \
+            jnp.ones(lcap, jnp.uint64)
+        side_r = jnp.ones(rcap, jnp.uint64) if right_after else \
+            jnp.zeros(rcap, jnp.uint64)
+        words = [jnp.concatenate([a, b]) for a, b in zip(lws, rws)]
+        side = jnp.concatenate([side_l, side_r])
+        ridx = jnp.concatenate([jnp.full(lcap, rcap, jnp.uint64),
+                                jnp.arange(rcap, dtype=jnp.uint64)])
+        res = jax.lax.sort(tuple(words) + (side, ridx),
+                           dimension=0, num_keys=len(words) + 1,
+                           is_stable=True)
+        side_s, ridx_s = res[-2], res[-1]
+        is_right = side_s == (1 if right_after else 0)
+        pos = jnp.arange(lcap + rcap, dtype=jnp.int64)
+        rights_before_incl = jnp.cumsum(is_right.astype(jnp.int64))
+        lefts_before = pos + 1 - rights_before_incl
+        # scatter back to right-item order
+        out = jnp.zeros(rcap + 1, jnp.int64)
+        tgt = jnp.where(is_right, ridx_s.astype(jnp.int64), rcap)
+        out = out.at[tgt].set(jnp.where(is_right, lefts_before, 0))
+        return out[:rcap]
 
-    a = [w[:, None] for w in lws]
-    b = [w[None, :] for w in rw]
-    lt, eq = lex_less(a, b)            # [lcap, rcap]
-    lo = jnp.sum(lt, axis=0)           # #left strictly below each right
-    hi = lo + jnp.sum(eq, axis=0)      # + equals
-    return lo.astype(jnp.int64), hi.astype(jnp.int64)
+    hi = counts_below(right_after=True)
+    lo = counts_below(right_after=False)
+    return lo, hi
 
 
 def _h(k):

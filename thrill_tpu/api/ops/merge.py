@@ -46,7 +46,7 @@ class MergeNode(DIABase):
         combined = rebalance_to_even(pulls[0].mesh_exec, pulls,
                                      ("merge", self.id))
         return _device_sample_sort(combined, self.key_fn,
-                                   ("merge", id(self.key_fn)))
+                                   ("merge", self.key_fn))
 
 
 def Merge(dias: List[DIA], key_fn=None) -> DIA:

@@ -74,7 +74,7 @@ class ReduceNode(DIABase):
         if isinstance(shards, HostShards):
             return self._compute_host(shards)
         key_fn, reduce_fn = self.key_fn, self.reduce_fn
-        token = (id(key_fn), id(reduce_fn))
+        token = (key_fn, reduce_fn)
         W = self.context.num_workers
         # pre-phase: local combine (reference: ReducePrePhase)
         pre = _local_reduce_device(shards, key_fn, reduce_fn, "pre", token)
@@ -147,7 +147,7 @@ class ReduceToIndexNode(DIABase):
 
         mex = shards.mesh_exec
         index_fn, reduce_fn = self.index_fn, self.reduce_fn
-        token = (id(index_fn), id(reduce_fn), n)
+        token = (index_fn, reduce_fn, n)
         bounds_dev = jnp.asarray(bounds)
 
         if W > 1:

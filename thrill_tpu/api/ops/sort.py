@@ -56,7 +56,7 @@ class SortNode(DIABase):
         if self.compare_fn is not None:
             return self._compute_host(shards.to_host_shards())
         return _device_sample_sort(shards, self.key_fn,
-                                   (id(self.key_fn),))
+                                   (self.key_fn,))
 
     def _compute_host(self, shards: HostShards):
         import functools

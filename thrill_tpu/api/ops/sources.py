@@ -45,7 +45,7 @@ class GenerateNode(DIABase):
         starts = mex.put(np.array(bounds[:W], dtype=np.int64)[:, None])
         fn = self.fn
         holder = {}
-        key = ("generate", n, cap, id(fn) if fn else None)
+        key = ("generate", n, cap, fn)
 
         def build():
             def f(start):
@@ -67,6 +67,10 @@ class DistributeNode(DIABase):
 
     def __init__(self, ctx, items, storage: Optional[str]) -> None:
         super().__init__(ctx, "Distribute")
+        # materialize iterators/generators up front: storage inference
+        # probes the first element, which would otherwise be consumed
+        if not _is_columnar(items) and not isinstance(items, (list, tuple)):
+            items = list(items)
         self.items = items
         self.storage = storage or _infer_storage(ctx, items)
 
@@ -107,8 +111,21 @@ class ConcatToDIANode(DIABase):
         return shards
 
 
-def _infer_storage(ctx, items) -> str:
+def _is_columnar(items) -> bool:
+    """Columnar input: a global array, or a dict pytree of equal-length
+    arrays (struct-of-arrays). Lists/tuples are item *sequences*."""
     if isinstance(items, np.ndarray) or hasattr(items, "dtype"):
+        return True
+    if isinstance(items, dict):
+        leaves = jax.tree.leaves(items)
+        return bool(leaves) and all(
+            isinstance(l, np.ndarray) or hasattr(l, "dtype")
+            for l in leaves)
+    return False
+
+
+def _infer_storage(ctx, items) -> str:
+    if _is_columnar(items):
         return "device"
     probe = None
     for it in items:
@@ -124,9 +141,9 @@ def _infer_storage(ctx, items) -> str:
 
 
 def _columnarize(items):
-    """list of item pytrees (or a global array) -> columnar pytree."""
-    if isinstance(items, np.ndarray) or hasattr(items, "dtype"):
-        return np.asarray(items)
+    """Columnar pytree passthrough, or list of item pytrees -> columns."""
+    if _is_columnar(items):
+        return jax.tree.map(np.asarray, items)
     items = list(items)
     if not items:
         raise ValueError("cannot infer schema of empty device DIA; "

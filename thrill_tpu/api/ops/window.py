@@ -72,7 +72,7 @@ class WindowNode(DIABase):
         offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
         leaves, treedef = jax.tree.flatten(shards.tree)
         fn = self.device_fn
-        key = ("window_dev", k, id(fn), cap, treedef,
+        key = ("window_dev", k, fn, cap, treedef,
                tuple((l.dtype, l.shape[2:]) for l in leaves))
         holder = {}
 

@@ -118,7 +118,7 @@ class ZipNode(DIABase):
             treedefs.append(td)
         zip_fn = self.zip_fn
         nums = [len(ls) for ls in all_leaves]
-        key = ("zip_fuse", id(zip_fn) if zip_fn else None, cap,
+        key = ("zip_fuse", zip_fn, cap,
                tuple(treedefs), tuple(tuple((l.dtype, l.shape[2:])
                                             for l in ls)
                                       for ls in all_leaves))
@@ -150,7 +150,9 @@ class ZipNode(DIABase):
         totals = [len(l) for l in lists]
         n_out = self._out_size(totals)
         if self.mode == "pad":
-            pads = [l[-1] if l else None for l in lists]
+            # pad with default-constructed items (reference ZipPad uses
+            # default-constructed T), derived from each side's schema
+            pads = [_default_item(l, pulls) for l in lists]
             lists = [l + [pads[i]] * (n_out - len(l))
                      for i, l in enumerate(lists)]
         zf = self.zip_fn or (lambda *xs: tuple(xs))
@@ -158,6 +160,18 @@ class ZipNode(DIABase):
         bounds = [(w * n_out) // W for w in range(W + 1)]
         return HostShards(W, [zipped[bounds[w]:bounds[w + 1]]
                               for w in range(W)])
+
+
+def _default_item(items, _pulls):
+    """Zero/default-constructed item matching this side's schema."""
+    import jax
+    if not items:
+        return None   # fully empty side: nothing to zip anyway
+    probe = items[0]
+    return jax.tree.map(
+        lambda l: (np.zeros_like(np.asarray(l))
+                   if isinstance(l, (np.ndarray, np.generic))
+                   else type(l)()), probe)
 
 
 def _repad(shards: DeviceShards, cap: int) -> DeviceShards:
@@ -194,7 +208,7 @@ class ZipWithIndexNode(DIABase):
         cap = shards.cap
         offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
         leaves, treedef = jax.tree.flatten(shards.tree)
-        key = ("zip_index", id(self.zip_fn) if self.zip_fn else None,
+        key = ("zip_index", self.zip_fn,
                cap, treedef, tuple((l.dtype, l.shape[2:]) for l in leaves))
         holder = {}
 

@@ -37,7 +37,10 @@ class StackOp:
     factor: int = 1
 
     def cache_token(self) -> Tuple:
-        return (self.kind, id(self.fn), self.factor)
+        # the function object itself (hashable by identity) keys the
+        # compiled-program cache; holding it in the key pins it alive so
+        # a freed lambda's id can never alias onto a stale executable
+        return (self.kind, self.fn, self.factor)
 
 
 Stack = Tuple[StackOp, ...]

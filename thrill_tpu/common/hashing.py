@@ -83,8 +83,9 @@ def stable_host_hash(obj) -> int:
         obj = int(obj)
     if isinstance(obj, (float, np.floating)):
         f = float(obj) + 0.0          # normalizes -0.0 -> +0.0
-        if f.is_integer() and abs(f) < 2.0 ** 63:
-            obj = int(f)
+        if f.is_integer():
+            obj = int(f)              # int path below wraps mod 2^64,
+            # keeping hash(2.0**64) == hash(2**64) like Python equality
         else:
             return int(np_mix64(np.float64(f).view(np.uint64)))
     if isinstance(obj, (int, np.integer)):
