@@ -1,0 +1,250 @@
+// Native host-side block store with LRU spill-to-disk.
+//
+// Equivalent of the reference's data-plane core: ByteBlock/Block
+// ref-counted buffers (reference: thrill/data/byte_block.hpp:51,
+// block.hpp:52) managed by a BlockPool with soft/hard RAM limits and
+// LRU eviction to disk (reference: thrill/data/block_pool.hpp:42, which
+// spills through foxxll async I/O). Here the store backs the Python
+// data layer through a ctypes interface: Python owns scheduling, C++
+// owns bytes — copies, pinning, spill files, and newline scanning for
+// the ReadLines byte-range splitter (reference: api/read_lines.hpp:181).
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 blockstore.cpp -o libblockstore.so
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <list>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Block {
+  std::vector<char> data;       // empty when spilled
+  std::string spill_path;       // non-empty when on disk
+  int64_t size = 0;
+  int64_t pin_count = 0;
+  std::list<int64_t>::iterator lru_it;
+  bool in_lru = false;
+};
+
+class BlockStore {
+ public:
+  BlockStore(std::string spill_dir, int64_t soft_limit)
+      : spill_dir_(std::move(spill_dir)), soft_limit_(soft_limit) {}
+
+  ~BlockStore() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : blocks_) {
+      if (!kv.second.spill_path.empty())
+        std::remove(kv.second.spill_path.c_str());
+    }
+  }
+
+  int64_t Put(const void* data, int64_t size) {
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t id = next_id_++;
+    Block& b = blocks_[id];
+    b.size = size;
+    b.data.assign(static_cast<const char*>(data),
+                  static_cast<const char*>(data) + size);
+    mem_usage_ += size;
+    Touch(id, b);
+    MaybeSpill();
+    return id;
+  }
+
+  int64_t Size(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = blocks_.find(id);
+    return it == blocks_.end() ? -1 : it->second.size;
+  }
+
+  // Copy block contents into out (caller allocates Size(id) bytes).
+  // Returns 0 on success, -1 unknown id, -2 I/O error.
+  int Get(int64_t id, void* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = blocks_.find(id);
+    if (it == blocks_.end()) return -1;
+    Block& b = it->second;
+    if (!b.data.empty() || b.size == 0) {
+      std::memcpy(out, b.data.data(), b.size);
+      Touch(id, b);
+      return 0;
+    }
+    // fault in from disk (stays spilled; read-through)
+    FILE* f = std::fopen(b.spill_path.c_str(), "rb");
+    if (!f) return -2;
+    size_t got = std::fread(out, 1, b.size, f);
+    std::fclose(f);
+    return got == static_cast<size_t>(b.size) ? 0 : -2;
+  }
+
+  // Bring a spilled block back to RAM and keep it there while pinned.
+  int Pin(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = blocks_.find(id);
+    if (it == blocks_.end()) return -1;
+    Block& b = it->second;
+    if (b.data.empty() && b.size > 0) {
+      FILE* f = std::fopen(b.spill_path.c_str(), "rb");
+      if (!f) return -2;
+      b.data.resize(b.size);
+      size_t got = std::fread(b.data.data(), 1, b.size, f);
+      std::fclose(f);
+      if (got != static_cast<size_t>(b.size)) return -2;
+      std::remove(b.spill_path.c_str());
+      b.spill_path.clear();
+      mem_usage_ += b.size;
+    }
+    b.pin_count++;
+    if (b.in_lru) {
+      lru_.erase(b.lru_it);
+      b.in_lru = false;
+    }
+    return 0;
+  }
+
+  int Unpin(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = blocks_.find(id);
+    if (it == blocks_.end()) return -1;
+    Block& b = it->second;
+    if (b.pin_count > 0) b.pin_count--;
+    if (b.pin_count == 0 && !b.data.empty()) Touch(id, b);
+    MaybeSpill();
+    return 0;
+  }
+
+  void Drop(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = blocks_.find(id);
+    if (it == blocks_.end()) return;
+    Block& b = it->second;
+    if (!b.data.empty()) mem_usage_ -= b.size;
+    if (b.in_lru) lru_.erase(b.lru_it);
+    if (!b.spill_path.empty()) std::remove(b.spill_path.c_str());
+    blocks_.erase(it);
+  }
+
+  int64_t MemUsage() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return mem_usage_;
+  }
+
+  int64_t NumBlocks() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int64_t>(blocks_.size());
+  }
+
+ private:
+  void Touch(int64_t id, Block& b) {
+    if (b.in_lru) lru_.erase(b.lru_it);
+    lru_.push_front(id);
+    b.lru_it = lru_.begin();
+    b.in_lru = true;
+  }
+
+  void MaybeSpill() {
+    while (soft_limit_ > 0 && mem_usage_ > soft_limit_ && !lru_.empty()) {
+      int64_t victim = lru_.back();
+      lru_.pop_back();
+      Block& b = blocks_[victim];
+      b.in_lru = false;
+      if (b.data.empty() || b.pin_count > 0) continue;
+      char path[512];
+      std::snprintf(path, sizeof(path), "%s/ttpu-blk-%p-%lld.spill",
+                    spill_dir_.c_str(), static_cast<void*>(this),
+                    static_cast<long long>(victim));
+      FILE* f = std::fopen(path, "wb");
+      if (!f) return;  // cannot spill; keep in RAM
+      size_t put = std::fwrite(b.data.data(), 1, b.size, f);
+      std::fclose(f);
+      if (put != static_cast<size_t>(b.size)) {
+        std::remove(path);
+        return;
+      }
+      b.spill_path = path;
+      b.data.clear();
+      b.data.shrink_to_fit();
+      mem_usage_ -= b.size;
+    }
+  }
+
+  std::mutex mu_;
+  std::string spill_dir_;
+  int64_t soft_limit_;
+  int64_t next_id_ = 1;
+  int64_t mem_usage_ = 0;
+  std::unordered_map<int64_t, Block> blocks_;
+  std::list<int64_t> lru_;  // front = most recent; only unpinned in-RAM
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bs_create(const char* spill_dir, int64_t soft_limit) {
+  return new BlockStore(spill_dir ? spill_dir : "/tmp", soft_limit);
+}
+
+void bs_destroy(void* s) { delete static_cast<BlockStore*>(s); }
+
+int64_t bs_put(void* s, const void* data, int64_t size) {
+  return static_cast<BlockStore*>(s)->Put(data, size);
+}
+
+int64_t bs_size(void* s, int64_t id) {
+  return static_cast<BlockStore*>(s)->Size(id);
+}
+
+int bs_get(void* s, int64_t id, void* out) {
+  return static_cast<BlockStore*>(s)->Get(id, out);
+}
+
+int bs_pin(void* s, int64_t id) {
+  return static_cast<BlockStore*>(s)->Pin(id);
+}
+
+int bs_unpin(void* s, int64_t id) {
+  return static_cast<BlockStore*>(s)->Unpin(id);
+}
+
+void bs_drop(void* s, int64_t id) {
+  static_cast<BlockStore*>(s)->Drop(id);
+}
+
+int64_t bs_mem_usage(void* s) {
+  return static_cast<BlockStore*>(s)->MemUsage();
+}
+
+int64_t bs_num_blocks(void* s) {
+  return static_cast<BlockStore*>(s)->NumBlocks();
+}
+
+// Scan buf for line-start offsets (byte after each '\n', plus 0).
+// Writes up to max_out offsets; returns the number found (clamped).
+// Used by the ReadLines range splitter (reference: read_lines.hpp:181).
+int64_t bs_scan_lines(const char* buf, int64_t size, int64_t* out,
+                      int64_t max_out) {
+  int64_t n = 0;
+  if (size <= 0) return 0;
+  if (max_out > 0) out[n++] = 0;
+  const char* p = buf;
+  const char* end = buf + size;
+  while (p < end && n < max_out) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', end - p));
+    if (!nl) break;
+    int64_t off = (nl - buf) + 1;
+    if (off < size) out[n++] = off;
+    p = nl + 1;
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,56 @@
+"""Tracing/profiling pipeline: event log -> HTML report + dot DAG.
+
+Mirrors the reference's JsonLogger + json2profile/json2graphviz flow
+(reference: thrill/common/json_logger.hpp, misc/json2profile.cpp).
+"""
+
+import json
+import os
+import tempfile
+
+from thrill_tpu.api import RunLocalMock
+from thrill_tpu.common.config import Config
+from thrill_tpu.common.profile import ProfileThread
+from thrill_tpu.common.logger import JsonLogger
+from thrill_tpu.tools.json2graphviz import render_dot
+from thrill_tpu.tools.json2profile import load_events, render_html
+
+
+def test_event_log_and_reports():
+    with tempfile.TemporaryDirectory() as d:
+        log = os.path.join(d, "events.json")
+        cfg = Config(log_path=log)
+
+        def job(ctx):
+            a = ctx.Generate(100)
+            b = a.Map(lambda x: x * 2).Sort()
+            assert b.Size() == 100
+
+        RunLocalMock(job, 2, config=cfg)
+        events = load_events(os.path.join(d, "events-host0.json"))
+        kinds = {e.get("event") for e in events}
+        assert "node_execute_start" in kinds
+        assert "node_execute_done" in kinds
+
+        html = render_html(events)
+        assert "stage timeline" in html and "Sort" in html
+
+        dot = render_dot(events)
+        assert "digraph dia" in dot and "->" in dot
+
+
+def test_profile_thread_samples():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "p.json")
+        logger = JsonLogger(path)
+        pt = ProfileThread(logger, interval=0.05)
+        pt.start()
+        import time
+        time.sleep(0.3)
+        pt.stop()
+        logger.close()
+        with open(path) as f:
+            events = [json.loads(l) for l in f if l.strip()]
+        samples = [e for e in events if e.get("event") == "profile"]
+        assert len(samples) >= 2
+        assert any("host_mem_total" in e for e in samples)

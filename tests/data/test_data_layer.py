@@ -1,0 +1,109 @@
+"""Data-layer tests: BlockPool (native spill), File, serialization.
+
+Mirrors the reference's tests/data/ (File round-trips, block queue and
+pool behavior).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from thrill_tpu.data.block_pool import BlockPool, scan_line_offsets
+from thrill_tpu.data.file import File
+from thrill_tpu.data.serializer import deserialize_batch, serialize_batch
+
+
+def test_native_library_builds():
+    pool = BlockPool()
+    assert pool.native, "native blockstore should compile in this image"
+    pool.close()
+
+
+def test_block_pool_roundtrip():
+    pool = BlockPool()
+    a = pool.put(b"hello world")
+    b = pool.put(b"\x00\x01\x02" * 100)
+    assert pool.get(a) == b"hello world"
+    assert pool.get(b) == b"\x00\x01\x02" * 100
+    assert pool.num_blocks == 2
+    pool.drop(a)
+    assert pool.num_blocks == 1
+    with pytest.raises(KeyError):
+        pool.get(a)
+    pool.close()
+
+
+def test_block_pool_spill_and_fault_in():
+    with tempfile.TemporaryDirectory() as d:
+        pool = BlockPool(spill_dir=d, soft_limit=10_000)
+        payloads = [bytes([i]) * 4000 for i in range(10)]  # 40 KB total
+        ids = [pool.put(p) for p in payloads]
+        # over the soft limit -> old blocks spilled to disk
+        assert pool.mem_usage <= 10_000
+        assert len(os.listdir(d)) > 0, "expected spill files"
+        for i, bid in enumerate(ids):
+            assert pool.get(bid) == payloads[i]
+        pool.close()
+
+
+def test_block_pool_pin_prevents_spill():
+    with tempfile.TemporaryDirectory() as d:
+        pool = BlockPool(spill_dir=d, soft_limit=5_000)
+        bid = pool.put(b"x" * 4000)
+        pool.pin(bid)
+        for i in range(5):
+            pool.put(bytes([i]) * 4000)
+        # pinned block must still be resident
+        assert pool.get(bid) == b"x" * 4000
+        pool.unpin(bid)
+        pool.close()
+
+
+def test_serializer_raw_and_pickle():
+    arrs = [np.arange(10, dtype=np.int64) for _ in range(5)]
+    round1 = deserialize_batch(serialize_batch(arrs))
+    assert all(np.array_equal(a, b) for a, b in zip(arrs, round1))
+    objs = ["a", ("b", 1), {"k": [1, 2]}]
+    assert deserialize_batch(serialize_batch(objs)) == objs
+
+
+def test_file_writer_readers():
+    f = File(block_items=16)
+    with f.writer() as w:
+        for i in range(100):
+            w.put(("item", i))
+    assert f.num_items == 100
+    assert len(f.block_ids) == 7           # ceil(100/16)
+    assert list(f.keep_reader()) == [("item", i) for i in range(100)]
+    # keep reader does not consume
+    assert f.num_items == 100
+    assert f.get_item_at(50) == ("item", 50)
+    got = list(f.consume_reader())
+    assert got == [("item", i) for i in range(100)]
+    assert f.num_items == 0
+    f.close()
+
+
+def test_file_spills_large_data():
+    with tempfile.TemporaryDirectory() as d:
+        pool = BlockPool(spill_dir=d, soft_limit=50_000)
+        f = File(pool=pool, block_items=1000)
+        with f.writer() as w:
+            for i in range(20000):
+                w.put(np.int64(i))
+        assert pool.mem_usage <= 50_000
+        back = list(f.keep_reader())
+        assert [int(x) for x in back] == list(range(20000))
+        f.close()
+        pool.close()
+
+
+def test_scan_line_offsets():
+    data = b"abc\ndef\n\nxyz"
+    assert scan_line_offsets(data) == [0, 4, 8, 9]
+    assert scan_line_offsets(b"") == []
+    assert scan_line_offsets(b"no newline") == [0]
+    # trailing newline: no empty last line
+    assert scan_line_offsets(b"a\n") == [0]

@@ -46,6 +46,10 @@ class Context:
         self.mem = MemoryManager(name="context")
         self.rng = np.random.default_rng(seed)
         self._nodes: List[Any] = []
+        self._profiler = None
+        if self.config.profile and self.logger.enabled:
+            from ..common.profile import ProfileThread
+            self._profiler = ProfileThread(self.logger).start()
 
     # -- identity -------------------------------------------------------
     @property
@@ -85,6 +89,8 @@ class Context:
         return read_write.ReadBinary(self, path_or_glob, dtype, record_shape)
 
     def close(self) -> None:
+        if self._profiler is not None:
+            self._profiler.stop()
         self.logger.close()
 
 

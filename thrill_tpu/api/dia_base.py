@@ -82,7 +82,8 @@ class DIABase:
             log = self.context.logger
             if log.enabled:
                 log.line(event="node_execute_start", node=self.label,
-                         dia_id=self.id)
+                         dia_id=self.id,
+                         parents=[p.node.id for p in self.parents])
             self._shards = self.compute()
             self.state = EXECUTED
             if log.enabled:

@@ -78,23 +78,24 @@ def _read_lines_range(fl: file_io.FileList, lo: int, hi: int) -> List[str]:
             if start > 0:
                 f.seek(start - 1)
                 prev = f.read(1)
-                # if previous byte is not \n, we are mid-line: skip to next
-                chunk_start = start if prev == b"\n" else None
+                if prev == b"\n":
+                    chunk_start = start
+                else:
+                    # mid-line: scan forward to the next newline
+                    chunk_start = None
+                    pos = start
+                    while True:
+                        b = f.read(1 << 16)
+                        if not b:
+                            chunk_start = f_hi - f_lo
+                            break
+                        nl = b.find(b"\n")
+                        if nl >= 0:
+                            chunk_start = pos + nl + 1
+                            break
+                        pos += len(b)
             else:
                 chunk_start = 0
-            if chunk_start is None:
-                # scan forward to the next newline
-                pos = start
-                while True:
-                    b = f.read(1 << 16)
-                    if not b:
-                        chunk_start = f_hi - f_lo
-                        break
-                    nl = b.find(b"\n")
-                    if nl >= 0:
-                        chunk_start = pos + nl + 1
-                        break
-                    pos += len(b)
             if chunk_start >= end:
                 continue
             f.seek(chunk_start)
@@ -110,6 +111,10 @@ def _read_lines_range(fl: file_io.FileList, lo: int, hi: int) -> List[str]:
                         data += b[:nl + 1]
                         break
                     data += b
+            # str.splitlines is already a C-level loop and handles CRLF
+            # etc.; the native scanner (data/block_pool.scan_line_offsets)
+            # is reserved for the raw-bytes -> device packing path where
+            # no Python string objects are materialized
             out.extend(data.decode("utf-8").splitlines())
     return out
 

@@ -1,0 +1,97 @@
+"""Periodic runtime profiling into the JSON event log.
+
+Equivalent of the reference's ProfileThread + LinuxProcStatsProfiler
+(reference: thrill/common/profile_thread.hpp:32,
+linux_proc_stats.cpp — CPU/mem/net sampled every 500ms into the
+JsonLogger) plus TPU-specific device memory stats from PJRT.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from .logger import JsonLogger
+
+
+def _read_proc_stat():
+    try:
+        with open("/proc/stat") as f:
+            parts = f.readline().split()
+        vals = [int(x) for x in parts[1:8]]
+        idle = vals[3] + vals[4]
+        total = sum(vals)
+        return total, idle
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _read_meminfo():
+    try:
+        out = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                k, _, rest = line.partition(":")
+                if k in ("MemTotal", "MemAvailable"):
+                    out[k] = int(rest.split()[0]) * 1024
+        return out
+    except (OSError, ValueError):
+        return {}
+
+
+def _device_memory_stats():
+    try:
+        import jax
+        dev = jax.devices()[0]
+        stats = dev.memory_stats()
+        if stats:
+            return {"bytes_in_use": stats.get("bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit")}
+    except Exception:
+        pass
+    return {}
+
+
+class ProfileThread:
+    """Samples host CPU/RAM and device HBM every ``interval`` seconds."""
+
+    def __init__(self, logger: JsonLogger, interval: float = 0.5) -> None:
+        self.logger = logger
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_cpu = None
+
+    def start(self) -> "ProfileThread":
+        if self._thread is None and self.logger.enabled:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self._sample()
+
+    def _sample(self) -> None:
+        fields = {"event": "profile"}
+        cpu = _read_proc_stat()
+        if cpu and self._last_cpu:
+            dt = cpu[0] - self._last_cpu[0]
+            didle = cpu[1] - self._last_cpu[1]
+            if dt > 0:
+                fields["cpu_util"] = round(1.0 - didle / dt, 4)
+        self._last_cpu = cpu
+        mem = _read_meminfo()
+        if mem:
+            fields["host_mem_total"] = mem.get("MemTotal")
+            fields["host_mem_available"] = mem.get("MemAvailable")
+        fields.update(_device_memory_stats())
+        self.logger.line(**fields)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

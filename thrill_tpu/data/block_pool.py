@@ -1,0 +1,158 @@
+"""Python interface to the native block store.
+
+Equivalent of the reference's BlockPool/ByteBlock layer
+(reference: thrill/data/block_pool.hpp:42 — soft/hard limits, pin/unpin,
+LRU eviction to disk): bytes live in the C++ store (native/
+blockstore.cpp, built on first use with g++), Python handles only ids.
+Falls back to a pure-Python dict store when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_FAILED = False
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_FAILED
+    with _LIB_LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        src = os.path.abspath(os.path.join(_NATIVE_DIR, "blockstore.cpp"))
+        out = os.path.abspath(os.path.join(_NATIVE_DIR, "build",
+                                           "libblockstore.so"))
+        try:
+            if (not os.path.exists(out)
+                    or os.path.getmtime(out) < os.path.getmtime(src)):
+                os.makedirs(os.path.dirname(out), exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     src, "-o", out],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(out)
+        except (OSError, subprocess.SubprocessError):
+            _LIB_FAILED = True
+            return None
+        lib.bs_create.restype = ctypes.c_void_p
+        lib.bs_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.bs_destroy.argtypes = [ctypes.c_void_p]
+        lib.bs_put.restype = ctypes.c_int64
+        lib.bs_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int64]
+        lib.bs_size.restype = ctypes.c_int64
+        lib.bs_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bs_get.restype = ctypes.c_int
+        lib.bs_get.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.c_void_p]
+        lib.bs_pin.restype = ctypes.c_int
+        lib.bs_pin.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bs_unpin.restype = ctypes.c_int
+        lib.bs_unpin.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bs_drop.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bs_mem_usage.restype = ctypes.c_int64
+        lib.bs_mem_usage.argtypes = [ctypes.c_void_p]
+        lib.bs_num_blocks.restype = ctypes.c_int64
+        lib.bs_num_blocks.argtypes = [ctypes.c_void_p]
+        lib.bs_scan_lines.restype = ctypes.c_int64
+        lib.bs_scan_lines.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_int64),
+                                      ctypes.c_int64]
+        _LIB = lib
+        return _LIB
+
+
+class BlockPool:
+    """Byte-block store with a soft RAM limit and disk spill."""
+
+    def __init__(self, spill_dir: str = "/tmp", soft_limit: int = 0) -> None:
+        self._lib = _load_native()
+        self.native = self._lib is not None
+        if self.native:
+            self._h = self._lib.bs_create(spill_dir.encode(), soft_limit)
+        else:  # pure-python fallback: no spill, just a dict
+            self._blocks: Dict[int, bytes] = {}
+            self._next = 1
+            self._soft = soft_limit
+
+    def put(self, data: bytes) -> int:
+        if self.native:
+            return self._lib.bs_put(self._h, data, len(data))
+        bid = self._next
+        self._next += 1
+        self._blocks[bid] = bytes(data)
+        return bid
+
+    def get(self, block_id: int) -> bytes:
+        if self.native:
+            size = self._lib.bs_size(self._h, block_id)
+            if size < 0:
+                raise KeyError(f"unknown block {block_id}")
+            buf = ctypes.create_string_buffer(max(size, 1))
+            rc = self._lib.bs_get(self._h, block_id, buf)
+            if rc != 0:
+                raise IOError(f"block {block_id} fetch failed rc={rc}")
+            return buf.raw[:size]
+        return self._blocks[block_id]
+
+    def pin(self, block_id: int) -> None:
+        if self.native:
+            self._lib.bs_pin(self._h, block_id)
+
+    def unpin(self, block_id: int) -> None:
+        if self.native:
+            self._lib.bs_unpin(self._h, block_id)
+
+    def drop(self, block_id: int) -> None:
+        if self.native:
+            self._lib.bs_drop(self._h, block_id)
+        else:
+            self._blocks.pop(block_id, None)
+
+    @property
+    def mem_usage(self) -> int:
+        if self.native:
+            return self._lib.bs_mem_usage(self._h)
+        return sum(len(b) for b in self._blocks.values())
+
+    @property
+    def num_blocks(self) -> int:
+        if self.native:
+            return self._lib.bs_num_blocks(self._h)
+        return len(self._blocks)
+
+    def close(self) -> None:
+        if self.native and self._h:
+            self._lib.bs_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def scan_line_offsets(data: bytes, max_lines: int = 1 << 22):
+    """Offsets of line starts in data (C++ memchr scan when available)."""
+    lib = _load_native()
+    if lib is None:
+        out = [0] if data else []
+        pos = 0
+        while True:
+            nl = data.find(b"\n", pos)
+            if nl < 0 or nl + 1 >= len(data):
+                break
+            out.append(nl + 1)
+            pos = nl + 1
+        return out
+    arr = (ctypes.c_int64 * max_lines)()
+    n = lib.bs_scan_lines(data, len(data), arr, max_lines)
+    return list(arr[:n])

@@ -1,0 +1,102 @@
+"""File: an ordered spillable sequence of item blocks.
+
+Equivalent of the reference's data::File + BlockWriter/BlockReader
+(reference: thrill/data/file.hpp:56, block_writer.hpp:53,
+block_reader.hpp:42): items are appended through a writer that fills
+fixed-budget blocks, blocks live in the BlockPool (C++ store with LRU
+disk spill), and keep/consume readers stream them back. Random access
+``get_item_at`` mirrors File::GetItemAt.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Optional
+
+from .block_pool import BlockPool
+from .serializer import deserialize_batch, serialize_batch
+
+DEFAULT_BLOCK_ITEMS = 4096
+
+
+class File:
+    def __init__(self, pool: Optional[BlockPool] = None,
+                 block_items: int = DEFAULT_BLOCK_ITEMS) -> None:
+        self.pool = pool or BlockPool()
+        self._owns_pool = pool is None
+        self.block_items = block_items
+        self.block_ids: List[int] = []
+        self.block_counts: List[int] = []
+
+    # -- writing --------------------------------------------------------
+    def writer(self) -> "BlockWriter":
+        return BlockWriter(self)
+
+    @property
+    def num_items(self) -> int:
+        return sum(self.block_counts)
+
+    # -- reading --------------------------------------------------------
+    def keep_reader(self) -> Iterator[Any]:
+        """Stream items without consuming the file
+        (reference: KeepFileBlockSource, file.hpp:349)."""
+        for bid in self.block_ids:
+            for it in deserialize_batch(self.pool.get(bid)):
+                yield it
+
+    def consume_reader(self) -> Iterator[Any]:
+        """Stream items, dropping each block after it is read
+        (reference: ConsumeFileBlockSource, file.hpp:414)."""
+        while self.block_ids:
+            bid = self.block_ids.pop(0)
+            self.block_counts.pop(0)
+            for it in deserialize_batch(self.pool.get(bid)):
+                yield it
+            self.pool.drop(bid)
+
+    def get_item_at(self, index: int) -> Any:
+        """Random access (reference: File::GetItemAt)."""
+        for bid, cnt in zip(self.block_ids, self.block_counts):
+            if index < cnt:
+                return deserialize_batch(self.pool.get(bid))[index]
+            index -= cnt
+        raise IndexError(index)
+
+    def clear(self) -> None:
+        for bid in self.block_ids:
+            self.pool.drop(bid)
+        self.block_ids.clear()
+        self.block_counts.clear()
+
+    def close(self) -> None:
+        self.clear()
+        if self._owns_pool:
+            self.pool.close()
+
+
+class BlockWriter:
+    def __init__(self, file: File) -> None:
+        self.file = file
+        self._buf: List[Any] = []
+
+    def put(self, item: Any) -> None:
+        self._buf.append(item)
+        if len(self._buf) >= self.file.block_items:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._buf:
+            return
+        payload = serialize_batch(self._buf)
+        bid = self.file.pool.put(payload)
+        self.file.block_ids.append(bid)
+        self.file.block_counts.append(len(self._buf))
+        self._buf = []
+
+    def close(self) -> None:
+        self.flush()
+
+    def __enter__(self) -> "BlockWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,42 @@
+"""Item serialization for the host data plane.
+
+Equivalent of the reference's Serialization traits
+(reference: thrill/data/serialization.hpp:34 — POD memcpy path, strings,
+pairs/tuples, vectors; optional cereal adapter). Fixed-size numeric
+records take a raw-bytes fast path (the memcpy analog); everything else
+goes through pickle (the cereal analog).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import numpy as np
+
+_RAW = 0       # np.ndarray with given dtype/shape
+_PICKLE = 1
+
+
+def serialize_batch(items: List[Any]) -> bytes:
+    """Serialize a list of items into one block payload."""
+    if items and all(isinstance(it, np.ndarray) for it in items) and \
+            len({(it.dtype.str, it.shape) for it in items}) == 1:
+        arr = np.stack(items)
+        header = pickle.dumps((_RAW, arr.dtype.str, arr.shape))
+        return struct.pack("<I", len(header)) + header + \
+            np.ascontiguousarray(arr).tobytes()
+    header = pickle.dumps((_PICKLE, None, len(items)))
+    return struct.pack("<I", len(header)) + header + pickle.dumps(items)
+
+
+def deserialize_batch(data: bytes) -> List[Any]:
+    (hlen,) = struct.unpack_from("<I", data, 0)
+    kind, dstr, shape_or_n = pickle.loads(data[4:4 + hlen])
+    payload = data[4 + hlen:]
+    if kind == _RAW:
+        arr = np.frombuffer(payload, dtype=np.dtype(dstr)).reshape(
+            shape_or_n)
+        return list(arr)
+    return pickle.loads(payload)

@@ -1,0 +1,157 @@
+"""TCP network backend: real-socket Group with full-mesh bootstrap.
+
+Equivalent of the reference's net/tcp backend
+(reference: thrill/net/tcp/construct.cpp full-mesh bootstrap with retry
+rounds, socket.hpp:50, group.hpp) — the control plane between Python
+hosts in a multi-controller deployment. The bulk data plane stays on
+XLA collectives over ICI/DCN (jax.distributed); this layer carries the
+small coordination values (size agreements, splitters, barriers) the
+way the reference's flow-control group does, and is what host-path
+operators use across machines.
+
+Wire format: 4-byte little-endian length + pickle payload per message.
+Bootstrap: rank j connects to every rank i < j (i listens); each side
+announces its rank. Retries cover staggered process starts.
+
+Env (reference: THRILL_RANK/THRILL_HOSTLIST, api/context.cpp:204-272):
+THRILL_TPU_RANK, THRILL_TPU_HOSTLIST="host0:port0 host1:port1 ...".
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .group import Connection, Group
+
+
+class TcpConnection(Connection):
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._recv_lock = threading.Lock()
+
+    def send(self, obj: Any) -> None:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        msg = struct.pack("<I", len(payload)) + payload
+        with self._send_lock:
+            self.sock.sendall(msg)
+
+    def recv(self) -> Any:
+        with self._recv_lock:
+            header = self._recv_exact(4)
+            (size,) = struct.unpack("<I", header)
+            return pickle.loads(self._recv_exact(size))
+
+    def _recv_exact(self, n: int) -> bytes:
+        chunks = []
+        while n > 0:
+            b = self.sock.recv(n)
+            if not b:
+                raise ConnectionError("peer closed connection")
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TcpGroup(Group):
+    def __init__(self, my_rank: int, num_hosts: int,
+                 conns: Dict[int, TcpConnection]) -> None:
+        super().__init__(my_rank, num_hosts)
+        self._conns = conns
+
+    def connection(self, peer: int) -> TcpConnection:
+        if peer == self.my_rank:
+            raise ValueError("no connection to self")
+        return self._conns[peer]
+
+    def close(self) -> None:
+        for c in self._conns.values():
+            c.close()
+
+
+def parse_hostlist(s: str) -> List[Tuple[str, int]]:
+    out = []
+    for part in s.replace(",", " ").split():
+        host, _, port = part.rpartition(":")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
+def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
+                        timeout: float = 30.0) -> TcpGroup:
+    """Full-mesh bootstrap: rank j dials every i < j; i accepts j..p-1."""
+    p = len(hosts)
+    if p == 1:
+        return TcpGroup(0, 1, {})
+    conns: Dict[int, TcpConnection] = {}
+    lock = threading.Lock()
+    errors: List[BaseException] = []
+
+    def accept_side():
+        try:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((hosts[rank][0] if hosts[rank][0] != "localhost"
+                      else "127.0.0.1", hosts[rank][1]))
+            srv.listen(p)
+            srv.settimeout(timeout)
+            expected = p - 1 - rank          # ranks > mine dial in
+            for _ in range(expected):
+                s, _ = srv.accept()
+                conn = TcpConnection(s)
+                peer = conn.recv()           # rank announcement
+                with lock:
+                    conns[peer] = conn
+            srv.close()
+        except BaseException as e:  # pragma: no cover
+            errors.append(e)
+
+    acceptor = threading.Thread(target=accept_side, daemon=True)
+    acceptor.start()
+
+    deadline = time.time() + timeout
+    for peer in range(rank):                 # dial every lower rank
+        while True:
+            try:
+                s = socket.create_connection(hosts[peer], timeout=2.0)
+                conn = TcpConnection(s)
+                conn.send(rank)
+                with lock:
+                    conns[peer] = conn
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"rank {rank}: cannot reach rank {peer} at "
+                        f"{hosts[peer]}")
+                time.sleep(0.05)
+
+    acceptor.join(timeout=timeout)
+    if acceptor.is_alive():
+        raise TimeoutError(f"rank {rank}: bootstrap accept timed out")
+    if errors:
+        raise errors[0]
+    assert len(conns) == p - 1
+    return TcpGroup(rank, p, conns)
+
+
+def construct_from_env() -> Optional[TcpGroup]:
+    """THRILL_TPU_RANK/HOSTLIST -> TcpGroup (None when unset)."""
+    hostlist = os.environ.get("THRILL_TPU_HOSTLIST")
+    if not hostlist:
+        return None
+    rank = int(os.environ.get("THRILL_TPU_RANK", "0"))
+    return construct_tcp_group(rank, parse_hostlist(hostlist))

@@ -1,0 +1,107 @@
+"""Render a JSON event log into a standalone HTML timeline report.
+
+Equivalent of the reference's misc/json2profile.cpp (1.5k LoC C++ that
+parses JsonLogger output into an HTML report with CPU/net/disk/stage
+timelines). Usage:
+
+    python -m thrill_tpu.tools.json2profile LOG.json > report.html
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import sys
+from typing import List
+
+
+def load_events(path: str) -> List[dict]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return events
+
+
+def render_html(events: List[dict]) -> str:
+    nodes = {}
+    profiles = []
+    t0 = min((e["ts"] for e in events), default=0)
+    for e in events:
+        t = (e["ts"] - t0) / 1e6
+        if e.get("event") == "node_execute_start":
+            nodes.setdefault(e.get("dia_id"), {}).update(
+                start=t, label=e.get("node"))
+        elif e.get("event") == "node_execute_done":
+            nodes.setdefault(e.get("dia_id"), {}).update(
+                end=t, items=e.get("items"))
+        elif e.get("event") == "profile":
+            profiles.append((t, e))
+
+    rows = []
+    for nid in sorted(k for k in nodes if k is not None):
+        n = nodes[nid]
+        if "start" not in n or "end" not in n:
+            continue
+        dur = n["end"] - n["start"]
+        rows.append((nid, n.get("label", "?"), n["start"], dur,
+                     n.get("items")))
+    total = max((r[2] + r[3] for r in rows), default=1.0)
+
+    bars = []
+    for nid, label, start, dur, items in rows:
+        left = 100.0 * start / total
+        width = max(100.0 * dur / total, 0.2)
+        bars.append(
+            f'<div class="row"><span class="lbl">#{nid} '
+            f'{html.escape(str(label))}</span>'
+            f'<div class="track"><div class="bar" style="left:{left:.2f}%;'
+            f'width:{width:.2f}%"></div></div>'
+            f'<span class="dur">{dur * 1e3:.1f} ms'
+            f'{f" · {items} items" if items is not None else ""}</span>'
+            f'</div>')
+
+    cpu_pts = [(t, e.get("cpu_util")) for t, e in profiles
+               if e.get("cpu_util") is not None]
+    cpu_line = ""
+    if cpu_pts:
+        pts = " ".join(f"{100 * t / total:.2f},{40 - 40 * u:.1f}"
+                       for t, u in cpu_pts)
+        cpu_line = (f'<h2>host CPU utilization</h2>'
+                    f'<svg viewBox="0 0 100 40" class="cpu">'
+                    f'<polyline fill="none" stroke="#07c" stroke-width="0.5"'
+                    f' points="{pts}"/></svg>')
+
+    return f"""<!doctype html><html><head><meta charset="utf-8">
+<title>thrill_tpu profile</title><style>
+body {{ font: 13px monospace; margin: 2em; }}
+.row {{ display: flex; align-items: center; margin: 2px 0; }}
+.lbl {{ width: 22em; }}
+.track {{ position: relative; flex: 1; height: 14px; background: #eee; }}
+.bar {{ position: absolute; top: 0; height: 100%; background: #07c; }}
+.dur {{ width: 16em; text-align: right; color: #666; }}
+.cpu {{ width: 100%; height: 80px; background: #f7f7f7; }}
+</style></head><body>
+<h1>thrill_tpu execution profile</h1>
+<p>{len(rows)} executed nodes, total span {total:.3f}s,
+{len(profiles)} profile samples</p>
+<h2>stage timeline</h2>
+{''.join(bars)}
+{cpu_line}
+</body></html>"""
+
+
+def main() -> None:
+    if len(sys.argv) != 2:
+        print("usage: json2profile LOG.json > report.html", file=sys.stderr)
+        sys.exit(2)
+    sys.stdout.write(render_html(load_events(sys.argv[1])))
+
+
+if __name__ == "__main__":
+    main()
