@@ -1,0 +1,102 @@
+"""API-level benchmarks: Sort / ReduceByKey / Generate throughput.
+
+Equivalent of the reference's benchmarks/api/{sort,groupby,...}.cpp.
+Runs on whatever devices are available (virtual CPU mesh with
+--xla_force_host_platform_device_count, or the real chip).
+Prints RESULT lines like the reference (benchmarks/api/sort.cpp:49-58).
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
+
+
+import time
+
+import numpy as np
+
+
+def _ctx():
+    from thrill_tpu.api import Context
+    return Context()
+
+
+def _sort_key(r):
+    return r["key"]
+
+
+def _dict_key(t):
+    return t["k"]
+
+
+def _dict_reduce(a, b):
+    return {"k": a["k"], "v": a["v"] + b["v"]}
+
+
+def bench_sort(n=1 << 16, iterations=3):
+    import jax
+    ctx = _ctx()
+    rng = np.random.default_rng(0)
+    recs = {"key": rng.integers(0, 256, size=(n, 10)).astype(np.uint8),
+            "value": rng.integers(0, 256, size=(n, 90)).astype(np.uint8)}
+
+    def once():
+        out = ctx.Distribute(recs).Sort(key_fn=_sort_key)
+        sh = out.node.materialize()
+        jax.block_until_ready(jax.tree.leaves(sh.tree))
+
+    once()
+    for _ in range(iterations):
+        t0 = time.perf_counter()
+        once()
+        dt = time.perf_counter() - t0
+        print(f"RESULT bench=api_sort workers={ctx.num_workers} items={n} "
+              f"time_ms={dt * 1e3:.1f} items_per_s={n / dt:.0f}")
+    ctx.close()
+
+
+def bench_reduce(n=1 << 18, keys=1 << 10, iterations=3):
+    import jax
+    ctx = _ctx()
+    rng = np.random.default_rng(0)
+    vals = (rng.integers(0, keys, n).astype(np.int64),
+            np.ones(n, dtype=np.int64))
+
+    def once():
+        d = ctx.Distribute({"k": vals[0], "v": vals[1]})
+        out = d.ReduceByKey(_dict_key, _dict_reduce)
+        sh = out.node.materialize()
+        jax.block_until_ready(jax.tree.leaves(sh.tree))
+
+    once()
+    for _ in range(iterations):
+        t0 = time.perf_counter()
+        once()
+        dt = time.perf_counter() - t0
+        print(f"RESULT bench=api_reduce workers={ctx.num_workers} items={n} "
+              f"keys={keys} time_ms={dt * 1e3:.1f} items_per_s={n / dt:.0f}")
+    ctx.close()
+
+
+def bench_generate(n=1 << 20, iterations=3):
+    import jax
+    ctx = _ctx()
+
+    def once():
+        sh = ctx.Generate(n).node.materialize()
+        jax.block_until_ready(jax.tree.leaves(sh.tree))
+
+    once()
+    for _ in range(iterations):
+        t0 = time.perf_counter()
+        once()
+        dt = time.perf_counter() - t0
+        print(f"RESULT bench=api_generate workers={ctx.num_workers} "
+              f"items={n} time_ms={dt * 1e3:.1f}")
+    ctx.close()
+
+
+if __name__ == "__main__":
+    bench_generate()
+    bench_sort()
+    bench_reduce()

@@ -1,0 +1,87 @@
+"""Data-layer microbenchmarks: File/BlockPool/serializer throughput.
+
+Equivalent of the reference's benchmarks/data/data_benchmark.cpp.
+Prints RESULT lines.
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
+
+
+import tempfile
+import time
+
+import numpy as np
+
+from thrill_tpu.data.block_pool import BlockPool
+from thrill_tpu.data.file import File
+from thrill_tpu.data.serializer import deserialize_batch, serialize_batch
+
+
+def bench_blockpool(n_blocks=2000, block_kb=64):
+    payload = np.random.default_rng(0).bytes(block_kb * 1024)
+    pool = BlockPool()
+    t0 = time.perf_counter()
+    ids = [pool.put(payload) for _ in range(n_blocks)]
+    put_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for bid in ids:
+        pool.get(bid)
+    get_dt = time.perf_counter() - t0
+    vol = n_blocks * block_kb / 1024
+    print(f"RESULT bench=blockpool_put native={pool.native} "
+          f"volume_mb={vol:.0f} throughput_mb_s={vol / put_dt:.1f}")
+    print(f"RESULT bench=blockpool_get native={pool.native} "
+          f"volume_mb={vol:.0f} throughput_mb_s={vol / get_dt:.1f}")
+    pool.close()
+
+
+def bench_blockpool_spill(n_blocks=500, block_kb=64):
+    with tempfile.TemporaryDirectory() as d:
+        pool = BlockPool(spill_dir=d, soft_limit=4 << 20)
+        payload = np.random.default_rng(0).bytes(block_kb * 1024)
+        t0 = time.perf_counter()
+        ids = [pool.put(payload) for _ in range(n_blocks)]
+        for bid in ids:
+            pool.get(bid)
+        dt = time.perf_counter() - t0
+        vol = n_blocks * block_kb / 1024
+        print(f"RESULT bench=blockpool_spill_roundtrip volume_mb={vol:.0f} "
+              f"resident_mb={pool.mem_usage / 1e6:.1f} "
+              f"throughput_mb_s={2 * vol / dt:.1f}")
+        pool.close()
+
+
+def bench_file_items(n=200_000):
+    f = File(block_items=8192)
+    t0 = time.perf_counter()
+    with f.writer() as w:
+        for i in range(n):
+            w.put(i)
+    wr = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    cnt = sum(1 for _ in f.keep_reader())
+    rd = time.perf_counter() - t0
+    assert cnt == n
+    print(f"RESULT bench=file_write items={n} items_per_s={n / wr:.0f}")
+    print(f"RESULT bench=file_read items={n} items_per_s={n / rd:.0f}")
+    f.close()
+
+
+def bench_serializer(n=100, batch=10_000):
+    arrs = [np.arange(batch, dtype=np.int64) for _ in range(8)]
+    t0 = time.perf_counter()
+    for _ in range(n):
+        deserialize_batch(serialize_batch(arrs))
+    dt = time.perf_counter() - t0
+    vol = n * 8 * batch * 8 / 1e6
+    print(f"RESULT bench=serializer_raw_roundtrip volume_mb={vol:.0f} "
+          f"throughput_mb_s={vol / dt:.1f}")
+
+
+if __name__ == "__main__":
+    bench_blockpool()
+    bench_blockpool_spill()
+    bench_file_items()
+    bench_serializer()

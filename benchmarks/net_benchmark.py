@@ -1,0 +1,115 @@
+"""Net microbenchmarks: ping-pong latency, pairwise bandwidth, collectives.
+
+Equivalent of the reference's benchmarks/net/net_benchmark.cpp (ping-pong
+latency, 1-factor bandwidth matrix, FCC Broadcast/PrefixSum), run over
+the TCP backend on localhost. Prints reference-style RESULT lines.
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
+
+
+import socket
+import threading
+import time
+
+import numpy as np
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def run_group_threads(p, job):
+    from thrill_tpu.net.tcp import construct_tcp_group
+    hosts = [("127.0.0.1", pt) for pt in _free_ports(p)]
+    res = [None] * p
+
+    def tgt(r):
+        g = construct_tcp_group(r, hosts, timeout=20)
+        try:
+            res[r] = job(g)
+        finally:
+            g.close()
+
+    ts = [threading.Thread(target=tgt, args=(r,), daemon=True)
+          for r in range(p)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    return res
+
+
+def bench_ping_pong(iterations=200):
+    def job(g):
+        if g.num_hosts < 2:
+            return None
+        t0 = time.perf_counter()
+        for _ in range(iterations):
+            if g.my_rank == 0:
+                g.send_to(1, b"x")
+                g.recv_from(1)
+            elif g.my_rank == 1:
+                g.send_to(0, g.recv_from(0))
+        return (time.perf_counter() - t0) / iterations
+
+    res = run_group_threads(2, job)
+    rtt = res[0]
+    print(f"RESULT bench=ping_pong hosts=2 iterations={iterations} "
+          f"rtt_us={rtt * 1e6:.1f}")
+
+
+def bench_bandwidth(mb=64):
+    blob = np.random.default_rng(0).bytes(1 << 20)
+
+    def job(g):
+        if g.my_rank == 0:
+            t0 = time.perf_counter()
+            for _ in range(mb):
+                g.send_to(1, blob)
+            g.recv_from(1)
+            return mb / (time.perf_counter() - t0)
+        for _ in range(mb):
+            g.recv_from(0)
+        g.send_to(0, b"done")
+        return None
+
+    res = run_group_threads(2, job)
+    print(f"RESULT bench=bandwidth hosts=2 volume_mb={mb} "
+          f"throughput_mb_s={res[0]:.1f}")
+
+
+def bench_collectives(p=4, iterations=50):
+    from thrill_tpu.net import FlowControlChannel
+
+    def job(g):
+        fcc = FlowControlChannel(g)
+        t0 = time.perf_counter()
+        for i in range(iterations):
+            fcc.prefix_sum(g.my_rank + i)
+        prefix = (time.perf_counter() - t0) / iterations
+        t0 = time.perf_counter()
+        for i in range(iterations):
+            fcc.broadcast(i if g.my_rank == 0 else None)
+        bcast = (time.perf_counter() - t0) / iterations
+        return prefix, bcast
+
+    res = run_group_threads(p, job)
+    prefix = max(r[0] for r in res)
+    bcast = max(r[1] for r in res)
+    print(f"RESULT bench=fcc_prefix_sum hosts={p} time_us={prefix * 1e6:.1f}")
+    print(f"RESULT bench=fcc_broadcast hosts={p} time_us={bcast * 1e6:.1f}")
+
+
+if __name__ == "__main__":
+    bench_ping_pong()
+    bench_bandwidth()
+    bench_collectives()

@@ -8,6 +8,9 @@ is a batched matmul on device columns (MXU), summed via the Sum action
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
+
+
 import numpy as np
 
 from thrill_tpu.api import Context

@@ -11,6 +11,9 @@ flags), the exact structure the reference runs over its sample sort.
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
+
+
 import numpy as np
 
 from thrill_tpu.api import Context

@@ -8,6 +8,9 @@ sample sort classifies by two packed uint64 key words.
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
+
+
 import numpy as np
 
 from thrill_tpu.api import Context

@@ -7,6 +7,9 @@ close triangles.
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
+
+
 import numpy as np
 
 from thrill_tpu.api import Context, InnerJoin

@@ -10,6 +10,9 @@ Reference: /root/reference/examples/word_count/word_count.hpp:35-57
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
+
+
 import numpy as np
 
 from thrill_tpu.api import Context

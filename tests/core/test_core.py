@@ -1,0 +1,132 @@
+"""core-layer tests: multiway merge, Golomb streams, location/duplicate
+detection (mirrors the reference's tests/core/)."""
+
+import numpy as np
+import pytest
+
+from thrill_tpu.core.duplicate_detection import (find_non_unique_hashes,
+                                                 is_unique)
+from thrill_tpu.core.golomb import (BitReader, BitWriter, decode_sorted,
+                                    encode_sorted, rice_parameter)
+from thrill_tpu.core.location_detection import (LocationDetection,
+                                                decode_fingerprint,
+                                                encode_fingerprint,
+                                                fingerprint)
+from thrill_tpu.core.multiway_merge import multiway_merge, \
+    multiway_merge_files
+from thrill_tpu.data.file import File
+
+
+def test_bit_stream_roundtrip():
+    w = BitWriter()
+    w.put_bits(0b1011, 4)
+    w.put_unary(3)
+    w.put_bits(0xABCD, 16)
+    data = w.to_bytes()
+    r = BitReader(data, len(w))
+    assert r.get_bits(4) == 0b1011
+    assert r.get_unary() == 3
+    assert r.get_bits(16) == 0xABCD
+    assert r.exhausted
+
+
+@pytest.mark.parametrize("k", [0, 1, 4, 8])
+def test_golomb_sorted_roundtrip(k):
+    rng = np.random.default_rng(0)
+    vals = np.unique(rng.integers(0, 1 << 20, 500))
+    payload, nbits, count = encode_sorted([int(v) for v in vals], k)
+    back = list(decode_sorted(payload, nbits, count, k))
+    assert back == [int(v) for v in vals]
+
+
+def test_golomb_compresses_dense_lists():
+    # dense sorted list: Golomb-Rice with fitted k beats raw 8B/value
+    vals = list(range(0, 40000, 4))
+    k = rice_parameter(4)
+    payload, _, _ = encode_sorted(vals, k)
+    assert len(payload) < len(vals) * 2   # ~6 bits/value vs 64 raw
+
+
+def test_rice_parameter():
+    assert rice_parameter(1.0) == 0
+    assert rice_parameter(100.0) in (5, 6)
+
+
+def test_multiway_merge_stable():
+    runs = [[(1, "a"), (3, "a")], [(1, "b"), (2, "b")], [(1, "c")]]
+    merged = list(multiway_merge(runs, key=lambda kv: kv[0]))
+    # ties resolve by run index: (1,a) from run 0 before (1,b), (1,c)
+    assert merged == [(1, "a"), (1, "b"), (1, "c"), (2, "b"), (3, "a")]
+
+
+def test_multiway_merge_files():
+    files = []
+    for base in (0, 1, 2):
+        f = File(block_items=8)
+        with f.writer() as w:
+            for i in range(base, 60, 3):
+                w.put(i)
+        files.append(f)
+    merged = list(multiway_merge_files(files))
+    assert merged == list(range(60))
+    for f in files:
+        f.close()
+
+
+def test_fingerprint_roundtrip():
+    hashes = [12, 7, 12, 900000, 55]
+    fp = fingerprint(hashes)
+    assert fp.tolist() == sorted({12, 7, 900000, 55})
+    back = decode_fingerprint(encode_fingerprint(fp))
+    assert back.tolist() == fp.tolist()
+    assert decode_fingerprint(encode_fingerprint(
+        fingerprint([]))).tolist() == []
+
+
+def test_location_detection():
+    ld = LocationDetection(4)
+    ld.add_worker(0, [1, 2, 3])
+    ld.add_worker(1, [3, 4])
+    ld.add_worker(2, [5])
+    assert ld.workers_of(3) == [0, 1]
+    assert ld.workers_of(4) == [1]
+    assert ld.target_of(3) == 0
+    assert ld.workers_of(99) == []
+
+    other = LocationDetection(4)
+    other.add_worker(0, [3, 5, 99])
+    assert ld.common_hashes(other) == {3, 5}
+
+
+def test_duplicate_detection():
+    non_unique = find_non_unique_hashes([[1, 2], [2, 3], [4]])
+    assert non_unique == {2}
+    assert is_unique(1, non_unique)
+    assert not is_unique(2, non_unique)
+
+
+def test_reduce_with_dup_detection_matches_plain():
+    from thrill_tpu.api import RunLocalMock
+    words = ["a", "b", "a", "c", "d", "e", "b"] * 3
+
+    def job(ctx):
+        d = ctx.Distribute(words, storage="host")
+        out = d.Map(lambda w: (w, 1)).ReduceByKey(
+            lambda kv: kv[0], lambda a, b: (a[0], a[1] + b[1]),
+            dup_detection=True)
+        assert dict(out.AllGather()) == {"a": 6, "b": 6, "c": 3,
+                                         "d": 3, "e": 3}
+    RunLocalMock(job, 4)
+
+
+def test_join_with_location_detection_matches_plain():
+    from thrill_tpu.api import InnerJoin, RunLocalMock
+
+    def job(ctx):
+        l = ctx.Distribute([("a", 1), ("b", 2), ("x", 9)], storage="host")
+        r = ctx.Distribute([("a", 10), ("c", 30)], storage="host")
+        j = InnerJoin(l, r, lambda kv: kv[0], lambda kv: kv[0],
+                      lambda lv, rv: (lv[0], lv[1], rv[1]),
+                      location_detection=True)
+        assert sorted(j.AllGather()) == [("a", 1, 10)]
+    RunLocalMock(job, 4)

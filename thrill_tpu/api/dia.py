@@ -55,9 +55,14 @@ class DIA:
     # ------------------------------------------------------------------
     # distributed ops
     # ------------------------------------------------------------------
-    def ReduceByKey(self, key_fn: Callable, reduce_fn: Callable) -> "DIA":
+    def ReduceByKey(self, key_fn: Callable, reduce_fn: Callable,
+                    dup_detection: bool = False) -> "DIA":
+        """``dup_detection`` (reference: DuplicateDetectionTag) skips
+        shuffling globally-unique keys — host-storage path only; the
+        device path ignores it (its pre-reduce already bounds shuffle
+        volume at one item per local distinct key)."""
         from .ops import reduce as _r
-        return _r.ReduceByKey(self, key_fn, reduce_fn)
+        return _r.ReduceByKey(self, key_fn, reduce_fn, dup_detection)
 
     def ReducePair(self, reduce_fn: Callable) -> "DIA":
         """Items are (key, value) pairs; reduce_fn combines values."""
@@ -236,6 +241,11 @@ def Union(*dias: DIA) -> DIA:
 
 
 def InnerJoin(left: DIA, right: DIA, left_key_fn: Callable,
-              right_key_fn: Callable, join_fn: Callable) -> DIA:
+              right_key_fn: Callable, join_fn: Callable,
+              location_detection: bool = False) -> DIA:
+    """``location_detection`` (reference: LocationDetectionTag) prunes
+    items whose key exists on only one side before the shuffle —
+    host-storage path only; the device path ignores the flag."""
     from .ops import join as _j
-    return _j.InnerJoin(left, right, left_key_fn, right_key_fn, join_fn)
+    return _j.InnerJoin(left, right, left_key_fn, right_key_fn, join_fn,
+                        location_detection=location_detection)

@@ -31,11 +31,16 @@ from ..dia_base import DIABase
 
 
 class InnerJoinNode(DIABase):
-    def __init__(self, ctx, llink, rlink, lkey, rkey, join_fn) -> None:
+    def __init__(self, ctx, llink, rlink, lkey, rkey, join_fn,
+                 location_detection: bool = False) -> None:
         super().__init__(ctx, "InnerJoin", [llink, rlink])
         self.lkey = lkey
         self.rkey = rkey
         self.join_fn = join_fn
+        # reference: LocationDetectionTag, api/inner_join.hpp:161-190 —
+        # prune items whose key hash exists on only one side before the
+        # shuffle (host path)
+        self.location_detection = location_detection
 
     def compute(self):
         left = self.parents[0].pull()
@@ -52,10 +57,45 @@ class InnerJoinNode(DIABase):
             right = right.to_host_shards()
         W = left.num_workers
         lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
-        lx = exchange.host_exchange(
-            left, lambda it: hashing.stable_host_hash(_h(lkey(it))))
-        rx = exchange.host_exchange(
-            right, lambda it: hashing.stable_host_hash(_h(rkey(it))))
+        # hash each item once; reuse for detection, pruning and shuffle
+        lh = [[hashing.stable_host_hash(_h(lkey(it))) for it in l]
+              for l in left.lists]
+        rh = [[hashing.stable_host_hash(_h(rkey(it))) for it in l]
+              for l in right.lists]
+        if self.location_detection and W > 1:
+            from ...core.location_detection import (LocationDetection,
+                                                    _MASK)
+            ld_l = LocationDetection(W)
+            ld_r = LocationDetection(W)
+            for w in range(W):
+                ld_l.add_worker(w, lh[w])
+                ld_r.add_worker(w, rh[w])
+            common = ld_l.common_hashes(ld_r)
+
+            def prune(shards, hs):
+                kept_items, kept_hashes = [], []
+                for items, hlist in zip(shards.lists, hs):
+                    ki, kh = [], []
+                    for it, h in zip(items, hlist):
+                        if h & _MASK in common:
+                            ki.append(it)
+                            kh.append(h)
+                    kept_items.append(ki)
+                    kept_hashes.append(kh)
+                return HostShards(W, kept_items), kept_hashes
+
+            left, lh = prune(left, lh)
+            right, rh = prune(right, rh)
+
+        def shuffle(shards, hs):
+            buckets = [[] for _ in range(W)]
+            for items, hlist in zip(shards.lists, hs):
+                for it, h in zip(items, hlist):
+                    buckets[h % W].append(it)
+            return HostShards(W, buckets)
+
+        lx = shuffle(left, lh)
+        rx = shuffle(right, rh)
         out = []
         for litems, ritems in zip(lx.lists, rx.lists):
             table = {}
@@ -221,6 +261,7 @@ def _h(k):
 
 
 def InnerJoin(left: DIA, right: DIA, left_key_fn, right_key_fn,
-              join_fn) -> DIA:
+              join_fn, location_detection: bool = False) -> DIA:
     return DIA(InnerJoinNode(left.context, left._link(), right._link(),
-                             left_key_fn, right_key_fn, join_fn))
+                             left_key_fn, right_key_fn, join_fn,
+                             location_detection=location_detection))

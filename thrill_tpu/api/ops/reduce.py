@@ -64,10 +64,14 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
 
 class ReduceNode(DIABase):
     def __init__(self, ctx, link, key_fn: Callable, reduce_fn: Callable,
-                 label: str = "ReduceByKey") -> None:
+                 label: str = "ReduceByKey",
+                 dup_detection: bool = False) -> None:
         super().__init__(ctx, label, [link])
         self.key_fn = key_fn
         self.reduce_fn = reduce_fn
+        # reference: DuplicateDetectionTag, api/reduce_by_key.hpp — skip
+        # shuffling keys whose hash is globally unique (host path)
+        self.dup_detection = dup_detection
 
     def compute(self):
         shards = self.parents[0].pull()
@@ -100,17 +104,30 @@ class ReduceNode(DIABase):
                 k = key_fn(it)
                 table[k] = reduce_fn(table[k], it) if k in table else it
             pre_tables.append(table)
-        # shuffle + post-phase
+        non_unique = None
+        if self.dup_detection and W > 1:
+            from ...core import duplicate_detection as dd
+            non_unique = dd.find_non_unique_hashes(
+                [[hashing.stable_host_hash(k) for k in t] for t in
+                 pre_tables])
+        # shuffle + post-phase; globally-unique keys stay local
         post = [dict() for _ in range(W)]
-        for table in pre_tables:
+        for w, table in enumerate(pre_tables):
             for k, v in table.items():
-                t = post[hashing.stable_host_hash(k) % W]
+                h = hashing.stable_host_hash(k)
+                if non_unique is not None and \
+                        dd.is_unique(h, non_unique):
+                    t = post[w]              # no shuffle needed
+                else:
+                    t = post[h % W]
                 t[k] = reduce_fn(t[k], v) if k in t else v
         return HostShards(W, [list(t.values()) for t in post])
 
 
-def ReduceByKey(dia: DIA, key_fn: Callable, reduce_fn: Callable) -> DIA:
-    return DIA(ReduceNode(dia.context, dia._link(), key_fn, reduce_fn))
+def ReduceByKey(dia: DIA, key_fn: Callable, reduce_fn: Callable,
+                dup_detection: bool = False) -> DIA:
+    return DIA(ReduceNode(dia.context, dia._link(), key_fn, reduce_fn,
+                          dup_detection=dup_detection))
 
 
 def ReducePair(dia: DIA, value_reduce_fn: Callable) -> DIA:

@@ -1,0 +1,34 @@
+"""Distributed duplicate detection for reduce shuffles.
+
+Equivalent of the reference's DuplicateDetection
+(reference: thrill/core/duplicate_detection.hpp:46): workers exchange
+Golomb-coded sorted hash lists of their keys; hashes seen by exactly
+one worker are *globally unique* — their items cannot combine with
+anything remote, so ReduceByKey can skip shuffling them (a large win
+when most keys are unique, e.g. WordCount over natural text).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Set
+
+import numpy as np
+
+from .location_detection import (decode_fingerprint, encode_fingerprint,
+                                 fingerprint, _MASK)
+
+
+def find_non_unique_hashes(per_worker_hashes: List[Iterable[int]]
+                           ) -> Set[int]:
+    """Hashes appearing on >= 2 workers (these must be shuffled)."""
+    seen: dict = {}
+    for w, hashes in enumerate(per_worker_hashes):
+        msg = encode_fingerprint(fingerprint(hashes))
+        for h in decode_fingerprint(msg):
+            h = int(h)
+            seen[h] = seen.get(h, 0) + 1
+    return {h for h, c in seen.items() if c >= 2}
+
+
+def is_unique(h: int, non_unique: Set[int]) -> bool:
+    return (h & _MASK) not in non_unique

@@ -1,0 +1,110 @@
+"""Golomb-Rice coded bit streams over delta-coded sorted integers.
+
+Equivalent of the reference's bit/Golomb/delta streams
+(reference: thrill/core/bit_stream.hpp:29, golomb_bit_stream.hpp:29,145,
+delta_stream.hpp) used by LocationDetection and DuplicateDetection to
+exchange sorted hash lists compactly: sorted values are delta-coded and
+each delta is Golomb-Rice encoded with parameter b (quotient unary,
+remainder in floor(log2 b) or ceil bits — we use the Rice special case
+b = 2^k for branch-free codecs).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List
+
+import numpy as np
+
+
+class BitWriter:
+    def __init__(self) -> None:
+        self._bits: List[int] = []
+
+    def put_bits(self, value: int, nbits: int) -> None:
+        for i in range(nbits - 1, -1, -1):
+            self._bits.append((value >> i) & 1)
+
+    def put_unary(self, q: int) -> None:
+        self._bits.extend([1] * q)
+        self._bits.append(0)
+
+    def to_bytes(self) -> bytes:
+        bits = self._bits
+        out = bytearray((len(bits) + 7) // 8)
+        for i, b in enumerate(bits):
+            if b:
+                out[i >> 3] |= 1 << (7 - (i & 7))
+        return bytes(out)
+
+    def __len__(self) -> int:
+        return len(self._bits)
+
+
+class BitReader:
+    def __init__(self, data: bytes, nbits: int) -> None:
+        self.data = data
+        self.nbits = nbits
+        self.pos = 0
+
+    def get_bit(self) -> int:
+        b = (self.data[self.pos >> 3] >> (7 - (self.pos & 7))) & 1
+        self.pos += 1
+        return b
+
+    def get_bits(self, n: int) -> int:
+        v = 0
+        for _ in range(n):
+            v = (v << 1) | self.get_bit()
+        return v
+
+    def get_unary(self) -> int:
+        q = 0
+        while self.get_bit():
+            q += 1
+        return q
+
+    @property
+    def exhausted(self) -> bool:
+        return self.pos >= self.nbits
+
+
+def rice_parameter(mean_delta: float) -> int:
+    """Rice k ~ log2(mean delta) (reference picks b from the expected
+    gap n_total/space like GolombKeyCounterPair setups)."""
+    k = 0
+    while (1 << (k + 1)) < mean_delta:
+        k += 1
+    return k
+
+
+def encode_sorted(values: Iterable[int], k: int) -> tuple:
+    """Delta + Rice(2^k) encode a sorted non-negative sequence.
+    Returns (payload bytes, nbits, count)."""
+    w = BitWriter()
+    prev = -1
+    count = 0
+    for v in values:
+        delta = v - prev - 1        # strictly increasing -> delta >= 0
+        assert delta >= 0, "encode_sorted requires strictly increasing"
+        w.put_unary(delta >> k)
+        if k:
+            w.put_bits(delta & ((1 << k) - 1), k)
+        prev = v
+        count += 1
+    return w.to_bytes(), len(w), count
+
+
+def decode_sorted(payload: bytes, nbits: int, count: int, k: int
+                  ) -> Iterator[int]:
+    r = BitReader(payload, nbits)
+    prev = -1
+    for _ in range(count):
+        q = r.get_unary()
+        rem = r.get_bits(k) if k else 0
+        delta = (q << k) | rem
+        prev = prev + delta + 1
+        yield prev
+
+
+def encode_sorted_np(values: np.ndarray, k: int) -> tuple:
+    return encode_sorted([int(v) for v in values], k)
