@@ -1,0 +1,54 @@
+"""Bitonic vs XLA sort engine equivalence."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from thrill_tpu.core import device_sort
+
+
+@pytest.mark.parametrize("n", [1, 2, 64, 1024])
+@pytest.mark.parametrize("nwords", [1, 2, 3])
+def test_bitonic_matches_xla(monkeypatch, n, nwords):
+    rng = np.random.default_rng(n * 10 + nwords)
+    # include duplicates to exercise the stability tiebreak
+    words = [jnp.asarray(rng.integers(0, max(n // 4, 2), n).astype(np.uint64))
+             for _ in range(nwords)]
+
+    monkeypatch.setenv("THRILL_TPU_SORT_IMPL", "xla")
+    perm_xla = np.asarray(jax.jit(device_sort.argsort_words)(words))
+    monkeypatch.setenv("THRILL_TPU_SORT_IMPL", "bitonic")
+    perm_bit = np.asarray(jax.jit(device_sort._bitonic_argsort)(words))
+    # with the iota tiebreak the stable permutation is unique
+    assert np.array_equal(perm_xla, perm_bit)
+
+
+def test_bitonic_large_random():
+    rng = np.random.default_rng(0)
+    n = 1 << 14
+    w = jnp.asarray(rng.integers(0, 1 << 60, n).astype(np.uint64))
+    perm = np.asarray(jax.jit(device_sort._bitonic_argsort)([w]))
+    sorted_w = np.asarray(w)[perm]
+    assert np.all(sorted_w[1:] >= sorted_w[:-1])
+    assert len(np.unique(perm)) == n
+
+
+def test_pipeline_on_bitonic_engine(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_SORT_IMPL", "bitonic")
+    from thrill_tpu.api import RunLocalMock
+
+    def job(ctx):
+        rng = np.random.default_rng(1)
+        vals = rng.integers(0, 500, 3000).astype(np.int64)
+        assert [int(x) for x in ctx.Distribute(vals).Sort().AllGather()] \
+            == sorted(vals.tolist())
+        hist = ctx.Distribute(vals).Map(lambda x: (x % 7, 1)) \
+            .ReducePair(lambda a, b: a + b)
+        got = dict((int(k), int(v)) for k, v in hist.AllGather())
+        want = {}
+        for v in vals.tolist():
+            want[v % 7] = want.get(v % 7, 0) + 1
+        assert got == want
+    RunLocalMock(job, 4)

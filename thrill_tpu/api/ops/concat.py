@@ -85,7 +85,8 @@ def rebalance_to_even(mex, parts: List[DeviceShards], token) -> DeviceShards:
             tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
             g = tree["__gidx"].astype(jnp.uint64)
             g = jnp.where(valid, g, jnp.uint64(2 ** 63))
-            order = jnp.argsort(g)
+            from ...core.device_sort import argsort_words
+            order = argsort_words([g])
             out_tree = jax.tree.map(lambda l: jnp.take(l, order, axis=0),
                                     tree["tree"])
             out_leaves, out_td = jax.tree.flatten(out_tree)

@@ -224,6 +224,8 @@ def _run_bounds(lw, lvalid, rw, rvalid):
     lws = [jnp.where(lvalid, w, maxw) for w in lw]
     rws = [jnp.where(rvalid, w, maxw) for w in rw]
 
+    from ...core.device_sort import argsort_words
+
     def counts_below(right_after: bool):
         side_l = jnp.zeros(lcap, jnp.uint64) if right_after else \
             jnp.ones(lcap, jnp.uint64)
@@ -233,14 +235,13 @@ def _run_bounds(lw, lvalid, rw, rvalid):
         side = jnp.concatenate([side_l, side_r])
         ridx = jnp.concatenate([jnp.full(lcap, rcap, jnp.uint64),
                                 jnp.arange(rcap, dtype=jnp.uint64)])
-        res = jax.lax.sort(tuple(words) + (side, ridx),
-                           dimension=0, num_keys=len(words) + 1,
-                           is_stable=True)
-        side_s, ridx_s = res[-2], res[-1]
+        perm = argsort_words(words + [side])
+        side_s = jnp.take(side, perm)
+        ridx_s = jnp.take(ridx, perm)
         is_right = side_s == (1 if right_after else 0)
-        pos = jnp.arange(lcap + rcap, dtype=jnp.int64)
-        rights_before_incl = jnp.cumsum(is_right.astype(jnp.int64))
-        lefts_before = pos + 1 - rights_before_incl
+        is_left = ~is_right
+        # lefts at positions <= p == lefts strictly before a right item
+        lefts_before = jnp.cumsum(is_left.astype(jnp.int64))
         # scatter back to right-item order
         out = jnp.zeros(rcap + 1, jnp.int64)
         tgt = jnp.where(is_right, ridx_s.astype(jnp.int64), rcap)

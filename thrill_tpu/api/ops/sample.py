@@ -98,7 +98,9 @@ class SampleNode(DIABase):
                 # random scores; invalid items pushed last, take first t
                 scores = jax.random.uniform(kk, (cap,))
                 scores = jnp.where(mask, scores, 2.0)
-                order = jnp.argsort(scores)
+                from ...core import keys as keymod
+                from ...core.device_sort import argsort_words
+                order = argsort_words(keymod.encode_key_words(scores))
                 keep_sorted = jnp.arange(cap) < t
                 keep = jnp.zeros(cap, bool).at[order].set(keep_sorted)
                 tree = jax.tree.unflatten(treedef, [l[0] for l in ls])

@@ -37,11 +37,8 @@ def sort_by_key_words(words: List[jnp.ndarray], tree: Any, valid: jnp.ndarray,
 
 def _argsort_multi(keys: List[jnp.ndarray]) -> jnp.ndarray:
     """Stable argsort by multiple uint64 key arrays (lexicographic)."""
-    n = keys[0].shape[0]
-    iota = jnp.arange(n, dtype=jnp.uint64)
-    res = jax.lax.sort(tuple(keys) + (iota,), dimension=0,
-                       num_keys=len(keys), is_stable=True)
-    return res[-1].astype(jnp.int32)
+    from .device_sort import argsort_words
+    return argsort_words(keys)
 
 
 def segment_boundaries(words: List[jnp.ndarray], valid: jnp.ndarray

@@ -70,7 +70,8 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
             widx = lax.axis_index(AXIS)
             dest = dest_builder(tree, mask, widx).astype(jnp.int32)
             dest = jnp.where(mask, jnp.clip(dest, 0, W - 1), W)
-            perm = jnp.argsort(dest, stable=True)
+            from ..core.device_sort import argsort_words
+            perm = argsort_words([dest.astype(jnp.uint64)])
             sorted_dest = jnp.take(dest, perm)
             sorted_ls = [jnp.take(l[0], perm, axis=0) for l in ls]
             send = jnp.bincount(sorted_dest, length=W + 1)[:W].astype(jnp.int32)

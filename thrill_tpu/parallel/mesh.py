@@ -82,7 +82,12 @@ class MeshExec:
         DOp implementations use module-level builder functions plus a
         static-parameter key, so re-running a pipeline reuses compiled
         XLA executables (first compile 20-40s on TPU, then cached).
+        Trace-time environment knobs that change generated code (the
+        sort engine selection) are folded into every key so toggling
+        them mid-process takes effect instead of hitting stale programs.
         """
+        import os
+        key = key + (os.environ.get("THRILL_TPU_SORT_IMPL", "auto"),)
         fn = self._cache.get(key)
         if fn is None:
             fn = builder()
