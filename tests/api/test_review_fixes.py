@@ -56,3 +56,50 @@ def test_executable_cache_pins_functions():
         assert outs[0] == [i * 2 for i in range(50)]
         assert outs[1] == [i * 3 for i in range(50)]
     RunLocalMock(job, 2)
+
+
+def test_action_futures_and_overall_stats():
+    from thrill_tpu.api import RunLocalMock
+
+    def job(ctx):
+        d = ctx.Generate(100).Cache().Keep(1)
+        fs = d.SizeFuture()
+        fg = d.AllGatherFuture()
+        assert not fs.done
+        assert fs.get() == 100
+        assert fs.done and fs() == 100      # cached
+        assert len(fg.get()) == 100
+        # exchange traffic accounted after a shuffle
+        s = ctx.Distribute(np.arange(1000, dtype=np.int64) % 97).Sort()
+        s.Execute()
+        stats = ctx.overall_stats()
+        assert stats["nodes_executed"] >= 3
+        if ctx.num_workers > 1:
+            assert stats["exchanges"] >= 1
+            assert stats["items_moved"] > 0
+        return True
+    RunLocalMock(job, 4)
+
+
+def test_future_survives_intervening_action():
+    from thrill_tpu.api import RunLocalMock
+
+    def job(ctx):
+        d = ctx.Generate(50).Cache()
+        f = d.SizeFuture()      # reserves a use at issue time
+        assert d.Size() == 50   # consumes the original budget
+        assert f.get() == 50    # future's reservation still valid
+        # custom-fold deferred variant
+        g = ctx.Generate(10).SumFuture(fn=lambda a, b: max(a, b))
+        assert g.get() == 9
+    RunLocalMock(job, 2)
+
+
+def test_histogram_dispatch_ignores_negatives():
+    import jax.numpy as jnp
+    from thrill_tpu.core.pallas_kernels import (partition_histogram,
+                                                segment_sum)
+    d = jnp.asarray(np.array([-1, 0, 0, 2, 99], dtype=np.int32))
+    assert np.asarray(partition_histogram(d, 3)).tolist() == [2, 0, 1]
+    s = segment_sum(d, jnp.asarray(np.ones(5, np.float32)), 3)
+    assert np.asarray(s).tolist() == [2.0, 0.0, 1.0]

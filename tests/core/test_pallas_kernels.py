@@ -1,0 +1,45 @@
+"""Pallas kernel equivalence tests (interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from thrill_tpu.core import pallas_kernels as pk
+
+
+@pytest.mark.parametrize("n,bins", [(10, 4), (512, 8), (2000, 17),
+                                    (4096, 256)])
+def test_partition_histogram_matches_bincount(n, bins):
+    rng = np.random.default_rng(n)
+    dest = rng.integers(0, bins, n).astype(np.int32)
+    got = np.asarray(pk.partition_histogram_pallas(
+        jnp.asarray(dest), bins, interpret=True))
+    want = np.bincount(dest, minlength=bins)
+    assert np.array_equal(got, want)
+
+
+def test_partition_histogram_ignores_sentinel():
+    dest = np.array([0, 1, 1, 7, 7, 7, -1], dtype=np.int32)  # 7 = "W"
+    got = np.asarray(pk.partition_histogram_pallas(
+        jnp.asarray(dest), 4, interpret=True))
+    assert got.tolist() == [1, 2, 0, 0]
+
+
+@pytest.mark.parametrize("n,segs", [(100, 5), (1000, 300)])
+def test_segment_sum_matches_numpy(n, segs):
+    rng = np.random.default_rng(n)
+    ids = rng.integers(0, segs, n).astype(np.int32)
+    vals = rng.normal(size=n).astype(np.float32)
+    got = np.asarray(pk.segment_sum_pallas(
+        jnp.asarray(ids), jnp.asarray(vals), segs, interpret=True))
+    want = np.zeros(segs, np.float32)
+    np.add.at(want, ids, vals)
+    assert np.allclose(got, want, atol=1e-4)
+
+
+def test_dispatch_fallback_off_tpu():
+    # on CPU the dispatcher must use the jnp fallback and still be right
+    dest = jnp.asarray(np.array([0, 2, 2, 5], dtype=np.int32))
+    got = np.asarray(pk.partition_histogram(dest, 6))
+    assert got.tolist() == [1, 0, 2, 0, 0, 1]

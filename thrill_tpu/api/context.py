@@ -88,9 +88,26 @@ class Context:
         from .ops import read_write
         return read_write.ReadBinary(self, path_or_glob, dtype, record_shape)
 
+    def overall_stats(self) -> dict:
+        """End-of-job summary (reference: OverallStats AllReduce,
+        api/context.cpp:1235-1341)."""
+        mex = self.mesh_exec
+        return {
+            "workers": self.num_workers,
+            "nodes_created": len(self._nodes),
+            "nodes_executed": sum(1 for n in self._nodes
+                                  if n.state != "NEW"),
+            "exchanges": mex.stats_exchanges,
+            "items_moved": mex.stats_items_moved,
+            "bytes_moved": mex.stats_bytes_moved,
+            "host_mem_peak": self.mem.peak,
+        }
+
     def close(self) -> None:
         if self._profiler is not None:
             self._profiler.stop()
+        if self.logger.enabled:
+            self.logger.line(event="overall_stats", **self.overall_stats())
         self.logger.close()
 
 

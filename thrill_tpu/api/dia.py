@@ -164,6 +164,29 @@ class DIA:
         from .ops import actions
         return actions.Size(self)
 
+    # Future variants defer execution until .get() — reference:
+    # api/action_node.hpp Future<T>. Creation reserves one consume-budget
+    # unit so issue order (not get order) governs consumption: actions
+    # run between issue and get cannot starve the future.
+    def _future(self, thunk) -> "ActionFuture":
+        from .future import ActionFuture
+        self.node.keep(1)
+        return ActionFuture(thunk)
+
+    def SizeFuture(self):
+        from .ops import actions
+        return self._future(lambda: actions.Size(self))
+
+    def AllGatherFuture(self):
+        from .ops import actions
+        return self._future(lambda: actions.AllGather(self))
+
+    def SumFuture(self, fn: Callable = None, initial: Any = 0):
+        from .ops import actions
+        if fn is not None:
+            return self._future(lambda: actions.AllReduce(self, fn, initial))
+        return self._future(lambda: actions.Sum(self, initial))
+
     def AllGather(self) -> list:
         from .ops import actions
         return actions.AllGather(self)

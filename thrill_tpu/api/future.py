@@ -1,0 +1,36 @@
+"""Deferred action results.
+
+Equivalent of the reference's ActionResultNode / Future<T>
+(reference: thrill/api/action_node.hpp:65,83,126): *Future action
+variants defer evaluation; ``get()`` (or calling the future) runs the
+pipeline. Issuing a future reserves one consume-budget unit on its DIA
+(DIA._future), so actions executed between issue and get cannot starve
+it — issue order governs consumption like the reference, where the
+action node is built at creation time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Generic, TypeVar
+
+T = TypeVar("T")
+
+_UNSET = object()
+
+
+class ActionFuture(Generic[T]):
+    def __init__(self, thunk: Callable[[], T]) -> None:
+        self._thunk = thunk
+        self._result: Any = _UNSET
+
+    def get(self) -> T:
+        if self._result is _UNSET:
+            self._result = self._thunk()
+            self._thunk = None  # free captured pipeline references
+        return self._result
+
+    __call__ = get
+
+    @property
+    def done(self) -> bool:
+        return self._result is not _UNSET

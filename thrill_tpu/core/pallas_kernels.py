@@ -1,0 +1,157 @@
+"""Pallas TPU kernels for data-plane hot loops.
+
+The exchange planner's per-destination histogram and the reduce phases'
+segment sums are the innermost device loops of every shuffle (reference
+analog: the per-partition counters of ReducePrePhase,
+core/reduce_pre_phase.hpp:94). These kernels keep the accumulator in
+VMEM across a sequential grid over row blocks, and express the one-hot
+accumulation as a matmul so the MXU does the counting.
+
+Usage is gated: ``partition_histogram`` dispatches to the Pallas kernel
+when THRILL_TPU_PALLAS=1 and the platform is a TPU, else to the jnp
+fallback (identical semantics; CPU tests run the kernel in interpret
+mode to pin equivalence).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK = 512          # rows per grid step (multiple of the 128 lane width)
+LANES = 128
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("THRILL_TPU_PALLAS", "0") == "1" and \
+        jax.default_backend() == "tpu"
+
+
+def _round_up(n: int, g: int) -> int:
+    return ((n + g - 1) // g) * g
+
+
+def _hist_kernel(dest_ref, out_ref, *, num_bins_padded: int):
+    from jax.experimental import pallas as pl
+
+    pi = pl.program_id(0)
+
+    @pl.when(pi == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    d = dest_ref[:]                                   # [1, BLOCK] int32
+    bins = jax.lax.broadcasted_iota(
+        jnp.int32, (BLOCK, num_bins_padded), 1)       # [BLOCK, B]
+    onehot = (d.reshape(BLOCK, 1) == bins).astype(jnp.float32)
+    # MXU-friendly: per-block count = ones[1,BLOCK] @ onehot[BLOCK,B].
+    # Block partials are <= BLOCK (exact in f32); the cross-block
+    # accumulator is int32 so totals never lose precision past 2^24.
+    ones = jnp.ones((1, BLOCK), jnp.float32)
+    partial = jnp.dot(ones, onehot, preferred_element_type=jnp.float32)
+    out_ref[:] += partial.astype(jnp.int32)
+
+
+def partition_histogram_pallas(dest: jnp.ndarray, num_bins: int,
+                               interpret: bool = False) -> jnp.ndarray:
+    """Count occurrences of each bin value in ``dest`` (int32 [n]).
+
+    Values outside [0, num_bins) are ignored (padding sentinel W).
+    """
+    from jax.experimental import pallas as pl
+
+    n = dest.shape[0]
+    n_pad = _round_up(max(n, 1), BLOCK)
+    bpad = _round_up(max(num_bins, 1), LANES)
+    d = jnp.full(n_pad, -1, jnp.int32).at[:n].set(dest.astype(jnp.int32))
+    d2 = d.reshape(n_pad // BLOCK, BLOCK)
+
+    kernel = functools.partial(_hist_kernel, num_bins_padded=bpad)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // BLOCK,),
+        in_specs=[pl.BlockSpec((1, BLOCK), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, bpad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, bpad), jnp.int32),
+        interpret=interpret,
+    )(d2)
+    return out[0, :num_bins]
+
+
+def partition_histogram(dest: jnp.ndarray, num_bins: int) -> jnp.ndarray:
+    """Dispatch: Pallas on TPU when enabled, else jnp.bincount.
+
+    Both paths ignore values outside [0, num_bins) — negative or
+    too-large ids are padding sentinels, never counted.
+    """
+    if pallas_enabled():
+        return partition_histogram_pallas(dest, num_bins)
+    sanitized = jnp.where((dest >= 0) & (dest < num_bins), dest, num_bins)
+    return jnp.bincount(sanitized,
+                        length=num_bins + 1)[:num_bins].astype(jnp.int32)
+
+
+def _segsum_kernel(seg_ref, val_ref, out_ref, *, num_segs_padded: int):
+    from jax.experimental import pallas as pl
+
+    pi = pl.program_id(0)
+
+    @pl.when(pi == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    s = seg_ref[:]                                    # [1, BLOCK] int32
+    v = val_ref[:]                                    # [1, BLOCK] f32
+    segs = jax.lax.broadcasted_iota(
+        jnp.int32, (BLOCK, num_segs_padded), 1)
+    onehot = (s.reshape(BLOCK, 1) == segs).astype(jnp.float32)
+    out_ref[:] += jnp.dot(v.reshape(1, BLOCK), onehot,
+                          preferred_element_type=jnp.float32)
+
+
+def segment_sum(seg_ids: jnp.ndarray, values: jnp.ndarray,
+                num_segments: int) -> jnp.ndarray:
+    """Dispatch: Pallas on TPU when enabled, else jax segment_sum."""
+    if pallas_enabled():
+        return segment_sum_pallas(seg_ids, values, num_segments)
+    import jax.ops
+    safe = jnp.where((seg_ids >= 0) & (seg_ids < num_segments),
+                     seg_ids, num_segments)
+    return jax.ops.segment_sum(values.astype(jnp.float32), safe,
+                               num_segments=num_segments + 1)[:num_segments]
+
+
+def segment_sum_pallas(seg_ids: jnp.ndarray, values: jnp.ndarray,
+                       num_segments: int,
+                       interpret: bool = False) -> jnp.ndarray:
+    """Sum float32 ``values`` into ``num_segments`` buckets by seg id.
+
+    The one-hot matmul runs the accumulation on the MXU. This is the
+    specialized fast path for additive float reductions (dense
+    ReduceToIndex-style sums); the generic reduce pipeline keeps the
+    segmented associative scan, which supports arbitrary reduce
+    functions.
+    """
+    from jax.experimental import pallas as pl
+
+    n = values.shape[0]
+    n_pad = _round_up(max(n, 1), BLOCK)
+    spad = _round_up(max(num_segments, 1), LANES)
+    s = jnp.full(n_pad, -1, jnp.int32).at[:n].set(seg_ids.astype(jnp.int32))
+    v = jnp.zeros(n_pad, jnp.float32).at[:n].set(values.astype(jnp.float32))
+
+    kernel = functools.partial(_segsum_kernel, num_segs_padded=spad)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // BLOCK,),
+        in_specs=[pl.BlockSpec((1, BLOCK), lambda i: (i, 0)),
+                  pl.BlockSpec((1, BLOCK), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, spad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, spad), jnp.float32),
+        interpret=interpret,
+    )(s.reshape(-1, BLOCK), v.reshape(-1, BLOCK))
+    return out[0, :num_segments]

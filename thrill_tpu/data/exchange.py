@@ -74,7 +74,8 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
             perm = argsort_words([dest.astype(jnp.uint64)])
             sorted_dest = jnp.take(dest, perm)
             sorted_ls = [jnp.take(l[0], perm, axis=0) for l in ls]
-            send = jnp.bincount(sorted_dest, length=W + 1)[:W].astype(jnp.int32)
+            from ..core.pallas_kernels import partition_histogram
+            send = partition_histogram(sorted_dest, W)
             return (sorted_dest[None], send[None],
                     *[sl[None] for sl in sorted_ls])
 
@@ -97,6 +98,16 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
     R = S.sum(axis=0)                             # recv totals per worker
     new_counts = R.astype(np.int64)
+
+    # traffic accounting (reference: net::Manager tx/rx counters feeding
+    # the end-of-job OverallStats AllReduce, api/context.cpp:1275-1341)
+    moved = int(S.sum()) - int(np.trace(S))       # off-diagonal items
+    item_bytes = sum(int(np.dtype(l.dtype).itemsize) *
+                     int(np.prod(l.shape[2:], dtype=np.int64))
+                     for l in sorted_leaves)
+    mex.stats_exchanges += 1
+    mex.stats_items_moved += moved
+    mex.stats_bytes_moved += moved * item_bytes
 
     if W == 1:
         # no movement: items are already dest-sorted (valid first)

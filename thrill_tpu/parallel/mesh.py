@@ -44,6 +44,10 @@ class MeshExec:
         self.num_workers = len(self.devices)
         self.mesh = Mesh(np.asarray(self.devices), (AXIS,))
         self._cache: Dict[Any, Callable] = {}
+        # cumulative data-plane traffic (cross-worker items/bytes)
+        self.stats_exchanges = 0
+        self.stats_items_moved = 0
+        self.stats_bytes_moved = 0
 
     # -- shardings ------------------------------------------------------
     @property
