@@ -1,4 +1,9 @@
-"""Put the repo root on sys.path for direct `python examples/x.py` runs."""
+"""Repo-root sys.path + platform forcing for direct CLI runs.
+
+Also makes the standard JAX_PLATFORMS env var effective: some device
+plugins (axon) ignore the env var unless the config is set before
+first jax use, so `JAX_PLATFORMS=cpu python examples/x.py` works.
+"""
 
 import os
 import sys
@@ -6,3 +11,19 @@ import sys
 _root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _root not in sys.path:
     sys.path.insert(0, _root)
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # only intervene for an explicit CPU request: this image exports
+    # JAX_PLATFORMS=axon globally, and re-applying that here would
+    # clobber a harness (conftest) that already forced CPU
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # unregister accelerator plugins entirely: on this image the axon
+    # plugin can hang PJRT client init even when the platform list
+    # excludes it, and plugin discovery at first backends() would
+    # re-register and re-force jax_platforms
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    _xb.discover_pjrt_plugins = lambda: None

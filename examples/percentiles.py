@@ -1,0 +1,45 @@
+"""Percentiles of a distributed dataset via Sort + ZipWithIndex.
+
+Reference: /root/reference/examples/percentiles/ — sort the values and
+probe rank positions.
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
+
+import numpy as np
+
+from thrill_tpu.api import Context
+
+
+def percentiles(ctx: Context, values: np.ndarray, qs=(50, 90, 95, 99)):
+    n = len(values)
+    wanted = {int(np.clip(int(q / 100.0 * n), 0, n - 1)): q for q in qs}
+    idx_dev = np.array(sorted(wanted), dtype=np.int64)
+
+    import jax.numpy as jnp
+    tgt = jnp.asarray(idx_dev)
+
+    s = ctx.Distribute(np.asarray(values, dtype=np.int64)).Sort()
+    ranked = s.ZipWithIndex(lambda v, i: (i, v))
+    picked = ranked.Filter(lambda t: jnp.isin(t[0], tgt))
+    out = {}
+    for i, v in picked.AllGather():
+        out[wanted[int(i)]] = int(v)
+    return out
+
+
+def main():
+    from thrill_tpu.api import Run
+
+    def job(ctx):
+        rng = np.random.default_rng(0)
+        vals = rng.integers(0, 10 ** 9, 100000)
+        print(percentiles(ctx, vals))
+
+    Run(job)
+
+
+if __name__ == "__main__":
+    main()

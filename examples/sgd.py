@@ -1,0 +1,56 @@
+"""Mini-batch SGD linear regression over a distributed dataset.
+
+Reference: /root/reference/examples/sgd/ — per-iteration gradient on a
+Bernoulli-sampled mini batch, AllReduce'd, applied to the model.
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
+
+import numpy as np
+
+from thrill_tpu.api import Context
+
+
+def sgd_linear(ctx: Context, X: np.ndarray, y: np.ndarray,
+               iterations: int = 40, lr: float = 0.1,
+               batch_fraction: float = 0.25, seed: int = 0):
+    import jax.numpy as jnp
+
+    n, dim = X.shape
+    data = ctx.Distribute({"x": X.astype(np.float64),
+                           "y": y.astype(np.float64)}).Cache() \
+        .Keep(iterations + 1)
+    w = np.zeros(dim)
+    for t in range(iterations):
+        wj = jnp.asarray(w)
+        batch = data.BernoulliSample(batch_fraction, seed=seed + t)
+
+        def grad(tr):
+            err = tr["x"] @ wj - tr["y"]
+            return err[:, None] * tr["x"]
+
+        m = max(int(n * batch_fraction), 1)
+        gsum = batch.Map(grad).Sum()
+        w = w - lr * np.asarray(gsum) / m
+    return w
+
+
+def main():
+    from thrill_tpu.api import Run
+
+    def job(ctx):
+        rng = np.random.default_rng(0)
+        n, dim = 20000, 6
+        true_w = rng.normal(size=dim)
+        X = rng.normal(size=(n, dim))
+        y = X @ true_w + 0.01 * rng.normal(size=n)
+        w = sgd_linear(ctx, X, y)
+        print("err:", float(np.linalg.norm(w - true_w)))
+
+    Run(job)
+
+
+if __name__ == "__main__":
+    main()

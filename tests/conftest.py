@@ -4,6 +4,11 @@ Mirrors the reference's in-process virtual-cluster testing strategy
 (reference: thrill/api/context.cpp:336-341 RunLocalTests over mock
 clusters): all distributed tests run on XLA host-platform devices, no
 real TPU needed.
+
+Accelerator plugins are unregistered outright: on this image the axon
+TPU plugin can intermittently hang its PJRT client init even when
+``jax_platforms=cpu`` (jax still initializes registered plugin
+backends), which stalls the whole suite at the first jax.devices call.
 """
 
 import os
@@ -15,3 +20,14 @@ if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+from jax._src import xla_bridge as _xb
+
+# pop ONLY axon: removing builtin platforms (tpu) breaks Pallas's MLIR
+# platform registry, which mirrors the factory table
+_xb._backend_factories.pop("axon", None)
+
+# PJRT plugin discovery at first backends() re-registers the axon plugin
+# AND re-sets jax_platforms='axon,cpu' (its entry-point initialize), which
+# would undo the forcing above mid-suite — disable discovery outright
+_xb.discover_pjrt_plugins = lambda: None

@@ -150,3 +150,53 @@ def test_logistic_regression():
         acc = np.mean((X @ w > 0) == (y > 0.5))
         assert acc > 0.95
     RunLocalMock(job, 4)
+
+
+def test_bfs():
+    import bfs as bf
+    rng = np.random.default_rng(21)
+    edges = rng.integers(0, 60, (250, 2)).astype(np.int64)
+
+    def job(ctx):
+        lv = bf.bfs_levels(ctx, edges, 60, source=0)
+        want = bf.bfs_dense(edges, 60, source=0)
+        assert np.array_equal(lv, want)
+    RunLocalMock(job, 4)
+
+
+def test_percentiles():
+    import percentiles as pc
+    rng = np.random.default_rng(23)
+    vals = rng.integers(0, 1 << 30, 5000)
+
+    def job(ctx):
+        got = pc.percentiles(ctx, vals, qs=(50, 90, 99))
+        s = np.sort(vals)
+        for q, v in got.items():
+            assert v == int(s[min(int(q / 100 * len(s)), len(s) - 1)])
+    RunLocalMock(job, 4)
+
+
+def test_sgd():
+    import sgd as sg
+    rng = np.random.default_rng(29)
+    n, dim = 4000, 4
+    true_w = rng.normal(size=dim)
+    X = rng.normal(size=(n, dim))
+    y = X @ true_w
+
+    def job(ctx):
+        w = sg.sgd_linear(ctx, X, y, iterations=30, lr=0.2)
+        assert np.linalg.norm(w - true_w) < 0.2, (w, true_w)
+    RunLocalMock(job, 4)
+
+
+def test_tpch_q3():
+    import tpch as tq
+    orders, lineitem = tq.generate_tables(800, seed=31)
+
+    def job(ctx):
+        got = tq.q3_lite(ctx, orders, lineitem)
+        want = tq.q3_dense(orders, lineitem)
+        assert np.array_equal(got, want), (got, want)
+    RunLocalMock(job, 4)

@@ -1,2 +1,3 @@
-from .context import Context, Run, RunLocalMock, RunLocalTests  # noqa: F401
+from .context import (Context, Run, RunDistributed, RunLocalMock,  # noqa: F401
+                      RunLocalTests)
 from .dia import DIA, Concat, InnerJoin, Merge, Union, Zip, ZipWindow  # noqa: F401

@@ -35,13 +35,18 @@ class Context:
     """Runtime handle passed to user jobs; owns the mesh and services."""
 
     def __init__(self, mesh_exec: Optional[MeshExec] = None,
-                 config: Optional[Config] = None, seed: int = 0) -> None:
+                 config: Optional[Config] = None, seed: int = 0,
+                 host_rank: Optional[int] = None) -> None:
         self.config = config or Config.from_env()
         self.mesh_exec = mesh_exec or MeshExec(
             num_workers=self.config.num_workers)
+        self.mesh_exec.exchange_mode = self.config.exchange
+        if host_rank is None:
+            host_rank = jax.process_index()
+        self.host_rank = host_rank
         self.flow = LocalFlowControl(self.num_workers)
         self.logger = JsonLogger(
-            default_log_path(self.config.log_path, host_rank=0),
+            default_log_path(self.config.log_path, host_rank=host_rank),
             program="thrill_tpu", workers=self.num_workers)
         self.mem = MemoryManager(name="context")
         self.rng = np.random.default_rng(seed)
@@ -137,6 +142,40 @@ def RunLocalMock(job: Callable[[Context], Any], workers: int,
             f"--xla_force_host_platform_device_count={workers}")
     mex = MeshExec(devices=cpus[:workers])
     ctx = Context(mex, config, seed)
+    try:
+        return job(ctx)
+    finally:
+        ctx.close()
+
+
+def RunDistributed(job: Callable[[Context], Any],
+                   coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None,
+                   config: Optional[Config] = None) -> Any:
+    """Multi-host entry point: the mesh spans every host's devices.
+
+    The reference reaches multiple hosts through its tcp/mpi backends
+    (api/context.cpp:496,651); here the data plane rides
+    ``jax.distributed`` — XLA routes collectives over ICI within a
+    slice and DCN across slices, and the jitted operator programs are
+    unchanged. Each host runs this same function (standard JAX
+    multi-controller SPMD). Sources that take global host data
+    (Distribute) expect identical input on every host; per-host data
+    should enter via ConcatToDIA of the local portion.
+
+    EXPERIMENTAL: the exchange plan step replicates its send-count
+    matrix so it is fetchable on every process, but other host-side
+    steps (per-worker counts refresh) still fetch globally-sharded
+    arrays, which multi-controller JAX only permits for addressable
+    shards — full multi-host hardening is tracked for the next round.
+    """
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    mex = MeshExec(devices=jax.devices())
+    ctx = Context(mex, config, host_rank=process_id or 0)
     try:
         return job(ctx)
     finally:

@@ -76,10 +76,16 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
             sorted_ls = [jnp.take(l[0], perm, axis=0) for l in ls]
             from ..core.pallas_kernels import partition_histogram
             send = partition_histogram(sorted_dest, W)
-            return (sorted_dest[None], send[None],
+            # replicate the [W, W] send-count matrix: every process can
+            # then fetch it locally (multi-controller safe host step)
+            all_send = lax.all_gather(send, AXIS)
+            return (sorted_dest[None], all_send,
                     *[sl[None] for sl in sorted_ls])
 
-        return mex.smap(fa, 1 + len(leaves))
+        from jax.sharding import PartitionSpec as P
+        return mex.smap(fa, 1 + len(leaves),
+                        out_specs=(P(AXIS), P()) +
+                        (P(AXIS),) * len(leaves))
 
     fa = mex.cached(key_a, build_a)
     out_a = fa(shards.counts_device(), *leaves)
@@ -113,6 +119,12 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
         # no movement: items are already dest-sorted (valid first)
         tree = jax.tree.unflatten(treedef, sorted_leaves)
         return DeviceShards(mex, tree, new_counts)
+
+    import os
+    mode = os.environ.get("THRILL_TPU_EXCHANGE") or \
+        getattr(mex, "exchange_mode", "dense")
+    if mode == "ragged":
+        return _exchange_ragged(mex, treedef, sorted_leaves, S, min_cap)
 
     M_pad = round_up_pow2(max(int(S.max()), 1))
     out_cap = round_up_pow2(max(int(R.max()), min_cap, 1))
@@ -157,6 +169,54 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     srow = mex.put(S.astype(np.int32))            # row w on worker w
     scol = mex.put(S.T.copy().astype(np.int32))   # col w on worker w
     out_leaves = list(fb(sorted_dest, srow, scol, *sorted_leaves))
+    tree = jax.tree.unflatten(treedef, out_leaves)
+    return DeviceShards(mex, tree, new_counts)
+
+
+def _exchange_ragged(mex: MeshExec, treedef, sorted_leaves, S: np.ndarray,
+                     min_cap: int = 1) -> DeviceShards:
+    """TPU fast path: ``lax.ragged_all_to_all`` — no per-pair padding.
+
+    Phase-A output is already destination-contiguous, which is exactly
+    the operand layout ragged_all_to_all wants: per-destination input
+    offsets are the exclusive cumsum of the send-count row; receive
+    offsets group by source (rank order), preserving the same
+    deterministic item order as the dense path. XLA:CPU lacks this op,
+    so the path is only selected via THRILL_TPU_EXCHANGE=ragged.
+    """
+    W = mex.num_workers
+    R = S.sum(axis=0)
+    new_counts = R.astype(np.int64)
+    out_cap = round_up_pow2(max(int(R.max()), min_cap, 1))
+    key = ("xchg_ragged", out_cap, treedef,
+           tuple((l.dtype, l.shape[1:]) for l in sorted_leaves))
+
+    def build():
+        def f(srow, scol, olanding, *ls):
+            S_row = srow[0].astype(jnp.int32)     # my sends by dest
+            S_col = scol[0].astype(jnp.int32)     # my recvs by source
+            in_off = _ex_cumsum(S_row)
+            # where MY chunk lands inside each destination's buffer:
+            # sources before me writing to that destination
+            out_off = olanding[0].astype(jnp.int32)
+            outs = []
+            for l in ls:
+                x = l[0]
+                out = jnp.zeros((out_cap,) + x.shape[1:], x.dtype)
+                res = lax.ragged_all_to_all(
+                    x, out, in_off, S_row, out_off, S_col,
+                    axis_name=AXIS)
+                outs.append(res[None])
+            return tuple(outs)
+
+        return mex.smap(f, 3 + len(sorted_leaves))
+
+    fb = mex.cached(key, build)
+    srow = mex.put(S.astype(np.int32))
+    scol = mex.put(S.T.copy().astype(np.int32))
+    # landing[w, d] = sum of S[0:w, d] (receiver-side offset of w's chunk)
+    landing = (np.cumsum(S, axis=0) - S).astype(np.int32)
+    out_leaves = list(fb(srow, scol, mex.put(landing), *sorted_leaves))
     tree = jax.tree.unflatten(treedef, out_leaves)
     return DeviceShards(mex, tree, new_counts)
 

@@ -48,6 +48,9 @@ class MeshExec:
         self.stats_exchanges = 0
         self.stats_items_moved = 0
         self.stats_bytes_moved = 0
+        # exchange implementation ('dense' | 'ragged'); Context sets it
+        # from Config.exchange, THRILL_TPU_EXCHANGE env overrides
+        self.exchange_mode = "dense"
 
     # -- shardings ------------------------------------------------------
     @property
