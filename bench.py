@@ -45,12 +45,18 @@ def main():
 
     import jax
 
+    try:  # persistent compile cache: axon compiles cost ~40s/program
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/thrill_tpu_xla"))
+    except Exception:
+        pass
+
     import thrill_tpu  # noqa: F401  (enables x64)
     from thrill_tpu.api import Context
     from thrill_tpu.parallel.mesh import MeshExec
 
     platform = jax.default_backend()
-    default_n = 1 << 21 if platform != "cpu" else 1 << 18
+    default_n = 1 << 20 if platform != "cpu" else 1 << 18
     n = int(os.environ.get("THRILL_TPU_BENCH_N", default_n))
 
     rng = np.random.default_rng(0)
