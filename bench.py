@@ -45,6 +45,9 @@ def main():
 
     import jax
 
+    from thrill_tpu.common.platform import maybe_force_cpu_from_env
+    maybe_force_cpu_from_env()
+
     try:  # persistent compile cache: axon compiles cost ~40s/program
         jax.config.update("jax_compilation_cache_dir",
                           os.path.expanduser("~/.cache/thrill_tpu_xla"))
@@ -57,7 +60,11 @@ def main():
 
     platform = jax.default_backend()
     default_n = 1 << 20 if platform != "cpu" else 1 << 18
-    n = int(os.environ.get("THRILL_TPU_BENCH_N", default_n))
+    n = int(os.environ.get("THRILL_TPU_BENCH_N", default_n) or default_n)
+    if n < 1024:
+        import sys
+        print(f"bench: clamping n={n} to 1024 (minimum)", file=sys.stderr)
+        n = 1024
 
     rng = np.random.default_rng(0)
     recs = {

@@ -1,8 +1,8 @@
 """Repo-root sys.path + platform forcing for direct CLI runs.
 
-Also makes the standard JAX_PLATFORMS env var effective: some device
-plugins (axon) ignore the env var unless the config is set before
-first jax use, so `JAX_PLATFORMS=cpu python examples/x.py` works.
+Makes `JAX_PLATFORMS=cpu python examples/x.py` work on this image (the
+axon plugin otherwise ignores the env var / can hang; see
+thrill_tpu.common.platform).
 """
 
 import os
@@ -12,18 +12,6 @@ _root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _root not in sys.path:
     sys.path.insert(0, _root)
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # only intervene for an explicit CPU request: this image exports
-    # JAX_PLATFORMS=axon globally, and re-applying that here would
-    # clobber a harness (conftest) that already forced CPU
-    import jax
+from thrill_tpu.common.platform import maybe_force_cpu_from_env
 
-    jax.config.update("jax_platforms", "cpu")
-    # unregister accelerator plugins entirely: on this image the axon
-    # plugin can hang PJRT client init even when the platform list
-    # excludes it, and plugin discovery at first backends() would
-    # re-register and re-force jax_platforms
-    from jax._src import xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
-    _xb.discover_pjrt_plugins = lambda: None
+maybe_force_cpu_from_env()

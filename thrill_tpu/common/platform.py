@@ -1,0 +1,31 @@
+"""Platform forcing helper for this image's axon-plugin quirks.
+
+The image exports ``JAX_PLATFORMS=axon`` globally and the axon plugin
+both ignores the env var for CPU selection and can hang PJRT client
+init when its tunnel is unhealthy. ``force_cpu_platform()`` makes an
+explicit CPU request robust; the private-API pieces are best-effort so
+a jax upgrade degrades to the plain config update instead of crashing.
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_platform() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+        # discovery at first backends() would re-register the plugin and
+        # re-force jax_platforms
+        _xb.discover_pjrt_plugins = lambda: None
+    except Exception:
+        pass
+
+
+def maybe_force_cpu_from_env() -> None:
+    """Apply force_cpu_platform iff the user explicitly asked for CPU."""
+    import os
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        force_cpu_platform()
