@@ -17,17 +17,6 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
     os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
 
-import jax
+from thrill_tpu.common.platform import force_cpu_platform
 
-jax.config.update("jax_platforms", "cpu")
-
-from jax._src import xla_bridge as _xb
-
-# pop ONLY axon: removing builtin platforms (tpu) breaks Pallas's MLIR
-# platform registry, which mirrors the factory table
-_xb._backend_factories.pop("axon", None)
-
-# PJRT plugin discovery at first backends() re-registers the axon plugin
-# AND re-sets jax_platforms='axon,cpu' (its entry-point initialize), which
-# would undo the forcing above mid-suite — disable discovery outright
-_xb.discover_pjrt_plugins = lambda: None
+force_cpu_platform()

@@ -58,20 +58,84 @@ class SortNode(DIABase):
         return _device_sample_sort(shards, self.key_fn,
                                    (self.key_fn,))
 
+    # above this many items the host path sorts external-memory style:
+    # sorted runs spilled to Files, k-way merged (reference:
+    # SortAndWriteToFile + PartialMultiwayMerge, api/sort.hpp:665-699,
+    # 216-271). Overridable for tests via THRILL_TPU_HOST_SORT_RUN.
+    HOST_RUN_SIZE = 1 << 20
+
     def _compute_host(self, shards: HostShards):
         import functools
+        import os
         W = shards.num_workers
-        items = [it for l in shards.lists for it in l]
         if self.compare_fn is not None:
-            items.sort(key=functools.cmp_to_key(
+            sort_key = functools.cmp_to_key(
                 lambda a, b: -1 if self.compare_fn(a, b)
-                else (1 if self.compare_fn(b, a) else 0)))
+                else (1 if self.compare_fn(b, a) else 0))
         else:
-            items.sort(key=self.key_fn)
-        n = len(items)
+            sort_key = self.key_fn
+
+        run_size = int(os.environ.get("THRILL_TPU_HOST_SORT_RUN") or
+                       self.HOST_RUN_SIZE)
+        run_size = max(run_size, 16)
+        n = shards.total
+        if n <= run_size:
+            items = [it for l in shards.lists for it in l]
+            items.sort(key=sort_key)
+        else:
+            try:
+                items = self._em_sort(shards, sort_key, run_size)
+            except (TypeError, ValueError, AttributeError):
+                # unpicklable items cannot spill; fall back in-memory
+                items = [it for l in shards.lists for it in l]
+                items.sort(key=sort_key)
         bounds = [(w * n) // W for w in range(W + 1)]
         return HostShards(W, [items[bounds[w]:bounds[w + 1]]
                               for w in range(W)])
+
+    def _em_sort(self, shards: HostShards, sort_key, run_size: int):
+        """External-memory sort: spill sorted runs, k-way merge them.
+
+        When this node owns the input exclusively (the consuming pull
+        disposed the parent), shard lists are released as they spill so
+        the spilled copy replaces — not duplicates — the resident items.
+        """
+        from ...data.block_pool import BlockPool
+        from ...core.multiway_merge import multiway_merge_files
+
+        owns_input = self.parents[0].node.state == "DISPOSED"
+        pool = BlockPool(spill_dir=self.context.config.spill_dir,
+                         soft_limit=64 << 20)
+        files = []
+        run = []
+        try:
+            for lst in shards.lists:
+                for it in lst:
+                    run.append(it)
+                    if len(run) >= run_size:
+                        files.append(_spill_run(pool, run, sort_key))
+                        run = []
+                if owns_input:
+                    lst.clear()
+            if run:
+                files.append(_spill_run(pool, run, sort_key))
+            merged = list(multiway_merge_files(files, key=sort_key,
+                                               consume=True))
+        finally:
+            for f in files:
+                f.clear()
+            pool.close()
+        return merged
+
+
+def _spill_run(pool, run, sort_key):
+    from ...data.file import File
+    run.sort(key=sort_key)
+    f = File(pool=pool)
+    with f.writer() as w:
+        for it in run:
+            w.put(it)
+    return f
 
 
 def _device_sample_sort(shards: DeviceShards, key_fn: Callable,

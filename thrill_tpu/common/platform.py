@@ -20,8 +20,11 @@ def force_cpu_platform() -> None:
         # discovery at first backends() would re-register the plugin and
         # re-force jax_platforms
         _xb.discover_pjrt_plugins = lambda: None
-    except Exception:
-        pass
+    except Exception as e:  # private API drifted: warn, don't crash
+        import sys
+        print(f"thrill_tpu: CPU forcing is partial ({e!r}); if jax hangs "
+              f"at device init, the accelerator plugin is the cause",
+              file=sys.stderr)
 
 
 def maybe_force_cpu_from_env() -> None:
