@@ -262,6 +262,36 @@ def test_inner_join_device():
     sweep(job)
 
 
+def test_inner_join_all_ones_keys():
+    """Regression: keys encoding to all-ones words (uint64.max / int64
+    max patterns) must not collide with the padding sentinel and create
+    phantom pairs (ADVICE r1: join.py validity-word fix)."""
+    big = np.iinfo(np.int64).max
+
+    def job(ctx):
+        left = ctx.Distribute(np.array([1, 2, 3], dtype=np.int64)).Map(
+            lambda x: (x, x))
+        right = ctx.Distribute(np.array([2, big], dtype=np.int64)).Map(
+            lambda x: (x, x * 2))
+        j = InnerJoin(left, right,
+                      lambda kv: kv[0], lambda kv: kv[0],
+                      lambda l, r: (l[0], r[1]))
+        got = sorted((int(a), int(b)) for a, b in j.AllGather())
+        assert got == [(2, 4)]
+
+        # both sides containing the max key: must join max with max,
+        # exactly once per pair
+        l2 = ctx.Distribute(np.array([big, 5], dtype=np.int64)).Map(
+            lambda x: (x, 1))
+        r2 = ctx.Distribute(np.array([big], dtype=np.int64)).Map(
+            lambda x: (x, 2))
+        j2 = InnerJoin(l2, r2, lambda kv: kv[0], lambda kv: kv[0],
+                       lambda l, r: (l[0], l[1] + r[1]))
+        got2 = [(int(a), int(b)) for a, b in j2.AllGather()]
+        assert got2 == [(big, 3)]
+    sweep(job)
+
+
 def test_inner_join_host():
     def job(ctx):
         l = ctx.Distribute([("a", 1), ("b", 2), ("a", 3)], storage="host")

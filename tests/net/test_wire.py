@@ -1,0 +1,165 @@
+"""Wire codec + authentication tests (ADVICE r1: unauthenticated pickle
+RCE on the control-plane sockets)."""
+
+import pickle
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from thrill_tpu.net import wire
+from thrill_tpu.net.tcp import TcpConnection, construct_tcp_group
+
+
+def _roundtrip(obj, allow_pickle=False):
+    return wire.loads(wire.dumps(obj, allow_pickle), allow_pickle)
+
+
+def test_codec_roundtrip_common_types():
+    cases = [
+        None, True, False, 0, -1, 1 << 100, -(1 << 100), 3.5, float("inf"),
+        "héllo", b"\x00\xff", (1, "a", None), [1, [2, [3]]],
+        {"a": 1, (1, 2): [3.0]},
+    ]
+    for obj in cases:
+        assert _roundtrip(obj) == obj, obj
+
+
+def test_codec_roundtrip_numpy():
+    a = np.arange(24, dtype=np.int64).reshape(2, 3, 4)
+    b = _roundtrip(a)
+    assert b.dtype == a.dtype and np.array_equal(a, b)
+    s = _roundtrip(np.float32(2.5))
+    assert s == np.float32(2.5) and s.dtype == np.float32
+    assert _roundtrip(np.uint64(2**63 + 7)) == np.uint64(2**63 + 7)
+
+
+def test_codec_refuses_arbitrary_objects_unauthenticated():
+    class Thing:
+        pass
+
+    with pytest.raises(TypeError):
+        wire.dumps(Thing(), allow_pickle=False)
+    # and refuses to *decode* a pickle frame even if one is forged
+    payload = pickle.dumps(slice(1, 2))
+    forged = b"P" + len(payload).to_bytes(4, "little") + payload
+    with pytest.raises(ValueError):
+        wire.loads(forged, allow_pickle=False)
+
+
+def test_codec_pickle_when_authenticated():
+    obj = {"fn": slice(1, 2)}  # not a codec-native type
+    assert _roundtrip(obj, allow_pickle=True) == obj
+
+
+def test_mutual_auth_over_socketpair():
+    a, b = socket.socketpair()
+    ca, cb = TcpConnection(a), TcpConnection(b)
+    errs = []
+
+    def side(conn):
+        try:
+            conn.authenticate(b"sekrit", role="client")
+        except BaseException as e:
+            errs.append(e)
+
+    t = threading.Thread(target=side, args=(ca,), daemon=True)
+    t.start()
+    cb.authenticate(b"sekrit", role="server")
+    t.join(timeout=10)
+    assert not errs and ca.authenticated and cb.authenticated
+    ca.send({"x": slice(0, 3)})   # pickle path now allowed
+    assert cb.recv() == {"x": slice(0, 3)}
+    ca.close()
+    cb.close()
+
+
+def test_mutual_auth_rejects_wrong_secret():
+    a, b = socket.socketpair()
+    ca, cb = TcpConnection(a), TcpConnection(b)
+    errs = []
+
+    def side(conn, secret):
+        try:
+            conn.authenticate(secret, role="client")
+        except BaseException as e:
+            errs.append(e)
+
+    t = threading.Thread(target=side, args=(ca, b"right"), daemon=True)
+    t.start()
+    with pytest.raises((ConnectionError, OSError)):
+        cb.authenticate(b"wrong", role="server")
+        # if our side passed (ordering), the peer must have failed
+        t.join(timeout=10)
+        if errs:
+            raise errs[0]
+    ca.close()
+    cb.close()
+
+
+def test_mutual_auth_reflection_attack_fails():
+    """An attacker without the secret cannot authenticate by echoing the
+    server's own challenge back (role binding defeats reflection)."""
+    a, b = socket.socketpair()
+    server = TcpConnection(a)
+    errs = []
+
+    def attacker():
+        try:
+            # read the server's challenge, reflect it as our challenge
+            chal = b.recv(32)
+            b.sendall(chal)
+            # server now answers OUR challenge (== its own); replay it
+            answer = b.recv(32)
+            b.sendall(answer)
+        except BaseException as e:
+            errs.append(e)
+
+    t = threading.Thread(target=attacker, daemon=True)
+    t.start()
+    with pytest.raises(ConnectionError):
+        server.authenticate(b"sekrit", role="server")
+    t.join(timeout=10)
+    assert not server.authenticated
+    server.close()
+    b.close()
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def test_tcp_group_with_secret():
+    hosts = [("127.0.0.1", p) for p in _free_ports(3)]
+    results = [None] * 3
+    errors = [None] * 3
+
+    def target(r):
+        try:
+            g = construct_tcp_group(r, hosts, timeout=20,
+                                    secret=b"cluster-secret")
+            try:
+                results[r] = g.all_reduce(r + 1)
+            finally:
+                g.close()
+        except BaseException as e:
+            errors[r] = e
+
+    threads = [threading.Thread(target=target, args=(r,), daemon=True)
+               for r in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=40)
+    assert all(e is None for e in errors), errors
+    assert all(not t.is_alive() for t in threads)
+    assert results == [6, 6, 6]

@@ -56,8 +56,10 @@ def HyperLogLog(dia: DIA, precision: int = 14) -> float:
                 h = hashing.stable_host_hash(_hashable(it))
                 idx = h >> (64 - p)
                 rest = (h << p) & 0xFFFFFFFFFFFFFFFF
-                rho = 64 - p if rest == 0 else _clz64(rest) + 1
-                regs[idx] = max(regs[idx], min(rho, 64 - p))
+                # standard register range is [1, 64-p+1]: an all-zero
+                # suffix yields rho = 64-p+1 (ADVICE r1)
+                rho = 64 - p + 1 if rest == 0 else _clz64(rest) + 1
+                regs[idx] = max(regs[idx], min(rho, 64 - p + 1))
         return _estimate(regs, p)
 
     mex = shards.mesh_exec
@@ -74,8 +76,9 @@ def HyperLogLog(dia: DIA, precision: int = 14) -> float:
             h = hashing.hash_key_words(words)
             idx = (h >> jnp.uint64(64 - p)).astype(jnp.int32)
             rest = h << jnp.uint64(p)
-            rho = jnp.where(rest == 0, 64 - p, _clz_device(rest) + 1)
-            rho = jnp.minimum(rho, 64 - p).astype(jnp.int32)
+            # register range [1, 64-p+1]; rest==0 -> 64-p+1 (ADVICE r1)
+            rho = jnp.where(rest == 0, 64 - p + 1, _clz_device(rest) + 1)
+            rho = jnp.minimum(rho, 64 - p + 1).astype(jnp.int32)
             rho = jnp.where(valid, rho, 0)
             regs = jnp.zeros(m, jnp.int32).at[idx].max(rho)
             return lax.pmax(regs, AXIS)

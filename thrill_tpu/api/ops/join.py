@@ -215,14 +215,18 @@ def _run_bounds(lw, lvalid, rw, rvalid):
     side flag. With right sorting *after* equal left keys, a right item
     at combined position p has (p - #rights before) = #lefts with key
     <= its key = ``hi``; flipping the flag gives #lefts with key < its
-    key = ``lo``. Invalid items carry max-words so they sort last and
-    never perturb valid bounds.
+    key = ``lo``. Invalid items sort last via a prepended validity word
+    (not a key-word sentinel) and are excluded from the left counts, so
+    they never perturb valid bounds even for all-ones keys.
     """
     lcap = lw[0].shape[0]
     rcap = rw[0].shape[0]
-    maxw = jnp.uint64(0xFFFFFFFFFFFFFFFF)
-    lws = [jnp.where(lvalid, w, maxw) for w in lw]
-    rws = [jnp.where(rvalid, w, maxw) for w in rw]
+    # Validity is a *prepended sort word* (0 = valid, 1 = invalid), never
+    # an overwrite of the key words: the all-ones sentinel would collide
+    # with legitimate keys that encode to all-ones (uint64.max, all-0xFF
+    # byte keys) and produce phantom pairs against padding garbage.
+    valid_all = jnp.concatenate([lvalid, rvalid])
+    invalid_word = (~valid_all).astype(jnp.uint64)
 
     from ...core.device_sort import argsort_words
 
@@ -231,17 +235,18 @@ def _run_bounds(lw, lvalid, rw, rvalid):
             jnp.ones(lcap, jnp.uint64)
         side_r = jnp.ones(rcap, jnp.uint64) if right_after else \
             jnp.zeros(rcap, jnp.uint64)
-        words = [jnp.concatenate([a, b]) for a, b in zip(lws, rws)]
+        words = [jnp.concatenate([a, b]) for a, b in zip(lw, rw)]
         side = jnp.concatenate([side_l, side_r])
         ridx = jnp.concatenate([jnp.full(lcap, rcap, jnp.uint64),
                                 jnp.arange(rcap, dtype=jnp.uint64)])
-        perm = argsort_words(words + [side])
+        perm = argsort_words([invalid_word] + words + [side])
         side_s = jnp.take(side, perm)
         ridx_s = jnp.take(ridx, perm)
+        valid_s = jnp.take(valid_all, perm)
         is_right = side_s == (1 if right_after else 0)
         is_left = ~is_right
-        # lefts at positions <= p == lefts strictly before a right item
-        lefts_before = jnp.cumsum(is_left.astype(jnp.int64))
+        # valid lefts at positions <= p == valid lefts before a right item
+        lefts_before = jnp.cumsum((is_left & valid_s).astype(jnp.int64))
         # scatter back to right-item order
         out = jnp.zeros(rcap + 1, jnp.int64)
         tgt = jnp.where(is_right, ridx_s.astype(jnp.int64), rcap)

@@ -22,21 +22,30 @@ _LIB_FAILED = False
 
 
 def _load_native() -> Optional[ctypes.CDLL]:
+    """Build-from-source-only loader: the library path embeds the SHA256
+    of blockstore.cpp, so a stale or foreign binary (wrong hash name) is
+    never loaded — it is rebuilt from the reviewed source instead. No
+    prebuilt binaries are shipped in the repo (native/build/ is
+    gitignored)."""
     global _LIB, _LIB_FAILED
     with _LIB_LOCK:
         if _LIB is not None or _LIB_FAILED:
             return _LIB
         src = os.path.abspath(os.path.join(_NATIVE_DIR, "blockstore.cpp"))
-        out = os.path.abspath(os.path.join(_NATIVE_DIR, "build",
-                                           "libblockstore.so"))
         try:
-            if (not os.path.exists(out)
-                    or os.path.getmtime(out) < os.path.getmtime(src)):
+            import hashlib
+            with open(src, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            out = os.path.abspath(os.path.join(
+                _NATIVE_DIR, "build", f"libblockstore-{digest}.so"))
+            if not os.path.exists(out):
                 os.makedirs(os.path.dirname(out), exist_ok=True)
+                tmp = out + f".tmp.{os.getpid()}"
                 subprocess.run(
                     ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                     src, "-o", out],
+                     src, "-o", tmp],
                     check=True, capture_output=True, timeout=120)
+                os.replace(tmp, out)  # atomic vs concurrent builders
             lib = ctypes.CDLL(out)
         except (OSError, subprocess.SubprocessError):
             _LIB_FAILED = True

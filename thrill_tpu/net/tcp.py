@@ -9,36 +9,48 @@ small coordination values (size agreements, splitters, barriers) the
 way the reference's flow-control group does, and is what host-path
 operators use across machines.
 
-Wire format: 4-byte little-endian length + pickle payload per message.
+Wire format: 4-byte little-endian length + a non-executing typed codec
+(net/wire.py) per message — decoding never runs code. When a shared
+secret is configured (THRILL_TPU_SECRET), every connection runs a
+mutual HMAC-SHA256 challenge-response at bootstrap and, once
+authenticated, may additionally carry pickled payloads for exotic
+types; without a secret, pickle frames are refused in both directions.
 Bootstrap: rank j connects to every rank i < j (i listens); each side
-announces its rank. Retries cover staggered process starts.
+announces its rank (validated: in-range, not self, not yet taken).
+Retries cover staggered process starts.
 
 Env (reference: THRILL_RANK/THRILL_HOSTLIST, api/context.cpp:204-272):
-THRILL_TPU_RANK, THRILL_TPU_HOSTLIST="host0:port0 host1:port1 ...".
+THRILL_TPU_RANK, THRILL_TPU_HOSTLIST="host0:port0 host1:port1 ...",
+THRILL_TPU_SECRET=<shared cluster secret>.
 """
 
 from __future__ import annotations
 
 import os
-import pickle
 import socket
 import struct
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import wire
 from .group import Connection, Group
 
 
 class TcpConnection(Connection):
-    def __init__(self, sock: socket.socket) -> None:
+    def __init__(self, sock: socket.socket,
+                 authenticated: bool = False) -> None:
         self.sock = sock
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # e.g. AF_UNIX socketpair in tests
+        self.authenticated = authenticated
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()
 
     def send(self, obj: Any) -> None:
-        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        payload = wire.dumps(obj, allow_pickle=self.authenticated)
         msg = struct.pack("<I", len(payload)) + payload
         with self._send_lock:
             self.sock.sendall(msg)
@@ -47,7 +59,17 @@ class TcpConnection(Connection):
         with self._recv_lock:
             header = self._recv_exact(4)
             (size,) = struct.unpack("<I", header)
-            return pickle.loads(self._recv_exact(size))
+            return wire.loads(self._recv_exact(size),
+                              allow_pickle=self.authenticated)
+
+    def authenticate(self, secret: bytes, role: str) -> None:
+        """Mutual role-bound HMAC challenge-response; raises on
+        mismatch. ``role`` is "client" for the dialing side, "server"
+        for the accepting side."""
+        with self._send_lock, self._recv_lock:
+            wire.mutual_auth(secret, role, self.sock.sendall,
+                             self._recv_exact)
+        self.authenticated = True
 
     def _recv_exact(self, n: int) -> bytes:
         chunks = []
@@ -91,8 +113,14 @@ def parse_hostlist(s: str) -> List[Tuple[str, int]]:
 
 
 def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
-                        timeout: float = 30.0) -> TcpGroup:
-    """Full-mesh bootstrap: rank j dials every i < j; i accepts j..p-1."""
+                        timeout: float = 30.0,
+                        secret: Optional[bytes] = None) -> TcpGroup:
+    """Full-mesh bootstrap: rank j dials every i < j; i accepts j..p-1.
+
+    With ``secret`` every connection is mutually HMAC-authenticated
+    before the rank announcement is trusted (and pickled payloads are
+    enabled); without it the non-executing codec is the only format.
+    """
     p = len(hosts)
     if p == 1:
         return TcpGroup(0, 1, {})
@@ -109,12 +137,37 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
             srv.listen(p)
             srv.settimeout(timeout)
             expected = p - 1 - rank          # ranks > mine dial in
-            for _ in range(expected):
-                s, _ = srv.accept()
+            accepted = 0
+            accept_deadline = time.time() + timeout
+            while accepted < expected:
+                if time.time() > accept_deadline:
+                    raise TimeoutError(
+                        f"rank {rank}: bootstrap accept timed out")
+                s, addr = srv.accept()
+                # accepted sockets do NOT inherit the listener timeout;
+                # without one, a silent connection would park this
+                # thread in recv forever and wedge the whole bootstrap
+                s.settimeout(min(10.0, timeout))
                 conn = TcpConnection(s)
-                peer = conn.recv()           # rank announcement
-                with lock:
-                    conns[peer] = conn
+                try:
+                    if secret is not None:
+                        conn.authenticate(secret, role="server")
+                    peer = conn.recv()       # rank announcement
+                    with lock:
+                        if (type(peer) is not int or not rank < peer < p
+                                or peer in conns):
+                            raise ConnectionError(
+                                f"invalid rank announcement {peer!r}")
+                        conns[peer] = conn
+                except Exception as e:
+                    # reject the rogue/failed peer, keep accepting
+                    conn.close()
+                    import sys
+                    print(f"thrill_tpu.net.tcp: rank {rank} rejected "
+                          f"peer {addr}: {e}", file=sys.stderr)
+                    continue
+                s.settimeout(None)           # handshake done: blocking
+                accepted += 1
             srv.close()
         except BaseException as e:  # pragma: no cover
             errors.append(e)
@@ -127,11 +180,19 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
         while True:
             try:
                 s = socket.create_connection(hosts[peer], timeout=2.0)
+                s.settimeout(min(10.0, timeout))
                 conn = TcpConnection(s)
+                if secret is not None:
+                    conn.authenticate(secret, role="client")
                 conn.send(rank)
+                s.settimeout(None)           # handshake done: blocking
                 with lock:
                     conns[peer] = conn
                 break
+            except wire.AuthError:
+                # auth failure is definitive (secret mismatch), not a
+                # transient dial error — fail fast with the real cause
+                raise
             except OSError:
                 if time.time() > deadline:
                     raise TimeoutError(
@@ -154,4 +215,11 @@ def construct_from_env() -> Optional[TcpGroup]:
     if not hostlist:
         return None
     rank = int(os.environ.get("THRILL_TPU_RANK", "0"))
-    return construct_tcp_group(rank, parse_hostlist(hostlist))
+    secret = wire.secret_from_env()
+    if secret is None:
+        import sys
+        print("thrill_tpu.net.tcp: THRILL_TPU_SECRET unset — "
+              "connections are unauthenticated and restricted to the "
+              "non-executing wire codec", file=sys.stderr)
+    return construct_tcp_group(rank, parse_hostlist(hostlist),
+                               secret=secret)
