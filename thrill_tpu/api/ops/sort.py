@@ -6,17 +6,25 @@ classifies every item down a branchless splitter tree into per-worker
 stream writers (tie-break by global index for balance on equal keys,
 api/sort.hpp:487-502); receivers sort runs and multiway-merge.
 
-TPU-native design, three bulk-synchronous device programs:
- 1. sample:   local XLA sort + quantile sampling of (key words, global
-              index) pairs -> tiny host gather (the worker-0 splitter
-              step collapses to the single controller).
- 2. exchange: destination = lexicographic rank among splitters
+TPU-native design, bulk-synchronous device programs in which the
+payload is gathered exactly ONCE per phase and only (validity, key
+words, global index) flow through sort networks:
+ 1. keys:     local argsort of the key words + quantile sampling —
+              outputs the permutation, sorted words and samples, with
+              NO payload movement (the worker-0 splitter step collapses
+              to the single controller). W == 1 finishes here with a
+              single payload gather.
+ 2. classify: destination = lexicographic rank among splitters
               ((words, index) compare, so duplicate keys spread evenly
-              across workers exactly like the reference's tie-break),
-              then the padded all-to-all shuffle.
- 3. merge:    one local XLA sort of the received items (stable by
-              original index) — the analog of sort-runs + multiway
-              merge, executed as a single bitonic sort on-device.
+              across workers exactly like the reference's tie-break).
+              Items are already key-sorted, so destinations are
+              MONOTONE — destination grouping needs no second sort; the
+              same program gathers the payload once (by the phase-1
+              permutation) and the planned all-to-all ships it.
+ 3. merge:    one local sort of the received (words, index) pairs +
+              one payload gather — the analog of sort-runs + multiway
+              merge (received runs are rank-ordered and internally
+              sorted; the chunked engine exploits tile sortedness).
 
 The result is globally sorted across worker ranks and stable: equal
 keys keep their original global order, making Sort and SortStable one
@@ -30,11 +38,12 @@ from typing import Callable, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ...core import keys as keymod
-from ...core import segmented
 from ...data import exchange
 from ...data.shards import DeviceShards, HostShards
+from ...parallel.mesh import AXIS
 from ..dia import DIA
 from ..dia_base import DIABase
 
@@ -151,44 +160,85 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
     # global index offsets (host-known counts -> exclusive prefix)
     offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
 
-    # ---- phase 1: local sort + quantile samples ----------------------
-    key1 = ("sort_sample", token, cap, treedef,
+    # all shards full -> the validity sort word is statically dropped
+    # (one fewer sort operand; the common case after Distribute/Generate)
+    full = bool(np.all(shards.counts == cap))
+
+    if W == 1:
+        # single worker: one fused program — key-only argsort, then the
+        # single payload gather. No samples, no splitters, no exchange.
+        key1 = ("sort_w1", token, cap, full, treedef,
+                tuple((l.dtype, l.shape[2:]) for l in leaves))
+
+        def build_w1():
+            def f(counts_dev, *ls):
+                count = counts_dev[0, 0]
+                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+                words = keymod.encode_key_words(key_fn(tree))
+                iota = jnp.arange(cap, dtype=jnp.uint64)
+                from ...core.device_sort import argsort_words
+                if full:
+                    sort_words = list(words) + [iota]
+                else:
+                    valid = jnp.arange(cap) < count
+                    sort_words = ([(~valid).astype(jnp.uint64)]
+                                  + list(words) + [iota])
+                perm = argsort_words(sort_words)
+                return tuple(jnp.take(l[0], perm, axis=0)[None]
+                             for l in ls)
+
+            return mex.smap(f, 1 + len(leaves))
+
+        f1 = mex.cached(key1, build_w1)
+        out1 = f1(shards.counts_device(), *leaves)
+        tree = jax.tree.unflatten(treedef, list(out1))
+        return DeviceShards(mex, tree, shards.counts.copy())
+
+    # ---- phase 1: key-only local argsort + quantile samples ----------
+    # No payload touches the sort network: only (validity, key words,
+    # global index) are sorted; the permutation is carried forward and
+    # the payload is gathered once, later, per phase.
+    key1 = ("sort_keys", token, cap, full, treedef,
             tuple((l.dtype, l.shape[2:]) for l in leaves))
     holder = {}
 
     def build1():
         def f(counts_dev, offset_dev, *ls):
             count = counts_dev[0, 0]
-            valid = jnp.arange(cap) < count
             tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
             gidx = offset_dev[0, 0] + jnp.arange(cap, dtype=jnp.int64)
             words = keymod.encode_key_words(key_fn(tree))
             holder["nwords"] = len(words)
-            words, tree, valid, extra = segmented.sort_by_key_words(
-                words, tree, valid, [gidx.astype(jnp.uint64)])
-            gidx_sorted = extra[0]
-            # quantile positions over the valid prefix
+            from ...core.device_sort import argsort_words
+            if full:
+                sort_words = list(words) + [gidx.astype(jnp.uint64)]
+            else:
+                valid = jnp.arange(cap) < count
+                sort_words = ([(~valid).astype(jnp.uint64)]
+                              + list(words) + [gidx.astype(jnp.uint64)])
+            perm = argsort_words(sort_words)
+            words_s = [jnp.take(w, perm) for w in words]
+            gidx_s = jnp.take(gidx, perm)
+            # quantile positions over the valid prefix (sorted: valid
+            # items occupy [0, count))
             count_f = jnp.maximum(count, 1)
             qpos = ((jnp.arange(OVERSAMPLE, dtype=jnp.int64) * 2 + 1)
                     * count_f // (2 * OVERSAMPLE))
             qpos = jnp.clip(qpos, 0, cap - 1)
             sample_words = jnp.stack(
-                [jnp.take(w, qpos) for w in words], axis=1)  # [S, nw]
-            sample_idx = jnp.take(gidx_sorted, qpos)         # [S]
+                [jnp.take(w, qpos) for w in words_s], axis=1)  # [S, nw]
+            sample_idx = jnp.take(gidx_s, qpos)                # [S]
             sample_valid = qpos < count
-            out_leaves = jax.tree.leaves(tree)
-            return (jnp.stack(words, 1)[None],
-                    gidx_sorted[None],
-                    sample_words[None], sample_idx[None], sample_valid[None],
-                    *[l[None] for l in out_leaves])
+            return (jnp.stack(words_s, 1)[None], gidx_s[None],
+                    perm[None], sample_words[None], sample_idx[None],
+                    sample_valid[None])
 
         return mex.smap(f, 2 + len(leaves)), holder
 
     f1, h1 = mex.cached(key1, build1)
     out1 = f1(shards.counts_device(),
               mex.put(offsets.astype(np.int64)[:, None]), *leaves)
-    words_mat, gidx_s, s_words, s_idx, s_valid = out1[:5]
-    sorted_leaves = list(out1[5:])
+    words_mat, gidx_s, perm_dev, s_words, s_idx, s_valid = out1
     nwords = h1["nwords"]
 
     # ---- host: choose splitters (the "worker 0" step) ----------------
@@ -199,41 +249,65 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
                for i in range(len(sv)) if sv[i]]
     samples.sort()
     splitters = np.zeros((max(W - 1, 1), nwords + 1), dtype=np.uint64)
-    if samples and W > 1:
+    if samples:
         for j in range(1, W):
             s = samples[min(len(samples) - 1, (j * len(samples)) // W)]
             splitters[j - 1, :nwords] = np.array(s[0], dtype=np.uint64)
             splitters[j - 1, nwords] = np.uint64(s[1])
 
-    if W == 1:
-        tree = jax.tree.unflatten(treedef, sorted_leaves)
-        return DeviceShards(mex, tree, shards.counts.copy())
+    # ---- phase 2: classify on sorted keys + single payload gather ----
+    # Items are key-sorted, so destinations (rank among splitters) are
+    # monotone: no destination sort is needed — this replaces the
+    # generic exchange's phase-A argsort entirely. Splitters are a
+    # RUNTIME operand (replicated like the send-count matrix), never
+    # baked into the cached executable.
+    key2 = ("sort_classify", token, W, cap, nwords, treedef,
+            tuple((l.dtype, l.shape[2:]) for l in leaves))
 
-    # ---- phase 2: classify + exchange --------------------------------
-    # destination = number of splitters strictly below (words, gidx)
-    spl = jnp.asarray(splitters)  # [W-1, nwords+1]
+    def build2():
+        def f(spl_a, words_a, gidx_a, perm_a, counts_dev, *ls):
+            spl = spl_a[0]                        # [W-1, nwords+1]
+            wm = words_a[0]                       # [cap, nwords] sorted
+            gi = gidx_a[0]
+            p = perm_a[0]
+            count = counts_dev[0, 0]
+            valid = jnp.arange(cap) < count       # sorted: valid first
+            d = jnp.zeros(cap, dtype=jnp.int32)
+            for j in range(W - 1):
+                gt = _lex_greater(wm, gi.astype(jnp.uint64), spl[j])
+                d = d + gt.astype(jnp.int32)
+            dest = jnp.where(valid, d, W)
+            all_send = exchange.send_counts(dest, W)
+            # the ONE payload gather of this phase
+            sorted_ls = [jnp.take(l[0], p, axis=0) for l in ls]
+            return (dest[None], all_send,
+                    *[sl[None] for sl in sorted_ls])
 
-    sorted_tree_full = {
+        from jax.sharding import PartitionSpec as P
+        return mex.smap(f, 5 + len(leaves),
+                        out_specs=(P(AXIS), P()) + (P(AXIS),) * len(leaves))
+
+    f2 = mex.cached(key2, build2)
+    spl_dev = mex.put(np.broadcast_to(
+        splitters, (W,) + splitters.shape).copy())
+    out2 = f2(spl_dev, words_mat, gidx_s, perm_dev,
+              shards.counts_device(), *leaves)
+    sorted_dest, send_mat = out2[0], out2[1]
+    sorted_payload = list(out2[2:])
+    S = np.asarray(send_mat)
+
+    # carrier = words + gidx (already sorted, no gather needed) + payload
+    carrier_tree = {
         "__words": words_mat, "__gidx": gidx_s,
-        "tree": jax.tree.unflatten(treedef, sorted_leaves),
+        "tree": jax.tree.unflatten(treedef, sorted_payload),
     }
-    carrier = DeviceShards(mex, sorted_tree_full, shards.counts.copy())
+    carrier_leaves, treedef3 = jax.tree.flatten(carrier_tree)
+    carrier = exchange.exchange_presorted(mex, treedef3, sorted_dest,
+                                          carrier_leaves, S)
 
-    def dest(tree, mask, widx):
-        wm = tree["__words"]            # [cap, nwords]
-        gi = tree["__gidx"].astype(jnp.uint64)
-        d = jnp.zeros(wm.shape[0], dtype=jnp.int32)
-        for j in range(W - 1):
-            gt = _lex_greater(wm, gi, spl[j])
-            d = d + gt.astype(jnp.int32)
-        return d
-
-    carrier = exchange.exchange(carrier, dest,
-                                ("sort_dest", token, W, cap))
-
-    # ---- phase 3: final local merge (stable by global index) ---------
+    # ---- phase 3: merge received runs (keys-only sort + one gather) --
     cap3 = carrier.cap
-    leaves3, treedef3 = jax.tree.flatten(carrier.tree)
+    leaves3, _ = jax.tree.flatten(carrier.tree)
     key3 = ("sort_final", token, cap3, treedef3,
             tuple((l.dtype, l.shape[2:]) for l in leaves3))
 
@@ -245,9 +319,13 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
             wm = tree["__words"]
             gi = tree["__gidx"]
             words = [wm[:, i] for i in range(nwords)]
-            words, t_sorted, valid, extra = segmented.sort_by_key_words(
-                words, tree["tree"], valid, [gi.astype(jnp.uint64)])
-            out_leaves = jax.tree.leaves(t_sorted)
+            from ...core.device_sort import argsort_words
+            invalid_word = (~valid).astype(jnp.uint64)
+            perm = argsort_words([invalid_word] + words
+                                 + [gi.astype(jnp.uint64)])
+            # the ONE payload gather of this phase
+            out_leaves = [jnp.take(l, perm, axis=0)
+                          for l in jax.tree.leaves(tree["tree"])]
             return tuple(l[None] for l in out_leaves)
 
         return mex.smap(f, 1 + len(leaves3))

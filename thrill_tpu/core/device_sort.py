@@ -1,23 +1,28 @@
-"""Device sort engine: XLA sort or explicit bitonic network.
+"""Device sort engine: XLA sort, chunked sort+merge, or bitonic network.
 
 The DIA operators sort through one entry point, ``argsort_words``
-(stable argsort by a list of uint64 key words). Two interchangeable
+(stable argsort by a list of uint64 key words). Three interchangeable
 implementations:
 
 * ``xla``     — ``lax.sort`` multi-operand (fastest where the XLA sort
                 lowering is healthy; always used on CPU).
-* ``bitonic`` — an explicit bitonic network driven by ``lax.fori_loop``:
-                k(k+1)/2 compare-exchange substages of pure elementwise
-                gathers/selects. Compiles to a tiny program regardless
-                of n, which matters on TPU toolchains whose sort
-                lowering degrades at large row counts (observed: the
-                axon single-chip backend stalls compiling sorts beyond
-                ~64K rows). Requires n to be a power of two — DIA shard
-                capacities already are.
+* ``chunked`` — batched ``lax.sort`` over 64K-row tiles (each tile
+                stays below the TPU sort-lowering compile cliff), then
+                a bitonic *merge* tree over the sorted tiles. Every
+                merge substage is a reshape-based compare-exchange —
+                pure slicing/selects at static strides, NO random
+                gathers — so it is both MXU/VPU friendly and cheap to
+                compile: O(log C · log n) elementwise substages versus
+                the full network's O(log² n) gather substages.
+* ``bitonic`` — the explicit full bitonic network driven by
+                ``lax.fori_loop`` (kept as a fallback: tiny program
+                regardless of n, but O(n log² n) gathers at runtime).
 
-Selection: THRILL_TPU_SORT_IMPL = auto (default) | xla | bitonic.
-``auto`` uses xla on CPU backends and for small n, bitonic on
-accelerators above the threshold.
+Selection: THRILL_TPU_SORT_IMPL = auto (default) | xla | chunked |
+bitonic. ``auto`` uses xla on CPU backends and for small n, chunked on
+accelerators above the threshold (observed on the axon single-chip
+backend: plain sort compiles stall beyond ~64K rows; batched 64K tiles
+compile fine).
 """
 
 from __future__ import annotations
@@ -30,28 +35,103 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-# above this row count, accelerator backends switch to bitonic in auto
+# above this row count, accelerator backends switch engines in auto
 XLA_SORT_MAX_N = 1 << 16
 
 
 def _impl(n: int) -> str:
     mode = os.environ.get("THRILL_TPU_SORT_IMPL", "auto")
-    if mode in ("xla", "bitonic"):
+    if mode in ("xla", "bitonic", "chunked"):
         return mode
     if jax.default_backend() == "cpu" or n <= XLA_SORT_MAX_N:
         return "xla"
-    return "bitonic"
+    return "chunked"
 
 
 def argsort_words(words: List[jnp.ndarray]) -> jnp.ndarray:
     """Stable argsort by uint64 key words (lexicographic). [n] int32."""
     n = words[0].shape[0]
-    if _impl(n) == "xla":
+    impl = _impl(n)
+    if impl == "xla":
         iota = jnp.arange(n, dtype=jnp.uint64)
         res = lax.sort(tuple(words) + (iota,), dimension=0,
                        num_keys=len(words), is_stable=True)
         return res[-1].astype(jnp.int32)
+    if impl == "chunked":
+        return _chunked_argsort(words)
     return _bitonic_argsort(words)
+
+
+def _lex_gt(a_words, b_words):
+    """Elementwise lexicographic a > b over parallel word lists."""
+    gt = jnp.zeros(a_words[0].shape, bool)
+    eq = jnp.ones(a_words[0].shape, bool)
+    for a, b in zip(a_words, b_words):
+        gt = gt | (eq & (a > b))
+        eq = eq & (a == b)
+    return gt
+
+
+def _compare_exchange(arrs, d: int):
+    """Min-first compare-exchange at distance ``d`` on [C, L] arrays.
+
+    Within each 2d-block, position i is compared with i+d and the smaller
+    tuple kept first — expressed as reshape + slice + select (static
+    strides), never as a gather.
+    """
+    C, L = arrs[0].shape
+    resh = [a.reshape(C, L // (2 * d), 2, d) for a in arrs]
+    a_side = [r[:, :, 0, :] for r in resh]
+    b_side = [r[:, :, 1, :] for r in resh]
+    gt = _lex_gt(a_side, b_side)
+    out = []
+    for x, y in zip(a_side, b_side):
+        lo = jnp.where(gt, y, x)
+        hi = jnp.where(gt, x, y)
+        out.append(jnp.stack([lo, hi], axis=2).reshape(C, L))
+    return out
+
+
+def _chunked_argsort(words: List[jnp.ndarray],
+                     chunk: int = XLA_SORT_MAX_N) -> jnp.ndarray:
+    """Sorted 64K tiles + bitonic merge tree; [n] int32 permutation.
+
+    Stability comes from carrying the original index as the final key
+    word (total order), not from the network itself. Pads (max words,
+    index >= n) sort last within their tile and stay last through every
+    merge, so perm[:n] is exactly the sorted real items.
+    """
+    n_real = words[0].shape[0]
+    if n_real == 1:
+        return jnp.zeros(1, jnp.int32)
+    n = 1 << (n_real - 1).bit_length()
+    c = min(chunk, n)
+    pad = n - n_real
+    maxw = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    iota = jnp.arange(n, dtype=jnp.uint64)
+    arrs = [jnp.concatenate([w.astype(jnp.uint64),
+                             jnp.full(pad, maxw, jnp.uint64)])
+            if pad else w.astype(jnp.uint64) for w in words] + [iota]
+
+    C = n // c
+    arrs = [a.reshape(C, c) for a in arrs]
+    # base case: batched sort of every tile (compiles like one 64K sort)
+    arrs = list(lax.sort(tuple(arrs), dimension=1, num_keys=len(arrs),
+                         is_stable=False))
+    L = c
+    while C > 1:
+        # pair neighbouring runs: ascending ++ descending is bitonic
+        paired = [a.reshape(C // 2, 2, L) for a in arrs]
+        arrs = [jnp.concatenate(
+                    [p[:, 0, :], jnp.flip(p[:, 1, :], axis=1)], axis=1)
+                for p in paired]
+        C //= 2
+        L *= 2
+        d = L // 2
+        while d >= 1:
+            arrs = _compare_exchange(arrs, d)
+            d //= 2
+    return arrs[-1].reshape(-1)[:n_real].astype(jnp.int32)
 
 
 def _bitonic_argsort(words: List[jnp.ndarray]) -> jnp.ndarray:

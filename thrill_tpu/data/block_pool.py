@@ -46,6 +46,17 @@ def _load_native() -> Optional[ctypes.CDLL]:
                      src, "-o", tmp],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, out)  # atomic vs concurrent builders
+                # GC stale hash-named builds from earlier source versions
+                for name in os.listdir(os.path.dirname(out)):
+                    if (name.startswith("libblockstore-")
+                            and name.endswith(".so")
+                            and os.path.join(os.path.dirname(out), name)
+                            != out):
+                        try:
+                            os.unlink(os.path.join(
+                                os.path.dirname(out), name))
+                        except OSError:
+                            pass
             lib = ctypes.CDLL(out)
         except (OSError, subprocess.SubprocessError):
             _LIB_FAILED = True

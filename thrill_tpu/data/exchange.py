@@ -45,6 +45,32 @@ def _ex_cumsum(x):
     return jnp.cumsum(x) - x
 
 
+def send_counts(dest: jnp.ndarray, W: int) -> jnp.ndarray:
+    """Traced helper (inside shard_map): per-destination send histogram,
+    all-gathered into the replicated [W, W] matrix every worker needs
+    for the host planning step. ``dest`` uses W for invalid items."""
+    from ..core.pallas_kernels import partition_histogram
+    send = partition_histogram(dest, W)
+    return lax.all_gather(send, AXIS)
+
+
+def exchange_presorted(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
+                       S: np.ndarray, min_cap: int = 1) -> DeviceShards:
+    """Ship items that are ALREADY grouped by destination.
+
+    Public entry for operators whose upstream order makes destinations
+    monotone (Sort: items are key-sorted, so splitter rank never
+    decreases) — they skip the generic phase-A destination sort
+    entirely. Contract: ``sorted_dest`` is [W, cap] int32 with each
+    worker's valid items contiguous per destination in rank order
+    (monotone suffices) and W marking invalid slots; ``sorted_leaves``
+    are [W, cap, ...] in that same order; ``S[w, d]`` counts w's items
+    bound for d (as produced by ``send_counts``).
+    """
+    return _exchange_planned(mex, treedef, sorted_dest, sorted_leaves, S,
+                             min_cap=min_cap)
+
+
 def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
              min_cap: int = 1) -> DeviceShards:
     """Move every valid item to the worker computed by ``dest_builder``.
@@ -74,11 +100,9 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
             perm = argsort_words([dest.astype(jnp.uint64)])
             sorted_dest = jnp.take(dest, perm)
             sorted_ls = [jnp.take(l[0], perm, axis=0) for l in ls]
-            from ..core.pallas_kernels import partition_histogram
-            send = partition_histogram(sorted_dest, W)
             # replicate the [W, W] send-count matrix: every process can
             # then fetch it locally (multi-controller safe host step)
-            all_send = lax.all_gather(send, AXIS)
+            all_send = send_counts(sorted_dest, W)
             return (sorted_dest[None], all_send,
                     *[sl[None] for sl in sorted_ls])
 
@@ -89,10 +113,10 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
 
     fa = mex.cached(key_a, build_a)
     out_a = fa(shards.counts_device(), *leaves)
-    sorted_dest, send_counts = out_a[0], out_a[1]
+    sorted_dest, send_mat = out_a[0], out_a[1]
     sorted_leaves = list(out_a[2:])
 
-    S = np.asarray(send_counts)                   # [W, W] S[w, d]
+    S = np.asarray(send_mat)                      # [W, W] S[w, d]
     return _exchange_planned(mex, treedef, sorted_dest, sorted_leaves, S,
                              min_cap=min_cap)
 

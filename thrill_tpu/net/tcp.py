@@ -38,14 +38,18 @@ from .group import Connection, Group
 
 
 class TcpConnection(Connection):
-    def __init__(self, sock: socket.socket,
-                 authenticated: bool = False) -> None:
+    def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
         try:
             self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass  # e.g. AF_UNIX socketpair in tests
-        self.authenticated = authenticated
+        self.authenticated = False
+        self._session_key: Optional[bytes] = None
+        self._send_dir = b""
+        self._recv_dir = b""
+        self._send_seq = 0
+        self._recv_seq = 0
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()
 
@@ -53,22 +57,40 @@ class TcpConnection(Connection):
         payload = wire.dumps(obj, allow_pickle=self.authenticated)
         msg = struct.pack("<I", len(payload)) + payload
         with self._send_lock:
+            if self._session_key is not None:
+                # per-frame MAC: the handshake alone does not protect
+                # the stream from on-path frame injection
+                msg += wire.frame_mac(self._session_key, self._send_dir,
+                                      self._send_seq, payload)
+                self._send_seq += 1
             self.sock.sendall(msg)
 
     def recv(self) -> Any:
         with self._recv_lock:
             header = self._recv_exact(4)
             (size,) = struct.unpack("<I", header)
-            return wire.loads(self._recv_exact(size),
-                              allow_pickle=self.authenticated)
+            payload = self._recv_exact(size)
+            if self._session_key is not None:
+                mac = self._recv_exact(wire._MAC_LEN)
+                want = wire.frame_mac(self._session_key, self._recv_dir,
+                                      self._recv_seq, payload)
+                import hmac as _hmac
+                if not _hmac.compare_digest(mac, want):
+                    raise wire.AuthError("wire: frame MAC mismatch")
+                self._recv_seq += 1
+            return wire.loads(payload, allow_pickle=self.authenticated)
 
     def authenticate(self, secret: bytes, role: str) -> None:
         """Mutual role-bound HMAC challenge-response; raises on
         mismatch. ``role`` is "client" for the dialing side, "server"
-        for the accepting side."""
+        for the accepting side. On success every subsequent frame is
+        MACed under the derived session key."""
         with self._send_lock, self._recv_lock:
-            wire.mutual_auth(secret, role, self.sock.sendall,
-                             self._recv_exact)
+            key = wire.mutual_auth(secret, role, self.sock.sendall,
+                                   self._recv_exact)
+            self._session_key = key
+            self._send_dir = b"c>" if role == "client" else b"s>"
+            self._recv_dir = b"s>" if role == "client" else b"c>"
         self.authenticated = True
 
     def _recv_exact(self, n: int) -> bytes:
@@ -102,6 +124,21 @@ class TcpGroup(Group):
     def close(self) -> None:
         for c in self._conns.values():
             c.close()
+
+
+def _exchange_auth_flag(conn: TcpConnection, have_secret: bool) -> None:
+    """1-byte preamble so an asymmetric THRILL_TPU_SECRET configuration
+    fails fast with the real cause instead of a generic bootstrap
+    timeout (one side waiting for a challenge that never comes)."""
+    conn.sock.sendall(b"\x01" if have_secret else b"\x00")
+    peer = conn._recv_exact(1)
+    if peer not in (b"\x00", b"\x01"):
+        raise ConnectionError(f"tcp: bad auth preamble {peer!r}")
+    if (peer == b"\x01") != have_secret:
+        raise wire.AuthError(
+            "tcp: THRILL_TPU_SECRET is configured on one side of the "
+            "connection but not the other — set the same secret on "
+            "every host (or on none)")
 
 
 def parse_hostlist(s: str) -> List[Tuple[str, int]]:
@@ -150,6 +187,7 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
                 s.settimeout(min(10.0, timeout))
                 conn = TcpConnection(s)
                 try:
+                    _exchange_auth_flag(conn, secret is not None)
                     if secret is not None:
                         conn.authenticate(secret, role="server")
                     peer = conn.recv()       # rank announcement
@@ -182,6 +220,7 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
                 s = socket.create_connection(hosts[peer], timeout=2.0)
                 s.settimeout(min(10.0, timeout))
                 conn = TcpConnection(s)
+                _exchange_auth_flag(conn, secret is not None)
                 if secret is not None:
                     conn.authenticate(secret, role="client")
                 conn.send(rank)

@@ -173,12 +173,15 @@ def _decode(r: _Reader, allow_pickle: bool, depth: int) -> Any:
             raise ValueError("wire: object dtype refused")
         ndim = r.take_len()
         shape = tuple(r.take_len() for _ in range(ndim))
-        return np.frombuffer(r.take_bytes(), dtype=dtype).reshape(shape)
+        # .copy(): frombuffer views are read-only; receivers expect
+        # writable arrays (parity with the former pickle format)
+        return np.frombuffer(r.take_bytes(),
+                             dtype=dtype).reshape(shape).copy()
     if tag == _T_NPSCALAR:
         dtype = np.dtype(r.take_bytes().decode())
         if dtype.hasobject:
             raise ValueError("wire: object dtype refused")
-        return np.frombuffer(r.take_bytes(), dtype=dtype)[0]
+        return np.frombuffer(r.take_bytes(), dtype=dtype).copy()[0]
     if tag == _T_PICKLE:
         if not allow_pickle:
             raise ValueError(
@@ -216,7 +219,7 @@ def _answer(secret: bytes, role: bytes, challenge: bytes) -> bytes:
 
 def mutual_auth(secret: bytes, role: str,
                 send_raw: Callable[[bytes], None],
-                recv_raw: Callable[[int], bytes]) -> None:
+                recv_raw: Callable[[int], bytes]) -> bytes:
     """Run a mutual challenge-response over raw framed I/O.
 
     Both sides issue a random challenge and verify the peer's HMAC
@@ -226,6 +229,11 @@ def mutual_auth(secret: bytes, role: str,
     to the wrong role and fails verification (no reflection attack).
     ``send_raw`` writes a fixed-size blob, ``recv_raw(n)`` reads
     exactly n bytes.
+
+    Returns the derived per-connection *session key* — the handshake
+    only proves who is at each end; every subsequent frame must carry a
+    MAC under this key (``frame_mac``) or an on-path attacker could
+    inject a pickle frame into the authenticated stream.
     """
     if role not in ("client", "server"):
         raise ValueError(f"wire: bad auth role {role!r}")
@@ -241,3 +249,20 @@ def mutual_auth(secret: bytes, role: str,
     if not hmac.compare_digest(
             peer_answer, _answer(secret, peer_role, my_challenge)):
         raise AuthError("wire: HMAC authentication failed")
+    client_chal = my_challenge if role == "client" else peer_challenge
+    server_chal = peer_challenge if role == "client" else my_challenge
+    return hmac.new(secret, b"session:" + client_chal + server_chal,
+                    "sha256").digest()
+
+
+_MAC_LEN = 16
+
+
+def frame_mac(session_key: bytes, direction: bytes, seq: int,
+              payload: bytes) -> bytes:
+    """Per-frame MAC: binds session key, direction and sequence number
+    (anti-injection + anti-replay + anti-reorder)."""
+    h = hmac.new(session_key, direction + seq.to_bytes(8, "little"),
+                 "sha256")
+    h.update(payload)
+    return h.digest()[:_MAC_LEN]
