@@ -52,3 +52,43 @@ def test_pipeline_on_bitonic_engine(monkeypatch):
             want[v % 7] = want.get(v % 7, 0) + 1
         assert got == want
     RunLocalMock(job, 4)
+
+
+@pytest.mark.parametrize("n", [1, 2, 64, 1024, 5000])
+@pytest.mark.parametrize("nwords", [1, 2, 3])
+def test_chunked_matches_xla(monkeypatch, n, nwords):
+    rng = np.random.default_rng(n * 31 + nwords)
+    words = [jnp.asarray(rng.integers(0, max(n // 4, 2), n).astype(np.uint64))
+             for _ in range(nwords)]
+
+    monkeypatch.setenv("THRILL_TPU_SORT_IMPL", "xla")
+    perm_xla = np.asarray(jax.jit(device_sort.argsort_words)(words))
+    # small chunk forces several merge-tree levels even at modest n
+    perm_ch = np.asarray(jax.jit(
+        lambda ws: device_sort._chunked_argsort(ws, chunk=256))(words))
+    # with the iota tiebreak the stable permutation is unique
+    assert np.array_equal(perm_xla, perm_ch)
+
+
+def test_chunked_all_ones_and_presorted():
+    """Padding sentinel (max words) must not displace real max-valued
+    keys, and already-sorted input must round-trip."""
+    maxu = np.uint64(0xFFFFFFFFFFFFFFFF)
+    w = jnp.asarray(np.array([maxu, 3, maxu, 1, 2], dtype=np.uint64))
+    perm = np.asarray(device_sort._chunked_argsort([w], chunk=2))
+    assert perm.tolist() == [3, 4, 1, 0, 2]  # stable among the two maxu
+    srt = jnp.asarray(np.arange(1000, dtype=np.uint64))
+    perm2 = np.asarray(device_sort._chunked_argsort([srt], chunk=64))
+    assert perm2.tolist() == list(range(1000))
+
+
+def test_pipeline_on_chunked_engine(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_SORT_IMPL", "chunked")
+    from thrill_tpu.api import RunLocalMock
+
+    def job(ctx):
+        rng = np.random.default_rng(2)
+        vals = rng.integers(0, 500, 3000).astype(np.int64)
+        assert [int(x) for x in ctx.Distribute(vals).Sort().AllGather()] \
+            == sorted(vals.tolist())
+    RunLocalMock(job, 4)

@@ -49,6 +49,8 @@ class Context:
             default_log_path(self.config.log_path, host_rank=host_rank),
             program="thrill_tpu", workers=self.num_workers)
         self.mem = MemoryManager(name="context")
+        from ..mem.hbm import HbmGovernor
+        self.hbm = HbmGovernor(self, limit=self.config.hbm_limit)
         self.rng = np.random.default_rng(seed)
         self._nodes: List[Any] = []
         self._profiler = None
@@ -106,6 +108,9 @@ class Context:
             "items_moved": mex.stats_items_moved,
             "bytes_moved": mex.stats_bytes_moved,
             "host_mem_peak": self.mem.peak,
+            "hbm_peak": self.hbm.mem.peak,
+            "hbm_spills": self.hbm.spill_count,
+            "hbm_restores": self.hbm.restore_count,
         }
 
     def close(self) -> None:
@@ -114,6 +119,7 @@ class Context:
         if self.logger.enabled:
             self.logger.line(event="overall_stats", **self.overall_stats())
         self.logger.close()
+        self.hbm.close()
 
 
 # ----------------------------------------------------------------------

@@ -78,6 +78,7 @@ class DIABase:
                 f"DIA node {self.label}#{self.id} was consumed/disposed "
                 f"(consume budget exhausted); call .Keep() before reusing "
                 f"a DIA in more than one operation")
+        hbm = self.context.hbm
         if self._shards is None:
             log = self.context.logger
             if log.enabled:
@@ -86,24 +87,34 @@ class DIABase:
                          parents=[p.node.id for p in self.parents])
             self._shards = self.compute()
             self.state = EXECUTED
+            if not (consume and self.consume_budget <= 1):
+                # a result released by this very pull is never worth
+                # spilling a kept sibling for — skip the LRU entirely
+                hbm.on_cache(self)
             if log.enabled:
                 log.line(event="node_execute_done", node=self.label,
                          dia_id=self.id,
                          items=int(self._shards.counts.sum()))
+        else:
+            # LRU bump; transparently re-uploads a spilled result
+            hbm.touch(self)
         result = self._shards
         if consume:
             self.consume_budget -= 1
             if self.consume_budget <= 0:
                 self._shards = None
                 self.state = DISPOSED
+                hbm.on_release(self, None)  # caller now owns `result`
         return result
 
     def keep(self, n: int = 1) -> None:
         self.consume_budget += n
 
     def dispose(self) -> None:
+        dropped = self._shards
         self._shards = None
         self.state = DISPOSED
+        self.context.hbm.on_release(self, dropped)
 
     def __repr__(self) -> str:
         return f"<{type(self).__name__} {self.label}#{self.id} {self.state}>"

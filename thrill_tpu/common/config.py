@@ -62,6 +62,14 @@ class Config:
     block_items: int = 1024
     # Bytes of device memory the block pool may use (0 = autodetect).
     ram: int = 0
+    # HBM budget for cached DIA node results (0 = unlimited). When the
+    # budget is exceeded, cold EXECUTED node shards spill to the host
+    # block store and are re-uploaded on their next pull.
+    hbm_limit: int = 0
+    # Host-DRAM budget for the spill block store (0 = autodetect: one
+    # third of physical RAM, the reference's MemoryConfig split); past
+    # this soft limit the store evicts blocks to disk.
+    host_ram: int = 0
     # JSON event-log path pattern (None = disabled).
     log_path: Optional[str] = None
     # Directory for host-side spill files.
@@ -72,12 +80,16 @@ class Config:
     @staticmethod
     def from_env() -> "Config":
         ram = os.environ.get("THRILL_TPU_RAM")
+        hbm = os.environ.get("THRILL_TPU_HBM_LIMIT")
         return Config(
             num_workers=_env_int("THRILL_TPU_WORKERS", 0),
             default_storage=_env_str("THRILL_TPU_STORAGE", "device"),
             exchange=_env_str("THRILL_TPU_EXCHANGE", "dense"),
             block_items=_env_int("THRILL_TPU_BLOCK_ITEMS", 1024),
             ram=parse_si_iec_units(ram) if ram else 0,
+            hbm_limit=parse_si_iec_units(hbm) if hbm else 0,
+            host_ram=parse_si_iec_units(
+                os.environ.get("THRILL_TPU_HOST_RAM") or "0"),
             log_path=_env_str("THRILL_TPU_LOG", None),
             spill_dir=_env_str("THRILL_TPU_SPILL_DIR", "/tmp"),
             profile=bool(_env_int("THRILL_TPU_PROFILE", 0)),

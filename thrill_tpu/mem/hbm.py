@@ -1,0 +1,207 @@
+"""HBM budget governor: accounting + device->host spill for DIA results.
+
+Equivalent of the reference's memory-pressure machinery: BlockPool
+soft/hard RAM limits with LRU eviction to disk
+(reference: thrill/data/block_pool.hpp:42), the malloc_tracker
+``memory_exceeded`` flag operators consult
+(reference: thrill/mem/malloc_tracker.hpp:36-43, consulted by Sort at
+api/sort.hpp:679), and the per-stage RAM distribution of the
+StageBuilder (reference: thrill/api/dia_base.cpp:121-270).
+
+TPU translation: the scarce resource is HBM, and the dominant HBM
+consumers are the cached EXECUTED node results (columnar DeviceShards).
+The governor keeps an LRU over nodes holding device-resident shards and
+a byte counter with a limit (``MemoryManager.exceeded``); when the
+budget is exceeded the coldest nodes' shards are fetched to host and
+parked in the native block store (which itself spills to disk past its
+soft limit — the HBM -> host DRAM -> disk ladder). A spilled node's
+next pull re-uploads transparently.
+
+Transient arrays inside a running operator program are XLA-managed and
+not tracked here, matching the reference's split between tracked block
+memory and the floating heap.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .manager import MemoryManager
+
+
+class SpilledShards:
+    """Host-parked form of a DeviceShards: raw leaf bytes in the block
+    store plus the metadata to rebuild the sharded device arrays.
+
+    Spilling and restoring operate on the *addressable* shards of each
+    leaf (one block per local device), so a multi-controller process
+    parks and re-uploads exactly its own slice of the mesh — fetching a
+    globally-sharded array with np.asarray would raise on multi-host.
+    """
+
+    def __init__(self, mesh_exec, treedef, counts: np.ndarray,
+                 pool, leaf_blocks: List[List[Tuple[int, int]]],
+                 leaf_meta: List[Tuple[Any, Tuple[int, ...]]]) -> None:
+        self.mesh_exec = mesh_exec
+        self.treedef = treedef
+        self.counts = counts
+        self.pool = pool
+        # per leaf: [(device_position_in_mesh, block_id), ...]
+        self.leaf_blocks = leaf_blocks
+        self.leaf_meta = leaf_meta   # (dtype, global shape) per leaf
+
+    def restore(self):
+        from ..data.shards import DeviceShards
+        import jax
+        mex = self.mesh_exec
+        leaves = []
+        for blocks, (dt, shape) in zip(self.leaf_blocks, self.leaf_meta):
+            shard_shape = (1,) + tuple(shape[1:])
+            singles = []
+            for dev_pos, bid in blocks:
+                raw = self.pool.get(bid)
+                arr = np.frombuffer(raw, dtype=dt).reshape(shard_shape)
+                singles.append(jax.device_put(arr, mex.devices[dev_pos]))
+            leaves.append(jax.make_array_from_single_device_arrays(
+                tuple(shape), mex.sharded, singles))
+        tree = jax.tree.unflatten(self.treedef, leaves)
+        return DeviceShards(mex, tree, self.counts)
+
+    def free(self) -> None:
+        for blocks in self.leaf_blocks:
+            for _, bid in blocks:
+                self.pool.drop(bid)
+        self.leaf_blocks = []
+
+
+class HbmGovernor:
+    """LRU of nodes with device-cached results + spill under pressure."""
+
+    def __init__(self, context, limit: int = 0) -> None:
+        self.context = context
+        self.mem = MemoryManager(name="hbm", limit=limit)
+        self._lru: Dict[int, Any] = {}   # node id -> node (insertion = LRU)
+        self._pool = None
+        self.spill_count = 0
+        self.restore_count = 0
+
+    # -- pool -----------------------------------------------------------
+    def _spill_pool(self):
+        if self._pool is None:
+            from ..data.block_pool import BlockPool
+            from .manager import MemoryConfig
+            cfg = self.context.config
+            host_ram = cfg.host_ram
+            if not host_ram:
+                try:
+                    host_ram = (os.sysconf("SC_PAGE_SIZE")
+                                * os.sysconf("SC_PHYS_PAGES"))
+                except (ValueError, OSError):
+                    host_ram = 8 << 30
+            # past this soft limit the store evicts to disk: the
+            # HBM -> host DRAM -> disk ladder
+            soft = MemoryConfig.split(host_ram).ram_block_pool_soft
+            self._pool = BlockPool(spill_dir=cfg.spill_dir,
+                                   soft_limit=soft)
+        return self._pool
+
+    # -- node lifecycle hooks (called by DIABase.materialize) -----------
+    @staticmethod
+    def _device_bytes(shards) -> int:
+        from ..data.shards import DeviceShards
+        if not isinstance(shards, DeviceShards):
+            return 0
+        import jax
+        return sum(int(l.nbytes) for l in jax.tree.leaves(shards.tree))
+
+    def on_cache(self, node) -> None:
+        """A node just cached freshly computed shards."""
+        nb = self._device_bytes(node._shards)
+        if nb == 0:
+            return
+        node._hbm_bytes = nb
+        self.mem.add(nb)
+        self._lru[node.id] = node
+        self.maybe_spill(exclude=node.id)
+
+    def touch(self, node) -> None:
+        """A cached node was pulled again: LRU bump + restore if spilled."""
+        if isinstance(node._shards, SpilledShards):
+            spilled = node._shards
+            node._shards = spilled.restore()
+            spilled.free()
+            self.restore_count += 1
+            nb = self._device_bytes(node._shards)
+            node._hbm_bytes = nb
+            self.mem.add(nb)
+            log = self.context.logger
+            if log.enabled:
+                log.line(event="hbm_restore", node=node.label,
+                         dia_id=node.id, bytes=nb)
+        if node.id in self._lru:
+            self._lru[node.id] = self._lru.pop(node.id)  # move to end
+        elif getattr(node, "_hbm_bytes", 0):
+            self._lru[node.id] = node
+        self.maybe_spill(exclude=node.id)
+
+    def on_release(self, node, dropped) -> None:
+        """A node's cached result (``dropped``) was disposed."""
+        if isinstance(dropped, SpilledShards):
+            dropped.free()
+        nb = getattr(node, "_hbm_bytes", 0)
+        if nb:
+            self.mem.subtract(nb)
+            node._hbm_bytes = 0
+        self._lru.pop(node.id, None)
+
+    # -- spilling -------------------------------------------------------
+    def maybe_spill(self, exclude: Optional[int] = None) -> None:
+        """Consult the exceeded flag; spill coldest nodes until under
+        budget (the analog of memory_exceeded-triggered spilling)."""
+        if not self.mem.exceeded:
+            return
+        for nid in list(self._lru.keys()):
+            if nid == exclude:
+                continue
+            self.spill(self._lru[nid])
+            if not self.mem.exceeded:
+                break
+
+    def spill(self, node) -> None:
+        from ..data.shards import DeviceShards
+        import jax
+        shards = node._shards
+        if not isinstance(shards, DeviceShards):
+            return
+        pool = self._spill_pool()
+        mex = shards.mesh_exec
+        dev_pos = {d: i for i, d in enumerate(mex.devices)}
+        leaves, treedef = jax.tree.flatten(shards.tree)
+        leaf_blocks, meta = [], []
+        for leaf in leaves:
+            blocks = []
+            for sh in leaf.addressable_shards:
+                arr = np.asarray(sh.data)
+                blocks.append((dev_pos[sh.device], pool.put(arr.tobytes())))
+            leaf_blocks.append(blocks)
+            meta.append((leaf.dtype, tuple(leaf.shape)))
+        node._shards = SpilledShards(mex, treedef, shards.counts.copy(),
+                                     pool, leaf_blocks, meta)
+        nb = getattr(node, "_hbm_bytes", 0)
+        if nb:
+            self.mem.subtract(nb)
+            node._hbm_bytes = 0
+        self._lru.pop(node.id, None)
+        self.spill_count += 1
+        log = self.context.logger
+        if log.enabled:
+            log.line(event="hbm_spill", node=node.label, dia_id=node.id,
+                     bytes=nb)
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
