@@ -1,0 +1,52 @@
+"""Child process body for the 2-process RunDistributed test.
+
+Launched by tests/net/test_distributed.py with:
+  python distributed_child.py <coordinator_addr> <rank>
+and THRILL_TPU_HOSTLIST/RANK/SECRET in the environment. Runs the
+WordCount-shaped device pipeline plus host-plane agreement and prints
+one RESULT line for the parent to compare across ranks.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+from thrill_tpu.common.platform import force_cpu_platform
+
+force_cpu_platform()
+
+import numpy as np  # noqa: E402
+
+from thrill_tpu.api import RunDistributed  # noqa: E402
+
+
+def job(ctx):
+    vals = np.arange(1000, dtype=np.int64)
+    # WordCount shape: item -> (key, 1) -> ReducePair (device two-phase
+    # reduce with a cross-process hash exchange)
+    hist = ctx.Distribute(vals).Map(lambda x: (x % 10, 1)) \
+        .ReducePair(lambda a, b: a + b)
+    pairs = sorted((int(k), int(v)) for k, v in hist.AllGather())
+    total = int(ctx.Distribute(vals).Sum())
+    # host-plane agreement across the 2 controllers (TCP FCC)
+    totals = ctx.net.all_gather(total)
+    stats = ctx.overall_stats()
+    return {"pairs": pairs, "total": total, "totals": totals,
+            "hosts": stats.get("hosts", 1),
+            "net_workers": ctx.net.num_workers,
+            "mesh_workers": ctx.num_workers}
+
+
+def main():
+    coordinator, rank = sys.argv[1], int(sys.argv[2])
+    res = RunDistributed(job, coordinator_address=coordinator,
+                         num_processes=2, process_id=rank)
+    print("RESULT " + json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()

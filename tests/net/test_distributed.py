@@ -1,0 +1,85 @@
+"""Real 2-process distributed run: jax.distributed over CPU + TCP
+control plane (the reference's analog: the same gtest binary under
+mpirun -np N, tests/CMakeLists.txt:116-120).
+
+Launches two actual OS processes, each a separate JAX controller with
+its own 2-device CPU mesh (global mesh = 4 workers), runs the
+WordCount-shaped pipeline on the device path, and asserts both
+controllers computed identical, correct results and agreed over the
+authenticated host control plane.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+CHILD = os.path.join(os.path.dirname(__file__), "distributed_child.py")
+
+
+def test_two_process_wordcount_agrees():
+    coord_port, tcp0, tcp1 = _free_ports(3)
+    coordinator = f"127.0.0.1:{coord_port}"
+    hostlist = f"127.0.0.1:{tcp0} 127.0.0.1:{tcp1}"
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env.update({
+            "PYTHONPATH": repo_root + os.pathsep
+            + env.get("PYTHONPATH", ""),
+            "THRILL_TPU_HOSTLIST": hostlist,
+            "THRILL_TPU_RANK": str(rank),
+            "THRILL_TPU_SECRET": "test-cluster-secret",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, CHILD, coordinator, str(rank)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed child timed out")
+        assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
+        outs.append((out, err))
+
+    results = []
+    for out, err in outs:
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, f"no RESULT line:\n{out}\n{err[-2000:]}"
+        results.append(json.loads(lines[-1][len("RESULT "):]))
+
+    r0, r1 = results
+    # both controllers computed the identical logical result
+    assert r0 == r1
+    # and it is the correct one
+    assert r0["pairs"] == [[i, 100] for i in range(10)]
+    assert r0["total"] == 999 * 1000 // 2
+    # host control plane saw both controllers and they agreed
+    assert r0["net_workers"] == 2
+    assert r0["totals"] == [r0["total"], r0["total"]]
+    # the device mesh spanned both processes (2 devices each)
+    assert r0["mesh_workers"] == 4
+    assert r0["hosts"] == 2

@@ -27,7 +27,7 @@ import jax
 from ..common.config import Config
 from ..common.logger import JsonLogger, default_log_path
 from ..mem.manager import MemoryManager
-from ..net.flow import LocalFlowControl
+from ..net.flow import FlowControlChannel, LocalFlowControl
 from ..parallel.mesh import MeshExec
 
 
@@ -44,7 +44,14 @@ class Context:
         if host_rank is None:
             host_rank = jax.process_index()
         self.host_rank = host_rank
+        # worker-level collectives, single-controller flavor (host ops)
         self.flow = LocalFlowControl(self.num_workers)
+        # host-level control plane: FlowControlChannel over a real group
+        # (reference: ctx.net, api/context.hpp:446-448). Single-process
+        # runs get a trivial 1-host group; multi-process deployments
+        # bootstrap the authenticated TCP full mesh from THRILL_TPU_*
+        # env so host-side scalar agreement crosses machines.
+        self.net = FlowControlChannel(self._construct_host_group())
         self.logger = JsonLogger(
             default_log_path(self.config.log_path, host_rank=host_rank),
             program="thrill_tpu", workers=self.num_workers)
@@ -57,6 +64,31 @@ class Context:
         if self.config.profile and self.logger.enabled:
             from ..common.profile import ProfileThread
             self._profiler = ProfileThread(self.logger).start()
+
+    def _construct_host_group(self):
+        from ..net import tcp
+        if jax.process_count() > 1:
+            grp = tcp.construct_from_env()
+            if grp is not None:
+                if grp.num_hosts != jax.process_count():
+                    raise ValueError(
+                        f"THRILL_TPU_HOSTLIST has {grp.num_hosts} hosts "
+                        f"but jax.process_count() is "
+                        f"{jax.process_count()}")
+                if grp.my_rank != jax.process_index():
+                    raise ValueError(
+                        f"THRILL_TPU_RANK={grp.my_rank} disagrees with "
+                        f"jax.process_index()={jax.process_index()} — "
+                        f"the host control plane and the device mesh "
+                        f"must use the same rank order")
+                return grp
+            import sys
+            print("thrill_tpu: multi-process run without "
+                  "THRILL_TPU_HOSTLIST — host-side control plane is "
+                  "process-local only (cross-host scalar agreement "
+                  "rides device collectives exclusively)",
+                  file=sys.stderr)
+        return tcp.TcpGroup(0, 1, {})
 
     # -- identity -------------------------------------------------------
     @property
@@ -97,9 +129,11 @@ class Context:
 
     def overall_stats(self) -> dict:
         """End-of-job summary (reference: OverallStats AllReduce,
-        api/context.cpp:1235-1341)."""
+        api/context.cpp:1235-1341). In multi-process runs the per-host
+        stats are aggregated over the host control plane (``ctx.net``):
+        counters sum, peaks take the max."""
         mex = self.mesh_exec
-        return {
+        stats = {
             "workers": self.num_workers,
             "nodes_created": len(self._nodes),
             "nodes_executed": sum(1 for n in self._nodes
@@ -112,14 +146,35 @@ class Context:
             "hbm_spills": self.hbm.spill_count,
             "hbm_restores": self.hbm.restore_count,
         }
+        if self.net.num_workers > 1:
+            per_host = self.net.all_gather(stats)
+            # almost every counter is a per-controller view of one
+            # global value (exchange stats derive from the replicated
+            # send matrix, the mesh spans all hosts, the DAG is one
+            # logical graph) — take host 0's copy, don't sum. Only the
+            # host-process-local peaks genuinely differ across hosts.
+            local_peaks = {"host_mem_peak"}
+            stats = {
+                k: (max(h[k] for h in per_host) if k in local_peaks
+                    else per_host[0][k])
+                for k in stats}
+            stats["hosts"] = len(per_host)
+        return stats
 
     def close(self) -> None:
         if self._profiler is not None:
             self._profiler.stop()
+        # overall_stats() is a COLLECTIVE in multi-host runs: every host
+        # must enter it regardless of its local logger setting, or
+        # all_gather and barrier traffic would interleave across hosts
+        stats = self.overall_stats()
         if self.logger.enabled:
-            self.logger.line(event="overall_stats", **self.overall_stats())
+            self.logger.line(event="overall_stats", **stats)
         self.logger.close()
         self.hbm.close()
+        if self.net.num_workers > 1:
+            self.net.barrier()
+            self.net.group.close()
 
 
 # ----------------------------------------------------------------------
@@ -170,11 +225,14 @@ def RunDistributed(job: Callable[[Context], Any],
     (Distribute) expect identical input on every host; per-host data
     should enter via ConcatToDIA of the local portion.
 
-    EXPERIMENTAL: the exchange plan step replicates its send-count
-    matrix so it is fetchable on every process, but other host-side
-    steps (per-worker counts refresh) still fetch globally-sharded
-    arrays, which multi-controller JAX only permits for addressable
-    shards — full multi-host hardening is tracked for the next round.
+    Host fetches of device results are multi-controller safe: plan
+    matrices and samples are replicated inside the jitted programs, and
+    every remaining device->host read goes through ``MeshExec.fetch``,
+    which process-allgathers arrays spanning non-addressable devices.
+    Host-side scalar agreement between controllers rides ``ctx.net``
+    (FlowControlChannel over the authenticated TCP group from
+    THRILL_TPU_HOSTLIST/RANK/SECRET). Validated by the 2-process
+    WordCount test (tests/net/test_distributed.py).
     """
     if num_processes is not None and num_processes > 1:
         jax.distributed.initialize(

@@ -43,6 +43,6 @@ def apply_stack_device(shards: DeviceShards, stack: Stack) -> DeviceShards:
 
     fn, h = mex.cached(key, build)
     out = fn(shards.counts_device(), *leaves)
-    new_counts = np.asarray(out[0]).reshape(-1).astype(np.int64)
+    new_counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
     tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
     return DeviceShards(mex, tree, new_counts)

@@ -81,7 +81,7 @@ def _device_reduce(shards: DeviceShards, mode: str):
 
     fn = mex.cached(key, build)
     out = fn(shards.counts_device(), *leaves)
-    vals = [np.asarray(o) for o in out]
+    vals = [mex.fetch(o) for o in out]
     vals = [v.item() if v.ndim == 0 else v for v in vals]
     return jax.tree.unflatten(treedef, vals)
 

@@ -87,7 +87,7 @@ def HyperLogLog(dia: DIA, precision: int = 14) -> float:
         return mex.smap(f, 1 + len(leaves), out_specs=P())
 
     fn = mex.cached(key, build)
-    regs = np.asarray(fn(shards.counts_device(), *leaves))
+    regs = mex.fetch(fn(shards.counts_device(), *leaves))
     return _estimate(regs, p)
 
 

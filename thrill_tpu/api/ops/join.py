@@ -163,7 +163,7 @@ class InnerJoinNode(DIABase):
         f1 = mex.cached(key1, build1)
         out1 = f1(left.counts_device(), right.counts_device(),
                   *lleaves, *rleaves)
-        totals = np.asarray(out1[0]).reshape(-1).astype(np.int64)
+        totals = mex.fetch(out1[0]).reshape(-1).astype(np.int64)
         matches_dev, lo_dev = out1[1], out1[2]
         lsorted = list(out1[3:3 + nl])
         rsorted = list(out1[3 + nl:])

@@ -57,7 +57,7 @@ class BernoulliSampleNode(DIABase):
 
         fn = mex.cached(key, build)
         out = fn(shards.counts_device(), *leaves)
-        counts = np.asarray(out[0]).reshape(-1).astype(np.int64)
+        counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
         tree = jax.tree.unflatten(treedef, list(out[1:]))
         return DeviceShards(mex, tree, counts)
 
@@ -113,7 +113,7 @@ class SampleNode(DIABase):
         fn = mex.cached(key, build)
         out = fn(shards.counts_device(),
                  mex.put(takes.astype(np.int64)[:, None]), *leaves)
-        counts = np.asarray(out[0]).reshape(-1).astype(np.int64)
+        counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
         tree = jax.tree.unflatten(treedef, list(out[1:]))
         return DeviceShards(mex, tree, counts)
 

@@ -242,9 +242,9 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
     nwords = h1["nwords"]
 
     # ---- host: choose splitters (the "worker 0" step) ----------------
-    sw = np.asarray(s_words).reshape(W * OVERSAMPLE, nwords)
-    si = np.asarray(s_idx).reshape(W * OVERSAMPLE)
-    sv = np.asarray(s_valid).reshape(W * OVERSAMPLE)
+    sw = mex.fetch(s_words).reshape(W * OVERSAMPLE, nwords)
+    si = mex.fetch(s_idx).reshape(W * OVERSAMPLE)
+    sv = mex.fetch(s_valid).reshape(W * OVERSAMPLE)
     samples = [(tuple(int(x) for x in sw[i]), int(si[i]))
                for i in range(len(sv)) if sv[i]]
     samples.sort()
@@ -294,7 +294,7 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
               shards.counts_device(), *leaves)
     sorted_dest, send_mat = out2[0], out2[1]
     sorted_payload = list(out2[2:])
-    S = np.asarray(send_mat)
+    S = mex.fetch(send_mat)
 
     # carrier = words + gidx (already sorted, no gather needed) + payload
     carrier_tree = {

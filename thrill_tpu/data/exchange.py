@@ -116,7 +116,7 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
     sorted_dest, send_mat = out_a[0], out_a[1]
     sorted_leaves = list(out_a[2:])
 
-    S = np.asarray(send_mat)                      # [W, W] S[w, d]
+    S = mex.fetch(send_mat)                       # [W, W] S[w, d]
     return _exchange_planned(mex, treedef, sorted_dest, sorted_leaves, S,
                              min_cap=min_cap)
 

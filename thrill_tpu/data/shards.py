@@ -99,7 +99,7 @@ class DeviceShards:
 
     def to_worker_arrays(self) -> List[Any]:
         """Fetch to host: W pytrees of numpy arrays trimmed to counts."""
-        host_tree = jax.tree.map(np.asarray, self.tree)
+        host_tree = self.mesh_exec.fetch_tree(self.tree)
         out = []
         for w in range(self.num_workers):
             c = int(self.counts[w])

@@ -69,6 +69,22 @@ class MeshExec:
     def put_tree(self, tree):
         return jax.tree.map(self.put, tree)
 
+    def fetch(self, arr) -> np.ndarray:
+        """Device -> host fetch that is multi-controller safe.
+
+        ``np.asarray`` raises on arrays spanning non-addressable
+        devices (other processes' chips); those are gathered across
+        processes first. Single-process meshes take the direct path.
+        """
+        if getattr(arr, "is_fully_addressable", True):
+            return np.asarray(arr)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(arr,
+                                                            tiled=True))
+
+    def fetch_tree(self, tree):
+        return jax.tree.map(self.fetch, tree)
+
     # -- compiled SPMD programs ----------------------------------------
     def smap(self, fn: Callable, num_args: int, out_specs=P(AXIS),
              in_specs=None, check_vma: bool = False) -> Callable:
