@@ -361,3 +361,86 @@ def test_host_sort_external_memory(monkeypatch):
             compare_fn=lambda a, b: a > b)   # descending
         assert out2.AllGather() == sorted(vals[:500], reverse=True)
     RunLocalMock(job, 4)
+
+
+def test_group_by_key_device_fn():
+    """Fully-device grouping: segment_* fold, one row per key."""
+    import jax
+
+    def job(ctx):
+        vals = np.arange(60, dtype=np.int64)
+        d = ctx.Distribute(vals).Map(lambda x: (x % 6, x))
+
+        def device_fn(tree, seg_ids, nseg):
+            k, v = tree
+            import jax.numpy as jnp
+            return (jax.ops.segment_max(k, seg_ids, num_segments=nseg),
+                    jax.ops.segment_sum(v, seg_ids, num_segments=nseg))
+
+        g = d.GroupByKey(lambda kv: kv[0], device_fn=device_fn)
+        got = sorted((int(k), int(s)) for k, s in g.AllGather())
+        want = sorted((k, sum(v for v in range(60) if v % 6 == k))
+                      for k in range(6))
+        assert got == want
+    sweep(job)
+
+
+def test_group_by_key_sorted_host_path():
+    """Arbitrary group_fn on device storage: groups are contiguous runs
+    after the device sort; results must match the naive grouping."""
+    def job(ctx):
+        rng = np.random.default_rng(5)
+        vals = rng.integers(0, 13, 500).astype(np.int64)
+        d = ctx.Distribute(vals).Map(lambda x: (x, 1))
+        g = d.GroupByKey(lambda kv: kv[0],
+                         lambda k, items: (k, len(list(items))))
+        got = sorted((int(k), int(c)) for k, c in g.AllGather())
+        want = {}
+        for v in vals.tolist():
+            want[v] = want.get(v, 0) + 1
+        assert got == sorted(want.items())
+    sweep(job)
+
+
+def test_device_to_host_demotion_logged(tmp_path):
+    """Every device->host fallback must emit a trace event."""
+    import json
+    from thrill_tpu.api import RunLocalMock
+    from thrill_tpu.common.config import Config
+
+    cfg = Config(log_path=str(tmp_path / "log.jsonl"))
+
+    def job(ctx):
+        d = ctx.Distribute(np.arange(100, dtype=np.int64))
+        # comparator Sort forces the host path -> demotion
+        out = d.Sort(compare_fn=lambda a, b: a > b).AllGather()
+        assert [int(x) for x in out] == list(range(99, -1, -1))
+    RunLocalMock(job, 2, cfg)
+    logfile = next(tmp_path.glob("log*"))
+    events = [json.loads(l) for l in open(logfile)]
+    demotions = [e for e in events if e.get("event") == "device_to_host"]
+    assert demotions and demotions[0]["reason"] == "sort-compare-fn"
+    assert demotions[0]["items"] == 100
+
+
+def test_group_to_index_device_fn():
+    import jax
+
+    def job(ctx):
+        vals = np.arange(30, dtype=np.int64)
+
+        def device_fn(tree, ids, nseg):
+            return jax.ops.segment_sum(tree, ids, num_segments=nseg)
+
+        out = ctx.Distribute(vals).GroupToIndex(
+            lambda x: x % 5, None, 5, neutral=-1, device_fn=device_fn)
+        got = [int(x) for x in out.AllGather()]
+        want = [sum(v for v in range(30) if v % 5 == i) for i in range(5)]
+        assert got == want
+
+        # neutral fill: index 3 receives nothing
+        sparse = ctx.Distribute(np.array([0, 1, 2, 4], dtype=np.int64))
+        out2 = sparse.GroupToIndex(
+            lambda x: x, None, 5, neutral=-1, device_fn=device_fn)
+        assert [int(x) for x in out2.AllGather()] == [0, 1, 2, -1, 4]
+    sweep(job)

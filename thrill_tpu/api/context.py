@@ -55,6 +55,9 @@ class Context:
         self.logger = JsonLogger(
             default_log_path(self.config.log_path, host_rank=host_rank),
             program="thrill_tpu", workers=self.num_workers)
+        # storage-layer events (device->host demotions) log through the
+        # mesh the shards carry a reference to
+        self.mesh_exec.logger = self.logger
         self.mem = MemoryManager(name="context")
         from ..mem.hbm import HbmGovernor
         self.hbm = HbmGovernor(self, limit=self.config.hbm_limit)

@@ -74,14 +74,18 @@ class DIA:
         from .ops import reduce as _r
         return _r.ReduceToIndex(self, index_fn, reduce_fn, size, neutral)
 
-    def GroupByKey(self, key_fn: Callable, group_fn: Callable) -> "DIA":
+    def GroupByKey(self, key_fn: Callable, group_fn: Callable = None,
+                   device_fn: Callable = None) -> "DIA":
         from .ops import groupby
-        return groupby.GroupByKey(self, key_fn, group_fn)
+        return groupby.GroupByKey(self, key_fn, group_fn,
+                                  device_fn=device_fn)
 
-    def GroupToIndex(self, index_fn: Callable, group_fn: Callable,
-                     size: int, neutral: Any = None) -> "DIA":
+    def GroupToIndex(self, index_fn: Callable, group_fn: Callable = None,
+                     size: int = 0, neutral: Any = None,
+                     device_fn: Callable = None) -> "DIA":
         from .ops import groupby
-        return groupby.GroupToIndex(self, index_fn, group_fn, size, neutral)
+        return groupby.GroupToIndex(self, index_fn, group_fn, size, neutral,
+                                    device_fn=device_fn)
 
     def Sort(self, key_fn: Optional[Callable] = None,
              compare_fn: Optional[Callable] = None) -> "DIA":

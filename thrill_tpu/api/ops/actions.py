@@ -33,7 +33,7 @@ def Size(dia) -> int:
 def AllGather(dia) -> list:
     shards = _pull(dia)
     if isinstance(shards, DeviceShards):
-        shards = shards.to_host_shards()
+        shards = shards.to_host_shards("allgather-action")
     return [it for l in shards.lists for it in l]
 
 

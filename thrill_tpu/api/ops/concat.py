@@ -163,7 +163,7 @@ class ConcatNode(DIABase):
     def compute(self):
         pulls = [l.pull() for l in self.parents]
         if any(isinstance(p, HostShards) for p in pulls):
-            pulls = [p.to_host_shards() if isinstance(p, DeviceShards)
+            pulls = [p.to_host_shards("concat-mixed-storage") if isinstance(p, DeviceShards)
                      else p for p in pulls]
             W = pulls[0].num_workers
             flat = [it for p in pulls for l in p.lists for it in l]

@@ -2,16 +2,27 @@
 
 Reference: thrill/api/group_by_key.hpp:47 — hash-partition shuffle, local
 sort (with spill + multiway merge), then the user function over each
-key's iterator. The group function is inherently per-group and arbitrary
-(it sees all values of one key), so after a device-side exchange + sort
-the per-group application runs on the host — the device handles the
-communication-heavy phases, Python the sequential group fold. Vectorized
-aggregations should use ReduceByKey, which stays fully on device.
+key's iterator (group_by_key.hpp:188-216).
+
+TPU-native design: the communication-heavy phases (hash exchange, key
+sort, run segmentation) always run on device. What happens per group
+depends on the group function:
+
+* ``device_fn`` given — FULLY on device: the user receives the sorted
+  item tree plus per-item segment ids and folds each group with
+  ``jax.ops.segment_*``-family ops; one result row per key, no Python
+  per item or per group.
+* only ``group_fn`` — the device hands back *sorted* columns; groups
+  are delimited with one vectorized boundary scan on the host and
+  ``group_fn`` is applied per key run (per-group Python, which an
+  arbitrary sequential fold inherently requires — the reference's host
+  iterator loop is the same shape). Vectorized aggregations should
+  prefer ``device_fn`` or ReduceByKey.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import numpy as np
 
@@ -24,32 +35,32 @@ from ..dia_base import DIABase
 
 
 class GroupByKeyNode(DIABase):
-    def __init__(self, ctx, link, key_fn: Callable, group_fn: Callable
-                 ) -> None:
+    def __init__(self, ctx, link, key_fn: Callable, group_fn: Callable,
+                 device_fn: Optional[Callable] = None) -> None:
         super().__init__(ctx, "GroupByKey", [link])
         self.key_fn = key_fn
         self.group_fn = group_fn
+        self.device_fn = device_fn
 
     def compute(self):
         shards = self.parents[0].pull()
         W = self.context.num_workers
         key_fn = self.key_fn
         if isinstance(shards, DeviceShards):
-            # device exchange by key hash, then group on host
-            if W > 1:
-                import jax.numpy as jnp
-
-                def dest(tree, mask, widx):
-                    words = keymod.encode_key_words(key_fn(tree))
-                    h = hashing.hash_key_words(words)
-                    return (h % jnp.uint64(W)).astype(jnp.int32)
-
-                shards = exchange.exchange(
-                    shards, dest, ("groupby_dest", key_fn, W))
-            shards = shards.to_host_shards()
-        else:
-            shards = exchange.host_exchange(
-                shards, lambda it: hashing.stable_host_hash(key_fn(it)))
+            if self.group_fn is None and self.device_fn is None:
+                raise ValueError(
+                    "GroupByKey needs group_fn (host fold) or device_fn "
+                    "(vectorized segment fold)")
+            shards = self._exchange_by_key_hash(shards)
+            if self.device_fn is not None:
+                return self._group_device(shards)
+            return self._group_sorted_host(shards)
+        if self.group_fn is None:
+            raise ValueError(
+                "GroupByKey over host storage requires group_fn "
+                "(device_fn needs columnar device shards)")
+        shards = exchange.host_exchange(
+            shards, lambda it: hashing.stable_host_hash(key_fn(it)))
         out = []
         for items in shards.lists:
             groups = {}
@@ -57,6 +68,126 @@ class GroupByKeyNode(DIABase):
                 groups.setdefault(_hashable(key_fn(it)), []).append(it)
             out.append([self.group_fn(k, vs) for k, vs in groups.items()])
         return HostShards(W, out)
+
+    # -- device phases --------------------------------------------------
+    def _exchange_by_key_hash(self, shards: DeviceShards) -> DeviceShards:
+        """Hash exchange (W > 1); grouping sorts afterwards."""
+        import jax.numpy as jnp
+
+        W = self.context.num_workers
+        key_fn = self.key_fn
+        if W == 1:
+            return shards
+
+        def dest(tree, mask, widx):
+            words = keymod.encode_key_words(key_fn(tree))
+            h = hashing.hash_key_words(words)
+            return (h % jnp.uint64(W)).astype(jnp.int32)
+
+        return exchange.exchange(shards, dest,
+                                 ("groupby_dest", key_fn, W))
+
+    def _group_device(self, shards: DeviceShards) -> DeviceShards:
+        """Fully-device grouping: sort by key words, segment ids, then
+        the user's vectorized fold (jax.ops.segment_* family).
+
+        ``device_fn(sorted_tree, segment_ids, num_segments)`` must
+        return a pytree of arrays with leading dim ``num_segments``
+        (static == shard capacity); row j is group j's result. Invalid
+        rows carry segment id num_segments - 1 only when that slot is
+        unused (padded capacity), so segment_* ops can ignore them.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        mex = shards.mesh_exec
+        cap = shards.cap
+        key_fn, device_fn = self.key_fn, self.device_fn
+        leaves, treedef = jax.tree.flatten(shards.tree)
+        key = ("groupby_device", key_fn, device_fn, cap, treedef,
+               tuple((l.dtype, l.shape[2:]) for l in leaves))
+        holder = {}
+
+        def build():
+            def f(counts_dev, *ls):
+                count = counts_dev[0, 0]
+                valid = jnp.arange(cap) < count
+                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+                _, tree_s, valid_s, starts = _sorted_key_runs(
+                    tree, valid, key_fn)
+                seg_ids = jnp.cumsum(starts.astype(jnp.int32)) - 1
+                nseg = jnp.sum(starts.astype(jnp.int32))
+                # park invalid rows in the last (padded, hence unused)
+                # segment slot; nseg <= count < cap whenever they exist
+                seg_ids = jnp.where(valid_s, seg_ids, cap - 1)
+                out_tree = device_fn(tree_s, seg_ids, cap)
+                out_leaves, out_td = jax.tree.flatten(out_tree)
+                holder["treedef"] = out_td
+                return (nseg[None, None].astype(jnp.int32),
+                        *[l[None] for l in out_leaves])
+
+            return mex.smap(f, 1 + len(leaves)), holder
+
+        fn, h = mex.cached(key, build)
+        out = fn(shards.counts_device(), *leaves)
+        new_counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
+        tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
+        return DeviceShards(mex, tree, new_counts)
+
+    def _group_sorted_host(self, shards: DeviceShards) -> HostShards:
+        """Arbitrary group_fn: device sort + ONE vectorized boundary
+        scan per worker; Python runs once per group, never per item."""
+        import jax
+        import jax.numpy as jnp
+
+        mex = shards.mesh_exec
+        cap = shards.cap
+        key_fn = self.key_fn
+        leaves, treedef = jax.tree.flatten(shards.tree)
+        key = ("groupby_sort", key_fn, cap, treedef,
+               tuple((l.dtype, l.shape[2:]) for l in leaves))
+
+        def build():
+            def f(counts_dev, *ls):
+                count = counts_dev[0, 0]
+                valid = jnp.arange(cap) < count
+                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+                _, tree_s, _, starts = _sorted_key_runs(
+                    tree, valid, key_fn)
+                out_leaves = jax.tree.leaves(tree_s)
+                return (starts[None], *[l[None] for l in out_leaves])
+
+            return mex.smap(f, 1 + len(leaves))
+
+        fn = mex.cached(key, build)
+        out = fn(shards.counts_device(), *leaves)
+        starts_all = mex.fetch(out[0])
+        sorted_shards = DeviceShards(
+            mex, jax.tree.unflatten(treedef, list(out[1:])),
+            shards.counts.copy())
+        group_fn, key_fn_ = self.group_fn, self.key_fn
+        lists = []
+        for w, items in enumerate(
+                sorted_shards.to_host_shards("groupbykey-group-fn").lists):
+            n = len(items)
+            bounds = np.flatnonzero(starts_all[w, :n]).tolist() + [n]
+            lists.append([
+                group_fn(_hashable(key_fn_(items[lo])), items[lo:hi])
+                for lo, hi in zip(bounds[:-1], bounds[1:])])
+        return HostShards(self.context.num_workers, lists)
+
+
+def _sorted_key_runs(tree, valid, key_fn):
+    """Traced preamble shared by both grouping paths: key-sort the items
+    (invalid last) and mark run starts. Returns
+    (sorted_words, sorted_tree, sorted_valid, run_starts)."""
+    from ...core import segmented
+
+    words = keymod.encode_key_words(key_fn(tree))
+    words_s, tree_s, valid_s, _ = segmented.sort_by_key_words(
+        words, tree, valid)
+    starts = segmented.segment_boundaries(words_s, valid_s)
+    return words_s, tree_s, valid_s, starts
 
 
 def _hashable(k: Any):
@@ -72,17 +203,27 @@ def _hashable(k: Any):
 class GroupToIndexNode(DIABase):
     """Index-range variant (reference: api/group_to_index.hpp:42)."""
 
-    def __init__(self, ctx, link, index_fn, group_fn, size, neutral) -> None:
+    def __init__(self, ctx, link, index_fn, group_fn, size, neutral,
+                 device_fn: Optional[Callable] = None) -> None:
         super().__init__(ctx, "GroupToIndex", [link])
         self.index_fn = index_fn
         self.group_fn = group_fn
         self.size = int(size)
+        if self.size <= 0:
+            raise ValueError("GroupToIndex requires a positive size")
         self.neutral = neutral
+        self.device_fn = device_fn
 
     def compute(self):
         shards = self.parents[0].pull()
+        if isinstance(shards, DeviceShards) and self.device_fn is not None:
+            return self._compute_device(shards)
+        if self.group_fn is None:
+            raise ValueError(
+                "GroupToIndex over host storage requires group_fn "
+                "(device_fn needs columnar device shards)")
         if isinstance(shards, DeviceShards):
-            shards = shards.to_host_shards()
+            shards = shards.to_host_shards("grouptoindex")
         W = self.context.num_workers
         n = self.size
         bounds = [(w * n) // W for w in range(W + 1)]
@@ -106,10 +247,97 @@ class GroupToIndexNode(DIABase):
         return HostShards(W, out)
 
 
-def GroupByKey(dia: DIA, key_fn, group_fn) -> DIA:
-    return DIA(GroupByKeyNode(dia.context, dia._link(), key_fn, group_fn))
+    def _compute_device(self, shards: DeviceShards) -> DeviceShards:
+        """Device GroupToIndex: range exchange, then the user's
+        ``device_fn(tree, local_index_ids, num_segments)`` folds each
+        index's items with segment_* ops (one output row per local
+        index, dense). No sort is needed — segment scatters accept
+        unsorted ids. Invalid/out-of-range rows carry id num_segments,
+        which scatter semantics drop. ``neutral`` (scalar or pytree)
+        fills indices that received no items.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        mex = shards.mesh_exec
+        W = self.context.num_workers
+        n = self.size
+        index_fn, device_fn = self.index_fn, self.device_fn
+        neutral = self.neutral
+        bounds = np.array([(w * n) // W for w in range(W + 1)],
+                          dtype=np.int64)
+
+        if W > 1:
+            bounds_dev = jnp.asarray(bounds)
+
+            def dest(tree, mask, widx):
+                idx = jnp.asarray(index_fn(tree)).astype(jnp.int64)
+                return (jnp.searchsorted(bounds_dev[1:], idx,
+                                         side="right")).astype(jnp.int32)
+
+            # destination program depends only on index_fn/n/W — never
+            # on device_fn, so different folds share one executable
+            shards = exchange.exchange(shards, dest,
+                                       ("g2i_dest", index_fn, n, W))
+
+        cap = shards.cap
+        leaves, treedef = jax.tree.flatten(shards.tree)
+        local_sizes = (bounds[1:] - bounds[:-1]).astype(np.int64)
+        out_cap = max(1, int(local_sizes.max()))
+        key = ("g2i_device", index_fn, device_fn, n, cap, out_cap, treedef,
+               tuple((l.dtype, l.shape[2:]) for l in leaves))
+        holder = {}
+
+        def build():
+            def f(counts_dev, range_start, range_size, *ls):
+                valid = jnp.arange(cap) < counts_dev[0, 0]
+                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+                idx = jnp.asarray(index_fn(tree)).astype(jnp.int64)
+                local_idx = idx - range_start[0, 0]
+                in_range = valid & (local_idx >= 0) & (local_idx
+                                                       < out_cap)
+                ids = jnp.where(in_range, local_idx, out_cap
+                                ).astype(jnp.int32)
+                out_tree = device_fn(tree, ids, out_cap)
+                if neutral is not None:
+                    cnt = jnp.zeros(out_cap + 1, jnp.int32
+                                    ).at[ids].add(1)[:out_cap]
+
+                    def fill(leaf, nval):
+                        m = (cnt > 0).reshape(
+                            (out_cap,) + (1,) * (leaf.ndim - 1))
+                        return jnp.where(m, leaf,
+                                         jnp.asarray(nval, leaf.dtype))
+
+                    if jax.tree.structure(out_tree) == \
+                            jax.tree.structure(neutral):
+                        out_tree = jax.tree.map(fill, out_tree, neutral)
+                    else:
+                        out_tree = jax.tree.map(
+                            lambda l: fill(l, neutral), out_tree)
+                out_leaves, out_td = jax.tree.flatten(out_tree)
+                holder["treedef"] = out_td
+                return (range_size[0].astype(jnp.int32)[None],
+                        *[l[None] for l in out_leaves])
+
+            return mex.smap(f, 3 + len(leaves)), holder
+
+        fn, h = mex.cached(key, build)
+        out = fn(shards.counts_device(),
+                 mex.put(bounds[:-1].astype(np.int64)[:, None]),
+                 mex.put(local_sizes[:, None]), *leaves)
+        new_counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
+        tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
+        return DeviceShards(mex, tree, new_counts)
 
 
-def GroupToIndex(dia: DIA, index_fn, group_fn, size, neutral=None) -> DIA:
+def GroupByKey(dia: DIA, key_fn, group_fn, device_fn=None) -> DIA:
+    return DIA(GroupByKeyNode(dia.context, dia._link(), key_fn, group_fn,
+                              device_fn=device_fn))
+
+
+def GroupToIndex(dia: DIA, index_fn, group_fn, size, neutral=None,
+                 device_fn=None) -> DIA:
     return DIA(GroupToIndexNode(dia.context, dia._link(), index_fn,
-                                group_fn, size, neutral))
+                                group_fn, size, neutral,
+                                device_fn=device_fn))

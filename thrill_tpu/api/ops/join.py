@@ -52,9 +52,9 @@ class InnerJoinNode(DIABase):
     # -- host path ------------------------------------------------------
     def _compute_host(self, left, right):
         if isinstance(left, DeviceShards):
-            left = left.to_host_shards()
+            left = left.to_host_shards("join-host-path")
         if isinstance(right, DeviceShards):
-            right = right.to_host_shards()
+            right = right.to_host_shards("join-host-path")
         W = left.num_workers
         lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
         # hash each item once; reuse for detection, pruning and shuffle

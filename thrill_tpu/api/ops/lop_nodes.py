@@ -24,7 +24,7 @@ class HostFlatMapNode(DIABase):
     def compute(self):
         shards = self.parents[0].pull()
         if isinstance(shards, DeviceShards):
-            shards = shards.to_host_shards()
+            shards = shards.to_host_shards("explicit-tohost")
         out = []
         for items in shards.lists:
             lst = []
@@ -45,7 +45,7 @@ class ToHostNode(DIABase):
     def compute(self):
         shards = self.parents[0].pull()
         if isinstance(shards, DeviceShards):
-            return shards.to_host_shards()
+            return shards.to_host_shards("explicit-tohost")
         return shards
 
 

@@ -32,7 +32,7 @@ class MergeNode(DIABase):
     def compute(self):
         pulls = [l.pull() for l in self.parents]
         if any(isinstance(p, HostShards) for p in pulls):
-            pulls = [p.to_host_shards() if isinstance(p, DeviceShards)
+            pulls = [p.to_host_shards("merge-host-path") if isinstance(p, DeviceShards)
                      else p for p in pulls]
             W = pulls[0].num_workers
             seqs = [[it for lst in p.lists for it in lst] for p in pulls]

@@ -35,7 +35,7 @@ class PrefixSumNode(DIABase):
         shards = self.parents[0].pull()
         if isinstance(shards, HostShards) or self.fn is not None:
             if isinstance(shards, DeviceShards):
-                shards = shards.to_host_shards()
+                shards = shards.to_host_shards("prefixsum-nonnumeric-op")
             return self._compute_host(shards)
         return self._compute_device(shards)
 

@@ -176,7 +176,7 @@ def _worker_path(pattern: str, w: int) -> str:
 def _host_lists(dia) -> HostShards:
     shards = dia._link().pull()
     if isinstance(shards, DeviceShards):
-        shards = shards.to_host_shards()
+        shards = shards.to_host_shards("writelines")
     return shards
 
 

@@ -63,7 +63,7 @@ class SortNode(DIABase):
         if isinstance(shards, HostShards):
             return self._compute_host(shards)
         if self.compare_fn is not None:
-            return self._compute_host(shards.to_host_shards())
+            return self._compute_host(shards.to_host_shards("sort-compare-fn"))
         return _device_sample_sort(shards, self.key_fn,
                                    (self.key_fn,))
 

@@ -21,7 +21,7 @@ class UnionNode(DIABase):
     def compute(self):
         pulls = [l.pull() for l in self.parents]
         if any(isinstance(p, HostShards) for p in pulls):
-            pulls = [p.to_host_shards() if isinstance(p, DeviceShards)
+            pulls = [p.to_host_shards("union-mixed-storage") if isinstance(p, DeviceShards)
                      else p for p in pulls]
             W = pulls[0].num_workers
             return HostShards(W, [[it for p in pulls for it in p.lists[w]]

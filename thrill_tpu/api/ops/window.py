@@ -46,7 +46,7 @@ class WindowNode(DIABase):
                 and bool(np.all(shards.counts[:-1] >= k - 1)):
             return self._compute_device(shards)
         if isinstance(shards, DeviceShards):
-            shards = shards.to_host_shards()
+            shards = shards.to_host_shards("window-host-fn")
         return self._compute_host(shards)
 
     def _compute_host(self, shards: HostShards):
@@ -128,7 +128,7 @@ class FlatWindowNode(DIABase):
     def compute(self):
         shards = self.parents[0].pull()
         if isinstance(shards, DeviceShards):
-            shards = shards.to_host_shards()
+            shards = shards.to_host_shards("flatwindow")
         flat = [it for l in shards.lists for it in l]
         out = []
         for i in range(len(flat) - self.k + 1):

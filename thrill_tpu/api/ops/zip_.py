@@ -68,7 +68,7 @@ class ZipNode(DIABase):
     def compute(self):
         pulls = [l.pull() for l in self.parents]
         if any(isinstance(p, HostShards) for p in pulls):
-            pulls = [p.to_host_shards() if isinstance(p, DeviceShards) else p
+            pulls = [p.to_host_shards("zip-unequal-pad") if isinstance(p, DeviceShards) else p
                      for p in pulls]
             return self._compute_host(pulls)
         return self._compute_device(pulls)
@@ -89,7 +89,7 @@ class ZipNode(DIABase):
         totals = [p.total for p in pulls]
         n_out = self._out_size(totals)
         if self.mode == "pad" and max(totals) != min(totals):
-            return self._compute_host([p.to_host_shards() for p in pulls])
+            return self._compute_host([p.to_host_shards("zip-host-fallback") for p in pulls])
         # target partition = first DIA's distribution truncated to n_out
         c0 = np.clip(pulls[0].counts,
                      0, None)
@@ -251,7 +251,7 @@ class ZipWindowNode(DIABase):
 
     def compute(self):
         pulls = [l.pull() for l in self.parents]
-        pulls = [p.to_host_shards() if isinstance(p, DeviceShards) else p
+        pulls = [p.to_host_shards("zipwindow") if isinstance(p, DeviceShards) else p
                  for p in pulls]
         W = pulls[0].num_workers
         flats = [[it for l in p.lists for it in l] for p in pulls]

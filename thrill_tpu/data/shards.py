@@ -112,17 +112,34 @@ class DeviceShards:
         return tree_map(lambda *leaves: np.concatenate(leaves, axis=0),
                         *per_worker)
 
-    def to_host_shards(self) -> "HostShards":
-        """Itemize into per-worker Python lists (scalars unboxed)."""
+    def to_host_shards(self, reason: str = "unspecified") -> "HostShards":
+        """Itemize into per-worker Python lists (scalars unboxed).
+
+        This is a device->host DEMOTION: the pipeline leaves columnar
+        device storage and continues at Python speed. Every demotion is
+        logged (``reason`` says which operator path forced it) so users
+        can see why a "device" pipeline slowed down.
+        """
+        log = getattr(self.mesh_exec, "logger", None)
+        if log is not None and log.enabled:
+            log.line(event="device_to_host", reason=reason,
+                     items=int(self.counts.sum()))
+        leaf_struct = jax.tree.structure(0)
         lists: List[List[Any]] = []
         for tree in self.to_worker_arrays():
             leaves, treedef = jax.tree.flatten(tree)
-            n = leaves[0].shape[0] if leaves else 0
-            items = []
-            for i in range(n):
-                vals = [leaf[i] if leaf.ndim > 1 else leaf[i].item()
-                        for leaf in leaves]
-                items.append(jax.tree.unflatten(treedef, vals))
+            if not leaves:
+                lists.append([])
+                continue
+            # columnar slices: one tolist()/list() per leaf, not one
+            # python round trip per item per leaf
+            cols = [leaf.tolist() if leaf.ndim == 1 else list(leaf)
+                    for leaf in leaves]
+            if treedef == leaf_struct:
+                items = cols[0]
+            else:
+                items = [jax.tree.unflatten(treedef, vals)
+                         for vals in zip(*cols)]
             lists.append(items)
         return HostShards(self.num_workers, lists)
 
