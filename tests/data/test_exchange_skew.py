@@ -1,0 +1,135 @@
+"""Skew-proof exchange + sticky capacities.
+
+Reference analogs: 1-factor round scheduling (thrill/net/group.hpp:
+90-107) and MixStream's skew tolerance (data/mix_stream.hpp:126).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from thrill_tpu.api import Context
+from thrill_tpu.parallel.mesh import MeshExec
+
+
+def _ctx(W, monkeypatch=None, mode=None):
+    if monkeypatch is not None and mode is not None:
+        monkeypatch.setenv("THRILL_TPU_EXCHANGE", mode)
+    return Context(MeshExec(devices=jax.devices("cpu")[:W]))
+
+
+def _key(t):
+    return t[0]
+
+
+def _count(k, items):
+    return (k, len(list(items)))
+
+
+def _skewed_job(ctx, n=40_000):
+    """GroupByKey with ONE hot (source, destination) pair: a single
+    worker holds ~n items of one key, everyone else a trickle. No
+    pre-reduction collapses groups (unlike ReduceByKey), so the hash
+    exchange really ships the hot run — a genuinely skewed pair."""
+    W = ctx.num_workers
+    rng = np.random.default_rng(0)
+    per_worker = []
+    for w in range(W):
+        if w == min(3, W - 1):
+            vals = np.full(n, 7, dtype=np.int64)          # the hot run
+        else:
+            vals = rng.integers(8, 1000, 64).astype(np.int64)
+        per_worker.append(vals)
+    d = ctx.ConcatToDIA(per_worker, storage="device").Map(lambda x: (x, 1))
+    out = d.GroupByKey(_key, _count)
+    got = {int(k): int(c) for k, c in out.AllGather()}
+    want = {}
+    for vals in per_worker:
+        for v in vals.tolist():
+            want[v] = want.get(v, 0) + 1
+    assert got == want
+
+
+def test_onefactor_exchange_correct(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_EXCHANGE", "onefactor")
+    for W in (2, 5, 8):
+        ctx = _ctx(W)
+        _skewed_job(ctx, n=5000)
+        # uniform data too
+        vals = np.arange(3000, dtype=np.int64)
+        srt = ctx.Distribute(vals[::-1].copy()).Sort()
+        assert [int(x) for x in srt.AllGather()] == vals.tolist()
+        ctx.close()
+
+
+def test_skew_padding_proportional_to_data(monkeypatch):
+    """Under ~100:1 skew the auto plan (1-factor rounds) must allocate
+    far fewer padded rows than the uniform all_to_all plan."""
+    W = 8
+    n = 40_000
+    monkeypatch.setenv("THRILL_TPU_EXCHANGE", "dense")
+    ctx = _ctx(W)
+    _skewed_job(ctx, n=n)
+    auto_rows = ctx.mesh_exec.stats_padded_rows
+    ctx.close()
+
+    monkeypatch.setenv("THRILL_TPU_EXCHANGE", "onefactor")
+    ctx = _ctx(W)
+    _skewed_job(ctx, n=n)
+    onefactor_rows = ctx.mesh_exec.stats_padded_rows
+    ctx.close()
+
+    # the exchange actually ran on the device path (not vacuous)
+    assert auto_rows > 0 and onefactor_rows > 0
+    # dense mode auto-detects the skew and switches to 1-factor rounds
+    assert auto_rows == onefactor_rows
+    # padded rows track the data (one hot pair), far below the uniform
+    # plan's W * round_up_pow2(hot_pair) = W * 65536
+    uniform_rows = W * (1 << 16)
+    assert onefactor_rows < uniform_rows / 4
+
+
+def test_dense_vs_onefactor_padding_ratio(monkeypatch):
+    """Directly compare: force uniform padding via a low-skew guard
+    bypass (small data keeps _skewed False) vs the explicit 1-factor
+    mode on the same skewed matrix."""
+    from thrill_tpu.data import exchange as ex
+
+    S = np.zeros((8, 8), dtype=np.int64)
+    S[:, 0] = 100          # everyone sends a bit to worker 0
+    S[3, 0] = 40_000       # one hot pair
+    assert ex._skewed(S)
+    # uniform plan rows: W * round_up_pow2(max) = 8 * 65536
+    uniform_rows = 8 * (1 << 16)
+    onefactor_rows = sum(
+        max(int(S[np.arange(8), (np.arange(8) + r) % 8].max()), 1)
+        for r in range(1, 8))
+    assert onefactor_rows * 8 < uniform_rows
+
+
+def test_sticky_capacities_stop_recompile_churn(monkeypatch):
+    """Across loop iterations with wiggling counts, executables and
+    capacities must reach a fixed point (no unbounded cache growth)."""
+    ctx = _ctx(5)
+    mex = ctx.mesh_exec
+    rng = np.random.default_rng(1)
+
+    def map_fn(x):          # defined once: loop bodies must not mint
+        return (x, 1)       # fresh lambdas or nothing can ever cache
+
+    def red_fn(a, b):
+        return a + b
+
+    sizes = []
+    for it in range(6):
+        # sizes wiggle around a power-of-two boundary
+        n = 4000 + int(rng.integers(-300, 300))
+        vals = rng.integers(0, 50, n).astype(np.int64)
+        out = ctx.Distribute(vals).Map(map_fn).ReducePair(red_fn)
+        assert out.Size() == len(set(vals.tolist()))
+        sizes.append(len(mex._cache))
+    # after warmup the executable cache stops growing: capacities are
+    # sticky, so count wiggles reuse the same compiled programs
+    assert sizes[-1] == sizes[2], sizes
+    ctx.close()

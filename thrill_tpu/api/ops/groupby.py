@@ -284,12 +284,17 @@ class GroupToIndexNode(DIABase):
         leaves, treedef = jax.tree.flatten(shards.tree)
         local_sizes = (bounds[1:] - bounds[:-1]).astype(np.int64)
         out_cap = max(1, int(local_sizes.max()))
-        key = ("g2i_device", index_fn, device_fn, n, cap, out_cap, treedef,
+        import jax as _jax
+        neutral_token = (None if neutral is None else
+                         (str(_jax.tree.structure(neutral)),
+                          tuple(repr(x) for x in _jax.tree.leaves(neutral))))
+        key = ("g2i_device", index_fn, device_fn, n, neutral_token, cap,
+               out_cap, treedef,
                tuple((l.dtype, l.shape[2:]) for l in leaves))
         holder = {}
 
         def build():
-            def f(counts_dev, range_start, range_size, *ls):
+            def f(counts_dev, range_start, *ls):
                 valid = jnp.arange(cap) < counts_dev[0, 0]
                 tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
                 idx = jnp.asarray(index_fn(tree)).astype(jnp.int64)
@@ -317,18 +322,17 @@ class GroupToIndexNode(DIABase):
                             lambda l: fill(l, neutral), out_tree)
                 out_leaves, out_td = jax.tree.flatten(out_tree)
                 holder["treedef"] = out_td
-                return (range_size[0].astype(jnp.int32)[None],
-                        *[l[None] for l in out_leaves])
+                return tuple(l[None] for l in out_leaves)
 
-            return mex.smap(f, 3 + len(leaves)), holder
+            return mex.smap(f, 2 + len(leaves)), holder
 
         fn, h = mex.cached(key, build)
         out = fn(shards.counts_device(),
-                 mex.put(bounds[:-1].astype(np.int64)[:, None]),
-                 mex.put(local_sizes[:, None]), *leaves)
-        new_counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
-        tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
-        return DeviceShards(mex, tree, new_counts)
+                 mex.put(bounds[:-1].astype(np.int64)[:, None]), *leaves)
+        tree = jax.tree.unflatten(h["treedef"], list(out))
+        # per-worker result counts are the host-known range sizes — no
+        # device round trip needed
+        return DeviceShards(mex, tree, local_sizes.copy())
 
 
 def GroupByKey(dia: DIA, key_fn, group_fn, device_fn=None) -> DIA:

@@ -65,10 +65,15 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
 class ReduceNode(DIABase):
     def __init__(self, ctx, link, key_fn: Callable, reduce_fn: Callable,
                  label: str = "ReduceByKey",
-                 dup_detection: bool = False) -> None:
+                 dup_detection: bool = False, token=None) -> None:
         super().__init__(ctx, label, [link])
         self.key_fn = key_fn
         self.reduce_fn = reduce_fn
+        # executable-cache token. When a wrapper (ReducePair) mints
+        # fresh closures per call, it must pass a token derived from
+        # the USER's stable functions, or loops recompile every
+        # iteration.
+        self.token = token if token is not None else (key_fn, reduce_fn)
         # reference: DuplicateDetectionTag, api/reduce_by_key.hpp — skip
         # shuffling keys whose hash is globally unique (host path)
         self.dup_detection = dup_detection
@@ -78,7 +83,7 @@ class ReduceNode(DIABase):
         if isinstance(shards, HostShards):
             return self._compute_host(shards)
         key_fn, reduce_fn = self.key_fn, self.reduce_fn
-        token = (key_fn, reduce_fn)
+        token = self.token
         W = self.context.num_workers
         # pre-phase: local combine (reference: ReducePrePhase)
         pre = _local_reduce_device(shards, key_fn, reduce_fn, "pre", token)
@@ -140,7 +145,8 @@ def ReducePair(dia: DIA, value_reduce_fn: Callable) -> DIA:
         return (a[0], value_reduce_fn(a[1], b[1]))
 
     return DIA(ReduceNode(dia.context, dia._link(), key_fn, reduce_fn,
-                          label="ReducePair"))
+                          label="ReducePair",
+                          token=("ReducePair", value_reduce_fn)))
 
 
 class ReduceToIndexNode(DIABase):

@@ -56,7 +56,10 @@ class Config:
     # Preferred storage for ambiguous sources: 'device' or 'host'.
     default_storage: str = "device"
     # Exchange implementation: 'dense' (padded all_to_all; works on all
-    # platforms) or 'ragged' (lax.ragged_all_to_all; TPU-only fast path).
+    # platforms; auto-switches to 1-factor rounds when the send matrix
+    # is skewed), 'onefactor' (always W-1 ppermute rounds, each padded
+    # to its own pair maximum — skew-proof), or 'ragged'
+    # (lax.ragged_all_to_all; TPU-only fast path).
     exchange: str = "dense"
     # Item-capacity granularity for device block padding (power of two).
     block_items: int = 1024

@@ -48,8 +48,11 @@ class MeshExec:
         self.stats_exchanges = 0
         self.stats_items_moved = 0
         self.stats_bytes_moved = 0
-        # exchange implementation ('dense' | 'ragged'); Context sets it
-        # from Config.exchange, THRILL_TPU_EXCHANGE env overrides
+        # padded rows allocated by exchange plans (skew diagnostics)
+        self.stats_padded_rows = 0
+        # exchange implementation ('dense' | 'onefactor' | 'ragged');
+        # Context sets it from Config.exchange, THRILL_TPU_EXCHANGE
+        # env overrides ('dense' auto-switches to 1-factor under skew)
         self.exchange_mode = "dense"
 
     # -- shardings ------------------------------------------------------
