@@ -15,8 +15,9 @@ from .stack import Stack, apply_stack_traced, stack_cache_token
 def apply_stack_device(shards: DeviceShards, stack: Stack) -> DeviceShards:
     """Apply an LOp stack to device shards as one fused jitted program.
 
-    Compacts valid items to the front and refreshes per-worker counts
-    (one tiny device->host transfer for the counts).
+    Compacts valid items to the front; the refreshed per-worker counts
+    stay device-resident (DeviceShards fetches them lazily only where a
+    plan step needs host values).
     """
     if not stack:
         return shards
@@ -43,6 +44,6 @@ def apply_stack_device(shards: DeviceShards, stack: Stack) -> DeviceShards:
 
     fn, h = mex.cached(key, build)
     out = fn(shards.counts_device(), *leaves)
-    new_counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
     tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
-    return DeviceShards(mex, tree, new_counts)
+    # counts stay on device: no host sync between chained programs
+    return DeviceShards(mex, tree, out[0])

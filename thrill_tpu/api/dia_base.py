@@ -92,9 +92,18 @@ class DIABase:
                 # spilling a kept sibling for — skip the LRU entirely
                 hbm.on_cache(self)
             if log.enabled:
+                # never FORCE a counts fetch for the log line: it would
+                # reintroduce a per-op host sync, and (multi-controller)
+                # a fetch conditional on local logger settings would
+                # issue asymmetric collectives across processes
+                host_counts = getattr(self._shards, "_counts_host",
+                                      self._shards.counts
+                                      if isinstance(self._shards,
+                                                    HostShards) else None)
                 log.line(event="node_execute_done", node=self.label,
                          dia_id=self.id,
-                         items=int(self._shards.counts.sum()))
+                         items=(int(host_counts.sum())
+                                if host_counts is not None else None))
         else:
             # LRU bump; transparently re-uploads a spilled result
             hbm.touch(self)

@@ -130,9 +130,8 @@ class GroupByKeyNode(DIABase):
 
         fn, h = mex.cached(key, build)
         out = fn(shards.counts_device(), *leaves)
-        new_counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
         tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
-        return DeviceShards(mex, tree, new_counts)
+        return DeviceShards(mex, tree, out[0])
 
     def _group_sorted_host(self, shards: DeviceShards) -> HostShards:
         """Arbitrary group_fn: device sort + ONE vectorized boundary

@@ -57,9 +57,10 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
 
     fn = mex.cached(key, build)
     out = fn(shards.counts_device(), *leaves)
-    new_counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
     tree = jax.tree.unflatten(treedef, list(out[1:]))
-    return DeviceShards(mex, tree, new_counts)
+    # counts stay on device: pre-phase -> exchange phase A dispatches
+    # back-to-back with no host sync in between
+    return DeviceShards(mex, tree, out[0])
 
 
 class ReduceNode(DIABase):

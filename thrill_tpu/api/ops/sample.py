@@ -57,9 +57,8 @@ class BernoulliSampleNode(DIABase):
 
         fn = mex.cached(key, build)
         out = fn(shards.counts_device(), *leaves)
-        counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
         tree = jax.tree.unflatten(treedef, list(out[1:]))
-        return DeviceShards(mex, tree, counts)
+        return DeviceShards(mex, tree, out[0])
 
 
 class SampleNode(DIABase):
@@ -113,9 +112,8 @@ class SampleNode(DIABase):
         fn = mex.cached(key, build)
         out = fn(shards.counts_device(),
                  mex.put(takes.astype(np.int64)[:, None]), *leaves)
-        counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
         tree = jax.tree.unflatten(treedef, list(out[1:]))
-        return DeviceShards(mex, tree, counts)
+        return DeviceShards(mex, tree, out[0])
 
 
 def BernoulliSample(dia: DIA, p: float, seed: int = 0) -> DIA:

@@ -112,9 +112,8 @@ class WindowNode(DIABase):
         f, h = mex.cached(key, build)
         out = f(shards.counts_device(),
                 mex.put(offsets.astype(np.int64)[:, None]), *leaves)
-        counts = mex.fetch(out[0]).reshape(-1).astype(np.int64)
         tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
-        return DeviceShards(mex, tree, counts)
+        return DeviceShards(mex, tree, out[0])
 
 
 class FlatWindowNode(DIABase):

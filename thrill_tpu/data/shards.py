@@ -20,7 +20,7 @@ Two storage classes implement one concept:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Sequence
+from typing import Any, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -38,13 +38,40 @@ def tree_map(fn, *trees):
     return jax.tree.map(fn, *trees)
 
 
-@dataclasses.dataclass
 class DeviceShards:
-    """Columnar device storage: leaves [W, cap, ...], sharded on axis 0."""
+    """Columnar device storage: leaves [W, cap, ...], sharded on axis 0.
 
-    mesh_exec: MeshExec
-    tree: Any                  # pytree of jax arrays [W, cap, *]
-    counts: np.ndarray         # host copy of per-worker valid counts [W]
+    Per-worker valid counts live in EITHER form and convert lazily:
+
+    * host (numpy [W] int64) — needed by plan steps (exchange sizing,
+      splitters, action results);
+    * device (sharded [W, 1] int32, a program output) — enough to feed
+      the next jitted program.
+
+    A chain of device operators therefore never blocks on a
+    device->host counts fetch between programs: jax's async dispatch
+    keeps the device running ahead, and the host syncs only where a
+    plan genuinely needs the numbers (the analog of the reference's
+    overlapped post-phase thread, api/reduce_by_key.hpp:142-168).
+    """
+
+    def __init__(self, mesh_exec: MeshExec, tree: Any, counts) -> None:
+        self.mesh_exec = mesh_exec
+        self.tree = tree
+        if isinstance(counts, np.ndarray):
+            self._counts_host: Optional[np.ndarray] = counts
+            self._counts_dev = None
+        else:
+            self._counts_host = None
+            self._counts_dev = counts          # sharded [W, 1] int32
+
+    @property
+    def counts(self) -> np.ndarray:
+        """Host counts; fetches (and caches) from device on first use."""
+        if self._counts_host is None:
+            self._counts_host = self.mesh_exec.fetch(
+                self._counts_dev).reshape(-1).astype(np.int64)
+        return self._counts_host
 
     @property
     def num_workers(self) -> int:
@@ -59,8 +86,12 @@ class DeviceShards:
         return int(self.counts.sum())
 
     def counts_device(self) -> jax.Array:
-        """Counts as a sharded [W, 1] device array (one scalar per shard)."""
-        return self.mesh_exec.put(self.counts.astype(np.int32)[:, None])
+        """Counts as a sharded [W, 1] device array (one scalar per
+        shard); cached so repeated programs reuse one transfer."""
+        if self._counts_dev is None:
+            self._counts_dev = self.mesh_exec.put(
+                self.counts.astype(np.int32)[:, None])
+        return self._counts_dev
 
     # -- conversion -----------------------------------------------------
     @staticmethod
