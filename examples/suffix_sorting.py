@@ -54,6 +54,107 @@ def suffix_array(ctx: Context, text: np.ndarray) -> np.ndarray:
             return si
 
 
+def dc3_suffix_array(ctx: Context, text: np.ndarray) -> np.ndarray:
+    """DC3 (difference cover mod 3, a.k.a. skew) suffix array.
+
+    Reference: /root/reference/examples/suffix_sorting/dc3.cpp — the
+    heaviest recursive Sort stress test of the reference suite. The
+    heavy phases ride the device: the (t_i, t_{i+1}, t_{i+2}) triple
+    sort of the mod-1/mod-2 sample and the (t_i, rank_{i+1}) sort of
+    the mod-0 class are DIA Sorts at every recursion level; lexicographic
+    naming and the class-aware 3-way merge are linear host passes.
+    """
+    T = np.asarray(text, dtype=np.int64) + 1     # 0 reserved as sentinel
+    return _dc3(ctx, T)
+
+
+def _dc3(ctx: Context, T: np.ndarray) -> np.ndarray:
+    n = len(T)
+    if n <= 3:
+        return np.array(sorted(range(n),
+                               key=lambda i: tuple(T[i:]) + (0,)),
+                        dtype=np.int64)
+
+    # canonical Kärkkäinen–Sanders counts: when n % 3 == 1 the sample
+    # gains the dummy position n (triple (0,0,0)), so the mod-1 section
+    # of the recursion string ends with a unique smallest terminator
+    n0 = (n + 2) // 3
+    n1 = (n + 1) // 3
+    ext = n0 - n1                    # 1 iff n % 3 == 1
+    m = n + ext
+    Tp = np.concatenate([T, np.zeros(3 + ext, dtype=np.int64)])
+    s12 = np.array([i for i in range(m) if i % 3 != 0], dtype=np.int64)
+
+    # device sort of the sample triples (the hot phase)
+    d = ctx.Distribute({"i": s12, "a": Tp[s12], "b": Tp[s12 + 1],
+                        "c": Tp[s12 + 2]})
+    got = d.Sort(key_fn=lambda t: (t["a"], t["b"], t["c"])).AllGather()
+    order = np.array([int(t["i"]) for t in got], dtype=np.int64)
+    trip = np.array([[int(t["a"]), int(t["b"]), int(t["c"])]
+                     for t in got], dtype=np.int64)
+
+    # lexicographic names: 1 + count of strict triple boundaries
+    boundary = np.ones(len(order), dtype=np.int64)
+    if len(order) > 1:
+        boundary[1:] = np.any(trip[1:] != trip[:-1], axis=1)
+    names_sorted = np.cumsum(boundary)
+    num_names = int(names_sorted[-1])
+    name_of = np.zeros(m + 3, dtype=np.int64)
+    name_of[order] = names_sorted
+
+    if num_names < len(s12):
+        # names collide: recurse on the sample string (mod-1 positions
+        # then mod-2 positions, the canonical DC3 arrangement)
+        ones = np.array([i for i in range(m) if i % 3 == 1])
+        twos = np.array([i for i in range(m) if i % 3 == 2])
+        R = np.concatenate([name_of[ones], name_of[twos]])
+        SA_R = _dc3(ctx, R)
+        k1 = len(ones)
+        SA12 = np.where(SA_R < k1, 1 + 3 * SA_R, 2 + 3 * (SA_R - k1))
+    else:
+        SA12 = order
+
+    # rank of each sample suffix in SA12 (1-based; 0 = beyond end)
+    rank12 = np.zeros(m + 3, dtype=np.int64)
+    rank12[SA12] = np.arange(1, len(SA12) + 1)
+    # the dummy (position n, empty suffix) leaves the output
+    SA12 = SA12[SA12 < n]
+
+    # device sort of the mod-0 class by (t_i, rank_{i+1})
+    s0 = np.array([i for i in range(n) if i % 3 == 0], dtype=np.int64)
+    d0 = ctx.Distribute({"i": s0, "a": Tp[s0], "r": rank12[s0 + 1]})
+    got0 = d0.Sort(key_fn=lambda t: (t["a"], t["r"])).AllGather()
+    SA0 = np.array([int(t["i"]) for t in got0], dtype=np.int64)
+
+    # class-aware linear merge (reference: dc3.cpp merge comparators)
+    def leq12(i, j):
+        """suffix i (mod 1 or 2) <= suffix j (mod 0)?"""
+        if i % 3 == 1:
+            return (Tp[i], rank12[i + 1]) <= (Tp[j], rank12[j + 1])
+        return (Tp[i], Tp[i + 1], rank12[i + 2]) <= \
+            (Tp[j], Tp[j + 1], rank12[j + 2])
+
+    out = np.empty(n, dtype=np.int64)
+    a = b = k = 0
+    while a < len(SA12) and b < len(SA0):
+        if leq12(int(SA12[a]), int(SA0[b])):
+            out[k] = SA12[a]
+            a += 1
+        else:
+            out[k] = SA0[b]
+            b += 1
+        k += 1
+    while a < len(SA12):
+        out[k] = SA12[a]
+        a += 1
+        k += 1
+    while b < len(SA0):
+        out[k] = SA0[b]
+        b += 1
+        k += 1
+    return out
+
+
 def suffix_array_dense(text: np.ndarray) -> np.ndarray:
     s = bytes(text)
     return np.array(sorted(range(len(s)), key=lambda i: s[i:]),

@@ -444,3 +444,81 @@ def test_group_to_index_device_fn():
             lambda x: x, None, 5, neutral=-1, device_fn=device_fn)
         assert [int(x) for x in out2.AllGather()] == [0, 1, 2, -1, 4]
     sweep(job)
+
+
+def test_merge_three_inputs_with_ties():
+    """Merge exploits sortedness; ties order by input index (the
+    reference's tie ordering), sizes may differ."""
+    def job(ctx):
+        a = ctx.Distribute(np.array([1, 3, 5, 7, 7, 9], dtype=np.int64))
+        b = ctx.Distribute(np.array([1, 2, 7, 8], dtype=np.int64))
+        c = ctx.Distribute(np.array([0, 7], dtype=np.int64))
+        m = Merge(a, b, c, key_fn=lambda kv: kv)
+        got = [int(v) for v in m.AllGather()]
+        assert got == sorted([1, 3, 5, 7, 7, 9, 1, 2, 7, 8, 0, 7])
+
+        # tie order: tag items by input, equal keys keep input order
+        a2 = ctx.Distribute(np.array([5, 5], dtype=np.int64)).Map(
+            lambda x: (x, 0))
+        b2 = ctx.Distribute(np.array([5], dtype=np.int64)).Map(
+            lambda x: (x, 1))
+        m2 = Merge(a2, b2, key_fn=lambda kv: kv[0])
+        tags = [int(t) for _, t in m2.AllGather()]
+        assert tags == [0, 0, 1]
+    sweep(job)
+
+
+def test_gather_root_and_storage_moves():
+    def job(ctx):
+        d = ctx.Generate(50)
+        d.Keep(2)
+        # single-controller: every worker is local, root receives
+        assert [int(x) for x in d.Gather(root=1)] == list(range(50))
+        # explicit storage moves round-trip
+        h = d.ToHost()
+        hv = h.Keep().AllGather()
+        assert [int(x) for x in hv] == list(range(50))
+        back = h.ToDevice().Map(lambda x: x + 1)
+        assert [int(x) for x in back.AllGather()] == list(range(1, 51))
+    sweep(job)
+
+
+def _merge_key(x):
+    return x
+
+
+def test_merge_executable_cache_hit():
+    """Second identical Merge in one context must reuse cached
+    executables (regression: holder KeyError on cache hit)."""
+    import jax
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    ctx = Context(MeshExec(devices=jax.devices("cpu")[:4]))
+    for _ in range(2):
+        a = ctx.Distribute(np.arange(0, 64, 2).astype(np.int64))
+        b = ctx.Distribute(np.arange(1, 64, 2).astype(np.int64))
+        m = Merge(a, b, key_fn=_merge_key)
+        assert [int(v) for v in m.AllGather()] == list(range(64))
+    ctx.close()
+
+
+def test_em_sort_duplicate_heavy_balanced(monkeypatch):
+    """EM host sort with one dominating key must not pile every
+    duplicate onto worker 0 (position tiebreak in the splitters)."""
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "64")
+    import jax
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    ctx = Context(MeshExec(devices=jax.devices("cpu")[:4]))
+    vals = ["x"] * 2000 + ["y"] * 10
+    d = ctx.Distribute(vals, storage="host")
+    srt = d.Sort()
+    shards = srt.node.materialize()
+    sizes = [len(l) for l in shards.lists]
+    assert sum(sizes) == 2010
+    assert max(sizes) < 2000, sizes  # duplicates split across workers
+    flat = [it for l in shards.lists for it in l]
+    assert flat == sorted(vals)
+    ctx.close()

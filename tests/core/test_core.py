@@ -130,3 +130,29 @@ def test_join_with_location_detection_matches_plain():
                       location_detection=True)
         assert sorted(j.AllGather()) == [("a", 1, 10)]
     RunLocalMock(job, 4)
+
+
+def test_multiway_merge_degree_cap():
+    """1000 spilled runs merge with bounded open-reader degree
+    (reference: MaxMergeDegreePrefetch + partial merges)."""
+    import numpy as np
+    from thrill_tpu.core.multiway_merge import multiway_merge_files
+    from thrill_tpu.data.block_pool import BlockPool
+    from thrill_tpu.data.file import File
+
+    rng = np.random.default_rng(0)
+    pool = BlockPool(soft_limit=1 << 20)
+    files = []
+    all_vals = []
+    for _ in range(1000):
+        vals = sorted(rng.integers(0, 10_000, 5).tolist())
+        all_vals.extend(vals)
+        f = File(pool=pool)
+        with f.writer() as w:
+            for v in vals:
+                w.put(v)
+        files.append(f)
+    merged = list(multiway_merge_files(files, consume=True,
+                                       max_merge_degree=8))
+    assert merged == sorted(all_vals)
+    pool.close()

@@ -115,6 +115,25 @@ def test_suffix_array():
     RunLocalMock(job, 4)
 
 
+def test_dc3_suffix_array():
+    """DC3 golden test on the virtual mesh (reference: dc3.cpp) —
+    recursion-forcing inputs (heavy repeats) included."""
+    rng = np.random.default_rng(11)
+
+    def job(ctx):
+        for text in (
+            rng.integers(97, 100, 200).astype(np.uint8),   # random
+            np.frombuffer(b"abcabcabcabcabcabcab", np.uint8).copy(),
+            np.frombuffer(b"aaaaaaaaaaaaaaaa", np.uint8).copy(),
+            np.frombuffer(b"mississippi", np.uint8).copy(),
+            np.frombuffer(b"ab", np.uint8).copy(),
+        ):
+            got = ss.dc3_suffix_array(ctx, text)
+            want = ss.suffix_array_dense(text)
+            assert np.array_equal(got, want), bytes(text)[:20]
+    RunLocalMock(job, 4)
+
+
 def test_triangles():
     rng = np.random.default_rng(9)
     raw = rng.integers(0, 30, (120, 2))

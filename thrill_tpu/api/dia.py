@@ -142,6 +142,16 @@ class DIA:
     # ------------------------------------------------------------------
     # consume control / materialization nodes
     # ------------------------------------------------------------------
+    def ToHost(self) -> "DIA":
+        """Explicitly demote to host item-list storage (logged)."""
+        from .ops import lop_nodes
+        return lop_nodes.to_host(self)
+
+    def ToDevice(self) -> "DIA":
+        """Explicitly promote host items to columnar device storage."""
+        from .ops import lop_nodes
+        return lop_nodes.to_device(self)
+
     def Keep(self, n: int = 1) -> "DIA":
         self.node.keep(n)
         return self

@@ -38,7 +38,21 @@ def AllGather(dia) -> list:
 
 
 def Gather(dia, root: int = 0) -> list:
-    return AllGather(dia)
+    """Items of the whole DIA, delivered to worker ``root`` only
+    (reference: api/gather.hpp:28). Single-controller runs ARE every
+    worker, so they receive the list; in multi-controller runs only the
+    process hosting worker ``root`` gets the items — the others get []
+    (the reference's non-root workers likewise emit nothing)."""
+    shards = _pull(dia)
+    if isinstance(shards, DeviceShards):
+        mex = shards.mesh_exec
+        root = root % max(mex.num_workers, 1)
+        owner = mex.devices[root].process_index
+        shards = shards.to_host_shards("gather-action")
+        import jax as _jax
+        if owner != _jax.process_index():
+            return []
+    return [it for l in shards.lists for it in l]
 
 
 def Print(dia, label: str = "", limit: int = 100) -> None:

@@ -1,27 +1,52 @@
 """Merge of sorted DIAs.
 
 Reference: thrill/api/merge.hpp:76 — distributed multi-sequence
-selection (iterative pivot search over the sorted inputs) to find
+selection (iterative pivot search over the sorted inputs,
+SelectPivots/GetGlobalRanks/SearchStep at merge.hpp:325-429) to find
 balanced split points, then stream exchange + local k-way merge.
 
-Device translation: a concatenation that tags items with (input index,
-position) followed by the sample-sort machinery keyed on the user key
-degenerates to exactly the merge semantics — inputs are already sorted,
-so splitter sampling is cheap and the final local sort is a near-sorted
-bitonic pass. Equal keys order by input index then original position
-(the reference's tie ordering).
+TPU-native design that actually EXPLOITS sortedness (round-1 review:
+the old path concatenated and re-ran the full sample sort):
+
+ 1. sample:   inputs are already key-sorted, so splitter samples are
+              plain quantile *reads* of each worker's sorted columns —
+              NO local sort, NO payload movement. The host merges all
+              inputs' samples and picks W-1 splitters (the
+              single-controller collapse of the reference's pivot
+              search).
+ 2. classify: per input, destination = rank among splitters of
+              (key words, input index, position) — monotone along each
+              already-sorted input, so items ship through
+              ``exchange_presorted`` with an IDENTITY permutation: the
+              payload is never gathered before the exchange.
+ 3. combine:  each worker holds k x W sorted runs (rank-ordered by
+              construction); one argsort of the (validity, key words,
+              input index, position) words + a single payload gather
+              produces the merged output. Equal keys order by input
+              index then original position — the reference's tie order.
+
+Total sort-network work: ONE argsort of key words per worker, versus
+three full sorts in the naive concat+sort formulation.
 """
 
 from __future__ import annotations
 
+import heapq
 from typing import Callable, List, Optional
 
-import heapq
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
 
+from ...core import keys as keymod
+from ...data import exchange
 from ...data.shards import DeviceShards, HostShards
+from ...parallel.mesh import AXIS
 from ..dia import DIA
 from ..dia_base import DIABase
-from .sort import _device_sample_sort
+from .sort import (OVERSAMPLE, _lex_greater, choose_splitters,
+                   quantile_positions)
 
 
 class MergeNode(DIABase):
@@ -32,21 +57,219 @@ class MergeNode(DIABase):
     def compute(self):
         pulls = [l.pull() for l in self.parents]
         if any(isinstance(p, HostShards) for p in pulls):
-            pulls = [p.to_host_shards("merge-host-path") if isinstance(p, DeviceShards)
-                     else p for p in pulls]
+            pulls = [p.to_host_shards("merge-host-path")
+                     if isinstance(p, DeviceShards) else p for p in pulls]
             W = pulls[0].num_workers
             seqs = [[it for lst in p.lists for it in lst] for p in pulls]
             merged = list(heapq.merge(*seqs, key=self.key_fn))
             bounds = [(w * len(merged)) // W for w in range(W + 1)]
             return HostShards(W, [merged[bounds[w]:bounds[w + 1]]
                                   for w in range(W)])
-        # device: order-preserving concat (keeps input-rank global order
-        # as the stability tiebreak), then stable sample sort
-        from .concat import rebalance_to_even
-        combined = rebalance_to_even(pulls[0].mesh_exec, pulls,
-                                     ("merge", self.id))
-        return _device_sample_sort(combined, self.key_fn,
-                                   ("merge", self.key_fn))
+        return _device_merge(pulls, self.key_fn, ("merge", self.key_fn))
+
+
+def _device_merge(inputs: List[DeviceShards], key_fn: Callable,
+                  token) -> DeviceShards:
+    mex = inputs[0].mesh_exec
+    W = mex.num_workers
+    k = len(inputs)
+    if sum(s.total for s in inputs) == 0:
+        return inputs[0]
+
+    # ---- phase 1: quantile samples of the (already sorted) inputs ----
+    # A sorted column's quantiles are direct reads — no sort, no gather.
+    all_samples = []          # (words..., input_idx, gidx) tuples
+    nwords_holder = {}
+    samples_per_input = []
+    for i, shards in enumerate(inputs):
+        cap = shards.cap
+        leaves, treedef = jax.tree.flatten(shards.tree)
+        offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
+        key1 = ("merge_sample", token, i, cap, treedef,
+                tuple((l.dtype, l.shape[2:]) for l in leaves))
+
+        def build1(cap=cap, treedef=treedef):
+            holder = {}
+
+            def f(counts_dev, offset_dev, *ls):
+                count = counts_dev[0, 0]
+                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+                words = keymod.encode_key_words(key_fn(tree))
+                holder["n"] = len(words)
+                gidx = offset_dev[0, 0] + jnp.arange(cap, dtype=jnp.int64)
+                qpos = quantile_positions(count, cap)
+                s_words = jnp.stack([jnp.take(w, qpos) for w in words], 1)
+                s_idx = jnp.take(gidx, qpos)
+                s_valid = qpos < count
+                return (lax.all_gather(s_words, AXIS),
+                        lax.all_gather(s_idx, AXIS),
+                        lax.all_gather(s_valid, AXIS))
+
+            from jax.sharding import PartitionSpec as P
+            # holder is cached WITH the executable: cache hits must not
+            # leave it unpopulated
+            return (mex.smap(f, 2 + len(leaves),
+                             out_specs=(P(), P(), P())), holder)
+
+        f1, h1 = mex.cached(key1, build1)
+        sw, si, sv = f1(shards.counts_device(),
+                        mex.put(offsets.astype(np.int64)[:, None]),
+                        *leaves)
+        nwords_holder.update(h1)
+        samples_per_input.append((mex.fetch(sw), mex.fetch(si),
+                                  mex.fetch(sv)))
+
+    nwords = nwords_holder["n"]
+    for i, (sw, si, sv) in enumerate(samples_per_input):
+        sw = sw.reshape(W * OVERSAMPLE, nwords)
+        si = si.reshape(-1)
+        sv = sv.reshape(-1)
+        for j in range(len(sv)):
+            if sv[j]:
+                all_samples.append(
+                    (tuple(int(x) for x in sw[j]), i, int(si[j])))
+    all_samples.sort()
+    # W-1 splitters over (words, input_idx, gidx)
+    splitters = choose_splitters(
+        [s[0] + (s[1], s[2]) for s in all_samples], W, nwords + 2)
+
+    # ---- phase 2: classify (monotone) + ship via presorted exchange --
+    carriers = []
+    for i, shards in enumerate(inputs):
+        cap = shards.cap
+        leaves, treedef = jax.tree.flatten(shards.tree)
+        offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
+        if W == 1:
+            # single worker: nothing to ship; build the carrier directly
+            key2 = ("merge_carrier1", token, i, cap, treedef,
+                    tuple((l.dtype, l.shape[2:]) for l in leaves))
+
+            def build2a(cap=cap, treedef=treedef):
+                def f(counts_dev, offset_dev, *ls):
+                    tree = jax.tree.unflatten(treedef,
+                                              [l[0] for l in ls])
+                    words = keymod.encode_key_words(key_fn(tree))
+                    gidx = (offset_dev[0, 0]
+                            + jnp.arange(cap, dtype=jnp.int64))
+                    return (jnp.stack(words, 1)[None], gidx[None],
+                            *[l for l in ls])
+
+                return mex.smap(f, 2 + len(leaves))
+
+            f2 = mex.cached(key2, build2a)
+            out2 = f2(shards.counts_device(),
+                      mex.put(offsets.astype(np.int64)[:, None]),
+                      *leaves)
+            carrier_tree = {"__words": out2[0], "__gidx": out2[1],
+                            "tree": jax.tree.unflatten(treedef,
+                                                       list(out2[2:]))}
+            carriers.append(DeviceShards(mex, carrier_tree,
+                                         shards.counts.copy()))
+            continue
+
+        key2 = ("merge_classify", token, i, W, cap, nwords, treedef,
+                tuple((l.dtype, l.shape[2:]) for l in leaves))
+
+        def build2(cap=cap, treedef=treedef, i=i, nleaves=len(leaves)):
+            def f(spl_a, counts_dev, offset_dev, *ls):
+                spl = spl_a[0]                      # [W-1, nwords+2]
+                count = counts_dev[0, 0]
+                valid = jnp.arange(cap) < count
+                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+                words = keymod.encode_key_words(key_fn(tree))
+                wm = jnp.stack(words, 1)
+                gidx = (offset_dev[0, 0]
+                        + jnp.arange(cap, dtype=jnp.int64))
+                # destination = #splitters below (words, input, gidx);
+                # monotone because the input is sorted
+                iw = jnp.full(cap, i, dtype=jnp.uint64)
+                d = jnp.zeros(cap, dtype=jnp.int32)
+                for j in range(W - 1):
+                    gt = _lex_greater(
+                        jnp.concatenate([wm, iw[:, None]], axis=1),
+                        gidx.astype(jnp.uint64), spl[j])
+                    d = d + gt.astype(jnp.int32)
+                dest = jnp.where(valid, d, W)
+                all_send = exchange.send_counts(dest, W)
+                return (dest[None], all_send, wm[None], gidx[None],
+                        *[l for l in ls])
+
+            from jax.sharding import PartitionSpec as P
+            return mex.smap(f, 3 + nleaves,
+                            out_specs=(P(AXIS), P())
+                            + (P(AXIS),) * (2 + nleaves))
+
+        f2 = mex.cached(key2, build2)
+        spl_dev = mex.put(np.broadcast_to(
+            splitters, (W,) + splitters.shape).copy())
+        out2 = f2(spl_dev, shards.counts_device(),
+                  mex.put(offsets.astype(np.int64)[:, None]), *leaves)
+        sorted_dest, send_mat = out2[0], out2[1]
+        carrier_tree = {"__words": out2[2], "__gidx": out2[3],
+                        "tree": jax.tree.unflatten(treedef,
+                                                   list(out2[4:]))}
+        carrier_leaves, treedef3 = jax.tree.flatten(carrier_tree)
+        S = mex.fetch(send_mat)
+        carriers.append(exchange.exchange_presorted(
+            mex, treedef3, sorted_dest, carrier_leaves, S,
+            ident=("merge_x", token, i)))
+
+    # ---- phase 3: one local merge sort over all received runs -------
+    caps = tuple(c.cap for c in carriers)
+    leaves_per, treedefs = zip(*(jax.tree.flatten(c.tree)
+                                 for c in carriers))
+    nleaves_per = tuple(len(ls) for ls in leaves_per)
+    key3 = ("merge_final", token, caps, treedefs,
+            tuple(tuple((l.dtype, l.shape[2:]) for l in ls)
+                  for ls in leaves_per))
+    payload_treedef = jax.tree.structure(inputs[0].tree)
+
+    def build3():
+        def f(*args):
+            counts = args[:k]
+            rest = list(args[k:])
+            words_all, iw_all, gidx_all, valid_all, payload_all = \
+                [], [], [], [], None
+            for i in range(k):
+                ls = rest[:nleaves_per[i]]
+                rest_i = [l[0] for l in ls]
+                del rest[:nleaves_per[i]]
+                tree = jax.tree.unflatten(treedefs[i], rest_i)
+                wm = tree["__words"]
+                gi = tree["__gidx"]
+                cap_i = wm.shape[0]
+                valid = jnp.arange(cap_i) < counts[i][0, 0]
+                words_all.append(wm)
+                iw_all.append(jnp.full(cap_i, i, jnp.uint64))
+                gidx_all.append(gi.astype(jnp.uint64))
+                valid_all.append(valid)
+                pl = jax.tree.leaves(tree["tree"])
+                payload_all = ([jnp.concatenate([a, b], axis=0)
+                                for a, b in zip(payload_all, pl)]
+                               if payload_all is not None else pl)
+            wm = jnp.concatenate(words_all, axis=0)
+            iw = jnp.concatenate(iw_all)
+            gi = jnp.concatenate(gidx_all)
+            valid = jnp.concatenate(valid_all)
+            from ...core.device_sort import argsort_words
+            sort_words = ([(~valid).astype(jnp.uint64)]
+                          + [wm[:, j] for j in range(nwords)]
+                          + [iw, gi])
+            perm = argsort_words(sort_words)
+            outs = [jnp.take(l, perm, axis=0)[None] for l in payload_all]
+            return tuple(outs)
+
+        return mex.smap(f, k + sum(nleaves_per))
+
+    f3 = mex.cached(key3, build3)
+    args = [c.counts_device() for c in carriers]
+    for ls in leaves_per:
+        args.extend(ls)
+    out3 = f3(*args)
+    tree = jax.tree.unflatten(payload_treedef, list(out3))
+    new_counts = sum((c.counts for c in carriers),
+                     np.zeros(W, dtype=np.int64))
+    return DeviceShards(mex, tree, new_counts)
 
 
 def Merge(dias: List[DIA], key_fn=None) -> DIA:

@@ -50,6 +50,29 @@ from ..dia_base import DIABase
 OVERSAMPLE = 32  # samples per worker; splitter error ~ 1/OVERSAMPLE
 
 
+def quantile_positions(count, cap: int):
+    """Traced helper: OVERSAMPLE quantile positions over the valid
+    prefix [0, count) of a sorted column (clipped to [0, cap))."""
+    count_f = jnp.maximum(count, 1)
+    qpos = ((jnp.arange(OVERSAMPLE, dtype=jnp.int64) * 2 + 1)
+            * count_f // (2 * OVERSAMPLE))
+    return jnp.clip(qpos, 0, cap - 1)
+
+
+def choose_splitters(samples, W: int, ncols: int) -> np.ndarray:
+    """Host helper: W-1 equidistant splitters from SORTED sample tuples
+    (each a flat tuple of ints, ncols wide) -> uint64 matrix
+    [max(W-1,1), ncols]. The worker-0 splitter step collapsed to the
+    single controller (reference: FindAndSendSplitters,
+    api/sort.hpp:337-378)."""
+    splitters = np.zeros((max(W - 1, 1), ncols), dtype=np.uint64)
+    if samples and W > 1:
+        for j in range(1, W):
+            s = samples[min(len(samples) - 1, (j * len(samples)) // W)]
+            splitters[j - 1] = np.array(s, dtype=np.uint64)
+    return splitters
+
+
 class SortNode(DIABase):
     def __init__(self, ctx, link, key_fn: Optional[Callable],
                  compare_fn: Optional[Callable], stable: bool) -> None:
@@ -91,50 +114,84 @@ class SortNode(DIABase):
         if n <= run_size:
             items = [it for l in shards.lists for it in l]
             items.sort(key=sort_key)
-        else:
-            try:
-                items = self._em_sort(shards, sort_key, run_size)
-            except (TypeError, ValueError, AttributeError):
-                # unpicklable items cannot spill; fall back in-memory
-                items = [it for l in shards.lists for it in l]
-                items.sort(key=sort_key)
-        bounds = [(w * n) // W for w in range(W + 1)]
-        return HostShards(W, [items[bounds[w]:bounds[w + 1]]
-                              for w in range(W)])
+            bounds = [(w * n) // W for w in range(W + 1)]
+            return HostShards(W, [items[bounds[w]:bounds[w + 1]]
+                                  for w in range(W)])
+        try:
+            return HostShards(W, self._em_sort(shards, sort_key,
+                                               run_size, W))
+        except (TypeError, ValueError, AttributeError):
+            # unpicklable items cannot spill; fall back in-memory
+            items = [it for l in shards.lists for it in l]
+            items.sort(key=sort_key)
+            bounds = [(w * n) // W for w in range(W + 1)]
+            return HostShards(W, [items[bounds[w]:bounds[w + 1]]
+                                  for w in range(W)])
 
-    def _em_sort(self, shards: HostShards, sort_key, run_size: int):
+    def _em_sort(self, shards: HostShards, sort_key, run_size: int,
+                 W: int):
         """External-memory sort: spill sorted runs, k-way merge them.
+
+        A growing reservoir samples the stream while it spills
+        (reference: ReservoirSamplingGrow in the Sort PreOp,
+        api/sort.hpp:303) and yields W-1 splitters; the k-way merge then
+        streams STRAIGHT into splitter-partitioned per-worker output
+        lists — the merged sequence is never materialized twice.
 
         When this node owns the input exclusively (the consuming pull
         disposed the parent), shard lists are released as they spill so
         the spilled copy replaces — not duplicates — the resident items.
         """
+        from ...common.sampling import ReservoirSamplingGrow
         from ...data.block_pool import BlockPool
         from ...core.multiway_merge import multiway_merge_files
 
         owns_input = self.parents[0].node.state == "DISPOSED"
         pool = BlockPool(spill_dir=self.context.config.spill_dir,
                          soft_limit=64 << 20)
+        sampler = ReservoirSamplingGrow(np.random.default_rng(17))
+        # items carry their stream position: the (key, position)
+        # tiebreak makes the EM sort stable AND lets splitters cut
+        # inside equal-key runs, so low-cardinality keys cannot pile
+        # every duplicate onto one worker (the reference breaks splitter
+        # ties by global index the same way, api/sort.hpp:487-502)
+        pair_key = lambda t: (sort_key(t[1]), t[0])  # noqa: E731
         files = []
         run = []
+        pos = 0
         try:
             for lst in shards.lists:
                 for it in lst:
-                    run.append(it)
+                    run.append((pos, it))
+                    sampler.add((pos, it))
+                    pos += 1
                     if len(run) >= run_size:
-                        files.append(_spill_run(pool, run, sort_key))
+                        files.append(_spill_run(pool, run, pair_key))
                         run = []
                 if owns_input:
                     lst.clear()
             if run:
-                files.append(_spill_run(pool, run, sort_key))
-            merged = list(multiway_merge_files(files, key=sort_key,
-                                               consume=True))
+                files.append(_spill_run(pool, run, pair_key))
+
+            # W-1 (key, position) splitters from the reservoir
+            samples = sorted(sampler.samples, key=pair_key)
+            split_keys = [pair_key(samples[min(len(samples) - 1,
+                                               (j * len(samples)) // W)])
+                          for j in range(1, W)] if samples else []
+
+            out = [[] for _ in range(W)]
+            w = 0
+            for t in multiway_merge_files(files, key=pair_key,
+                                          consume=True):
+                k = pair_key(t)
+                while w < len(split_keys) and k > split_keys[w]:
+                    w += 1
+                out[w].append(t[1])
         finally:
             for f in files:
                 f.clear()
             pool.close()
-        return merged
+        return out
 
 
 def _spill_run(pool, run, sort_key):
@@ -221,10 +278,7 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
             gidx_s = jnp.take(gidx, perm)
             # quantile positions over the valid prefix (sorted: valid
             # items occupy [0, count))
-            count_f = jnp.maximum(count, 1)
-            qpos = ((jnp.arange(OVERSAMPLE, dtype=jnp.int64) * 2 + 1)
-                    * count_f // (2 * OVERSAMPLE))
-            qpos = jnp.clip(qpos, 0, cap - 1)
+            qpos = quantile_positions(count, cap)
             sample_words = jnp.stack(
                 [jnp.take(w, qpos) for w in words_s], axis=1)  # [S, nw]
             sample_idx = jnp.take(gidx_s, qpos)                # [S]
@@ -245,15 +299,9 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
     sw = mex.fetch(s_words).reshape(W * OVERSAMPLE, nwords)
     si = mex.fetch(s_idx).reshape(W * OVERSAMPLE)
     sv = mex.fetch(s_valid).reshape(W * OVERSAMPLE)
-    samples = [(tuple(int(x) for x in sw[i]), int(si[i]))
-               for i in range(len(sv)) if sv[i]]
-    samples.sort()
-    splitters = np.zeros((max(W - 1, 1), nwords + 1), dtype=np.uint64)
-    if samples:
-        for j in range(1, W):
-            s = samples[min(len(samples) - 1, (j * len(samples)) // W)]
-            splitters[j - 1, :nwords] = np.array(s[0], dtype=np.uint64)
-            splitters[j - 1, nwords] = np.uint64(s[1])
+    samples = sorted(tuple(int(x) for x in sw[i]) + (int(si[i]),)
+                     for i in range(len(sv)) if sv[i])
+    splitters = choose_splitters(samples, W, nwords + 1)
 
     # ---- phase 2: classify on sorted keys + single payload gather ----
     # Items are key-sorted, so destinations (rank among splitters) are

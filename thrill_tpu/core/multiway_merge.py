@@ -38,9 +38,48 @@ def multiway_merge(runs: List[Iterable[Any]],
 
 
 def multiway_merge_files(files: List[File], key: Optional[Callable] = None,
-                         consume: bool = False) -> Iterator[Any]:
-    """Merge sorted Files block-lazily (reference merges File readers
-    with prefetch degree control, data/block_pool.hpp:177)."""
-    readers = [f.consume_reader() if consume else f.keep_reader()
-               for f in files]
-    return multiway_merge(readers, key)
+                         consume: bool = False,
+                         max_merge_degree: int = 0) -> Iterator[Any]:
+    """Merge sorted Files block-lazily with bounded merge degree.
+
+    At most ``max_merge_degree`` run readers are open at once
+    (reference: MaxMergeDegreePrefetch, thrill/data/block_pool.hpp:177,
+    and Sort's partial-merge loop, api/sort.hpp:229-260): when there
+    are more runs, groups are partially merged into intermediate Files
+    first, so memory stays bounded even for thousands of spilled runs.
+    0 = default (64, the reference's prefetch-less fallback ballpark).
+    """
+    import os
+    if max_merge_degree <= 0:
+        max_merge_degree = int(
+            os.environ.get("THRILL_TPU_MAX_MERGE_DEGREE", "64") or 64)
+    max_merge_degree = max(max_merge_degree, 2)
+
+    files = list(files)
+    made_intermediates = []
+    try:
+        while len(files) > max_merge_degree:
+            # partially merge the SMALLEST runs first (fewest re-copies)
+            files.sort(key=lambda f: f.num_items)
+            group, files = files[:max_merge_degree], \
+                files[max_merge_degree:]
+            pool = group[0].pool
+            merged = File(pool=pool)
+            with merged.writer() as w:
+                readers = [f.consume_reader() if consume
+                           else f.keep_reader() for f in group]
+                for item in multiway_merge(readers, key):
+                    w.put(item)
+            if consume:
+                for f in group:
+                    f.clear()
+            made_intermediates.append(merged)
+            files.append(merged)
+
+        readers = [f.consume_reader()
+                   if (consume or f in made_intermediates)
+                   else f.keep_reader() for f in files]
+        yield from multiway_merge(readers, key)
+    finally:
+        for f in made_intermediates:
+            f.clear()

@@ -63,10 +63,19 @@ def Glob(path_or_glob: str) -> FileList:
     Reference: vfs::Glob, file_io.hpp:105; FileList::size_ex_psum :79-99.
     """
     scheme = _scheme(path_or_glob)
+    if scheme == "s3":
+        from . import s3_file
+        files: List[FileInfo] = []
+        psum = 0
+        for p, sz in s3_file.s3_glob(path_or_glob):
+            files.append(FileInfo(p, sz, psum,
+                                  p.endswith(COMPRESSED_SUFFIXES)))
+            psum += sz
+        return FileList(files)
     if scheme != "file":
         raise NotImplementedError(
             f"vfs scheme '{scheme}' requires an SDK not present in this "
-            f"image; only file:// is enabled")
+            f"image; only file:// and s3:// are implemented")
     pat = path_or_glob[len("file://"):] if path_or_glob.startswith("file://") \
         else path_or_glob
     if os.path.isdir(pat):
@@ -90,6 +99,11 @@ def OpenReadStream(path: str, offset: int = 0) -> IO[bytes]:
     Compressed files do not support nonzero offsets (whole-file
     granularity, like the reference's ReadLines on compressed input).
     """
+    if _scheme(path) == "s3":
+        if path.endswith(COMPRESSED_SUFFIXES):
+            raise ValueError("compressed s3 objects are read whole-file")
+        from . import s3_file
+        return s3_file.s3_open_read(path, offset)
     f = _open_filtered(path, "rb")
     if offset:
         if path.endswith(COMPRESSED_SUFFIXES):
@@ -99,6 +113,9 @@ def OpenReadStream(path: str, offset: int = 0) -> IO[bytes]:
 
 
 def OpenWriteStream(path: str) -> IO[bytes]:
+    if _scheme(path) == "s3":
+        from . import s3_file
+        return s3_file.s3_open_write(path)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

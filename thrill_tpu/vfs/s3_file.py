@@ -1,0 +1,125 @@
+"""S3 object storage backend for the vfs layer.
+
+Reference: thrill/vfs/s3_file.cpp (~1,100 LoC over vendored libs3):
+object listing for Glob, ranged GETs for offset reads, streamed PUTs
+for writes. Here the transport is boto3, probed lazily — the backend
+self-gates with an actionable error when the SDK is absent (this image
+ships no boto3 and has no network), and everything above the vfs seam
+(ReadLines/ReadBinary/WriteLines byte-range splitting) is
+scheme-agnostic, so enabling S3 is purely additive.
+
+Paths: s3://bucket/key or s3://bucket/prefix* (suffix glob).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import IO, List, Tuple
+
+
+def _boto3():
+    try:
+        import boto3  # type: ignore
+        return boto3
+    except ImportError as e:
+        raise NotImplementedError(
+            "vfs scheme 's3' needs the boto3 SDK, which is not "
+            "installed in this image (no network to fetch it); install "
+            "boto3 and configure AWS credentials to enable s3:// paths"
+        ) from e
+
+
+def parse_s3_path(path: str) -> Tuple[str, str]:
+    assert path.startswith("s3://"), path
+    rest = path[len("s3://"):]
+    bucket, _, key = rest.partition("/")
+    if not bucket:
+        raise ValueError(f"s3 path has no bucket: {path!r}")
+    return bucket, key
+
+
+def s3_glob(path_or_glob: str) -> List[Tuple[str, int]]:
+    """List (s3://bucket/key, size) matching the path or '*'-suffix
+    prefix glob, sorted by key (reference: S3 list in vfs::Glob)."""
+    boto3 = _boto3()
+    bucket, key = parse_s3_path(path_or_glob)
+    client = boto3.client("s3")
+    if "*" in key:
+        star = key.index("*")
+        if "*" in key[star + 1:]:
+            raise ValueError("s3 glob supports a single trailing '*'")
+        prefix, suffix = key[:star], key[star + 1:]
+    else:
+        prefix, suffix = key, ""
+    out: List[Tuple[str, int]] = []
+    paginator = client.get_paginator("list_objects_v2")
+    for page in paginator.paginate(Bucket=bucket, Prefix=prefix):
+        for obj in page.get("Contents", ()):
+            k = obj["Key"]
+            if suffix and not k.endswith(suffix):
+                continue
+            out.append((f"s3://{bucket}/{k}", int(obj["Size"])))
+    out.sort()
+    return out
+
+
+class _S3ReadStream(io.RawIOBase):
+    """Ranged sequential reads over one object (reference: ranged GET,
+    s3_file.cpp)."""
+
+    def __init__(self, bucket: str, key: str, offset: int = 0) -> None:
+        client = _boto3().client("s3")
+        kwargs = {"Bucket": bucket, "Key": key}
+        if offset:
+            kwargs["Range"] = f"bytes={offset}-"
+        self._body = client.get_object(**kwargs)["Body"]
+
+    def readable(self) -> bool:
+        return True
+
+    def read(self, n: int = -1) -> bytes:
+        return self._body.read(None if n is None or n < 0 else n)
+
+    def readinto(self, b) -> int:
+        data = self._body.read(len(b))
+        b[:len(data)] = data
+        return len(data)
+
+    def close(self) -> None:
+        try:
+            self._body.close()
+        finally:
+            super().close()
+
+
+class _S3WriteStream(io.RawIOBase):
+    """Buffered whole-object PUT on close (small coordination files and
+    per-worker output chunks; multipart upload is a follow-up)."""
+
+    def __init__(self, bucket: str, key: str) -> None:
+        self._bucket = bucket
+        self._key = key
+        self._buf = io.BytesIO()
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        return self._buf.write(b)
+
+    def close(self) -> None:
+        if not self.closed:
+            client = _boto3().client("s3")
+            client.put_object(Bucket=self._bucket, Key=self._key,
+                              Body=self._buf.getvalue())
+        super().close()
+
+
+def s3_open_read(path: str, offset: int = 0) -> IO[bytes]:
+    bucket, key = parse_s3_path(path)
+    return io.BufferedReader(_S3ReadStream(bucket, key, offset))
+
+
+def s3_open_write(path: str) -> IO[bytes]:
+    bucket, key = parse_s3_path(path)
+    return io.BufferedWriter(_S3WriteStream(bucket, key))
