@@ -1,0 +1,160 @@
+"""Real-accelerator validation checklist — run when the chip is healthy.
+
+The CI suite (tests/) pins everything to a virtual CPU mesh; the paths
+that only matter on real hardware (chunked sort engine above the 64K
+compile cliff, pallas kernels outside interpret mode, ragged
+all-to-all) are claims until they execute on the device. This script
+runs them one by one and prints one RESULT line each, never letting a
+single failure hide the rest.
+
+Usage (healthy chip):   python benchmarks/tpu_checks.py
+The axon plugin can hang at init — probe with a subprocess timeout
+before running this (bench.py does that automatically).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+RESULTS = []
+
+
+def check(name):
+    def deco(fn):
+        RESULTS.append((name, fn))
+        return fn
+    return deco
+
+
+@check("platform")
+def _platform():
+    import jax
+    d = jax.devices()[0]
+    return f"platform={d.platform} kind={getattr(d, 'device_kind', '?')}"
+
+
+@check("chunked_sort_1m")
+def _chunked_sort():
+    import jax
+    import jax.numpy as jnp
+    from thrill_tpu.core.device_sort import _chunked_argsort
+
+    n = 1 << 20
+    rng = np.random.default_rng(0)
+    ws = [jnp.asarray(rng.integers(0, 1 << 63, n, dtype=np.uint64))
+          for _ in range(2)]
+    f = jax.jit(lambda *w: _chunked_argsort(list(w)))
+    t0 = time.perf_counter()
+    perm = f(*ws)
+    perm.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    perm = f(*ws)
+    perm.block_until_ready()
+    run_s = time.perf_counter() - t0
+    a, b = np.asarray(ws[0]), np.asarray(ws[1])
+    got = np.asarray(perm)
+    want = np.lexsort((b, a))
+    assert np.array_equal(a[got], a[want]) and np.array_equal(
+        b[got], b[want]), "chunked sort wrong"
+    return (f"compile={compile_s:.1f}s run={run_s * 1000:.0f}ms "
+            f"({n / run_s / 1e6:.1f} Mrows/s)")
+
+
+@check("terasort_pipeline_1m")
+def _terasort():
+    import jax
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    n = 1 << 20
+    rng = np.random.default_rng(0)
+    recs = {"key": rng.integers(0, 256, size=(n, 10)).astype(np.uint8),
+            "value": rng.integers(0, 256, size=(n, 90)).astype(np.uint8)}
+    ctx = Context(MeshExec())
+
+    def key_fn(r):
+        return r["key"]
+
+    def once():
+        out = ctx.Distribute(recs).Sort(key_fn=key_fn)
+        sh = out.node.materialize()
+        jax.block_until_ready(jax.tree.leaves(sh.tree))
+        return sh
+
+    once()
+    t0 = time.perf_counter()
+    once()
+    dt = time.perf_counter() - t0
+    ctx.close()
+    return f"{n / dt / 1e6:.2f} Mrec/s ({dt * 1000:.0f} ms)"
+
+
+@check("pallas_histogram_device")
+def _pallas():
+    import jax
+    import jax.numpy as jnp
+    from thrill_tpu.core.pallas_kernels import partition_histogram
+
+    dest = jnp.asarray(
+        np.random.default_rng(1).integers(0, 8, 1 << 16).astype(np.int32))
+    hist = jax.jit(lambda d: partition_histogram(d, 8))(dest)
+    got = np.asarray(hist)
+    want = np.bincount(np.asarray(dest), minlength=8)[:8]
+    assert np.array_equal(got, want), (got, want)
+    return "device histogram matches bincount"
+
+
+@check("ragged_all_to_all")
+def _ragged():
+    import jax
+
+    if len(jax.devices()) < 2:
+        return "SKIP (single device; needs a multi-chip mesh)"
+    import os
+    os.environ["THRILL_TPU_EXCHANGE"] = "ragged"
+    try:
+        from thrill_tpu.api import Context
+        from thrill_tpu.parallel.mesh import MeshExec
+        ctx = Context(MeshExec())
+        vals = np.arange(4096, dtype=np.int64)
+        out = ctx.Distribute(vals).Map(lambda x: (x % 7, 1)).ReducePair(
+            lambda a, b: a + b)
+        assert sum(int(v) for _, v in out.AllGather()) == 4096
+        ctx.close()
+        return "ragged exchange pipeline correct"
+    finally:
+        os.environ.pop("THRILL_TPU_EXCHANGE", None)
+
+
+def main():
+    from thrill_tpu.common.platform import maybe_force_cpu_from_env
+    maybe_force_cpu_from_env()
+
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.cache/thrill_tpu_xla"))
+    import thrill_tpu  # noqa: F401
+
+    failures = 0
+    for name, fn in RESULTS:
+        try:
+            msg = fn()
+            print(f"RESULT check={name} status=ok {msg}", flush=True)
+        except Exception:
+            failures += 1
+            print(f"RESULT check={name} status=FAIL", flush=True)
+            traceback.print_exc()
+    raise SystemExit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

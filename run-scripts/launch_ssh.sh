@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Multi-host launcher over ssh (reference: run/ssh/invoke.sh — env-var
+# spray + remote start + die-with-parent hygiene).
+#
+# Usage:
+#   run-scripts/launch_ssh.sh HOSTFILE PROGRAM [args...]
+#
+# HOSTFILE: one "host[:tcp_port]" per line (first host also runs the
+# jax.distributed coordinator). PROGRAM: a python script whose job entry
+# calls thrill_tpu.api.RunDistributed; it receives
+#   THRILL_TPU_COORDINATOR  host:port   (pass to RunDistributed)
+#   THRILL_TPU_HOSTLIST     control-plane host:port list
+#   THRILL_TPU_RANK         this process' rank
+#   THRILL_TPU_NPROCS       total processes
+#   THRILL_TPU_SECRET       shared control-plane secret
+set -euo pipefail
+
+HOSTFILE=${1:?usage: launch_ssh.sh HOSTFILE PROGRAM [args...]}
+PROGRAM=${2:?usage: launch_ssh.sh HOSTFILE PROGRAM [args...]}
+shift 2
+
+mapfile -t RAW < <(grep -v '^\s*#' "$HOSTFILE" | grep -v '^\s*$')
+NP=${#RAW[@]}
+[ "$NP" -ge 1 ] || { echo "hostfile is empty" >&2; exit 1; }
+
+COORD_PORT=${THRILL_TPU_COORD_PORT:-29400}
+CTRL_BASE=${THRILL_TPU_CTRL_PORT:-29500}
+SECRET=${THRILL_TPU_SECRET:-$(head -c 24 /dev/urandom | base64 | tr -d '+/=')}
+
+HOSTS=(); HOSTLIST=""
+for i in "${!RAW[@]}"; do
+  h=${RAW[$i]%%:*}; p=${RAW[$i]#*:}
+  [ "$p" = "$h" ] && p=$((CTRL_BASE + i))
+  HOSTS+=("$h")
+  HOSTLIST+="${h}:${p} "
+done
+COORD="${HOSTS[0]}:${COORD_PORT}"
+
+PIDS=()
+cleanup() { for pid in "${PIDS[@]}"; do kill "$pid" 2>/dev/null || true; done; }
+trap cleanup EXIT INT TERM
+
+for i in "${!HOSTS[@]}"; do
+  # die-with-parent: the remote shell exits when this launcher's ssh
+  # connection drops (reference: THRILL_DIE_WITH_PARENT)
+  ssh -o BatchMode=yes "${HOSTS[$i]}" \
+    "THRILL_TPU_COORDINATOR='$COORD' \
+     THRILL_TPU_HOSTLIST='${HOSTLIST% }' \
+     THRILL_TPU_RANK=$i THRILL_TPU_NPROCS=$NP \
+     THRILL_TPU_SECRET='$SECRET' \
+     exec python3 '$PROGRAM' $*" &
+  PIDS+=($!)
+done
+
+FAIL=0
+for pid in "${PIDS[@]}"; do wait "$pid" || FAIL=1; done
+exit $FAIL
