@@ -80,21 +80,22 @@ def _terasort():
     recs = {"key": rng.integers(0, 256, size=(n, 10)).astype(np.uint8),
             "value": rng.integers(0, 256, size=(n, 90)).astype(np.uint8)}
     ctx = Context(MeshExec())
+    try:
+        def key_fn(r):
+            return r["key"]
 
-    def key_fn(r):
-        return r["key"]
+        def once():
+            out = ctx.Distribute(recs).Sort(key_fn=key_fn)
+            sh = out.node.materialize()
+            jax.block_until_ready(jax.tree.leaves(sh.tree))
+            return sh
 
-    def once():
-        out = ctx.Distribute(recs).Sort(key_fn=key_fn)
-        sh = out.node.materialize()
-        jax.block_until_ready(jax.tree.leaves(sh.tree))
-        return sh
-
-    once()
-    t0 = time.perf_counter()
-    once()
-    dt = time.perf_counter() - t0
-    ctx.close()
+        once()
+        t0 = time.perf_counter()
+        once()
+        dt = time.perf_counter() - t0
+    finally:
+        ctx.close()
     return f"{n / dt / 1e6:.2f} Mrec/s ({dt * 1000:.0f} ms)"
 
 
@@ -119,8 +120,9 @@ def _ragged():
 
     if len(jax.devices()) < 2:
         return "SKIP (single device; needs a multi-chip mesh)"
-    import os
+    prev = os.environ.get("THRILL_TPU_EXCHANGE")
     os.environ["THRILL_TPU_EXCHANGE"] = "ragged"
+    ctx = None
     try:
         from thrill_tpu.api import Context
         from thrill_tpu.parallel.mesh import MeshExec
@@ -129,19 +131,37 @@ def _ragged():
         out = ctx.Distribute(vals).Map(lambda x: (x % 7, 1)).ReducePair(
             lambda a, b: a + b)
         assert sum(int(v) for _, v in out.AllGather()) == 4096
-        ctx.close()
         return "ragged exchange pipeline correct"
     finally:
-        os.environ.pop("THRILL_TPU_EXCHANGE", None)
+        if ctx is not None:
+            ctx.close()
+        if prev is None:
+            os.environ.pop("THRILL_TPU_EXCHANGE", None)
+        else:
+            os.environ["THRILL_TPU_EXCHANGE"] = prev
 
 
 def main():
     from thrill_tpu.common.platform import maybe_force_cpu_from_env
     maybe_force_cpu_from_env()
 
+    if os.environ.get("JAX_PLATFORMS") != "cpu":
+        # the axon plugin can HANG (not raise) at PJRT init — probe in
+        # a throwaway subprocess first, exactly like bench.py
+        from bench import _probe_accelerator
+        if _probe_accelerator(float(os.environ.get(
+                "THRILL_TPU_BENCH_PROBE_TIMEOUT_S", "150"))) is None:
+            print("RESULT check=platform status=FAIL accelerator probe "
+                  "failed/timed out; run with JAX_PLATFORMS=cpu for a "
+                  "CPU smoke", flush=True)
+            raise SystemExit(1)
+
     import jax
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.expanduser("~/.cache/thrill_tpu_xla"))
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/thrill_tpu_xla"))
+    except Exception:
+        pass
     import thrill_tpu  # noqa: F401
 
     failures = 0

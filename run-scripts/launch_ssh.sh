@@ -13,6 +13,15 @@
 #   THRILL_TPU_RANK         this process' rank
 #   THRILL_TPU_NPROCS       total processes
 #   THRILL_TPU_SECRET       shared control-plane secret
+#
+# Mechanics:
+# - The environment (including the SECRET) travels over each ssh
+#   session's STDIN, never on a remote command line — `ps` on a shared
+#   remote host must not reveal the control-plane secret.
+# - Die-with-parent: each remote wraps the program with a watchdog that
+#   kills it when stdin hits EOF. Stdin is a per-host FIFO whose write
+#   end is held by THIS launcher process, so the fleet dies when the
+#   launcher dies — even on SIGKILL (fd closure needs no trap).
 set -euo pipefail
 
 HOSTFILE=${1:?usage: launch_ssh.sh HOSTFILE PROGRAM [args...]}
@@ -34,22 +43,50 @@ for i in "${!RAW[@]}"; do
   HOSTS+=("$h")
   HOSTLIST+="${h}:${p} "
 done
+HOSTLIST=${HOSTLIST% }
 COORD="${HOSTS[0]}:${COORD_PORT}"
 
+# program + args, safely quoted for the remote shell
+CMD=$(printf "%q " python3 "$PROGRAM" "$@")
+
+# remote payload: read one env line from stdin, then run the program
+# under an EOF watchdog (single-quoted: nothing interpolates locally)
+REMOTE='
+IFS= read -r __env || exit 90
+eval "export $__env"
+exec 3<&0   # background jobs get stdin=/dev/null; keep the real one
+'"$CMD"' &
+pid=$!
+{ cat <&3 >/dev/null; kill "$pid" 2>/dev/null; } &
+watcher=$!
+wait "$pid"; st=$?
+kill "$watcher" 2>/dev/null
+exit "$st"
+'
+
+TMP=$(mktemp -d)
 PIDS=()
-cleanup() { for pid in "${PIDS[@]}"; do kill "$pid" 2>/dev/null || true; done; }
+cleanup() {
+  for pid in "${PIDS[@]}"; do kill "$pid" 2>/dev/null || true; done
+  rm -rf "$TMP"
+}
 trap cleanup EXIT INT TERM
 
 for i in "${!HOSTS[@]}"; do
-  # die-with-parent: the remote shell exits when this launcher's ssh
-  # connection drops (reference: THRILL_DIE_WITH_PARENT)
-  ssh -o BatchMode=yes "${HOSTS[$i]}" \
-    "THRILL_TPU_COORDINATOR='$COORD' \
-     THRILL_TPU_HOSTLIST='${HOSTLIST% }' \
-     THRILL_TPU_RANK=$i THRILL_TPU_NPROCS=$NP \
-     THRILL_TPU_SECRET='$SECRET' \
-     exec python3 '$PROGRAM' $*" &
+  fifo="$TMP/keep$i"
+  mkfifo "$fifo"
+  ssh -o BatchMode=yes "${HOSTS[$i]}" "$REMOTE" < "$fifo" &
   PIDS+=($!)
+  # hold the write end open for the launcher's lifetime; closing it
+  # (process death included) EOFs the remote watchdog
+  exec {fd}> "$fifo"
+  printf '%s\n' \
+    "$(printf '%q=%q %q=%q %q=%q %q=%q %q=%q' \
+        THRILL_TPU_COORDINATOR "$COORD" \
+        THRILL_TPU_HOSTLIST "$HOSTLIST" \
+        THRILL_TPU_RANK "$i" \
+        THRILL_TPU_NPROCS "$NP" \
+        THRILL_TPU_SECRET "$SECRET")" >&"$fd"
 done
 
 FAIL=0
