@@ -522,3 +522,100 @@ def test_em_sort_duplicate_heavy_balanced(monkeypatch):
     flat = [it for l in shards.lists for it in l]
     assert flat == sorted(vals)
     ctx.close()
+
+
+def test_disjoint_window_device_fn():
+    import jax.numpy as jnp
+
+    def job(ctx):
+        d = ctx.Generate(23)
+        dev = d.DisjointWindow(
+            5, lambda i, w: max(w),
+            device_fn=lambda wins: jnp.max(wins, axis=1))
+        assert [int(v) for v in dev.AllGather()] == [4, 9, 14, 19]
+    sweep(job)
+
+
+def test_flat_window_device_fn():
+    import jax.numpy as jnp
+
+    def job(ctx):
+        d = ctx.Generate(12)
+        # each window (a, b) emits a+b and a*b  (factor 2, all valid)
+        host = d.Keep().FlatWindow(
+            2, lambda i, w: [w[0] + w[1], w[0] * w[1]])
+        want = []
+        for i in range(11):
+            want.extend([i + (i + 1), i * (i + 1)])
+        assert [int(v) for v in host.AllGather()] == want
+
+        dev = d.FlatWindow(
+            2, device_fn=lambda wins: (
+                jnp.stack([wins[:, 0] + wins[:, 1],
+                           wins[:, 0] * wins[:, 1]], axis=1),
+                jnp.ones((wins.shape[0], 2), bool)),
+            factor=2)
+        assert [int(v) for v in dev.AllGather()] == want
+    sweep(job)
+
+
+def test_reduce_by_key_device_dup_detection():
+    """Device DuplicateDetection: globally-unique hashes skip the
+    shuffle; results identical either way and traffic drops."""
+    import jax
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    rng = np.random.default_rng(8)
+    # mostly unique keys + a few shared across workers
+    vals = np.concatenate([np.arange(10_000, dtype=np.int64) * 7 + 1,
+                           np.zeros(64, dtype=np.int64)])
+    rng.shuffle(vals)
+
+    def run(dup):
+        ctx = Context(MeshExec(devices=jax.devices("cpu")[:8]))
+        out = ctx.Distribute(vals).Map(lambda x: (x, 1)).ReduceByKey(
+            lambda kv: kv[0], lambda a, b: (a[0], a[1] + b[1]),
+            dup_detection=dup)
+        got = {int(k): int(v) for k, v in out.AllGather()}
+        moved = ctx.mesh_exec.stats_items_moved
+        ctx.close()
+        return got, moved
+
+    base, moved_base = run(False)
+    dd, moved_dd = run(True)
+    assert dd == base
+    want = {}
+    for v in vals.tolist():
+        want[v] = want.get(v, 0) + 1
+    assert dd == want
+    # unique keys stayed local: far fewer items crossed the mesh
+    assert moved_dd < moved_base / 2, (moved_dd, moved_base)
+
+
+def test_inner_join_device_location_detection():
+    """Device LocationDetection prunes non-matching keys before the
+    exchange; same results, less traffic."""
+    import jax
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    left_keys = np.arange(20_000, dtype=np.int64)          # 0..19999
+    right_keys = np.arange(19_900, 40_000, dtype=np.int64)  # tiny overlap
+
+    def run(ld):
+        ctx = Context(MeshExec(devices=jax.devices("cpu")[:8]))
+        l = ctx.Distribute(left_keys).Map(lambda x: (x, x))
+        r = ctx.Distribute(right_keys).Map(lambda x: (x, x * 2))
+        j = InnerJoin(l, r, lambda kv: kv[0], lambda kv: kv[0],
+                      lambda a, b: (a[0], b[1]),
+                      location_detection=ld)
+        got = sorted((int(a), int(b)) for a, b in j.AllGather())
+        moved = ctx.mesh_exec.stats_items_moved
+        ctx.close()
+        return got, moved
+
+    base, moved_base = run(False)
+    ld, moved_ld = run(True)
+    assert ld == base == [(k, 2 * k) for k in range(19_900, 20_000)]
+    assert moved_ld < moved_base / 3, (moved_ld, moved_base)

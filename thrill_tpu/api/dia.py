@@ -114,9 +114,12 @@ class DIA:
         from .ops import window as _w
         return _w.Window(self, k, fn, device_fn, disjoint=False)
 
-    def FlatWindow(self, k: int, fn: Callable) -> "DIA":
+    def FlatWindow(self, k: int, fn: Callable = None,
+                   device_fn: Optional[Callable] = None,
+                   factor: int = 0) -> "DIA":
         from .ops import window as _w
-        return _w.FlatWindow(self, k, fn)
+        return _w.FlatWindow(self, k, fn, device_fn=device_fn,
+                             factor=factor)
 
     def DisjointWindow(self, k: int, fn: Callable,
                        device_fn: Optional[Callable] = None) -> "DIA":

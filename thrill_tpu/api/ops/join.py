@@ -115,6 +115,10 @@ class InnerJoinNode(DIABase):
         lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
         token = (lkey, rkey, jfn)
 
+        if self.location_detection and W > 1:
+            left, right = _location_filter(left, right, lkey, rkey,
+                                           token)
+
         if W > 1:
             def mk_dest(key_fn):
                 def dest(tree, mask, widx):
@@ -205,6 +209,74 @@ class InnerJoinNode(DIABase):
         out2 = f2(matches_dev, lo_dev, *lsorted, *rsorted)
         tree = jax.tree.unflatten(holder["treedef"], list(out2))
         return DeviceShards(mex, tree, totals)
+
+
+# presence-register width for device LocationDetection (false positives
+# only cost shuffle traffic, never correctness)
+_LD_REGISTERS = 1 << 17
+
+
+def _location_filter(left: DeviceShards, right: DeviceShards,
+                     lkey, rkey, token):
+    """Device LocationDetection: drop items whose key hash has no
+    presence on the OTHER side anywhere in the cluster, before paying
+    for the exchange (reference: LocationDetectionTag,
+    api/inner_join.hpp:161-190, core/location_detection.hpp:70 — the
+    Golomb-coded per-key location exchange becomes one pmax over
+    presence registers)."""
+    import jax
+    from jax import lax
+
+    from ...data.shards import compact_valid
+    from ...parallel.mesh import AXIS
+
+    mex = left.mesh_exec
+    M = _LD_REGISTERS
+    lcap, rcap = left.cap, right.cap
+    lleaves, ltd = jax.tree.flatten(left.tree)
+    rleaves, rtd = jax.tree.flatten(right.tree)
+    nl = len(lleaves)
+    key = ("join_ld", token, M, lcap, rcap, ltd, rtd,
+           tuple((l.dtype, l.shape[2:]) for l in lleaves),
+           tuple((l.dtype, l.shape[2:]) for l in rleaves))
+
+    def build():
+        def f(lc, rc, *ls):
+            ltree = jax.tree.unflatten(ltd, [x[0] for x in ls[:nl]])
+            rtree = jax.tree.unflatten(rtd, [x[0] for x in ls[nl:]])
+            lvalid = jnp.arange(lcap) < lc[0, 0]
+            rvalid = jnp.arange(rcap) < rc[0, 0]
+            hl = (hashing.hash_key_words(
+                keymod.encode_key_words(lkey(ltree)))
+                % jnp.uint64(M)).astype(jnp.int32)
+            hr = (hashing.hash_key_words(
+                keymod.encode_key_words(rkey(rtree)))
+                % jnp.uint64(M)).astype(jnp.int32)
+            pres_l = jnp.zeros(M, jnp.int32).at[hl].max(
+                lvalid.astype(jnp.int32))
+            pres_r = jnp.zeros(M, jnp.int32).at[hr].max(
+                rvalid.astype(jnp.int32))
+            pres_l = lax.pmax(pres_l, AXIS)
+            pres_r = lax.pmax(pres_r, AXIS)
+            keep_l = lvalid & (jnp.take(pres_r, hl) > 0)
+            keep_r = rvalid & (jnp.take(pres_l, hr) > 0)
+            ltree_c, lcount = compact_valid(ltree, keep_l)
+            rtree_c, rcount = compact_valid(rtree, keep_r)
+            return (lcount[None, None].astype(jnp.int32),
+                    rcount[None, None].astype(jnp.int32),
+                    *[x[None] for x in jax.tree.leaves(ltree_c)],
+                    *[x[None] for x in jax.tree.leaves(rtree_c)])
+
+        return mex.smap(f, 2 + nl + len(rleaves))
+
+    fn = mex.cached(key, build)
+    out = fn(left.counts_device(), right.counts_device(),
+             *lleaves, *rleaves)
+    new_left = DeviceShards(mex, jax.tree.unflatten(
+        ltd, list(out[2:2 + nl])), out[0])
+    new_right = DeviceShards(mex, jax.tree.unflatten(
+        rtd, list(out[2 + nl:])), out[1])
+    return new_left, new_right
 
 
 def _run_bounds(lw, lvalid, rw, rvalid):

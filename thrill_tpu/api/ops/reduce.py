@@ -18,6 +18,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from ...common import hashing
@@ -27,6 +28,12 @@ from ...data import exchange
 from ...data.shards import DeviceShards, HostShards, compact_valid
 from ..dia import DIA
 from ..dia_base import DIABase
+from ...parallel.mesh import AXIS
+
+
+# register array size for device DuplicateDetection (collisions only
+# cause unnecessary shuffling, never wrong results)
+_DUP_REGISTERS = 1 << 17
 
 
 def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
@@ -86,16 +93,36 @@ class ReduceNode(DIABase):
         key_fn, reduce_fn = self.key_fn, self.reduce_fn
         token = self.token
         W = self.context.num_workers
+        dup = self.dup_detection
         # pre-phase: local combine (reference: ReducePrePhase)
         pre = _local_reduce_device(shards, key_fn, reduce_fn, "pre", token)
-        # shuffle by key hash (reference: Mix/CatStream exchange)
+        # shuffle by key hash (reference: Mix/CatStream exchange).
+        # With DuplicateDetection, globally-unique key hashes skip the
+        # shuffle: a register psum inside the destination program finds
+        # hashes held by exactly one worker and keeps those items local
+        # (reference: core/duplicate_detection.hpp:46 — the Golomb-coded
+        # register exchange becomes one psum over a [M] register array).
         if W > 1:
+            M = _DUP_REGISTERS if dup else 0
+
             def dest(tree, mask, widx):
                 words = keymod.encode_key_words(key_fn(tree))
                 h = hashing.hash_key_words(words)
-                return (h % jnp.uint64(W)).astype(jnp.int32)
+                hash_dest = (h % jnp.uint64(W)).astype(jnp.int32)
+                if not dup:
+                    return hash_dest
+                reg = (h % jnp.uint64(M)).astype(jnp.int32)
+                local = jnp.zeros(M, jnp.int32).at[reg].add(
+                    mask.astype(jnp.int32))
+                glob = lax.psum(local, AXIS)
+                # register count == my count -> no other worker holds
+                # this hash: the post-phase combine is a local no-op
+                mine_only = jnp.take(glob, reg) == jnp.take(local, reg)
+                return jnp.where(mine_only, widx.astype(jnp.int32),
+                                 hash_dest)
 
-            pre = exchange.exchange(pre, dest, ("reduce_dest", token, W))
+            pre = exchange.exchange(pre, dest,
+                                    ("reduce_dest", token, W, dup))
         # post-phase: final combine (reference: ReduceByHashPostPhase)
         return _local_reduce_device(pre, key_fn, reduce_fn, "post", token)
 

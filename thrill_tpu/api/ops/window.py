@@ -9,8 +9,10 @@ the mesh axis — each worker passes its last k-1 items to its successor,
 the 1-D sharded-sequence pattern that generalizes to ring-style
 sequence parallelism (this is where the long-context halo primitive
 lives in this framework). Window functions are applied batched over
-[n_windows, k] stacks. Workers with fewer than k-1 items (rare,
-tiny inputs) fall back to the host path.
+[n_windows, k] stacks; DisjointWindow is the same machinery with a
+start-alignment mask, and FlatWindow uses the FlatMap contract (a
+static output factor + validity mask). Workers with fewer than k-1
+items (rare, tiny inputs) fall back to the host path.
 """
 
 from __future__ import annotations
@@ -28,6 +30,70 @@ from ..dia import DIA
 from ..dia_base import DIABase
 
 
+def _device_windows(tree, cap, count, off, k, W):
+    """Traced helper: halo exchange + batched [cap, k, ...] windows.
+
+    Window j ends at local item j (covers global positions
+    off+j-(k-1) .. off+j); the k-1 halo items come from the predecessor
+    worker via a ppermute ring step. Returns (windows_tree, ends_valid,
+    g_start) where ends_valid marks windows whose full extent exists.
+    """
+    def halo_of(leaf):
+        idx = jnp.clip(count - (k - 1) + jnp.arange(k - 1), 0, cap - 1)
+        h = jnp.take(leaf, idx, axis=0)
+        perm = [(i, i + 1) for i in range(W - 1)]
+        return lax.ppermute(h, AXIS, perm) if W > 1 else \
+            jnp.zeros_like(h)
+
+    halo = jax.tree.map(halo_of, tree)
+    ext = jax.tree.map(lambda h, x: jnp.concatenate([h, x], axis=0),
+                       halo, tree)
+    widx_mat = jnp.arange(cap)[:, None] + jnp.arange(k)[None, :]
+    windows = jax.tree.map(lambda e: jnp.take(e, widx_mat, axis=0), ext)
+    g_end = off + jnp.arange(cap, dtype=jnp.int64)
+    ends_valid = (jnp.arange(cap) < count) & (g_end >= k - 1)
+    g_start = g_end - (k - 1)
+    return windows, ends_valid, g_start
+
+
+
+def _windowed_device_program(shards: DeviceShards, k: int, cache_tag,
+                             make_output):
+    """Shared driver for all windowed device ops: one jitted program
+    building halo windows, applying ``make_output(windows, ends_valid,
+    g_start) -> (out_tree, keep_mask)`` and compacting the kept rows."""
+    mex = shards.mesh_exec
+    W = mex.num_workers
+    cap = shards.cap
+    offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
+    leaves, treedef = jax.tree.flatten(shards.tree)
+    key = ("windowed",) + tuple(cache_tag) + (
+        k, cap, treedef, tuple((l.dtype, l.shape[2:]) for l in leaves))
+    holder = {}
+
+    def build():
+        def f(counts_dev, off_dev, *ls):
+            count = counts_dev[0, 0]
+            off = off_dev[0, 0]
+            tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+            windows, valid, g_start = _device_windows(
+                tree, cap, count, off, k, W)
+            out_tree, keep = make_output(windows, valid, g_start)
+            out, cnt = compact_valid(out_tree, keep)
+            out_leaves, out_td = jax.tree.flatten(out)
+            holder["treedef"] = out_td
+            return (cnt[None, None].astype(jnp.int32),
+                    *[l[None] for l in out_leaves])
+
+        return mex.smap(f, 2 + len(leaves)), holder
+
+    f, h = mex.cached(key, build)
+    out = f(shards.counts_device(),
+            mex.put(offsets.astype(np.int64)[:, None]), *leaves)
+    tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
+    return DeviceShards(mex, tree, out[0])
+
+
 class WindowNode(DIABase):
     def __init__(self, ctx, link, k: int, fn: Optional[Callable],
                  device_fn: Optional[Callable], disjoint: bool) -> None:
@@ -42,7 +108,6 @@ class WindowNode(DIABase):
         shards = self.parents[0].pull()
         k = self.k
         if isinstance(shards, DeviceShards) and self.device_fn is not None \
-                and not self.disjoint \
                 and bool(np.all(shards.counts[:-1] >= k - 1)):
             return self._compute_device(shards)
         if isinstance(shards, DeviceShards):
@@ -65,67 +130,58 @@ class WindowNode(DIABase):
                               for w in range(W)])
 
     def _compute_device(self, shards: DeviceShards):
-        mex = shards.mesh_exec
-        W = mex.num_workers
         k = self.k
-        cap = shards.cap
-        offsets = np.concatenate([[0], np.cumsum(shards.counts)])[:-1]
-        leaves, treedef = jax.tree.flatten(shards.tree)
+        disjoint = self.disjoint
         fn = self.device_fn
-        key = ("window_dev", k, fn, cap, treedef,
-               tuple((l.dtype, l.shape[2:]) for l in leaves))
-        holder = {}
 
-        def build():
-            def f(counts_dev, off_dev, *ls):
-                count = counts_dev[0, 0]
-                off = off_dev[0, 0]
-                tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
+        def make_output(windows, valid, g_start):
+            if disjoint:
+                # keep only windows aligned to a k boundary
+                valid = valid & (g_start % k == 0)
+            return fn(windows), valid        # batched [cap, ...]
 
-                # halo: my last k-1 items -> successor (ppermute ring step)
-                def halo_of(leaf):
-                    idx = jnp.clip(count - (k - 1) + jnp.arange(k - 1), 0,
-                                   cap - 1)
-                    h = jnp.take(leaf, idx, axis=0)
-                    perm = [(i, i + 1) for i in range(W - 1)]
-                    return lax.ppermute(h, AXIS, perm) if W > 1 else \
-                        jnp.zeros_like(h)
-
-                halo = jax.tree.map(halo_of, tree)
-                ext = jax.tree.map(
-                    lambda h, x: jnp.concatenate([h, x], axis=0), halo, tree)
-                # window ending at local item j = ext[j : j + k]
-                widx_mat = jnp.arange(cap)[:, None] + jnp.arange(k)[None, :]
-                windows = jax.tree.map(
-                    lambda e: jnp.take(e, widx_mat, axis=0), ext)
-                out = fn(windows)            # batched [cap, ...]
-                g_end = off + jnp.arange(cap, dtype=jnp.int64)
-                valid = (jnp.arange(cap) < count) & (g_end >= k - 1)
-                out, cnt = compact_valid(out, valid)
-                out_leaves, out_td = jax.tree.flatten(out)
-                holder["treedef"] = out_td
-                return (cnt[None, None].astype(jnp.int32),
-                        *[l[None] for l in out_leaves])
-
-            return mex.smap(f, 2 + len(leaves)), holder
-
-        f, h = mex.cached(key, build)
-        out = f(shards.counts_device(),
-                mex.put(offsets.astype(np.int64)[:, None]), *leaves)
-        tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
-        return DeviceShards(mex, tree, out[0])
+        return _windowed_device_program(
+            shards, k, ("window_dev", fn, disjoint), make_output)
 
 
 class FlatWindowNode(DIABase):
-    """fn(index, window) -> iterable of outputs (host path)."""
+    """fn(index, window) -> iterable of outputs.
 
-    def __init__(self, ctx, link, k: int, fn: Callable) -> None:
+    Device path (``device_fn`` + ``factor``): like FlatMap's device
+    contract — ``device_fn(windows)`` receives the batched
+    [cap, k, ...] window tree and returns ``(outputs, mask)`` where
+    outputs' leaves are [cap, factor, ...] and mask is [cap, factor]
+    bool (which of each window's factor slots are real). Windows whose
+    extent is incomplete are masked automatically.
+    """
+
+    def __init__(self, ctx, link, k: int, fn: Callable,
+                 device_fn: Optional[Callable] = None,
+                 factor: int = 0) -> None:
         super().__init__(ctx, "FlatWindow", [link])
         self.k = int(k)
         self.fn = fn
+        self.device_fn = device_fn
+        self.factor = int(factor)
+        if device_fn is not None and self.factor <= 0:
+            raise ValueError(
+                "FlatWindow device_fn requires factor > 0 (static "
+                "outputs per window)")
+        if fn is None and device_fn is None:
+            raise ValueError("FlatWindow needs fn and/or device_fn")
 
     def compute(self):
         shards = self.parents[0].pull()
+        k = self.k
+        if isinstance(shards, DeviceShards) and self.device_fn is not None \
+                and self.factor > 0 \
+                and bool(np.all(shards.counts[:-1] >= k - 1)):
+            return self._compute_device(shards)
+        if self.fn is None:
+            raise ValueError(
+                "FlatWindow fell back to the host path (host storage "
+                "or a worker with fewer than k-1 items) but no host "
+                "fn was given — pass fn alongside device_fn")
         if isinstance(shards, DeviceShards):
             shards = shards.to_host_shards("flatwindow")
         flat = [it for l in shards.lists for it in l]
@@ -137,11 +193,28 @@ class FlatWindowNode(DIABase):
         return HostShards(W, [out[bounds[w]:bounds[w + 1]]
                               for w in range(W)])
 
+    def _compute_device(self, shards: DeviceShards):
+        k = self.k
+        factor = self.factor
+        fn = self.device_fn
+
+        def make_output(windows, valid, g_start):
+            out, mask = fn(windows)          # [cap, factor, ...], mask
+            cap = valid.shape[0]
+            flat_tree = jax.tree.map(
+                lambda l: l.reshape((cap * factor,) + l.shape[2:]), out)
+            return flat_tree, (valid[:, None] & mask).reshape(-1)
+
+        return _windowed_device_program(
+            shards, k, ("flatwindow_dev", fn, factor), make_output)
+
 
 def Window(dia: DIA, k: int, fn, device_fn=None, disjoint=False) -> DIA:
     return DIA(WindowNode(dia.context, dia._link(), k, fn, device_fn,
                           disjoint))
 
 
-def FlatWindow(dia: DIA, k: int, fn) -> DIA:
-    return DIA(FlatWindowNode(dia.context, dia._link(), k, fn))
+def FlatWindow(dia: DIA, k: int, fn, device_fn=None, factor: int = 0
+               ) -> DIA:
+    return DIA(FlatWindowNode(dia.context, dia._link(), k, fn,
+                              device_fn=device_fn, factor=factor))
