@@ -161,6 +161,51 @@ def suffix_array_dense(text: np.ndarray) -> np.ndarray:
                     dtype=np.int64)
 
 
+def wavelet_tree(ctx: Context, text: np.ndarray, bits: int = 8):
+    """Wavelet matrix (level-ordered wavelet tree) of a byte sequence.
+
+    Reference: /root/reference/examples/suffix_sorting wavelet_tree —
+    construction is one stable bit-partition per level, which maps to
+    one device SortStable by the current bit (the reference builds the
+    node-ordered tree with its sample sort; the level-ordered matrix
+    variant is the natural fit for whole-array device partitions and
+    supports the same rank/select/access queries). Returns one packed
+    bitvector per level, MSB first, each in that level's element order.
+    """
+    levels = []
+    cur = np.asarray(text, dtype=np.uint8)
+    for b in reversed(range(bits)):
+        bit = (cur >> b) & 1
+        levels.append(np.packbits(bit))
+        if b == 0:
+            break
+        # stable partition by the current bit = stable sort on it, run
+        # on the device path through the DIA Sort
+        d = ctx.Distribute({"v": cur.astype(np.int64),
+                            "b": bit.astype(np.int64)})
+        got = d.SortStable(key_fn=lambda t: t["b"]).AllGather()
+        cur = np.array([int(t["v"]) for t in got], dtype=np.uint8)
+    return levels
+
+
+def wavelet_access(levels, n: int, i: int, bits: int = 8) -> int:
+    """Reconstruct the symbol at original position i from the matrix
+    (rank-based descent; validates the construction)."""
+    sym = 0
+    pos = i
+    for lvl in range(bits):
+        bv = np.unpackbits(levels[lvl])[:n]
+        b = int(bv[pos])
+        sym = (sym << 1) | b
+        if lvl == bits - 1:
+            break
+        if b == 0:
+            pos = int(np.sum(bv[:pos] == 0))
+        else:
+            pos = int(np.sum(bv == 0)) + int(np.sum(bv[:pos] == 1))
+    return sym
+
+
 def bwt(ctx: Context, text: np.ndarray) -> np.ndarray:
     """Burrows-Wheeler transform via the suffix array
     (reference: examples/suffix_sorting/wavelet_tree / bwt usage)."""

@@ -134,6 +134,23 @@ def test_dc3_suffix_array():
     RunLocalMock(job, 4)
 
 
+def test_wavelet_matrix_and_bwt():
+    """Wavelet matrix access reconstructs every symbol; BWT round-trip
+    sanity via its defining permutation."""
+    rng = np.random.default_rng(13)
+    text = rng.integers(97, 123, 400).astype(np.uint8)
+
+    def job(ctx):
+        levels = ss.wavelet_tree(ctx, text)
+        assert len(levels) == 8
+        for i in list(range(0, 400, 37)) + [0, 399]:
+            assert ss.wavelet_access(levels, len(text), i) == int(text[i])
+        b = ss.bwt(ctx, text)
+        sa = ss.suffix_array_dense(text)
+        assert np.array_equal(b, text[(sa - 1) % len(text)])
+    RunLocalMock(job, 4)
+
+
 def test_triangles():
     rng = np.random.default_rng(9)
     raw = rng.integers(0, 30, (120, 2))

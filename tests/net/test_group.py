@@ -133,3 +133,16 @@ def test_ex_prefix_sum_min_op_with_identity():
     res = run_group(4, lambda g: g.ex_prefix_sum(
         [5, 3, 8, 1][g.my_rank], op=min, initial=10 ** 9))
     assert res == [10 ** 9, 5, 3, 3]
+
+
+def test_all_reduce_elimination_non_pow2():
+    """Non-power-of-two sizes use the elimination variant (reference:
+    AllReduceElimination, net/collective.hpp:459): extras fold into a
+    partner, hypercube over the power-of-two core, result fan-back."""
+    for p in (3, 5, 6, 7):
+        results = run_group(p, lambda g: g.all_reduce(g.my_rank + 1))
+        assert results == [p * (p + 1) // 2] * p
+    # max as the op
+    results = run_group(5, lambda g: g.all_reduce(
+        (g.my_rank * 7) % 5, op=max))
+    assert results == [4] * 5

@@ -82,3 +82,17 @@ def test_s3_glob_read_write_roundtrip(monkeypatch):
     with file_io.OpenWriteStream("s3://bkt/out/res.txt") as f:
         f.write(b"abc")
     assert objects["out/res.txt"] == b"abc"
+
+
+def test_hdfs_gated_without_runtime():
+    """hdfs:// self-gates with an actionable error when libhdfs / the
+    Hadoop runtime is absent (pyarrow itself is installed)."""
+    with pytest.raises(NotImplementedError, match="hdfs"):
+        file_io.Glob("hdfs://namenode:9000/data/part-*")
+
+
+def test_hdfs_path_parse():
+    from thrill_tpu.vfs import hdfs_file
+    assert hdfs_file.parse_hdfs_path("hdfs://nn:9000/a/b.txt") == \
+        ("nn", 9000, "/a/b.txt")
+    assert hdfs_file.parse_hdfs_path("hdfs:///a/b.txt") == ("", 0, "/a/b.txt")

@@ -110,6 +110,11 @@ class WindowNode(DIABase):
         if isinstance(shards, DeviceShards) and self.device_fn is not None \
                 and bool(np.all(shards.counts[:-1] >= k - 1)):
             return self._compute_device(shards)
+        if self.fn is None:
+            raise ValueError(
+                f"{self.label} fell back to the host path (host storage "
+                f"or a worker with fewer than k-1 items) but no host fn "
+                f"was given — pass fn alongside device_fn")
         if isinstance(shards, DeviceShards):
             shards = shards.to_host_shards("window-host-fn")
         return self._compute_host(shards)

@@ -154,29 +154,57 @@ class Group(abc.ABC):
         return acc if vr == 0 else None
 
     def all_reduce(self, value: Any, op: Callable = operator.add) -> Any:
-        """All-reduce; hypercube for powers of two, reduce+broadcast
-        otherwise (reference: AllReduceHypercube / AllReduceAtRoot +
-        select, net/collective.hpp:414,382,551)."""
+        """All-reduce; hypercube for powers of two, elimination for the
+        rest (reference: AllReduceHypercube net/collective.hpp:414 and
+        the 3-2 elimination variant :459-548 — here the standard 2-1
+        form: extras above the largest power of two fold into a partner
+        first, the partners run the hypercube, and the extras get the
+        result back: 2 extra rounds instead of a full
+        reduce+broadcast)."""
         p = self.num_hosts
-        if p & (p - 1) == 0:
+        r = self.my_rank
+        pp = 1 << (p.bit_length() - 1)      # largest power of two <= p
+        if pp == p:
+            return self._hypercube_all_reduce(value, op, p, r)
+        # ADJACENT ranks pair up (2i folds 2i+1), so the virtual-rank
+        # order equals the global rank order and non-commutative
+        # (associative) ops still combine left-to-right
+        extras = p - pp
+        if r < 2 * extras:
+            if r % 2 == 1:                   # eliminated: partner computes
+                self.send_to(r - 1, value)
+                return self.recv_from(r - 1)
+            acc = op(value, self.recv_from(r + 1))
+            vr = r // 2
+        else:
             acc = value
-            r = self.my_rank
-            d = 1
-            while d < p:
-                peer = r ^ d
-                # symmetric exchange; deterministic order avoids deadlock
-                if r < peer:
-                    self.send_to(peer, acc)
-                    other = self.recv_from(peer)
-                else:
-                    other = self.recv_from(peer)
-                    self.send_to(peer, acc)
-                # keep rank order as operand order for non-commutative ops
-                acc = op(acc, other) if r < peer else op(other, acc)
-                d <<= 1
-            return acc
-        res = self.reduce(value, op, root=0)
-        return self.broadcast(res, origin=0)
+            vr = r - extras
+
+        def to_real(v: int) -> int:
+            return 2 * v if v < extras else v + extras
+
+        acc = self._hypercube_all_reduce(acc, op, pp, vr, to_real)
+        if r < 2 * extras:                   # fan the result back
+            self.send_to(r + 1, acc)
+        return acc
+
+    def _hypercube_all_reduce(self, acc: Any, op: Callable, p: int,
+                              r: int, to_real: Callable = None) -> Any:
+        to_real = to_real or (lambda v: v)
+        d = 1
+        while d < p:
+            peer = r ^ d
+            # symmetric exchange; deterministic order avoids deadlock
+            if r < peer:
+                self.send_to(to_real(peer), acc)
+                other = self.recv_from(to_real(peer))
+            else:
+                other = self.recv_from(to_real(peer))
+                self.send_to(to_real(peer), acc)
+            # keep rank order as operand order for non-commutative ops
+            acc = op(acc, other) if r < peer else op(other, acc)
+            d <<= 1
+        return acc
 
     def barrier(self) -> None:
         self.all_reduce(0, operator.add)

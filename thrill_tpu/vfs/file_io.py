@@ -72,10 +72,19 @@ def Glob(path_or_glob: str) -> FileList:
                                   p.endswith(COMPRESSED_SUFFIXES)))
             psum += sz
         return FileList(files)
+    if scheme == "hdfs":
+        from . import hdfs_file
+        files = []
+        psum = 0
+        for p, sz in hdfs_file.hdfs_glob(path_or_glob):
+            files.append(FileInfo(p, sz, psum,
+                                  p.endswith(COMPRESSED_SUFFIXES)))
+            psum += sz
+        return FileList(files)
     if scheme != "file":
         raise NotImplementedError(
-            f"vfs scheme '{scheme}' requires an SDK not present in this "
-            f"image; only file:// and s3:// are implemented")
+            f"vfs scheme '{scheme}' is not implemented; file://, s3:// "
+            f"and hdfs:// are")
     pat = path_or_glob[len("file://"):] if path_or_glob.startswith("file://") \
         else path_or_glob
     if os.path.isdir(pat):
@@ -104,6 +113,9 @@ def OpenReadStream(path: str, offset: int = 0) -> IO[bytes]:
             raise ValueError("compressed s3 objects are read whole-file")
         from . import s3_file
         return s3_file.s3_open_read(path, offset)
+    if _scheme(path) == "hdfs":
+        from . import hdfs_file
+        return hdfs_file.hdfs_open_read(path, offset)
     f = _open_filtered(path, "rb")
     if offset:
         if path.endswith(COMPRESSED_SUFFIXES):
@@ -116,6 +128,9 @@ def OpenWriteStream(path: str) -> IO[bytes]:
     if _scheme(path) == "s3":
         from . import s3_file
         return s3_file.s3_open_write(path)
+    if _scheme(path) == "hdfs":
+        from . import hdfs_file
+        return hdfs_file.hdfs_open_write(path)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
