@@ -1,0 +1,53 @@
+"""vfs_tool: inspect and copy through the virtual file system.
+
+Reference: /root/reference/examples/vfs_tool (glob/read/write over the
+vfs dispatch). Works with file://, s3:// and hdfs:// paths, compressed
+suffixes included.
+
+Usage:
+  python examples/vfs_tool.py glob  'PATH_OR_GLOB'
+  python examples/vfs_tool.py cat   'PATH' [--offset N]
+  python examples/vfs_tool.py copy  'SRC' 'DST'
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401
+
+import argparse
+import shutil
+import sys
+
+from thrill_tpu.vfs import file_io
+
+
+def main():
+    p = argparse.ArgumentParser()
+    sub = p.add_subparsers(dest="cmd", required=True)
+    g = sub.add_parser("glob")
+    g.add_argument("pattern")
+    c = sub.add_parser("cat")
+    c.add_argument("path")
+    c.add_argument("--offset", type=int, default=0)
+    cp = sub.add_parser("copy")
+    cp.add_argument("src")
+    cp.add_argument("dst")
+    args = p.parse_args()
+
+    if args.cmd == "glob":
+        fl = file_io.Glob(args.pattern)
+        for f in fl.files:
+            print(f"{f.size:>12}  {f.size_ex_psum:>12}  "
+                  f"{'Z' if f.is_compressed else ' '}  {f.path}")
+        print(f"total: {len(fl)} files, {fl.total_size} bytes")
+    elif args.cmd == "cat":
+        with file_io.OpenReadStream(args.path, offset=args.offset) as f:
+            shutil.copyfileobj(f, sys.stdout.buffer)
+    else:
+        with file_io.OpenReadStream(args.src) as src, \
+                file_io.OpenWriteStream(args.dst) as dst:
+            shutil.copyfileobj(src, dst)
+
+
+if __name__ == "__main__":
+    main()

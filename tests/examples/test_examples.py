@@ -134,6 +134,16 @@ def test_dc3_suffix_array():
     RunLocalMock(job, 4)
 
 
+def test_prefix_quadrupling():
+    rng = np.random.default_rng(17)
+    text = rng.integers(97, 100, 250).astype(np.uint8)
+
+    def job(ctx):
+        sa = ss.suffix_array_quadrupling(ctx, text)
+        assert np.array_equal(sa, ss.suffix_array_dense(text))
+    RunLocalMock(job, 4)
+
+
 def test_wavelet_matrix_and_bwt():
     """Wavelet matrix access reconstructs every symbol; BWT round-trip
     sanity via its defining permutation."""
