@@ -92,3 +92,50 @@ def test_pipeline_on_chunked_engine(monkeypatch):
         assert [int(x) for x in ctx.Distribute(vals).Sort().AllGather()] \
             == sorted(vals.tolist())
     RunLocalMock(job, 4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "chunked", "bitonic"])
+@pytest.mark.parametrize("n", [1, 5, 1000])
+def test_u32_split_matches_u64(monkeypatch, impl, n):
+    """The uint32 word-split path (TPU: no native 64-bit integer ALU)
+    must produce the identical stable permutation."""
+    rng = np.random.default_rng(n * 7 + len(impl))
+    words = [jnp.asarray((rng.integers(0, 1 << 62, n, dtype=np.int64)
+                          ).astype(np.uint64)),
+             jnp.asarray(rng.integers(0, 3, n).astype(np.uint64))]
+    monkeypatch.setenv("THRILL_TPU_SORT_IMPL", impl)
+    monkeypatch.setenv("THRILL_TPU_SORT_U32", "0")
+    perm64 = np.asarray(device_sort.argsort_words(words))
+    monkeypatch.setenv("THRILL_TPU_SORT_U32", "1")
+    perm32 = np.asarray(device_sort.argsort_words(words))
+    assert np.array_equal(perm64, perm32)
+
+
+def test_merge_sorted_runs():
+    """C sorted runs in, one sorted sequence out (no base-case sort)."""
+    rng = np.random.default_rng(9)
+    C, L = 4, 256
+    key = np.sort(rng.integers(0, 1000, (C, L)).astype(np.uint64), axis=1)
+    iota = np.arange(C * L, dtype=np.uint64).reshape(C, L)
+    out = device_sort.merge_sorted_runs(
+        [jnp.asarray(key), jnp.asarray(iota)])
+    merged_key = np.asarray(out[0]).reshape(-1)
+    merged_iota = np.asarray(out[1]).reshape(-1)
+    order = np.lexsort((iota.reshape(-1), key.reshape(-1)))
+    assert np.array_equal(merged_key, key.reshape(-1)[order])
+    assert np.array_equal(merged_iota, iota.reshape(-1)[order])
+
+
+def test_pipeline_u32_engine(monkeypatch):
+    """Full Sort pipeline (incl. the fused run-merge exchange) on the
+    u32 split path across worker counts incl. non-power-of-two."""
+    monkeypatch.setenv("THRILL_TPU_SORT_U32", "1")
+    from thrill_tpu.api import RunLocalMock
+
+    def job(ctx):
+        rng = np.random.default_rng(3)
+        vals = rng.integers(0, 200, 5000).astype(np.int64)
+        assert [int(x) for x in ctx.Distribute(vals).Sort().AllGather()] \
+            == sorted(vals.tolist())
+    for w in (1, 2, 5, 8):
+        RunLocalMock(job, w)

@@ -298,7 +298,7 @@ def _run_bounds(lw, lvalid, rw, rvalid):
     # with legitimate keys that encode to all-ones (uint64.max, all-0xFF
     # byte keys) and produce phantom pairs against padding garbage.
     valid_all = jnp.concatenate([lvalid, rvalid])
-    invalid_word = (~valid_all).astype(jnp.uint64)
+    invalid_word = (~valid_all).astype(jnp.uint32)
 
     from ...core.device_sort import argsort_words
 

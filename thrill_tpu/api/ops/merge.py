@@ -252,7 +252,7 @@ def _device_merge(inputs: List[DeviceShards], key_fn: Callable,
             gi = jnp.concatenate(gidx_all)
             valid = jnp.concatenate(valid_all)
             from ...core.device_sort import argsort_words
-            sort_words = ([(~valid).astype(jnp.uint64)]
+            sort_words = ([(~valid).astype(jnp.uint32)]
                           + [wm[:, j] for j in range(nwords)]
                           + [iw, gi])
             perm = argsort_words(sort_words)

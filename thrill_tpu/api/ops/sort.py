@@ -238,7 +238,7 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
                     sort_words = list(words) + [iota]
                 else:
                     valid = jnp.arange(cap) < count
-                    sort_words = ([(~valid).astype(jnp.uint64)]
+                    sort_words = ([(~valid).astype(jnp.uint32)]
                                   + list(words) + [iota])
                 perm = argsort_words(sort_words)
                 return tuple(jnp.take(l[0], perm, axis=0)[None]
@@ -271,7 +271,7 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
                 sort_words = list(words) + [gidx.astype(jnp.uint64)]
             else:
                 valid = jnp.arange(cap) < count
-                sort_words = ([(~valid).astype(jnp.uint64)]
+                sort_words = ([(~valid).astype(jnp.uint32)]
                               + list(words) + [gidx.astype(jnp.uint64)])
             perm = argsort_words(sort_words)
             words_s = [jnp.take(w, perm) for w in words]
@@ -344,6 +344,13 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
     sorted_payload = list(out2[2:])
     S = mex.fetch(send_mat)
 
+    # fused dense path: ship + MERGE the received rank-ordered runs in
+    # one program (no compaction scatter, no phase-3 re-sort)
+    if exchange.dense_all_to_all_applies(mex, S):
+        return _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
+                                     sorted_payload, treedef, S, nwords,
+                                     token)
+
     # carrier = words + gidx (already sorted, no gather needed) + payload
     carrier_tree = {
         "__words": words_mat, "__gidx": gidx_s,
@@ -368,7 +375,7 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
             gi = tree["__gidx"]
             words = [wm[:, i] for i in range(nwords)]
             from ...core.device_sort import argsort_words
-            invalid_word = (~valid).astype(jnp.uint64)
+            invalid_word = (~valid).astype(jnp.uint32)
             perm = argsort_words([invalid_word] + words
                                  + [gi.astype(jnp.uint64)])
             # the ONE payload gather of this phase
@@ -382,6 +389,108 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
     out3 = f3(carrier.counts_device(), *leaves3)
     tree = jax.tree.unflatten(treedef, list(out3))
     return DeviceShards(mex, tree, carrier.counts.copy())
+
+
+def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
+                          sorted_payload, treedef, S: np.ndarray,
+                          nwords: int, token) -> DeviceShards:
+    """Phase 2.5+3 fused: scatter sends, all_to_all, then MERGE the W
+    received runs — one jitted program, one payload gather.
+
+    The received blocks land rank-ordered at static ``M_pad`` run
+    boundaries, each run internally sorted by (key words, global index)
+    — the sender classified over key-sorted items. Re-sorting them from
+    scratch (the reference receivers sort run-by-run then multiway-merge,
+    api/sort.hpp:665-699, 216-271) wastes the sortedness; here a bitonic
+    merge tree over the run boundaries replaces both the phase-B
+    compaction scatter and the phase-3 full sort. Falls back to the
+    generic exchange + full sort for ragged/one-factor modes (those
+    compact receives at dynamic boundaries).
+    """
+    from ...core.device_sort import (XLA_SORT_MAX_N, _impl, _use_u32,
+                                     _split_words_u32, merge_sorted_runs)
+    W = mex.num_workers
+    cap = sorted_dest.shape[1]
+    R = S.sum(axis=0)
+    new_counts = R.astype(np.int64)
+
+    # capacity agreement — sticky like the generic dense exchange
+    cap_ident = ("sort_fused_caps", token, cap, nwords, treedef,
+                 tuple((l.dtype, l.shape[2:]) for l in sorted_payload))
+    M_pad, out_cap = exchange._sticky_caps(
+        mex, cap_ident, (max(int(S.max()), 1), max(int(R.max()), 1)))
+    mex.stats_padded_rows += W * M_pad
+
+    # carrier = payload + words matrix + gidx (the shipped columns)
+    exchange.account_traffic(
+        mex, S, exchange.leaf_item_bytes(sorted_payload) + 8 * (nwords + 1))
+
+    Wp = 1 << (W - 1).bit_length()                # runs padded to pow2
+    Np = Wp * M_pad
+    key = ("sort_fused", token, W, cap, M_pad, out_cap, nwords, treedef,
+           tuple((l.dtype, l.shape[2:]) for l in sorted_payload))
+
+    def build():
+        def f(sdest, srow, scol, wm_a, gi_a, *ls):
+            d = sdest[0]
+            S_row = srow[0]
+            S_col = scol[0]
+            send_idx = exchange.send_slot_index(d, S_row, W, M_pad, cap)
+
+            def ship(x):
+                return exchange.ship_blocks(x, send_idx, W, M_pad)
+
+            wm_r = ship(wm_a[0])                  # [W*M_pad, nwords]
+            gi_r = ship(gi_a[0])                  # [W*M_pad]
+            payload_r = [ship(l[0]) for l in ls]
+
+            j = jnp.arange(M_pad)[None, :]
+            valid = (j < S_col[:, None]).reshape(-1)   # [W*M_pad]
+
+            words = [wm_r[:, k] for k in range(nwords)]
+            # validity as a native u32 word: _split_words_u32 keeps
+            # non-u64 words single, so no dead zero hi-word rides along
+            sort_words = ([(~valid).astype(jnp.uint32)] + words
+                          + [gi_r.astype(jnp.uint64)])
+            if _use_u32():
+                sort_words = _split_words_u32(sort_words)
+            idt = jnp.uint32 if Np <= (1 << 31) else jnp.uint64
+            iota = jnp.arange(Np, dtype=idt)
+
+            # pad runs W -> Wp: invalid word 1 + max key words sorts the
+            # synthetic runs after every real row (real invalid rows
+            # carry zero key words from the recv buffer)
+            def pad_rows(a):
+                if Wp == W:
+                    return a
+                return jnp.concatenate(
+                    [a, jnp.full(Np - W * M_pad, jnp.iinfo(a.dtype).max,
+                                 a.dtype)])
+
+            arrs = [pad_rows(w) for w in sort_words] + [iota]
+            if _impl(Np) == "xla":
+                res = lax.sort(tuple(arrs), dimension=0,
+                               num_keys=len(arrs), is_stable=False)
+                perm = res[-1][:out_cap].astype(jnp.int32)
+            else:
+                arrs = [a.reshape(Wp, M_pad) for a in arrs]
+                merged = merge_sorted_runs(arrs)
+                perm = merged[-1].reshape(-1)[:out_cap].astype(jnp.int32)
+
+            # the ONE payload gather of this phase (clip: slots past the
+            # valid total may point at synthetic pad rows)
+            perm = jnp.minimum(perm, W * M_pad - 1)
+            return tuple(jnp.take(p, perm, axis=0)[None]
+                         for p in payload_r)
+
+        return mex.smap(f, 5 + len(sorted_payload))
+
+    fb = mex.cached(key, build)
+    srow = mex.put(S.astype(np.int32))
+    scol = mex.put(S.T.copy().astype(np.int32))
+    out = fb(sorted_dest, srow, scol, words_mat, gidx_s, *sorted_payload)
+    tree = jax.tree.unflatten(treedef, list(out))
+    return DeviceShards(mex, tree, new_counts)
 
 
 def _lex_greater(words_mat: jnp.ndarray, gidx: jnp.ndarray,

@@ -48,18 +48,53 @@ def _impl(n: int) -> str:
     return "chunked"
 
 
+def _use_u32() -> bool:
+    """Split uint64 key words into native uint32 (hi, lo) pairs?
+
+    TPU VPU lanes are 32-bit; XLA emulates every 64-bit integer compare
+    and select as u32 pairs with carry fixups. Splitting explicitly
+    yields the same lexicographic order ((hi, lo) big-endian) out of
+    native ops and lets the carried iota be a single u32 word. Default
+    on for accelerator backends, off on CPU (native 64-bit ALU); env
+    THRILL_TPU_SORT_U32 = 0|1 overrides.
+    """
+    mode = os.environ.get("THRILL_TPU_SORT_U32")
+    if mode is not None:
+        return mode not in ("0", "false", "")
+    return jax.default_backend() != "cpu"
+
+
+def _split_words_u32(words: List[jnp.ndarray]) -> List[jnp.ndarray]:
+    """uint64 word list -> equivalent uint32 (hi, lo) word list.
+
+    Words already narrower than 33 bits keep one (lo) word."""
+    out: List[jnp.ndarray] = []
+    for w in words:
+        if w.dtype != jnp.uint64:
+            out.append(w.astype(jnp.uint32))
+            continue
+        out.append((w >> jnp.uint64(32)).astype(jnp.uint32))
+        out.append(w.astype(jnp.uint32))
+    return out
+
+
 def argsort_words(words: List[jnp.ndarray]) -> jnp.ndarray:
     """Stable argsort by uint64 key words (lexicographic). [n] int32."""
     n = words[0].shape[0]
     impl = _impl(n)
+    if _use_u32():
+        words = _split_words_u32(words)
+        idt = jnp.uint32 if n <= (1 << 31) else jnp.uint64
+    else:
+        idt = jnp.uint64
     if impl == "xla":
-        iota = jnp.arange(n, dtype=jnp.uint64)
+        iota = jnp.arange(n, dtype=idt)
         res = lax.sort(tuple(words) + (iota,), dimension=0,
                        num_keys=len(words), is_stable=True)
         return res[-1].astype(jnp.int32)
     if impl == "chunked":
-        return _chunked_argsort(words)
-    return _bitonic_argsort(words)
+        return _chunked_argsort(words, index_dtype=idt)
+    return _bitonic_argsort(words, index_dtype=idt)
 
 
 def _lex_gt(a_words, b_words):
@@ -93,7 +128,8 @@ def _compare_exchange(arrs, d: int):
 
 
 def _chunked_argsort(words: List[jnp.ndarray],
-                     chunk: int = XLA_SORT_MAX_N) -> jnp.ndarray:
+                     chunk: int = XLA_SORT_MAX_N,
+                     index_dtype=jnp.uint64) -> jnp.ndarray:
     """Sorted 64K tiles + bitonic merge tree; [n] int32 permutation.
 
     Stability comes from carrying the original index as the final key
@@ -107,18 +143,30 @@ def _chunked_argsort(words: List[jnp.ndarray],
     n = 1 << (n_real - 1).bit_length()
     c = min(chunk, n)
     pad = n - n_real
-    maxw = jnp.uint64(0xFFFFFFFFFFFFFFFF)
-    iota = jnp.arange(n, dtype=jnp.uint64)
-    arrs = [jnp.concatenate([w.astype(jnp.uint64),
-                             jnp.full(pad, maxw, jnp.uint64)])
-            if pad else w.astype(jnp.uint64) for w in words] + [iota]
+    iota = jnp.arange(n, dtype=index_dtype)
+    arrs = [jnp.concatenate([w, jnp.full(pad, jnp.iinfo(w.dtype).max,
+                                         w.dtype)])
+            if pad else w for w in words] + [iota]
 
     C = n // c
     arrs = [a.reshape(C, c) for a in arrs]
     # base case: batched sort of every tile (compiles like one 64K sort)
     arrs = list(lax.sort(tuple(arrs), dimension=1, num_keys=len(arrs),
                          is_stable=False))
-    L = c
+    arrs = merge_sorted_runs(arrs)
+    return arrs[-1].reshape(-1)[:n_real].astype(jnp.int32)
+
+
+def merge_sorted_runs(arrs: List[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Bitonic merge tree over C sorted runs; [C, L] arrays -> [1, C*L].
+
+    Each input row must be sorted ascending by the word tuple (ties
+    allowed); C and L must be powers of two. log C merge levels, each a
+    reshape-based compare-exchange cascade — no gathers. This is the
+    back half of the chunked sort, exposed for callers whose runs are
+    already sorted (Sort phase 3 merges the W received rank-ordered
+    runs this way instead of re-sorting from scratch)."""
+    C, L = arrs[0].shape
     while C > 1:
         # pair neighbouring runs: ascending ++ descending is bitonic
         paired = [a.reshape(C // 2, 2, L) for a in arrs]
@@ -131,10 +179,11 @@ def _chunked_argsort(words: List[jnp.ndarray],
         while d >= 1:
             arrs = _compare_exchange(arrs, d)
             d //= 2
-    return arrs[-1].reshape(-1)[:n_real].astype(jnp.int32)
+    return arrs
 
 
-def _bitonic_argsort(words: List[jnp.ndarray]) -> jnp.ndarray:
+def _bitonic_argsort(words: List[jnp.ndarray],
+                     index_dtype=jnp.uint64) -> jnp.ndarray:
     n_real = words[0].shape[0]
     if n_real == 1:
         return jnp.zeros(1, jnp.int32)
@@ -143,13 +192,12 @@ def _bitonic_argsort(words: List[jnp.ndarray]) -> jnp.ndarray:
     # real items (handles non-pow2 caps, e.g. after local concat)
     n = 1 << (n_real - 1).bit_length()
     pad = n - n_real
-    maxw = jnp.uint64(0xFFFFFFFFFFFFFFFF)
     k = n.bit_length() - 1
     # original index as the final key word: total order -> stability
-    iota = jnp.arange(n, dtype=jnp.uint64)
-    arrs = tuple(jnp.concatenate([w.astype(jnp.uint64),
-                                  jnp.full(pad, maxw, jnp.uint64)])
-                 if pad else w.astype(jnp.uint64) for w in words) + (iota,)
+    iota = jnp.arange(n, dtype=index_dtype)
+    arrs = tuple(jnp.concatenate([w, jnp.full(pad, jnp.iinfo(w.dtype).max,
+                                              w.dtype)])
+                 if pad else w for w in words) + (iota,)
 
     stages = [(s, ss) for s in range(k) for ss in range(s, -1, -1)]
     stage_of = jnp.array([s for s, _ in stages], jnp.int32)

@@ -25,7 +25,7 @@ def sort_by_key_words(words: List[jnp.ndarray], tree: Any, valid: jnp.ndarray,
     Returns (sorted_words, sorted_tree, sorted_valid). ``extra_words``
     sort after the key words (e.g. global index for stability).
     """
-    invalid_first_word = (~valid).astype(jnp.uint64)  # valid(0) < invalid(1)
+    invalid_first_word = (~valid).astype(jnp.uint32)  # valid(0) < invalid(1)
     sort_keys = [invalid_first_word] + list(words) + list(extra_words)
     perm = _argsort_multi(sort_keys)
     take = lambda x: jnp.take(x, perm, axis=0)

@@ -45,6 +45,39 @@ def _ex_cumsum(x):
     return jnp.cumsum(x) - x
 
 
+def resolve_mode(mex: MeshExec) -> str:
+    """Exchange mode precedence: env THRILL_TPU_EXCHANGE, then the
+    mesh's configured mode, then dense. Single source of truth for
+    every caller that gates on the exchange plan (the Sort fused path
+    must agree with the plan the generic exchange would pick)."""
+    import os
+    return os.environ.get("THRILL_TPU_EXCHANGE") or \
+        getattr(mex, "exchange_mode", "dense")
+
+
+def send_slot_index(dest, S_row, W: int, M_pad: int, cap: int):
+    """Traced helper: flat [W*M_pad] send-buffer position per item
+    (dump row W*M_pad for invalid), given dest-sorted destinations and
+    this worker's send-count row."""
+    off = _ex_cumsum(S_row)
+    dc = jnp.clip(dest, 0, W - 1)
+    slot = jnp.arange(cap) - jnp.take(off, dc)
+    return jnp.where(dest < W, dc * M_pad + slot, W * M_pad)
+
+
+def ship_blocks(x, send_idx, W: int, M_pad: int):
+    """Traced helper: scatter one leaf into [W, M_pad] padded
+    per-destination blocks and all_to_all them; returns the received
+    [W*M_pad, ...] rank-ordered runs (run w = source w's items)."""
+    trail = x.shape[1:]
+    buf = jnp.zeros((W * M_pad + 1,) + trail, x.dtype)
+    buf = buf.at[send_idx].set(x)
+    blocks = buf[:W * M_pad].reshape((W, M_pad) + trail)
+    recv = lax.all_to_all(blocks, AXIS, split_axis=0,
+                          concat_axis=0, tiled=True)
+    return recv.reshape((W * M_pad,) + trail)
+
+
 def send_counts(dest: jnp.ndarray, W: int) -> jnp.ndarray:
     """Traced helper (inside shard_map): per-destination send histogram,
     all-gathered into the replicated [W, W] matrix every worker needs
@@ -142,6 +175,31 @@ def _sticky_caps(mex: MeshExec, ident: Tuple, needed: Tuple[int, ...]
     return grown
 
 
+def dense_all_to_all_applies(mex: MeshExec, S: np.ndarray) -> bool:
+    """Would the planner use the single dense all_to_all for this send
+    matrix? Shared predicate so fused callers (Sort's run-merge path)
+    take the fused program exactly when the generic exchange would have
+    taken the dense plan."""
+    return resolve_mode(mex) == "dense" and not _skewed(S)
+
+
+def account_traffic(mex: MeshExec, S: np.ndarray, item_bytes: int) -> None:
+    """Traffic accounting shared by every exchange plan (reference:
+    net::Manager tx/rx counters feeding the end-of-job OverallStats
+    AllReduce, api/context.cpp:1275-1341)."""
+    moved = int(S.sum()) - int(np.trace(S))       # off-diagonal items
+    mex.stats_exchanges += 1
+    mex.stats_items_moved += moved
+    mex.stats_bytes_moved += moved * item_bytes
+
+
+def leaf_item_bytes(leaves) -> int:
+    """Per-item byte width across [W, cap, ...] leaves."""
+    return sum(int(np.dtype(l.dtype).itemsize) *
+               int(np.prod(l.shape[2:], dtype=np.int64))
+               for l in leaves)
+
+
 def _skewed(S: np.ndarray) -> bool:
     """Is the send matrix skewed enough that uniform padding wastes
     more than the 1-factor round schedule's extra latency costs?
@@ -171,24 +229,14 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     R = S.sum(axis=0)                             # recv totals per worker
     new_counts = R.astype(np.int64)
 
-    # traffic accounting (reference: net::Manager tx/rx counters feeding
-    # the end-of-job OverallStats AllReduce, api/context.cpp:1275-1341)
-    moved = int(S.sum()) - int(np.trace(S))       # off-diagonal items
-    item_bytes = sum(int(np.dtype(l.dtype).itemsize) *
-                     int(np.prod(l.shape[2:], dtype=np.int64))
-                     for l in sorted_leaves)
-    mex.stats_exchanges += 1
-    mex.stats_items_moved += moved
-    mex.stats_bytes_moved += moved * item_bytes
+    account_traffic(mex, S, leaf_item_bytes(sorted_leaves))
 
     if W == 1:
         # no movement: items are already dest-sorted (valid first)
         tree = jax.tree.unflatten(treedef, sorted_leaves)
         return DeviceShards(mex, tree, new_counts)
 
-    import os
-    mode = os.environ.get("THRILL_TPU_EXCHANGE") or \
-        getattr(mex, "exchange_mode", "dense")
+    mode = resolve_mode(mex)
     if mode == "ragged":
         return _exchange_ragged(mex, treedef, sorted_leaves, S, min_cap)
     if mode == "onefactor" or (mode == "dense" and _skewed(S)):
@@ -212,12 +260,7 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
             d = sdest[0]                          # [cap] dest-sorted
             S_row = srow[0]                       # my send counts [W]
             S_col = scol[0]                       # my recv counts by src [W]
-            off = _ex_cumsum(S_row)
-            i = jnp.arange(cap)
-            valid = d < W
-            slot = i - jnp.take(off, jnp.clip(d, 0, W - 1))
-            send_idx = jnp.where(valid, jnp.clip(d, 0, W - 1) * M_pad + slot,
-                                 W * M_pad)
+            send_idx = send_slot_index(d, S_row, W, M_pad, cap)
             roff = _ex_cumsum(S_col)
             j = jnp.arange(M_pad)[None, :]
             rc_valid = j < S_col[:, None]
@@ -226,15 +269,9 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
             outs = []
             for l in ls:
                 x = l[0]                          # [cap, ...]
-                trail = x.shape[1:]
-                buf = jnp.zeros((W * M_pad + 1,) + trail, x.dtype)
-                buf = buf.at[send_idx].set(x)
-                blocks = buf[:W * M_pad].reshape((W, M_pad) + trail)
-                recv = lax.all_to_all(blocks, AXIS, split_axis=0,
-                                      concat_axis=0, tiled=True)
-                out = jnp.zeros((out_cap + 1,) + trail, x.dtype)
-                out = out.at[out_idx.reshape(-1)].set(
-                    recv.reshape((W * M_pad,) + trail))
+                recv = ship_blocks(x, send_idx, W, M_pad)
+                out = jnp.zeros((out_cap + 1,) + x.shape[1:], x.dtype)
+                out = out.at[out_idx.reshape(-1)].set(recv)
                 outs.append(out[:out_cap][None])
             return tuple(outs)
 
