@@ -203,6 +203,131 @@ def suffix_array_dense(text: np.ndarray) -> np.ndarray:
                     dtype=np.int64)
 
 
+# DC7 difference cover: {0, 1, 3} mod 7 (differences cover Z_7), so 3/7
+# of positions are sampled and any two residues share an aligning shift
+DC7_D = (0, 1, 3)
+# SHIFT[a][b] = min t >= 0 with (a+t) % 7 in D and (b+t) % 7 in D
+DC7_SHIFT = [[min(t for t in range(7)
+                  if (a + t) % 7 in DC7_D and (b + t) % 7 in DC7_D)
+              for b in range(7)] for a in range(7)]
+
+
+def dc7_suffix_array(ctx: Context, text: np.ndarray) -> np.ndarray:
+    """DC7 (difference cover mod 7) suffix array.
+
+    Reference: /root/reference/examples/suffix_sorting/dc7.cpp — like
+    DC3 but samples 3/7 of positions with the perfect difference cover
+    {0,1,3} mod 7, so each recursion level shrinks by 3/7 instead of
+    2/3 and sorts wider (7-char) tuples: fewer, fatter device Sorts,
+    the shape the MXU-era sort engine prefers. The sample 7-tuple sort
+    and the batched non-sample class sort ride the device DIA Sort;
+    naming and the comparator merge are linear host passes.
+    """
+    return _dc7(ctx, np.asarray(text, dtype=np.int64))
+
+
+def _dc7(ctx: Context, S: np.ndarray) -> np.ndarray:
+    """Suffix array of an arbitrary non-negative int string S."""
+    n = len(S)
+    if n <= 16:
+        return np.array(sorted(range(n),
+                               key=lambda i: tuple(S[i:]) + (-1,)),
+                        dtype=np.int64)
+
+    # internal shift so 0 is reserved for padding/terminators: zeros
+    # then appear only in the tail, making every zero-containing
+    # 7-tuple position-unique (shorter-suffix-sorts-first semantics)
+    T = S + 1
+    Tp = np.concatenate([T, np.zeros(14, dtype=np.int64)])
+
+    res = np.arange(n) % 7
+    s_cls = [np.flatnonzero(res == c).astype(np.int64) for c in range(7)]
+    s_all = np.concatenate([s_cls[c] for c in DC7_D])
+
+    # ---- device sort of the sample 7-tuples (naming phase) ----------
+    cols = {f"c{k}": Tp[s_all + k] for k in range(7)}
+    d = ctx.Distribute({"i": s_all, **cols})
+    got = d.Sort(key_fn=lambda t: tuple(t[f"c{k}"] for k in range(7))) \
+        .AllGather()
+    order = np.array([int(t["i"]) for t in got], dtype=np.int64)
+    tup = np.array([[int(t[f"c{k}"]) for k in range(7)] for t in got],
+                   dtype=np.int64)
+
+    boundary = np.ones(len(order), dtype=np.int64)
+    if len(order) > 1:
+        boundary[1:] = np.any(tup[1:] != tup[:-1], axis=1)
+    names_sorted = np.cumsum(boundary)
+    num_names = int(names_sorted[-1])
+    name_of = np.zeros(n + 14, dtype=np.int64)
+    name_of[order] = names_sorted
+
+    if num_names < len(s_all):
+        # recursion string: class sections joined by 0 terminators (a
+        # unique-smallest section end keeps cross-section comparisons
+        # from ever being decided by wrapped-around names; the
+        # recursion re-shifts internally, so 0 stays reserved)
+        sections = [name_of[s_cls[c]] for c in DC7_D]
+        R = np.concatenate([sections[0], [0], sections[1], [0],
+                            sections[2]])
+        pos_map = np.concatenate([s_cls[DC7_D[0]], [-1],
+                                  s_cls[DC7_D[1]], [-1],
+                                  s_cls[DC7_D[2]]])
+        SA_R = _dc7(ctx, R)
+        SA12 = pos_map[SA_R]
+        SA12 = SA12[SA12 >= 0]
+    else:
+        SA12 = order
+
+    rank7 = np.zeros(n + 14, dtype=np.int64)
+    rank7[SA12] = np.arange(1, len(SA12) + 1)
+
+    # ---- one batched device sort of the non-sample classes ----------
+    # class c orders by (T[i..i+tc-1], rank7[i+tc]); keys are laid out
+    # (class, ch0.., rank, 0-pad) so one Sort covers all four classes
+    ns_cls = [c for c in range(7) if c not in DC7_D]
+    ns_pos = np.concatenate([s_cls[c] for c in ns_cls])
+    if len(ns_pos):
+        tcs = np.array([DC7_SHIFT[c][c] for c in range(7)], dtype=np.int64)
+        tmax = int(tcs[ns_cls].max())              # = 3 for {0,1,3}
+        keys = np.zeros((len(ns_pos), tmax + 2), dtype=np.int64)
+        keys[:, 0] = ns_pos % 7
+        for c in ns_cls:                           # 4 vectorized fills
+            mask = ns_pos % 7 == c
+            pos = ns_pos[mask]
+            tc = int(tcs[c])
+            keys[np.flatnonzero(mask)[:, None], 1 + np.arange(tc)] = \
+                Tp[pos[:, None] + np.arange(tc)]
+            keys[mask, 1 + tc] = rank7[pos + tc]
+        dn = ctx.Distribute({"i": ns_pos,
+                             **{f"k{j}": keys[:, j]
+                                for j in range(tmax + 2)}})
+        gotn = dn.Sort(key_fn=lambda t: tuple(t[f"k{j}"]
+                                              for j in range(tmax + 2))) \
+            .AllGather()
+        by_cls = {c: [] for c in ns_cls}
+        for t in gotn:
+            by_cls[int(t["k0"])].append(int(t["i"]))
+        seqs = [SA12.tolist()] + [by_cls[c] for c in ns_cls]
+    else:
+        seqs = [SA12.tolist()]
+
+    # ---- comparator merge of the 5 sorted sequences -----------------
+    import heapq
+    from functools import cmp_to_key
+
+    def cmp(i: int, j: int) -> int:
+        t = DC7_SHIFT[i % 7][j % 7]
+        for k in range(t):
+            if Tp[i + k] != Tp[j + k]:
+                return -1 if Tp[i + k] < Tp[j + k] else 1
+        ri, rj = rank7[i + t], rank7[j + t]
+        return -1 if ri < rj else (1 if ri > rj else 0)
+
+    out = np.fromiter(
+        heapq.merge(*seqs, key=cmp_to_key(cmp)), dtype=np.int64, count=n)
+    return out
+
+
 def wavelet_tree(ctx: Context, text: np.ndarray, bits: int = 8):
     """Wavelet matrix (level-ordered wavelet tree) of a byte sequence.
 
@@ -253,6 +378,75 @@ def bwt(ctx: Context, text: np.ndarray) -> np.ndarray:
     (reference: examples/suffix_sorting/wavelet_tree / bwt usage)."""
     sa = suffix_array(ctx, text)
     return text[(sa - 1) % len(text)]
+
+
+def rl_bwt(ctx: Context, text: np.ndarray):
+    """Run-length-compressed BWT: (run chars, run lengths).
+
+    Reference: examples/suffix_sorting/rl_bwt.cpp — BWT through the
+    suffix array, then run-length encoding of the output (the
+    reference encodes via a FlatWindow scan; the host pass here is the
+    same boundary-flag + segment-length computation).
+    """
+    b = bwt(ctx, text)
+    if len(b) == 0:
+        return np.array([], dtype=text.dtype), np.array([], np.int64)
+    starts = np.concatenate([[0], np.flatnonzero(b[1:] != b[:-1]) + 1])
+    lengths = np.diff(np.concatenate([starts, [len(b)]]))
+    return b[starts], lengths.astype(np.int64)
+
+
+def check_sa(text: np.ndarray, sa: np.ndarray) -> bool:
+    """Linear-time suffix array verification.
+
+    Reference: examples/suffix_sorting/check_sa.hpp — permutation check
+    plus the rank trick: sa is correct iff for consecutive entries
+    (text[sa[r-1]], rank[sa[r-1]+1]) <= (text[sa[r]], rank[sa[r]+1])
+    with the empty suffix ranked smallest.
+    """
+    n = len(text)
+    sa = np.asarray(sa)
+    if len(sa) != n:
+        return False
+    if n == 0:
+        return True
+    if not np.array_equal(np.sort(sa), np.arange(n)):
+        return False
+    rank = np.zeros(n + 1, dtype=np.int64)
+    rank[sa] = np.arange(1, n + 1)                 # rank[n] = 0 (empty)
+    a, b = sa[:-1], sa[1:]
+    ca, cb = text[a], text[b]
+    ra, rb = rank[a + 1], rank[b + 1]
+    return bool(np.all((ca < cb) | ((ca == cb) & (ra < rb))))
+
+
+def lcp_from_sa(text: np.ndarray, sa: np.ndarray) -> np.ndarray:
+    """LCP array (lcp[r] = lcp(suffix sa[r-1], suffix sa[r]), lcp[0]=0)
+    by Kasai's algorithm.
+
+    Reference: examples/suffix_sorting/construct_lcp.hpp — the
+    reference derives LCP during construction; the Kasai pass here
+    yields the identical array from any valid SA in O(n) host time.
+    """
+    n = len(text)
+    lcp = np.zeros(n, dtype=np.int64)
+    if n == 0:
+        return lcp
+    rank = np.zeros(n, dtype=np.int64)
+    rank[sa] = np.arange(n)
+    h = 0
+    for i in range(n):
+        r = rank[i]
+        if r > 0:
+            j = int(sa[r - 1])
+            while i + h < n and j + h < n and text[i + h] == text[j + h]:
+                h += 1
+            lcp[r] = h
+            if h > 0:
+                h -= 1
+        else:
+            h = 0
+    return lcp
 
 
 def main():

@@ -134,6 +134,55 @@ def test_dc3_suffix_array():
     RunLocalMock(job, 4)
 
 
+def test_dc7_suffix_array():
+    """DC7 golden test (reference: dc7.cpp). Periodic inputs whose
+    length is a multiple of 7 stress the section-terminator logic (a
+    class's last sample tuple can then contain no padding zeros)."""
+    rng = np.random.default_rng(23)
+
+    def job(ctx):
+        for text in (
+            rng.integers(97, 100, 201).astype(np.uint8),   # random
+            np.frombuffer(b"a" * 28, np.uint8).copy(),     # n % 7 == 0
+            np.frombuffer(b"abababababababababababababab",
+                          np.uint8).copy(),                # period 2, n=28
+            np.frombuffer(b"abcabcabcabcabcabcabca", np.uint8).copy(),
+            np.frombuffer(b"mississippi", np.uint8).copy(),
+            np.frombuffer(b"ba", np.uint8).copy(),
+        ):
+            got = ss.dc7_suffix_array(ctx, text)
+            want = ss.suffix_array_dense(text)
+            assert np.array_equal(got, want), bytes(text)[:20]
+            assert ss.check_sa(text, got)
+    RunLocalMock(job, 4)
+
+
+def test_lcp_and_rl_bwt():
+    """Kasai LCP against brute force; run-length BWT reconstructs the
+    plain BWT (reference: construct_lcp.hpp, rl_bwt.cpp)."""
+    rng = np.random.default_rng(29)
+    text = rng.integers(97, 99, 150).astype(np.uint8)
+    sa = ss.suffix_array_dense(text)
+    lcp = ss.lcp_from_sa(text, sa)
+
+    def brute_lcp(a, b):
+        k = 0
+        while a + k < len(text) and b + k < len(text) \
+                and text[a + k] == text[b + k]:
+            k += 1
+        return k
+    assert lcp[0] == 0
+    for r in range(1, len(text), 13):
+        assert lcp[r] == brute_lcp(int(sa[r - 1]), int(sa[r]))
+    assert not ss.check_sa(text, sa[::-1])         # rejects a wrong SA
+
+    def job(ctx):
+        chars, lengths = ss.rl_bwt(ctx, text)
+        assert np.array_equal(np.repeat(chars, lengths), ss.bwt(ctx, text))
+        assert np.all(lengths >= 1)
+    RunLocalMock(job, 2)
+
+
 def test_prefix_quadrupling():
     rng = np.random.default_rng(17)
     text = rng.integers(97, 100, 250).astype(np.uint8)

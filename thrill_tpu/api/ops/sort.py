@@ -407,8 +407,8 @@ def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
     generic exchange + full sort for ragged/one-factor modes (those
     compact receives at dynamic boundaries).
     """
-    from ...core.device_sort import (XLA_SORT_MAX_N, _impl, _use_u32,
-                                     _split_words_u32, merge_sorted_runs)
+    from ...core.device_sort import (_impl, _use_u32, _split_words_u32,
+                                     merge_sorted_runs)
     W = mex.num_workers
     cap = sorted_dest.shape[1]
     R = S.sum(axis=0)
