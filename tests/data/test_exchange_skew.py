@@ -108,6 +108,50 @@ def test_dense_vs_onefactor_padding_ratio(monkeypatch):
     assert onefactor_rows * 8 < uniform_rows
 
 
+def test_multislice_tier_pure_rounds(monkeypatch):
+    """With THRILL_TPU_SLICES=2 on W=8, the 1-factor schedule must be
+    tier-pure (each round fully intra- or fully cross-slice), cover
+    every ordered pair once, and group the DCN rounds last."""
+    from thrill_tpu.data import exchange as ex
+
+    monkeypatch.setenv("THRILL_TPU_SLICES", "2")
+    mex = MeshExec(devices=jax.devices("cpu")[:8])
+    assert mex.num_slices == 2
+    rounds = ex.one_factor_rounds(mex)
+    assert len(rounds) == 7
+    sid = mex.slice_id
+    seen = set()
+    tiers = []
+    for to in rounds:
+        pair_tiers = {bool(sid[w] != sid[to[w]]) for w in range(8)}
+        assert len(pair_tiers) == 1, "mixed-tier round"
+        tiers.append(pair_tiers.pop())
+        assert sorted(to.tolist()) == list(range(8))   # a permutation
+        for w in range(8):
+            assert to[w] != w
+            seen.add((w, int(to[w])))
+    assert len(seen) == 8 * 7                          # full coverage
+    assert tiers == sorted(tiers), "ICI rounds must precede DCN rounds"
+
+
+def test_multislice_exchange_correct_and_accounted(monkeypatch):
+    """The sliced 1-factor exchange produces identical results and the
+    ICI/DCN byte split sums to the total moved bytes."""
+    monkeypatch.setenv("THRILL_TPU_SLICES", "2")
+    monkeypatch.setenv("THRILL_TPU_EXCHANGE", "onefactor")
+    ctx = _ctx(8)
+    assert ctx.mesh_exec.num_slices == 2
+    _skewed_job(ctx, n=5000)
+    vals = np.arange(3000, dtype=np.int64)
+    srt = ctx.Distribute(vals[::-1].copy()).Sort()
+    assert [int(x) for x in srt.AllGather()] == vals.tolist()
+    mex = ctx.mesh_exec
+    assert mex.stats_bytes_dcn > 0 and mex.stats_bytes_ici > 0
+    assert mex.stats_bytes_ici + mex.stats_bytes_dcn == \
+        mex.stats_bytes_moved
+    ctx.close()
+
+
 def test_sticky_capacities_stop_recompile_churn(monkeypatch):
     """Across loop iterations with wiggling counts, executables and
     capacities must reach a fixed point (no unbounded cache growth)."""

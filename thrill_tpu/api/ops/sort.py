@@ -407,8 +407,8 @@ def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
     generic exchange + full sort for ragged/one-factor modes (those
     compact receives at dynamic boundaries).
     """
-    from ...core.device_sort import (_impl, _use_u32, _split_words_u32,
-                                     merge_sorted_runs)
+    from ...core.device_sort import (_impl, merge_sorted_runs,
+                                     prepare_sort_words)
     W = mex.num_workers
     cap = sorted_dest.shape[1]
     R = S.sum(axis=0)
@@ -452,9 +452,7 @@ def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
             # non-u64 words single, so no dead zero hi-word rides along
             sort_words = ([(~valid).astype(jnp.uint32)] + words
                           + [gi_r.astype(jnp.uint64)])
-            if _use_u32():
-                sort_words = _split_words_u32(sort_words)
-            idt = jnp.uint32 if Np <= (1 << 31) else jnp.uint64
+            sort_words, idt = prepare_sort_words(sort_words, Np)
             iota = jnp.arange(Np, dtype=idt)
 
             # pad runs W -> Wp: invalid word 1 + max key words sorts the

@@ -78,15 +78,25 @@ def _split_words_u32(words: List[jnp.ndarray]) -> List[jnp.ndarray]:
     return out
 
 
-def argsort_words(words: List[jnp.ndarray]) -> jnp.ndarray:
-    """Stable argsort by uint64 key words (lexicographic). [n] int32."""
-    n = words[0].shape[0]
-    impl = _impl(n)
+def prepare_sort_words(words: List[jnp.ndarray], n: int):
+    """Shared key prep for every sort entry point: apply the u32 word
+    split when enabled and pick the index/iota dtype for ``n`` rows.
+    Returns (words, index_dtype). Callers that build their own sort
+    or merge network (Sort's fused run-merge) MUST go through this so
+    their key layout never diverges from ``argsort_words``."""
     if _use_u32():
         words = _split_words_u32(words)
         idt = jnp.uint32 if n <= (1 << 31) else jnp.uint64
     else:
         idt = jnp.uint64
+    return words, idt
+
+
+def argsort_words(words: List[jnp.ndarray]) -> jnp.ndarray:
+    """Stable argsort by uint64 key words (lexicographic). [n] int32."""
+    n = words[0].shape[0]
+    impl = _impl(n)
+    words, idt = prepare_sort_words(words, n)
     if impl == "xla":
         iota = jnp.arange(n, dtype=idt)
         res = lax.sort(tuple(words) + (iota,), dimension=0,

@@ -186,11 +186,55 @@ def dense_all_to_all_applies(mex: MeshExec, S: np.ndarray) -> bool:
 def account_traffic(mex: MeshExec, S: np.ndarray, item_bytes: int) -> None:
     """Traffic accounting shared by every exchange plan (reference:
     net::Manager tx/rx counters feeding the end-of-job OverallStats
-    AllReduce, api/context.cpp:1275-1341)."""
+    AllReduce, api/context.cpp:1275-1341). On multi-slice meshes the
+    bytes are split by tier: same-slice pairs ride ICI, cross-slice
+    pairs DCN."""
     moved = int(S.sum()) - int(np.trace(S))       # off-diagonal items
     mex.stats_exchanges += 1
     mex.stats_items_moved += moved
     mex.stats_bytes_moved += moved * item_bytes
+    sid = mex.slice_id
+    if mex.num_slices > 1:
+        cross = sid[:, None] != sid[None, :]
+        dcn_items = int(S[cross].sum())
+        mex.stats_bytes_dcn += dcn_items * item_bytes
+        mex.stats_bytes_ici += (moved - dcn_items) * item_bytes
+    else:
+        mex.stats_bytes_ici += moved * item_bytes
+
+
+def one_factor_rounds(mex: MeshExec) -> List[np.ndarray]:
+    """Round schedule for the pairwise exchange: a list of partner
+    permutations partner[w] covering every ordered pair exactly once
+    (the identity round is excluded — the caller scatters locally).
+
+    Single slice: the classic rotation partner = (w + r) % W
+    (reference: 1-factor scheduling, thrill/net/group.hpp:90-107).
+    Multi-slice (workers blocked by slice, equal block size B): rounds
+    are decomposed over (slice shift ds, chip shift dc) so every round
+    is TIER-PURE — either all pairs same-slice (ICI) or all cross-slice
+    (DCN). Tier-pure rounds pad only to their own tier's maximum (a
+    mixed round pays the global max even when DCN traffic is light),
+    and the DCN rounds are grouped last so the latency-bound tail rides
+    the wide-ICI rounds first.
+    """
+    W = mex.num_workers
+    sid = mex.slice_id
+    nS = mex.num_slices
+    blocked = (nS > 1 and W % nS == 0 and
+               np.array_equal(sid, np.repeat(np.arange(nS), W // nS)))
+    if not blocked:
+        return [np.array([(w + r) % W for w in range(W)])
+                for r in range(1, W)]
+    B = W // nS
+    s, c = np.arange(W) // B, np.arange(W) % B
+    rounds = []
+    for dc in range(1, B):                         # intra-slice (ICI)
+        rounds.append(s * B + (c + dc) % B)
+    for ds in range(1, nS):                        # cross-slice (DCN)
+        for dc in range(B):
+            rounds.append(((s + ds) % nS) * B + (c + dc) % B)
+    return rounds
 
 
 def leaf_item_bytes(leaves) -> int:
@@ -302,16 +346,17 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
     R = S.sum(axis=0)
     new_counts = R.astype(np.int64)
+    rounds = one_factor_rounds(mex)               # tier-pure if sliced
     cap_ident = ("xchg_of_caps", ident, cap, treedef,
                  tuple((l.dtype, l.shape[2:]) for l in sorted_leaves))
     needed = tuple(
-        max(int(S[np.arange(W), (np.arange(W) + r) % W].max()), 1)
-        for r in range(1, W)) + (max(int(R.max()), min_cap, 1),)
+        max(int(S[np.arange(W), to].max()), 1) for to in rounds
+    ) + (max(int(R.max()), min_cap, 1),)
     caps = _sticky_caps(mex, cap_ident, needed)
     M_rounds, out_cap = caps[:-1], caps[-1]
     mex.stats_padded_rows += sum(M_rounds)
 
-    key_b = ("xchg_of", cap, M_rounds, out_cap, treedef,
+    key_b = ("xchg_of", cap, M_rounds, out_cap, mex.num_slices, treedef,
              tuple((l.dtype, l.shape[2:]) for l in sorted_leaves))
 
     def build_b():
@@ -326,19 +371,21 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
             xs = [l[0] for l in ls]
             outs = [jnp.zeros((out_cap + 1,) + x.shape[1:], x.dtype)
                     for x in xs]
-            for r in range(W):
-                d_r = (widx + r) % W          # partner I send to
-                s_r = (widx - r) % W          # partner I receive from
+            # identity round: local scatter, no communication
+            sel0 = d == widx
+            slot0 = i - jnp.take(off, widx)
+            pos0 = jnp.where(sel0, jnp.take(roff, widx) + slot0, out_cap)
+            outs = [o.at[pos0].set(x) for o, x in zip(outs, xs)]
+            for r, to in enumerate(rounds):
+                inv = np.empty(W, dtype=np.int64)
+                inv[to] = np.arange(W)
+                d_r = jnp.take(jnp.asarray(to), widx)   # partner I send to
+                s_r = jnp.take(jnp.asarray(inv), widx)  # partner I recv from
                 sel = d == d_r
                 slot = i - jnp.take(off, d_r)
-                if r == 0:
-                    pos = jnp.where(sel, jnp.take(roff, widx) + slot,
-                                    out_cap)
-                    outs = [o.at[pos].set(x) for o, x in zip(outs, xs)]
-                    continue
-                M_r = M_rounds[r - 1]
+                M_r = M_rounds[r]
                 send_idx = jnp.where(sel, slot, M_r)
-                perm = [(w, (w + r) % W) for w in range(W)]
+                perm = [(w, int(to[w])) for w in range(W)]
                 j = jnp.arange(M_r)
                 n_recv = jnp.take(S_col, s_r)
                 pos = jnp.where(j < n_recv, jnp.take(roff, s_r) + j,

@@ -50,10 +50,47 @@ class MeshExec:
         self.stats_bytes_moved = 0
         # padded rows allocated by exchange plans (skew diagnostics)
         self.stats_padded_rows = 0
+        # ICI-vs-DCN split of bytes_moved (multi-slice meshes; equal to
+        # bytes_moved/0 on a single slice)
+        self.stats_bytes_ici = 0
+        self.stats_bytes_dcn = 0
         # exchange implementation ('dense' | 'onefactor' | 'ragged');
         # Context sets it from Config.exchange, THRILL_TPU_EXCHANGE
         # env overrides ('dense' auto-switches to 1-factor under skew)
         self.exchange_mode = "dense"
+        # slice topology: collectives between same-slice workers ride
+        # ICI, cross-slice DCN. Detected from the device objects'
+        # slice_index (real multi-slice pods); THRILL_TPU_SLICES=k
+        # overrides with k contiguous blocks (virtual-mesh testing).
+        self.slice_id = self._detect_slices()
+        self.num_slices = int(self.slice_id.max()) + 1 \
+            if len(self.slice_id) else 1
+
+    def _detect_slices(self) -> np.ndarray:
+        import os
+        import sys
+        W = self.num_workers
+        k = os.environ.get("THRILL_TPU_SLICES")
+        if k:
+            try:
+                k = int(k)
+            except ValueError:
+                print(f"thrill_tpu: THRILL_TPU_SLICES={k!r} is not an "
+                      f"integer; ignoring (single-slice topology)",
+                      file=sys.stderr)
+                k = 0
+            if k > 1:
+                if W % k == 0:
+                    return np.repeat(np.arange(k), W // k)
+                print(f"thrill_tpu: THRILL_TPU_SLICES={k} does not "
+                      f"divide {W} workers; ignoring (single-slice "
+                      f"topology)", file=sys.stderr)
+        ids = [getattr(d, "slice_index", None) for d in self.devices]
+        if all(i is not None for i in ids) and len(set(ids)) > 1:
+            # normalize to dense 0..nS-1 preserving device order
+            uniq = {s: n for n, s in enumerate(dict.fromkeys(ids))}
+            return np.array([uniq[i] for i in ids], dtype=np.int64)
+        return np.zeros(W, dtype=np.int64)
 
     # -- shardings ------------------------------------------------------
     @property
@@ -113,7 +150,8 @@ class MeshExec:
         them mid-process takes effect instead of hitting stale programs.
         """
         import os
-        key = key + (os.environ.get("THRILL_TPU_SORT_IMPL", "auto"),)
+        key = key + (os.environ.get("THRILL_TPU_SORT_IMPL", "auto"),
+                     os.environ.get("THRILL_TPU_SORT_U32"))
         fn = self._cache.get(key)
         if fn is None:
             fn = builder()
