@@ -32,8 +32,16 @@ def test_event_log_and_reports():
         assert "node_execute_start" in kinds
         assert "node_execute_done" in kinds
 
+        # every device exchange logs its volume + per-worker send split
+        xev = [e for e in events if e.get("event") == "exchange"]
+        assert xev, kinds
+        assert all(len(e["per_worker_sent"]) == 2 for e in xev)
+        assert all(e["bytes"] >= 0 and e["bytes_dcn"] == 0 for e in xev)
+
         html = render_html(events)
         assert "stage timeline" in html and "Sort" in html
+        assert "exchange volume" in html
+        assert "per-worker exchange lanes" in html and "worker 1" in html
 
         dot = render_dot(events)
         assert "digraph dia" in dot and "->" in dot

@@ -200,7 +200,17 @@ def account_traffic(mex: MeshExec, S: np.ndarray, item_bytes: int) -> None:
         mex.stats_bytes_dcn += dcn_items * item_bytes
         mex.stats_bytes_ici += (moved - dcn_items) * item_bytes
     else:
+        dcn_items = 0
         mex.stats_bytes_ici += moved * item_bytes
+    log = getattr(mex, "logger", None)
+    if log is not None and log.enabled:
+        sent = (S.sum(axis=1) - np.diag(S)).astype(int)
+        recv = (S.sum(axis=0) - np.diag(S)).astype(int)
+        log.line(event="exchange", items=moved,
+                 bytes=moved * item_bytes,
+                 bytes_dcn=dcn_items * item_bytes,
+                 per_worker_sent=sent.tolist(),
+                 per_worker_recv=recv.tolist())
 
 
 def one_factor_rounds(mex: MeshExec) -> List[np.ndarray]:

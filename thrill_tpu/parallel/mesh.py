@@ -79,6 +79,8 @@ class MeshExec:
                       f"integer; ignoring (single-slice topology)",
                       file=sys.stderr)
                 k = 0
+            if k == 1:                  # explicit single-slice override
+                return np.zeros(W, dtype=np.int64)
             if k > 1:
                 if W % k == 0:
                     return np.repeat(np.arange(k), W // k)

@@ -31,6 +31,7 @@ def load_events(path: str) -> List[dict]:
 def render_html(events: List[dict]) -> str:
     nodes = {}
     profiles = []
+    exchanges = []
     t0 = min((e["ts"] for e in events), default=0)
     for e in events:
         t = (e["ts"] - t0) / 1e6
@@ -42,6 +43,8 @@ def render_html(events: List[dict]) -> str:
                 end=t, items=e.get("items"))
         elif e.get("event") == "profile":
             profiles.append((t, e))
+        elif e.get("event") == "exchange":
+            exchanges.append((t, e))
 
     rows = []
     for nid in sorted(k for k in nodes if k is not None):
@@ -84,16 +87,77 @@ body {{ font: 13px monospace; margin: 2em; }}
 .lbl {{ width: 22em; }}
 .track {{ position: relative; flex: 1; height: 14px; background: #eee; }}
 .bar {{ position: absolute; top: 0; height: 100%; background: #07c; }}
+.mark {{ position: absolute; top: 0; height: 100%; background: #e60; }}
 .dur {{ width: 16em; text-align: right; color: #666; }}
 .cpu {{ width: 100%; height: 80px; background: #f7f7f7; }}
+.vol {{ width: 100%; height: 120px; background: #f7f7f7; }}
 </style></head><body>
 <h1>thrill_tpu execution profile</h1>
 <p>{len(rows)} executed nodes, total span {total:.3f}s,
-{len(profiles)} profile samples</p>
+{len(profiles)} profile samples, {len(exchanges)} exchanges</p>
 <h2>stage timeline</h2>
 {''.join(bars)}
+{_render_exchange_volume(exchanges, total)}
+{_render_worker_lanes(exchanges, total)}
 {cpu_line}
 </body></html>"""
+
+
+def _render_exchange_volume(exchanges, total: float) -> str:
+    """Cumulative cross-worker bytes over time, with the DCN share as a
+    second line on multi-slice meshes."""
+    if not exchanges:
+        return ""
+    cum = cum_dcn = 0
+    pts, pts_dcn = [(0.0, 0)], [(0.0, 0)]
+    for t, e in exchanges:
+        cum += e.get("bytes", 0)
+        cum_dcn += e.get("bytes_dcn", 0)
+        pts.append((t, cum))
+        pts_dcn.append((t, cum_dcn))
+    top = max(cum, 1)
+
+    def line(p, color):
+        s = " ".join(f"{100 * t / total:.2f},{118 - 110 * v / top:.1f}"
+                     for t, v in p)
+        return (f'<polyline fill="none" stroke="{color}" '
+                f'stroke-width="0.6" points="{s}"/>')
+
+    dcn = line(pts_dcn, "#e60") if cum_dcn else ""
+    return (f'<h2>exchange volume (cumulative {cum / 1e6:.1f} MB'
+            f'{f", DCN {cum_dcn / 1e6:.1f} MB" if cum_dcn else ""})</h2>'
+            f'<svg viewBox="0 0 100 120" class="vol" '
+            f'preserveAspectRatio="none">{line(pts, "#07c")}{dcn}</svg>')
+
+
+def _render_worker_lanes(exchanges, total: float) -> str:
+    """One lane per worker: each exchange draws a tick whose height is
+    that worker's share of the shipped items (send side) — skew between
+    lanes is load imbalance in the data plane."""
+    pairs = [(t, e["per_worker_sent"]) for t, e in exchanges
+             if e.get("per_worker_sent")]
+    if not pairs:
+        return ""
+    W = max(len(p) for _, p in pairs)
+    # tolerate appended logs from runs with different worker counts
+    pairs = [(t, p) for t, p in pairs if len(p) == W]
+    peak = max((max(p) for _, p in pairs), default=1) or 1
+    lanes = []
+    for w in range(W):
+        sent_total = sum(p[w] for _, p in pairs)
+        marks = []
+        for t, p in pairs:
+            h = max(100.0 * p[w] / peak, 2.0) if p[w] else 0.0
+            if h:
+                marks.append(
+                    f'<div class="mark" style="left:'
+                    f'{100 * t / total:.2f}%;width:0.4%;height:{h:.0f}%;'
+                    f'top:{100 - h:.0f}%"></div>')
+        lanes.append(
+            f'<div class="row"><span class="lbl">worker {w}</span>'
+            f'<div class="track">{"".join(marks)}</div>'
+            f'<span class="dur">{sent_total} items sent</span></div>')
+    return "<h2>per-worker exchange lanes</h2>" + "".join(lanes)
 
 
 def main() -> None:
