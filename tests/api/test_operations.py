@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from thrill_tpu.api import (Concat, InnerJoin, Merge, RunLocalTests, Union,
-                            Zip)
+                            Zip, ZipWindow)
 
 SIZES = (1, 2, 5, 8)
 
@@ -155,6 +155,44 @@ def test_zip_modes():
         d = ctx.Generate(20, fn=lambda i: i * 2)
         zc = Zip(c, d, zip_fn=lambda x, y: y - x, mode="cut")
         assert [int(v) for v in zc.AllGather()] == [i for i in range(20)]
+    sweep(job)
+
+
+def test_zip_pad_device():
+    """Pad mode with unequal sizes stays on the device: the short side
+    is padded with default (zero) items, matching the host semantics."""
+    def job(ctx):
+        a = ctx.Generate(25)                      # device storage
+        b = ctx.Generate(10, fn=lambda i: i * 3)
+        z = Zip(a, b, zip_fn=lambda x, y: x + y, mode="pad")
+        want = [i + (i * 3 if i < 10 else 0) for i in range(25)]
+        assert [int(v) for v in z.AllGather()] == want
+    sweep(job)
+
+
+def test_zip_window_device():
+    """Device ZipWindow: chunked consumption with a window-batched
+    device_fn (reference: api/zip_window.hpp:175)."""
+    import jax.numpy as jnp
+
+    def job(ctx):
+        a = ctx.Generate(24)                      # chunks of 2
+        b = ctx.Generate(36, fn=lambda i: i * 10)  # chunks of 3
+        z = ZipWindow((2, 3), a, b,
+                      zip_fn=lambda ca, cb: int(sum(ca)) + int(sum(cb)),
+                      device_fn=lambda ca, cb: jnp.sum(ca, axis=1)
+                      + jnp.sum(cb, axis=1))
+        want = [sum(range(2 * j, 2 * j + 2))
+                + sum(10 * k for k in range(3 * j, 3 * j + 3))
+                for j in range(12)]
+        assert [int(v) for v in z.AllGather()] == want
+
+        # host path agrees
+        ah = ctx.Generate(24, storage="host")
+        bh = ctx.Generate(36, fn=lambda i: i * 10, storage="host")
+        zh = ZipWindow((2, 3), ah, bh,
+                       zip_fn=lambda ca, cb: sum(ca) + sum(cb))
+        assert [int(v) for v in zh.AllGather()] == want
     sweep(job)
 
 

@@ -260,9 +260,10 @@ def Zip(*dias: DIA, zip_fn: Callable = None, mode: str = "strict") -> DIA:
     from .ops import zip_ as _z
     return _z.Zip(list(dias), zip_fn, mode)
 
-def ZipWindow(window: tuple, *dias: DIA, zip_fn: Callable = None) -> DIA:
+def ZipWindow(window: tuple, *dias: DIA, zip_fn: Callable = None,
+              device_fn: Callable = None) -> DIA:
     from .ops import zip_ as _z
-    return _z.ZipWindowOp(list(dias), window, zip_fn)
+    return _z.ZipWindowOp(list(dias), window, zip_fn, device_fn)
 
 
 def Merge(*dias: DIA, key_fn: Callable = None) -> DIA:

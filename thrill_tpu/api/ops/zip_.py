@@ -25,7 +25,7 @@ from ..dia_base import DIABase
 
 
 def _realign_device(shards: DeviceShards, target_bounds: np.ndarray,
-                    n_out: int, token) -> DeviceShards:
+                    n_out: int, token, min_cap: int = 1) -> DeviceShards:
     """Move items so worker w holds global indices
     [target_bounds[w], target_bounds[w+1]) of this DIA (items beyond
     n_out are dropped). Order within workers is preserved because the
@@ -47,7 +47,7 @@ def _realign_device(shards: DeviceShards, target_bounds: np.ndarray,
 
     # dest == W marks dropped items; exchange clips dest, so pre-mask:
     return exchange.exchange(_mask_tail(shards, n_out), dest,
-                             ("realign", token, W))
+                             ("realign", token, W), min_cap=min_cap)
 
 
 def _mask_tail(shards: DeviceShards, n_out: int) -> DeviceShards:
@@ -56,6 +56,19 @@ def _mask_tail(shards: DeviceShards, n_out: int) -> DeviceShards:
     new_counts = np.clip(n_out - offsets, 0, shards.counts)
     return DeviceShards(shards.mesh_exec, shards.tree,
                         new_counts.astype(np.int64))
+
+
+def _realign_or_keep(p: DeviceShards, tb: np.ndarray, n_out: int, token,
+                     min_cap: int = 1):
+    """Realign to target bounds, or keep in place when the partition
+    already matches (the no-exchange fast path). Returns
+    (shards, moved)."""
+    off = np.concatenate([[0], np.cumsum(p.counts)])
+    same = (len(off) == len(tb) and
+            np.array_equal(np.clip(off, 0, n_out), tb))
+    if same:
+        return _mask_tail(p, n_out), False
+    return _realign_device(p, tb, n_out, token, min_cap=min_cap), True
 
 
 class ZipNode(DIABase):
@@ -89,59 +102,46 @@ class ZipNode(DIABase):
         totals = [p.total for p in pulls]
         n_out = self._out_size(totals)
         if self.mode == "pad" and max(totals) != min(totals):
-            return self._compute_host([p.to_host_shards("zip-host-fallback") for p in pulls])
+            # pad stays on the device: realign EVERY input to an even
+            # n_out partition; the exchange's receive buffers are
+            # zero-initialized, so the short inputs' missing tail slots
+            # are already default-constructed (zero) items — exactly the
+            # reference's ZipPad semantics (api/zip.hpp Pad variant)
+            tb = np.array([(w * n_out) // W for w in range(W + 1)],
+                          dtype=np.int64)
+            counts = (tb[1:] - tb[:-1]).astype(np.int64)
+            aligned = []
+            for i, p in enumerate(pulls):
+                a, moved = _realign_or_keep(
+                    p, tb, n_out, (self.id, i, "pad"),
+                    min_cap=int(counts.max()))
+                if (not moved or W == 1) and np.any(a.counts < counts):
+                    # slots beyond the received prefix become the pad
+                    # items; the W>1 exchange zero-fills them already,
+                    # but the kept / W==1 no-movement paths do not
+                    a = _zero_beyond_count(a)
+                # explicit zero-extension keeps the counts<=cap invariant
+                # (pads past a short input's cap must be zeros)
+                a = _repad(a, max(int(counts.max()), a.cap))
+                aligned.append(DeviceShards(mex, a.tree, counts.copy()))
+            return self._fused_zip(mex, aligned, counts)
         # target partition = first DIA's distribution truncated to n_out
         c0 = np.clip(pulls[0].counts,
                      0, None)
         tb = np.concatenate([[0], np.cumsum(c0)])
         tb = np.clip(tb, 0, n_out)
-        aligned = []
-        for i, p in enumerate(pulls):
-            off = np.concatenate([[0], np.cumsum(p.counts)])
-            same = (len(off) == len(tb) and np.array_equal(
-                np.clip(off, 0, n_out), tb))
-            if same:
-                aligned.append(_mask_tail(p, n_out))
-            else:
-                aligned.append(_realign_device(p, tb, n_out,
-                                               (self.id, i)))
+        aligned = [_realign_or_keep(p, tb, n_out, (self.id, i))[0]
+                   for i, p in enumerate(pulls)]
         counts = (tb[1:] - tb[:-1]).astype(np.int64)
+        return self._fused_zip(mex, aligned, counts)
+
+    def _fused_zip(self, mex, aligned: List[DeviceShards],
+                   counts: np.ndarray):
         # fused local zip
         cap = max(a.cap for a in aligned)
         aligned = [_repad(a, cap) for a in aligned]
-        trees = [a.tree for a in aligned]
-        all_leaves = []
-        treedefs = []
-        for t in trees:
-            ls, td = jax.tree.flatten(t)
-            all_leaves.append(ls)
-            treedefs.append(td)
-        zip_fn = self.zip_fn
-        nums = [len(ls) for ls in all_leaves]
-        key = ("zip_fuse", zip_fn, cap,
-               tuple(treedefs), tuple(tuple((l.dtype, l.shape[2:])
-                                            for l in ls)
-                                      for ls in all_leaves))
-        holder = {}
-
-        def build():
-            def f(*flat):
-                trees_in = []
-                i = 0
-                for td, k in zip(treedefs, nums):
-                    trees_in.append(jax.tree.unflatten(
-                        td, [x[0] for x in flat[i:i + k]]))
-                    i += k
-                out = zip_fn(*trees_in) if zip_fn else tuple(trees_in)
-                out_leaves, out_td = jax.tree.flatten(out)
-                holder["treedef"] = out_td
-                return tuple(l[None] for l in out_leaves)
-
-            return mex.smap(f, sum(nums)), holder
-
-        fn, h = mex.cached(key, build)
-        out = fn(*[l for ls in all_leaves for l in ls])
-        tree = jax.tree.unflatten(h["treedef"], list(out))
+        tree = _fused_map_trees(mex, [a.tree for a in aligned],
+                                self.zip_fn, "zip_fuse")
         return DeviceShards(mex, tree, counts)
 
     def _compute_host(self, pulls: List[HostShards]):
@@ -172,6 +172,70 @@ def _default_item(items, _pulls):
         lambda l: (np.zeros_like(np.asarray(l))
                    if isinstance(l, (np.ndarray, np.generic))
                    else type(l)()), probe)
+
+
+def _fused_map_trees(mex, trees: List, fn: Optional[Callable],
+                     key_prefix: str):
+    """One jitted program applying ``fn(*trees)`` (or tuple-of-trees
+    when fn is None) per worker over several same-cap shard trees —
+    the shared fusion driver for Zip and ZipWindow device paths."""
+    all_leaves, treedefs = [], []
+    for t in trees:
+        ls, td = jax.tree.flatten(t)
+        all_leaves.append(ls)
+        treedefs.append(td)
+    nums = [len(ls) for ls in all_leaves]
+    key = (key_prefix, fn, tuple(treedefs),
+           tuple(tuple((l.dtype, l.shape[1:]) for l in ls)
+                 for ls in all_leaves))
+    holder = {}
+
+    def build():
+        def f(*flat):
+            trees_in = []
+            i = 0
+            for td, k in zip(treedefs, nums):
+                trees_in.append(jax.tree.unflatten(
+                    td, [x[0] for x in flat[i:i + k]]))
+                i += k
+            out = fn(*trees_in) if fn else tuple(trees_in)
+            out_leaves, out_td = jax.tree.flatten(out)
+            holder["treedef"] = out_td
+            return tuple(l[None] for l in out_leaves)
+
+        return mex.smap(f, sum(nums)), holder
+
+    g, h = mex.cached(key, build)
+    out = g(*[l for ls in all_leaves for l in ls])
+    return jax.tree.unflatten(h["treedef"], list(out))
+
+
+def _zero_beyond_count(shards: DeviceShards) -> DeviceShards:
+    """Zero every slot at or past this worker's valid count (default-
+    constructed pad items for ZipPad semantics)."""
+    mex = shards.mesh_exec
+    cap = shards.cap
+    leaves, treedef = jax.tree.flatten(shards.tree)
+    key = ("zero_beyond", cap, treedef,
+           tuple((l.dtype, l.shape[2:]) for l in leaves))
+
+    def build():
+        def f(counts_dev, *ls):
+            count = counts_dev[0, 0]
+            valid = jnp.arange(cap) < count
+            outs = []
+            for l in ls:
+                x = l[0]
+                m = valid.reshape((cap,) + (1,) * (x.ndim - 1))
+                outs.append(jnp.where(m, x, jnp.zeros_like(x))[None])
+            return tuple(outs)
+
+        return mex.smap(f, 1 + len(leaves))
+
+    fn = mex.cached(key, build)
+    out = fn(shards.counts_device(), *leaves)
+    return DeviceShards(mex, jax.tree.unflatten(treedef, list(out)),
+                        shards.counts.copy())
 
 
 def _repad(shards: DeviceShards, cap: int) -> DeviceShards:
@@ -242,15 +306,32 @@ def ZipWithIndex(dia: DIA, zip_fn=None) -> DIA:
 class ZipWindowNode(DIABase):
     """Zip fixed-size windows across DIAs
     (reference: api/zip_window.hpp:175): DIA i is consumed in chunks of
-    window[i] items; output item j is the tuple of chunk j from each."""
+    window[i] items; output item j is the tuple of chunk j from each.
 
-    def __init__(self, ctx, links, window, zip_fn) -> None:
+    Device path (``device_fn``): each input is realigned so worker w
+    holds exactly output chunks [b_w, b_{w+1}) — an index-range exchange
+    to chunk-aligned bounds — then reshaped to [chunk_cap, window_i,
+    ...] window batches; ``device_fn(*chunk_trees)`` maps them to output
+    items like the Window/FlatWindow device contract."""
+
+    def __init__(self, ctx, links, window, zip_fn,
+                 device_fn: Optional[Callable] = None) -> None:
         super().__init__(ctx, "ZipWindow", links)
         self.window = tuple(int(w) for w in window)
         self.zip_fn = zip_fn
+        self.device_fn = device_fn
 
     def compute(self):
         pulls = [l.pull() for l in self.parents]
+        if self.device_fn is not None and all(
+                isinstance(p, DeviceShards) for p in pulls):
+            return self._compute_device(pulls)
+        if self.device_fn is not None and self.zip_fn is None:
+            # mirror Window's contract: never silently emit the default
+            # tuple-of-chunks schema where device_fn output was expected
+            raise ValueError(
+                "ZipWindow: inputs are host-resident but only device_fn "
+                "was given — pass zip_fn alongside device_fn")
         pulls = [p.to_host_shards("zipwindow") if isinstance(p, DeviceShards) else p
                  for p in pulls]
         W = pulls[0].num_workers
@@ -264,7 +345,35 @@ class ZipWindowNode(DIABase):
         return HostShards(W, [out[bounds[w]:bounds[w + 1]]
                               for w in range(W)])
 
+    def _compute_device(self, pulls: List[DeviceShards]):
+        mex = pulls[0].mesh_exec
+        W = mex.num_workers
+        n_out = min(p.total // w for p, w in zip(pulls, self.window))
+        cb = np.array([(w * n_out) // W for w in range(W + 1)],
+                      dtype=np.int64)                    # chunk bounds
+        chunk_counts = (cb[1:] - cb[:-1]).astype(np.int64)
+        chunk_cap = int(chunk_counts.max()) if n_out else 1
 
-def ZipWindowOp(dias: List[DIA], window, zip_fn=None) -> DIA:
+        batched = []                                     # per input
+        for i, (p, wsz) in enumerate(zip(pulls, self.window)):
+            tb = cb * wsz                                # item bounds
+            a, _ = _realign_or_keep(p, tb, n_out * wsz,
+                                    (self.id, i, "zw"),
+                                    min_cap=chunk_cap * wsz)
+            a = _repad(a, chunk_cap * wsz) if a.cap < chunk_cap * wsz \
+                else a
+            # [1, chunk_cap * wsz, ...] -> [1, chunk_cap, wsz, ...]
+            tree = jax.tree.map(
+                lambda l: l[:, :chunk_cap * wsz].reshape(
+                    (l.shape[0], chunk_cap, wsz) + l.shape[2:]),
+                a.tree)
+            batched.append(tree)
+
+        tree = _fused_map_trees(mex, batched, self.device_fn,
+                                "zip_window")
+        return DeviceShards(mex, tree, chunk_counts)
+
+
+def ZipWindowOp(dias: List[DIA], window, zip_fn=None, device_fn=None) -> DIA:
     return DIA(ZipWindowNode(dias[0].context, [d._link() for d in dias],
-                             window, zip_fn))
+                             window, zip_fn, device_fn))
