@@ -70,6 +70,51 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
     return DeviceShards(mex, tree, out[0])
 
 
+def _fold_reduce_device(acc: DeviceShards, block: DeviceShards,
+                        key_fn: Callable, reduce_fn: Callable,
+                        token) -> DeviceShards:
+    """One jitted program folding a received round block into the
+    accumulator: concat both valid prefixes, sort by key words,
+    segmented-reduce, compact. Counts stay device-resident end to end —
+    the whole streamed post phase runs with zero host syncs."""
+    mex = acc.mesh_exec
+    leaves_a, td = jax.tree.flatten(acc.tree)
+    leaves_b, td_b = jax.tree.flatten(block.tree)
+    assert td == td_b, "fold requires matching schemas"
+    capA, capB = acc.cap, block.cap
+    nA = len(leaves_a)
+    key = ("reduce_fold", token, capA, capB, td,
+           tuple((l.dtype, l.shape[2:]) for l in leaves_a))
+
+    def build():
+        def f(ca, cb, *ls):
+            validA = jnp.arange(capA) < ca[0, 0]
+            validB = jnp.arange(capB) < cb[0, 0]
+            treeA = jax.tree.unflatten(td, [l[0] for l in ls[:nA]])
+            treeB = jax.tree.unflatten(td, [l[0] for l in ls[nA:]])
+            tree = jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b], axis=0),
+                treeA, treeB)
+            valid = jnp.concatenate([validA, validB])
+            words = keymod.encode_key_words(key_fn(tree))
+            words, tree, valid, _ = segmented.sort_by_key_words(
+                words, tree, valid)
+            words, tree, rep = segmented.segmented_reduce(
+                words, tree, valid, reduce_fn)
+            tree, new_count = compact_valid(tree, rep)
+            out_leaves = jax.tree.leaves(tree)
+            return (new_count[None, None].astype(jnp.int32),
+                    *[l[None] for l in out_leaves])
+
+        return mex.smap(f, 2 + 2 * nA)
+
+    fn = mex.cached(key, build)
+    out = fn(acc.counts_device(), block.counts_device(),
+             *leaves_a, *leaves_b)
+    tree = jax.tree.unflatten(td, list(out[1:]))
+    return DeviceShards(mex, tree, out[0])
+
+
 class ReduceNode(DIABase):
     def __init__(self, ctx, link, key_fn: Callable, reduce_fn: Callable,
                  label: str = "ReduceByKey",
@@ -121,10 +166,40 @@ class ReduceNode(DIABase):
                 return jnp.where(mine_only, widx.astype(jnp.int32),
                                  hash_dest)
 
+            import os
+            if os.environ.get("THRILL_TPU_REDUCE_STREAM") == "1":
+                # MixStream-analog post phase: fold each received round
+                # into the accumulator while later rounds' collectives
+                # are still in flight (reference: use_post_thread_
+                # overlap, api/reduce_by_key.hpp:142-168, over
+                # MixStream's arbitrary-order delivery)
+                return self._compute_device_stream(pre, dest, token)
             pre = exchange.exchange(pre, dest,
                                     ("reduce_dest", token, W, dup))
         # post-phase: final combine (reference: ReduceByHashPostPhase)
         return _local_reduce_device(pre, key_fn, reduce_fn, "post", token)
+
+    def _compute_device_stream(self, pre: DeviceShards, dest, token):
+        """Streamed post-phase: per-round receive + incremental fold.
+
+        Every yielded round block is folded into the running accumulator
+        by ONE jitted program (concat + sort + segmented reduce, counts
+        staying device-resident throughout — a host counts sync per
+        round would serialize the rounds). The accumulator stays compact
+        (one row per distinct key seen), so the giant all-rounds receive
+        buffer and its compaction scatter never exist; jax async
+        dispatch overlaps round r's fold with round r+1's ppermute.
+        """
+        key_fn, reduce_fn = self.key_fn, self.reduce_fn
+        W = self.context.num_workers
+        acc: Optional[DeviceShards] = None
+        for block in exchange.exchange_stream(
+                pre, dest, ("reduce_dest", token, W, self.dup_detection)):
+            # round blocks carry pre-reduced (unique-key) rows, so the
+            # first block IS a valid accumulator
+            acc = block if acc is None else _fold_reduce_device(
+                acc, block, key_fn, reduce_fn, token)
+        return acc
 
     def _compute_host(self, shards: HostShards):
         W = shards.num_workers

@@ -115,10 +115,11 @@ class ZipNode(DIABase):
                 a, moved = _realign_or_keep(
                     p, tb, n_out, (self.id, i, "pad"),
                     min_cap=int(counts.max()))
-                if (not moved or W == 1) and np.any(a.counts < counts):
+                if W == 1 and np.any(a.counts < counts):
                     # slots beyond the received prefix become the pad
                     # items; the W>1 exchange zero-fills them already,
-                    # but the kept / W==1 no-movement paths do not
+                    # but the W==1 no-movement shortcut does not (a
+                    # kept W>1 input always has exactly target counts)
                     a = _zero_beyond_count(a)
                 # explicit zero-extension keeps the counts<=cap invariant
                 # (pads past a short input's cap must be zeros)

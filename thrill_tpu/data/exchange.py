@@ -105,20 +105,14 @@ def exchange_presorted(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                              min_cap=min_cap, ident=ident)
 
 
-def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
-             min_cap: int = 1) -> DeviceShards:
-    """Move every valid item to the worker computed by ``dest_builder``.
-
-    ``dest_builder(tree, valid_mask, worker_index) -> int32 [cap]`` is
-    traced inside the phase-A program; ``cache_key`` must identify it
-    (plus its static parameters) for executable caching.
-    """
+def _phase_a(shards: DeviceShards, dest_builder: Callable,
+             cache_key: Tuple):
+    """Phase A: destination, local dest-sort, send counts. Returns
+    (treedef, sorted_dest, sorted_leaves, S)."""
     mex = shards.mesh_exec
     W = mex.num_workers
     cap = shards.cap
     leaves, treedef = jax.tree.flatten(shards.tree)
-
-    # ---- Phase A: destination, local sort, send counts ---------------
     key_a = ("xchg_a", cache_key, cap, treedef,
              tuple((l.dtype, l.shape[2:]) for l in leaves))
 
@@ -149,10 +143,107 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
     out_a = fa(shards.counts_device(), *leaves)
     sorted_dest, send_mat = out_a[0], out_a[1]
     sorted_leaves = list(out_a[2:])
-
     S = mex.fetch(send_mat)                       # [W, W] S[w, d]
+    return treedef, sorted_dest, sorted_leaves, S
+
+
+def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
+             min_cap: int = 1) -> DeviceShards:
+    """Move every valid item to the worker computed by ``dest_builder``.
+
+    ``dest_builder(tree, valid_mask, worker_index) -> int32 [cap]`` is
+    traced inside the phase-A program; ``cache_key`` must identify it
+    (plus its static parameters) for executable caching.
+    """
+    mex = shards.mesh_exec
+    treedef, sorted_dest, sorted_leaves, S = _phase_a(
+        shards, dest_builder, cache_key)
     return _exchange_planned(mex, treedef, sorted_dest, sorted_leaves, S,
                              min_cap=min_cap, ident=cache_key)
+
+
+def exchange_stream(shards: DeviceShards, dest_builder: Callable,
+                    cache_key: Tuple):
+    """MixStream analog: yield received blocks round by round, in
+    arbitrary (schedule) order, instead of one compacted shard.
+
+    The reference's MixStream (thrill/data/mix_stream.hpp:126) delivers
+    blocks as they arrive so the consumer overlaps processing with the
+    shuffle. The TPU-native equivalent: each 1-factor round is its own
+    small jitted program whose result the consumer folds while jax's
+    async dispatch keeps later rounds' collectives in flight — no
+    global receive buffer, no compaction scatter, no rank-order
+    guarantee. Yields one DeviceShards per source (identity round
+    first, then the 1-factor schedule — tier-pure on sliced meshes).
+    """
+    mex = shards.mesh_exec
+    W = mex.num_workers
+    treedef, sorted_dest, sorted_leaves, S = _phase_a(
+        shards, dest_builder, cache_key)
+    account_traffic(mex, S, leaf_item_bytes(sorted_leaves))
+    cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
+
+    if W == 1:
+        yield DeviceShards(mex, jax.tree.unflatten(treedef, sorted_leaves),
+                           np.diag(S).astype(np.int64).copy())
+        return
+
+    rounds = one_factor_rounds(mex)
+    cap_ident = ("xchg_stream_caps", cache_key, cap, treedef,
+                 tuple((l.dtype, l.shape[2:]) for l in sorted_leaves))
+    needed = (max(int(np.diag(S).max()), 1),) + tuple(
+        max(int(S[np.arange(W), to].max()), 1) for to in rounds)
+    caps = _sticky_caps(mex, cap_ident, needed)
+    mex.stats_padded_rows += sum(caps)
+
+    srow = mex.put(S.astype(np.int32))
+
+    def round_program(r: int, to, M_r: int):
+        key = ("xchg_stream_round", cap, M_r, W,
+               None if to is None else tuple(int(x) for x in to),
+               treedef,
+               tuple((l.dtype, l.shape[2:]) for l in sorted_leaves))
+
+        def build():
+            def f(sdest, srow_a, *ls):
+                d = sdest[0]
+                off = _ex_cumsum(srow_a[0])
+                i = jnp.arange(cap)
+                widx = lax.axis_index(AXIS)
+                d_r = widx if to is None else jnp.take(
+                    jnp.asarray(to), widx)
+                sel = d == d_r
+                slot = i - jnp.take(off, d_r)
+                send_idx = jnp.where(sel, slot, M_r)
+                outs = []
+                for l in ls:
+                    x = l[0]
+                    buf = jnp.zeros((M_r + 1,) + x.shape[1:], x.dtype)
+                    buf = buf.at[send_idx].set(x)[:M_r]
+                    if to is not None:
+                        buf = lax.ppermute(
+                            buf, AXIS,
+                            perm=[(w, int(to[w])) for w in range(W)])
+                    outs.append(buf[None])
+                return tuple(outs)
+
+            return mex.smap(f, 2 + len(sorted_leaves))
+
+        return mex.cached(key, build)
+
+    # identity round: the diagonal blocks, no communication
+    f0 = round_program(0, None, caps[0])
+    out0 = f0(sorted_dest, srow, *sorted_leaves)
+    yield DeviceShards(mex, jax.tree.unflatten(treedef, list(out0)),
+                       np.diag(S).astype(np.int64).copy())
+    for r, to in enumerate(rounds):
+        inv = np.empty(W, dtype=np.int64)
+        inv[to] = np.arange(W)
+        fr = round_program(r + 1, to, caps[r + 1])
+        outr = fr(sorted_dest, srow, *sorted_leaves)
+        counts_r = S[inv, np.arange(W)].astype(np.int64)
+        yield DeviceShards(mex, jax.tree.unflatten(treedef, list(outr)),
+                           counts_r.copy())
 
 
 def _sticky_caps(mex: MeshExec, ident: Tuple, needed: Tuple[int, ...]
