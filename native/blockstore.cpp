@@ -9,23 +9,42 @@
 // owns bytes — copies, pinning, spill files, and newline scanning for
 // the ReadLines byte-range splitter (reference: api/read_lines.hpp:181).
 //
+// Spills are ASYNCHRONOUS by default: eviction moves the bytes into an
+// immutable write request processed by a dedicated writer thread (the
+// analog of foxxll's async disk queue / the reference's Dispatcher
+// thread, net/dispatcher.hpp:510) — Put/Unpin never block on disk.
+// Pin/Get of an in-flight block are served from the request buffer;
+// pinning cancels the spill (the writer removes the file post-write).
+//
 // Build: g++ -O3 -shared -fPIC -std=c++17 blockstore.cpp -o libblockstore.so
 
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <deque>
 #include <list>
+#include <memory>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
 namespace {
 
+struct SpillRequest {
+  int64_t id = 0;
+  std::vector<char> data;       // owned; IMMUTABLE once enqueued
+  std::string path;
+  bool cancelled = false;       // guarded by the store mutex
+};
+
 struct Block {
-  std::vector<char> data;       // empty when spilled
+  std::vector<char> data;       // empty when spilled or spilling
   std::string spill_path;       // non-empty when on disk
+  std::shared_ptr<SpillRequest> req;  // non-null while write in flight
   int64_t size = 0;
   int64_t pin_count = 0;
   std::list<int64_t>::iterator lru_it;
@@ -34,10 +53,21 @@ struct Block {
 
 class BlockStore {
  public:
-  BlockStore(std::string spill_dir, int64_t soft_limit)
-      : spill_dir_(std::move(spill_dir)), soft_limit_(soft_limit) {}
+  BlockStore(std::string spill_dir, int64_t soft_limit, bool async_io)
+      : spill_dir_(std::move(spill_dir)), soft_limit_(soft_limit),
+        async_(async_io) {
+    if (async_) writer_ = std::thread([this] { WriterLoop(); });
+  }
 
   ~BlockStore() {
+    if (async_) {
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        stop_ = true;
+      }
+      cv_work_.notify_all();
+      writer_.join();
+    }
     std::lock_guard<std::mutex> lk(mu_);
     for (auto& kv : blocks_) {
       if (!kv.second.spill_path.empty())
@@ -76,6 +106,10 @@ class BlockStore {
       Touch(id, b);
       return 0;
     }
+    if (b.req) {  // write in flight: serve from the request buffer
+      std::memcpy(out, b.req->data.data(), b.size);
+      return 0;
+    }
     // fault in from disk (stays spilled; read-through)
     FILE* f = std::fopen(b.spill_path.c_str(), "rb");
     if (!f) return -2;
@@ -90,7 +124,14 @@ class BlockStore {
     auto it = blocks_.find(id);
     if (it == blocks_.end()) return -1;
     Block& b = it->second;
-    if (b.data.empty() && b.size > 0) {
+    if (b.data.empty() && b.size > 0 && b.req) {
+      // cancel the in-flight spill: copy back (the writer may be
+      // mid-fwrite from the request buffer, so it cannot be moved)
+      b.data = b.req->data;
+      b.req->cancelled = true;
+      b.req.reset();
+      mem_usage_ += b.size;
+    } else if (b.data.empty() && b.size > 0) {
       FILE* f = std::fopen(b.spill_path.c_str(), "rb");
       if (!f) return -2;
       b.data.resize(b.size);
@@ -127,6 +168,7 @@ class BlockStore {
     Block& b = it->second;
     if (!b.data.empty()) mem_usage_ -= b.size;
     if (b.in_lru) lru_.erase(b.lru_it);
+    if (b.req) b.req->cancelled = true;  // writer removes its file
     if (!b.spill_path.empty()) std::remove(b.spill_path.c_str());
     blocks_.erase(it);
   }
@@ -141,7 +183,66 @@ class BlockStore {
     return static_cast<int64_t>(blocks_.size());
   }
 
+  // Block until every queued/in-flight spill write has completed.
+  void Flush() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_idle_.wait(lk, [this] { return queue_.empty() && inflight_ == 0; });
+  }
+
+  int64_t Pending() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int64_t>(queue_.size()) + inflight_;
+  }
+
  private:
+  void WriterLoop() {
+    for (;;) {
+      std::shared_ptr<SpillRequest> req;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_work_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+        if (queue_.empty()) return;  // stop_ and drained
+        req = queue_.front();
+        queue_.pop_front();
+        if (req->cancelled) {        // Pin/Drop got there first:
+          spilling_bytes_ -= static_cast<int64_t>(req->data.size());
+          cv_idle_.notify_all();     // skip the disk write entirely
+          continue;
+        }
+        inflight_++;
+      }
+      // file write OUTSIDE the lock: the request buffer is immutable
+      bool ok = false;
+      FILE* f = std::fopen(req->path.c_str(), "wb");
+      if (f) {
+        size_t put = std::fwrite(req->data.data(), 1, req->data.size(), f);
+        std::fclose(f);
+        ok = put == req->data.size();
+        if (!ok) std::remove(req->path.c_str());
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        inflight_--;
+        spilling_bytes_ -= static_cast<int64_t>(req->data.size());
+        auto it = blocks_.find(req->id);
+        if (req->cancelled || it == blocks_.end()) {
+          if (ok) std::remove(req->path.c_str());
+        } else if (ok) {
+          it->second.spill_path = req->path;
+          it->second.req.reset();
+        } else {
+          // write failed: restore the bytes to RAM (cannot move — the
+          // request may still be aliased; copy like Pin does)
+          Block& b = it->second;
+          b.data = req->data;
+          b.req.reset();
+          mem_usage_ += b.size;
+          Touch(req->id, b);
+        }
+        cv_idle_.notify_all();
+      }
+    }
+  }
   void Touch(int64_t id, Block& b) {
     if (b.in_lru) lru_.erase(b.lru_it);
     lru_.push_front(id);
@@ -149,23 +250,47 @@ class BlockStore {
     b.in_lru = true;
   }
 
+  std::string SpillPath(int64_t victim) {
+    char path[512];
+    std::snprintf(path, sizeof(path), "%s/ttpu-blk-%p-%lld.spill",
+                  spill_dir_.c_str(), static_cast<void*>(this),
+                  static_cast<long long>(victim));
+    return path;
+  }
+
   void MaybeSpill() {
+    bool queued = false;
     while (soft_limit_ > 0 && mem_usage_ > soft_limit_ && !lru_.empty()) {
       int64_t victim = lru_.back();
       lru_.pop_back();
       Block& b = blocks_[victim];
       b.in_lru = false;
       if (b.data.empty() || b.pin_count > 0) continue;
-      char path[512];
-      std::snprintf(path, sizeof(path), "%s/ttpu-blk-%p-%lld.spill",
-                    spill_dir_.c_str(), static_cast<void*>(this),
-                    static_cast<long long>(victim));
-      FILE* f = std::fopen(path, "wb");
+      // bounded write pool (foxxll semantics): async only while the
+      // in-flight bytes stay under the budget; past it, spill
+      // synchronously — Put/Unpin then block on disk, which is the
+      // backpressure that keeps real RSS bounded at ~2x soft_limit
+      if (async_ && spilling_bytes_ < soft_limit_) {
+        auto req = std::make_shared<SpillRequest>();
+        req->id = victim;
+        req->data = std::move(b.data);
+        req->path = SpillPath(victim);
+        b.data.clear();
+        b.data.shrink_to_fit();
+        b.req = req;
+        mem_usage_ -= b.size;
+        spilling_bytes_ += b.size;
+        queue_.push_back(std::move(req));
+        queued = true;
+        continue;
+      }
+      std::string path = SpillPath(victim);
+      FILE* f = std::fopen(path.c_str(), "wb");
       if (!f) return;  // cannot spill; keep in RAM
       size_t put = std::fwrite(b.data.data(), 1, b.size, f);
       std::fclose(f);
       if (put != static_cast<size_t>(b.size)) {
-        std::remove(path);
+        std::remove(path.c_str());
         return;
       }
       b.spill_path = path;
@@ -173,11 +298,20 @@ class BlockStore {
       b.data.shrink_to_fit();
       mem_usage_ -= b.size;
     }
+    if (queued) cv_work_.notify_one();
   }
 
   std::mutex mu_;
+  std::condition_variable cv_work_;
+  std::condition_variable cv_idle_;
+  std::deque<std::shared_ptr<SpillRequest>> queue_;
+  std::thread writer_;
+  int64_t inflight_ = 0;
+  int64_t spilling_bytes_ = 0;  // bytes owned by queued/in-flight writes
+  bool stop_ = false;
   std::string spill_dir_;
   int64_t soft_limit_;
+  bool async_;
   int64_t next_id_ = 1;
   int64_t mem_usage_ = 0;
   std::unordered_map<int64_t, Block> blocks_;
@@ -188,8 +322,16 @@ class BlockStore {
 
 extern "C" {
 
-void* bs_create(const char* spill_dir, int64_t soft_limit) {
-  return new BlockStore(spill_dir ? spill_dir : "/tmp", soft_limit);
+void* bs_create(const char* spill_dir, int64_t soft_limit,
+                int async_io) {
+  return new BlockStore(spill_dir ? spill_dir : "/tmp", soft_limit,
+                        async_io != 0);
+}
+
+void bs_flush(void* s) { static_cast<BlockStore*>(s)->Flush(); }
+
+int64_t bs_pending(void* s) {
+  return static_cast<BlockStore*>(s)->Pending();
 }
 
 void bs_destroy(void* s) { delete static_cast<BlockStore*>(s); }

@@ -40,11 +40,57 @@ def test_block_pool_spill_and_fault_in():
         pool = BlockPool(spill_dir=d, soft_limit=10_000)
         payloads = [bytes([i]) * 4000 for i in range(10)]  # 40 KB total
         ids = [pool.put(p) for p in payloads]
-        # over the soft limit -> old blocks spilled to disk
+        # over the soft limit -> old blocks handed to the spill writer
         assert pool.mem_usage <= 10_000
+        pool.flush()                   # barrier on the async writes
         assert len(os.listdir(d)) > 0, "expected spill files"
         for i, bid in enumerate(ids):
             assert pool.get(bid) == payloads[i]
+        pool.close()
+
+
+def test_block_pool_async_spill_overlap():
+    """Reads during an in-flight spill are served from the request
+    buffer; pinning cancels the write (foxxll/Dispatcher analog)."""
+    with tempfile.TemporaryDirectory() as d:
+        pool = BlockPool(spill_dir=d, soft_limit=8_000)
+        first = pool.put(b"a" * 6000)
+        second = pool.put(b"b" * 6000)   # evicts `first` to the queue
+        # immediately readable regardless of write progress
+        assert pool.get(first) == b"a" * 6000
+        # pin cancels the spill (or faults in if already written)
+        pool.pin(first)
+        assert pool.get(first) == b"a" * 6000
+        pool.flush()
+        assert pool.get(first) == b"a" * 6000
+        assert pool.get(second) == b"b" * 6000
+        pool.unpin(first)
+        pool.close()
+
+
+def test_block_pool_sync_mode_still_works():
+    with tempfile.TemporaryDirectory() as d:
+        pool = BlockPool(spill_dir=d, soft_limit=8_000, async_io=False)
+        ids = [pool.put(bytes([i]) * 4000) for i in range(6)]
+        assert pool.mem_usage <= 8_000
+        assert pool.pending_spills == 0
+        assert len(os.listdir(d)) > 0
+        for i, bid in enumerate(ids):
+            assert pool.get(bid) == bytes([i]) * 4000
+        pool.close()
+
+
+def test_block_pool_async_drop_inflight():
+    """Dropping a block whose spill is queued/in flight must not leak
+    files after the writer drains."""
+    with tempfile.TemporaryDirectory() as d:
+        pool = BlockPool(spill_dir=d, soft_limit=4_000)
+        ids = [pool.put(bytes([i]) * 3000) for i in range(8)]
+        for bid in ids:
+            pool.drop(bid)
+        pool.flush()
+        assert pool.num_blocks == 0
+        assert os.listdir(d) == []
         pool.close()
 
 

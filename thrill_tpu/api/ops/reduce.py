@@ -76,14 +76,22 @@ def _fold_reduce_device(acc: DeviceShards, block: DeviceShards,
     """One jitted program folding a received round block into the
     accumulator: concat both valid prefixes, sort by key words,
     segmented-reduce, compact. Counts stay device-resident end to end —
-    the whole streamed post phase runs with zero host syncs."""
+    the whole streamed post phase runs with zero host syncs.
+
+    The output capacity is normalized to round_up_pow2(capA + capB), so
+    accumulator caps walk a power-of-two ladder: only O(log W) distinct
+    (capA, capB) shapes compile across the W-1 folds, and the total
+    rows sorted across all folds is ~2x the bulk path's single sort
+    (capB + 2*capB + 4*capB ... is a geometric series, not W^2)."""
+    from ...common.config import round_up_pow2
     mex = acc.mesh_exec
     leaves_a, td = jax.tree.flatten(acc.tree)
     leaves_b, td_b = jax.tree.flatten(block.tree)
     assert td == td_b, "fold requires matching schemas"
     capA, capB = acc.cap, block.cap
+    out_cap = round_up_pow2(capA + capB)
     nA = len(leaves_a)
-    key = ("reduce_fold", token, capA, capB, td,
+    key = ("reduce_fold", token, capA, capB, out_cap, td,
            tuple((l.dtype, l.shape[2:]) for l in leaves_a))
 
     def build():
@@ -102,6 +110,10 @@ def _fold_reduce_device(acc: DeviceShards, block: DeviceShards,
             words, tree, rep = segmented.segmented_reduce(
                 words, tree, valid, reduce_fn)
             tree, new_count = compact_valid(tree, rep)
+            pad = out_cap - (capA + capB)
+            tree = jax.tree.map(
+                lambda l: jnp.pad(l, [(0, pad)] + [(0, 0)] * (l.ndim - 1))
+                if pad else l, tree)
             out_leaves = jax.tree.leaves(tree)
             return (new_count[None, None].astype(jnp.int32),
                     *[l[None] for l in out_leaves])

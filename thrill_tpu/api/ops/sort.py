@@ -345,8 +345,12 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
     S = mex.fetch(send_mat)
 
     # fused dense path: ship + MERGE the received rank-ordered runs in
-    # one program (no compaction scatter, no phase-3 re-sort)
-    if exchange.dense_all_to_all_applies(mex, S):
+    # one program (no compaction scatter, no phase-3 re-sort).
+    # THRILL_TPU_SORT_FUSED=0 forces the generic exchange + full
+    # re-sort fallback (perf A/B diagnostics).
+    import os
+    fused_ok = os.environ.get("THRILL_TPU_SORT_FUSED", "1") != "0"
+    if fused_ok and exchange.dense_all_to_all_applies(mex, S):
         return _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
                                      sorted_payload, treedef, S, nwords,
                                      token)

@@ -43,7 +43,7 @@ def _load_native() -> Optional[ctypes.CDLL]:
                 tmp = out + f".tmp.{os.getpid()}"
                 subprocess.run(
                     ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                     src, "-o", tmp],
+                     "-pthread", src, "-o", tmp],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, out)  # atomic vs concurrent builders
                 # GC stale hash-named builds from earlier source versions
@@ -62,8 +62,12 @@ def _load_native() -> Optional[ctypes.CDLL]:
             _LIB_FAILED = True
             return None
         lib.bs_create.restype = ctypes.c_void_p
-        lib.bs_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.bs_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.c_int]
         lib.bs_destroy.argtypes = [ctypes.c_void_p]
+        lib.bs_flush.argtypes = [ctypes.c_void_p]
+        lib.bs_pending.restype = ctypes.c_int64
+        lib.bs_pending.argtypes = [ctypes.c_void_p]
         lib.bs_put.restype = ctypes.c_int64
         lib.bs_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                ctypes.c_int64]
@@ -90,13 +94,20 @@ def _load_native() -> Optional[ctypes.CDLL]:
 
 
 class BlockPool:
-    """Byte-block store with a soft RAM limit and disk spill."""
+    """Byte-block store with a soft RAM limit and disk spill.
 
-    def __init__(self, spill_dir: str = "/tmp", soft_limit: int = 0) -> None:
+    ``async_io=True`` (default) spills through the store's writer
+    thread — Put/Unpin never block on disk, like the reference's
+    foxxll-backed BlockPool; ``flush()`` barriers on in-flight writes.
+    """
+
+    def __init__(self, spill_dir: str = "/tmp", soft_limit: int = 0,
+                 async_io: bool = True) -> None:
         self._lib = _load_native()
         self.native = self._lib is not None
         if self.native:
-            self._h = self._lib.bs_create(spill_dir.encode(), soft_limit)
+            self._h = self._lib.bs_create(spill_dir.encode(), soft_limit,
+                                          1 if async_io else 0)
         else:  # pure-python fallback: no spill, just a dict
             self._blocks: Dict[int, bytes] = {}
             self._next = 1
@@ -135,6 +146,15 @@ class BlockPool:
             self._lib.bs_drop(self._h, block_id)
         else:
             self._blocks.pop(block_id, None)
+
+    def flush(self) -> None:
+        """Wait for every queued/in-flight spill write to complete."""
+        if self.native:
+            self._lib.bs_flush(self._h)
+
+    @property
+    def pending_spills(self) -> int:
+        return self._lib.bs_pending(self._h) if self.native else 0
 
     @property
     def mem_usage(self) -> int:
