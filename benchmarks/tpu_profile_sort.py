@@ -1,0 +1,116 @@
+"""Decompose the on-chip TeraSort cost: upload vs sort vs gather.
+
+Prints one RESULT line per component so the perf pass can target the
+dominant one instead of guessing. Run on a healthy chip.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def timeit(fn, iters=3, warmup=1):
+    import jax
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(fn())
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/thrill_tpu_xla"))
+    except Exception:
+        pass
+
+    import thrill_tpu  # noqa: F401
+    from thrill_tpu.core import keys as keymod
+    from thrill_tpu.core.device_sort import argsort_words
+
+    n = 1 << 20
+    rng = np.random.default_rng(0)
+    keys_h = rng.integers(0, 256, size=(n, 10)).astype(np.uint8)
+    vals_h = rng.integers(0, 256, size=(n, 90)).astype(np.uint8)
+
+    print(f"RESULT platform={jax.default_backend()} n={n}", flush=True)
+
+    # 1. upload cost (host -> device through the tunnel)
+    t0 = time.perf_counter()
+    keys_d = jax.device_put(keys_h)
+    vals_d = jax.device_put(vals_h)
+    jax.block_until_ready((keys_d, vals_d))
+    up = time.perf_counter() - t0
+    print(f"RESULT step=upload_100mb time_ms={up*1000:.0f} "
+          f"mb_s={100/up:.0f}", flush=True)
+
+    # 2. encode key words only
+    f_enc = jax.jit(lambda k: keymod.encode_key_words(k))
+    dt = timeit(lambda: f_enc(keys_d))
+    print(f"RESULT step=encode_words time_ms={dt*1000:.1f}", flush=True)
+
+    # 3. argsort words only (chunked engine path)
+    def sort_only(k):
+        words = keymod.encode_key_words(k)
+        return argsort_words(list(words))
+    f_sort = jax.jit(sort_only)
+    dt = timeit(lambda: f_sort(keys_d))
+    print(f"RESULT step=argsort_words time_ms={dt*1000:.1f}", flush=True)
+
+    perm_d = jax.block_until_ready(f_sort(keys_d))
+
+    # 4. payload gather only: [n, 90] u8 take along axis 0
+    f_gather = jax.jit(lambda v, p: jnp.take(v, p, axis=0))
+    dt = timeit(lambda: f_gather(vals_d, perm_d))
+    print(f"RESULT step=gather_90b_u8 time_ms={dt*1000:.1f}", flush=True)
+
+    # 4b. payload gather with payload packed as u32 words
+    vals_u32 = jax.jit(
+        lambda v: jax.lax.bitcast_convert_type(
+            jnp.pad(v, ((0, 0), (0, 2))).reshape(n, 23, 4),
+            jnp.uint32))(vals_d)
+    vals_u32 = jax.block_until_ready(vals_u32)
+    dt = timeit(lambda: f_gather(vals_u32, perm_d))
+    print(f"RESULT step=gather_23w_u32 time_ms={dt*1000:.1f}", flush=True)
+
+    # 4c. gather keys [n, 10] u8
+    dt = timeit(lambda: f_gather(keys_d, perm_d))
+    print(f"RESULT step=gather_10b_u8 time_ms={dt*1000:.1f}", flush=True)
+
+    # 5. fused whole program (encode + sort + both gathers), like the
+    #    W=1 Sort program
+    def fused(k, v):
+        words = keymod.encode_key_words(k)
+        perm = argsort_words(list(words))
+        return jnp.take(k, perm, axis=0), jnp.take(v, perm, axis=0)
+    f_all = jax.jit(fused)
+    dt = timeit(lambda: f_all(keys_d, vals_d))
+    print(f"RESULT step=fused_sort_gather time_ms={dt*1000:.1f} "
+          f"mrec_s={n/dt/1e6:.2f}", flush=True)
+
+    # 6. per-dispatch overhead through the tunnel (tiny program)
+    f_tiny = jax.jit(lambda x: x + 1)
+    x1 = jax.device_put(np.zeros(8, np.float32))
+    dt = timeit(lambda: f_tiny(x1), iters=20)
+    print(f"RESULT step=dispatch_tiny time_ms={dt*1000:.2f}", flush=True)
+
+    # 7. device->host fetch of the [W,W] counts analog (tiny fetch)
+    t_small = jax.device_put(np.zeros((1, 1), np.int32))
+    dt = timeit(lambda: np.asarray(t_small), iters=20)
+    print(f"RESULT step=fetch_tiny time_ms={dt*1000:.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,26 @@ def test_reduce_stream_matches_default(monkeypatch, W):
     assert run() == want                      # streamed fold path
 
 
+def test_reduce_stream_cap_stays_linear(monkeypatch):
+    """Regression: the streamed post phase folds round blocks as a
+    binary counter. A linear fold through one accumulator doubles the
+    padded cap every round (round_up_pow2 fed back into itself) —
+    with W=8 that is a 2^7 blowup; the counter keeps the final cap
+    linear in the rows actually received."""
+    monkeypatch.setenv("THRILL_TPU_REDUCE_STREAM", "1")
+    ctx = _ctx(8)
+    vals = np.arange(20000, dtype=np.int64)
+    out = ctx.Distribute(vals).Map(lambda x: (x % 1000, 1)).ReducePair(
+        lambda a, b: a + b)
+    sh = out.node.materialize(consume=False)
+    # ~1000 distinct keys -> ~125/worker; round blocks cap at a few
+    # hundred; exponential feedback would exceed 2^15
+    assert sh.cap <= 8192, f"accumulator cap blew up: {sh.cap}"
+    got = dict((int(k), int(v)) for k, v in out.AllGather())
+    assert len(got) == 1000 and all(v == 20 for v in got.values())
+    ctx.close()
+
+
 def test_reduce_stream_on_sliced_mesh(monkeypatch):
     monkeypatch.setenv("THRILL_TPU_REDUCE_STREAM", "1")
     monkeypatch.setenv("THRILL_TPU_SLICES", "2")

@@ -14,7 +14,7 @@ algorithm the reference runs, in Python).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -73,16 +73,18 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
 def _fold_reduce_device(acc: DeviceShards, block: DeviceShards,
                         key_fn: Callable, reduce_fn: Callable,
                         token) -> DeviceShards:
-    """One jitted program folding a received round block into the
-    accumulator: concat both valid prefixes, sort by key words,
-    segmented-reduce, compact. Counts stay device-resident end to end —
-    the whole streamed post phase runs with zero host syncs.
+    """One jitted program folding two reduced shards into one: concat
+    both valid prefixes, sort by key words, segmented-reduce, compact.
+    Counts stay device-resident end to end — the whole streamed post
+    phase runs with zero host syncs.
 
-    The output capacity is normalized to round_up_pow2(capA + capB), so
-    accumulator caps walk a power-of-two ladder: only O(log W) distinct
-    (capA, capB) shapes compile across the W-1 folds, and the total
-    rows sorted across all folds is ~2x the bulk path's single sort
-    (capB + 2*capB + 4*capB ... is a geometric series, not W^2)."""
+    The output capacity is round_up_pow2(capA + capB). Callers must NOT
+    fold a long stream linearly through one accumulator — feeding the
+    rounded cap back makes the accumulator double every fold
+    (exponential padding). The streamed post phase folds blocks as a
+    binary counter instead (see ``_compute_device_stream``): caps stay
+    on a power-of-two ladder, only O(log W) distinct shapes compile,
+    and worst-case padded rows stay within ~2x the bulk path."""
     from ...common.config import round_up_pow2
     mex = acc.mesh_exec
     leaves_a, td = jax.tree.flatten(acc.tree)
@@ -194,23 +196,45 @@ class ReduceNode(DIABase):
     def _compute_device_stream(self, pre: DeviceShards, dest, token):
         """Streamed post-phase: per-round receive + incremental fold.
 
-        Every yielded round block is folded into the running accumulator
-        by ONE jitted program (concat + sort + segmented reduce, counts
-        staying device-resident throughout — a host counts sync per
-        round would serialize the rounds). The accumulator stays compact
-        (one row per distinct key seen), so the giant all-rounds receive
-        buffer and its compaction scatter never exist; jax async
-        dispatch overlaps round r's fold with round r+1's ppermute.
+        Every yielded round block is folded by ONE jitted program
+        (concat + sort + segmented reduce, counts staying
+        device-resident throughout — a host counts sync per round would
+        serialize the rounds); jax async dispatch overlaps round r's
+        fold with round r+1's ppermute.
+
+        Blocks combine as a BINARY COUNTER (bottom-up merge-sort
+        shape): ``levels[i]`` holds the reduction of 2^i round blocks;
+        a new block folds up through full levels. A single linear
+        accumulator would double its padded cap on every fold (the fold
+        rounds capA+capB up to a power of two and feeds it back —
+        exponential growth); the counter keeps every fold between
+        same-magnitude shards, so caps walk a pow2 ladder with O(log W)
+        distinct compiled shapes and ~2x the bulk path's padded rows.
         """
         key_fn, reduce_fn = self.key_fn, self.reduce_fn
         W = self.context.num_workers
-        acc: Optional[DeviceShards] = None
+        levels: List[Optional[DeviceShards]] = []
         for block in exchange.exchange_stream(
                 pre, dest, ("reduce_dest", token, W, self.dup_detection)):
-            # round blocks carry pre-reduced (unique-key) rows, so the
-            # first block IS a valid accumulator
-            acc = block if acc is None else _fold_reduce_device(
-                acc, block, key_fn, reduce_fn, token)
+            # round blocks carry pre-reduced (unique-key) rows, so any
+            # block IS a valid partial accumulator
+            cur = block
+            i = 0
+            while i < len(levels) and levels[i] is not None:
+                cur = _fold_reduce_device(levels[i], cur, key_fn,
+                                          reduce_fn, token)
+                levels[i] = None
+                i += 1
+            if i == len(levels):
+                levels.append(cur)
+            else:
+                levels[i] = cur
+        acc: Optional[DeviceShards] = None
+        for lv in levels:                  # fold up the leftovers
+            if lv is None:
+                continue
+            acc = lv if acc is None else _fold_reduce_device(
+                lv, acc, key_fn, reduce_fn, token)
         return acc
 
     def _compute_host(self, shards: HostShards):

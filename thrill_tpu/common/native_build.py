@@ -1,0 +1,57 @@
+"""Shared build-from-source loader for the native C++ components.
+
+One artifact lifecycle for every native library (block store,
+dispatcher, ...): the output path embeds the SHA256 of the source file,
+so a stale or foreign binary (wrong hash name) is never loaded — it is
+rebuilt from the reviewed source instead. No prebuilt binaries ship in
+the repo (native/build/ is gitignored). Builds land through a
+tmp+rename so concurrent builders race safely, and stale hash-named
+artifacts from earlier source versions are garbage-collected.
+
+Callers attach their own ctypes signatures to the returned CDLL.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "native"))
+
+
+def build_and_load(source_name: str,
+                   extra_flags: tuple = ()) -> Optional[ctypes.CDLL]:
+    """Compile ``native/<source_name>`` (if needed) and dlopen it.
+
+    Returns None when the toolchain is unavailable or the build fails;
+    callers fall back to their pure-Python engines.
+    """
+    src = os.path.join(NATIVE_DIR, source_name)
+    stem = os.path.splitext(source_name)[0]
+    try:
+        import hashlib
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        build_dir = os.path.join(NATIVE_DIR, "build")
+        out = os.path.join(build_dir, f"lib{stem}-{digest}.so")
+        if not os.path.exists(out):
+            os.makedirs(build_dir, exist_ok=True)
+            tmp = out + f".tmp.{os.getpid()}"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                 "-pthread", *extra_flags, src, "-o", tmp],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, out)  # atomic vs concurrent builders
+            for name in os.listdir(build_dir):
+                if (name.startswith(f"lib{stem}-") and name.endswith(".so")
+                        and os.path.join(build_dir, name) != out):
+                    try:
+                        os.unlink(os.path.join(build_dir, name))
+                    except OSError:
+                        pass
+        return ctypes.CDLL(out)
+    except (OSError, subprocess.SubprocessError):
+        return None

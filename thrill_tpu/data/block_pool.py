@@ -22,43 +22,16 @@ _LIB_FAILED = False
 
 
 def _load_native() -> Optional[ctypes.CDLL]:
-    """Build-from-source-only loader: the library path embeds the SHA256
-    of blockstore.cpp, so a stale or foreign binary (wrong hash name) is
-    never loaded — it is rebuilt from the reviewed source instead. No
-    prebuilt binaries are shipped in the repo (native/build/ is
-    gitignored)."""
+    """Build-from-source-only loader (hash-named artifact; shared
+    lifecycle in common/native_build.py — a stale or foreign binary is
+    never loaded, it is rebuilt from the reviewed source instead)."""
     global _LIB, _LIB_FAILED
     with _LIB_LOCK:
         if _LIB is not None or _LIB_FAILED:
             return _LIB
-        src = os.path.abspath(os.path.join(_NATIVE_DIR, "blockstore.cpp"))
-        try:
-            import hashlib
-            with open(src, "rb") as f:
-                digest = hashlib.sha256(f.read()).hexdigest()[:16]
-            out = os.path.abspath(os.path.join(
-                _NATIVE_DIR, "build", f"libblockstore-{digest}.so"))
-            if not os.path.exists(out):
-                os.makedirs(os.path.dirname(out), exist_ok=True)
-                tmp = out + f".tmp.{os.getpid()}"
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                     "-pthread", src, "-o", tmp],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(tmp, out)  # atomic vs concurrent builders
-                # GC stale hash-named builds from earlier source versions
-                for name in os.listdir(os.path.dirname(out)):
-                    if (name.startswith("libblockstore-")
-                            and name.endswith(".so")
-                            and os.path.join(os.path.dirname(out), name)
-                            != out):
-                        try:
-                            os.unlink(os.path.join(
-                                os.path.dirname(out), name))
-                        except OSError:
-                            pass
-            lib = ctypes.CDLL(out)
-        except (OSError, subprocess.SubprocessError):
+        from ..common.native_build import build_and_load
+        lib = build_and_load("blockstore.cpp")
+        if lib is None:
             _LIB_FAILED = True
             return None
         lib.bs_create.restype = ctypes.c_void_p

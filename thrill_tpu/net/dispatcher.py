@@ -1,0 +1,379 @@
+"""Async I/O engine: the net layer's dispatcher + dispatcher thread.
+
+Equivalent of the reference's net::Dispatcher / DispatcherThread
+(reference: thrill/net/dispatcher.hpp:510 — per-connection queues of
+AsyncRead/AsyncWrite buffers driven by an event loop on a dedicated
+thread; dispatcher_thread.hpp:60). The engine itself is native C++
+(native/dispatcher.cpp, epoll + dedicated thread, built from source on
+first use like the block store); this wrapper exposes request handles
+Python can wait on, and a pure-Python ``selectors`` fallback keeps the
+API available without a compiler.
+
+Semantics shared by both engines:
+  * ``async_write(sock, bytes)`` copies the buffer in and returns a
+    request id immediately; the engine writes when the socket is
+    writable. Per-fd writes retire FIFO, so framing order is preserved.
+  * ``async_read(sock, n)`` completes once exactly n bytes arrived.
+  * ``wait(id)`` blocks until completion; ``fetch(id)`` returns a
+    read's payload (b"" for writes) and frees the slot.
+Registered fds are switched to non-blocking and owned by the engine —
+all traffic on them must flow through it until ``unregister``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import selectors
+import socket
+import threading
+from collections import deque
+from typing import Deque, Dict, Optional, Tuple
+
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_FAILED = False
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    """Build-from-source-only loader (hash-named artifact; shared
+    lifecycle in common/native_build.py)."""
+    global _LIB, _LIB_FAILED
+    with _LIB_LOCK:
+        if _LIB is not None or _LIB_FAILED:
+            return _LIB
+        from ..common.native_build import build_and_load
+        lib = build_and_load("dispatcher.cpp")
+        if lib is None:
+            _LIB_FAILED = True
+            return None
+        try:
+            lib.disp_create.restype = ctypes.c_void_p
+            lib.disp_destroy.argtypes = [ctypes.c_void_p]
+            lib.disp_register.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.disp_register.restype = ctypes.c_int
+            lib.disp_unregister.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.disp_unregister.restype = ctypes.c_int
+            lib.disp_async_write.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+                ctypes.c_int64]
+            lib.disp_async_write.restype = ctypes.c_int64
+            lib.disp_async_read.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int64]
+            lib.disp_async_read.restype = ctypes.c_int64
+            lib.disp_poll.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            lib.disp_poll.restype = ctypes.c_int64
+            lib.disp_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_double]
+            lib.disp_wait.restype = ctypes.c_int64
+            lib.disp_fetch.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_char_p, ctypes.c_int64]
+            lib.disp_fetch.restype = ctypes.c_int64
+            lib.disp_pending.argtypes = [ctypes.c_void_p]
+            lib.disp_pending.restype = ctypes.c_int64
+            _LIB = lib
+        except Exception:
+            _LIB_FAILED = True
+    return _LIB
+
+
+class DispatcherError(ConnectionError):
+    pass
+
+
+class _NativeDispatcher:
+    """ctypes front for the epoll engine."""
+
+    def __init__(self, lib: ctypes.CDLL) -> None:
+        self._lib = lib
+        self._h = lib.disp_create()
+        if not self._h:
+            raise OSError("disp_create failed")
+        self._sizes: Dict[int, int] = {}   # read req id -> want bytes
+
+    def register(self, sock: socket.socket) -> None:
+        if self._lib.disp_register(self._h, sock.fileno()) != 0:
+            raise OSError("disp_register failed")
+
+    def unregister(self, sock: socket.socket) -> None:
+        self._lib.disp_unregister(self._h, sock.fileno())
+
+    def async_write(self, sock: socket.socket, data: bytes) -> int:
+        rid = self._lib.disp_async_write(self._h, sock.fileno(), data,
+                                         len(data))
+        if rid < 0:
+            raise DispatcherError("async_write on unregistered/failed fd")
+        return rid
+
+    def async_read(self, sock: socket.socket, n: int) -> int:
+        rid = self._lib.disp_async_read(self._h, sock.fileno(), n)
+        if rid < 0:
+            raise DispatcherError("async_read on unregistered/failed fd")
+        self._sizes[rid] = n
+        return rid
+
+    def poll(self, rid: int) -> int:
+        return int(self._lib.disp_poll(self._h, rid))
+
+    def wait(self, rid: int, timeout: Optional[float] = None) -> int:
+        return int(self._lib.disp_wait(
+            self._h, rid, -1.0 if timeout is None else timeout))
+
+    def fetch(self, rid: int) -> bytes:
+        cap = self._sizes.pop(rid, 0)
+        buf = ctypes.create_string_buffer(cap) if cap else None
+        n = self._lib.disp_fetch(self._h, rid, buf, cap)
+        if n < 0:
+            raise DispatcherError(
+                f"async request {rid} failed (status {n})")
+        return buf.raw[:n] if buf is not None else b""
+
+    def pending(self) -> int:
+        return int(self._lib.disp_pending(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.disp_destroy(self._h)
+            self._h = None
+
+
+class _PyDispatcher:
+    """Pure-Python fallback: ``selectors`` loop on a daemon thread."""
+
+    def __init__(self) -> None:
+        self._sel = selectors.DefaultSelector()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._writes: Dict[int, Deque[Tuple[int, memoryview]]] = {}
+        self._reads: Dict[int, Deque[Tuple[int, int, bytearray]]] = {}
+        self._socks: Dict[int, socket.socket] = {}
+        self._done: Dict[int, Tuple[int, bytes]] = {}  # id -> (status, data)
+        self._next_id = 1
+        self._stop = False
+        self._waker_r, self._waker_w = socket.socketpair()
+        self._waker_r.setblocking(False)
+        self._sel.register(self._waker_r, selectors.EVENT_READ, None)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="thrill-dispatcher")
+        self._thread.start()
+
+    def _wake(self) -> None:
+        try:
+            self._waker_w.send(b"\x01")
+        except OSError:
+            pass
+
+    def register(self, sock: socket.socket) -> None:
+        sock.setblocking(False)
+        with self._lock:
+            fd = sock.fileno()
+            self._writes[fd] = deque()
+            self._reads[fd] = deque()
+            self._socks[fd] = sock
+            # no selector registration yet: selectors reject an empty
+            # interest set, so the fd joins the loop on first request
+
+    def unregister(self, sock: socket.socket) -> None:
+        with self._cv:
+            fd = sock.fileno()
+            for rid, _ in self._writes.pop(fd, ()):
+                self._done[rid] = (-32, b"")
+            for rid, _, _ in self._reads.pop(fd, ()):
+                self._done[rid] = (-32, b"")
+            self._socks.pop(fd, None)
+            try:
+                self._sel.unregister(sock)
+            except (KeyError, ValueError):
+                pass
+            self._cv.notify_all()
+        sock.setblocking(True)
+
+    def async_write(self, sock: socket.socket, data: bytes) -> int:
+        with self._lock:
+            fd = sock.fileno()
+            if fd not in self._writes:
+                raise DispatcherError("async_write on unregistered fd")
+            rid = self._next_id
+            self._next_id += 1
+            self._writes[fd].append((rid, memoryview(bytes(data))))
+            self._update(fd)
+        self._wake()
+        return rid
+
+    def async_read(self, sock: socket.socket, n: int) -> int:
+        with self._cv:
+            fd = sock.fileno()
+            if fd not in self._reads:
+                raise DispatcherError("async_read on unregistered fd")
+            rid = self._next_id
+            self._next_id += 1
+            if n == 0 and not self._reads[fd]:
+                # zero-byte read with nothing queued ahead completes
+                # right away (select never fires for it)
+                self._done[rid] = (1, b"")
+                self._cv.notify_all()
+                return rid
+            self._reads[fd].append((rid, n, bytearray()))
+            self._update(fd)
+        self._wake()
+        return rid
+
+    def poll(self, rid: int) -> int:
+        with self._lock:
+            if rid not in self._done:
+                return 0
+            status, _ = self._done[rid]
+            return 1 if status >= 0 else status
+
+    def wait(self, rid: int, timeout: Optional[float] = None) -> int:
+        with self._cv:
+            ok = self._cv.wait_for(lambda: rid in self._done, timeout)
+            if not ok:
+                return 0
+            status, _ = self._done[rid]
+            return 1 if status >= 0 else status
+
+    def fetch(self, rid: int) -> bytes:
+        with self._lock:
+            status, data = self._done.pop(rid, (-1, b""))
+        if status < 0:
+            raise DispatcherError(
+                f"async request {rid} failed (status {status})")
+        return data
+
+    def pending(self) -> int:
+        with self._lock:
+            return (sum(len(q) for q in self._writes.values())
+                    + sum(len(q) for q in self._reads.values()))
+
+    def close(self) -> None:
+        self._stop = True
+        self._wake()
+        self._thread.join(timeout=5)
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+        self._waker_r.close()
+        self._waker_w.close()
+
+    # -- loop ----------------------------------------------------------
+    def _update(self, fd: int) -> None:
+        """Recompute the interest set; caller holds the lock."""
+        sock = self._socks.get(fd)
+        if sock is None:
+            return
+        ev = 0
+        if self._reads.get(fd):
+            ev |= selectors.EVENT_READ
+        if self._writes.get(fd):
+            ev |= selectors.EVENT_WRITE
+        try:
+            if ev == 0:
+                self._sel.unregister(sock)
+            else:
+                self._sel.modify(sock, ev, fd)
+        except KeyError:
+            if ev:
+                self._sel.register(sock, ev, fd)
+        except ValueError:
+            pass
+
+    def _fail_fd(self, fd: int, status: int) -> None:
+        for rid, _ in self._writes.get(fd, ()):
+            self._done[rid] = (status, b"")
+        for rid, _, _ in self._reads.get(fd, ()):
+            self._done[rid] = (status, b"")
+        if fd in self._writes:
+            self._writes[fd].clear()
+        if fd in self._reads:
+            self._reads[fd].clear()
+        self._update(fd)
+        self._cv.notify_all()
+
+    def _run(self) -> None:
+        while not self._stop:
+            events = self._sel.select(timeout=0.2)
+            with self._cv:
+                for key, mask in events:
+                    if key.data is None:          # waker
+                        try:
+                            while self._waker_r.recv(256):
+                                pass
+                        except OSError:
+                            pass
+                        continue
+                    fd = key.data
+                    sock = self._socks.get(fd)
+                    if sock is None:
+                        continue
+                    if mask & selectors.EVENT_WRITE:
+                        self._drain_writes(fd, sock)
+                    if mask & selectors.EVENT_READ:
+                        self._drain_reads(fd, sock)
+                    self._update(fd)
+
+    def _drain_writes(self, fd: int, sock: socket.socket) -> None:
+        q = self._writes.get(fd)
+        while q:
+            rid, mv = q[0]
+            try:
+                n = sock.send(mv)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._fail_fd(fd, -32)
+                return
+            if n < len(mv):
+                q[0] = (rid, mv[n:])
+                return
+            q.popleft()
+            self._done[rid] = (1, b"")
+            self._cv.notify_all()
+
+    def _drain_reads(self, fd: int, sock: socket.socket) -> None:
+        q = self._reads.get(fd)
+        while q:
+            rid, want, buf = q[0]
+            if want == 0:
+                q.popleft()
+                self._done[rid] = (1, b"")
+                self._cv.notify_all()
+                continue
+            try:
+                chunk = sock.recv(want - len(buf))
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._fail_fd(fd, -32)
+                return
+            if not chunk:
+                self._fail_fd(fd, -1)
+                return
+            buf.extend(chunk)
+            if len(buf) < want:
+                return
+            q.popleft()
+            self._done[rid] = (1, bytes(buf))
+            self._cv.notify_all()
+
+
+def Dispatcher(force_py: bool = False):
+    """Engine factory: native epoll when buildable, selectors fallback.
+
+    THRILL_TPU_NATIVE=0 forces the fallback (mirrors block_pool)."""
+    use_native = (not force_py
+                  and os.environ.get("THRILL_TPU_NATIVE", "1") != "0")
+    if use_native:
+        lib = _load_native()
+        if lib is not None:
+            try:
+                return _NativeDispatcher(lib)
+            except OSError:
+                pass
+    return _PyDispatcher()
+
+
+# NOTE: the length-framed channel over this engine lives in
+# tcp.TcpConnection (attach_dispatcher) — one implementation of the
+# bounded-in-flight reap/flush logic, in the product path.

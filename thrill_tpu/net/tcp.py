@@ -52,6 +52,47 @@ class TcpConnection(Connection):
         self._recv_seq = 0
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()
+        # async engine (net/dispatcher.py); None = blocking socket ops
+        self._disp = None
+        self._disp_inflight: "deque" = None
+        self._max_inflight = 64
+
+    def attach_dispatcher(self, disp, max_inflight: int = 64) -> None:
+        """Route all traffic through the async engine from now on:
+        sends enqueue and return (bounded in-flight, the reference's
+        send-semaphore analog), receives complete on the dispatcher
+        thread. Must be called between messages (e.g. right after
+        bootstrap), never mid-frame."""
+        from collections import deque
+        with self._send_lock, self._recv_lock:
+            disp.register(self.sock)
+            self._disp = disp
+            self._disp_inflight = deque()
+            self._max_inflight = max_inflight
+
+    def _reap_sends(self, block: bool) -> None:
+        """Caller holds _send_lock. Retire completed async sends; when
+        ``block``, wait until back under the in-flight cap."""
+        q = self._disp_inflight
+        while q:
+            rid = q[0]
+            if block and len(q) >= self._max_inflight:
+                self._disp.wait(rid)
+            elif self._disp.poll(rid) == 0:
+                return
+            q.popleft()
+            self._disp.fetch(rid)     # raises if the write failed
+
+    def flush(self) -> None:
+        """Block until every queued async send has hit the socket."""
+        if self._disp is None:
+            return
+        with self._send_lock:
+            q = self._disp_inflight
+            while q:
+                rid = q.popleft()
+                self._disp.wait(rid)
+                self._disp.fetch(rid)
 
     def send(self, obj: Any) -> None:
         payload = wire.dumps(obj, allow_pickle=self.authenticated)
@@ -63,7 +104,12 @@ class TcpConnection(Connection):
                 msg += wire.frame_mac(self._session_key, self._send_dir,
                                       self._send_seq, payload)
                 self._send_seq += 1
-            self.sock.sendall(msg)
+            if self._disp is not None:
+                self._reap_sends(block=True)
+                self._disp_inflight.append(
+                    self._disp.async_write(self.sock, msg))
+            else:
+                self.sock.sendall(msg)
 
     def recv(self) -> Any:
         with self._recv_lock:
@@ -94,6 +140,10 @@ class TcpConnection(Connection):
         self.authenticated = True
 
     def _recv_exact(self, n: int) -> bytes:
+        if self._disp is not None:
+            rid = self._disp.async_read(self.sock, n)
+            self._disp.wait(rid)
+            return self._disp.fetch(rid)
         chunks = []
         while n > 0:
             b = self.sock.recv(n)
@@ -105,6 +155,22 @@ class TcpConnection(Connection):
 
     def close(self) -> None:
         try:
+            self.flush()
+        except (ConnectionError, OSError) as e:
+            # close() must not raise in cleanup paths, but a deferred
+            # async-send failure means queued messages were LOST — make
+            # that visible (callers needing a guarantee call flush()
+            # themselves and get the exception at the call site)
+            import sys
+            print(f"thrill_tpu.net.tcp: async sends lost at close: {e}",
+                  file=sys.stderr)
+        if self._disp is not None:
+            try:
+                self._disp.unregister(self.sock)
+            except OSError:
+                pass
+            self._disp = None
+        try:
             self.sock.close()
         except OSError:
             pass
@@ -115,15 +181,36 @@ class TcpGroup(Group):
                  conns: Dict[int, TcpConnection]) -> None:
         super().__init__(my_rank, num_hosts)
         self._conns = conns
+        self._disp = None
 
     def connection(self, peer: int) -> TcpConnection:
         if peer == self.my_rank:
             raise ValueError("no connection to self")
         return self._conns[peer]
 
+    def attach_dispatcher(self, disp=None) -> None:
+        """Drive every connection through one async engine (a dedicated
+        DispatcherThread per host, reference:
+        thrill/net/dispatcher_thread.hpp:60) — fan-out sends to many
+        peers then progress concurrently instead of serializing on
+        sendall. The group owns the engine and closes it."""
+        if disp is None:
+            from .dispatcher import Dispatcher
+            disp = Dispatcher()
+        self._disp = disp
+        for c in self._conns.values():
+            c.attach_dispatcher(disp)
+
+    def flush(self) -> None:
+        for c in self._conns.values():
+            c.flush()
+
     def close(self) -> None:
         for c in self._conns.values():
             c.close()
+        if self._disp is not None:
+            self._disp.close()
+            self._disp = None
 
 
 def _exchange_auth_flag(conn: TcpConnection, have_secret: bool) -> None:
@@ -245,7 +332,13 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
     if errors:
         raise errors[0]
     assert len(conns) == p - 1
-    return TcpGroup(rank, p, conns)
+    group = TcpGroup(rank, p, conns)
+    # async engine on by default: collectives' fan-out sends overlap
+    # (reference always runs its Dispatcher; THRILL_TPU_ASYNC_NET=0
+    # falls back to blocking sockets)
+    if os.environ.get("THRILL_TPU_ASYNC_NET", "1") != "0":
+        group.attach_dispatcher()
+    return group
 
 
 def construct_from_env() -> Optional[TcpGroup]:
