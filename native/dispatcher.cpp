@@ -40,7 +40,11 @@ namespace {
 
 struct WriteReq {
   int64_t id = 0;
-  std::vector<char> data;
+  // borrowed buffer: the caller guarantees it stays valid until the
+  // request completes (the Python wrapper pins the immutable bytes
+  // object until fetch) — enqueue is zero-copy even for huge frames
+  const char* data = nullptr;
+  size_t len = 0;
   size_t off = 0;
 };
 
@@ -57,6 +61,11 @@ struct FdState {
   std::deque<ReadReq> reads;
   uint32_t events = 0;      // current epoll interest set
   bool error = false;
+  // fd removed from the epoll set: a bare EPOLLHUP/EPOLLERR is
+  // level-triggered and reported regardless of the interest mask, so
+  // an idle hung-up fd must leave the set or the loop busy-spins. A
+  // later request re-adds it (buffered bytes are still readable).
+  bool parked = false;
 };
 
 // completed request: status >0 ok (bytes), <0 error (-errno or -1 eof)
@@ -95,10 +104,10 @@ class Dispatcher {
   bool ok() const { return running_; }
 
   int Register(int fd) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (fds_.count(fd)) return -1;       // before any fd-mode change
     int flags = fcntl(fd, F_GETFL, 0);
     if (flags < 0 || fcntl(fd, F_SETFL, flags | O_NONBLOCK) < 0) return -1;
-    std::lock_guard<std::mutex> g(mu_);
-    if (fds_.count(fd)) return -1;
     FdState st;
     st.fd = fd;
     fds_.emplace(fd, std::move(st));
@@ -107,6 +116,7 @@ class Dispatcher {
     ev.data.fd = fd;
     if (epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev) != 0) {
       fds_.erase(fd);
+      fcntl(fd, F_SETFL, flags);         // restore blocking mode
       return -1;
     }
     return 0;
@@ -133,13 +143,42 @@ class Dispatcher {
     std::lock_guard<std::mutex> g(mu_);
     auto it = fds_.find(fd);
     if (it == fds_.end() || it->second.error) return -1;
+    FdState& st = it->second;
+    int64_t id = next_id_++;
+    size_t off = 0;
+    if (st.writes.empty()) {
+      // opportunistic inline send while the queue is empty (FIFO-safe):
+      // a few attempts fill the socket buffer at caller speed — small
+      // frames usually complete here — but the attempt cap keeps the
+      // caller's enqueue latency bounded so a continuously-draining
+      // receiver cannot turn the async send into a full blocking one
+      for (int attempts = 0;
+           off < static_cast<size_t>(len) && attempts < 4; attempts++) {
+        ssize_t n = send(fd, buf + off, len - off, MSG_NOSIGNAL);
+        if (n < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          if (errno == EINTR) continue;
+          Retire(id, -errno, {});
+          FailAll(st, -errno);
+          return id;
+        }
+        off += static_cast<size_t>(n);
+      }
+      if (off == static_cast<size_t>(len)) {
+        Retire(id, std::max<int64_t>(len, 1), {});
+        cv_.notify_all();
+        return id;
+      }
+    }
     WriteReq req;
-    req.id = next_id_++;
-    req.data.assign(buf, buf + len);
-    it->second.writes.push_back(std::move(req));
-    UpdateInterest(it->second);
+    req.id = id;
+    req.data = buf;
+    req.len = static_cast<size_t>(len);
+    req.off = off;
+    st.writes.push_back(req);
+    UpdateInterest(st);
     Wake();
-    return it->second.writes.back().id;
+    return id;
   }
 
   int64_t AsyncRead(int fd, int64_t len) {
@@ -186,11 +225,15 @@ class Dispatcher {
   }
 
   // copy a completed request's read bytes out and free the slot;
-  // returns bytes copied (0 for writes), negative on error/unknown id
+  // returns bytes copied (0 for writes), negative error status, or
+  // kNotDone for an id with no completion yet (distinct from the -1
+  // EOF status so callers can tell "still pending" from "failed")
+  static constexpr int64_t kNotDone = -(int64_t(1) << 62);
+
   int64_t Fetch(int64_t id, char* out, int64_t cap) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = done_.find(id);
-    if (it == done_.end()) return -1;
+    if (it == done_.end()) return kNotDone;
     Done d = std::move(it->second);
     done_.erase(it);
     if (d.status < 0) return d.status;
@@ -230,6 +273,17 @@ class Dispatcher {
     uint32_t want = 0;
     if (!st.reads.empty()) want |= EPOLLIN;
     if (!st.writes.empty()) want |= EPOLLOUT;
+    if (st.parked) {
+      if (want == 0) return;
+      epoll_event ev{};
+      ev.events = want;
+      ev.data.fd = st.fd;
+      if (epoll_ctl(epfd_, EPOLL_CTL_ADD, st.fd, &ev) == 0) {
+        st.parked = false;
+        st.events = want;
+      }
+      return;
+    }
     if (want == st.events) return;
     epoll_event ev{};
     ev.events = want;
@@ -238,10 +292,18 @@ class Dispatcher {
     st.events = want;
   }
 
+  // caller holds mu_: drop the fd from the epoll set (see FdState)
+  void Park(FdState& st) {
+    if (st.parked) return;
+    epoll_ctl(epfd_, EPOLL_CTL_DEL, st.fd, nullptr);
+    st.parked = true;
+    st.events = 0;
+  }
+
   void HandleWritable(FdState& st) {
     while (!st.writes.empty()) {
       WriteReq& w = st.writes.front();
-      ssize_t n = send(st.fd, w.data.data() + w.off, w.data.size() - w.off,
+      ssize_t n = send(st.fd, w.data + w.off, w.len - w.off,
                        MSG_NOSIGNAL);
       if (n < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) return;
@@ -250,8 +312,9 @@ class Dispatcher {
         return;
       }
       w.off += static_cast<size_t>(n);
-      if (w.off < w.data.size()) return;
-      Retire(w.id, static_cast<int64_t>(w.data.size()), {});
+      if (w.off < w.len) return;
+      // zero-length writes still report success (status must be > 0)
+      Retire(w.id, std::max<int64_t>(static_cast<int64_t>(w.len), 1), {});
       st.writes.pop_front();
       cv_.notify_all();
     }
@@ -292,7 +355,7 @@ class Dispatcher {
     for (auto& r : st.reads) Retire(r.id, status, {});
     st.writes.clear();
     st.reads.clear();
-    UpdateInterest(st);
+    Park(st);  // errored fds keep reporting HUP/ERR — leave the set
     cv_.notify_all();
   }
 
@@ -315,15 +378,23 @@ class Dispatcher {
         }
         auto it = fds_.find(fd);
         if (it == fds_.end()) continue;
+        FdState& st = it->second;
         if (evs[i].events & (EPOLLERR | EPOLLHUP)) {
-          // drain reads first: a closing peer's final bytes are valid
-          if (evs[i].events & EPOLLIN) HandleReadable(it->second);
-          if (!it->second.error) FailAll(it->second, -1);
+          // a hangup is NOT an error for this fd's buffered data:
+          // drain pending reads (recv returns the peer's final bytes,
+          // then 0 -> EOF fails only reads that cannot complete) and
+          // let pending writes fail through send() itself. An idle fd
+          // is parked so the level-triggered HUP stops firing; a later
+          // async_read re-adds it and still sees the kernel buffer.
+          HandleReadable(st);
+          HandleWritable(st);
+          if (!st.error && st.reads.empty() && st.writes.empty())
+            Park(st);
           continue;
         }
-        if (evs[i].events & EPOLLOUT) HandleWritable(it->second);
-        if (evs[i].events & EPOLLIN) HandleReadable(it->second);
-        UpdateInterest(it->second);
+        if (evs[i].events & EPOLLOUT) HandleWritable(st);
+        if (evs[i].events & EPOLLIN) HandleReadable(st);
+        UpdateInterest(st);
       }
     }
   }

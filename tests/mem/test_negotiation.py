@@ -1,0 +1,113 @@
+"""Stage memory negotiation: DIAMemUse analog.
+
+Reference: thrill/api/dia_base.cpp:121-270 — fixed requests are
+subtracted from the stage's RAM, the remainder splits evenly among
+DIAMemUse::Max requesters; Sort sizes its in-RAM run capacity from the
+grant (api/sort.hpp MainOp).
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from thrill_tpu.api import Context
+from thrill_tpu.api.dia_base import DIABase
+from thrill_tpu.common.config import Config
+from thrill_tpu.parallel.mesh import MeshExec
+
+
+def _ctx(W=2, **cfg_kw):
+    cfg = Config.from_env()
+    for k, v in cfg_kw.items():
+        setattr(cfg, k, v)
+    return Context(MeshExec(devices=jax.devices("cpu")[:W]), config=cfg)
+
+
+class _MaxNode(DIABase):
+    MEM_USE = "max"
+
+    def compute(self):  # pragma: no cover - never executed here
+        raise AssertionError
+
+
+class _FixedNode(DIABase):
+    MEM_USE = 1 << 20
+
+    def compute(self):  # pragma: no cover
+        raise AssertionError
+
+
+def test_max_requesters_never_overcommit():
+    ctx = _ctx(ram=90 << 20)
+    pool = ctx.ram_workers
+    assert pool == (90 << 20) // 3
+    a = _MaxNode(ctx, "A")
+    b = _MaxNode(ctx, "B")
+    assert ctx.negotiate_mem(a)
+    assert a.mem_limit == pool // 2
+    # a nested (concurrent) max requester gets half the REMAINDER —
+    # already-granted reservations are respected, never over-committed
+    assert ctx.negotiate_mem(b)
+    assert b.mem_limit == pool // 4
+    assert a.mem_limit + b.mem_limit <= pool
+    ctx.release_mem(b)
+    ctx.release_mem(a)
+    # reservations return to idle: a fresh requester sees the full pool
+    c = _MaxNode(ctx, "C")
+    ctx.negotiate_mem(c)
+    assert c.mem_limit == pool // 2
+    ctx.release_mem(c)
+    ctx.close()
+
+
+def test_fixed_requests_subtract_from_pool():
+    ctx = _ctx(ram=90 << 20)
+    pool = ctx.ram_workers
+    f = _FixedNode(ctx, "F")
+    m = _MaxNode(ctx, "M")
+    assert ctx.negotiate_mem(f)
+    assert f.mem_limit == 1 << 20
+    ctx.negotiate_mem(m)
+    assert m.mem_limit == (pool - (1 << 20)) // 2
+    ctx.release_mem(m)
+    ctx.release_mem(f)
+    assert ctx._mem_reserved == 0
+    ctx.close()
+
+
+def test_no_request_no_grant():
+    ctx = _ctx()
+    n = _MaxNode(ctx, "N")
+    n.MEM_USE = None
+    assert not ctx.negotiate_mem(n)
+    assert n.mem_limit is None
+    ctx.close()
+
+
+def test_host_sort_sizes_runs_from_grant(monkeypatch):
+    """A tiny RAM config forces the host Sort into the EM path with a
+    grant-derived run size — and the result is still correct."""
+    monkeypatch.delenv("THRILL_TPU_HOST_SORT_RUN", raising=False)
+    ctx = _ctx(ram=192 << 10)         # ram_workers = 64 KiB
+    vals = list(range(4000))
+    random.Random(7).shuffle(vals)
+    d = ctx.Distribute(vals, storage="host").Sort()
+    node = d.node
+    out = list(d.AllGather())
+    assert out == sorted(vals)
+    # the (single) max requester reserved half the pool
+    assert node.mem_limit == ctx.ram_workers // 2
+    # grant / pickled-item-size is far below n -> EM path actually ran
+    assert node._granted_run_size_last < 4000
+    ctx.close()
+
+
+def test_grant_large_ram_stays_in_memory():
+    ctx = _ctx(ram=8 << 30)
+    vals = list(range(2000))
+    random.Random(3).shuffle(vals)
+    d = ctx.Distribute(vals, storage="host").Sort()
+    assert list(d.AllGather()) == sorted(vals)
+    ctx.close()

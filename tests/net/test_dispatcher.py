@@ -133,6 +133,43 @@ def test_peer_close_fails_pending_read(disp):
         b.close()
 
 
+def test_final_bytes_readable_after_peer_close(disp):
+    """A peer's last frame must survive its close: the engine sees the
+    hangup while the fd is idle, parks it (no busy-spin), and a read
+    posted afterwards still drains the kernel buffer before EOF."""
+    import time
+
+    a, b = socket.socketpair()
+    try:
+        disp.register(b)
+        a.sendall(b"final")
+        a.close()
+        time.sleep(0.3)            # engine observes HUP with no request
+        r = disp.async_read(b, 5)
+        assert disp.wait(r, timeout=5) == 1
+        assert disp.fetch(r) == b"final"
+        r2 = disp.async_read(b, 1)  # now at EOF
+        assert disp.wait(r2, timeout=5) < 0
+        with pytest.raises(DispatcherError):
+            disp.fetch(r2)
+    finally:
+        disp.unregister(b)
+        b.close()
+
+
+def test_zero_length_write_completes(disp):
+    a, b = socket.socketpair()
+    try:
+        disp.register(a)
+        w = disp.async_write(a, b"")
+        assert disp.wait(w, timeout=5) == 1
+        assert disp.fetch(w) == b""
+    finally:
+        disp.unregister(a)
+        a.close()
+        b.close()
+
+
 def test_unregister_restores_blocking(disp):
     a, b = socket.socketpair()
     try:

@@ -61,6 +61,10 @@ class Context:
         self.mem = MemoryManager(name="context")
         from ..mem.hbm import HbmGovernor
         self.hbm = HbmGovernor(self, limit=self.config.hbm_limit)
+        # stage memory negotiation state: bytes currently reserved by
+        # active grants (reference: per-stage RAM distribution among
+        # max-RAM requesters, api/dia_base.cpp:121-270)
+        self._mem_reserved = 0
         self.rng = np.random.default_rng(seed)
         self._nodes: List[Any] = []
         self._profiler = None
@@ -101,6 +105,63 @@ class Context:
     def _register_node(self, node) -> int:
         self._nodes.append(node)
         return len(self._nodes) - 1
+
+    # -- stage memory negotiation ---------------------------------------
+    # Reference: the StageBuilder distributes worker RAM per stage —
+    # fixed DIAMemUse requests are subtracted, the remainder is split
+    # evenly among ops requesting DIAMemUse::Max
+    # (api/dia_base.cpp:121-270). Pull-model translation: requesters
+    # negotiate on entry to compute() and RESERVE their grant until
+    # release; a "max" requester gets half of the remaining pool, so
+    # nested concurrent requesters (recursive Sorts) get geometrically
+    # smaller shares and the pool is never over-committed (the
+    # reference can split exactly because a stage's requesters are
+    # known up front; here they arrive dynamically).
+    @property
+    def ram_workers(self) -> int:
+        """Host-RAM pool for operator workspace (one third of the
+        configured or detected RAM, reference MemoryConfig split,
+        api/context.cpp:1082-1093)."""
+        ram = getattr(self, "_ram_workers", None)
+        if ram is None:
+            total = self.config.ram or self.config.host_ram
+            if not total:
+                try:
+                    total = (os.sysconf("SC_PAGE_SIZE")
+                             * os.sysconf("SC_PHYS_PAGES"))
+                except (ValueError, OSError):
+                    total = 8 << 30
+            from ..mem.manager import MemoryConfig
+            ram = self._ram_workers = MemoryConfig.split(total).ram_workers
+        return ram
+
+    def negotiate_mem(self, node) -> bool:
+        """Grant ``node.mem_limit`` per its ``mem_use()`` request.
+        Returns True when something was granted (caller must
+        release_mem after compute)."""
+        req = node.mem_use()
+        if req is None:
+            node.mem_limit = None
+            return False
+        remaining = max(self.ram_workers - self._mem_reserved, 4096)
+        if req == "max":
+            grant = max(remaining // 2, 4096)
+        else:
+            grant = min(int(req), remaining)
+        self._mem_reserved += grant
+        node.mem_limit = grant
+        node._mem_grant = grant
+        if self.logger.enabled:
+            self.logger.line(event="mem_negotiate", node=node.label,
+                             dia_id=node.id, grant=grant,
+                             reserved=self._mem_reserved)
+        return True
+
+    def release_mem(self, node) -> None:
+        grant = getattr(node, "_mem_grant", 0)
+        if grant:
+            self._mem_reserved -= grant
+        node._mem_grant = 0
 
     # -- sources (created lazily like every DIA op) ---------------------
     def Generate(self, size: int, fn: Optional[Callable] = None,

@@ -65,8 +65,21 @@ class DIABase:
         # node's data may be used once, .Keep(n) allows n more uses
         # (reference: consume counters, api/dia_base.hpp:226-250)
         self.consume_budget = 1
+        # host-RAM grant for this node's compute, set by the stage
+        # driver from mem_use() before compute() runs (reference:
+        # DIAMemUse negotiation, api/dia_base.cpp:121-270). None =
+        # nothing requested/granted.
+        self.mem_limit: Optional[int] = None
 
     # -- overridables ---------------------------------------------------
+    # memory appetite of compute(): None = negligible, "max" = wants as
+    # much as available (EM operators: Sort runs, GroupBy tables), an
+    # int = fixed bytes (reference: DIAMemUse, api/dia_base.hpp:51)
+    MEM_USE = None
+
+    def mem_use(self):
+        return self.MEM_USE
+
     def compute(self) -> Shards:
         """Produce this node's output shards (the DOp main op + push)."""
         raise NotImplementedError
@@ -85,7 +98,16 @@ class DIABase:
                 log.line(event="node_execute_start", node=self.label,
                          dia_id=self.id,
                          parents=[p.node.id for p in self.parents])
-            self._shards = self.compute()
+            # stage memory negotiation: EM operators get a host-RAM
+            # grant split among concurrently computing max-requesters
+            # (nested pulls, e.g. recursive DC3 sorts, shrink the inner
+            # grants exactly like the reference's per-stage split)
+            negotiated = self.context.negotiate_mem(self)
+            try:
+                self._shards = self.compute()
+            finally:
+                if negotiated:
+                    self.context.release_mem(self)
             self.state = EXECUTED
             if not (consume and self.consume_budget <= 1):
                 # a result released by this very pull is never worth

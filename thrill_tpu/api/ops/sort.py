@@ -74,6 +74,11 @@ def choose_splitters(samples, W: int, ncols: int) -> np.ndarray:
 
 
 class SortNode(DIABase):
+    # EM operator: asks the stage negotiation for as much worker RAM as
+    # available (reference: SortNode uses DIAMemUse::Max for its
+    # ReceiveItems capacity, api/sort.hpp MainOp + dia_base.cpp:121-270)
+    MEM_USE = "max"
+
     def __init__(self, ctx, link, key_fn: Optional[Callable],
                  compare_fn: Optional[Callable], stable: bool) -> None:
         super().__init__(ctx, "Sort", [link])
@@ -108,8 +113,9 @@ class SortNode(DIABase):
             sort_key = self.key_fn
 
         run_size = int(os.environ.get("THRILL_TPU_HOST_SORT_RUN") or
-                       self.HOST_RUN_SIZE)
+                       self._granted_run_size(shards))
         run_size = max(run_size, 16)
+        self._granted_run_size_last = run_size
         n = shards.total
         if n <= run_size:
             items = [it for l in shards.lists for it in l]
@@ -127,6 +133,26 @@ class SortNode(DIABase):
             bounds = [(w * n) // W for w in range(W + 1)]
             return HostShards(W, [items[bounds[w]:bounds[w + 1]]
                                   for w in range(W)])
+
+    def _granted_run_size(self, shards: HostShards) -> int:
+        """In-RAM run capacity in items from the negotiated grant.
+
+        The reference sizes its ReceiveItems capacity from the granted
+        RAM over the item size (api/sort.hpp:665-699); host items here
+        are Python objects spilled pickled, so the estimate probes the
+        first item's pickled size (plus interpreter overhead)."""
+        if not self.mem_limit:
+            return self.HOST_RUN_SIZE
+        first = next((it for l in shards.lists for it in l), None)
+        if first is None:
+            return self.HOST_RUN_SIZE
+        try:
+            import pickle
+            est = len(pickle.dumps(
+                first, protocol=pickle.HIGHEST_PROTOCOL)) + 64
+        except Exception:
+            est = 256
+        return max(16, min(self.mem_limit // est, 1 << 26))
 
     def _em_sort(self, shards: HostShards, sort_key, run_size: int,
                  W: int):
@@ -147,8 +173,11 @@ class SortNode(DIABase):
         from ...core.multiway_merge import multiway_merge_files
 
         owns_input = self.parents[0].node.state == "DISPOSED"
+        # spilled-run store keeps a quarter of the grant resident
+        # before evicting runs to disk
         pool = BlockPool(spill_dir=self.context.config.spill_dir,
-                         soft_limit=64 << 20)
+                         soft_limit=max((self.mem_limit or 256 << 20) // 4,
+                                        8 << 20))
         sampler = ReservoirSamplingGrow(np.random.default_rng(17))
         # items carry their stream position: the (key, position)
         # tiebreak makes the EM sort stable AND lets splitters cut

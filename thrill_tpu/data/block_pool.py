@@ -10,12 +10,9 @@ Falls back to a pure-Python dict store when no compiler is available.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import Dict, Optional
 
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_FAILED = False

@@ -55,7 +55,7 @@ def _load_native() -> Optional[ctypes.CDLL]:
             lib.disp_unregister.argtypes = [ctypes.c_void_p, ctypes.c_int]
             lib.disp_unregister.restype = ctypes.c_int
             lib.disp_async_write.argtypes = [
-                ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
                 ctypes.c_int64]
             lib.disp_async_write.restype = ctypes.c_int64
             lib.disp_async_read.argtypes = [
@@ -90,26 +90,68 @@ class _NativeDispatcher:
         if not self._h:
             raise OSError("disp_create failed")
         self._sizes: Dict[int, int] = {}   # read req id -> want bytes
+        # write buffers are BORROWED by the engine (zero-copy enqueue):
+        # pin the buffer objects here until the request is fetched.
+        # Callers must not mutate a pinned buffer before completion.
+        self._pins: Dict[int, tuple] = {}
+        self._by_fd: Dict[int, set] = {}   # fd -> outstanding req ids
+        self._lock = threading.Lock()
 
     def register(self, sock: socket.socket) -> None:
         if self._lib.disp_register(self._h, sock.fileno()) != 0:
             raise OSError("disp_register failed")
+        with self._lock:
+            self._by_fd.setdefault(sock.fileno(), set())
 
     def unregister(self, sock: socket.socket) -> None:
-        self._lib.disp_unregister(self._h, sock.fileno())
+        fd = sock.fileno()
+        # the engine retires every outstanding request with an error
+        # status before returning...
+        self._lib.disp_unregister(self._h, fd)
+        # ...then drain those completions so pins/sizes/native slots
+        # don't leak in the group-shared engine
+        with self._lock:
+            rids = self._by_fd.pop(fd, set())
+        for rid in rids:
+            self._lib.disp_fetch(self._h, rid, None, 0)
+            with self._lock:
+                self._pins.pop(rid, None)
+                self._sizes.pop(rid, None)
 
-    def async_write(self, sock: socket.socket, data: bytes) -> int:
-        rid = self._lib.disp_async_write(self._h, sock.fileno(), data,
-                                         len(data))
+    @staticmethod
+    def _pinnable(data):
+        """(address, length, pin_objects) for a contiguous read view of
+        ``data`` — zero-copy for bytes/memoryview/contiguous buffers."""
+        import numpy as np
+        mv = memoryview(data)
+        if not mv.contiguous:
+            mv = memoryview(bytes(mv))
+        mv = mv.cast("B")
+        if len(mv) == 0:
+            return 0, 0, (mv,)
+        arr = np.frombuffer(mv, dtype=np.uint8)
+        return int(arr.ctypes.data), len(mv), (mv, arr)
+
+    def async_write(self, sock: socket.socket, data) -> int:
+        addr, n, pins = self._pinnable(data)
+        fd = sock.fileno()
+        rid = self._lib.disp_async_write(self._h, fd,
+                                         ctypes.c_void_p(addr), n)
         if rid < 0:
             raise DispatcherError("async_write on unregistered/failed fd")
+        with self._lock:
+            self._pins[rid] = pins    # engine borrows; release at fetch
+            self._by_fd.setdefault(fd, set()).add(rid)
         return rid
 
     def async_read(self, sock: socket.socket, n: int) -> int:
-        rid = self._lib.disp_async_read(self._h, sock.fileno(), n)
+        fd = sock.fileno()
+        rid = self._lib.disp_async_read(self._h, fd, n)
         if rid < 0:
             raise DispatcherError("async_read on unregistered/failed fd")
-        self._sizes[rid] = n
+        with self._lock:
+            self._sizes[rid] = n
+            self._by_fd.setdefault(fd, set()).add(rid)
         return rid
 
     def poll(self, rid: int) -> int:
@@ -119,10 +161,23 @@ class _NativeDispatcher:
         return int(self._lib.disp_wait(
             self._h, rid, -1.0 if timeout is None else timeout))
 
+    _NOT_DONE = -(1 << 62)
+
     def fetch(self, rid: int) -> bytes:
-        cap = self._sizes.pop(rid, 0)
+        with self._lock:
+            cap = self._sizes.get(rid, 0)
         buf = ctypes.create_string_buffer(cap) if cap else None
         n = self._lib.disp_fetch(self._h, rid, buf, cap)
+        if n == self._NOT_DONE:
+            # still pending — the engine may still borrow the write
+            # buffer, so the pin MUST stay
+            raise DispatcherError(
+                f"async request {rid} fetched before completion")
+        with self._lock:
+            self._pins.pop(rid, None)  # request retired: unpin buffer
+            self._sizes.pop(rid, None)
+            for rids in self._by_fd.values():
+                rids.discard(rid)
         if n < 0:
             raise DispatcherError(
                 f"async request {rid} failed (status {n})")
@@ -148,6 +203,7 @@ class _PyDispatcher:
         self._reads: Dict[int, Deque[Tuple[int, int, bytearray]]] = {}
         self._socks: Dict[int, socket.socket] = {}
         self._done: Dict[int, Tuple[int, bytes]] = {}  # id -> (status, data)
+        self._fd_rids: Dict[int, set] = {}  # fd -> requests ever issued
         self._next_id = 1
         self._stop = False
         self._waker_r, self._waker_w = socket.socketpair()
@@ -176,10 +232,18 @@ class _PyDispatcher:
     def unregister(self, sock: socket.socket) -> None:
         with self._cv:
             fd = sock.fileno()
-            for rid, _ in self._writes.pop(fd, ()):
+            # queued requests complete with an error so waiters wake;
+            # completed-but-unfetched slots are dropped with the fd so
+            # nothing outlives the registration (no leak in a shared
+            # engine — mirrors the native wrapper's drain)
+            pending = ({rid for rid, _ in self._writes.get(fd, ())}
+                       | {rid for rid, _, _ in self._reads.get(fd, ())})
+            for rid in pending:
                 self._done[rid] = (-32, b"")
-            for rid, _, _ in self._reads.pop(fd, ()):
-                self._done[rid] = (-32, b"")
+            self._writes.pop(fd, None)
+            self._reads.pop(fd, None)
+            for rid in self._fd_rids.pop(fd, set()) - pending:
+                self._done.pop(rid, None)
             self._socks.pop(fd, None)
             try:
                 self._sel.unregister(sock)
@@ -189,13 +253,38 @@ class _PyDispatcher:
         sock.setblocking(True)
 
     def async_write(self, sock: socket.socket, data: bytes) -> int:
-        with self._lock:
+        with self._cv:
             fd = sock.fileno()
             if fd not in self._writes:
                 raise DispatcherError("async_write on unregistered fd")
             rid = self._next_id
             self._next_id += 1
-            self._writes[fd].append((rid, memoryview(bytes(data))))
+            self._fd_rids.setdefault(fd, set()).add(rid)
+            mv = memoryview(data)          # zero-copy for bytes/views
+            if not mv.contiguous:
+                mv = memoryview(bytes(mv))
+            mv = mv.cast("B")
+            if not self._writes[fd]:
+                # opportunistic inline send while the queue is empty
+                # (FIFO-safe); the attempt cap bounds enqueue latency —
+                # only the unsent tail rides the loop
+                for _ in range(4):
+                    if not len(mv):
+                        break
+                    try:
+                        n = sock.send(mv)
+                    except (BlockingIOError, InterruptedError):
+                        break
+                    except OSError:
+                        self._done[rid] = (-32, b"")
+                        self._fail_fd(fd, -32)
+                        return rid
+                    mv = mv[n:]
+                if not len(mv):
+                    self._done[rid] = (1, b"")
+                    self._cv.notify_all()
+                    return rid
+            self._writes[fd].append((rid, mv))
             self._update(fd)
         self._wake()
         return rid
@@ -207,6 +296,7 @@ class _PyDispatcher:
                 raise DispatcherError("async_read on unregistered fd")
             rid = self._next_id
             self._next_id += 1
+            self._fd_rids.setdefault(fd, set()).add(rid)
             if n == 0 and not self._reads[fd]:
                 # zero-byte read with nothing queued ahead completes
                 # right away (select never fires for it)
@@ -235,7 +325,16 @@ class _PyDispatcher:
 
     def fetch(self, rid: int) -> bytes:
         with self._lock:
-            status, data = self._done.pop(rid, (-1, b""))
+            entry = self._done.pop(rid, None)
+            if entry is not None:
+                for rids in self._fd_rids.values():
+                    rids.discard(rid)
+        if entry is None:
+            # still pending (or already drained) — match the native
+            # engine's kNotDone semantics, keep state untouched
+            raise DispatcherError(
+                f"async request {rid} fetched before completion")
+        status, data = entry
         if status < 0:
             raise DispatcherError(
                 f"async request {rid} failed (status {status})")

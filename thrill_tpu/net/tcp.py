@@ -52,10 +52,22 @@ class TcpConnection(Connection):
         self._recv_seq = 0
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()
-        # async engine (net/dispatcher.py); None = blocking socket ops
+        # async engine (net/dispatcher.py); None = blocking socket ops.
+        # Small control frames stay on the blocking fast path (the
+        # reference's flow group is synchronous too); the engine
+        # attaches lazily on the first frame >= _async_threshold bytes
+        # when a supplier is set (data-plane overlap + symmetric
+        # large-message deadlock safety), and owns the fd from then on.
         self._disp = None
         self._disp_inflight: "deque" = None
         self._max_inflight = 64
+        self._disp_supplier = None
+        self._async_threshold = _async_threshold()
+
+    def set_dispatcher_supplier(self, supplier) -> None:
+        """Enable lazy attach: ``supplier()`` returns the shared engine
+        the first time a large frame needs it."""
+        self._disp_supplier = supplier
 
     def attach_dispatcher(self, disp, max_inflight: int = 64) -> None:
         """Route all traffic through the async engine from now on:
@@ -63,12 +75,17 @@ class TcpConnection(Connection):
         send-semaphore analog), receives complete on the dispatcher
         thread. Must be called between messages (e.g. right after
         bootstrap), never mid-frame."""
-        from collections import deque
         with self._send_lock, self._recv_lock:
-            disp.register(self.sock)
-            self._disp = disp
-            self._disp_inflight = deque()
-            self._max_inflight = max_inflight
+            if self._disp is not None:     # already attached
+                return
+            self._attach_locked(disp, max_inflight)
+
+    def _attach_locked(self, disp, max_inflight: int = 64) -> None:
+        disp.register(self.sock)
+        self._disp = disp
+        from collections import deque
+        self._disp_inflight = deque()
+        self._max_inflight = max_inflight
 
     def _reap_sends(self, block: bool) -> None:
         """Caller holds _send_lock. Retire completed async sends; when
@@ -95,21 +112,48 @@ class TcpConnection(Connection):
                 self._disp.fetch(rid)
 
     def send(self, obj: Any) -> None:
-        payload = wire.dumps(obj, allow_pickle=self.authenticated)
-        msg = struct.pack("<I", len(payload)) + payload
+        # scatter-gather framing: large payloads (bytes/ndarray) are
+        # borrowed views, never copied into one contiguous frame
+        parts = wire.dumps_parts(obj, allow_pickle=self.authenticated)
+        total = sum(len(p) for p in parts)
+        bufs = [struct.pack("<I", total), *parts]
         with self._send_lock:
             if self._session_key is not None:
                 # per-frame MAC: the handshake alone does not protect
                 # the stream from on-path frame injection
-                msg += wire.frame_mac(self._session_key, self._send_dir,
-                                      self._send_seq, payload)
+                bufs.append(wire.frame_mac_parts(
+                    self._session_key, self._send_dir, self._send_seq,
+                    parts))
                 self._send_seq += 1
+            if (self._disp is None and self._disp_supplier is not None
+                    and total >= self._async_threshold):
+                # first bulk frame: hand the fd to the async engine.
+                # recv must agree, so take the recv lock too (safe:
+                # recv never holds the send lock)
+                with self._recv_lock:
+                    self._attach_locked(self._disp_supplier())
             if self._disp is not None:
                 self._reap_sends(block=True)
-                self._disp_inflight.append(
-                    self._disp.async_write(self.sock, msg))
+                for b in bufs:
+                    self._disp_inflight.append(
+                        self._disp.async_write(self.sock, b))
             else:
-                self.sock.sendall(msg)
+                self._sendall_parts(bufs)
+
+    def _sendall_parts(self, bufs) -> None:
+        """sendmsg-based sendall over a list of buffers (zero-copy
+        scatter-gather; handles partial sends)."""
+        mvs = [memoryview(b).cast("B") for b in bufs]
+        while mvs:
+            try:
+                n = self.sock.sendmsg(mvs)
+            except InterruptedError:
+                continue
+            while mvs and n >= len(mvs[0]):
+                n -= len(mvs[0])
+                mvs.pop(0)
+            if mvs and n:
+                mvs[0] = mvs[0][n:]
 
     def recv(self) -> Any:
         with self._recv_lock:
@@ -182,22 +226,48 @@ class TcpGroup(Group):
         super().__init__(my_rank, num_hosts)
         self._conns = conns
         self._disp = None
+        self._disp_owned = False
+        self._disp_lock = threading.Lock()
 
     def connection(self, peer: int) -> TcpConnection:
         if peer == self.my_rank:
             raise ValueError("no connection to self")
         return self._conns[peer]
 
+    def _shared_dispatcher(self):
+        """One async engine per group, created on first bulk frame (a
+        dedicated DispatcherThread per host, reference:
+        thrill/net/dispatcher_thread.hpp:60)."""
+        with self._disp_lock:
+            if self._disp is None:
+                from .dispatcher import Dispatcher
+                self._disp = Dispatcher()
+                self._disp_owned = True
+            return self._disp
+
+    def enable_lazy_async(self) -> None:
+        """Connections keep the blocking fast path for control frames
+        and hand their fd to the shared engine on the first frame past
+        the async threshold — bulk fan-out overlaps, symmetric large
+        exchanges cannot deadlock on kernel buffers, and small-message
+        latency is untouched."""
+        for c in self._conns.values():
+            c.set_dispatcher_supplier(self._shared_dispatcher)
+
     def attach_dispatcher(self, disp=None) -> None:
-        """Drive every connection through one async engine (a dedicated
-        DispatcherThread per host, reference:
-        thrill/net/dispatcher_thread.hpp:60) — fan-out sends to many
-        peers then progress concurrently instead of serializing on
-        sendall. The group owns the engine and closes it."""
+        """Eagerly drive EVERY frame through one async engine (used by
+        tests and latency-insensitive bulk phases). A caller-provided
+        engine stays caller-owned (close() will not close it); an
+        engine this group created itself is closed when replaced."""
         if disp is None:
-            from .dispatcher import Dispatcher
-            disp = Dispatcher()
-        self._disp = disp
+            disp = self._shared_dispatcher()
+        else:
+            with self._disp_lock:
+                if self._disp is not None and self._disp is not disp \
+                        and self._disp_owned:
+                    self._disp.close()
+                self._disp = disp
+                self._disp_owned = False
         for c in self._conns.values():
             c.attach_dispatcher(disp)
 
@@ -208,9 +278,21 @@ class TcpGroup(Group):
     def close(self) -> None:
         for c in self._conns.values():
             c.close()
-        if self._disp is not None:
+        if self._disp is not None and self._disp_owned:
             self._disp.close()
-            self._disp = None
+        self._disp = None
+
+
+def _async_threshold() -> int:
+    """Frame size at which a connection hands its fd to the async
+    engine (small control frames keep the blocking fast path — the
+    reference's flow group is synchronous, only bulk streams ride the
+    Dispatcher)."""
+    try:
+        return int(os.environ.get("THRILL_TPU_ASYNC_THRESHOLD",
+                                  str(1 << 18)))
+    except ValueError:
+        return 1 << 18
 
 
 def _exchange_auth_flag(conn: TcpConnection, have_secret: bool) -> None:
@@ -333,11 +415,12 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
         raise errors[0]
     assert len(conns) == p - 1
     group = TcpGroup(rank, p, conns)
-    # async engine on by default: collectives' fan-out sends overlap
-    # (reference always runs its Dispatcher; THRILL_TPU_ASYNC_NET=0
-    # falls back to blocking sockets)
+    # lazy async engine on by default: control frames stay blocking
+    # (fast path), bulk frames ride the dispatcher
+    # (THRILL_TPU_ASYNC_NET=0 disables; THRILL_TPU_ASYNC_THRESHOLD
+    # tunes the cutover)
     if os.environ.get("THRILL_TPU_ASYNC_NET", "1") != "0":
-        group.attach_dispatcher()
+        group.enable_lazy_async()
     return group
 
 

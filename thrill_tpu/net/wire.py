@@ -121,6 +121,36 @@ def dumps(obj: Any, allow_pickle: bool = False) -> bytes:
     return buf.getvalue()
 
 
+# payloads at least this large take the zero-copy parts path
+_BIG_PAYLOAD = 1 << 16
+
+
+def dumps_parts(obj: Any, allow_pickle: bool = False) -> list:
+    """Encode to a LIST of buffers whose concatenation equals
+    ``dumps(obj)``. Large ``bytes`` and numpy-array payloads are
+    returned as borrowed views instead of being copied into one
+    contiguous buffer — senders with scatter-gather I/O (sendmsg, the
+    async engine's per-buffer writes) skip the O(size) framing copies
+    entirely."""
+    if type(obj) is bytes and len(obj) >= _BIG_PAYLOAD:
+        head = io.BytesIO()
+        head.write(_T_BYTES)
+        _w_len(head, len(obj))
+        return [head.getvalue(), obj]
+    if (isinstance(obj, np.ndarray) and obj.dtype.hasobject is False
+            and obj.nbytes >= _BIG_PAYLOAD):
+        a = np.ascontiguousarray(obj)
+        head = io.BytesIO()
+        head.write(_T_NDARRAY)
+        _w_bytes(head, a.dtype.str.encode())
+        _w_len(head, a.ndim)
+        for d in a.shape:
+            _w_len(head, d)
+        _w_len(head, a.nbytes)
+        return [head.getvalue(), a.data.cast("B")]
+    return [dumps(obj, allow_pickle)]
+
+
 class _Reader:
     def __init__(self, data: bytes) -> None:
         self.data = data
@@ -262,7 +292,15 @@ def frame_mac(session_key: bytes, direction: bytes, seq: int,
               payload: bytes) -> bytes:
     """Per-frame MAC: binds session key, direction and sequence number
     (anti-injection + anti-replay + anti-reorder)."""
+    return frame_mac_parts(session_key, direction, seq, [payload])
+
+
+def frame_mac_parts(session_key: bytes, direction: bytes, seq: int,
+                    parts) -> bytes:
+    """``frame_mac`` over a payload given as buffer parts (the
+    scatter-gather send path) — hmac streams, no concatenation."""
     h = hmac.new(session_key, direction + seq.to_bytes(8, "little"),
                  "sha256")
-    h.update(payload)
+    for p in parts:
+        h.update(p)
     return h.digest()[:_MAC_LEN]
