@@ -90,15 +90,21 @@ def main():
     print(f"RESULT step=gather_10b_u8 time_ms={dt*1000:.1f}", flush=True)
 
     # 5. fused whole program (encode + sort + both gathers), like the
-    #    W=1 Sort program
+    #    W=1 Sort program — A/B over the packed-movement flag
+    from thrill_tpu.core.rowmove import take_rows
+
     def fused(k, v):
         words = keymod.encode_key_words(k)
         perm = argsort_words(list(words))
-        return jnp.take(k, perm, axis=0), jnp.take(v, perm, axis=0)
-    f_all = jax.jit(fused)
-    dt = timeit(lambda: f_all(keys_d, vals_d))
-    print(f"RESULT step=fused_sort_gather time_ms={dt*1000:.1f} "
-          f"mrec_s={n/dt/1e6:.2f}", flush=True)
+        return take_rows(k, perm), take_rows(v, perm)
+
+    for mode in ("1", "0"):
+        os.environ["THRILL_TPU_PACK_MOVE"] = mode
+        f_all = jax.jit(lambda k, v: fused(k, v))  # fresh trace per mode
+        dt = timeit(lambda: f_all(keys_d, vals_d))
+        print(f"RESULT step=fused_sort_gather pack={mode} "
+              f"time_ms={dt*1000:.1f} mrec_s={n/dt/1e6:.2f}", flush=True)
+    os.environ.pop("THRILL_TPU_PACK_MOVE", None)
 
     # 6. per-dispatch overhead through the tunnel (tiny program)
     f_tiny = jax.jit(lambda x: x + 1)

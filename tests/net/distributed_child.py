@@ -43,8 +43,9 @@ def job(ctx):
 
 def main():
     coordinator, rank = sys.argv[1], int(sys.argv[2])
+    nproc = int(sys.argv[3]) if len(sys.argv) > 3 else 2
     res = RunDistributed(job, coordinator_address=coordinator,
-                         num_processes=2, process_id=rank)
+                         num_processes=nproc, process_id=rank)
     print("RESULT " + json.dumps(res), flush=True)
 
 

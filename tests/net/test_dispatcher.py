@@ -275,6 +275,27 @@ def test_tcp_group_async_collectives():
         assert ps == r + 1
 
 
+def test_symmetric_subthreshold_storm_no_deadlock():
+    """Frames below the async threshold stay on the blocking fast path
+    — but a stalled blocking send (both sides sending, nobody
+    receiving, kernel buffers full) must escape to the engine instead
+    of deadlocking."""
+    from tests.net.test_tcp import run_tcp
+
+    blob = b"s" * (200 << 10)           # < 256 KiB threshold
+    rounds = 40                          # ~8 MB each way, >> buffers
+
+    def job(g):
+        peer = 1 - g.my_rank
+        for _ in range(rounds):
+            g.send_to(peer, blob)
+        got = [g.recv_from(peer) for _ in range(rounds)]
+        assert all(len(x) == len(blob) for x in got)
+        return True
+
+    assert run_tcp(2, job) == [True, True]
+
+
 def test_tcp_group_async_large_symmetric():
     """Symmetric hypercube exchange of ~4 MB values: with blocking
     sends both sides of a pair can deadlock on full kernel buffers;

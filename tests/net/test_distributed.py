@@ -33,12 +33,16 @@ def _free_ports(n):
 CHILD = os.path.join(os.path.dirname(__file__), "distributed_child.py")
 
 
-def test_two_process_wordcount_agrees():
-    coord_port, tcp0, tcp1 = _free_ports(3)
+@pytest.mark.parametrize("nproc", [2, 3])
+def test_multi_process_wordcount_agrees(nproc):
+    """The reference sweeps real process counts (mpirun -np {1,2,3,7});
+    sweep {2,3} controllers here, 2 CPU devices each."""
+    ports = _free_ports(1 + nproc)
+    coord_port, tcp_ports = ports[0], ports[1:]
     coordinator = f"127.0.0.1:{coord_port}"
-    hostlist = f"127.0.0.1:{tcp0} 127.0.0.1:{tcp1}"
+    hostlist = " ".join(f"127.0.0.1:{p}" for p in tcp_ports)
     procs = []
-    for rank in range(2):
+    for rank in range(nproc):
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)
         repo_root = os.path.dirname(os.path.dirname(
@@ -51,7 +55,7 @@ def test_two_process_wordcount_agrees():
             "THRILL_TPU_SECRET": "test-cluster-secret",
         })
         procs.append(subprocess.Popen(
-            [sys.executable, CHILD, coordinator, str(rank)],
+            [sys.executable, CHILD, coordinator, str(rank), str(nproc)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, env=env))
     outs = []
@@ -71,15 +75,16 @@ def test_two_process_wordcount_agrees():
         assert lines, f"no RESULT line:\n{out}\n{err[-2000:]}"
         results.append(json.loads(lines[-1][len("RESULT "):]))
 
-    r0, r1 = results
-    # both controllers computed the identical logical result
-    assert r0 == r1
+    r0 = results[0]
+    # every controller computed the identical logical result
+    for r in results[1:]:
+        assert r == r0
     # and it is the correct one
     assert r0["pairs"] == [[i, 100] for i in range(10)]
     assert r0["total"] == 999 * 1000 // 2
-    # host control plane saw both controllers and they agreed
-    assert r0["net_workers"] == 2
-    assert r0["totals"] == [r0["total"], r0["total"]]
-    # the device mesh spanned both processes (2 devices each)
-    assert r0["mesh_workers"] == 4
-    assert r0["hosts"] == 2
+    # host control plane saw all controllers and they agreed
+    assert r0["net_workers"] == nproc
+    assert r0["totals"] == [r0["total"]] * nproc
+    # the device mesh spanned all processes (2 devices each)
+    assert r0["mesh_workers"] == 2 * nproc
+    assert r0["hosts"] == nproc

@@ -270,8 +270,8 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
                     sort_words = ([(~valid).astype(jnp.uint32)]
                                   + list(words) + [iota])
                 perm = argsort_words(sort_words)
-                return tuple(jnp.take(l[0], perm, axis=0)[None]
-                             for l in ls)
+                from ...core.rowmove import take_rows
+                return tuple(take_rows(l[0], perm)[None] for l in ls)
 
             return mex.smap(f, 1 + len(leaves))
 
@@ -356,7 +356,8 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
             dest = jnp.where(valid, d, W)
             all_send = exchange.send_counts(dest, W)
             # the ONE payload gather of this phase
-            sorted_ls = [jnp.take(l[0], p, axis=0) for l in ls]
+            from ...core.rowmove import take_rows
+            sorted_ls = [take_rows(l[0], p) for l in ls]
             return (dest[None], all_send,
                     *[sl[None] for sl in sorted_ls])
 
@@ -412,7 +413,8 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
             perm = argsort_words([invalid_word] + words
                                  + [gi.astype(jnp.uint64)])
             # the ONE payload gather of this phase
-            out_leaves = [jnp.take(l, perm, axis=0)
+            from ...core.rowmove import take_rows
+            out_leaves = [take_rows(l, perm)
                           for l in jax.tree.leaves(tree["tree"])]
             return tuple(l[None] for l in out_leaves)
 
@@ -465,6 +467,7 @@ def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
 
     def build():
         def f(sdest, srow, scol, wm_a, gi_a, *ls):
+            from ...core import rowmove
             d = sdest[0]
             S_row = srow[0]
             S_col = scol[0]
@@ -475,7 +478,14 @@ def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
 
             wm_r = ship(wm_a[0])                  # [W*M_pad, nwords]
             gi_r = ship(gi_a[0])                  # [W*M_pad]
-            payload_r = [ship(l[0]) for l in ls]
+            # payload rides the exchange AND the final gather as packed
+            # u32 words; unpacked only at the very end
+            if rowmove.enabled():
+                payload_p, pmetas = rowmove.pack_leaves(
+                    [l[0] for l in ls])
+            else:
+                payload_p, pmetas = [l[0] for l in ls], [None] * len(ls)
+            payload_r = [ship(p) for p in payload_p]
 
             j = jnp.arange(M_pad)[None, :]
             valid = (j < S_col[:, None]).reshape(-1)   # [W*M_pad]
@@ -511,8 +521,9 @@ def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
             # the ONE payload gather of this phase (clip: slots past the
             # valid total may point at synthetic pad rows)
             perm = jnp.minimum(perm, W * M_pad - 1)
-            return tuple(jnp.take(p, perm, axis=0)[None]
-                         for p in payload_r)
+            return tuple(
+                rowmove.unpack_rows(jnp.take(p, perm, axis=0), m)[None]
+                for p, m in zip(payload_r, pmetas))
 
         return mex.smap(f, 5 + len(sorted_payload))
 

@@ -1,0 +1,99 @@
+"""Packed row movement: u32 word views for sub-word payload columns.
+
+TPU VPU lanes are 32-bit; a gather/scatter of a [n, 90] uint8 payload
+column moves 90 sub-word elements per row where 23 u32 words would do.
+Every bulk row movement (sort payload gathers, exchange scatters +
+all_to_all) can therefore run on a bitcast u32 view: pad the trailing
+axis to a 4-byte multiple, bitcast to uint32, move, bitcast back,
+slice. Pack and unpack live INSIDE the same jitted program as the
+movement, so the layout is never observable outside and endianness is
+self-consistent by construction.
+
+Gate: THRILL_TPU_PACK_MOVE = auto (default: on for accelerator
+backends, off on CPU) | 1 | 0. The helpers are no-ops for leaves where
+packing cannot help (4-byte+ dtypes, tiny rows, 1-D sub-word columns).
+
+Reference analog: the block layer moves opaque byte ranges, not typed
+items (thrill/data/block.hpp:52) — this is the columnar, static-shape
+translation of that idea.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def enabled() -> bool:
+    mode = os.environ.get("THRILL_TPU_PACK_MOVE", "auto")
+    if mode in ("0", "false"):
+        return False
+    if mode == "auto":
+        return jax.default_backend() != "cpu"
+    return True
+
+
+def _packable(x) -> bool:
+    isz = jnp.dtype(x.dtype).itemsize
+    if isz >= 4 or x.ndim < 2:
+        return False
+    row_elems = 1
+    for d in x.shape[1:]:
+        row_elems *= d
+    return row_elems * isz >= 8      # tiny rows: packing buys nothing
+
+
+def pack_rows(x):
+    """[n, ...] sub-word leaf -> ([n, w] uint32 view, meta). Leaves that
+    cannot profit pass through with meta=None."""
+    if not _packable(x):
+        return x, None
+    n = x.shape[0]
+    isz = jnp.dtype(x.dtype).itemsize
+    flat = x.reshape(n, -1)
+    k = flat.shape[1]
+    per = 4 // isz                   # elements per u32 word
+    pad = (-k) % per
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    words = lax.bitcast_convert_type(
+        flat.reshape(n, (k + pad) // per, per), jnp.uint32)
+    return words, (x.dtype, x.shape[1:], k, per)
+
+
+def unpack_rows(words, meta):
+    """Inverse of pack_rows on the moved words."""
+    if meta is None:
+        return words
+    dtype, trail_shape, k, per = meta
+    n = words.shape[0]
+    flat = lax.bitcast_convert_type(words, dtype)   # [n, w, per]
+    flat = flat.reshape(n, -1)[:, :k]
+    return flat.reshape((n,) + tuple(trail_shape))
+
+
+def pack_leaves(leaves: List):
+    """Pack every leaf; returns (packed_leaves, metas)."""
+    packed, metas = [], []
+    for l in leaves:
+        p, m = pack_rows(l)
+        packed.append(p)
+        metas.append(m)
+    return packed, metas
+
+
+def unpack_leaves(packed: List, metas: List):
+    return [unpack_rows(p, m) for p, m in zip(packed, metas)]
+
+
+def take_rows(x, perm):
+    """jnp.take(x, perm, axis=0) through the packed view when enabled
+    and profitable — the drop-in gather for payload columns."""
+    if not enabled():
+        return jnp.take(x, perm, axis=0)
+    words, meta = pack_rows(x)
+    return unpack_rows(jnp.take(words, perm, axis=0), meta)

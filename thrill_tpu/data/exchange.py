@@ -125,9 +125,10 @@ def _phase_a(shards: DeviceShards, dest_builder: Callable,
             dest = dest_builder(tree, mask, widx).astype(jnp.int32)
             dest = jnp.where(mask, jnp.clip(dest, 0, W - 1), W)
             from ..core.device_sort import argsort_words
+            from ..core.rowmove import take_rows
             perm = argsort_words([dest.astype(jnp.uint64)])
             sorted_dest = jnp.take(dest, perm)
-            sorted_ls = [jnp.take(l[0], perm, axis=0) for l in ls]
+            sorted_ls = [take_rows(l[0], perm) for l in ls]
             # replicate the [W, W] send-count matrix: every process can
             # then fetch it locally (multi-controller safe host step)
             all_send = send_counts(sorted_dest, W)
@@ -402,6 +403,7 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
 
     def build_b():
         def fb(sdest, srow, scol, *ls):
+            from ..core import rowmove
             d = sdest[0]                          # [cap] dest-sorted
             S_row = srow[0]                       # my send counts [W]
             S_col = scol[0]                       # my recv counts by src [W]
@@ -411,13 +413,16 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
             rc_valid = j < S_col[:, None]
             out_idx = jnp.where(rc_valid, roff[:, None] + j, out_cap)
 
+            pack = rowmove.enabled()
             outs = []
             for l in ls:
-                x = l[0]                          # [cap, ...]
+                # scatter + all_to_all + compaction all run on the
+                # packed u32 view of sub-word payload columns
+                x, m = rowmove.pack_rows(l[0]) if pack else (l[0], None)
                 recv = ship_blocks(x, send_idx, W, M_pad)
                 out = jnp.zeros((out_cap + 1,) + x.shape[1:], x.dtype)
                 out = out.at[out_idx.reshape(-1)].set(recv)
-                outs.append(out[:out_cap][None])
+                outs.append(rowmove.unpack_rows(out[:out_cap], m)[None])
             return tuple(outs)
 
         return mex.smap(fb, 3 + len(sorted_leaves))
@@ -462,6 +467,7 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
 
     def build_b():
         def fb(sdest, srow, scol, *ls):
+            from ..core import rowmove
             d = sdest[0]
             S_row = srow[0]
             S_col = scol[0]
@@ -469,7 +475,10 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
             roff = _ex_cumsum(S_col)
             i = jnp.arange(cap)
             widx = lax.axis_index(AXIS)
-            xs = [l[0] for l in ls]
+            if rowmove.enabled():
+                xs, metas = rowmove.pack_leaves([l[0] for l in ls])
+            else:
+                xs, metas = [l[0] for l in ls], [None] * len(ls)
             outs = [jnp.zeros((out_cap + 1,) + x.shape[1:], x.dtype)
                     for x in xs]
             # identity round: local scatter, no communication
@@ -496,7 +505,9 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                     buf = buf.at[send_idx].set(x)[:M_r]
                     recv = lax.ppermute(buf, AXIS, perm=perm)
                     outs[li] = outs[li].at[pos].set(recv)
-            return tuple(o[:out_cap][None] for o in outs)
+            return tuple(
+                rowmove.unpack_rows(o[:out_cap], m)[None]
+                for o, m in zip(outs, metas))
 
         return mex.smap(fb, 3 + len(sorted_leaves))
 
@@ -528,20 +539,22 @@ def _exchange_ragged(mex: MeshExec, treedef, sorted_leaves, S: np.ndarray,
 
     def build():
         def f(srow, scol, olanding, *ls):
+            from ..core import rowmove
             S_row = srow[0].astype(jnp.int32)     # my sends by dest
             S_col = scol[0].astype(jnp.int32)     # my recvs by source
             in_off = _ex_cumsum(S_row)
             # where MY chunk lands inside each destination's buffer:
             # sources before me writing to that destination
             out_off = olanding[0].astype(jnp.int32)
+            pack = rowmove.enabled()
             outs = []
             for l in ls:
-                x = l[0]
+                x, m = rowmove.pack_rows(l[0]) if pack else (l[0], None)
                 out = jnp.zeros((out_cap,) + x.shape[1:], x.dtype)
                 res = lax.ragged_all_to_all(
                     x, out, in_off, S_row, out_off, S_col,
                     axis_name=AXIS)
-                outs.append(res[None])
+                outs.append(rowmove.unpack_rows(res, m)[None])
             return tuple(outs)
 
         return mex.smap(f, 3 + len(sorted_leaves))

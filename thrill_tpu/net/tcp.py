@@ -73,9 +73,12 @@ class TcpConnection(Connection):
         """Route all traffic through the async engine from now on:
         sends enqueue and return (bounded in-flight, the reference's
         send-semaphore analog), receives complete on the dispatcher
-        thread. Must be called between messages (e.g. right after
-        bootstrap), never mid-frame."""
-        with self._send_lock, self._recv_lock:
+        thread. Safe while a blocking recv is in progress on another
+        thread: the direct receive path tolerates the fd turning
+        non-blocking mid-frame (select loop), finishes its frame with
+        direct reads under _recv_lock, and the NEXT recv routes through
+        the engine."""
+        with self._send_lock:
             if self._disp is not None:     # already attached
                 return
             self._attach_locked(disp, max_inflight)
@@ -112,8 +115,12 @@ class TcpConnection(Connection):
                 self._disp.fetch(rid)
 
     def send(self, obj: Any) -> None:
-        # scatter-gather framing: large payloads (bytes/ndarray) are
-        # borrowed views, never copied into one contiguous frame
+        """Send one message. Large bytes/ndarray payloads are BORROWED
+        (zero-copy scatter-gather): on a dispatcher-attached connection
+        the buffer must not be mutated until the send completes —
+        ``flush()`` is the synchronization point. Collectives in
+        net/group.py never mutate sent values; callers reusing staging
+        arrays across rounds must flush between them."""
         parts = wire.dumps_parts(obj, allow_pickle=self.authenticated)
         total = sum(len(p) for p in parts)
         bufs = [struct.pack("<I", total), *parts]
@@ -127,11 +134,9 @@ class TcpConnection(Connection):
                 self._send_seq += 1
             if (self._disp is None and self._disp_supplier is not None
                     and total >= self._async_threshold):
-                # first bulk frame: hand the fd to the async engine.
-                # recv must agree, so take the recv lock too (safe:
-                # recv never holds the send lock)
-                with self._recv_lock:
-                    self._attach_locked(self._disp_supplier())
+                # first bulk frame: hand the fd to the async engine (no
+                # recv-lock handshake needed — see attach_dispatcher)
+                self._attach_locked(self._disp_supplier())
             if self._disp is not None:
                 self._reap_sends(block=True)
                 for b in bufs:
@@ -140,14 +145,37 @@ class TcpConnection(Connection):
             else:
                 self._sendall_parts(bufs)
 
+    # a blocking send making no progress for this long escapes to the
+    # async engine (symmetric small-frame exchanges that outgrow both
+    # kernel buffers cannot deadlock, whatever the frame size)
+    _BLOCKING_SEND_STALL_S = 2.0
+
     def _sendall_parts(self, bufs) -> None:
         """sendmsg-based sendall over a list of buffers (zero-copy
-        scatter-gather; handles partial sends)."""
+        scatter-gather; handles partial sends). Caller holds _send_lock.
+
+        With a dispatcher supplier configured, a stalled send (peer not
+        draining — e.g. both sides of a pairwise exchange sending
+        first) hands the unsent tail to the async engine instead of
+        blocking forever on kernel buffers."""
+        import select as _select
         mvs = [memoryview(b).cast("B") for b in bufs]
+        can_escape = self._disp_supplier is not None
         while mvs:
+            if can_escape:
+                r = _select.select([], [self.sock], [],
+                                   self._BLOCKING_SEND_STALL_S)[1]
+                if not r:
+                    # no progress possible: switch this connection to
+                    # the engine and enqueue the remaining tail
+                    self._attach_locked(self._disp_supplier())
+                    for mv in mvs:
+                        self._disp_inflight.append(
+                            self._disp.async_write(self.sock, mv))
+                    return
             try:
                 n = self.sock.sendmsg(mvs)
-            except InterruptedError:
+            except (BlockingIOError, InterruptedError):
                 continue
             while mvs and n >= len(mvs[0]):
                 n -= len(mvs[0])
@@ -168,7 +196,17 @@ class TcpConnection(Connection):
                 if not _hmac.compare_digest(mac, want):
                     raise wire.AuthError("wire: frame MAC mismatch")
                 self._recv_seq += 1
-            return wire.loads(payload, allow_pickle=self.authenticated)
+            obj = wire.loads(payload, allow_pickle=self.authenticated)
+        # opportunistic: drop pins of completed async sends (send/recv
+        # alternate in every collective, so retention stays bounded by
+        # one phase instead of lasting until the next send)
+        if self._disp is not None and self._send_lock.acquire(
+                blocking=False):
+            try:
+                self._reap_sends(block=False)
+            finally:
+                self._send_lock.release()
+        return obj
 
     def authenticate(self, secret: bytes, role: str) -> None:
         """Mutual role-bound HMAC challenge-response; raises on
@@ -188,9 +226,18 @@ class TcpConnection(Connection):
             rid = self._disp.async_read(self.sock, n)
             self._disp.wait(rid)
             return self._disp.fetch(rid)
+        import select as _select
         chunks = []
         while n > 0:
-            b = self.sock.recv(n)
+            try:
+                b = self.sock.recv(n)
+            except (BlockingIOError, InterruptedError):
+                # a concurrent dispatcher attach flipped the fd to
+                # non-blocking mid-frame; finish this frame with
+                # direct reads (we hold _recv_lock, so the engine has
+                # no read requests racing us)
+                _select.select([self.sock], [], [], 0.2)
+                continue
             if not b:
                 raise ConnectionError("peer closed connection")
             chunks.append(b)
@@ -263,9 +310,14 @@ class TcpGroup(Group):
             disp = self._shared_dispatcher()
         else:
             with self._disp_lock:
-                if self._disp is not None and self._disp is not disp \
-                        and self._disp_owned:
-                    self._disp.close()
+                if self._disp is not None and self._disp is not disp:
+                    # connections may already route through the active
+                    # engine; swapping under them would leave them on a
+                    # closed/foreign engine — make the misuse loud
+                    raise ValueError(
+                        "group already has an active dispatcher; "
+                        "attach before any bulk traffic or pass no "
+                        "engine to reuse the group's own")
                 self._disp = disp
                 self._disp_owned = False
         for c in self._conns.values():

@@ -153,7 +153,8 @@ class MeshExec:
         """
         import os
         key = key + (os.environ.get("THRILL_TPU_SORT_IMPL", "auto"),
-                     os.environ.get("THRILL_TPU_SORT_U32"))
+                     os.environ.get("THRILL_TPU_SORT_U32"),
+                     os.environ.get("THRILL_TPU_PACK_MOVE", "auto"))
         fn = self._cache.get(key)
         if fn is None:
             fn = builder()
