@@ -181,7 +181,11 @@ def _run_bench() -> None:
         inp.Keep()
         out = inp.Sort(key_fn=_key_fn)
         shards = out.node.materialize()
-        jax.block_until_ready(jax.tree.leaves(shards.tree))
+        leaves = jax.tree.leaves(shards.tree)
+        jax.block_until_ready(leaves)
+        # few-byte readback: forces completion even if the experimental
+        # backend's block_until_ready returns early (costs one RTT)
+        np.asarray(leaves[0][0, :1])
         return shards
 
     run_once()                      # warmup + compile

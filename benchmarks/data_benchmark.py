@@ -80,8 +80,38 @@ def bench_serializer(n=100, batch=10_000):
           f"throughput_mb_s={vol / dt:.1f}")
 
 
+def bench_file_scatter(n=1_000_000, parts=64):
+    """Zero-copy scatter vs item-level re-partitioning (the reference's
+    Stream::Scatter block re-slicing win, thrill/data/stream.hpp:77-210)."""
+    import numpy as np
+    from thrill_tpu.data.file import File
+
+    f = File(block_items=4096)
+    with f.writer() as w:
+        for i in range(0, n, 4096):
+            for row in np.arange(i, i + 4096, dtype=np.int64
+                                 ).reshape(-1, 1):
+                w.put(row)
+    offsets = [(p * n) // parts for p in range(parts + 1)]
+    t0 = time.perf_counter()
+    files = f.scatter(offsets)
+    dt_scatter = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    items = list(f.keep_reader())
+    lists = [items[offsets[p]:offsets[p + 1]] for p in range(parts)]
+    dt_items = time.perf_counter() - t0
+    assert sum(x.num_items for x in files) == sum(len(l) for l in lists)
+    print(f"RESULT bench=file_scatter items={n} parts={parts} "
+          f"scatter_ms={dt_scatter * 1000:.2f} "
+          f"item_repartition_ms={dt_items * 1000:.1f}")
+    for x in files:
+        x.close()
+    f.close()
+
+
 if __name__ == "__main__":
     bench_blockpool()
     bench_blockpool_spill()
     bench_file_items()
     bench_serializer()
+    bench_file_scatter()

@@ -17,12 +17,25 @@ import numpy as np  # noqa: E402
 
 
 def timeit(fn, iters=3, warmup=1):
+    """Time fn with block_until_ready AND a per-iteration readback of a
+    few result bytes. The axon backend is experimental; if block lies,
+    the fetch-inclusive number (minus one tunnel RTT, measured by the
+    dispatch_tiny/fetch_tiny steps) is the trustworthy one. Returns the
+    fetch-inclusive mean; prints nothing itself."""
     import jax
+    import numpy as _np
+
+    def _force(out):
+        out = jax.block_until_ready(out)
+        leaf = jax.tree.leaves(out)[0]
+        _np.asarray(leaf[:1])        # readback forces real completion
+        return out
+
     for _ in range(warmup):
-        jax.block_until_ready(fn())
+        _force(fn())
     t0 = time.perf_counter()
     for _ in range(iters):
-        jax.block_until_ready(fn())
+        _force(fn())
     return (time.perf_counter() - t0) / iters
 
 

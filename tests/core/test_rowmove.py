@@ -50,6 +50,7 @@ def test_pack_roundtrip_and_take(shape, dtype):
     ((64, 3), np.uint8),          # 3-byte rows: below profit threshold
     ((64, 4), np.float32),        # already word-sized
     ((64, 2), np.int64),
+    ((64, 16), np.bool_),         # bitcast rejects bool: must pass through
 ])
 def test_pack_passthrough(shape, dtype):
     x = jnp.zeros(shape, dtype)

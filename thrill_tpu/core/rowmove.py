@@ -38,8 +38,10 @@ def enabled() -> bool:
 
 
 def _packable(x) -> bool:
-    isz = jnp.dtype(x.dtype).itemsize
-    if isz >= 4 or x.ndim < 2:
+    dt = jnp.dtype(x.dtype)
+    isz = dt.itemsize
+    # bitcast_convert_type rejects bool (and complex never benefits)
+    if dt == jnp.bool_ or dt.kind == "c" or isz >= 4 or x.ndim < 2:
         return False
     row_elems = 1
     for d in x.shape[1:]:

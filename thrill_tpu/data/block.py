@@ -1,0 +1,62 @@
+"""Block: an item-range view of a shared, pooled byte block.
+
+Equivalent of the reference's Block over ByteBlock
+(reference: thrill/data/block.hpp:52 — a [begin, end) slice of a
+ref-counted byte buffer with item count and first-item offset, enabling
+zero-copy slicing and item-granular scatter; byte_block.hpp:51 for the
+shared buffer). Here the bytes live in the BlockPool (native C++ store
+with LRU disk spill) as one serialized batch; a Block names a slice
+[lo, hi) of that batch's items. Slicing adjusts the range and bumps the
+pool refcount — bytes are shared, never copied — and fixed-size record
+batches decode ONLY the sliced rows (serializer.deserialize_slice).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from .serializer import deserialize_slice
+
+
+class Block:
+    __slots__ = ("pool", "bid", "lo", "hi")
+
+    def __init__(self, pool, bid: int, lo: int, hi: int) -> None:
+        self.pool = pool
+        self.bid = bid
+        self.lo = lo
+        self.hi = hi
+
+    @property
+    def num_items(self) -> int:
+        return self.hi - self.lo
+
+    def items(self) -> List[Any]:
+        """Decode this Block's items (only the sliced rows for
+        fixed-size batches)."""
+        if self.hi == self.lo:
+            return []
+        return deserialize_slice(self.pool.get(self.bid), self.lo,
+                                 self.hi)
+
+    def item_at(self, i: int) -> Any:
+        return deserialize_slice(self.pool.get(self.bid),
+                                 self.lo + i, self.lo + i + 1)[0]
+
+    def slice(self, lo: int, hi: int) -> "Block":
+        """Zero-copy sub-range [lo, hi) relative to this Block; shares
+        the bytes (pool refcount, reference: PinnedBlock slicing)."""
+        if not 0 <= lo <= hi <= self.num_items:
+            raise IndexError((lo, hi, self.num_items))
+        self.pool.addref(self.bid)
+        return Block(self.pool, self.bid, self.lo + lo, self.lo + hi)
+
+    def share(self) -> "Block":
+        return self.slice(0, self.num_items)
+
+    def release(self) -> None:
+        """Give up this view; the pool frees the bytes with the last
+        reference."""
+        if self.bid >= 0:
+            self.pool.release(self.bid)
+            self.bid = -1

@@ -75,6 +75,8 @@ class BlockPool:
                  async_io: bool = True) -> None:
         self._lib = _load_native()
         self.native = self._lib is not None
+        self._refs: Dict[int, int] = {}   # shared-Block refcounts (>1)
+        self._ref_lock = threading.Lock()
         if self.native:
             self._h = self._lib.bs_create(spill_dir.encode(), soft_limit,
                                           1 if async_io else 0)
@@ -116,6 +118,24 @@ class BlockPool:
             self._lib.bs_drop(self._h, block_id)
         else:
             self._blocks.pop(block_id, None)
+
+    # -- sharing (reference: ByteBlock reference counting,
+    # thrill/data/byte_block.hpp:51 — Blocks are slices of shared
+    # ref-counted byte buffers; the last release frees the bytes) ------
+    def addref(self, block_id: int) -> None:
+        """Another Block now shares this byte block."""
+        with self._ref_lock:
+            self._refs[block_id] = self._refs.get(block_id, 1) + 1
+
+    def release(self, block_id: int) -> None:
+        """Drop one shared reference; frees the bytes at zero."""
+        with self._ref_lock:
+            n = self._refs.get(block_id, 1) - 1
+            if n > 0:
+                self._refs[block_id] = n
+                return
+            self._refs.pop(block_id, None)
+        self.drop(block_id)
 
     def flush(self) -> None:
         """Wait for every queued/in-flight spill write to complete."""

@@ -1,19 +1,25 @@
-"""File: an ordered spillable sequence of item blocks.
+"""File: an ordered spillable sequence of item Blocks.
 
 Equivalent of the reference's data::File + BlockWriter/BlockReader
 (reference: thrill/data/file.hpp:56, block_writer.hpp:53,
 block_reader.hpp:42): items are appended through a writer that fills
-fixed-budget blocks, blocks live in the BlockPool (C++ store with LRU
-disk spill), and keep/consume readers stream them back. Random access
-``get_item_at`` mirrors File::GetItemAt.
+fixed-budget blocks, bytes live in the BlockPool (C++ store with LRU
+disk spill), and keep/consume readers stream them back. Blocks are
+shared ref-counted views (data/block.py), so ``slice`` and ``scatter``
+carve item ranges ZERO-COPY — the reference's Stream::Scatter primitive
+(thrill/data/stream.hpp:77-210) that re-slices blocks without
+deserializing fixed-size items. Random access ``get_item_at`` mirrors
+File::GetItemAt via a cumulative-count bisect + single-row decode.
 """
 
 from __future__ import annotations
 
-from typing import Any, Iterator, List, Optional
+import bisect
+from typing import Any, Iterator, List, Optional, Sequence
 
+from .block import Block
 from .block_pool import BlockPool
-from .serializer import deserialize_batch, serialize_batch
+from .serializer import serialize_batch
 
 DEFAULT_BLOCK_ITEMS = 4096
 
@@ -24,48 +30,98 @@ class File:
         self.pool = pool or BlockPool()
         self._owns_pool = pool is None
         self.block_items = block_items
-        self.block_ids: List[int] = []
-        self.block_counts: List[int] = []
+        self.blocks: List[Block] = []
+
+    # legacy views (tests/introspection)
+    @property
+    def block_ids(self) -> List[int]:
+        return [b.bid for b in self.blocks]
+
+    @property
+    def block_counts(self) -> List[int]:
+        return [b.num_items for b in self.blocks]
 
     # -- writing --------------------------------------------------------
     def writer(self) -> "BlockWriter":
         return BlockWriter(self)
 
+    def append_block(self, block: Block) -> None:
+        """Adopt a Block view (takes ownership of one reference)."""
+        if block.num_items:
+            self.blocks.append(block)
+        else:
+            block.release()       # empty view: give the reference back
+
     @property
     def num_items(self) -> int:
-        return sum(self.block_counts)
+        return sum(b.num_items for b in self.blocks)
 
     # -- reading --------------------------------------------------------
     def keep_reader(self) -> Iterator[Any]:
         """Stream items without consuming the file
         (reference: KeepFileBlockSource, file.hpp:349)."""
-        for bid in self.block_ids:
-            for it in deserialize_batch(self.pool.get(bid)):
+        for b in self.blocks:
+            for it in b.items():
                 yield it
 
     def consume_reader(self) -> Iterator[Any]:
         """Stream items, dropping each block after it is read
         (reference: ConsumeFileBlockSource, file.hpp:414)."""
-        while self.block_ids:
-            bid = self.block_ids.pop(0)
-            self.block_counts.pop(0)
-            for it in deserialize_batch(self.pool.get(bid)):
+        while self.blocks:
+            b = self.blocks.pop(0)
+            for it in b.items():
                 yield it
-            self.pool.drop(bid)
+            b.release()
+
+    def _cumulative(self) -> List[int]:
+        out = [0]
+        for b in self.blocks:
+            out.append(out[-1] + b.num_items)
+        return out
 
     def get_item_at(self, index: int) -> Any:
-        """Random access (reference: File::GetItemAt)."""
-        for bid, cnt in zip(self.block_ids, self.block_counts):
-            if index < cnt:
-                return deserialize_batch(self.pool.get(bid))[index]
-            index -= cnt
-        raise IndexError(index)
+        """Random access (reference: File::GetItemAt) — bisect over
+        cumulative counts, decode exactly one row for fixed-size
+        batches."""
+        cum = self._cumulative()
+        if not 0 <= index < cum[-1]:
+            raise IndexError(index)
+        k = bisect.bisect_right(cum, index) - 1
+        return self.blocks[k].item_at(index - cum[k])
+
+    # -- zero-copy carving ---------------------------------------------
+    def slice(self, start: int, end: int) -> "File":
+        """New File over items [start, end), sharing every byte block
+        (reference: Block slicing, block.hpp:52)."""
+        cum = self._cumulative()
+        if not 0 <= start <= end <= cum[-1]:
+            raise IndexError((start, end, cum[-1]))
+        out = File(pool=self.pool, block_items=self.block_items)
+        if start == end:
+            return out
+        k = bisect.bisect_right(cum, start) - 1
+        pos = start
+        while pos < end:
+            b = self.blocks[k]
+            lo = pos - cum[k]
+            hi = min(end - cum[k], b.num_items)
+            out.append_block(b.slice(lo, hi))
+            pos = cum[k] + hi
+            k += 1
+        return out
+
+    def scatter(self, offsets: Sequence[int]) -> List["File"]:
+        """Split into len(offsets)-1 Files at the given item offsets —
+        the Stream::Scatter primitive (thrill/data/stream.hpp:77-210):
+        block-granular sharing, only edge blocks are sliced, no item is
+        deserialized."""
+        return [self.slice(offsets[i], offsets[i + 1])
+                for i in range(len(offsets) - 1)]
 
     def clear(self) -> None:
-        for bid in self.block_ids:
-            self.pool.drop(bid)
-        self.block_ids.clear()
-        self.block_counts.clear()
+        for b in self.blocks:
+            b.release()
+        self.blocks.clear()
 
     def close(self) -> None:
         self.clear()
@@ -88,8 +144,8 @@ class BlockWriter:
             return
         payload = serialize_batch(self._buf)
         bid = self.file.pool.put(payload)
-        self.file.block_ids.append(bid)
-        self.file.block_counts.append(len(self._buf))
+        self.file.blocks.append(Block(self.file.pool, bid, 0,
+                                      len(self._buf)))
         self._buf = []
 
     def close(self) -> None:

@@ -40,3 +40,24 @@ def deserialize_batch(data: bytes) -> List[Any]:
             shape_or_n)
         return list(arr)
     return pickle.loads(payload)
+
+
+def deserialize_slice(data: bytes, lo: int, hi: int) -> List[Any]:
+    """Decode only items [lo, hi) of a batch payload.
+
+    Fixed-size records (the RAW path) decode exactly the requested
+    rows by byte arithmetic — the analog of the reference's
+    ``is_fixed_size`` scatter fast path (thrill/data/serialization.hpp,
+    stream.hpp:77-210: Blocks are re-sliced without deserializing).
+    Variable items (pickle) must decode the whole batch first."""
+    (hlen,) = struct.unpack_from("<I", data, 0)
+    kind, dstr, shape_or_n = pickle.loads(data[4:4 + hlen])
+    if kind == _RAW:
+        dt = np.dtype(dstr)
+        row_shape = tuple(shape_or_n[1:])
+        row_bytes = dt.itemsize * int(np.prod(row_shape, dtype=np.int64))
+        base = 4 + hlen + lo * row_bytes
+        arr = np.frombuffer(data, dtype=dt, count=(hi - lo) *
+                            (row_bytes // dt.itemsize), offset=base)
+        return list(arr.reshape((hi - lo,) + row_shape))
+    return pickle.loads(data[4 + hlen:])[lo:hi]

@@ -63,6 +63,9 @@ class TcpConnection(Connection):
         self._max_inflight = 64
         self._disp_supplier = None
         self._async_threshold = _async_threshold()
+        # async send failure observed outside send() (e.g. during the
+        # opportunistic reap in recv); surfaced at the next send/flush
+        self._send_error = None
 
     def set_dispatcher_supplier(self, supplier) -> None:
         """Enable lazy attach: ``supplier()`` returns the shared engine
@@ -108,6 +111,9 @@ class TcpConnection(Connection):
         if self._disp is None:
             return
         with self._send_lock:
+            if self._send_error is not None:
+                e, self._send_error = self._send_error, None
+                raise e
             q = self._disp_inflight
             while q:
                 rid = q.popleft()
@@ -125,6 +131,9 @@ class TcpConnection(Connection):
         total = sum(len(p) for p in parts)
         bufs = [struct.pack("<I", total), *parts]
         with self._send_lock:
+            if self._send_error is not None:
+                e, self._send_error = self._send_error, None
+                raise e
             if self._session_key is not None:
                 # per-frame MAC: the handshake alone does not protect
                 # the stream from on-path frame injection
@@ -167,11 +176,15 @@ class TcpConnection(Connection):
                                    self._BLOCKING_SEND_STALL_S)[1]
                 if not r:
                     # no progress possible: switch this connection to
-                    # the engine and enqueue the remaining tail
+                    # the engine and enqueue the remaining tail. The
+                    # tail is COPIED — this frame was sent under
+                    # blocking semantics, so the caller may reuse its
+                    # buffer the moment send() returns (and blocking
+                    # here for the drain could deadlock symmetrically)
                     self._attach_locked(self._disp_supplier())
                     for mv in mvs:
                         self._disp_inflight.append(
-                            self._disp.async_write(self.sock, mv))
+                            self._disp.async_write(self.sock, bytes(mv)))
                     return
             try:
                 n = self.sock.sendmsg(mvs)
@@ -199,11 +212,15 @@ class TcpConnection(Connection):
             obj = wire.loads(payload, allow_pickle=self.authenticated)
         # opportunistic: drop pins of completed async sends (send/recv
         # alternate in every collective, so retention stays bounded by
-        # one phase instead of lasting until the next send)
+        # one phase instead of lasting until the next send). A send-
+        # side failure discovered here must NOT discard the received
+        # message — defer it to the next send()/flush()
         if self._disp is not None and self._send_lock.acquire(
                 blocking=False):
             try:
                 self._reap_sends(block=False)
+            except ConnectionError as e:
+                self._send_error = e
             finally:
                 self._send_lock.release()
         return obj
@@ -304,8 +321,10 @@ class TcpGroup(Group):
     def attach_dispatcher(self, disp=None) -> None:
         """Eagerly drive EVERY frame through one async engine (used by
         tests and latency-insensitive bulk phases). A caller-provided
-        engine stays caller-owned (close() will not close it); an
-        engine this group created itself is closed when replaced."""
+        engine stays caller-owned (close() will not close it). Once any
+        engine is active for this group it cannot be replaced — attach
+        before any bulk traffic, or pass no engine to reuse the
+        group's own."""
         if disp is None:
             disp = self._shared_dispatcher()
         else:
