@@ -32,6 +32,7 @@ def render_html(events: List[dict]) -> str:
     nodes = {}
     profiles = []
     exchanges = []
+    memory = []        # hbm_spill / hbm_restore / mem_negotiate / demotion
     t0 = min((e["ts"] for e in events), default=0)
     for e in events:
         t = (e["ts"] - t0) / 1e6
@@ -45,6 +46,9 @@ def render_html(events: List[dict]) -> str:
             profiles.append((t, e))
         elif e.get("event") == "exchange":
             exchanges.append((t, e))
+        elif e.get("event") in ("hbm_spill", "hbm_restore",
+                                "mem_negotiate", "device_to_host"):
+            memory.append((t, e))
 
     rows = []
     for nid in sorted(k for k in nodes if k is not None):
@@ -99,8 +103,36 @@ body {{ font: 13px monospace; margin: 2em; }}
 {''.join(bars)}
 {_render_exchange_volume(exchanges, total)}
 {_render_worker_lanes(exchanges, total)}
+{_render_memory_events(memory, total)}
 {cpu_line}
 </body></html>"""
+
+
+def _render_memory_events(memory, total: float) -> str:
+    """Memory-pressure timeline: HBM spills/restores, device->host
+    demotions and negotiation grants as ticks on one lane each
+    (reference: BlockPool occupancy in the profile report)."""
+    if not memory:
+        return ""
+    kinds = ["hbm_spill", "hbm_restore", "device_to_host",
+             "mem_negotiate"]
+    lanes = []
+    for kind in kinds:
+        evs = [(t, e) for t, e in memory if e.get("event") == kind]
+        if not evs:
+            continue
+        vol = sum(e.get("bytes", 0) or 0 for _, e in evs)
+        marks = "".join(
+            f'<div class="mark" style="left:{100 * t / total:.2f}%;'
+            f'width:0.4%;height:100%"></div>' for t, _ in evs)
+        extra = f" · {vol / 1e6:.1f} MB" if vol else ""
+        lanes.append(
+            f'<div class="row"><span class="lbl">{kind}</span>'
+            f'<div class="track">{marks}</div>'
+            f'<span class="dur">{len(evs)} events{extra}</span></div>')
+    if not lanes:
+        return ""
+    return "<h2>memory pressure</h2>" + "".join(lanes)
 
 
 def _render_exchange_volume(exchanges, total: float) -> str:
