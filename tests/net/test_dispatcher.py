@@ -190,7 +190,7 @@ def test_async_tcp_connection_framing(disp):
     payload (zero-byte read path)."""
     a, b = socket.socketpair()
     ca, cb = TcpConnection(a), TcpConnection(b)
-    ca.attach_dispatcher(disp, max_inflight=4)
+    ca.attach_dispatcher(disp, max_inflight_bytes=256)
     cb.attach_dispatcher(disp)
     try:
         msgs = [b"", b"x" * 5, b"y" * 70000, b"z"]

@@ -88,3 +88,71 @@ def test_parse_hostlist():
     hosts = parse_hostlist("a:1 b:2,c:3")
     assert hosts == [("a", 1), ("b", 2), ("c", 3)]
     assert parse_hostlist(":7000") == [("127.0.0.1", 7000)]
+
+def test_symmetric_bulk_burst_no_deadlock():
+    """Both peers enqueue far more than the in-flight byte cap before
+    either reads (the symmetric kernel-buffer scenario): the bounded
+    reap must queue past the cap instead of deadlocking."""
+    import os
+    os.environ["THRILL_TPU_ASYNC_INFLIGHT_BYTES"] = str(1 << 20)
+    try:
+        def job(g):
+            peer = 1 - g.my_rank
+            blob = b"\xab" * (1 << 20)        # 1 MiB, == the cap
+            for _ in range(8):                # 8 MiB queued, both sides
+                g.send_to(peer, blob)
+            got = [g.recv_from(peer) for _ in range(8)]
+            g.connection(peer).flush()
+            return all(x == blob for x in got)
+        assert run_tcp(2, job) == [True, True]
+    finally:
+        del os.environ["THRILL_TPU_ASYNC_INFLIGHT_BYTES"]
+
+
+def test_borrow_check_detects_mutation():
+    """THRILL_TPU_NET_DEBUG=1: mutating a borrowed staging buffer
+    before flush() raises instead of silently corrupting the frame."""
+    import os
+    import numpy as np
+    from thrill_tpu.net.dispatcher import Dispatcher
+    from thrill_tpu.net.tcp import TcpConnection
+    os.environ["THRILL_TPU_NET_DEBUG"] = "1"
+    disp = Dispatcher(force_py=True)
+    a, b = socket.socketpair()
+    ca, cb = TcpConnection(a), TcpConnection(b)
+    ca.attach_dispatcher(disp)
+    cb.attach_dispatcher(disp)
+    try:
+        staging = np.full(1 << 16, 7, dtype=np.uint8)
+        ca.send(staging)
+        staging[0] = 99                      # contract violation
+        with pytest.raises(RuntimeError, match="mutated"):
+            ca.flush()
+    finally:
+        del os.environ["THRILL_TPU_NET_DEBUG"]
+        ca.close()
+        cb.close()
+        disp.close()
+
+
+def test_dispatcher_errored_fd_rejected():
+    """After a send/recv failure the Python fallback engine rejects
+    further requests on that fd (same as the native engine)."""
+    from thrill_tpu.net.dispatcher import Dispatcher, DispatcherError
+    disp = Dispatcher(force_py=True)
+    a, b = socket.socketpair()
+    try:
+        disp.register(a)
+        b.close()                            # peer gone
+        rid = disp.async_read(a, 4)
+        assert disp.wait(rid, timeout=5) < 0
+        with pytest.raises(DispatcherError):
+            disp.fetch(rid)
+        with pytest.raises(DispatcherError):
+            disp.async_write(a, b"x")
+        with pytest.raises(DispatcherError):
+            disp.async_read(a, 1)
+    finally:
+        disp.unregister(a)
+        a.close()
+        disp.close()

@@ -18,6 +18,7 @@ same single-controller program (standard JAX multi-controller SPMD).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
@@ -65,6 +66,7 @@ class Context:
         # active grants (reference: per-stage RAM distribution among
         # max-RAM requesters, api/dia_base.cpp:121-270)
         self._mem_reserved = 0
+        self._mem_lock = threading.Lock()
         self.rng = np.random.default_rng(seed)
         self._nodes: List[Any] = []
         self._profiler = None
@@ -143,24 +145,36 @@ class Context:
         if req is None:
             node.mem_limit = None
             return False
-        remaining = max(self.ram_workers - self._mem_reserved, 4096)
-        if req == "max":
-            grant = max(remaining // 2, 4096)
-        else:
-            grant = min(int(req), remaining)
-        self._mem_reserved += grant
+        with self._mem_lock:   # net layer is multi-threaded; stay safe
+            remaining = max(self.ram_workers - self._mem_reserved, 4096)
+            if req == "max":
+                grant = max(remaining // 2, 4096)
+            else:
+                grant = min(int(req), remaining)
+            self._mem_reserved += grant
+            reserved = self._mem_reserved
         node.mem_limit = grant
         node._mem_grant = grant
+        short = req != "max" and grant < int(req)
         if self.logger.enabled:
             self.logger.line(event="mem_negotiate", node=node.label,
                              dia_id=node.id, grant=grant,
-                             reserved=self._mem_reserved)
+                             reserved=reserved,
+                             short=short or None)
+        if short:
+            # fixed-size requesters must see they got less than asked —
+            # they read node.mem_limit (the granted amount) to adapt
+            import sys
+            print(f"thrill_tpu: mem_negotiate short grant for "
+                  f"{node.label}: requested {req}, granted {grant}",
+                  file=sys.stderr)
         return True
 
     def release_mem(self, node) -> None:
         grant = getattr(node, "_mem_grant", 0)
         if grant:
-            self._mem_reserved -= grant
+            with self._mem_lock:
+                self._mem_reserved -= grant
         node._mem_grant = 0
 
     # -- sources (created lazily like every DIA op) ---------------------

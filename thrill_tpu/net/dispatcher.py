@@ -204,6 +204,7 @@ class _PyDispatcher:
         self._socks: Dict[int, socket.socket] = {}
         self._done: Dict[int, Tuple[int, bytes]] = {}  # id -> (status, data)
         self._fd_rids: Dict[int, set] = {}  # fd -> requests ever issued
+        self._errored: set = set()  # fds with a failed send/recv
         self._next_id = 1
         self._stop = False
         self._waker_r, self._waker_w = socket.socketpair()
@@ -226,6 +227,7 @@ class _PyDispatcher:
             self._writes[fd] = deque()
             self._reads[fd] = deque()
             self._socks[fd] = sock
+            self._errored.discard(fd)   # fd number may be recycled
             # no selector registration yet: selectors reject an empty
             # interest set, so the fd joins the loop on first request
 
@@ -245,6 +247,7 @@ class _PyDispatcher:
             for rid in self._fd_rids.pop(fd, set()) - pending:
                 self._done.pop(rid, None)
             self._socks.pop(fd, None)
+            self._errored.discard(fd)
             try:
                 self._sel.unregister(sock)
             except (KeyError, ValueError):
@@ -257,6 +260,10 @@ class _PyDispatcher:
             fd = sock.fileno()
             if fd not in self._writes:
                 raise DispatcherError("async_write on unregistered fd")
+            if fd in self._errored:
+                # match the native engine: once a send/recv failed, the
+                # fd stays rejected (no engine-dependent semantics)
+                raise DispatcherError("async_write on failed fd")
             rid = self._next_id
             self._next_id += 1
             self._fd_rids.setdefault(fd, set()).add(rid)
@@ -294,6 +301,8 @@ class _PyDispatcher:
             fd = sock.fileno()
             if fd not in self._reads:
                 raise DispatcherError("async_read on unregistered fd")
+            if fd in self._errored:
+                raise DispatcherError("async_read on failed fd")
             rid = self._next_id
             self._next_id += 1
             self._fd_rids.setdefault(fd, set()).add(rid)
@@ -379,6 +388,7 @@ class _PyDispatcher:
             pass
 
     def _fail_fd(self, fd: int, status: int) -> None:
+        self._errored.add(fd)
         for rid, _ in self._writes.get(fd, ()):
             self._done[rid] = (status, b"")
         for rid, _, _ in self._reads.get(fd, ()):

@@ -37,6 +37,20 @@ from . import wire
 from .group import Connection, Group
 
 
+def _wait_fd(sock: socket.socket, write: bool, timeout: float) -> bool:
+    """poll()-based readiness wait. select.select raises ValueError for
+    fds >= FD_SETSIZE (1024), which a large full-mesh with many open
+    files can hit — poll has no such limit."""
+    import select as _select
+    p = _select.poll()
+    p.register(sock.fileno(),
+               _select.POLLOUT if write else _select.POLLIN)
+    try:
+        return bool(p.poll(timeout * 1000.0))
+    finally:
+        p.unregister(sock.fileno())
+
+
 class TcpConnection(Connection):
     def __init__(self, sock: socket.socket) -> None:
         self.sock = sock
@@ -59,8 +73,13 @@ class TcpConnection(Connection):
         # when a supplier is set (data-plane overlap + symmetric
         # large-message deadlock safety), and owns the fd from then on.
         self._disp = None
+        # in-flight async sends: deque of (rid, nbytes, debug_check).
+        # Bounded by BYTES, not request count — many small frames are
+        # cheap to pin, while a few giant borrowed frames are not.
         self._disp_inflight: "deque" = None
-        self._max_inflight = 64
+        self._inflight_bytes = 0
+        self._max_inflight_bytes = _async_inflight_bytes()
+        self._reap_stalled_rid = None
         self._disp_supplier = None
         self._async_threshold = _async_threshold()
         # async send failure observed outside send() (e.g. during the
@@ -72,39 +91,74 @@ class TcpConnection(Connection):
         the first time a large frame needs it."""
         self._disp_supplier = supplier
 
-    def attach_dispatcher(self, disp, max_inflight: int = 64) -> None:
+    def attach_dispatcher(self, disp,
+                          max_inflight_bytes: Optional[int] = None) -> None:
         """Route all traffic through the async engine from now on:
-        sends enqueue and return (bounded in-flight, the reference's
-        send-semaphore analog), receives complete on the dispatcher
-        thread. Safe while a blocking recv is in progress on another
-        thread: the direct receive path tolerates the fd turning
-        non-blocking mid-frame (select loop), finishes its frame with
-        direct reads under _recv_lock, and the NEXT recv routes through
-        the engine."""
+        sends enqueue and return (byte-bounded in-flight, the
+        reference's send-semaphore analog), receives complete on the
+        dispatcher thread. Safe while a blocking recv is in progress on
+        another thread: the direct receive path tolerates the fd
+        turning non-blocking mid-frame (poll loop), finishes its frame
+        with direct reads under _recv_lock, and the NEXT recv routes
+        through the engine."""
         with self._send_lock:
             if self._disp is not None:     # already attached
                 return
-            self._attach_locked(disp, max_inflight)
+            self._attach_locked(disp, max_inflight_bytes)
 
-    def _attach_locked(self, disp, max_inflight: int = 64) -> None:
+    def _attach_locked(self, disp,
+                       max_inflight_bytes: Optional[int] = None) -> None:
         disp.register(self.sock)
         self._disp = disp
         from collections import deque
         self._disp_inflight = deque()
-        self._max_inflight = max_inflight
+        self._inflight_bytes = 0
+        if max_inflight_bytes is not None:
+            self._max_inflight_bytes = max_inflight_bytes
+
+    # bounded wait when over the in-flight byte cap: a symmetric bulk
+    # burst (both peers enqueue past the cap before either reads) makes
+    # the head write unretirable until the PEER's reads start draining;
+    # waiting forever here would deadlock both sides, so after the
+    # timeout we keep queuing past the cap instead (memory over
+    # deadlock — the reference's Dispatcher queues writes unbounded)
+    _REAP_TIMEOUT_S = 0.5
+
+    def _enqueue_send(self, rid: int, nbytes: int, check=None) -> None:
+        self._disp_inflight.append((rid, nbytes, check))
+        self._inflight_bytes += nbytes
+
+    def _retire_head(self) -> None:
+        """Caller holds _send_lock; head request is complete."""
+        rid, nb, check = self._disp_inflight.popleft()
+        self._inflight_bytes -= nb
+        self._reap_stalled_rid = None
+        try:
+            self._disp.fetch(rid)     # raises if the write failed
+        finally:
+            if check is not None:
+                check()               # debug: borrowed buffer unchanged?
 
     def _reap_sends(self, block: bool) -> None:
         """Caller holds _send_lock. Retire completed async sends; when
-        ``block``, wait until back under the in-flight cap."""
+        ``block``, wait (bounded) until back under the in-flight byte
+        cap. A head that already timed out once is not re-waited on
+        subsequent sends (the peer is stalled — burn the timeout once,
+        not once per frame), so an over-cap burst queues at enqueue
+        speed after the first stall."""
         q = self._disp_inflight
         while q:
-            rid = q[0]
-            if block and len(q) >= self._max_inflight:
-                self._disp.wait(rid)
-            elif self._disp.poll(rid) == 0:
-                return
-            q.popleft()
-            self._disp.fetch(rid)     # raises if the write failed
+            rid, nb, _check = q[0]
+            if self._disp.poll(rid) == 0:
+                if not (block
+                        and self._inflight_bytes > self._max_inflight_bytes):
+                    return
+                if rid == self._reap_stalled_rid:
+                    return            # already burned the timeout on it
+                if self._disp.wait(rid, self._REAP_TIMEOUT_S) == 0:
+                    self._reap_stalled_rid = rid
+                    return            # timed out: queue past the cap
+            self._retire_head()
 
     def flush(self) -> None:
         """Block until every queued async send has hit the socket."""
@@ -116,9 +170,8 @@ class TcpConnection(Connection):
                 raise e
             q = self._disp_inflight
             while q:
-                rid = q.popleft()
-                self._disp.wait(rid)
-                self._disp.fetch(rid)
+                self._disp.wait(q[0][0])
+                self._retire_head()
 
     def send(self, obj: Any) -> None:
         """Send one message. Large bytes/ndarray payloads are BORROWED
@@ -149,8 +202,8 @@ class TcpConnection(Connection):
             if self._disp is not None:
                 self._reap_sends(block=True)
                 for b in bufs:
-                    self._disp_inflight.append(
-                        self._disp.async_write(self.sock, b))
+                    self._enqueue_send(self._disp.async_write(self.sock, b),
+                                       len(b), _borrow_check(b))
             else:
                 self._sendall_parts(bufs)
 
@@ -167,14 +220,12 @@ class TcpConnection(Connection):
         draining — e.g. both sides of a pairwise exchange sending
         first) hands the unsent tail to the async engine instead of
         blocking forever on kernel buffers."""
-        import select as _select
         mvs = [memoryview(b).cast("B") for b in bufs]
         can_escape = self._disp_supplier is not None
         while mvs:
             if can_escape:
-                r = _select.select([], [self.sock], [],
-                                   self._BLOCKING_SEND_STALL_S)[1]
-                if not r:
+                if not _wait_fd(self.sock, write=True,
+                                timeout=self._BLOCKING_SEND_STALL_S):
                     # no progress possible: switch this connection to
                     # the engine and enqueue the remaining tail. The
                     # tail is COPIED — this frame was sent under
@@ -183,8 +234,9 @@ class TcpConnection(Connection):
                     # here for the drain could deadlock symmetrically)
                     self._attach_locked(self._disp_supplier())
                     for mv in mvs:
-                        self._disp_inflight.append(
-                            self._disp.async_write(self.sock, bytes(mv)))
+                        b = bytes(mv)
+                        self._enqueue_send(
+                            self._disp.async_write(self.sock, b), len(b))
                     return
             try:
                 n = self.sock.sendmsg(mvs)
@@ -243,7 +295,6 @@ class TcpConnection(Connection):
             rid = self._disp.async_read(self.sock, n)
             self._disp.wait(rid)
             return self._disp.fetch(rid)
-        import select as _select
         chunks = []
         while n > 0:
             try:
@@ -253,7 +304,7 @@ class TcpConnection(Connection):
                 # non-blocking mid-frame; finish this frame with
                 # direct reads (we hold _recv_lock, so the engine has
                 # no read requests racing us)
-                _select.select([self.sock], [], [], 0.2)
+                _wait_fd(self.sock, write=False, timeout=0.2)
                 continue
             if not b:
                 raise ConnectionError("peer closed connection")
@@ -364,6 +415,39 @@ def _async_threshold() -> int:
                                   str(1 << 18)))
     except ValueError:
         return 1 << 18
+
+
+def _async_inflight_bytes() -> int:
+    """Byte cap on unretired async sends per connection (beyond it,
+    send() waits — bounded — for the engine to drain). Caps pinned
+    borrowed-buffer memory; a request-count cap would let ~60 giant
+    frames pin unbounded bytes."""
+    try:
+        return int(os.environ.get("THRILL_TPU_ASYNC_INFLIGHT_BYTES",
+                                  str(64 << 20)))
+    except ValueError:
+        return 64 << 20
+
+
+def _borrow_check(buf):
+    """Debug guard for the zero-copy borrow contract (send() docstring):
+    with THRILL_TPU_NET_DEBUG=1, checksum the borrowed buffer at
+    enqueue and verify it at retirement, so a caller mutating a staging
+    array before flush() fails loudly instead of corrupting frames
+    (the MAC is computed before the borrow, so corruption would even be
+    authenticated)."""
+    if os.environ.get("THRILL_TPU_NET_DEBUG", "0") != "1":
+        return None
+    import zlib
+    want = zlib.crc32(buf)
+
+    def check(buf=buf, want=want):
+        if zlib.crc32(buf) != want:
+            raise RuntimeError(
+                "thrill_tpu.net.tcp: borrowed send buffer was mutated "
+                "before the async write retired — callers must not "
+                "reuse staging buffers until flush()")
+    return check
 
 
 def _exchange_auth_flag(conn: TcpConnection, have_secret: bool) -> None:
