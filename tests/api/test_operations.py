@@ -657,3 +657,19 @@ def test_inner_join_device_location_detection():
     ld, moved_ld = run(True)
     assert ld == base == [(k, 2 * k) for k in range(19_900, 20_000)]
     assert moved_ld < moved_base / 3, (moved_ld, moved_base)
+
+def test_zip_window_device_default_schema():
+    """ZipWindow with NO fns on device inputs stays on device with the
+    reference's default tuple-of-chunks schema (zip_window.hpp:175):
+    output item j is (chunk_j_of_a, chunk_j_of_b)."""
+    def job(ctx):
+        a = ctx.Generate(24)
+        b = ctx.Generate(36, fn=lambda i: i * 10)
+        z = ZipWindow((2, 3), a, b)
+        got = z.AllGather()
+        assert len(got) == 12
+        for j, (ca, cb) in enumerate(got):
+            assert [int(v) for v in ca] == [2 * j, 2 * j + 1]
+            assert [int(v) for v in cb] == [10 * k for k in
+                                            range(3 * j, 3 * j + 3)]
+    sweep(job)

@@ -62,3 +62,59 @@ def test_profile_thread_samples():
         samples = [e for e in events if e.get("event") == "profile"]
         assert len(samples) >= 2
         assert any("host_mem_total" in e for e in samples)
+
+def test_report_stage_worker_matrix_and_overlays():
+    """The upgraded report (reference: misc/json2profile.cpp): stage
+    summary table, stage x worker matrix, memory lanes and host
+    CPU/RAM overlay — driven by a PageRank run with profiling on."""
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(__file__), "..", "..", "examples"))
+    import numpy as np
+    from page_rank import page_rank, zipf_graph
+
+    with tempfile.TemporaryDirectory() as d:
+        log = os.path.join(d, "events.json")
+        cfg = Config(log_path=log, profile=True)
+
+        def job(ctx):
+            edges = zipf_graph(200, 600, seed=3)
+            ranks = page_rank(ctx, edges, 200, iterations=3)
+            # dangling pages leak mass; just sanity-check the result
+            assert 0.5 < float(np.sum(ranks)) <= 1.0 + 1e-6
+            assert float(np.min(ranks)) >= 0.0
+
+        RunLocalMock(job, 2, config=cfg)
+        events = load_events(os.path.join(d, "events-host0.json"))
+        html = render_html(events)
+        assert "stage summary" in html
+        assert "stage x worker items" in html
+        assert "Mitems/s" in html
+        # per-worker counts flow from node_execute_done into the matrix
+        done = [e for e in events if e.get("event") == "node_execute_done"
+                and e.get("per_worker")]
+        assert done, "no per_worker counts logged"
+        assert all(len(e["per_worker"]) == 2 for e in done)
+
+
+def test_report_merges_multi_host_logs():
+    from thrill_tpu.tools.json2profile import load_many
+
+    with tempfile.TemporaryDirectory() as d:
+        paths = []
+        for h in range(2):
+            p = os.path.join(d, f"events-host{h}.json")
+            logger = JsonLogger(p)
+            logger.line(event="node_execute_start", node="Map",
+                        dia_id=1)
+            logger.line(event="node_execute_done", node="Map", dia_id=1,
+                        items=10, per_worker=[5, 5])
+            logger.line(event="profile", cpu_util=0.5 + 0.1 * h,
+                        host_mem_total=100, host_mem_available=40)
+            logger.close()
+            paths.append(p)
+        events = load_many(paths)
+        assert {e["host"] for e in events} == {0, 1}
+        html = render_html(events)
+        assert "host0" in html and "host1" in html
+        assert "host RAM in use" in html

@@ -111,3 +111,59 @@ def test_grant_large_ram_stays_in_memory():
     d = ctx.Distribute(vals, storage="host").Sort()
     assert list(d.AllGather()) == sorted(vals)
     ctx.close()
+
+
+def test_rss_budget_triggers_early_spill(monkeypatch, tmp_path):
+    """Real-memory feedback (reference: malloc_tracker.hpp:36-43 ->
+    api/sort.hpp:679 spill-on-memory_exceeded): when process RSS grows
+    past the grant, the EM sort spills its run EARLY instead of
+    trusting the pickled-item estimate."""
+    from thrill_tpu.mem import manager as mm
+    from thrill_tpu.api.ops import sort as sort_mod
+
+    # simulated RSS: grows 1 MB per poll — blows a 4 MB grant after a
+    # few strides no matter what the item-size estimate said
+    state = {"rss": 100 << 20}
+
+    def fake_rss():
+        state["rss"] += 1 << 20
+        return state["rss"]
+
+    monkeypatch.setattr(mm, "process_rss", fake_rss)
+
+    from thrill_tpu.api import RunLocalMock
+    from thrill_tpu.common.config import Config
+
+    spills = []
+    real_spill = sort_mod._spill_run
+
+    def counting_spill(pool, run, key):
+        spills.append(len(run))
+        return real_spill(pool, run, key)
+
+    monkeypatch.setattr(sort_mod, "_spill_run", counting_spill)
+    # tiny stride so the fake RSS is polled often
+    monkeypatch.setattr(mm.RssBudget, "__init__",
+                        lambda self, grant, stride=16: (
+                            setattr(self, "grant", 4 << 20),
+                            setattr(self, "stride", 16),
+                            setattr(self, "base", mm.process_rss()),
+                            setattr(self, "_n", 0))[0])
+
+    # run cap 3000 < n forces the EM path; without RSS feedback every
+    # spill would hold exactly 3000 items
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "3000")
+
+    def job(ctx):
+        n = 4000
+        items = [f"key-{(i * 37) % n:06d}" for i in range(n)]
+        out = ctx.Distribute(items, storage="host") \
+            .Sort(compare_fn=lambda a, b: a < b).AllGather()
+        assert out == sorted(items)
+
+    cfg = Config.from_env()
+    RunLocalMock(job, 2, config=cfg)
+    # the estimate alone would spill only at the 3000-item run cap; the
+    # RSS budget must have forced earlier, smaller spills
+    assert spills, "RSS budget never spilled"
+    assert any(s < 3000 for s in spills)

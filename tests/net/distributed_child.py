@@ -34,11 +34,34 @@ def job(ctx):
     total = int(ctx.Distribute(vals).Sum())
     # host-plane agreement across the 2 controllers (TCP FCC)
     totals = ctx.net.all_gather(total)
+
+    # HOST-STORAGE WordCount over text: ReadLines -> FlatMap(words) ->
+    # (word, 1) -> ReducePair. String keys force host storage end to
+    # end, so the shuffle rides the multiplexer (cross-process framed
+    # batches over the TCP group), not XLA collectives.
+    text_path = os.environ.get("THRILL_TPU_TEST_TEXT")
+    host_counts = []
+    host_total = -1
+    host_sorted = []
+    if text_path:
+        words = ctx.ReadLines(text_path) \
+            .FlatMap(lambda line: line.split())
+        words.Keep()
+        wc = words.Map(lambda w: (w, 1)).ReducePair(lambda a, b: a + b)
+        host_counts = sorted((k, int(v)) for k, v in wc.AllGather())
+        host_total = int(words.Size())
+        # host Sort with a compare_fn (replicated EM/in-memory path)
+        host_sorted = ctx.ReadLines(text_path) \
+            .FlatMap(lambda line: line.split()) \
+            .Sort(compare_fn=lambda a, b: a < b).AllGather()
+
     stats = ctx.overall_stats()
     return {"pairs": pairs, "total": total, "totals": totals,
             "hosts": stats.get("hosts", 1),
             "net_workers": ctx.net.num_workers,
-            "mesh_workers": ctx.num_workers}
+            "mesh_workers": ctx.num_workers,
+            "host_counts": host_counts, "host_total": host_total,
+            "host_sorted": host_sorted}
 
 
 def main():

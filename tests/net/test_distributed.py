@@ -33,10 +33,24 @@ def _free_ports(n):
 CHILD = os.path.join(os.path.dirname(__file__), "distributed_child.py")
 
 
+_TEXT = "\n".join(
+    f"line {i} word{i % 7} again word{i % 3}" for i in range(211)) + "\n"
+
+
+def _golden_wordcount():
+    from collections import Counter
+    c = Counter(_TEXT.split())
+    return sorted(c.items()), len(_TEXT.split()), sorted(_TEXT.split())
+
+
 @pytest.mark.parametrize("nproc", [2, 3])
-def test_multi_process_wordcount_agrees(nproc):
+def test_multi_process_wordcount_agrees(nproc, tmp_path):
     """The reference sweeps real process counts (mpirun -np {1,2,3,7});
-    sweep {2,3} controllers here, 2 CPU devices each."""
+    sweep {2,3} controllers here, 2 CPU devices each. Covers both the
+    device pipeline (XLA collectives) and a host-storage text WordCount
+    whose shuffle rides the multiplexer over the TCP group."""
+    text_file = tmp_path / "words.txt"
+    text_file.write_text(_TEXT)
     ports = _free_ports(1 + nproc)
     coord_port, tcp_ports = ports[0], ports[1:]
     coordinator = f"127.0.0.1:{coord_port}"
@@ -53,6 +67,7 @@ def test_multi_process_wordcount_agrees(nproc):
             "THRILL_TPU_HOSTLIST": hostlist,
             "THRILL_TPU_RANK": str(rank),
             "THRILL_TPU_SECRET": "test-cluster-secret",
+            "THRILL_TPU_TEST_TEXT": str(text_file),
         })
         procs.append(subprocess.Popen(
             [sys.executable, CHILD, coordinator, str(rank), str(nproc)],
@@ -88,3 +103,10 @@ def test_multi_process_wordcount_agrees(nproc):
     # the device mesh spanned all processes (2 devices each)
     assert r0["mesh_workers"] == 2 * nproc
     assert r0["hosts"] == nproc
+    # host-storage text WordCount matches the in-process golden on
+    # every controller (cross-process multiplexer shuffle)
+    golden_counts, golden_total, golden_sorted = _golden_wordcount()
+    assert r0["host_counts"] == [list(kv) for kv in golden_counts] or \
+        r0["host_counts"] == golden_counts
+    assert r0["host_total"] == golden_total
+    assert r0["host_sorted"] == golden_sorted

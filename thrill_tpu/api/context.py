@@ -53,6 +53,9 @@ class Context:
         # bootstrap the authenticated TCP full mesh from THRILL_TPU_*
         # env so host-side scalar agreement crosses machines.
         self.net = FlowControlChannel(self._construct_host_group())
+        # the host-storage data plane (data/multiplexer.py) reaches the
+        # other controllers through the mesh handle every shard carries
+        self.mesh_exec.host_net = self.net
         self.logger = JsonLogger(
             default_log_path(self.config.log_path, host_rank=host_rank),
             program="thrill_tpu", workers=self.num_workers)
@@ -211,6 +214,9 @@ class Context:
         stats are aggregated over the host control plane (``ctx.net``):
         counters sum, peaks take the max."""
         mex = self.mesh_exec
+        # fold real process RSS into the reported peak (reference:
+        # malloc_tracker feeds OverallStats the true allocation peak)
+        self.mem.sample_rss()
         stats = {
             "workers": self.num_workers,
             "nodes_created": len(self._nodes),

@@ -125,7 +125,10 @@ class DIABase:
                 log.line(event="node_execute_done", node=self.label,
                          dia_id=self.id,
                          items=(int(host_counts.sum())
-                                if host_counts is not None else None))
+                                if host_counts is not None else None),
+                         per_worker=(host_counts.tolist()
+                                     if host_counts is not None
+                                     else None))
         else:
             # LRU bump; transparently re-uploads a spilled result
             hbm.touch(self)

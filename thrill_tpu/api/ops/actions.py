@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ...data import multiplexer
 from ...data.shards import DeviceShards, HostShards
 from ...parallel.mesh import AXIS
 
@@ -27,6 +28,8 @@ def _pull(dia, consume: bool = True):
 
 def Size(dia) -> int:
     shards = _pull(dia)
+    if isinstance(shards, HostShards):
+        return multiplexer.global_total(dia.context.mesh_exec, shards)
     return int(shards.counts.sum())
 
 
@@ -34,7 +37,7 @@ def AllGather(dia) -> list:
     shards = _pull(dia)
     if isinstance(shards, DeviceShards):
         shards = shards.to_host_shards("allgather-action")
-    return [it for l in shards.lists for it in l]
+    return multiplexer.all_items(dia.context.mesh_exec, shards)
 
 
 def Gather(dia, root: int = 0) -> list:
@@ -44,14 +47,14 @@ def Gather(dia, root: int = 0) -> list:
     process hosting worker ``root`` gets the items — the others get []
     (the reference's non-root workers likewise emit nothing)."""
     shards = _pull(dia)
+    mex = dia.context.mesh_exec
+    root = root % max(mex.num_workers, 1)
     if isinstance(shards, DeviceShards):
-        mex = shards.mesh_exec
-        root = root % max(mex.num_workers, 1)
-        owner = mex.devices[root].process_index
         shards = shards.to_host_shards("gather-action")
-        import jax as _jax
-        if owner != _jax.process_index():
-            return []
+    if multiplexer.multiprocess(mex):
+        owner = int(mex.worker_process[root])
+        items = multiplexer.all_items(mex, shards)
+        return items if owner == mex.process_index else []
     return [it for l in shards.lists for it in l]
 
 
@@ -122,17 +125,39 @@ def Sum(dia, initial: Any = 0) -> Any:
             return jax.tree.map(lambda r, i: r + i, reduced, initial)
         except ValueError:
             return jax.tree.map(lambda r: r + initial, reduced)
+    mex = dia.context.mesh_exec
     items = [it for l in shards.lists for it in l]
+    if multiplexer.multiprocess(mex):
+        local = functools.reduce(lambda a, b: a + b, items) if items \
+            else None
+        try:
+            merged = multiplexer.net_fold(mex, local,
+                                          lambda a, b: a + b,
+                                          empty=not items)
+        except ValueError:
+            return initial
+        return merged if initial is None else initial + merged
     return functools.reduce(lambda a, b: a + b, items, initial)
 
 
 def MinMax(dia, is_min: bool) -> Any:
     shards = _pull(dia)
-    if shards.total == 0:
-        raise ValueError("Min/Max of empty DIA")
     if isinstance(shards, DeviceShards):
+        if shards.total == 0:
+            raise ValueError("Min/Max of empty DIA")
         return _device_reduce(shards, "min" if is_min else "max")
+    mex = dia.context.mesh_exec
     items = [it for l in shards.lists for it in l]
+    if multiplexer.multiprocess(mex):
+        local = (min(items) if is_min else max(items)) if items else None
+        try:
+            return multiplexer.net_fold(
+                mex, local, (lambda a, b: min(a, b)) if is_min
+                else (lambda a, b: max(a, b)), empty=not items)
+        except ValueError:
+            raise ValueError("Min/Max of empty DIA")
+    if not items:
+        raise ValueError("Min/Max of empty DIA")
     return min(items) if is_min else max(items)
 
 

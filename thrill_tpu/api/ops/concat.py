@@ -165,11 +165,16 @@ class ConcatNode(DIABase):
         if any(isinstance(p, HostShards) for p in pulls):
             pulls = [p.to_host_shards("concat-mixed-storage") if isinstance(p, DeviceShards)
                      else p for p in pulls]
+            from ...data import multiplexer
+            mex = self.context.mesh_exec
+            pulls = [multiplexer.ensure_replicated(mex, p, "concat-host")
+                     for p in pulls]
             W = pulls[0].num_workers
             flat = [it for p in pulls for l in p.lists for it in l]
             bounds = [(w * len(flat)) // W for w in range(W + 1)]
-            return HostShards(W, [flat[bounds[w]:bounds[w + 1]]
-                                  for w in range(W)])
+            return multiplexer.localize(
+                mex, HostShards(W, [flat[bounds[w]:bounds[w + 1]]
+                                    for w in range(W)]))
         return rebalance_to_even(self.context.mesh_exec, pulls, (self.id,))
 
 
@@ -180,11 +185,16 @@ class RebalanceNode(DIABase):
     def compute(self):
         shards = self.parents[0].pull()
         if isinstance(shards, HostShards):
+            from ...data import multiplexer
+            mex = self.context.mesh_exec
+            shards = multiplexer.ensure_replicated(mex, shards,
+                                                   "rebalance-host")
             W = shards.num_workers
             flat = [it for l in shards.lists for it in l]
             bounds = [(w * len(flat)) // W for w in range(W + 1)]
-            return HostShards(W, [flat[bounds[w]:bounds[w + 1]]
-                                  for w in range(W)])
+            return multiplexer.localize(
+                mex, HostShards(W, [flat[bounds[w]:bounds[w + 1]]
+                                    for w in range(W)]))
         return rebalance_to_even(self.context.mesh_exec, [shards],
                                  (self.id,))
 

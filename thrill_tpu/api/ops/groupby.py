@@ -59,8 +59,11 @@ class GroupByKeyNode(DIABase):
             raise ValueError(
                 "GroupByKey over host storage requires group_fn "
                 "(device_fn needs columnar device shards)")
-        shards = exchange.host_exchange(
-            shards, lambda it: hashing.stable_host_hash(key_fn(it)))
+        from ...data import multiplexer
+        shards = multiplexer.host_exchange(
+            self.context.mesh_exec, shards,
+            lambda it: hashing.stable_host_hash(key_fn(it)),
+            reason="groupby")
         out = []
         for items in shards.lists:
             groups = {}
@@ -224,25 +227,36 @@ class GroupToIndexNode(DIABase):
         if isinstance(shards, DeviceShards):
             shards = shards.to_host_shards("grouptoindex")
         W = self.context.num_workers
+        mex = self.context.mesh_exec
         n = self.size
+        index_fn = self.index_fn
         bounds = [(w * n) // W for w in range(W + 1)]
-        buckets = [dict() for _ in range(W)]
-        for items in shards.lists:
-            for it in items:
-                i = int(self.index_fn(it))
-                if not 0 <= i < n:
-                    continue
-                w = int(np.searchsorted(bounds[1:], i, side="right"))
-                buckets[w].setdefault(i, []).append(it)
+
+        from ...data import multiplexer
+
+        def dest(it):
+            i = int(index_fn(it))
+            if not 0 <= i < n:
+                return W - 1        # dropped below; any owner works
+            return int(np.searchsorted(bounds[1:], i, side="right"))
+
+        shards = multiplexer.host_exchange(mex, shards, dest,
+                                           reason="grouptoindex")
+        owned = set(mex.local_workers) if multiplexer.multiprocess(mex) \
+            else set(range(W))
         out = []
         for w in range(W):
-            lst = []
-            for i in range(bounds[w], bounds[w + 1]):
-                if i in buckets[w]:
-                    lst.append(self.group_fn(i, buckets[w][i]))
-                else:
-                    lst.append(self.neutral)
-            out.append(lst)
+            if w not in owned:
+                out.append([])
+                continue
+            groups: dict = {}
+            for it in shards.lists[w]:
+                i = int(index_fn(it))
+                if bounds[w] <= i < bounds[w + 1]:
+                    groups.setdefault(i, []).append(it)
+            out.append([self.group_fn(i, groups[i]) if i in groups
+                        else self.neutral
+                        for i in range(bounds[w], bounds[w + 1])])
         return HostShards(W, out)
 
 

@@ -60,6 +60,13 @@ def HyperLogLog(dia: DIA, precision: int = 14) -> float:
                 # suffix yields rho = 64-p+1 (ADVICE r1)
                 rho = 64 - p + 1 if rest == 0 else _clz64(rest) + 1
                 regs[idx] = max(regs[idx], min(rho, 64 - p + 1))
+        from ...data import multiplexer
+        mex = dia.context.mesh_exec
+        if multiplexer.multiprocess(mex):
+            # the register sketch merges by elementwise max — ship the
+            # m-register array, not the items (reference:
+            # core/hyperloglog.hpp merge)
+            regs = multiplexer.net_fold(mex, regs, np.maximum)
         return _estimate(regs, p)
 
     mex = shards.mesh_exec

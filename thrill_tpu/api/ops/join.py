@@ -56,6 +56,8 @@ class InnerJoinNode(DIABase):
         if isinstance(right, DeviceShards):
             right = right.to_host_shards("join-host-path")
         W = left.num_workers
+        mex = self.context.mesh_exec
+        from ...data import multiplexer
         lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
         # hash each item once; reuse for detection, pruning and shuffle
         lh = [[hashing.stable_host_hash(_h(lkey(it))) for it in l]
@@ -65,11 +67,25 @@ class InnerJoinNode(DIABase):
         if self.location_detection and W > 1:
             from ...core.location_detection import (LocationDetection,
                                                     _MASK)
+            lh_all, rh_all = lh, rh
+            if multiplexer.multiprocess(mex):
+                # exchange the FINGERPRINTS (not the items) so every
+                # controller agrees on the common-hash set (reference:
+                # core/location_detection.hpp:70 ships Golomb-coded
+                # hashes the same way)
+                def _gather(hs):
+                    local = {w: hs[w] for w in mex.local_workers}
+                    out = [[] for _ in range(W)]
+                    for msg in mex.host_net.all_gather(local):
+                        for w, v in msg.items():
+                            out[int(w)] = v
+                    return out
+                lh_all, rh_all = _gather(lh), _gather(rh)
             ld_l = LocationDetection(W)
             ld_r = LocationDetection(W)
             for w in range(W):
-                ld_l.add_worker(w, lh[w])
-                ld_r.add_worker(w, rh[w])
+                ld_l.add_worker(w, lh_all[w])
+                ld_r.add_worker(w, rh_all[w])
             common = ld_l.common_hashes(ld_r)
 
             def prune(shards, hs):
@@ -88,11 +104,14 @@ class InnerJoinNode(DIABase):
             right, rh = prune(right, rh)
 
         def shuffle(shards, hs):
-            buckets = [[] for _ in range(W)]
-            for items, hlist in zip(shards.lists, hs):
-                for it, h in zip(items, hlist):
-                    buckets[h % W].append(it)
-            return HostShards(W, buckets)
+            # items travel tagged with their precomputed hash (computed
+            # once at line 62, survives pruning in lock-step)
+            tagged = HostShards(W, [[(h, it) for it, h in zip(items, hl)]
+                                    for items, hl in zip(shards.lists, hs)])
+            ex = multiplexer.host_exchange(mex, tagged,
+                                           lambda p: p[0] % W,
+                                           reason="join")
+            return HostShards(W, [[it for _, it in l] for l in ex.lists])
 
         lx = shuffle(left, lh)
         rx = shuffle(right, rh)

@@ -59,12 +59,17 @@ class MergeNode(DIABase):
         if any(isinstance(p, HostShards) for p in pulls):
             pulls = [p.to_host_shards("merge-host-path")
                      if isinstance(p, DeviceShards) else p for p in pulls]
+            from ...data import multiplexer
+            mex = self.context.mesh_exec
+            pulls = [multiplexer.ensure_replicated(mex, p, "merge-host")
+                     for p in pulls]
             W = pulls[0].num_workers
             seqs = [[it for lst in p.lists for it in lst] for p in pulls]
             merged = list(heapq.merge(*seqs, key=self.key_fn))
             bounds = [(w * len(merged)) // W for w in range(W + 1)]
-            return HostShards(W, [merged[bounds[w]:bounds[w + 1]]
-                                  for w in range(W)])
+            return multiplexer.localize(
+                mex, HostShards(W, [merged[bounds[w]:bounds[w + 1]]
+                                    for w in range(W)]))
         return _device_merge(pulls, self.key_fn, ("merge", self.key_fn))
 
 

@@ -40,10 +40,17 @@ class PrefixSumNode(DIABase):
         return self._compute_device(shards)
 
     def _compute_host(self, shards: HostShards):
+        # generic (possibly non-associative) fold is sequential across
+        # the whole stream: replicate across controllers, compute the
+        # identical full result, keep the local lists
+        from ...data import multiplexer
+        mex = self.context.mesh_exec
+        replicated = multiplexer.ensure_replicated(mex, shards,
+                                                   "prefixsum-host")
         fn = self.fn or (lambda a, b: a + b)
         out = []
         acc = self.initial
-        for items in shards.lists:
+        for items in replicated.lists:
             lst = []
             for it in items:
                 if self.inclusive:
@@ -53,7 +60,8 @@ class PrefixSumNode(DIABase):
                     lst.append(acc)
                     acc = fn(acc, it)
             out.append(lst)
-        return HostShards(shards.num_workers, out)
+        return multiplexer.localize(
+            mex, HostShards(shards.num_workers, out))
 
     def _compute_device(self, shards: DeviceShards):
         mex = shards.mesh_exec

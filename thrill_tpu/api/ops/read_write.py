@@ -39,10 +39,14 @@ class ReadLinesNode(DIABase):
         """Compressed: whole-file granularity round-robin by size psum."""
         W = self.context.num_workers
         total = fl.total_size
+        from ...data.multiplexer import local_worker_set
+        local = local_worker_set(self.context.mesh_exec)
         lists: List[List[str]] = [[] for _ in range(W)]
         for fi in fl.files:
             # assign file to the worker owning its start offset
             w = min(W - 1, (fi.size_ex_psum * W) // max(total, 1))
+            if w not in local:
+                continue          # another controller reads this file
             with file_io.OpenReadStream(fi.path) as f:
                 data = f.read()
             lists[w].extend(data.decode("utf-8").splitlines())
@@ -51,13 +55,20 @@ class ReadLinesNode(DIABase):
     def _compute_ranges(self, fl: file_io.FileList):
         """Uncompressed: split the global byte range evenly; each worker
         starts after the first newline past its range start (the item
-        owned by the worker containing its first byte... reference rule:
-        a line belongs to the worker whose range contains its START)."""
+        owned by the worker containing its START). Multi-controller:
+        each process reads ONLY its own workers' byte ranges — the I/O
+        scales out with processes (reference: read_lines.hpp:41 splits
+        by worker the same way)."""
         W = self.context.num_workers
         total = fl.total_size
+        from ...data.multiplexer import local_worker_set
+        local = local_worker_set(self.context.mesh_exec)
         bounds = [(w * total) // W for w in range(W + 1)]
         lists: List[List[str]] = []
         for w in range(W):
+            if w not in local:
+                lists.append([])
+                continue
             lo, hi = bounds[w], bounds[w + 1]
             lists.append(_read_lines_range(fl, lo, hi))
         return HostShards(W, lists)
@@ -136,13 +147,23 @@ class ReadBinaryNode(DIABase):
         rec_bytes = rec_items * self.dtype.itemsize
         total_recs = fl.total_size // rec_bytes
         bounds = [(w * total_recs) // W for w in range(W + 1)]
+        # multi-controller: read only this process's workers' ranges;
+        # counts derive from bounds, so no agreement round is needed
+        from ...data.multiplexer import local_worker_set
+        local = local_worker_set(self.context.mesh_exec)
+        empty = np.empty((0,) + self.record_shape, dtype=self.dtype)
         per_worker = []
         for w in range(W):
+            if w not in local:
+                per_worker.append(empty)
+                continue
             lo, hi = bounds[w], bounds[w + 1]
             arr = _read_records(fl, lo, hi, rec_bytes, self.dtype)
             per_worker.append(arr.reshape((-1,) + self.record_shape))
+        counts = np.array([bounds[w + 1] - bounds[w] for w in range(W)],
+                          dtype=np.int64)
         return DeviceShards.from_worker_arrays(
-            self.context.mesh_exec, per_worker)
+            self.context.mesh_exec, per_worker, counts=counts)
 
 
 def _read_records(fl, lo_rec, hi_rec, rec_bytes, dtype) -> np.ndarray:
@@ -180,10 +201,22 @@ def _host_lists(dia) -> HostShards:
     return shards
 
 
+def _local_worker_ids(dia):
+    mex = dia.context.mesh_exec
+    from ...data import multiplexer
+    if multiplexer.multiprocess(mex):
+        return set(mex.local_workers)
+    return set(range(mex.num_workers))
+
+
 def WriteLines(dia, path_pattern: str) -> None:
-    """One text file per worker (reference: api/write_lines.hpp:33)."""
+    """One text file per worker (reference: api/write_lines.hpp:33).
+    Multi-controller: each process writes only its own workers' files."""
     shards = _host_lists(dia)
+    owned = _local_worker_ids(dia)
     for w, items in enumerate(shards.lists):
+        if w not in owned:
+            continue
         with file_io.OpenWriteStream(_worker_path(path_pattern, w)) as f:
             for it in items:
                 f.write(str(it).encode("utf-8"))
@@ -191,8 +224,21 @@ def WriteLines(dia, path_pattern: str) -> None:
 
 
 def WriteLinesOne(dia, path: str) -> None:
-    """Single coordinated output file (reference: write_lines_one.hpp:31)."""
+    """Single coordinated output file (reference: write_lines_one.hpp:31).
+    Multi-controller: items gather to process 0, which writes the file
+    alone (worker-rank order is preserved)."""
     shards = _host_lists(dia)
+    mex = dia.context.mesh_exec
+    from ...data import multiplexer
+    if multiplexer.multiprocess(mex):
+        items = multiplexer.all_items(mex, shards)
+        if mex.process_index != 0:
+            return
+        with file_io.OpenWriteStream(path) as f:
+            for it in items:
+                f.write(str(it).encode("utf-8"))
+                f.write(b"\n")
+        return
     with file_io.OpenWriteStream(path) as f:
         for items in shards.lists:
             for it in items:
@@ -204,16 +250,21 @@ def WriteBinary(dia, path_pattern: str) -> None:
     """Raw fixed-size records, one file per worker
     (reference: api/write_binary.hpp:36)."""
     shards = dia._link().pull()
+    owned = _local_worker_ids(dia)
     if isinstance(shards, DeviceShards):
-        per_worker = shards.to_worker_arrays()
+        per_worker = shards.to_worker_arrays(local_only=True)
         import jax
         for w, tree in enumerate(per_worker):
+            if tree is None or w not in owned:
+                continue
             leaves = jax.tree.leaves(tree)
             with file_io.OpenWriteStream(_worker_path(path_pattern, w)) as f:
                 for leaf in leaves:
                     f.write(np.ascontiguousarray(leaf).tobytes())
         return
     for w, items in enumerate(shards.lists):
+        if w not in owned:
+            continue
         with file_io.OpenWriteStream(_worker_path(path_pattern, w)) as f:
             for it in items:
                 f.write(np.asarray(it).tobytes())

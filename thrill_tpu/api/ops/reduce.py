@@ -239,8 +239,11 @@ class ReduceNode(DIABase):
 
     def _compute_host(self, shards: HostShards):
         W = shards.num_workers
+        mex = self.context.mesh_exec
         key_fn, reduce_fn = self.key_fn, self.reduce_fn
-        # pre-phase per worker
+        from ...data import multiplexer
+        # pre-phase per worker (local combine cuts shuffle volume, the
+        # reference's ReducePrePhase table)
         pre_tables = []
         for items in shards.lists:
             table = {}
@@ -251,21 +254,50 @@ class ReduceNode(DIABase):
         non_unique = None
         if self.dup_detection and W > 1:
             from ...core import duplicate_detection as dd
-            non_unique = dd.find_non_unique_hashes(
-                [[hashing.stable_host_hash(k) for k in t] for t in
-                 pre_tables])
-        # shuffle + post-phase; globally-unique keys stay local
-        post = [dict() for _ in range(W)]
+            hash_lists = [[hashing.stable_host_hash(k) for k in t]
+                          for t in pre_tables]
+            if multiplexer.multiprocess(mex):
+                # fingerprint exchange over the control plane: ship the
+                # hashes (not the items) so every process agrees on the
+                # globally-unique set (reference:
+                # core/duplicate_detection.hpp:46)
+                local = {w: hash_lists[w] for w in mex.local_workers}
+                merged = [[] for _ in range(W)]
+                for msg in mex.host_net.all_gather(local):
+                    for w, hs in msg.items():
+                        merged[int(w)] = hs
+                hash_lists = merged
+            non_unique = dd.find_non_unique_hashes(hash_lists)
+        # shuffle + post-phase; globally-unique keys stay local. Items
+        # travel as (src_worker_kept, key, value) so the PRE-PHASE key
+        # stays authoritative (reduce_fn need not preserve key_fn — the
+        # reference's tables likewise carry the extracted key) and the
+        # multiplexer ships them cross-process (CatStream order).
+        def dest(kv):
+            keep, k, _ = kv
+            if keep is not None:
+                return keep
+            return hashing.stable_host_hash(k) % W
+
+        pre_lists = []
         for w, table in enumerate(pre_tables):
+            lst = []
             for k, v in table.items():
-                h = hashing.stable_host_hash(k)
-                if non_unique is not None and \
-                        dd.is_unique(h, non_unique):
-                    t = post[w]              # no shuffle needed
-                else:
-                    t = post[h % W]
+                keep = None
+                if non_unique is not None and dd.is_unique(
+                        hashing.stable_host_hash(k), non_unique):
+                    keep = w              # globally unique: stays local
+                lst.append((keep, k, v))
+            pre_lists.append(lst)
+        ex = multiplexer.host_exchange(mex, HostShards(W, pre_lists),
+                                       dest, reason="reduce")
+        post_lists = []
+        for items in ex.lists:
+            t: dict = {}
+            for _, k, v in items:
                 t[k] = reduce_fn(t[k], v) if k in t else v
-        return HostShards(W, [list(t.values()) for t in post])
+            post_lists.append(list(t.values()))
+        return HostShards(W, post_lists)
 
 
 def ReduceByKey(dia: DIA, key_fn: Callable, reduce_fn: Callable,

@@ -70,7 +70,13 @@ class SampleNode(DIABase):
     def compute(self):
         shards = self.parents[0].pull()
         rng = np.random.default_rng(self.seed)
-        takes = hypergeometric_split(rng, self.k, shards.counts)
+        if isinstance(shards, HostShards):
+            from ...data import multiplexer
+            counts = multiplexer.global_counts(
+                self.context.mesh_exec, shards)
+        else:
+            counts = shards.counts
+        takes = hypergeometric_split(rng, self.k, counts)
         if isinstance(shards, HostShards):
             out = []
             for items, t in zip(shards.lists, takes):

@@ -102,6 +102,16 @@ class SortNode(DIABase):
     HOST_RUN_SIZE = 1 << 20
 
     def _compute_host(self, shards: HostShards):
+        # multi-controller: the EM/in-memory host sort needs the global
+        # item stream; replicate, compute identically, keep local lists
+        from ...data import multiplexer
+        mex = self.context.mesh_exec
+        if multiplexer.multiprocess(mex):
+            rep = multiplexer.ensure_replicated(mex, shards, "sort-host")
+            return multiplexer.localize(mex, self._compute_host_impl(rep))
+        return self._compute_host_impl(shards)
+
+    def _compute_host_impl(self, shards: HostShards):
         import functools
         import os
         W = shards.num_workers
@@ -188,15 +198,24 @@ class SortNode(DIABase):
         files = []
         run = []
         pos = 0
+        # real-memory feedback: run_size is an ESTIMATE from one
+        # pickled item; the RSS budget is ground truth and spills the
+        # run early when actual interpreter growth passes the grant
+        # (reference: ReceiveItems spills on mem::memory_exceeded,
+        # api/sort.hpp:679)
+        from ...mem.manager import RssBudget
+        budget = RssBudget(self.mem_limit or 0)
         try:
             for lst in shards.lists:
                 for it in lst:
                     run.append((pos, it))
                     sampler.add((pos, it))
                     pos += 1
-                    if len(run) >= run_size:
+                    if len(run) >= run_size or \
+                            (budget.exceeded() and len(run) >= 16):
                         files.append(_spill_run(pool, run, pair_key))
                         run = []
+                        budget.reset()
                 if owns_input:
                     lst.clear()
             if run:

@@ -35,8 +35,13 @@ class GenerateNode(DIABase):
         bounds = [(w * n) // W for w in range(W + 1)]
         if self.storage == "host":
             fn = self.fn or (lambda i: i)
-            return HostShards(W, [[fn(i) for i in range(bounds[w], bounds[w + 1])]
-                                  for w in range(W)])
+            # multi-controller: materialize only this process's workers
+            # (the host-storage invariant, data/multiplexer.py)
+            from ...data.multiplexer import local_worker_set
+            local = local_worker_set(self.context.mesh_exec)
+            return HostShards(
+                W, [[fn(i) for i in range(bounds[w], bounds[w + 1])]
+                    if w in local else [] for w in range(W)])
         mex = self.context.mesh_exec
         counts = np.array([bounds[w + 1] - bounds[w] for w in range(W)],
                           dtype=np.int64)
@@ -81,7 +86,12 @@ class DistributeNode(DIABase):
                 else self.items
             n = len(items)
             bounds = [(w * n) // W for w in range(W + 1)]
+            # Distribute expects identical input on every controller
+            # (see RunDistributed docstring); each keeps its own slice
+            from ...data.multiplexer import local_worker_set
+            local = local_worker_set(self.context.mesh_exec)
             return HostShards(W, [items[bounds[w]:bounds[w + 1]]
+                                  if w in local else []
                                   for w in range(W)])
         tree = _columnarize(self.items)
         return DeviceShards.from_global_numpy(self.context.mesh_exec, tree)
@@ -105,7 +115,9 @@ class ConcatToDIANode(DIABase):
             extra = [it for l in lists[W:] for it in l]
             lists = lists[:W - 1] + [lists[W - 1] + extra] if W > 0 else lists
             lists = lists[:W]
-        shards = HostShards(W, lists)
+        from ...data import multiplexer
+        shards = multiplexer.localize(self.context.mesh_exec,
+                                      HostShards(W, lists))
         if self.storage == "device":
             return shards.to_device(self.context.mesh_exec)
         return shards

@@ -122,6 +122,9 @@ class WindowNode(DIABase):
     def _compute_host(self, shards: HostShards):
         k = self.k
         fn = self.fn
+        from ...data import multiplexer
+        mex = self.context.mesh_exec
+        shards = multiplexer.ensure_replicated(mex, shards, "window-host")
         flat = [it for l in shards.lists for it in l]
         if self.disjoint:
             wins = [flat[i:i + k] for i in range(0, len(flat) - k + 1, k)]
@@ -131,8 +134,9 @@ class WindowNode(DIABase):
                for i, w in enumerate(wins)]
         W = shards.num_workers
         bounds = [(w * len(out)) // W for w in range(W + 1)]
-        return HostShards(W, [out[bounds[w]:bounds[w + 1]]
-                              for w in range(W)])
+        return multiplexer.localize(
+            mex, HostShards(W, [out[bounds[w]:bounds[w + 1]]
+                                for w in range(W)]))
 
     def _compute_device(self, shards: DeviceShards):
         k = self.k
@@ -189,14 +193,19 @@ class FlatWindowNode(DIABase):
                 "fn was given — pass fn alongside device_fn")
         if isinstance(shards, DeviceShards):
             shards = shards.to_host_shards("flatwindow")
+        from ...data import multiplexer
+        mex = self.context.mesh_exec
+        shards = multiplexer.ensure_replicated(mex, shards,
+                                               "flatwindow-host")
         flat = [it for l in shards.lists for it in l]
         out = []
         for i in range(len(flat) - self.k + 1):
             out.extend(self.fn(i, flat[i:i + self.k]))
         W = shards.num_workers
         bounds = [(w * len(out)) // W for w in range(W + 1)]
-        return HostShards(W, [out[bounds[w]:bounds[w + 1]]
-                              for w in range(W)])
+        return multiplexer.localize(
+            mex, HostShards(W, [out[bounds[w]:bounds[w + 1]]
+                                for w in range(W)]))
 
     def _compute_device(self, shards: DeviceShards):
         k = self.k

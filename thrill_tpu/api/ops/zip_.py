@@ -147,6 +147,10 @@ class ZipNode(DIABase):
 
     def _compute_host(self, pulls: List[HostShards]):
         W = pulls[0].num_workers
+        from ...data import multiplexer
+        mex = self.context.mesh_exec
+        pulls = [multiplexer.ensure_replicated(mex, p, "zip-host")
+                 for p in pulls]
         lists = [[it for l in p.lists for it in l] for p in pulls]
         totals = [len(l) for l in lists]
         n_out = self._out_size(totals)
@@ -159,8 +163,9 @@ class ZipNode(DIABase):
         zf = self.zip_fn or (lambda *xs: tuple(xs))
         zipped = [zf(*vals) for vals in zip(*[l[:n_out] for l in lists])]
         bounds = [(w * n_out) // W for w in range(W + 1)]
-        return HostShards(W, [zipped[bounds[w]:bounds[w + 1]]
-                              for w in range(W)])
+        return multiplexer.localize(
+            mex, HostShards(W, [zipped[bounds[w]:bounds[w + 1]]
+                                for w in range(W)]))
 
 
 def _default_item(items, _pulls):
@@ -260,13 +265,14 @@ class ZipWithIndexNode(DIABase):
         shards = self.parents[0].pull()
         zf = self.zip_fn or (lambda it, i: (it, i))
         if isinstance(shards, HostShards):
-            out, g = [], 0
-            for items in shards.lists:
-                lst = []
-                for it in items:
-                    lst.append(zf(it, g))
-                    g += 1
-                out.append(lst)
+            from ...data import multiplexer
+            counts = multiplexer.global_counts(
+                self.context.mesh_exec, shards)
+            offsets = np.concatenate([[0], np.cumsum(counts)])
+            out = []
+            for w, items in enumerate(shards.lists):
+                out.append([zf(it, int(offsets[w]) + i)
+                            for i, it in enumerate(items)])
             return HostShards(shards.num_workers, out)
 
         mex = shards.mesh_exec
@@ -324,9 +330,15 @@ class ZipWindowNode(DIABase):
 
     def compute(self):
         pulls = [l.pull() for l in self.parents]
-        if self.device_fn is not None and all(
-                isinstance(p, DeviceShards) for p in pulls):
-            return self._compute_device(pulls)
+        if all(isinstance(p, DeviceShards) for p in pulls):
+            if self.device_fn is not None:
+                return self._compute_device(pulls, self.device_fn)
+            if self.zip_fn is None:
+                # reference default schema (zip_window.hpp:175): output
+                # item j is the tuple of chunk j from each input —
+                # batched on device as leaves [cap, window_i, ...]
+                return self._compute_device(
+                    pulls, lambda *chunks: tuple(chunks))
         if self.device_fn is not None and self.zip_fn is None:
             # mirror Window's contract: never silently emit the default
             # tuple-of-chunks schema where device_fn output was expected
@@ -334,6 +346,10 @@ class ZipWindowNode(DIABase):
                 "ZipWindow: inputs are host-resident but only device_fn "
                 "was given — pass zip_fn alongside device_fn")
         pulls = [p.to_host_shards("zipwindow") if isinstance(p, DeviceShards) else p
+                 for p in pulls]
+        from ...data import multiplexer
+        mex = self.context.mesh_exec
+        pulls = [multiplexer.ensure_replicated(mex, p, "zipwindow-host")
                  for p in pulls]
         W = pulls[0].num_workers
         flats = [[it for l in p.lists for it in l] for p in pulls]
@@ -343,10 +359,11 @@ class ZipWindowNode(DIABase):
                     for i, w in enumerate(self.window)])
                for j in range(n_out)]
         bounds = [(w * n_out) // W for w in range(W + 1)]
-        return HostShards(W, [out[bounds[w]:bounds[w + 1]]
-                              for w in range(W)])
+        return multiplexer.localize(
+            mex, HostShards(W, [out[bounds[w]:bounds[w + 1]]
+                                for w in range(W)]))
 
-    def _compute_device(self, pulls: List[DeviceShards]):
+    def _compute_device(self, pulls: List[DeviceShards], device_fn):
         mex = pulls[0].mesh_exec
         W = mex.num_workers
         n_out = min(p.total // w for p, w in zip(pulls, self.window))
@@ -370,8 +387,7 @@ class ZipWindowNode(DIABase):
                 a.tree)
             batched.append(tree)
 
-        tree = _fused_map_trees(mex, batched, self.device_fn,
-                                "zip_window")
+        tree = _fused_map_trees(mex, batched, device_fn, "zip_window")
         return DeviceShards(mex, tree, chunk_counts)
 
 

@@ -38,7 +38,7 @@ from jax import lax
 
 from ..common.config import round_up_pow2
 from ..parallel.mesh import AXIS, MeshExec
-from .shards import DeviceShards, HostShards
+from .shards import DeviceShards
 
 
 def _ex_cumsum(x):
@@ -569,12 +569,5 @@ def _exchange_ragged(mex: MeshExec, treedef, sorted_leaves, S: np.ndarray,
     return DeviceShards(mex, tree, new_counts)
 
 
-def host_exchange(shards: HostShards, dest_fn: Callable[[Any], int]
-                  ) -> HostShards:
-    """Host-path shuffle: bucket every item to its destination worker."""
-    W = shards.num_workers
-    buckets: List[List[Any]] = [[] for _ in range(W)]
-    for items in shards.lists:
-        for it in items:
-            buckets[dest_fn(it) % W].append(it)
-    return HostShards(W, buckets)
+# The host-path shuffle lives in data/multiplexer.py (host_exchange):
+# single-process bucketing plus the cross-process framed-batch plane.

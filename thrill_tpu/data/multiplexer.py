@@ -1,0 +1,252 @@
+"""Cross-process host-storage data plane: the Multiplexer equivalent.
+
+The reference moves serialized Blocks between hosts for every stream
+through its Multiplexer (reference: thrill/data/multiplexer.cpp:282-440
+— per-destination BlockWriters, framed block dispatch over the async
+group, receive-side BlockQueues with rank-ordered CatStream delivery).
+
+The TPU-native repo keeps the BULK data plane on XLA collectives
+(data/exchange.py); this module is its host-storage sibling for items
+that cannot live in device columns (strings, variable-shape pytrees).
+Invariant in multi-controller runs: a ``HostShards`` holds items ONLY
+for the workers whose device this process owns — every other worker's
+list is empty. The helpers here move items between processes over the
+authenticated TCP control plane (``mex.host_net``) and restore that
+invariant:
+
+* ``host_exchange``   — per-item destination shuffle (CatStream order:
+  each receiving worker sees batches in source-worker rank order).
+* ``ensure_replicated`` — every process gets every worker's items (the
+  demotion for host ops that genuinely need a global view).
+* ``localize``        — drop non-local lists (after a replicated
+  computation produced full lists identically on every process).
+* ``host_to_device``  — HostShards -> DeviceShards with globally agreed
+  capacity/counts/schema.
+
+Single-controller runs (every worker local) take the direct in-process
+paths — identical behavior to the pre-multiplexer code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..common.config import round_up_pow2
+from .shards import DeviceShards, HostShards
+
+_MISSING = "__thrill_tpu_missing__"
+
+
+def multiprocess(mex) -> bool:
+    """Is the host plane split across controllers?
+
+    Loud by design: a multi-process mesh WITHOUT a working host control
+    plane cannot run host-storage pipelines correctly (each process
+    holds only its workers' items and has no way to ship the rest), so
+    that configuration raises here rather than silently computing
+    per-process answers."""
+    if getattr(mex, "num_processes", 1) <= 1:
+        return False
+    _net(mex)
+    return True
+
+
+def _net(mex):
+    net = getattr(mex, "host_net", None)
+    if net is None or net.num_workers != mex.num_processes:
+        raise RuntimeError(
+            "multi-process host-storage pipeline needs the host control "
+            "plane: set THRILL_TPU_HOSTLIST/RANK/SECRET so every "
+            "controller joins the TCP group")
+    return net
+
+
+def local_worker_set(mex) -> set:
+    """Workers this process materializes host storage for. All of them
+    in a single-controller run; in a multi-controller run only the
+    local block (and the control plane must exist — see multiprocess)."""
+    if multiprocess(mex):
+        return set(mex.local_workers)
+    return set(range(mex.num_workers))
+
+
+def host_exchange(mex, shards: HostShards, dest_fn: Callable[[Any], int],
+                  reason: str = "host-exchange") -> HostShards:
+    """Move every item to the worker ``dest_fn(item) % W`` computes.
+
+    Single-controller: in-process bucketing (the old fast path).
+    Multi-controller: this process buckets its local workers' items,
+    ships each remote process one framed message of
+    ``{dest_worker: {src_worker: [items...]}}`` over the TCP group
+    (large frames ride the async dispatcher), and assembles its own
+    workers' receives in source-worker rank order — the CatStream
+    delivery guarantee (reference: thrill/data/cat_stream.hpp:155).
+    """
+    W = shards.num_workers
+    if not multiprocess(mex):
+        buckets: List[List[Any]] = [[] for _ in range(W)]
+        for items in shards.lists:
+            for it in items:
+                buckets[dest_fn(it) % W].append(it)
+        return HostShards(W, buckets)
+
+    net = _net(mex)
+    wp = mex.worker_process
+    me = mex.process_index
+    P = mex.num_processes
+    # bucket local items: {dest_worker: {src_worker: [items]}} per
+    # destination process (iterating local workers in rank order keeps
+    # each batch internally ordered)
+    outgoing: List[dict] = [dict() for _ in range(P)]
+    for sw in mex.local_workers:
+        for it in shards.lists[sw]:
+            dw = int(dest_fn(it)) % W
+            msg = outgoing[int(wp[dw])]
+            msg.setdefault(dw, {}).setdefault(sw, []).append(it)
+
+    received = [outgoing[me]]
+    sent_items = 0
+    group = net.group
+    for r in range(1, P):
+        to, frm = (me + r) % P, (me - r) % P
+        sent_items += sum(len(b) for dws in outgoing[to].values()
+                          for b in dws.values())
+        group.send_to(to, outgoing[to])
+        received.append(group.recv_from(frm))
+
+    lists: List[List[Any]] = [[] for _ in range(W)]
+    for w in mex.local_workers:
+        per_src: dict = {}
+        for msg in received:
+            per_src.update(msg.get(w, {}))
+        for sw in sorted(per_src):
+            lists[w].extend(per_src[sw])
+
+    mex.stats_exchanges += 1
+    mex.stats_items_moved += sent_items
+    log = getattr(mex, "logger", None)
+    if log is not None and log.enabled:
+        log.line(event="host_exchange", reason=reason,
+                 items_sent=sent_items, processes=P)
+    return HostShards(W, lists)
+
+
+def ensure_replicated(mex, shards: HostShards,
+                      reason: str = "host-global") -> HostShards:
+    """Every process gets every worker's items (identical full lists).
+
+    The demotion for host operators that need a global item view (EM
+    sort, zip alignment, generic prefix sums...). Idempotent: each
+    worker's list is taken from its owning process only.
+    """
+    if not multiprocess(mex):
+        return shards
+    net = _net(mex)
+    W = shards.num_workers
+    local = {w: shards.lists[w] for w in mex.local_workers
+             if shards.lists[w]}
+    gathered = net.all_gather(local)
+    lists: List[List[Any]] = [[] for _ in range(W)]
+    for msg in gathered:
+        for w, items in msg.items():
+            lists[int(w)] = list(items)
+    log = getattr(mex, "logger", None)
+    if log is not None and log.enabled:
+        log.line(event="host_replicate", reason=reason,
+                 items=sum(len(l) for l in lists))
+    return HostShards(W, lists)
+
+
+def localize(mex, shards: HostShards) -> HostShards:
+    """Restore the local-only invariant after a replicated computation
+    produced identical full lists on every process."""
+    if not multiprocess(mex):
+        return shards
+    local = set(mex.local_workers)
+    return HostShards(shards.num_workers,
+                      [shards.lists[w] if w in local else []
+                       for w in range(shards.num_workers)])
+
+
+def global_counts(mex, shards: HostShards) -> np.ndarray:
+    """Per-worker item counts agreed across processes."""
+    if not multiprocess(mex):
+        return shards.counts
+    net = _net(mex)
+    counts = np.zeros(shards.num_workers, dtype=np.int64)
+    local = {w: len(shards.lists[w]) for w in mex.local_workers}
+    for msg in net.all_gather(local):
+        for w, n in msg.items():
+            counts[int(w)] = int(n)
+    return counts
+
+
+def global_total(mex, shards: HostShards) -> int:
+    if not multiprocess(mex):
+        return shards.total
+    return int(_net(mex).all_reduce(
+        sum(len(shards.lists[w]) for w in mex.local_workers)))
+
+
+def all_items(mex, shards: HostShards) -> List[Any]:
+    """Every item in worker-rank order, on every process."""
+    return [it for l in ensure_replicated(mex, shards, "all-items").lists
+            for it in l]
+
+
+def net_fold(mex, local: Any, op: Callable[[Any, Any], Any],
+             empty: bool = False) -> Any:
+    """Fold per-process partial results over the control plane.
+
+    ``local`` is this process's partial (ignored when ``empty``);
+    returns the rank-ordered fold of all non-empty partials, or raises
+    if every process was empty."""
+    if not multiprocess(mex):
+        if empty:
+            raise ValueError("fold over an empty DIA")
+        return local
+    vals = _net(mex).all_gather(_MISSING if empty else local)
+    vals = [v for v in vals if not (isinstance(v, str) and v == _MISSING)]
+    if not vals:
+        raise ValueError("fold over an empty DIA")
+    acc = vals[0]
+    for v in vals[1:]:
+        acc = op(acc, v)
+    return acc
+
+
+def host_to_device(mex, shards: HostShards) -> DeviceShards:
+    """HostShards -> DeviceShards in a multi-controller run.
+
+    Three things must be agreed across processes before the device_put:
+    the padded capacity (shapes must match), the global per-worker
+    counts (each process only knows its own), and the item schema (a
+    process whose workers are all empty must still build correctly
+    shaped zero blocks)."""
+    counts = global_counts(mex, shards)
+    cap = max(1, round_up_pow2(int(counts.max()) if len(counts) else 1))
+    net = _net(mex)
+    sample = next((items[0] for w in mex.local_workers
+                   for items in [shards.lists[w]] if items), None)
+    samples = net.all_gather(_MISSING if sample is None else sample)
+    sample = next((s for s in samples
+                   if not (isinstance(s, str) and s == _MISSING)), None)
+    if sample is None:
+        raise ValueError("cannot infer schema of an entirely empty DIA")
+    import jax
+    treedef = jax.tree.structure(sample)
+    local = set(mex.local_workers)
+    per_worker = []
+    for w in range(shards.num_workers):
+        items = shards.lists[w] if w in local else []
+        if items:
+            cols = [np.asarray([jax.tree.leaves(it)[i] for it in items])
+                    for i in range(treedef.num_leaves)]
+        else:
+            cols = [np.asarray([jax.tree.leaves(sample)[i]])[:0]
+                    for i in range(treedef.num_leaves)]
+        per_worker.append(jax.tree.unflatten(treedef, cols))
+    return DeviceShards.from_worker_arrays(mex, per_worker, cap=cap,
+                                           counts=counts)

@@ -96,13 +96,20 @@ class DeviceShards:
     # -- conversion -----------------------------------------------------
     @staticmethod
     def from_worker_arrays(mesh_exec: MeshExec, per_worker: Sequence[Any],
-                           cap: int = 0) -> "DeviceShards":
-        """Build from W per-worker pytrees of numpy arrays (item axis 0)."""
+                           cap: int = 0,
+                           counts: Optional[np.ndarray] = None
+                           ) -> "DeviceShards":
+        """Build from W per-worker pytrees of numpy arrays (item axis 0).
+
+        ``counts`` overrides the per-worker lengths (multi-controller
+        builds pass globally agreed counts while supplying data only
+        for the workers this process owns)."""
         W = mesh_exec.num_workers
         assert len(per_worker) == W
-        counts = np.array(
-            [np.shape(tree_leaves(t)[0])[0] if tree_leaves(t) else 0
-             for t in per_worker], dtype=np.int64)
+        if counts is None:
+            counts = np.array(
+                [np.shape(tree_leaves(t)[0])[0] if tree_leaves(t) else 0
+                 for t in per_worker], dtype=np.int64)
         if cap <= 0:
             cap = max(1, round_up_pow2(int(counts.max()) if len(counts) else 1))
 
@@ -128,13 +135,44 @@ class DeviceShards:
                       for w in range(W)]
         return DeviceShards.from_worker_arrays(mesh_exec, per_worker)
 
-    def to_worker_arrays(self) -> List[Any]:
-        """Fetch to host: W pytrees of numpy arrays trimmed to counts."""
+    def to_worker_arrays(self, local_only: bool = False) -> List[Any]:
+        """Fetch to host: W pytrees of numpy arrays trimmed to counts.
+
+        ``local_only`` (multi-controller): read only this process's
+        addressable device shards — no cross-process allgather of the
+        bulk data — and return ``None`` for non-local workers."""
+        if local_only and getattr(self.mesh_exec, "num_processes", 1) > 1:
+            return self._local_worker_arrays()
         host_tree = self.mesh_exec.fetch_tree(self.tree)
         out = []
         for w in range(self.num_workers):
             c = int(self.counts[w])
             out.append(tree_map(lambda a: a[w, :c], host_tree))
+        return out
+
+    def _local_worker_arrays(self) -> List[Any]:
+        """Per-worker arrays from addressable shards only (None for
+        workers owned by other processes)."""
+        leaves, treedef = jax.tree.flatten(self.tree)
+        per_leaf: List[dict] = []
+        for leaf in leaves:
+            m: dict = {}
+            for sh in leaf.addressable_shards:
+                w0 = sh.index[0].start or 0
+                data = np.asarray(sh.data)
+                for i in range(data.shape[0]):
+                    m[w0 + i] = data[i]
+            per_leaf.append(m)
+        out: List[Any] = []
+        local = set(per_leaf[0]) if per_leaf else set(
+            getattr(self.mesh_exec, "local_workers", []))
+        for w in range(self.num_workers):
+            if w not in local:
+                out.append(None)
+                continue
+            c = int(self.counts[w])
+            out.append(jax.tree.unflatten(
+                treedef, [pl[w][:c] for pl in per_leaf]))
         return out
 
     def to_global_numpy(self) -> Any:
@@ -157,7 +195,13 @@ class DeviceShards:
                      items=int(self.counts.sum()))
         leaf_struct = jax.tree.structure(0)
         lists: List[List[Any]] = []
-        for tree in self.to_worker_arrays():
+        # multi-controller: materialize only this process's workers
+        # (the host-storage invariant, data/multiplexer.py) — the bulk
+        # data never crosses processes on a demotion
+        for tree in self.to_worker_arrays(local_only=True):
+            if tree is None:
+                lists.append([])
+                continue
             leaves, treedef = jax.tree.flatten(tree)
             if not leaves:
                 lists.append([])
@@ -192,6 +236,11 @@ class HostShards:
 
     def to_device(self, mesh_exec: MeshExec) -> DeviceShards:
         """Columnarize (requires items be fixed-shape pytrees of numbers)."""
+        if getattr(mesh_exec, "num_processes", 1) > 1:
+            # capacity/counts/schema must be agreed across controllers
+            from . import multiplexer
+            if multiplexer.multiprocess(mesh_exec):
+                return multiplexer.host_to_device(mesh_exec, self)
         per_worker = []
         for items in self.lists:
             if items:

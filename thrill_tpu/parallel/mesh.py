@@ -65,6 +65,21 @@ class MeshExec:
         self.slice_id = self._detect_slices()
         self.num_slices = int(self.slice_id.max()) + 1 \
             if len(self.slice_id) else 1
+        # controller topology: which PROCESS owns each worker's device.
+        # The host-storage data plane (data/multiplexer.py) keeps each
+        # process holding only its own workers' items and ships the
+        # rest over the host control plane (the reference's Multiplexer
+        # moving serialized Blocks between hosts,
+        # thrill/data/multiplexer.cpp:282-440).
+        self.worker_process = np.array(
+            [getattr(d, "process_index", 0) for d in self.devices],
+            dtype=np.int64)
+        self.process_index = int(jax.process_index())
+        self.num_processes = len(set(self.worker_process.tolist())) or 1
+        # host-plane collectives between processes (FlowControlChannel
+        # over the authenticated TCP group); Context wires it so the
+        # host-storage layer can reach the other controllers
+        self.host_net = None
 
     def _detect_slices(self) -> np.ndarray:
         import os
@@ -93,6 +108,18 @@ class MeshExec:
             uniq = {s: n for n, s in enumerate(dict.fromkeys(ids))}
             return np.array([uniq[i] for i in ids], dtype=np.int64)
         return np.zeros(W, dtype=np.int64)
+
+    # -- controller topology -------------------------------------------
+    @property
+    def multiprocess(self) -> bool:
+        return self.num_processes > 1
+
+    @property
+    def local_workers(self):
+        """Worker ids whose device this process owns (all of them in a
+        single-controller run)."""
+        return [w for w in range(self.num_workers)
+                if self.worker_process[w] == self.process_index]
 
     # -- shardings ------------------------------------------------------
     @property

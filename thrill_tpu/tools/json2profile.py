@@ -1,10 +1,16 @@
-"""Render a JSON event log into a standalone HTML timeline report.
+"""Render JSON event logs into a standalone HTML timeline report.
 
-Equivalent of the reference's misc/json2profile.cpp (1.5k LoC C++ that
-parses JsonLogger output into an HTML report with CPU/net/disk/stage
-timelines). Usage:
+Equivalent of the reference's misc/json2profile.cpp (the HTML report
+with CPU/net/disk/stage timelines). Sections: stage timeline, stage
+summary table (duration/items/rate/per-worker balance), stage x worker
+item matrix, exchange volume, per-worker exchange lanes, memory
+pressure, host CPU + RAM + HBM overlay. Usage:
 
-    python -m thrill_tpu.tools.json2profile LOG.json > report.html
+    python -m thrill_tpu.tools.json2profile LOG.json [LOG2.json ...] \
+        > report.html
+
+Multiple logs (one per host of a multi-controller run) merge on the
+shared timestamp axis; per-host samples are tagged by file order.
 """
 
 from __future__ import annotations
@@ -15,16 +21,26 @@ import sys
 from typing import List
 
 
-def load_events(path: str) -> List[dict]:
+def load_events(path: str, host: int = 0) -> List[dict]:
     events = []
     with open(path) as f:
         for line in f:
             line = line.strip()
             if line:
                 try:
-                    events.append(json.loads(line))
+                    e = json.loads(line)
+                    e.setdefault("host", host)
+                    events.append(e)
                 except json.JSONDecodeError:
                     continue
+    return events
+
+
+def load_many(paths: List[str]) -> List[dict]:
+    events = []
+    for h, p in enumerate(paths):
+        events.extend(load_events(p, host=h))
+    events.sort(key=lambda e: e.get("ts", 0))
     return events
 
 
@@ -41,13 +57,15 @@ def render_html(events: List[dict]) -> str:
                 start=t, label=e.get("node"))
         elif e.get("event") == "node_execute_done":
             nodes.setdefault(e.get("dia_id"), {}).update(
-                end=t, items=e.get("items"))
+                end=t, items=e.get("items"),
+                per_worker=e.get("per_worker"))
         elif e.get("event") == "profile":
             profiles.append((t, e))
-        elif e.get("event") == "exchange":
+        elif e.get("event") in ("exchange", "host_exchange"):
             exchanges.append((t, e))
         elif e.get("event") in ("hbm_spill", "hbm_restore",
-                                "mem_negotiate", "device_to_host"):
+                                "mem_negotiate", "device_to_host",
+                                "host_replicate"):
             memory.append((t, e))
 
     rows = []
@@ -73,17 +91,6 @@ def render_html(events: List[dict]) -> str:
             f'{f" · {items} items" if items is not None else ""}</span>'
             f'</div>')
 
-    cpu_pts = [(t, e.get("cpu_util")) for t, e in profiles
-               if e.get("cpu_util") is not None]
-    cpu_line = ""
-    if cpu_pts:
-        pts = " ".join(f"{100 * t / total:.2f},{40 - 40 * u:.1f}"
-                       for t, u in cpu_pts)
-        cpu_line = (f'<h2>host CPU utilization</h2>'
-                    f'<svg viewBox="0 0 100 40" class="cpu">'
-                    f'<polyline fill="none" stroke="#07c" stroke-width="0.5"'
-                    f' points="{pts}"/></svg>')
-
     return f"""<!doctype html><html><head><meta charset="utf-8">
 <title>thrill_tpu profile</title><style>
 body {{ font: 13px monospace; margin: 2em; }}
@@ -95,17 +102,130 @@ body {{ font: 13px monospace; margin: 2em; }}
 .dur {{ width: 16em; text-align: right; color: #666; }}
 .cpu {{ width: 100%; height: 80px; background: #f7f7f7; }}
 .vol {{ width: 100%; height: 120px; background: #f7f7f7; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #ccc; padding: 2px 8px; text-align: right; }}
+th {{ background: #eee; }}
+td.l, th.l {{ text-align: left; }}
+td.hm {{ min-width: 3em; }}
 </style></head><body>
 <h1>thrill_tpu execution profile</h1>
 <p>{len(rows)} executed nodes, total span {total:.3f}s,
 {len(profiles)} profile samples, {len(exchanges)} exchanges</p>
 <h2>stage timeline</h2>
 {''.join(bars)}
+{_render_stage_table(rows, exchanges, nodes)}
+{_render_stage_worker_matrix(nodes)}
 {_render_exchange_volume(exchanges, total)}
 {_render_worker_lanes(exchanges, total)}
 {_render_memory_events(memory, total)}
-{cpu_line}
+{_render_host_overlay(profiles, total)}
 </body></html>"""
+
+
+def _render_stage_table(rows, exchanges, nodes) -> str:
+    """Per-stage summary (reference: the stage table of
+    misc/json2profile.cpp): duration, items, throughput, bytes shipped
+    by exchanges during the stage, and worker balance (max/mean of the
+    per-worker item counts — 1.0 is perfectly even)."""
+    if not rows:
+        return ""
+    trs = []
+    for nid, label, start, dur, items in rows:
+        xb = sum(e.get("bytes", 0) or 0 for t, e in exchanges
+                 if start <= t <= start + dur)
+        rate = f"{items / dur / 1e6:.2f}" if items and dur > 0 else ""
+        pw = nodes.get(nid, {}).get("per_worker")
+        bal = ""
+        if pw and sum(pw):
+            mean = sum(pw) / len(pw)
+            bal = f"{max(pw) / mean:.2f}" if mean else ""
+        trs.append(
+            f'<tr><td class="l">#{nid} {html.escape(str(label))}</td>'
+            f'<td>{dur * 1e3:.1f}</td>'
+            f'<td>{items if items is not None else ""}</td>'
+            f'<td>{rate}</td><td>{xb / 1e6:.2f}</td><td>{bal}</td></tr>')
+    return ('<h2>stage summary</h2><table><tr><th class="l">stage</th>'
+            '<th>ms</th><th>items</th><th>Mitems/s</th>'
+            '<th>exchange MB</th><th>balance</th></tr>'
+            + "".join(trs) + "</table>")
+
+
+def _render_stage_worker_matrix(nodes) -> str:
+    """Stage x worker item matrix: one row per executed stage, one cell
+    per worker shaded by that worker's share of the stage's items —
+    the reference report's per-worker lanes, in matrix form."""
+    entries = [(nid, n) for nid, n in sorted(nodes.items(),
+                                             key=lambda kv: (kv[0] is None,
+                                                             kv[0]))
+               if nid is not None and n.get("per_worker")]
+    if not entries:
+        return ""
+    W = max(len(n["per_worker"]) for _, n in entries)
+    head = "".join(f"<th>w{w}</th>" for w in range(W))
+    trs = []
+    for nid, n in entries:
+        pw = n["per_worker"]
+        peak = max(pw) or 1
+        cells = []
+        for w in range(W):
+            v = pw[w] if w < len(pw) else 0
+            alpha = v / peak if peak else 0
+            cells.append(
+                f'<td class="hm" style="background:rgba(0,119,204,'
+                f'{alpha:.2f})">{v}</td>')
+        trs.append(f'<tr><td class="l">#{nid} '
+                   f'{html.escape(str(n.get("label", "?")))}</td>'
+                   + "".join(cells) + "</tr>")
+    return ('<h2>stage x worker items</h2><table>'
+            f'<tr><th class="l">stage</th>{head}</tr>'
+            + "".join(trs) + "</table>")
+
+
+def _render_host_overlay(profiles, total: float) -> str:
+    """Host CPU utilization, host RAM in use and device HBM in use on
+    one time axis, one polyline set per host (multi-controller logs
+    merge into one report)."""
+    if not profiles:
+        return ""
+    hosts = sorted({e.get("host", 0) for _, e in profiles})
+    palette = ["#07c", "#e60", "#2a4", "#a3c", "#888"]
+    out = []
+
+    def series(pred, norm, title):
+        lines, legend = [], []
+        for i, h in enumerate(hosts):
+            pts = [(t, pred(e)) for t, e in profiles
+                   if e.get("host", 0) == h and pred(e) is not None]
+            if not pts:
+                continue
+            top = norm(pts)
+            if not top:
+                continue
+            s = " ".join(f"{100 * t / total:.2f},"
+                         f"{78 - 74 * min(v / top, 1.0):.1f}"
+                         for t, v in pts)
+            color = palette[i % len(palette)]
+            lines.append(f'<polyline fill="none" stroke="{color}" '
+                         f'stroke-width="0.5" points="{s}"/>')
+            legend.append(f'<span style="color:{color}">host{h}</span>')
+        if not lines:
+            return ""
+        return (f'<h2>{title} ({" ".join(legend)})</h2>'
+                f'<svg viewBox="0 0 100 80" class="cpu" '
+                f'preserveAspectRatio="none">{"".join(lines)}</svg>')
+
+    out.append(series(lambda e: e.get("cpu_util"), lambda p: 1.0,
+                      "host CPU utilization"))
+    out.append(series(
+        lambda e: (e["host_mem_total"] - e["host_mem_available"])
+        if e.get("host_mem_total") and e.get("host_mem_available")
+        is not None else None,
+        lambda p: max(v for _, v in p),
+        "host RAM in use"))
+    out.append(series(lambda e: e.get("bytes_in_use"),
+                      lambda p: max((v for _, v in p), default=0) or None,
+                      "device HBM in use"))
+    return "".join(out)
 
 
 def _render_memory_events(memory, total: float) -> str:
@@ -193,10 +313,11 @@ def _render_worker_lanes(exchanges, total: float) -> str:
 
 
 def main() -> None:
-    if len(sys.argv) != 2:
-        print("usage: json2profile LOG.json > report.html", file=sys.stderr)
+    if len(sys.argv) < 2:
+        print("usage: json2profile LOG.json [LOG2.json ...] "
+              "> report.html", file=sys.stderr)
         sys.exit(2)
-    sys.stdout.write(render_html(load_events(sys.argv[1])))
+    sys.stdout.write(render_html(load_many(sys.argv[1:])))
 
 
 if __name__ == "__main__":
