@@ -1,0 +1,118 @@
+// Host-side sort kernels for the CPU backend.
+//
+// The reference framework's local-sort phase runs a tuned host sort
+// (sort_algorithm_ = std::sort / tlx radix variants, selected per key
+// type). On the CPU backend our "device" buffers are host memory, so
+// the same engine choice applies: a stable LSD radix argsort over the
+// already-encoded lexicographic uint64 key words, plus a row gather
+// for the single payload permutation. On TPU the device engines in
+// thrill_tpu/core/device_sort.py run instead; this file is never used
+// there.
+//
+// Layout notes:
+// * 16-bit digits: 65536-entry u32 histogram (256 KiB) per pass.
+// * Uniform-digit passes are detected from the histogram and skipped
+//   (zero-padded packed byte keys make most high/low passes uniform).
+// * Stability comes from the counting scatter being order-preserving;
+//   the caller needs no tie-break iota word.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int kDigitBits = 16;
+constexpr uint32_t kBuckets = 1u << kDigitBits;
+constexpr uint64_t kDigitMask = kBuckets - 1;
+
+}  // namespace
+
+extern "C" {
+
+// Stable argsort of n items keyed lexicographically by nwords uint64
+// words (words[w][i]; w = 0 is the MOST significant word). On return
+// perm_out[r] = original index of the r-th smallest item. Returns the
+// number of counting passes actually performed (>= 0), or -1 on bad
+// arguments.
+int radix_argsort_u64(int64_t n, int32_t nwords, const uint64_t** words,
+                      uint32_t* perm_out) {
+  if (n < 0 || nwords <= 0 || n > static_cast<int64_t>(UINT32_MAX)) {
+    return -1;
+  }
+  std::vector<uint32_t> tmp(static_cast<size_t>(n));
+  std::vector<uint32_t> hist(kBuckets);
+  uint32_t* cur = perm_out;
+  uint32_t* alt = tmp.data();
+  for (int64_t i = 0; i < n; ++i) cur[i] = static_cast<uint32_t>(i);
+
+  int passes = 0;
+  // least-significant word first, least-significant digit first
+  for (int w = nwords - 1; w >= 0; --w) {
+    const uint64_t* col = words[w];
+    for (int shift = 0; shift < 64; shift += kDigitBits) {
+      std::memset(hist.data(), 0, kBuckets * sizeof(uint32_t));
+      for (int64_t i = 0; i < n; ++i) {
+        ++hist[(col[cur[i]] >> shift) & kDigitMask];
+      }
+      // skip uniform passes (common: zero-padded key bytes)
+      if (n > 0 && hist[(col[cur[0]] >> shift) & kDigitMask] ==
+                       static_cast<uint32_t>(n)) {
+        continue;
+      }
+      uint32_t sum = 0;
+      for (uint32_t b = 0; b < kBuckets; ++b) {
+        uint32_t c = hist[b];
+        hist[b] = sum;
+        sum += c;
+      }
+      for (int64_t i = 0; i < n; ++i) {
+        uint32_t idx = cur[i];
+        alt[hist[(col[idx] >> shift) & kDigitMask]++] = idx;
+      }
+      std::swap(cur, alt);
+      ++passes;
+    }
+  }
+  if (cur != perm_out) {
+    std::memcpy(perm_out, cur, static_cast<size_t>(n) * sizeof(uint32_t));
+  }
+  return passes;
+}
+
+// dst row r = src row perm[r]; rows are row_bytes wide.
+void gather_rows_u8(int64_t n, int64_t row_bytes, const uint8_t* src,
+                    const uint32_t* perm, uint8_t* dst) {
+  switch (row_bytes) {
+    case 1: {
+      for (int64_t r = 0; r < n; ++r) dst[r] = src[perm[r]];
+      return;
+    }
+    case 2: {
+      const uint16_t* s = reinterpret_cast<const uint16_t*>(src);
+      uint16_t* d = reinterpret_cast<uint16_t*>(dst);
+      for (int64_t r = 0; r < n; ++r) d[r] = s[perm[r]];
+      return;
+    }
+    case 4: {
+      const uint32_t* s = reinterpret_cast<const uint32_t*>(src);
+      uint32_t* d = reinterpret_cast<uint32_t*>(dst);
+      for (int64_t r = 0; r < n; ++r) d[r] = s[perm[r]];
+      return;
+    }
+    case 8: {
+      const uint64_t* s = reinterpret_cast<const uint64_t*>(src);
+      uint64_t* d = reinterpret_cast<uint64_t*>(dst);
+      for (int64_t r = 0; r < n; ++r) d[r] = s[perm[r]];
+      return;
+    }
+    default: {
+      for (int64_t r = 0; r < n; ++r) {
+        std::memcpy(dst + r * row_bytes,
+                    src + static_cast<int64_t>(perm[r]) * row_bytes,
+                    static_cast<size_t>(row_bytes));
+      }
+    }
+  }
+}
+}  // extern "C"

@@ -234,10 +234,15 @@ class GroupToIndexNode(DIABase):
 
         from ...data import multiplexer
 
+        # out-of-range indices are dropped AT THE SOURCE — never
+        # serialized or shipped cross-process just to be filtered on
+        # arrival
+        shards = HostShards(W, [[it for it in l
+                                 if 0 <= int(index_fn(it)) < n]
+                                for l in shards.lists])
+
         def dest(it):
             i = int(index_fn(it))
-            if not 0 <= i < n:
-                return W - 1        # dropped below; any owner works
             return int(np.searchsorted(bounds[1:], i, side="right"))
 
         shards = multiplexer.host_exchange(mex, shards, dest,

@@ -251,11 +251,14 @@ class ReduceNode(DIABase):
                 k = key_fn(it)
                 table[k] = reduce_fn(table[k], it) if k in table else it
             pre_tables.append(table)
+        # one hash per key, computed once and carried with the item
+        # through detection, keep-check and the shuffle dest
+        pre_hashes = [{k: hashing.stable_host_hash(k) for k in t}
+                      for t in pre_tables]
         non_unique = None
         if self.dup_detection and W > 1:
             from ...core import duplicate_detection as dd
-            hash_lists = [[hashing.stable_host_hash(k) for k in t]
-                          for t in pre_tables]
+            hash_lists = [list(ph.values()) for ph in pre_hashes]
             if multiplexer.multiprocess(mex):
                 # fingerprint exchange over the control plane: ship the
                 # hashes (not the items) so every process agrees on the
@@ -269,32 +272,33 @@ class ReduceNode(DIABase):
                 hash_lists = merged
             non_unique = dd.find_non_unique_hashes(hash_lists)
         # shuffle + post-phase; globally-unique keys stay local. Items
-        # travel as (src_worker_kept, key, value) so the PRE-PHASE key
-        # stays authoritative (reduce_fn need not preserve key_fn — the
-        # reference's tables likewise carry the extracted key) and the
-        # multiplexer ships them cross-process (CatStream order).
+        # travel as (src_worker_kept, hash, key, value) so the
+        # PRE-PHASE key stays authoritative (reduce_fn need not
+        # preserve key_fn — the reference's tables likewise carry the
+        # extracted key) and the precomputed hash rides along instead
+        # of being recomputed per routing decision.
         def dest(kv):
-            keep, k, _ = kv
+            keep, h, _, _ = kv
             if keep is not None:
                 return keep
-            return hashing.stable_host_hash(k) % W
+            return h % W
 
         pre_lists = []
         for w, table in enumerate(pre_tables):
             lst = []
             for k, v in table.items():
+                h = pre_hashes[w][k]
                 keep = None
-                if non_unique is not None and dd.is_unique(
-                        hashing.stable_host_hash(k), non_unique):
+                if non_unique is not None and dd.is_unique(h, non_unique):
                     keep = w              # globally unique: stays local
-                lst.append((keep, k, v))
+                lst.append((keep, h, k, v))
             pre_lists.append(lst)
         ex = multiplexer.host_exchange(mex, HostShards(W, pre_lists),
                                        dest, reason="reduce")
         post_lists = []
         for items in ex.lists:
             t: dict = {}
-            for _, k, v in items:
+            for _, _, k, v in items:
                 t[k] = reduce_fn(t[k], v) if k in t else v
             post_lists.append(list(t.values()))
         return HostShards(W, post_lists)

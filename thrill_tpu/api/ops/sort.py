@@ -270,6 +270,14 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
     full = bool(np.all(shards.counts == cap))
 
     if W == 1:
+        # CPU backend: device buffers are host memory, so the local
+        # sort engine is the native stable radix sort — the same engine
+        # class the reference picks for its in-RAM run sorts
+        # (sort_algorithm_, api/sort.hpp). On TPU the jitted path below
+        # runs instead.
+        out = _host_radix_w1(mex, shards, key_fn, leaves, treedef, full)
+        if out is not None:
+            return out
         # single worker: one fused program — key-only argsort, then the
         # single payload gather. No samples, no splitters, no exchange.
         key1 = ("sort_w1", token, cap, full, treedef,
@@ -443,6 +451,42 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
     out3 = f3(carrier.counts_device(), *leaves3)
     tree = jax.tree.unflatten(treedef, list(out3))
     return DeviceShards(mex, tree, carrier.counts.copy())
+
+
+def _host_radix_w1(mex, shards: DeviceShards, key_fn, leaves, treedef,
+                   full: bool) -> Optional[DeviceShards]:
+    """Single-worker sort on the CPU backend via the native stable LSD
+    radix engine (core/host_radix.py). Returns None when inapplicable
+    (non-CPU platform, native toolchain missing, or a key_fn that only
+    works under tracing) so the caller falls through to the jitted
+    engine."""
+    from ...core import host_radix
+
+    if (mex.devices[0].platform != "cpu"
+            or jax.default_backend() != "cpu"
+            or not host_radix.available()):
+        return None
+    cap = shards.cap
+    count = int(shards.counts[0])
+    leaves_np = [np.asarray(l)[0] for l in leaves]       # [cap, ...]
+    tree = jax.tree.unflatten(treedef, leaves_np)
+    try:
+        sort_words = keymod.encode_key_words_np(key_fn(tree))
+    except Exception:
+        return None                                      # trace-only key_fn
+    if not full:
+        # validity as the most significant word: invalid rows sort last;
+        # radix stability keeps equal keys in global-index order, so no
+        # iota tie-break word is needed
+        sort_words = [(np.arange(cap) >= count).astype(np.uint64)] \
+            + sort_words
+    perm = host_radix.radix_argsort(sort_words)
+    out_leaves = [
+        host_radix.gather_rows(np.ascontiguousarray(l), perm)[None]
+        for l in leaves_np]
+    tree_out = jax.tree.unflatten(treedef,
+                                  [mex.put(l) for l in out_leaves])
+    return DeviceShards(mex, tree_out, shards.counts.copy())
 
 
 def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,

@@ -1,0 +1,90 @@
+"""Native stable radix argsort for the CPU backend's local-sort phase.
+
+On the CPU backend, "device" buffers live in host memory, so the local
+sort engine can be the same kind the reference uses for its in-RAM run
+sorts (sort_algorithm_ = std::sort / tlx radix variants, selected per
+key type in thrill/api/sort.hpp): a C++ stable LSD radix sort over the
+encoded lexicographic uint64 key words (native/hostsort.cpp), plus one
+native row gather for the payload permutation. On TPU the device
+engines in core/device_sort.py run; this module is never used there.
+
+Stability makes the global-index tie-break implicit: equal keys keep
+their input order, which at W == 1 is exactly global-index order.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        from ..common.native_build import build_and_load
+        lib = build_and_load("hostsort.cpp")
+        if lib is not None:
+            lib.radix_argsort_u64.restype = ctypes.c_int
+            lib.radix_argsort_u64.argtypes = [
+                ctypes.c_int64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p]
+            lib.gather_rows_u8.restype = None
+            lib.gather_rows_u8.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    if os.environ.get("THRILL_TPU_HOST_RADIX", "1") == "0":
+        return False
+    return _load() is not None
+
+
+def radix_argsort(words: List[np.ndarray]) -> np.ndarray:
+    """Stable argsort by lexicographic uint64 words (words[0] most
+    significant). Returns uint32 permutation (sorted -> original)."""
+    lib = _load()
+    assert lib is not None
+    n = int(words[0].shape[0])
+    cols = [np.ascontiguousarray(w, dtype=np.uint64) for w in words]
+    ptrs = (ctypes.c_void_p * len(cols))(
+        *[c.ctypes.data_as(ctypes.c_void_p).value for c in cols])
+    perm = np.empty(n, dtype=np.uint32)
+    rc = lib.radix_argsort_u64(
+        n, len(cols), ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
+        perm.ctypes.data_as(ctypes.c_void_p))
+    if rc < 0:
+        raise ValueError(f"radix_argsort_u64 failed (rc={rc}, n={n})")
+    return perm
+
+
+def gather_rows(arr: np.ndarray, perm: np.ndarray) -> np.ndarray:
+    """arr[perm] along axis 0 via the native row gather (falls back to
+    numpy take for non-contiguous inputs)."""
+    lib = _load()
+    if lib is None or not arr.flags.c_contiguous:
+        return np.take(arr, perm, axis=0)
+    n = int(perm.shape[0])
+    row_bytes = int(arr.dtype.itemsize * int(np.prod(arr.shape[1:], dtype=np.int64)))
+    if row_bytes == 0 or n == 0:
+        return np.take(arr, perm, axis=0)
+    out = np.empty((n,) + arr.shape[1:], dtype=arr.dtype)
+    lib.gather_rows_u8(
+        n, row_bytes, arr.ctypes.data_as(ctypes.c_void_p),
+        np.ascontiguousarray(perm, dtype=np.uint32).ctypes.data_as(
+            ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p))
+    return out

@@ -63,6 +63,43 @@ def encode_key_words(key_tree: Any) -> List[jnp.ndarray]:
     return words
 
 
+def encode_key_words_np(key_tree: Any) -> List[np.ndarray]:
+    """Host mirror of :func:`encode_key_words` over numpy leaves —
+    identical word values, no XLA dispatch (used by the CPU backend's
+    native radix sort path, where eager jnp op overhead would dominate
+    the sort itself)."""
+    words: List[np.ndarray] = []
+    for leaf in jax.tree.leaves(key_tree):
+        leaf = np.asarray(leaf)
+        dt = leaf.dtype
+        if dt == np.uint8 and leaf.ndim == 2:
+            n, L = leaf.shape
+            nwords = -(-L // 8)
+            padded = np.zeros((n, nwords * 8), dtype=np.uint8)
+            padded[:, :L] = leaf
+            packed = padded.view(np.dtype(">u8")).astype(np.uint64)
+            words.extend(packed[:, i] for i in range(nwords))
+        elif dt == np.uint8 and leaf.ndim > 2:
+            # >2-D byte keys produce non-flat words in the traced
+            # encoder; no host mirror — let callers fall back to it
+            raise TypeError("encode_key_words_np: >2-D uint8 key leaf")
+        elif np.issubdtype(dt, np.unsignedinteger):
+            words.append(leaf.astype(np.uint64))
+        elif np.issubdtype(dt, np.signedinteger) or dt == np.bool_:
+            words.append(leaf.astype(np.int64).astype(np.uint64)
+                         ^ np.uint64(1 << 63))
+        elif np.issubdtype(dt, np.floating):
+            bits = leaf.astype(np.float64).view(np.uint64)
+            sign = bits >> np.uint64(63)
+            words.append(np.where(sign == 1, ~bits,
+                                  bits | np.uint64(1 << 63)))
+        else:
+            raise TypeError(f"unsupported key leaf dtype {dt}")
+    if not words:
+        raise ValueError("key function produced an empty pytree")
+    return words
+
+
 def _pack_bytes(leaf: jnp.ndarray) -> List[jnp.ndarray]:
     """[n, L] uint8 -> ceil(L/8) big-endian uint64 [n] words."""
     n, L = leaf.shape[0], leaf.shape[-1]

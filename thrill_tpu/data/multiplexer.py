@@ -236,17 +236,14 @@ def host_to_device(mex, shards: HostShards) -> DeviceShards:
     if sample is None:
         raise ValueError("cannot infer schema of an entirely empty DIA")
     import jax
+
+    from .shards import columnarize
     treedef = jax.tree.structure(sample)
     local = set(mex.local_workers)
+    empty = jax.tree.map(lambda a: np.asarray([a])[:0], sample)
     per_worker = []
     for w in range(shards.num_workers):
         items = shards.lists[w] if w in local else []
-        if items:
-            cols = [np.asarray([jax.tree.leaves(it)[i] for it in items])
-                    for i in range(treedef.num_leaves)]
-        else:
-            cols = [np.asarray([jax.tree.leaves(sample)[i]])[:0]
-                    for i in range(treedef.num_leaves)]
-        per_worker.append(jax.tree.unflatten(treedef, cols))
+        per_worker.append(columnarize(items, treedef) if items else empty)
     return DeviceShards.from_worker_arrays(mex, per_worker, cap=cap,
                                            counts=counts)

@@ -34,6 +34,16 @@ def tree_leaves(tree):
     return jax.tree.leaves(tree)
 
 
+def columnarize(items, treedef):
+    """List of fixed-shape pytree items -> one pytree of stacked
+    columns. Flattens each item once (shared by HostShards.to_device
+    and the multi-controller multiplexer.host_to_device)."""
+    flat = [jax.tree.leaves(it) for it in items]
+    cols = [np.asarray([f[i] for f in flat])
+            for i in range(treedef.num_leaves)]
+    return jax.tree.unflatten(treedef, cols)
+
+
 def tree_map(fn, *trees):
     return jax.tree.map(fn, *trees)
 
@@ -244,10 +254,8 @@ class HostShards:
         per_worker = []
         for items in self.lists:
             if items:
-                treedef = jax.tree.structure(items[0])
-                cols = [np.asarray([jax.tree.leaves(it)[i] for it in items])
-                        for i in range(treedef.num_leaves)]
-                per_worker.append(jax.tree.unflatten(treedef, cols))
+                per_worker.append(columnarize(
+                    items, jax.tree.structure(items[0])))
             else:
                 per_worker.append(None)
         # empty workers: borrow structure from a non-empty one
