@@ -35,12 +35,40 @@ MAX_WORD = 16   # device variant: words truncated/padded to 16 bytes
 
 
 def pack_words(words) -> np.ndarray:
-    """Pack a list of strings into [n, MAX_WORD] uint8 (zero padded)."""
-    arr = np.zeros((len(words), MAX_WORD), dtype=np.uint8)
-    for i, w in enumerate(words):
-        b = w.encode("utf-8")[:MAX_WORD]
-        arr[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
-    return arr
+    """Pack a list of strings into [n, MAX_WORD] uint8 (zero padded).
+
+    Row i always corresponds to words[i] — empty strings keep their
+    (all-zero) row; the byte packing itself is one vectorized gather."""
+    enc = [w.encode("utf-8")[:MAX_WORD] for w in words]
+    lens = np.fromiter((len(b) for b in enc), np.int64, count=len(enc))
+    buf = np.frombuffer(b"".join(enc), dtype=np.uint8)
+    if buf.size == 0:
+        return np.zeros((len(words), MAX_WORD), dtype=np.uint8)
+    offs = np.concatenate(([0], np.cumsum(lens)))[:-1]
+    idx = offs[:, None] + np.arange(MAX_WORD)[None, :]
+    valid = np.arange(MAX_WORD)[None, :] < lens[:, None]
+    return np.where(valid, buf[np.where(valid, idx, 0)],
+                    0).astype(np.uint8)
+
+
+def word_count_text_device(ctx: Context, path: str,
+                           max_word: int = MAX_WORD):
+    """Device WordCount straight from a text file: vectorized
+    tokenization into packed byte rows (ctx.ReadWordsPacked), then the
+    whole aggregation as jitted device programs. Returns a DIA of
+    {"w": [max_word] u8, "c": count} rows (use
+    thrill_tpu.core.text.unpack_words to recover strings)."""
+    import jax.numpy as jnp
+
+    words = ctx.ReadWordsPacked(path, max_word=max_word)
+    # ones_like(..[..., 0]) yields [n] on the batched device tree and a
+    # scalar on a single host item — valid under both Map contracts
+    pairs = words.Map(lambda t: {
+        "w": t["w"],
+        "c": jnp.ones_like(t["w"][..., 0], dtype=jnp.int64)})
+    return pairs.ReduceByKey(lambda t: t["w"],
+                             lambda a, b: {"w": a["w"],
+                                           "c": a["c"] + b["c"]})
 
 
 def word_count_fixed(ctx: Context, packed: np.ndarray):

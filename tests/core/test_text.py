@@ -1,0 +1,99 @@
+"""Vectorized tokenization + the device text source.
+
+Reference behavior being matched: ReadLines + FlatMap(split) feeding
+ReduceByKey (examples/word_count/word_count.hpp:35-57), with byte-range
+item ownership identical to ReadLines (read_lines.hpp:181-199).
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+from thrill_tpu.core.text import (find_first_sep, sep_mask,
+                                  tokenize_packed, unpack_words)
+
+
+def test_tokenize_matches_split():
+    text = "  the quick\tbrown\nfox  jumps\r\nover the lazy dog \n"
+    packed = tokenize_packed(text.encode())
+    assert unpack_words(packed) == text.split()
+
+
+def test_tokenize_empty_and_all_sep():
+    assert tokenize_packed(b"").shape == (0, 16)
+    assert tokenize_packed(b" \n\t  ").shape == (0, 16)
+
+
+def test_tokenize_clips_long_words():
+    w = "x" * 40
+    packed = tokenize_packed(f"{w} yy".encode(), max_word=16)
+    assert unpack_words(packed) == [w[:16], "yy"]
+
+
+def test_tokenize_random_matches_split():
+    rng = np.random.default_rng(0)
+    chars = list("abc de\nf\tg")
+    text = "".join(rng.choice(chars, size=4000))
+    packed = tokenize_packed(text.encode(), max_word=8)
+    assert unpack_words(packed) == [w[:8] for w in text.split()]
+
+
+def test_find_first_sep():
+    assert find_first_sep(b"abc def") == 3
+    assert find_first_sep(b"abcdef") == -1
+    assert sep_mask(np.frombuffer(b"a b", np.uint8)).tolist() == \
+        [False, True, False]
+
+
+@pytest.mark.parametrize("W", [1, 2, 5, 8])
+def test_read_words_packed_sweep(W, tmp_path):
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    rng = np.random.default_rng(7)
+    words = ["".join(rng.choice(list("abcdef"), size=rng.integers(1, 10)))
+             for _ in range(800)]
+    text = ""
+    for i, w in enumerate(words):
+        text += w + (" " if i % 3 else "\n")
+    path = tmp_path / "words.txt"
+    path.write_text(text)
+
+    mex = MeshExec(num_workers=W)
+    ctx = Context(mex)
+    dia = ctx.ReadWordsPacked(str(path), max_word=12)
+    shards = dia.node.materialize()
+    got = []
+    for arr in shards.to_worker_arrays():
+        got.extend(unpack_words(arr["w"]))
+    assert got == [w[:12] for w in words], f"W={W}"
+    ctx.close()
+
+
+def test_word_count_text_device_matches_counter(tmp_path):
+    import sys
+    sys.path.insert(0, "examples")
+    import word_count as wc
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    rng = np.random.default_rng(1)
+    vocab = ["w%d" % i for i in range(40)]
+    text = " ".join(vocab[i] for i in rng.integers(0, 40, size=3000))
+    path = tmp_path / "t.txt"
+    path.write_text(text)
+    expect = collections.Counter(text.split())
+
+    mex = MeshExec(num_workers=2)
+    ctx = Context(mex)
+    out = wc.word_count_text_device(ctx, str(path))
+    hs = out.node.materialize().to_host_shards("test")
+    got = {}
+    for lst in hs.lists:
+        for it in lst:
+            w = bytes(np.asarray(it["w"])).rstrip(b"\x00").decode()
+            assert w not in got
+            got[w] = int(it["c"])
+    assert got == dict(expect)
+    ctx.close()

@@ -55,8 +55,37 @@ def job(ctx):
             .FlatMap(lambda line: line.split()) \
             .Sort(compare_fn=lambda a, b: a < b).AllGather()
 
+    # host-storage InnerJoin, with and without LocationDetection: the
+    # fingerprint exchange must agree across controllers and the flag
+    # must cut cross-process shuffle traffic (reference:
+    # api/inner_join.hpp:161-190, core/location_detection.hpp:70)
+    from thrill_tpu.api.ops.join import InnerJoin
+
+    def mkj(ld):
+        # kept small: the RESULT line must stay well under the 64 KiB
+        # pipe buffer (the parent drains stdout concurrently, but a
+        # bounded payload keeps failure output readable)
+        left = ctx.Distribute([(f"A{i % 10}", i) for i in range(60)],
+                              storage="host")
+        right = ctx.Distribute(
+            [(f"A{i % 5}" if i % 2 else f"B{i}", -i)
+             for i in range(60)], storage="host")
+        return InnerJoin(left, right, lambda t: t[0], lambda t: t[0],
+                         lambda a, b: (a[0], a[1], b[1]),
+                         location_detection=ld)
+
+    mexs = ctx.mesh_exec
+    base = int(mexs.stats_items_moved)
+    join_plain = sorted(map(list, mkj(False).AllGather()))
+    moved_plain = int(mexs.stats_items_moved) - base
+    base = int(mexs.stats_items_moved)
+    join_ld = sorted(map(list, mkj(True).AllGather()))
+    moved_ld = int(mexs.stats_items_moved) - base
+
     stats = ctx.overall_stats()
     return {"pairs": pairs, "total": total, "totals": totals,
+            "join_plain": join_plain, "join_ld": join_ld,
+            "moved_plain": moved_plain, "moved_ld": moved_ld,
             "hosts": stats.get("hosts", 1),
             "net_workers": ctx.net.num_workers,
             "mesh_workers": ctx.num_workers,

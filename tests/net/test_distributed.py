@@ -73,14 +73,20 @@ def test_multi_process_wordcount_agrees(nproc, tmp_path):
             [sys.executable, CHILD, coordinator, str(rank), str(nproc)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, env=env))
+    # drain every child's pipes CONCURRENTLY: children exit through a
+    # collective shutdown barrier, so one child blocked writing into a
+    # full stdout pipe would deadlock the whole group
+    import concurrent.futures as cf
     outs = []
-    for p in procs:
+    with cf.ThreadPoolExecutor(len(procs)) as ex:
+        futs = [ex.submit(p.communicate, None, 240) for p in procs]
         try:
-            out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
+            drained = [f.result(timeout=260) for f in futs]
+        except (cf.TimeoutError, subprocess.TimeoutExpired):
             for q in procs:
                 q.kill()
             pytest.fail("distributed child timed out")
+    for p, (out, err) in zip(procs, drained):
         assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
         outs.append((out, err))
 
@@ -90,10 +96,24 @@ def test_multi_process_wordcount_agrees(nproc, tmp_path):
         assert lines, f"no RESULT line:\n{out}\n{err[-2000:]}"
         results.append(json.loads(lines[-1][len("RESULT "):]))
 
+    # per-process traffic counters: each controller counts its OWN
+    # sent items, so compare them per rank, not across ranks
+    moved = [(r.pop("moved_plain"), r.pop("moved_ld")) for r in results]
     r0 = results[0]
     # every controller computed the identical logical result
     for r in results[1:]:
         assert r == r0
+    # LocationDetection prunes single-side keys BEFORE the shuffle:
+    # strictly fewer cross-process items in total, same join output
+    total_plain = sum(m[0] for m in moved)
+    total_ld = sum(m[1] for m in moved)
+    assert total_ld < total_plain, (moved,)
+    left = [(f"A{i % 10}", i) for i in range(60)]
+    right = [(f"A{i % 5}" if i % 2 else f"B{i}", -i) for i in range(60)]
+    golden_join = sorted([ka, a, b] for ka, a in left
+                         for kb, b in right if ka == kb)
+    assert r0["join_plain"] == golden_join
+    assert r0["join_ld"] == golden_join
     # and it is the correct one
     assert r0["pairs"] == [[i, 100] for i in range(10)]
     assert r0["total"] == 999 * 1000 // 2

@@ -204,6 +204,12 @@ class Context:
         from .ops import read_write
         return read_write.ReadLines(self, path_or_glob)
 
+    def ReadWordsPacked(self, path_or_glob: str, max_word: int = 16):
+        """Text -> device DIA of {"w": [max_word] uint8} packed words
+        (vectorized tokenization; device-native WordCount input)."""
+        from .ops import read_write
+        return read_write.ReadWordsPacked(self, path_or_glob, max_word)
+
     def ReadBinary(self, path_or_glob: str, dtype, record_shape=()):
         from .ops import read_write
         return read_write.ReadBinary(self, path_or_glob, dtype, record_shape)

@@ -74,9 +74,20 @@ class ReadLinesNode(DIABase):
         return HostShards(W, lists)
 
 
-def _read_lines_range(fl: file_io.FileList, lo: int, hi: int) -> List[str]:
-    """All lines whose first byte lies in [lo, hi) of the global stream."""
-    out: List[str] = []
+def _read_delimited_range(fl: file_io.FileList, lo: int, hi: int,
+                          is_delim, find_delim,
+                          include_delim: bool) -> List[bytes]:
+    """Byte chunks covering every delimited item whose FIRST byte lies
+    in [lo, hi) of the global stream (one chunk per overlapping file;
+    file boundaries always terminate an item).
+
+    The one boundary scanner behind both ReadLines (delimiter = '\\n',
+    kept in the chunk) and ReadWordsPacked (delimiter = any whitespace,
+    dropped): ``is_delim(byte) -> bool`` probes the byte before the
+    range, ``find_delim(bytes) -> offset|-1`` scans forward, and
+    ``include_delim`` controls whether the final delimiter is part of
+    the last item."""
+    out: List[bytes] = []
     if lo >= hi:
         return out
     for fi in fl.files:
@@ -88,11 +99,11 @@ def _read_lines_range(fl: file_io.FileList, lo: int, hi: int) -> List[str]:
         with file_io.OpenReadStream(fi.path) as f:
             if start > 0:
                 f.seek(start - 1)
-                prev = f.read(1)
-                if prev == b"\n":
+                if is_delim(f.read(1)):
                     chunk_start = start
                 else:
-                    # mid-line: scan forward to the next newline
+                    # mid-item: the item containing byte ``start``
+                    # began earlier and belongs to the previous range
                     chunk_start = None
                     pos = start
                     while True:
@@ -100,9 +111,9 @@ def _read_lines_range(fl: file_io.FileList, lo: int, hi: int) -> List[str]:
                         if not b:
                             chunk_start = f_hi - f_lo
                             break
-                        nl = b.find(b"\n")
-                        if nl >= 0:
-                            chunk_start = pos + nl + 1
+                        d = find_delim(b)
+                        if d >= 0:
+                            chunk_start = pos + d + 1
                             break
                         pos += len(b)
             else:
@@ -111,23 +122,112 @@ def _read_lines_range(fl: file_io.FileList, lo: int, hi: int) -> List[str]:
                 continue
             f.seek(chunk_start)
             data = f.read(end - chunk_start)
-            # extend to finish the last line (it starts in-range)
-            if not data.endswith(b"\n"):
+            # extend to finish the last item (it starts in-range)
+            if data and not is_delim(data[-1:]):
                 while True:
                     b = f.read(1 << 16)
                     if not b:
                         break
-                    nl = b.find(b"\n")
-                    if nl >= 0:
-                        data += b[:nl + 1]
+                    d = find_delim(b)
+                    if d >= 0:
+                        data += b[:d + 1] if include_delim else b[:d]
                         break
                     data += b
-            # str.splitlines is already a C-level loop and handles CRLF
-            # etc.; the native scanner (data/block_pool.scan_line_offsets)
-            # is reserved for the raw-bytes -> device packing path where
-            # no Python string objects are materialized
-            out.extend(data.decode("utf-8").splitlines())
+            out.append(data)
     return out
+
+
+def _read_lines_range(fl: file_io.FileList, lo: int, hi: int) -> List[str]:
+    """All lines whose first byte lies in [lo, hi) of the global stream."""
+    out: List[str] = []
+    for data in _read_delimited_range(
+            fl, lo, hi, lambda b: b == b"\n",
+            lambda b: b.find(b"\n"), include_delim=True):
+        # str.splitlines is already a C-level loop and handles CRLF
+        # etc.; the native scanner (data/block_pool.scan_line_offsets)
+        # is reserved for the raw-bytes -> device packing path where
+        # no Python string objects are materialized
+        out.extend(data.decode("utf-8").splitlines())
+    return out
+
+
+class ReadWordsPackedNode(DIABase):
+    """Text -> device DIA of fixed-width packed words.
+
+    The device-native text source (reference text pipelines start from
+    ReadLines + a per-item FlatMap split, read_lines.hpp:41 +
+    word_count.hpp:35-44; here tokenization is one vectorized pass and
+    the words land directly in device columns as {"w": [max_word] u8}
+    rows, ready for byte-key ReduceByKey/Sort). A word is owned by the
+    worker whose byte range contains its FIRST byte — the same
+    ownership rule ReadLines uses for lines."""
+
+    def __init__(self, ctx, path_or_glob: str, max_word: int) -> None:
+        super().__init__(ctx, "ReadWordsPacked")
+        self.pattern = path_or_glob
+        self.max_word = int(max_word)
+
+    def compute(self):
+        from ...core import text as textmod
+        from ...data import multiplexer
+
+        W = self.context.num_workers
+        mex = self.context.mesh_exec
+        fl = file_io.Glob(self.pattern)
+        if len(fl) == 0:
+            raise FileNotFoundError(f"ReadWordsPacked: no files match "
+                                    f"{self.pattern!r}")
+        local = multiplexer.local_worker_set(mex)
+        total = fl.total_size
+        empty = np.zeros((0, self.max_word), dtype=np.uint8)
+        per_worker = []
+        if fl.contains_compressed:
+            # whole-file granularity (same placement rule as ReadLines)
+            chunks: List[List[bytes]] = [[] for _ in range(W)]
+            for fi in fl.files:
+                w = min(W - 1, (fi.size_ex_psum * W) // max(total, 1))
+                if w not in local:
+                    continue
+                with file_io.OpenReadStream(fi.path) as f:
+                    chunks[w].append(f.read())
+            for w in range(W):
+                per_worker.append(np.concatenate(
+                    [textmod.tokenize_packed(c, self.max_word)
+                     for c in chunks[w]], axis=0)
+                    if chunks[w] else empty)
+        else:
+            bounds = [(w * total) // W for w in range(W + 1)]
+            for w in range(W):
+                if w not in local:
+                    per_worker.append(empty)
+                    continue
+                parts = [textmod.tokenize_packed(c, self.max_word)
+                         for c in _read_word_bytes_range(
+                             fl, bounds[w], bounds[w + 1])]
+                per_worker.append(np.concatenate(parts, axis=0)
+                                  if parts else empty)
+
+        counts = np.array([len(a) for a in per_worker], dtype=np.int64)
+        if multiplexer.multiprocess(mex):
+            # counts are data-dependent: agree on the global vector
+            mine = {w: int(counts[w]) for w in mex.local_workers}
+            for msg in multiplexer._net(mex).all_gather(mine):
+                for w, c in msg.items():
+                    counts[int(w)] = c
+        return DeviceShards.from_worker_arrays(
+            mex, [{"w": a} for a in per_worker], counts=counts)
+
+
+def _read_word_bytes_range(fl: file_io.FileList, lo: int,
+                           hi: int) -> List[bytes]:
+    """Byte chunks covering every word whose first byte lies in
+    [lo, hi) of the global stream (file boundaries count as
+    separators, like ReadLines treats them as line breaks)."""
+    from ...core import text as textmod
+    return _read_delimited_range(
+        fl, lo, hi,
+        lambda b: bool(textmod.sep_mask(np.frombuffer(b, np.uint8))[0]),
+        textmod.find_first_sep, include_delim=False)
 
 
 class ReadBinaryNode(DIABase):
@@ -272,6 +372,10 @@ def WriteBinary(dia, path_pattern: str) -> None:
 
 def ReadLines(ctx, path_or_glob: str) -> DIA:
     return DIA(ReadLinesNode(ctx, path_or_glob))
+
+
+def ReadWordsPacked(ctx, path_or_glob: str, max_word: int = 16) -> DIA:
+    return DIA(ReadWordsPackedNode(ctx, path_or_glob, max_word))
 
 
 def ReadBinary(ctx, path_or_glob: str, dtype, record_shape=()) -> DIA:

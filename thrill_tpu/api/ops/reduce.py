@@ -41,6 +41,9 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
                          token) -> DeviceShards:
     """One jitted program: encode keys, sort, segmented-reduce, compact."""
     mex = shards.mesh_exec
+    out = _host_reduce_shards(shards, key_fn, reduce_fn)
+    if out is not None:
+        return out
     cap = shards.cap
     leaves, treedef = jax.tree.flatten(shards.tree)
     key = ("reduce_local", phase, token, cap, treedef,
@@ -68,6 +71,94 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
     # counts stay on device: pre-phase -> exchange phase A dispatches
     # back-to-back with no host sync in between
     return DeviceShards(mex, tree, out[0])
+
+
+def _host_reduce_shards(shards: DeviceShards, key_fn: Callable,
+                        reduce_fn: Callable) -> Optional[DeviceShards]:
+    """CPU-backend mirror of :func:`_local_reduce_device`: native radix
+    sort (core/host_radix.py) + a geometric pairwise run fold.
+
+    On the CPU backend device buffers are host memory and XLA's
+    single-core sort + associative_scan are the wrong engines (a 1.2M
+    row WordCount reduce spent ~17s there). Here each equal-key run is
+    folded by combining adjacent pairs per level — run lengths halve
+    every level, so total gathered rows are geometric in n and
+    ``reduce_fn`` is called log2(longest run) times on whole arrays
+    (same associativity contract as the device segmented scan).
+
+    Returns None when inapplicable (non-CPU, multi-controller, trace-
+    only key_fn) so the caller falls through to the jitted engine."""
+    from ...core import host_radix
+
+    mex = shards.mesh_exec
+    if (mex.devices[0].platform != "cpu"
+            or jax.default_backend() != "cpu"
+            or getattr(mex, "num_processes", 1) > 1
+            or not host_radix.available()):
+        return None
+    leaves, treedef = jax.tree.flatten(shards.tree)
+    leaves_np = [np.asarray(l) for l in leaves]          # [W, cap, ...]
+    W = mex.num_workers
+    out_counts = np.zeros(W, dtype=np.int64)
+    per_worker = []
+    # any failure (trace-only key_fn, a reduce_fn using jax-array-only
+    # APIs like .at[] on the numpy trees, ...) falls back to the jitted
+    # engine, which either handles it or raises the real error
+    try:
+        for w in range(W):
+            cnt = int(shards.counts[w])
+            tree = jax.tree.unflatten(treedef,
+                                      [l[w][:cnt] for l in leaves_np])
+            if cnt == 0:
+                per_worker.append(tree)
+                continue
+            words = keymod.encode_key_words_np(key_fn(tree))
+            perm = host_radix.radix_argsort(words)
+            tree = jax.tree.map(
+                lambda a: host_radix.gather_rows(np.ascontiguousarray(a),
+                                                 perm), tree)
+            same_next = np.ones(cnt - 1, dtype=bool)
+            for kw in words:
+                kws = kw[perm]
+                same_next &= kws[1:] == kws[:-1]
+            run_id = np.concatenate(([0], np.cumsum(~same_next)))
+            tree, nruns = _pairwise_run_fold(tree, run_id, reduce_fn)
+            per_worker.append(tree)
+            out_counts[w] = nruns
+    except Exception:
+        return None
+    return DeviceShards.from_worker_arrays(mex, per_worker,
+                                           counts=out_counts)
+
+
+def _pairwise_run_fold(tree, run_id: np.ndarray, reduce_fn: Callable):
+    """Fold each equal-run of key-sorted rows to one row by repeatedly
+    combining adjacent in-run pairs (rows at even in-run positions
+    absorb their right neighbor). Returns (tree, num_runs)."""
+    while True:
+        m = run_id.shape[0]
+        same_next = run_id[1:] == run_id[:-1]
+        if not same_next.any():
+            return tree, m
+        starts = np.concatenate(([True], ~same_next))
+        idx = np.arange(m)
+        run_start = np.maximum.accumulate(np.where(starts, idx, 0))
+        is_left = ((idx - run_start) & 1) == 0
+        has_right = np.zeros(m, dtype=bool)
+        has_right[:-1] = is_left[:-1] & same_next
+        li = np.flatnonzero(has_right)
+        merged = reduce_fn(jax.tree.map(lambda a: a[li], tree),
+                           jax.tree.map(lambda a: a[li + 1], tree))
+        kept = jax.tree.map(lambda a: np.ascontiguousarray(a[is_left]),
+                            tree)
+        hr = has_right[is_left]
+
+        def scatter(dst, src):
+            dst[hr] = np.asarray(src)
+            return dst
+
+        tree = jax.tree.map(scatter, kept, merged)
+        run_id = run_id[is_left]
 
 
 def _fold_reduce_device(acc: DeviceShards, block: DeviceShards,

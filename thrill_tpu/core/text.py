@@ -1,0 +1,63 @@
+"""Vectorized text tokenization: raw bytes -> fixed-width word matrix.
+
+The device-side string story (SURVEY §7 "hard parts"): XLA programs
+need static shapes, so variable-length words become [n, max_word]
+zero-padded uint8 rows — the byte-key encoding keys.encode_key_words
+already sorts/hashes lexicographically. This module turns a text chunk
+into that packed matrix with numpy array ops only — no per-word Python
+loop (the reference tokenizes per-item inside its FlatMap lambda,
+examples/word_count/word_count.hpp:35-44; a Python-level equivalent
+would dominate the whole pipeline).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: ASCII whitespace — the same set str.split() treats as separators.
+SEPARATORS = b" \t\n\r\x0b\x0c"
+
+_SEP = np.zeros(256, dtype=bool)
+_SEP[list(SEPARATORS)] = True
+
+
+def sep_mask(data: np.ndarray) -> np.ndarray:
+    """bool[n]: which bytes are word separators."""
+    return _SEP[data]
+
+
+def find_first_sep(data: bytes) -> int:
+    """Offset of the first separator byte, or -1."""
+    hits = np.flatnonzero(_SEP[np.frombuffer(data, dtype=np.uint8)])
+    return int(hits[0]) if len(hits) else -1
+
+
+def tokenize_packed(data, max_word: int = 16) -> np.ndarray:
+    """Pack every whitespace-delimited word of ``data`` into a
+    [n_words, max_word] uint8 matrix (zero padded, clipped at
+    ``max_word`` bytes — matching the device WordCount contract)."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        a = np.frombuffer(data, dtype=np.uint8)
+    else:
+        a = np.asarray(data, dtype=np.uint8)
+    if a.size == 0:
+        return np.zeros((0, max_word), dtype=np.uint8)
+    sep = _SEP[a]
+    nonsep = ~sep
+    # word starts: non-sep preceded by sep (or stream start)
+    starts = np.flatnonzero(nonsep & np.concatenate(([True], sep[:-1])))
+    if len(starts) == 0:
+        return np.zeros((0, max_word), dtype=np.uint8)
+    # word ends (exclusive): non-sep followed by sep (or stream end)
+    ends = np.flatnonzero(nonsep & np.concatenate((sep[1:], [True]))) + 1
+    lens = np.minimum(ends - starts, max_word)
+    gather = starts[:, None] + np.arange(max_word)[None, :]
+    valid = np.arange(max_word)[None, :] < lens[:, None]
+    packed = np.where(valid, a[np.where(valid, gather, 0)], 0)
+    return packed.astype(np.uint8)
+
+
+def unpack_words(packed: np.ndarray) -> list:
+    """[n, L] uint8 -> list of str (zero padding stripped)."""
+    return [bytes(row).rstrip(b"\x00").decode("utf-8", "replace")
+            for row in np.asarray(packed)]
