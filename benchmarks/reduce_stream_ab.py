@@ -26,13 +26,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 import thrill_tpu  # noqa: F401,E402
-from thrill_tpu.common.platform import maybe_force_cpu_from_env  # noqa: E402
+from thrill_tpu.common.platform import force_cpu_unless_accelerator  # noqa: E402
 
-if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
-    from thrill_tpu.common.platform import force_cpu_platform
-    force_cpu_platform()
-else:
-    maybe_force_cpu_from_env()
+force_cpu_unless_accelerator()
 
 import jax  # noqa: E402
 

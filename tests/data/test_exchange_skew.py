@@ -99,7 +99,23 @@ def test_dense_vs_onefactor_padding_ratio(monkeypatch):
     S = np.zeros((8, 8), dtype=np.int64)
     S[:, 0] = 100          # everyone sends a bit to worker 0
     S[3, 0] = 40_000       # one hot pair
-    assert ex._skewed(S)
+    ctx = _ctx(8)
+    mex = ctx.mesh_exec
+    # measured cost model: the hot pair's padding waste clears the
+    # per-round launch overhead -> 1-factor
+    assert ex._skewed(S, 16, mex)
+    # small balanced neighbor shift: the padding saved is below the
+    # measured per-round launch cost -> stays on the single all_to_all
+    Sb = np.zeros((8, 8), dtype=np.int64)
+    for w in range(8):
+        Sb[w, (w + 1) % 8] = 100
+    assert not ex._skewed(Sb, 16, mex)
+    # ...but a LARGE sparse matrix flips: dense would pad W*W cells to
+    # the shift size, and that waste dwarfs 7 launches (this is the
+    # cost model improving on the old max-vs-mean heuristic, which
+    # kept any balanced matrix dense no matter how much it padded)
+    assert ex._skewed(Sb * 1000, 16, mex)
+    ctx.close()
     # uniform plan rows: W * round_up_pow2(max) = 8 * 65536
     uniform_rows = 8 * (1 << 16)
     onefactor_rows = sum(

@@ -407,7 +407,9 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
     # re-sort fallback (perf A/B diagnostics).
     import os
     fused_ok = os.environ.get("THRILL_TPU_SORT_FUSED", "1") != "0"
-    if fused_ok and exchange.dense_all_to_all_applies(mex, S):
+    if fused_ok and exchange.dense_all_to_all_applies(
+            mex, S, exchange.leaf_item_bytes(sorted_payload)
+            + 8 * (nwords + 1)):
         return _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
                                      sorted_payload, treedef, S, nwords,
                                      token)

@@ -32,3 +32,31 @@ def maybe_force_cpu_from_env() -> None:
     import os
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         force_cpu_platform()
+
+
+def accelerator_healthy(timeout_s: float = 75.0) -> bool:
+    """Probe the accelerator in a THROWAWAY subprocess with a timeout.
+
+    The axon plugin can hang (not raise) at PJRT client init when its
+    tunnel is wedged, so the probe must never run in the calling
+    process. Shared by bench.py and the benchmarks/ scripts."""
+    import subprocess
+    import sys
+    code = "import jax; assert jax.devices()[0].platform != 'cpu'"
+    try:
+        return subprocess.run([sys.executable, "-c", code],
+                              capture_output=True,
+                              timeout=timeout_s).returncode == 0
+    except subprocess.SubprocessError:
+        return False
+
+
+def force_cpu_unless_accelerator(timeout_s: float = 75.0) -> None:
+    """Benchmark-script platform policy: use the accelerator iff it
+    answers the subprocess probe; otherwise force CPU so the run never
+    wedges on the plugin."""
+    import os
+    if os.environ.get("AB_FORCE_TPU") == "1":
+        return
+    if not accelerator_healthy(timeout_s):
+        force_cpu_platform()

@@ -267,12 +267,14 @@ def _sticky_caps(mex: MeshExec, ident: Tuple, needed: Tuple[int, ...]
     return grown
 
 
-def dense_all_to_all_applies(mex: MeshExec, S: np.ndarray) -> bool:
+def dense_all_to_all_applies(mex: MeshExec, S: np.ndarray,
+                             row_bytes: int = 8) -> bool:
     """Would the planner use the single dense all_to_all for this send
     matrix? Shared predicate so fused callers (Sort's run-merge path)
     take the fused program exactly when the generic exchange would have
     taken the dense plan."""
-    return resolve_mode(mex) == "dense" and not _skewed(S)
+    return resolve_mode(mex) == "dense" and not _skewed(S, row_bytes,
+                                                        mex)
 
 
 def account_traffic(mex: MeshExec, S: np.ndarray, item_bytes: int) -> None:
@@ -346,24 +348,54 @@ def leaf_item_bytes(leaves) -> int:
                for l in leaves)
 
 
-def _skewed(S: np.ndarray) -> bool:
-    """Is the send matrix skewed enough that uniform padding wastes
-    more than the 1-factor round schedule's extra latency costs?
+# Break-even padded-byte volume per extra program launch: the dense
+# all_to_all is ONE launch padded to the global cell maximum; the
+# 1-factor schedule is (W-1) serialized launches padded per round.
+# 1-factor wins iff the padding it saves outweighs its extra launches:
+#
+#   saved_padded_bytes > extra_launches * BYTES_EQ
+#
+# where BYTES_EQ = round_overhead * exchange_bandwidth, both measured
+# on the actual mesh by benchmarks/exchange_crossover.py:
+#   * virtual 8-device CPU mesh (this image, 2026-07-30):
+#     round_overhead 288 us, dense bw 150 MB/s -> BYTES_EQ ~43 KiB
+#   * TPU ICI meshes: ~10-30 us launch overhead at multi-GB/s effective
+#     -> O(1 MiB); re-measure with the same script on real hardware.
+# Override with THRILL_TPU_XCHG_BYTES_EQ.
+_BYTES_EQ_MEASURED = {"cpu": 43_000}
+_BYTES_EQ_FALLBACK = 1 << 20
 
-    Judged over the NONZERO off-diagonal entries: a sparse-but-balanced
-    matrix (e.g. a neighbor shift with one equal transfer per row) has
-    max == mean over its actual transfers and must stay on the single
-    all_to_all, not pay W-1 serialized rounds.
-    """
-    mx = int(S.max())
-    if mx <= 1024:                    # tiny: padding is cheap
-        return False
-    offdiag = S.copy()
-    np.fill_diagonal(offdiag, 0)
-    nz = offdiag[offdiag > 0]
-    if nz.size == 0:
-        return False
-    return mx > 4 * nz.mean()
+
+def _bytes_eq(mex: MeshExec) -> int:
+    import os
+    env = os.environ.get("THRILL_TPU_XCHG_BYTES_EQ")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    platform = mex.devices[0].platform if mex.devices else "cpu"
+    return _BYTES_EQ_MEASURED.get(platform, _BYTES_EQ_FALLBACK)
+
+
+def _skewed(S: np.ndarray, row_bytes: int, mex: MeshExec) -> bool:
+    """Does the measured cost model prefer the 1-factor schedule over
+    the single dense all_to_all for this send matrix?
+
+    Rows entering the fabric: dense ships W slots of the global max per
+    worker; 1-factor ships each round's pair maximum (identity round is
+    a local scatter, no traffic). A sparse-but-balanced matrix (e.g. a
+    neighbor shift) saves nothing and stays on the single all_to_all; a
+    100:1 hot-key skew saves ~W x the padding and flips as soon as the
+    savings clear the per-round launch overhead."""
+    W = S.shape[0]
+    M_dense = int(S.max())
+    rounds = one_factor_rounds(mex)
+    M_rounds = [max(int(S[np.arange(W), to].max()), 1) for to in rounds]
+    dense_rows = W * W * M_dense
+    of_rows = W * sum(M_rounds)
+    saved = (dense_rows - of_rows) * max(row_bytes, 1)
+    return saved > len(rounds) * _bytes_eq(mex)
 
 
 def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
@@ -385,7 +417,9 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     mode = resolve_mode(mex)
     if mode == "ragged":
         return _exchange_ragged(mex, treedef, sorted_leaves, S, min_cap)
-    if mode == "onefactor" or (mode == "dense" and _skewed(S)):
+    if mode == "onefactor" or (
+            mode == "dense"
+            and _skewed(S, leaf_item_bytes(sorted_leaves), mex)):
         return _exchange_onefactor(mex, treedef, sorted_dest,
                                    sorted_leaves, S, min_cap, ident=ident)
 
