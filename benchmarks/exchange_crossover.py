@@ -59,6 +59,9 @@ def _mk_shards(mex, rows_per_worker: int, row_u64: int) -> DeviceShards:
 
 def _run_exchange(mex, shards, mode: str, iters: int, ident) -> float:
     os.environ["THRILL_TPU_EXCHANGE"] = mode
+    # calibration must time the REQUESTED plan: pin the crossover so the
+    # cost model under calibration cannot reroute the dense measurement
+    os.environ["THRILL_TPU_XCHG_BYTES_EQ"] = str(1 << 62)
     mex.exchange_mode = mode
     W = mex.num_workers
 

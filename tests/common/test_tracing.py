@@ -118,3 +118,62 @@ def test_report_merges_multi_host_logs():
         html = render_html(events)
         assert "host0" in html and "host1" in html
         assert "host RAM in use" in html
+
+
+def test_multi_host_log_merge():
+    """Multi-controller logs must MERGE stage records (span = min/max,
+    replicated device counts taken once, host-storage partials summed)
+    and count replicated device-plane exchange bytes ONCE, not P times."""
+    from thrill_tpu.tools.json2profile import load_many
+
+    with tempfile.TemporaryDirectory() as d:
+        # host 0: stage #1 device (global count 100), stage #2 host-
+        # storage (local 30); one global exchange of 1e6 bytes
+        p0 = os.path.join(d, "h0.json")
+        with open(p0, "w") as f:
+            f.write("\n".join([
+                json.dumps({"event": "node_execute_start", "dia_id": 1,
+                            "node": "Sort", "ts": 1_000_000}),
+                json.dumps({"event": "node_execute_done", "dia_id": 1,
+                            "items": 100, "per_worker": [50, 50],
+                            "ts": 3_000_000}),
+                json.dumps({"event": "node_execute_start", "dia_id": 2,
+                            "node": "ReduceByKey", "ts": 3_000_000}),
+                json.dumps({"event": "node_execute_done", "dia_id": 2,
+                            "items": 30, "per_worker": [30, 0],
+                            "ts": 4_000_000}),
+                json.dumps({"event": "exchange", "bytes": 1_000_000,
+                            "bytes_dcn": 0, "per_worker_sent": [60, 40],
+                            "ts": 2_000_000}),
+            ]))
+        # host 1: same stages, device count replicated, host partial 70,
+        # same global exchange logged again; later end timestamp
+        p1 = os.path.join(d, "h1.json")
+        with open(p1, "w") as f:
+            f.write("\n".join([
+                json.dumps({"event": "node_execute_start", "dia_id": 1,
+                            "node": "Sort", "ts": 1_100_000}),
+                json.dumps({"event": "node_execute_done", "dia_id": 1,
+                            "items": 100, "per_worker": [50, 50],
+                            "ts": 3_500_000}),
+                json.dumps({"event": "node_execute_start", "dia_id": 2,
+                            "node": "ReduceByKey", "ts": 3_500_000}),
+                json.dumps({"event": "node_execute_done", "dia_id": 2,
+                            "items": 70, "per_worker": [0, 70],
+                            "ts": 4_200_000}),
+                json.dumps({"event": "exchange", "bytes": 1_000_000,
+                            "bytes_dcn": 0, "per_worker_sent": [60, 40],
+                            "ts": 2_100_000}),
+            ]))
+        html = render_html(load_many([p0, p1]))
+        # device stage: replicated count taken once, not doubled
+        assert ">100<" in html and ">200<" not in html
+        # host-storage stage: per-host partials summed (30 + 70)
+        assert ">70<" in html  # per-worker cell
+        assert ">30<" in html
+        # stage table items column shows the global 100 for #1; the
+        # host-partial stage sums to 100 as well
+        # replicated exchange bytes counted once: 1.00 MB, not 2.00
+        assert "cumulative 1.0 MB" in html
+        # span = min start .. max end of #2: 3.2s total span
+        assert "total span 3.200s" in html

@@ -58,5 +58,8 @@ def force_cpu_unless_accelerator(timeout_s: float = 75.0) -> None:
     import os
     if os.environ.get("AB_FORCE_TPU") == "1":
         return
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        force_cpu_platform()           # explicit request: skip the probe
+        return
     if not accelerator_healthy(timeout_s):
         force_cpu_platform()

@@ -357,12 +357,13 @@ def leaf_item_bytes(leaves) -> int:
 #
 # where BYTES_EQ = round_overhead * exchange_bandwidth, both measured
 # on the actual mesh by benchmarks/exchange_crossover.py:
-#   * virtual 8-device CPU mesh (this image, 2026-07-30):
-#     round_overhead 288 us, dense bw 150 MB/s -> BYTES_EQ ~43 KiB
+#   * virtual 8-device CPU mesh (this image, 2026-07-30, plan pinned
+#     during calibration): round_overhead 119 us, dense bw 378 MB/s
+#     -> BYTES_EQ ~45 KiB
 #   * TPU ICI meshes: ~10-30 us launch overhead at multi-GB/s effective
 #     -> O(1 MiB); re-measure with the same script on real hardware.
 # Override with THRILL_TPU_XCHG_BYTES_EQ.
-_BYTES_EQ_MEASURED = {"cpu": 43_000}
+_BYTES_EQ_MEASURED = {"cpu": 45_000}
 _BYTES_EQ_FALLBACK = 1 << 20
 
 
@@ -392,7 +393,9 @@ def _skewed(S: np.ndarray, row_bytes: int, mex: MeshExec) -> bool:
     M_dense = int(S.max())
     rounds = one_factor_rounds(mex)
     M_rounds = [max(int(S[np.arange(W), to].max()), 1) for to in rounds]
-    dense_rows = W * W * M_dense
+    # fabric rows exclude self-traffic on BOTH sides: the dense plan's
+    # diagonal slot and the 1-factor identity round are local scatters
+    dense_rows = W * (W - 1) * M_dense
     of_rows = W * sum(M_rounds)
     saved = (dense_rows - of_rows) * max(row_bytes, 1)
     return saved > len(rounds) * _bytes_eq(mex)

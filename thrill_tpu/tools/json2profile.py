@@ -44,29 +44,93 @@ def load_many(paths: List[str]) -> List[dict]:
     return events
 
 
+def _merge_host_nodes(per_host: dict) -> dict:
+    """Merge per-(dia_id, host) node records into one per dia_id.
+
+    Every controller of a multi-host run logs the same stages, so the
+    records must MERGE, not overwrite: span = [min start, max end];
+    counts that agree on every host are one replicated global value
+    (device-path stages), disagreeing counts are per-host partials
+    (host-storage stages hold only local workers' items) and sum."""
+    merged: dict = {}
+    for nid, by_host in per_host.items():
+        m: dict = {}
+        starts = [d["start"] for d in by_host.values() if "start" in d]
+        ends = [d["end"] for d in by_host.values() if "end" in d]
+        if starts:
+            m["start"] = min(starts)
+        if ends:
+            m["end"] = max(ends)
+        labels = [d.get("label") for d in by_host.values()
+                  if d.get("label")]
+        if labels:
+            m["label"] = labels[0]
+        items = [d["items"] for d in by_host.values()
+                 if d.get("items") is not None]
+        pws = [d["per_worker"] for d in by_host.values()
+               if d.get("per_worker")]
+        # ONE replicated-vs-partial decision for both count fields: the
+        # per-worker split is the more discriminating signal (per-host
+        # partials can coincide in total while owning different
+        # workers), fall back to the scalar only without it
+        if pws:
+            replicated = all(p == pws[0] for p in pws)
+        elif items:
+            replicated = all(x == items[0] for x in items)
+        else:
+            replicated = True
+        if items:
+            m["items"] = items[0] if replicated else sum(items)
+        if pws:
+            if replicated:
+                m["per_worker"] = pws[0]
+            else:
+                W = max(len(p) for p in pws)
+                m["per_worker"] = [
+                    sum(p[w] if w < len(p) else 0 for p in pws)
+                    for w in range(W)]
+        merged[nid] = m
+    return merged
+
+
 def render_html(events: List[dict]) -> str:
-    nodes = {}
+    per_host_nodes: dict = {}
     profiles = []
     exchanges = []
+    device_xchg: dict = {}   # host -> ordered device-plane exchanges
     memory = []        # hbm_spill / hbm_restore / mem_negotiate / demotion
     t0 = min((e["ts"] for e in events), default=0)
     for e in events:
         t = (e["ts"] - t0) / 1e6
+        h = e.get("host", 0)
         if e.get("event") == "node_execute_start":
-            nodes.setdefault(e.get("dia_id"), {}).update(
-                start=t, label=e.get("node"))
+            per_host_nodes.setdefault(e.get("dia_id"), {}).setdefault(
+                h, {}).update(start=t, label=e.get("node"))
         elif e.get("event") == "node_execute_done":
-            nodes.setdefault(e.get("dia_id"), {}).update(
-                end=t, items=e.get("items"),
-                per_worker=e.get("per_worker"))
+            per_host_nodes.setdefault(e.get("dia_id"), {}).setdefault(
+                h, {}).update(end=t, items=e.get("items"),
+                              per_worker=e.get("per_worker"))
         elif e.get("event") == "profile":
             profiles.append((t, e))
-        elif e.get("event") in ("exchange", "host_exchange"):
+        elif e.get("event") == "exchange":
+            # device-plane exchanges log GLOBAL bytes (derived from the
+            # replicated send matrix) in the same deterministic order
+            # on every controller: keep ONE host's sequence — the most
+            # complete one, so a truncated host-0 log cannot hide
+            # exchanges other hosts recorded
+            device_xchg.setdefault(h, []).append((t, e))
+        elif e.get("event") == "host_exchange":
+            # host-plane counters are per-process partials: keep all
             exchanges.append((t, e))
         elif e.get("event") in ("hbm_spill", "hbm_restore",
                                 "mem_negotiate", "device_to_host",
                                 "host_replicate"):
             memory.append((t, e))
+    if device_xchg:
+        best = max(sorted(device_xchg), key=lambda h: len(device_xchg[h]))
+        exchanges.extend(device_xchg[best])
+        exchanges.sort(key=lambda te: te[0])
+    nodes = _merge_host_nodes(per_host_nodes)
 
     rows = []
     for nid in sorted(k for k in nodes if k is not None):
