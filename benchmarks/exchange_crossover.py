@@ -101,7 +101,9 @@ def main():
     rows, row_u64 = 1 << 14, 16                 # 2 MiB/worker
     big = _mk_shards(mex, rows, row_u64)
     t_dense_big = _run_exchange(mex, big, "dense", 5, ("xco_big",))
-    bytes_moved = W * rows * row_u64 * 8        # padded rows ~= rows
+    # fabric bytes only (exclude each worker's 1/W self-share) — the
+    # same units the runtime cost model compares
+    bytes_moved = (W - 1) * rows * row_u64 * 8
     bw = bytes_moved / t_dense_big
 
     bytes_eq = round_overhead * bw

@@ -79,7 +79,26 @@ class Context:
 
     def _construct_host_group(self):
         from ..net import tcp
+        import os
         if jax.process_count() > 1:
+            # THRILL_TPU_NET selects the control-plane transport like
+            # the reference's THRILL_NET (api/context.cpp:822-847):
+            # tcp (default, authenticated full mesh) or mpi (mpi4py,
+            # tag-namespace groups over COMM_WORLD)
+            if os.environ.get("THRILL_TPU_NET") == "mpi":
+                from ..net import mpi as mpi_net
+                grp = mpi_net.construct(1)[0]
+                if grp.num_hosts != jax.process_count():
+                    raise ValueError(
+                        f"MPI world has {grp.num_hosts} ranks but "
+                        f"jax.process_count() is {jax.process_count()}")
+                if grp.my_rank != jax.process_index():
+                    raise ValueError(
+                        f"MPI rank {grp.my_rank} disagrees with "
+                        f"jax.process_index()={jax.process_index()} — "
+                        f"the host control plane and the device mesh "
+                        f"must use the same rank order")
+                return grp
             grp = tcp.construct_from_env()
             if grp is not None:
                 if grp.num_hosts != jax.process_count():

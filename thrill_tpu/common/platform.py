@@ -27,11 +27,14 @@ def force_cpu_platform() -> None:
               file=sys.stderr)
 
 
-def maybe_force_cpu_from_env() -> None:
-    """Apply force_cpu_platform iff the user explicitly asked for CPU."""
+def maybe_force_cpu_from_env() -> bool:
+    """Apply force_cpu_platform iff the user explicitly asked for CPU.
+    Returns whether it applied."""
     import os
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         force_cpu_platform()
+        return True
+    return False
 
 
 def accelerator_healthy(timeout_s: float = 75.0) -> bool:
@@ -58,8 +61,7 @@ def force_cpu_unless_accelerator(timeout_s: float = 75.0) -> None:
     import os
     if os.environ.get("AB_FORCE_TPU") == "1":
         return
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        force_cpu_platform()           # explicit request: skip the probe
+    if maybe_force_cpu_from_env():     # explicit request: skip the probe
         return
     if not accelerator_healthy(timeout_s):
         force_cpu_platform()
