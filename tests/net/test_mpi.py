@@ -43,6 +43,12 @@ class _FakeComm:
                 pickle.dumps(obj))      # pickle like mpi4py does
             self._store.cond.notify_all()
 
+    def isend(self, obj, dest, tag):
+        # rendezvous simulation: delivery happens on the SECOND
+        # completion poll, so the backend's isend+test loop is actually
+        # exercised (a blocking send would deadlock real MPI here)
+        return _FakeRequest(self, obj, dest, tag)
+
     def Iprobe(self, source, tag):
         with self._store.lock:
             return bool(self._store.queues[(source, self._rank, tag)])
@@ -54,6 +60,23 @@ class _FakeComm:
             while not q:
                 self._store.cond.wait(timeout=10)
             return pickle.loads(q.popleft())
+
+
+class _FakeRequest:
+    def __init__(self, comm, obj, dest, tag):
+        self._comm = comm
+        self._args = (obj, dest, tag)
+        self._polls = 0
+
+    def test(self):
+        self._polls += 1
+        if self._polls < 2:
+            return (False, None)
+        if self._args is not None:
+            obj, dest, tag = self._args
+            self._args = None
+            self._comm.send(obj, dest, tag)
+        return (True, None)
 
 
 class _FakeMPI:

@@ -142,6 +142,9 @@ class GroupByKeyNode(DIABase):
         import jax
         import jax.numpy as jnp
 
+        out = _group_host_radix_impl(shards, self.key_fn, self.group_fn)
+        if out is not None:
+            return out
         mex = shards.mesh_exec
         cap = shards.cap
         key_fn = self.key_fn
@@ -177,6 +180,55 @@ class GroupByKeyNode(DIABase):
                 group_fn(_hashable(key_fn_(items[lo])), items[lo:hi])
                 for lo, hi in zip(bounds[:-1], bounds[1:])])
         return HostShards(self.context.num_workers, lists)
+
+
+def _group_host_radix_impl(shards, key_fn, group_fn):
+    """CPU-backend grouping: native radix sort + numpy boundary scan
+    (core/host_radix.py), mirroring reduce._host_reduce_shards — the
+    XLA single-core sort is the wrong engine when device buffers are
+    host memory. Returns None when inapplicable."""
+    import jax
+
+    from ...core import host_radix
+
+    mex = shards.mesh_exec
+    if not host_radix.eligible(mex):
+        return None
+    leaves, treedef = jax.tree.flatten(shards.tree)
+    leaves_np = [np.asarray(l) for l in leaves]
+    W = mex.num_workers
+    # only the sort/encode machinery may fall back (trace-only key_fn);
+    # group_fn is an arbitrary, possibly side-effecting host fold and
+    # must NOT be silently re-run by the slow path after a mid-loop
+    # failure — its exceptions propagate
+    per_worker = []
+    try:
+        for w in range(W):
+            cnt = int(shards.counts[w])
+            if cnt == 0:
+                per_worker.append((0, None, None))
+                continue
+            tree = jax.tree.unflatten(treedef,
+                                      [l[w][:cnt] for l in leaves_np])
+            words = keymod.encode_key_words_np(key_fn(tree))
+            perm, same = host_radix.sorted_runs(words)
+            srt = [host_radix.gather_rows(np.ascontiguousarray(a), perm)
+                   for a in jax.tree.leaves(tree)]
+            bounds = [0] + (np.flatnonzero(~same) + 1).tolist() + [cnt]
+            per_worker.append((cnt, srt, bounds))
+    except Exception:
+        return None
+    lists = []
+    for cnt, srt, bounds in per_worker:
+        if cnt == 0:
+            lists.append([])
+            continue
+        items = [jax.tree.unflatten(treedef, [l[i] for l in srt])
+                 for i in range(cnt)]
+        lists.append([
+            group_fn(_hashable(key_fn(items[lo])), items[lo:hi])
+            for lo, hi in zip(bounds[:-1], bounds[1:])])
+    return HostShards(W, lists)
 
 
 def _sorted_key_runs(tree, valid, key_fn):

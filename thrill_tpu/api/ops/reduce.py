@@ -91,10 +91,7 @@ def _host_reduce_shards(shards: DeviceShards, key_fn: Callable,
     from ...core import host_radix
 
     mex = shards.mesh_exec
-    if (mex.devices[0].platform != "cpu"
-            or jax.default_backend() != "cpu"
-            or getattr(mex, "num_processes", 1) > 1
-            or not host_radix.available()):
+    if not host_radix.eligible(mex):
         return None
     leaves, treedef = jax.tree.flatten(shards.tree)
     leaves_np = [np.asarray(l) for l in leaves]          # [W, cap, ...]
@@ -113,14 +110,10 @@ def _host_reduce_shards(shards: DeviceShards, key_fn: Callable,
                 per_worker.append(tree)
                 continue
             words = keymod.encode_key_words_np(key_fn(tree))
-            perm = host_radix.radix_argsort(words)
+            perm, same_next = host_radix.sorted_runs(words)
             tree = jax.tree.map(
                 lambda a: host_radix.gather_rows(np.ascontiguousarray(a),
                                                  perm), tree)
-            same_next = np.ones(cnt - 1, dtype=bool)
-            for kw in words:
-                kws = kw[perm]
-                same_next &= kws[1:] == kws[:-1]
             run_id = np.concatenate(([0], np.cumsum(~same_next)))
             tree, nruns = _pairwise_run_fold(tree, run_id, reduce_fn)
             per_worker.append(tree)

@@ -53,6 +53,32 @@ def available() -> bool:
     return _load() is not None
 
 
+def eligible(mex) -> bool:
+    """Shared CPU-backend gate for the native-radix fast paths (Sort,
+    ReduceByKey, GroupByKey): device buffers must BE host memory
+    (CPU platform, CPU default backend, single controller) and the
+    native library must load."""
+    import jax
+    return (bool(mex.devices)
+            and mex.devices[0].platform == "cpu"
+            and jax.default_backend() == "cpu"
+            and getattr(mex, "num_processes", 1) <= 1
+            and available())
+
+
+def sorted_runs(words: List[np.ndarray]):
+    """Stable radix argsort + equal-key run detection. Returns
+    (perm, same_next) where same_next[i] == True iff sorted rows i and
+    i+1 share all key words."""
+    perm = radix_argsort(words)
+    n = int(perm.shape[0])
+    same_next = np.ones(max(n - 1, 0), dtype=bool)
+    for kw in words:
+        kws = kw[perm]
+        same_next &= kws[1:] == kws[:-1]
+    return perm, same_next
+
+
 def radix_argsort(words: List[np.ndarray]) -> np.ndarray:
     """Stable argsort by lexicographic uint64 words (words[0] most
     significant). Returns uint32 permutation (sorted -> original)."""

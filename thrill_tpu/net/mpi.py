@@ -81,10 +81,21 @@ class MpiConnection(Connection):
         self.tag = tag
 
     def send(self, obj: Any) -> None:
+        # non-blocking send + completion poll, same discipline as recv:
+        # a blocking MPI_Send above the eager threshold would park in
+        # rendezvous while HOLDING the global lock (deadlocking the
+        # Iprobe poll that drains the matching inbound message) — the
+        # reference issues MPI_Isend through its dispatcher for exactly
+        # this reason (net/mpi/dispatcher.cpp:67)
         with _MPI_LOCK:
-            # mpi4py pickles obj; buffered send returns once the
-            # payload is owned by MPI (reference AsyncWrite analog)
-            self.comm.send(obj, dest=self.peer, tag=self.tag)
+            req = self.comm.isend(obj, dest=self.peer, tag=self.tag)
+        while True:
+            with _MPI_LOCK:
+                res = req.test()
+            done = res[0] if isinstance(res, tuple) else bool(res)
+            if done:
+                return
+            time.sleep(self.POLL_S)
 
     def recv(self) -> Any:
         while True:
