@@ -1,0 +1,86 @@
+"""Engine parity: the jitted device paths vs the native CPU paths.
+
+The CPU backend routes Sort(W==1)/ReduceByKey/GroupByKey local phases
+through the native radix engine; on TPU the jitted engines run instead.
+These tests pin THRILL_TPU_HOST_RADIX=0 so the JITTED paths keep CPU
+test coverage (they are the code that runs on real hardware), and
+assert both engines produce identical results.
+"""
+
+import numpy as np
+import pytest
+
+from thrill_tpu.api import Context
+from thrill_tpu.parallel.mesh import MeshExec
+
+
+@pytest.fixture
+def no_host_radix(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "0")
+
+
+def _sort_job(W):
+    mex = MeshExec(num_workers=W)
+    ctx = Context(mex)
+    rng = np.random.default_rng(9)
+    data = {"key": rng.integers(0, 256, size=(4000, 10)).astype(np.uint8),
+            "pay": rng.integers(0, 255, size=(4000, 4)).astype(np.uint8)}
+    out = ctx.Distribute(data).Sort(key_fn=lambda t: t["key"])
+    hs = out.node.materialize().to_host_shards("parity")
+    rows = [(bytes(np.asarray(it["key"])), bytes(np.asarray(it["pay"])))
+            for l in hs.lists for it in l]
+    ctx.close()
+    return rows
+
+
+def _reduce_job(W):
+    mex = MeshExec(num_workers=W)
+    ctx = Context(mex)
+    rng = np.random.default_rng(9)
+    data = {"k": rng.integers(0, 97, size=20000).astype(np.int64),
+            "v": rng.integers(0, 1000, size=20000).astype(np.int64)}
+    out = ctx.Distribute(data).ReduceByKey(
+        lambda t: t["k"], lambda a, b: {"k": a["k"], "v": a["v"] + b["v"]})
+    hs = out.node.materialize().to_host_shards("parity")
+    pairs = sorted((int(it["k"]), int(it["v"]))
+                   for l in hs.lists for it in l)
+    ctx.close()
+    return pairs
+
+
+def _group_job(W):
+    mex = MeshExec(num_workers=W)
+    ctx = Context(mex)
+    rng = np.random.default_rng(9)
+    data = {"k": rng.integers(0, 40, size=5000).astype(np.int64),
+            "v": rng.integers(0, 100, size=5000).astype(np.int64)}
+    # item TYPES are part of the engine contract: both engines must
+    # unbox scalar fields to native Python ints (no int() masking here)
+    out = ctx.Distribute(data).GroupByKey(
+        lambda t: t["k"],
+        lambda k, items: (k, len(items), sum(i["v"] for i in items),
+                          type(items[0]["v"]).__name__))
+    res = sorted(map(tuple, out.AllGather()))
+    ctx.close()
+    return res
+
+
+@pytest.mark.parametrize("W", [1, 2])
+def test_sort_jit_engine_matches_radix(W, no_host_radix):
+    jit_rows = _sort_job(W)
+    assert jit_rows == sorted(jit_rows, key=lambda r: r[0])
+
+
+@pytest.mark.parametrize("W", [1, 2])
+def test_jit_engines_match_native(W, monkeypatch):
+    from thrill_tpu.core import host_radix
+
+    monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "1")
+    if not host_radix.available():
+        pytest.skip("native radix library unavailable")
+    native = (_sort_job(W), _reduce_job(W), _group_job(W))
+    monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "0")
+    jit = (_sort_job(W), _reduce_job(W), _group_job(W))
+    assert native[0] == jit[0], "Sort engines disagree"
+    assert native[1] == jit[1], "ReduceByKey engines disagree"
+    assert native[2] == jit[2], "GroupByKey engines disagree"

@@ -464,9 +464,7 @@ def _host_radix_w1(mex, shards: DeviceShards, key_fn, leaves, treedef,
     engine."""
     from ...core import host_radix
 
-    if (mex.devices[0].platform != "cpu"
-            or jax.default_backend() != "cpu"
-            or not host_radix.available()):
+    if not host_radix.eligible(mex):
         return None
     cap = shards.cap
     count = int(shards.counts[0])
