@@ -124,6 +124,53 @@ def _pallas():
     return "device histogram matches bincount"
 
 
+@check("text_wordcount_device")
+def _text_wordcount():
+    """Round-3 device text pipeline on real hardware: vectorized
+    tokenization -> packed byte keys -> jitted ReduceByKey (the CPU
+    host-radix fast path is ineligible on TPU, so this exercises the
+    jitted sort + segmented-scan engines end to end)."""
+    import collections
+    import tempfile
+
+    import jax
+
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    rng = np.random.default_rng(7)
+    vocab = ["w%03d" % i for i in range(500)]
+    words = [vocab[i] for i in rng.integers(0, 500, size=200_000)]
+    text = " ".join(words)
+    ctx = None
+    with tempfile.NamedTemporaryFile("w", suffix=".txt") as f:
+        f.write(text)
+        f.flush()
+        try:
+            import sys
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))), "examples"))
+            import word_count as wc
+
+            ctx = Context(MeshExec())
+            t0 = time.perf_counter()
+            out = wc.word_count_text_device(ctx, f.name)
+            sh = out.node.materialize()
+            jax.block_until_ready(jax.tree.leaves(sh.tree))
+            np.asarray(jax.tree.leaves(sh.tree)[0])[:1]
+            dt = time.perf_counter() - t0
+            hs = sh.to_host_shards("tpu-check")
+            got = {bytes(np.asarray(it["w"])).rstrip(b"\x00").decode():
+                   int(it["c"]) for l in hs.lists for it in l}
+            assert got == dict(collections.Counter(words)), "counts wrong"
+        finally:
+            if ctx is not None:
+                ctx.close()
+    return (f"{len(words) / dt / 1e6:.2f} M words/s "
+            f"({dt * 1000:.0f} ms, {len(got)} keys, golden)")
+
+
 @check("ragged_all_to_all")
 def _ragged():
     import jax

@@ -39,6 +39,16 @@ class Context:
                  config: Optional[Config] = None, seed: int = 0,
                  host_rank: Optional[int] = None) -> None:
         self.config = config or Config.from_env()
+        if self.config.compile_cache not in ("", "0", "off", "none"):
+            # persistent XLA compile cache (idempotent; best-effort —
+            # jax without the feature or a read-only home degrades to
+            # in-memory caching)
+            try:
+                jax.config.update(
+                    "jax_compilation_cache_dir",
+                    os.path.expanduser(self.config.compile_cache))
+            except Exception:
+                pass
         self.mesh_exec = mesh_exec or MeshExec(
             num_workers=self.config.num_workers)
         self.mesh_exec.exchange_mode = self.config.exchange

@@ -223,15 +223,8 @@ def _group_host_radix_impl(shards, key_fn, group_fn):
         if cnt == 0:
             lists.append([])
             continue
-        # unbox exactly like DeviceShards.to_host_shards: scalar (1-D)
-        # columns become native Python scalars via tolist(), so
-        # group_fn sees the same item types as the jitted engine
-        cols = [l.tolist() if l.ndim == 1 else list(l) for l in srt]
-        if treedef == jax.tree.structure(0):   # bare-leaf items
-            items = cols[0]
-        else:
-            items = [jax.tree.unflatten(treedef, [c[i] for c in cols])
-                     for i in range(cnt)]
+        from ...data.shards import itemize
+        items = itemize(jax.tree.unflatten(treedef, srt))
         lists.append([
             group_fn(_hashable(key_fn(items[lo])), items[lo:hi])
             for lo, hi in zip(bounds[:-1], bounds[1:])])

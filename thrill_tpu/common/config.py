@@ -79,6 +79,11 @@ class Config:
     spill_dir: str = "/tmp"
     # Enable periodic profiling.
     profile: bool = False
+    # Persistent XLA compilation cache directory ("" or "0"/"off"
+    # disables — env vars can't carry an empty string distinctly). On
+    # the tunneled TPU a cold compile costs 20-200 s per program; the
+    # on-disk cache buries repeat costs across processes and sessions.
+    compile_cache: str = "~/.cache/thrill_tpu_xla"
 
     @staticmethod
     def from_env() -> "Config":
@@ -96,6 +101,8 @@ class Config:
             log_path=_env_str("THRILL_TPU_LOG", None),
             spill_dir=_env_str("THRILL_TPU_SPILL_DIR", "/tmp"),
             profile=bool(_env_int("THRILL_TPU_PROFILE", 0)),
+            compile_cache=_env_str("THRILL_TPU_COMPILE_CACHE",
+                                   "~/.cache/thrill_tpu_xla"),
         )
 
 

@@ -44,6 +44,24 @@ def columnarize(items, treedef):
     return jax.tree.unflatten(treedef, cols)
 
 
+def itemize(tree) -> list:
+    """Columnar pytree -> list of per-item trees, with scalar (1-D)
+    columns unboxed to native Python scalars and bare-leaf items
+    unwrapped. THE unboxing used everywhere device columns become host
+    items (to_host_shards, the GroupByKey radix path) — item types must
+    not depend on which engine materialized them."""
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return []
+    # columnar slices: one tolist()/list() per leaf, not one python
+    # round trip per item per leaf
+    cols = [leaf.tolist() if leaf.ndim == 1 else list(leaf)
+            for leaf in leaves]
+    if treedef == jax.tree.structure(0):
+        return cols[0]
+    return [jax.tree.unflatten(treedef, vals) for vals in zip(*cols)]
+
+
 def tree_map(fn, *trees):
     return jax.tree.map(fn, *trees)
 
@@ -203,29 +221,12 @@ class DeviceShards:
         if log is not None and log.enabled:
             log.line(event="device_to_host", reason=reason,
                      items=int(self.counts.sum()))
-        leaf_struct = jax.tree.structure(0)
         lists: List[List[Any]] = []
         # multi-controller: materialize only this process's workers
         # (the host-storage invariant, data/multiplexer.py) — the bulk
         # data never crosses processes on a demotion
         for tree in self.to_worker_arrays(local_only=True):
-            if tree is None:
-                lists.append([])
-                continue
-            leaves, treedef = jax.tree.flatten(tree)
-            if not leaves:
-                lists.append([])
-                continue
-            # columnar slices: one tolist()/list() per leaf, not one
-            # python round trip per item per leaf
-            cols = [leaf.tolist() if leaf.ndim == 1 else list(leaf)
-                    for leaf in leaves]
-            if treedef == leaf_struct:
-                items = cols[0]
-            else:
-                items = [jax.tree.unflatten(treedef, vals)
-                         for vals in zip(*cols)]
-            lists.append(items)
+            lists.append([] if tree is None else itemize(tree))
         return HostShards(self.num_workers, lists)
 
 
