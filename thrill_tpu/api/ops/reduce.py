@@ -239,6 +239,10 @@ class ReduceNode(DIABase):
         dup = self.dup_detection
         # pre-phase: local combine (reference: ReducePrePhase)
         pre = _local_reduce_device(shards, key_fn, reduce_fn, "pre", token)
+        if W == 1:
+            # the pre-phase already combined every key; with no
+            # exchange there is nothing for a post phase to merge
+            return pre
         # shuffle by key hash (reference: Mix/CatStream exchange).
         # With DuplicateDetection, globally-unique key hashes skip the
         # shuffle: a register psum inside the destination program finds

@@ -41,7 +41,7 @@ XLA_SORT_MAX_N = 1 << 16
 
 def _impl(n: int) -> str:
     mode = os.environ.get("THRILL_TPU_SORT_IMPL", "auto")
-    if mode in ("xla", "bitonic", "chunked"):
+    if mode in ("xla", "bitonic", "chunked", "radix"):
         return mode
     if jax.default_backend() == "cpu" or n <= XLA_SORT_MAX_N:
         return "xla"
@@ -96,6 +96,16 @@ def argsort_words(words: List[jnp.ndarray]) -> jnp.ndarray:
     """Stable argsort by uint64 key words (lexicographic). [n] int32."""
     n = words[0].shape[0]
     impl = _impl(n)
+    if impl == "radix":
+        # LSD radix over 8-bit digits (O(n * passes), no comparison
+        # network, no XLA-sort compile cliff): Pallas stable-partition
+        # kernel on TPU, lax.scan fallback elsewhere. u32 split is
+        # irrelevant — digits are extracted by shifts either way.
+        from .pallas_sort import radix_argsort_device
+        bits = [32 if w.dtype == jnp.uint32 else 64 for w in words]
+        return radix_argsort_device(
+            [w.astype(jnp.uint64) for w in words],
+            word_bits=bits).astype(jnp.int32)
     words, idt = prepare_sort_words(words, n)
     if impl == "xla":
         iota = jnp.arange(n, dtype=idt)
