@@ -40,6 +40,14 @@ def timeit(fn, iters=3, warmup=1):
 
 
 def main():
+    import thrill_tpu  # noqa: F401
+    from thrill_tpu.common.platform import force_cpu_unless_accelerator
+
+    # wedged-tunnel guard: probe the accelerator in a subprocess and
+    # force CPU if it hangs (the watcher normally runs this only on a
+    # healthy chip; direct CPU validation runs hit the hang otherwise)
+    force_cpu_unless_accelerator()
+
     import jax
     import jax.numpy as jnp
 
@@ -48,8 +56,6 @@ def main():
                           os.path.expanduser("~/.cache/thrill_tpu_xla"))
     except Exception:
         pass
-
-    import thrill_tpu  # noqa: F401
     from thrill_tpu.core import keys as keymod
     from thrill_tpu.core.device_sort import argsort_words
 
@@ -74,14 +80,34 @@ def main():
     dt = timeit(lambda: f_enc(keys_d))
     print(f"RESULT step=encode_words time_ms={dt*1000:.1f}", flush=True)
 
-    # 3. argsort words only (chunked engine path)
+    # 3. argsort words only — A/B every device engine at this size
+    #    (auto = chunked above 64K; radix = the Pallas stable-partition
+    #    LSD engine, with and without the compiled kernel)
     def sort_only(k):
         words = keymod.encode_key_words(k)
         return argsort_words(list(words))
-    f_sort = jax.jit(sort_only)
-    dt = timeit(lambda: f_sort(keys_d))
-    print(f"RESULT step=argsort_words time_ms={dt*1000:.1f}", flush=True)
 
+    prev_impl = os.environ.get("THRILL_TPU_SORT_IMPL")
+    prev_pallas = os.environ.get("THRILL_TPU_PALLAS")
+    for impl, pallas in (("auto", "0"), ("radix", "0"), ("radix", "1")):
+        os.environ["THRILL_TPU_SORT_IMPL"] = impl
+        os.environ["THRILL_TPU_PALLAS"] = pallas
+        f_sort = jax.jit(sort_only)             # fresh trace per engine
+        try:
+            dt = timeit(lambda: f_sort(keys_d))
+            print(f"RESULT step=argsort_words impl={impl} "
+                  f"pallas={pallas} time_ms={dt*1000:.1f}", flush=True)
+        except Exception as e:                  # engine fails: keep going
+            print(f"RESULT step=argsort_words impl={impl} "
+                  f"pallas={pallas} error={type(e).__name__}", flush=True)
+    for var, prev in (("THRILL_TPU_SORT_IMPL", prev_impl),
+                      ("THRILL_TPU_PALLAS", prev_pallas)):
+        if prev is None:
+            os.environ.pop(var, None)
+        else:
+            os.environ[var] = prev
+
+    f_sort = jax.jit(sort_only)
     perm_d = jax.block_until_ready(f_sort(keys_d))
 
     # 4. payload gather only: [n, 90] u8 take along axis 0

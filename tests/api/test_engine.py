@@ -104,3 +104,16 @@ def test_self_zip_needs_keep():
         z = Zip(d, d, zip_fn=lambda a, b: a + b)
         assert [int(v) for v in z.AllGather()] == [2 * i for i in range(10)]
     RunLocalMock(job, 2)
+
+
+def test_collective_mean_stdev():
+    """Reference parity: PrintCollectiveMeanStdev
+    (api/context.hpp:352-375) — single-controller flavor."""
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    ctx = Context(MeshExec(num_workers=1))
+    mean, stdev = ctx.collective_mean_stdev(42.0)
+    assert mean == 42.0 and stdev == 0.0
+    ctx.print_collective_mean_stdev("t", 1.0)   # smoke: rank-0 print
+    ctx.close()

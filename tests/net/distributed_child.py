@@ -82,8 +82,12 @@ def job(ctx):
     join_ld = sorted(map(list, mkj(True).AllGather()))
     moved_ld = int(mexs.stats_items_moved) - base
 
+    # PrintCollectiveMeanStdev parity over the real control plane
+    ms = ctx.collective_mean_stdev(float(ctx.host_rank))
+
     stats = ctx.overall_stats()
     return {"pairs": pairs, "total": total, "totals": totals,
+            "rank_mean_stdev": [round(ms[0], 6), round(ms[1], 6)],
             "join_plain": join_plain, "join_ld": join_ld,
             "moved_plain": moved_plain, "moved_ld": moved_ld,
             "hosts": stats.get("hosts", 1),

@@ -114,6 +114,10 @@ def test_multi_process_wordcount_agrees(nproc, tmp_path):
                          for kb, b in right if ka == kb)
     assert r0["join_plain"] == golden_join
     assert r0["join_ld"] == golden_join
+    # collective mean/stdev of the rank id across nproc controllers
+    assert r0["rank_mean_stdev"][0] == pytest.approx((nproc - 1) / 2)
+    assert r0["rank_mean_stdev"][1] == pytest.approx(
+        ((nproc ** 2 - 1) / 12) ** 0.5, abs=1e-6)
     # and it is the correct one
     assert r0["pairs"] == [[i, 100] for i in range(10)]
     assert r0["total"] == 999 * 1000 // 2

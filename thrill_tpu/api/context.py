@@ -39,14 +39,19 @@ class Context:
                  config: Optional[Config] = None, seed: int = 0,
                  host_rank: Optional[int] = None) -> None:
         self.config = config or Config.from_env()
-        if self.config.compile_cache not in ("", "0", "off", "none"):
-            # persistent XLA compile cache (idempotent; best-effort —
-            # jax without the feature or a read-only home degrades to
-            # in-memory caching)
+        from ..common.config import DEFAULT_COMPILE_CACHE
+        cc = self.config.compile_cache
+        # auto-enable only off-CPU (XLA:CPU AOT cache entries reload
+        # with machine-feature warning spam) — but ALWAYS honor an
+        # explicitly configured non-default directory
+        if cc not in ("", "0", "off", "none") and (
+                cc != DEFAULT_COMPILE_CACHE
+                or jax.default_backend() != "cpu"):
+            # best-effort: jax without the feature or a read-only home
+            # degrades to in-memory caching
             try:
-                jax.config.update(
-                    "jax_compilation_cache_dir",
-                    os.path.expanduser(self.config.compile_cache))
+                jax.config.update("jax_compilation_cache_dir",
+                                  os.path.expanduser(cc))
             except Exception:
                 pass
         self.mesh_exec = mesh_exec or MeshExec(
@@ -279,6 +284,23 @@ class Context:
                 for k in stats}
             stats["hosts"] = len(per_host)
         return stats
+
+    def collective_mean_stdev(self, value: float):
+        """(mean, stdev) of a per-controller scalar across the cluster
+        — a COLLECTIVE; every controller must call it (reference:
+        PrintCollectiveMeanStdev, api/context.hpp:352-375)."""
+        vals = [float(v) for v in self.net.all_gather(float(value))]
+        mean = sum(vals) / len(vals)
+        var = sum((v - mean) ** 2 for v in vals) / len(vals)
+        return mean, var ** 0.5
+
+    def print_collective_mean_stdev(self, label: str,
+                                    value: float) -> None:
+        """Rank-0 prints mean/stdev of a per-controller scalar."""
+        mean, stdev = self.collective_mean_stdev(value)
+        if self.host_rank == 0:
+            print(f"{label}: mean {mean:.6g} stdev {stdev:.6g} over "
+                  f"{self.net.num_workers} hosts", flush=True)
 
     def close(self) -> None:
         if self._profiler is not None:

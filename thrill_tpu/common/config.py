@@ -47,6 +47,9 @@ def parse_si_iec_units(s: str) -> int:
     return int(float(s.strip()) * mult)
 
 
+DEFAULT_COMPILE_CACHE = "~/.cache/thrill_tpu_xla"
+
+
 @dataclasses.dataclass
 class Config:
     """Host-level runtime configuration (one per HostContext)."""
@@ -83,7 +86,9 @@ class Config:
     # disables — env vars can't carry an empty string distinctly). On
     # the tunneled TPU a cold compile costs 20-200 s per program; the
     # on-disk cache buries repeat costs across processes and sessions.
-    compile_cache: str = "~/.cache/thrill_tpu_xla"
+    # The DEFAULT auto-enables off-CPU only; an explicit non-default
+    # value is honored on every backend (api/context.py).
+    compile_cache: str = DEFAULT_COMPILE_CACHE
 
     @staticmethod
     def from_env() -> "Config":
@@ -102,7 +107,7 @@ class Config:
             spill_dir=_env_str("THRILL_TPU_SPILL_DIR", "/tmp"),
             profile=bool(_env_int("THRILL_TPU_PROFILE", 0)),
             compile_cache=_env_str("THRILL_TPU_COMPILE_CACHE",
-                                   "~/.cache/thrill_tpu_xla"),
+                                   DEFAULT_COMPILE_CACHE),
         )
 
 
