@@ -311,3 +311,62 @@ def test_tcp_group_async_large_symmetric():
     results = run_tcp(2, job)
     for r in results:
         assert r == [0, 1]
+
+
+def test_concurrent_stress_many_threads(disp):
+    """Race-discipline stress (SURVEY §5 sanitizer strategy): several
+    threads hammer DISTINCT socketpairs through ONE engine with
+    randomized frame sizes in both directions; every byte must arrive
+    intact and in FIFO order. Runs over both engines (native epoll +
+    Python fallback) via the fixture."""
+    import hashlib
+    import random
+
+    NPAIRS = 4
+    NMSG = 30
+    pairs = [socket.socketpair() for _ in range(NPAIRS)]
+    for a, b in pairs:
+        disp.register(a)
+        disp.register(b)
+    errors = []
+
+    def pump(sock_tx, sock_rx, seed):
+        try:
+            rng = random.Random(seed)
+            sizes = [rng.randrange(1, 1 << rng.randrange(1, 18))
+                     for _ in range(NMSG)]
+            payloads = [bytes(hashlib.sha256(
+                f"{seed}:{i}".encode()).digest() * ((s + 31) // 32))[:s]
+                for i, s in enumerate(sizes)]
+            wids = [disp.async_write(sock_tx, p) for p in payloads]
+            rids = [disp.async_read(sock_rx, s) for s in sizes]
+            for i, (w, r) in enumerate(zip(wids, rids)):
+                assert disp.wait(w, timeout=30) == 1, f"write {i}"
+                assert disp.wait(r, timeout=30) == 1, f"read {i}"
+                got = disp.fetch(r)
+                assert got == payloads[i], \
+                    f"payload {i} corrupt ({len(got)} vs {sizes[i]})"
+        except Exception as e:  # surfaced by the main thread
+            errors.append(e)
+
+    threads = []
+    for k, (a, b) in enumerate(pairs):
+        # full duplex: one pumper per direction per pair
+        threads.append(threading.Thread(
+            target=pump, args=(a, b, 1000 + k), daemon=True))
+        threads.append(threading.Thread(
+            target=pump, args=(b, a, 2000 + k), daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "stress deadlocked"
+    try:
+        if errors:
+            raise errors[0]
+    finally:
+        for a, b in pairs:
+            disp.unregister(a)
+            disp.unregister(b)
+            a.close()
+            b.close()

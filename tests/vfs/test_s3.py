@@ -96,3 +96,83 @@ def test_hdfs_path_parse():
     assert hdfs_file.parse_hdfs_path("hdfs://nn:9000/a/b.txt") == \
         ("nn", 9000, "/a/b.txt")
     assert hdfs_file.parse_hdfs_path("hdfs:///a/b.txt") == ("", 0, "/a/b.txt")
+
+
+class _FakeHdfsClient:
+    """pyarrow.fs.HadoopFileSystem stand-in over one dict."""
+
+    def __init__(self, objects):
+        self.objects = objects
+
+    def get_file_info(self, sel_or_paths):
+        from pyarrow import fs as pafs
+        if isinstance(sel_or_paths, list):
+            out = []
+            for p in sel_or_paths:
+                key = p.lstrip("/")
+                if key in self.objects:
+                    out.append(types.SimpleNamespace(
+                        type=pafs.FileType.File, path=p,
+                        size=len(self.objects[key])))
+                elif any(k.startswith(key.rstrip("/") + "/")
+                         for k in self.objects):
+                    out.append(types.SimpleNamespace(
+                        type=pafs.FileType.Directory, path=p, size=0))
+                else:
+                    out.append(types.SimpleNamespace(
+                        type=pafs.FileType.NotFound, path=p, size=0))
+            return out
+        base = sel_or_paths.base_dir.strip("/")
+        out = []
+        for k, v in sorted(self.objects.items()):
+            parent = k.rsplit("/", 1)[0] if "/" in k else ""
+            if sel_or_paths.recursive:
+                if not k.startswith(base + "/") and parent != base:
+                    continue
+            elif parent != base:
+                continue
+            out.append(types.SimpleNamespace(
+                type=pafs.FileType.File, path="/" + k, size=len(v)))
+        return out
+
+    def open_input_stream(self, path):
+        return io.BytesIO(self.objects[path.lstrip("/")])
+
+    def open_output_stream(self, path):
+        client = self
+
+        class W(io.BytesIO):
+            def close(w):
+                client.objects[path.lstrip("/")] = w.getvalue()
+                io.BytesIO.close(w)
+
+        return W()
+
+
+def test_hdfs_glob_read_write_roundtrip(monkeypatch):
+    """The same vfs round-trip the s3 test pins, over a faked
+    HadoopFileSystem client (reference: vfs/hdfs3_file.{hpp,cpp})."""
+    from thrill_tpu.vfs import hdfs_file
+
+    objects = {"data/part-0.txt": b"hello\nworld\n",
+               "data/part-1.txt": b"more\n",
+               "data/part-1.bin": b"\x00\x01"}
+    client = _FakeHdfsClient(objects)
+    monkeypatch.setattr(hdfs_file, "_connect", lambda h, p: client)
+
+    fl = file_io.Glob("hdfs://nn:9000/data/part-*.txt")
+    assert [f.path for f in fl.files] == \
+        ["hdfs://nn:9000/data/part-0.txt",
+         "hdfs://nn:9000/data/part-1.txt"]
+    assert fl.total_size == 12 + 5
+    assert fl.files[1].size_ex_psum == 12
+
+    with file_io.OpenReadStream("hdfs://nn:9000/data/part-0.txt") as f:
+        assert f.read() == b"hello\nworld\n"
+    with file_io.OpenReadStream("hdfs://nn:9000/data/part-0.txt",
+                                offset=6) as f:
+        assert f.read() == b"world\n"
+
+    with file_io.OpenWriteStream("hdfs://nn:9000/out/res.txt") as f:
+        f.write(b"abc")
+    assert objects["out/res.txt"] == b"abc"
