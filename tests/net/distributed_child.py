@@ -55,6 +55,22 @@ def job(ctx):
             .FlatMap(lambda line: line.split()) \
             .Sort(compare_fn=lambda a, b: a < b).AllGather()
 
+    # DEVICE text pipeline across controllers: each process reads only
+    # its workers' byte ranges and the packed word counts are agreed
+    # over the control plane before the sharded device_put
+    device_counts = []
+    if text_path:
+        import jax.numpy as jnp
+        words_dev = ctx.ReadWordsPacked(text_path, max_word=12)
+        red = words_dev.Map(lambda t: {
+            "w": t["w"],
+            "c": jnp.ones_like(t["w"][..., 0], dtype=jnp.int64)}).ReduceByKey(
+            lambda t: t["w"],
+            lambda a, b: {"w": a["w"], "c": a["c"] + b["c"]})
+        device_counts = sorted(
+            (bytes(np.asarray(it["w"])).rstrip(b"\x00").decode(),
+             int(it["c"])) for it in red.AllGather())
+
     # host-storage InnerJoin, with and without LocationDetection: the
     # fingerprint exchange must agree across controllers and the flag
     # must cut cross-process shuffle traffic (reference:
@@ -88,6 +104,7 @@ def job(ctx):
     stats = ctx.overall_stats()
     return {"pairs": pairs, "total": total, "totals": totals,
             "rank_mean_stdev": [round(ms[0], 6), round(ms[1], 6)],
+            "device_counts": device_counts,
             "join_plain": join_plain, "join_ld": join_ld,
             "moved_plain": moved_plain, "moved_ld": moved_ld,
             "hosts": stats.get("hosts", 1),

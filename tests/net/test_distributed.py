@@ -130,6 +130,10 @@ def test_multi_process_wordcount_agrees(nproc, tmp_path):
     # host-storage text WordCount matches the in-process golden on
     # every controller (cross-process multiplexer shuffle)
     golden_counts, golden_total, golden_sorted = _golden_wordcount()
+    # DEVICE text pipeline (ReadWordsPacked + jitted ReduceByKey with
+    # cross-process counts agreement) matches the same golden
+    assert r0["device_counts"] == [list(kv) for kv in golden_counts] \
+        or r0["device_counts"] == golden_counts
     assert r0["host_counts"] == [list(kv) for kv in golden_counts] or \
         r0["host_counts"] == golden_counts
     assert r0["host_total"] == golden_total

@@ -132,7 +132,22 @@ class MeshExec:
         return NamedSharding(self.mesh, P())
 
     def put(self, arr) -> jax.Array:
-        """Place a host array (leading dim == num_workers) sharded."""
+        """Place a host array (leading dim == num_workers) sharded.
+
+        Multi-controller: assembled from per-device addressable shards
+        (jax.device_put with a sharded sharding ASSERTS value equality
+        across processes — but builds like ReadWordsPacked/ReadBinary
+        legitimately hold real data only for their own workers' rows,
+        with agreed shapes/counts and zero padding elsewhere)."""
+        if self.num_processes > 1:
+            arr = np.asarray(arr)
+            assert arr.shape[0] % self.num_workers == 0, arr.shape
+            k = arr.shape[0] // self.num_workers   # rows per worker
+            local = [jax.device_put(arr[w * k:(w + 1) * k],
+                                    self.devices[w])
+                     for w in self.local_workers]
+            return jax.make_array_from_single_device_arrays(
+                arr.shape, self.sharded, local)
         return jax.device_put(arr, self.sharded)
 
     def put_tree(self, tree):
