@@ -117,3 +117,15 @@ def test_collective_mean_stdev():
     assert mean == 42.0 and stdev == 0.0
     ctx.print_collective_mean_stdev("t", 1.0)   # smoke: rank-0 print
     ctx.close()
+
+
+def test_top_level_api_surface():
+    """thrill_tpu.Run / .DIA etc. resolve lazily at the package top
+    level (reference: thrill::Run, thrill::DIA)."""
+    import thrill_tpu as tt
+
+    assert tt.RunLocalMock(lambda ctx: int(ctx.Generate(10).Sum()),
+                           1) == 45
+    assert tt.DIA.__name__ == "DIA"
+    with pytest.raises(AttributeError):
+        tt.does_not_exist

@@ -21,3 +21,21 @@ _jax.config.update("jax_enable_x64", True)
 from . import common, mem, net  # noqa: E402,F401
 
 __version__ = "0.1.0"
+
+#: top-level convenience surface (the reference exposes thrill::Run /
+#: thrill::DIA the same way); resolved lazily so importing thrill_tpu
+#: stays light
+_API_NAMES = ("Context", "DIA", "Run", "RunDistributed", "RunLocalMock",
+              "RunLocalTests", "Concat", "InnerJoin", "Merge", "Union",
+              "Zip", "ZipWindow")
+
+
+def __getattr__(name):
+    if name in _API_NAMES:
+        from . import api
+        return getattr(api, name)
+    raise AttributeError(f"module 'thrill_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_API_NAMES))
