@@ -127,5 +127,12 @@ def test_top_level_api_surface():
     assert tt.RunLocalMock(lambda ctx: int(ctx.Generate(10).Sum()),
                            1) == 45
     assert tt.DIA.__name__ == "DIA"
+    # every name the lazy surface advertises must resolve, and every
+    # public api export must be advertised (no silent drift)
+    from thrill_tpu import api as tt_api
+    for name in tt._API_NAMES:
+        assert getattr(tt, name) is getattr(tt_api, name)
+    public = {n for n in dir(tt_api) if n[0].isupper()}
+    assert public <= set(tt._API_NAMES), public - set(tt._API_NAMES)
     with pytest.raises(AttributeError):
         tt.does_not_exist

@@ -1,0 +1,111 @@
+"""Pipeline fuzzing: random op chains vs a plain-Python interpreter.
+
+The reference pins operator semantics with hand-written cases per op;
+this adds the adversarial complement — randomly composed pipelines
+(Map/Filter/Sort/ReduceByKey/PrefixSum/Rebalance/Union...) over random
+int data, executed both by the framework (swept over mesh sizes) and
+by a tiny Python model. Order-ambiguous ops (reduce's hash order,
+union's interleaving) are normalized with an explicit Sort on BOTH
+sides, so every comparison is order-exact and later order-sensitive
+ops (PrefixSum) stay meaningful. Any divergence in any composition
+fails with the reproducing seed.
+"""
+
+import numpy as np
+import pytest
+
+from thrill_tpu.api import Context
+from thrill_tpu.parallel.mesh import MeshExec
+
+
+def _apply_ref(ops, data):
+    """Reference semantics in plain Python over a global list."""
+    cur = list(data)
+    for op, arg in ops:
+        if op == "map":
+            cur = [x * arg[0] + arg[1] for x in cur]
+        elif op == "filter":
+            cur = [x for x in cur if x % arg != 0]
+        elif op == "sort":
+            cur = sorted(cur)
+        elif op == "reduce":
+            acc = {}
+            for x in cur:
+                acc[x % arg] = acc.get(x % arg, 0) + x
+            cur = sorted(acc.values())
+        elif op == "prefix":
+            out, s = [], 0
+            for x in cur:
+                s += x
+                out.append(s)
+            cur = out
+        elif op == "union":
+            cur = sorted(cur + [x + arg for x in cur])
+        elif op == "rebalance":
+            pass                            # repartition only
+    return cur
+
+
+def _apply_dia(ops, data, W):
+    mex = MeshExec(num_workers=W)
+    ctx = Context(mex)
+    d = ctx.Distribute(np.asarray(data, dtype=np.int64))
+    for op, arg in ops:
+        if op == "map":
+            a, b = arg
+            d = d.Map(lambda x, a=a, b=b: x * a + b)
+        elif op == "filter":
+            d = d.Filter(lambda x, m=arg: x % m != 0)
+        elif op == "sort":
+            d = d.Sort()
+        elif op == "reduce":
+            # hash delivery order is unspecified: normalize like the
+            # model does
+            d = d.Map(lambda x, m=arg: (x % m, x)).ReducePair(
+                lambda a, b: a + b).Map(lambda kv: kv[1]).Sort()
+        elif op == "prefix":
+            d = d.PrefixSum()
+        elif op == "union":
+            from thrill_tpu.api import Union
+            d.Keep()
+            d = Union(d, d.Map(lambda x, k=arg: x + k)).Sort()
+        elif op == "rebalance":
+            d = d.Rebalance()
+    out = [int(x) for x in d.AllGather()]
+    ctx.close()
+    return out
+
+
+def _gen_ops(rng):
+    ops = []
+    n_union = 0
+    for _ in range(int(rng.integers(2, 6))):
+        kind = str(rng.choice(["map", "filter", "sort", "reduce",
+                               "prefix", "union", "rebalance"]))
+        if kind == "union":
+            if n_union >= 2:                # cap data blowup at 4x
+                continue
+            n_union += 1
+            ops.append(("union", int(rng.integers(1, 100))))
+        elif kind == "map":
+            ops.append(("map", (int(rng.integers(1, 5)),
+                                int(rng.integers(-3, 4)))))
+        elif kind == "filter":
+            ops.append(("filter", int(rng.integers(2, 6))))
+        elif kind == "reduce":
+            ops.append(("reduce", int(rng.integers(2, 10))))
+        else:
+            ops.append((kind, None))
+    return ops
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_pipeline_matches_python_model(seed):
+    rng = np.random.default_rng(seed)
+    data = rng.integers(-50, 200,
+                        size=int(rng.integers(10, 300))).tolist()
+    ops = _gen_ops(rng)
+    expect = _apply_ref(ops, data)
+    for W in (1, 2, 5):
+        got = _apply_dia(ops, data, W)
+        assert got == expect, (seed, W, ops)
