@@ -109,3 +109,48 @@ def test_fuzz_pipeline_matches_python_model(seed):
     for W in (1, 2, 5):
         got = _apply_dia(ops, data, W)
         assert got == expect, (seed, W, ops)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_two_chain_zip_join(seed):
+    """Two independently transformed chains combined by Zip (index
+    realignment exchange) or InnerJoin (hash exchange + sort-merge-
+    join + pair expansion), vs the Python model."""
+    from thrill_tpu.api import InnerJoin, Zip
+
+    rng = np.random.default_rng(1000 + seed)
+    n = int(rng.integers(8, 200))
+    data = rng.integers(0, 60, size=n).tolist()
+    a_mul = int(rng.integers(1, 4))
+    b_add = int(rng.integers(0, 9))
+    combine = str(rng.choice(["zip", "join"]))
+
+    # model
+    a_ref = [x * a_mul for x in data]
+    b_ref = [x + b_add for x in data]
+    if combine == "zip":
+        expect = sorted(x + y for x, y in zip(a_ref, b_ref))
+    else:
+        keys_a = {}
+        for x in a_ref:
+            keys_a.setdefault(x % 7, []).append(x)
+        expect = sorted((xa, y) for y in b_ref
+                        for xa in keys_a.get(y % 7, []))
+
+    for W in (1, 2, 5):
+        mex = MeshExec(num_workers=W)
+        ctx = Context(mex)
+        base = ctx.Distribute(np.asarray(data, dtype=np.int64))
+        base.Keep()
+        a = base.Map(lambda x, m=a_mul: x * m)
+        b = base.Map(lambda x, k=b_add: x + k)
+        if combine == "zip":
+            out = Zip(a, b, zip_fn=lambda x, y: x + y)
+            got = sorted(int(v) for v in out.AllGather())
+        else:
+            out = InnerJoin(a, b, lambda x: x % 7, lambda y: y % 7,
+                            lambda x, y: (x, y))
+            got = sorted((int(p[0]), int(p[1]))
+                         for p in out.AllGather())
+        assert got == expect, (seed, W, combine, n)
+        ctx.close()
