@@ -154,3 +154,53 @@ def test_fuzz_two_chain_zip_join(seed):
                          for p in out.AllGather())
         assert got == expect, (seed, W, combine, n)
         ctx.close()
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_host_string_pipelines(seed):
+    """Host-storage fuzzing: string items through FlatMap / Filter /
+    comparator Sort / ReducePair / GroupByKey vs the Python model —
+    the host fallback paths (Python lists, EM sort, host group-by)
+    composed randomly."""
+    rng = np.random.default_rng(5000 + seed)
+    vocab = ["".join(rng.choice(list("abcd"), size=int(rng.integers(1, 5))))
+             for _ in range(20)]
+    lines = [" ".join(vocab[i] for i in
+                      rng.integers(0, len(vocab),
+                                   size=int(rng.integers(0, 8))))
+             for _ in range(int(rng.integers(3, 40)))]
+    mode = str(rng.choice(["wordcount", "sort", "group"]))
+
+    words_ref = [w for line in lines for w in line.split()]
+    if mode == "wordcount":
+        acc = {}
+        for w in words_ref:
+            acc[w] = acc.get(w, 0) + 1
+        expect = sorted(acc.items())
+    elif mode == "sort":
+        expect = sorted(words_ref, reverse=True)
+    else:
+        groups = {}
+        for w in words_ref:
+            groups.setdefault(w[0], []).append(w)
+        expect = sorted((k, len(v), max(v)) for k, v in groups.items())
+
+    for W in (1, 2, 5):
+        mex = MeshExec(num_workers=W)
+        ctx = Context(mex)
+        words = ctx.Distribute(lines, storage="host") \
+            .FlatMap(lambda line: line.split())
+        if mode == "wordcount":
+            out = words.Map(lambda w: (w, 1)).ReducePair(
+                lambda a, b: a + b)
+            got = sorted((k, int(v)) for k, v in out.AllGather())
+        elif mode == "sort":
+            out = words.Sort(compare_fn=lambda a, b: a > b)
+            got = list(out.AllGather())
+        else:
+            out = words.GroupByKey(
+                lambda w: w[0],
+                lambda k, items: (k, len(items), max(items)))
+            got = sorted(map(tuple, out.AllGather()))
+        assert got == expect, (seed, W, mode)
+        ctx.close()
