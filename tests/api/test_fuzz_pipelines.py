@@ -204,3 +204,26 @@ def test_fuzz_host_string_pipelines(seed):
             got = sorted(map(tuple, out.AllGather()))
         assert got == expect, (seed, W, mode)
         ctx.close()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_sort_stability_heavy_duplicates(seed):
+    """Stability under heavy duplicate keys across the mesh sweep: equal
+    keys must keep GLOBAL input order (the reference breaks splitter
+    ties by global index, api/sort.hpp:487-502; here the tie-break
+    word). Payload carries the sequence id to prove it."""
+    rng = np.random.default_rng(9000 + seed)
+    n = int(rng.integers(50, 2000))
+    nkeys = int(rng.integers(1, 6))          # heavy duplication
+    data = {"k": rng.integers(0, nkeys, size=n).astype(np.int64),
+            "seq": np.arange(n, dtype=np.int64)}
+    expect = sorted(zip(data["k"].tolist(), data["seq"].tolist()))
+    for W in (1, 2, 5):
+        mex = MeshExec(num_workers=W)
+        ctx = Context(mex)
+        out = ctx.Distribute(data).Sort(key_fn=lambda t: t["k"])
+        hs = out.node.materialize().to_host_shards("fuzz")
+        got = [(int(it["k"]), int(it["seq"]))
+               for l in hs.lists for it in l]
+        assert got == expect, (seed, W, n, nkeys)
+        ctx.close()
