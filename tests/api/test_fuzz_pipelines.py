@@ -227,3 +227,49 @@ def test_fuzz_sort_stability_heavy_duplicates(seed):
                for l in hs.lists for it in l]
         assert got == expect, (seed, W, n, nkeys)
         ctx.close()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_index_space_ops(seed):
+    """ReduceToIndex (dense array, neutral fill) and GroupToIndex
+    (out-of-range indices dropped at the source) vs the Python model,
+    over random sizes/data and the mesh sweep."""
+    rng = np.random.default_rng(7000 + seed)
+    size = int(rng.integers(3, 30))
+    n = int(rng.integers(5, 400))
+    data = rng.integers(0, 500, size=n).tolist()
+    neutral = int(rng.integers(-5, 5))
+
+    # model: dense per-slot sums (neutral where empty) + group summary
+    # (out-of-range indices drop)
+    groups = {}
+    for x in data:
+        i = x % (size + 2)                  # some indices out of range
+        if i < size:
+            groups.setdefault(i, []).append(x)
+    sums = {}
+    for x in data:
+        i = x % (size + 2)
+        if i < size:
+            sums[i] = sums.get(i, 0) + x
+    dense = [sums.get(i, neutral) for i in range(size)]
+    expect_group = sorted((i, len(v), sum(v)) for i, v in groups.items())
+
+    for W in (1, 2, 5):
+        mex = MeshExec(num_workers=W)
+        ctx = Context(mex)
+        d = ctx.Distribute(np.asarray(data, dtype=np.int64))
+        d.Keep()
+        # in-range only for ReduceToIndex (its contract); GroupToIndex
+        # drops out-of-range itself
+        r = d.Filter(lambda x, s=size: x % (s + 2) < s).ReduceToIndex(
+            lambda x, s=size: x % (s + 2), lambda a, b: a + b, size,
+            neutral=neutral)
+        got_dense = [int(x) for x in r.AllGather()]
+        assert got_dense == dense, (seed, W, "reduce_to_index")
+        g = d.GroupToIndex(
+            lambda x, s=size: x % (s + 2),
+            lambda i, items: (i, len(items), sum(items)), size)
+        got_group = sorted(map(tuple, (t for t in g.AllGather())))
+        assert got_group == expect_group, (seed, W, "group_to_index")
+        ctx.close()
