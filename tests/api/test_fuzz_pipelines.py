@@ -273,3 +273,37 @@ def test_fuzz_index_space_ops(seed):
         got_group = sorted(map(tuple, (t for t in g.AllGather())))
         assert got_group == expect_group, (seed, W, "group_to_index")
         ctx.close()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_windows(seed):
+    """Window (ppermute halo exchange) and DisjointWindow over random
+    sizes/window widths vs the Python sliding/blocked model."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(8000 + seed)
+    n = int(rng.integers(5, 500))
+    k = int(rng.integers(2, 7))
+    data = rng.integers(-100, 100, size=n).tolist()
+
+    expect_slide = [sum(data[i:i + k]) for i in range(n - k + 1)] \
+        if n >= k else []
+    # trailing partial block is dropped (the reference delivers it only
+    # through a separate partial_window_function, api/window.hpp)
+    expect_disj = [sum(data[i:i + k]) for i in range(0, n - k + 1, k)]
+
+    for W in (1, 2, 5):
+        mex = MeshExec(num_workers=W)
+        ctx = Context(mex)
+        d = ctx.Distribute(np.asarray(data, dtype=np.int64))
+        d.Keep()
+        slide = d.Window(k, lambda i, w: sum(w),
+                         device_fn=lambda wins: jnp.sum(wins, axis=1))
+        got_slide = [int(x) for x in slide.AllGather()]
+        assert got_slide == expect_slide, (seed, W, n, k, "window")
+        disj = d.DisjointWindow(k, lambda i, w: sum(w),
+                                device_fn=lambda wins: jnp.sum(wins,
+                                                               axis=1))
+        got_disj = [int(x) for x in disj.AllGather()]
+        assert got_disj == expect_disj, (seed, W, n, k, "disjoint")
+        ctx.close()
