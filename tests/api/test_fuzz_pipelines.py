@@ -307,3 +307,26 @@ def test_fuzz_windows(seed):
         got_disj = [int(x) for x in disj.AllGather()]
         assert got_disj == expect_disj, (seed, W, n, k, "disjoint")
         ctx.close()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_disjoint_window_partial_fn(seed):
+    """partial_window_function parity: the trailing block of fewer
+    than k items reaches partial_fn (reference: api/window.hpp:389)."""
+    rng = np.random.default_rng(8500 + seed)
+    n = int(rng.integers(5, 300))
+    k = int(rng.integers(2, 7))
+    data = rng.integers(0, 100, size=n).tolist()
+    expect = [sum(data[i:i + k]) for i in range(0, n - k + 1, k)]
+    if n % k:
+        expect.append(-sum(data[n - (n % k):]))     # partial negated
+    for W in (1, 2, 5):
+        mex = MeshExec(num_workers=W)
+        ctx = Context(mex)
+        out = ctx.Distribute(np.asarray(data, dtype=np.int64)) \
+            .DisjointWindow(k, lambda i, w: sum(int(x) for x in w),
+                            partial_fn=lambda i, w: -sum(int(x)
+                                                         for x in w))
+        got = [int(x) for x in out.AllGather()]
+        assert got == expect, (seed, W, n, k)
+        ctx.close()

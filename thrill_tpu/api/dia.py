@@ -122,9 +122,15 @@ class DIA:
                              factor=factor)
 
     def DisjointWindow(self, k: int, fn: Callable,
-                       device_fn: Optional[Callable] = None) -> "DIA":
+                       device_fn: Optional[Callable] = None,
+                       partial_fn: Optional[Callable] = None) -> "DIA":
+        """``partial_fn(start, items)`` additionally receives the
+        trailing block of fewer than k items (reference:
+        partial_window_function, api/window.hpp:389); passing it keeps
+        the op on the host path (dynamic-length tail)."""
         from .ops import window as _w
-        return _w.Window(self, k, fn, device_fn, disjoint=True)
+        return _w.Window(self, k, fn, device_fn, disjoint=True,
+                         partial_fn=partial_fn)
 
     def Concat(self, other: "DIA") -> "DIA":
         from .ops import concat as _c

@@ -96,25 +96,36 @@ def _windowed_device_program(shards: DeviceShards, k: int, cache_tag,
 
 class WindowNode(DIABase):
     def __init__(self, ctx, link, k: int, fn: Optional[Callable],
-                 device_fn: Optional[Callable], disjoint: bool) -> None:
+                 device_fn: Optional[Callable], disjoint: bool,
+                 partial_fn: Optional[Callable] = None) -> None:
         super().__init__(ctx, "DisjointWindow" if disjoint else "Window",
                          [link])
         self.k = int(k)
         self.fn = fn
         self.device_fn = device_fn
         self.disjoint = disjoint
+        # reference: DisjointWindow delivers the trailing (< k) block
+        # to a separate partial_window_function (api/window.hpp:389);
+        # its dynamic length keeps it on the host path
+        if partial_fn is not None and not disjoint:
+            raise ValueError(
+                "partial_fn only applies to DisjointWindow (the sliding "
+                "Window has no trailing partial block)")
+        self.partial_fn = partial_fn
 
     def compute(self):
         shards = self.parents[0].pull()
         k = self.k
         if isinstance(shards, DeviceShards) and self.device_fn is not None \
+                and self.partial_fn is None \
                 and bool(np.all(shards.counts[:-1] >= k - 1)):
             return self._compute_device(shards)
         if self.fn is None:
             raise ValueError(
-                f"{self.label} fell back to the host path (host storage "
-                f"or a worker with fewer than k-1 items) but no host fn "
-                f"was given — pass fn alongside device_fn")
+                f"{self.label} fell back to the host path (host storage, "
+                f"a worker with fewer than k-1 items, or partial_fn — "
+                f"which is host-only) but no host fn was given — pass fn "
+                f"alongside device_fn")
         if isinstance(shards, DeviceShards):
             shards = shards.to_host_shards("window-host-fn")
         return self._compute_host(shards)
@@ -132,6 +143,10 @@ class WindowNode(DIABase):
             wins = [flat[i:i + k] for i in range(len(flat) - k + 1)]
         out = [fn(i * (k if self.disjoint else 1), w)
                for i, w in enumerate(wins)]
+        if self.disjoint and self.partial_fn is not None \
+                and len(flat) % k:
+            rest = flat[len(flat) - len(flat) % k:]
+            out.append(self.partial_fn(len(flat) - len(rest), rest))
         W = shards.num_workers
         bounds = [(w * len(out)) // W for w in range(W + 1)]
         return multiplexer.localize(
@@ -223,9 +238,10 @@ class FlatWindowNode(DIABase):
             shards, k, ("flatwindow_dev", fn, factor), make_output)
 
 
-def Window(dia: DIA, k: int, fn, device_fn=None, disjoint=False) -> DIA:
+def Window(dia: DIA, k: int, fn, device_fn=None, disjoint=False,
+           partial_fn=None) -> DIA:
     return DIA(WindowNode(dia.context, dia._link(), k, fn, device_fn,
-                          disjoint))
+                          disjoint, partial_fn=partial_fn))
 
 
 def FlatWindow(dia: DIA, k: int, fn, device_fn=None, factor: int = 0
