@@ -330,3 +330,43 @@ def test_fuzz_disjoint_window_partial_fn(seed):
         got = [int(x) for x in out.AllGather()]
         assert got == expect, (seed, W, n, k)
         ctx.close()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_merge_sample_hll(seed):
+    """Merge of sorted DIAs (quantile-split presorted exchange),
+    Sample(k) (hypergeometric budget split) and HyperLogLog (register
+    sketch) over random data and the mesh sweep."""
+    from thrill_tpu.api import Merge
+
+    rng = np.random.default_rng(3000 + seed)
+    na, nb = int(rng.integers(5, 400)), int(rng.integers(5, 400))
+    a_data = np.sort(rng.integers(0, 1000, size=na)).astype(np.int64)
+    b_data = np.sort(rng.integers(0, 1000, size=nb)).astype(np.int64)
+    expect_merge = sorted(a_data.tolist() + b_data.tolist())
+    k = int(rng.integers(1, 200))
+    pool = rng.integers(0, 10000, size=int(rng.integers(20, 500)))
+    distinct = len(set(pool.tolist()))
+
+    for W in (1, 2, 5):
+        mex = MeshExec(num_workers=W)
+        ctx = Context(mex)
+        m = Merge(ctx.Distribute(a_data.copy()),
+                  ctx.Distribute(b_data.copy()))
+        got = [int(x) for x in m.AllGather()]
+        assert got == expect_merge, (seed, W, "merge")
+
+        s = ctx.Distribute(pool.astype(np.int64)).Sample(k, seed=seed)
+        picked = [int(x) for x in s.AllGather()]
+        assert len(picked) == min(k, len(pool)), (seed, W, "sample")
+        counts = {}
+        for x in pool.tolist():
+            counts[x] = counts.get(x, 0) + 1
+        for x in picked:
+            counts[x] -= 1                   # multiset-subset property
+            assert counts[x] >= 0, (seed, W, "sample-subset")
+
+        est = ctx.Distribute(pool.astype(np.int64)).HyperLogLog()
+        assert 0.7 * distinct <= est <= 1.3 * distinct, \
+            (seed, W, "hll", est, distinct)
+        ctx.close()
