@@ -370,3 +370,32 @@ def test_fuzz_merge_sample_hll(seed):
         assert 0.7 * distinct <= est <= 1.3 * distinct, \
             (seed, W, "hll", est, distinct)
         ctx.close()
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_fuzz_write_read_binary_roundtrip(seed, tmp_path):
+    """Checkpoint/resume analog (reference: WriteBinary + ReadBinary,
+    api/dia.hpp:864-886): random dtype/shape/size round-trips through
+    per-worker binary files and back, across the mesh sweep."""
+    rng = np.random.default_rng(4000 + seed)
+    dtype = np.dtype(str(rng.choice(["int64", "float64", "uint8",
+                                     "int32"])))
+    shape = () if rng.integers(0, 2) else (int(rng.integers(2, 6)),)
+    n = int(rng.integers(3, 500))
+    if dtype.kind == "f":
+        data = rng.standard_normal((n,) + shape).astype(dtype)
+    else:
+        data = rng.integers(0, 100, size=(n,) + shape).astype(dtype)
+
+    for W in (1, 2, 5):
+        mex = MeshExec(num_workers=W)
+        ctx = Context(mex)
+        path = str(tmp_path / f"ckpt-{seed}-{W}-$$$$$.bin")
+        ctx.Distribute(data.copy()).WriteBinary(path)
+        back = ctx.ReadBinary(str(tmp_path / f"ckpt-{seed}-{W}-*.bin"),
+                              dtype, record_shape=shape)
+        got = np.stack([np.asarray(it) for it in back.AllGather()]) \
+            if shape else np.asarray(back.AllGather(), dtype=dtype)
+        assert got.shape == data.shape and np.array_equal(got, data), \
+            (seed, W, dtype, shape, n)
+        ctx.close()
