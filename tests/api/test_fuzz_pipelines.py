@@ -253,7 +253,11 @@ def test_fuzz_index_space_ops(seed):
         if i < size:
             sums[i] = sums.get(i, 0) + x
     dense = [sums.get(i, neutral) for i in range(size)]
-    expect_group = sorted((i, len(v), sum(v)) for i, v in groups.items())
+    # GroupToIndex emits the NEUTRAL element for empty slots (reference:
+    # group_to_index.hpp dense index-range semantics)
+    expect_group = sorted(
+        (i, len(groups[i]), sum(groups[i])) if i in groups
+        else (-1, -1, -1) for i in range(size))
 
     for W in (1, 2, 5):
         mex = MeshExec(num_workers=W)
@@ -269,8 +273,9 @@ def test_fuzz_index_space_ops(seed):
         assert got_dense == dense, (seed, W, "reduce_to_index")
         g = d.GroupToIndex(
             lambda x, s=size: x % (s + 2),
-            lambda i, items: (i, len(items), sum(items)), size)
-        got_group = sorted(map(tuple, (t for t in g.AllGather())))
+            lambda i, items: (i, len(items), sum(items)), size,
+            neutral=(-1, -1, -1))
+        got_group = sorted(map(tuple, g.AllGather()))
         assert got_group == expect_group, (seed, W, "group_to_index")
         ctx.close()
 
