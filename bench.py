@@ -216,14 +216,15 @@ def _wc_key(t):
     return t["w"]
 
 
-def _wc_red(a, b):
-    return {"w": a["w"], "c": a["c"] + b["c"]}
-
-
 def _wordcount_metric(ctx, n: int) -> dict:
     """WordCount throughput: n packed words, zipf-ish key skew, full
-    device ReduceByKey; proxy = collections.Counter over the strings."""
+    device ReduceByKey; proxy = collections.Counter over the strings.
+    The reduce functor is the declarative FieldReduce — the idiomatic
+    WordCount spelling here, matching the reference's std::plus functor
+    (examples/word_count/word_count.hpp) which its templates likewise
+    inline into the aggregation loop."""
     import collections
+    from thrill_tpu.api import FieldReduce
     try:
         rng = np.random.default_rng(1)
         vocab_n = max(1024, n // 64)
@@ -238,9 +239,11 @@ def _wordcount_metric(ctx, n: int) -> dict:
                             "c": np.ones(n, dtype=np.int64)})
         d.Keep()
 
+        red = FieldReduce({"w": "first", "c": "sum"})
+
         def once():
             d.Keep()
-            out = d.ReduceByKey(_wc_key, _wc_red)
+            out = d.ReduceByKey(_wc_key, red)
             sh = out.node.materialize()
             jax.block_until_ready(jax.tree.leaves(sh.tree))
             np.asarray(jax.tree.leaves(sh.tree)[0])[:1]

@@ -15,7 +15,7 @@ import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
 
 import numpy as np
 
-from thrill_tpu.api import Context
+from thrill_tpu.api import Context, FieldReduce
 
 
 def word_count(ctx: Context, path_or_lines):
@@ -66,9 +66,11 @@ def word_count_text_device(ctx: Context, path: str,
     pairs = words.Map(lambda t: {
         "w": t["w"],
         "c": jnp.ones_like(t["w"][..., 0], dtype=jnp.int64)})
+    # declarative functor: the host local phase fuses the whole
+    # aggregation into one native hash-probe pass (the analog of the
+    # reference's std::plus being template-inlined into its table)
     return pairs.ReduceByKey(lambda t: t["w"],
-                             lambda a, b: {"w": a["w"],
-                                           "c": a["c"] + b["c"]})
+                             FieldReduce({"w": "first", "c": "sum"}))
 
 
 def word_count_fixed(ctx: Context, packed: np.ndarray):
@@ -80,7 +82,7 @@ def word_count_fixed(ctx: Context, packed: np.ndarray):
     d = ctx.Distribute({"w": packed,
                         "c": np.ones(len(packed), dtype=np.int64)})
     return d.ReduceByKey(lambda t: t["w"],
-                         lambda a, b: {"w": a["w"], "c": a["c"] + b["c"]})
+                         FieldReduce({"w": "first", "c": "sum"}))
 
 
 def main():

@@ -1,0 +1,189 @@
+"""FieldReduce declarative functor (api/functors.py): fused-native /
+generic-fold / jitted-device engines must agree, and unsupported leaf
+shapes must fall back (correctly) rather than fail.
+"""
+
+import numpy as np
+import pytest
+
+from thrill_tpu.api import Context, FieldReduce
+from thrill_tpu.parallel.mesh import MeshExec
+
+
+def _run_reduce(W, red, data, env=None, monkeypatch=None):
+    if monkeypatch is not None and env is not None:
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+    mex = MeshExec(num_workers=W)
+    ctx = Context(mex)
+    out = ctx.Distribute(data).ReduceByKey(lambda t: t["k"], red)
+    hs = out.node.materialize().to_host_shards("test")
+    rows = [it for l in hs.lists for it in l]
+    ctx.close()
+    return rows
+
+
+def _model(data, n):
+    model = {}
+    for i in range(n):
+        k = int(data["k"][i])
+        v, f = int(data["v"][i]), float(data["f"][i])
+        if k in model:
+            mv, mf = model[k]
+            model[k] = (mv + v, min(mf, f))
+        else:
+            model[k] = (v, f)
+    return model
+
+
+@pytest.mark.parametrize("W", [1, 2])
+def test_field_reduce_matches_model_and_generic(W, monkeypatch):
+    rng = np.random.default_rng(11)
+    n = 20000
+    data = {"k": rng.integers(0, 257, size=n).astype(np.int64),
+            "v": rng.integers(-50, 50, size=n).astype(np.int64),
+            "f": rng.standard_normal(n)}
+    red = FieldReduce({"k": "first", "v": "sum", "f": "min"})
+    rows = _run_reduce(W, red, data)
+    model = _model(data, n)
+    got = {int(r["k"]): (int(r["v"]), float(r["f"])) for r in rows}
+    assert got == model
+    # jitted device engine (host engine disabled) agrees
+    monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "0")
+    rows_jit = _run_reduce(W, red, data)
+    got_jit = {int(r["k"]): (int(r["v"]), float(r["f"]))
+               for r in rows_jit}
+    assert got_jit == model
+
+
+def test_field_reduce_single_leaf_tree():
+    """Items that ARE the key (plain array tree): spec is the op string."""
+    rng = np.random.default_rng(5)
+    vals = rng.integers(0, 9, size=5000).astype(np.int64)
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    out = ctx.Distribute(vals).ReduceByKey(lambda x: x,
+                                           FieldReduce("first"))
+    got = sorted(int(x) for x in out.AllGather())
+    ctx.close()
+    assert got == sorted(set(int(v) for v in vals))
+
+
+def test_field_reduce_unsupported_leaves_fall_back():
+    """2-D summed leaf and bool leaf are not fuseable — the generic
+    fold must take over and still be correct."""
+    rng = np.random.default_rng(6)
+    n = 3000
+    data = {"k": rng.integers(0, 31, size=n).astype(np.int64),
+            "m": rng.integers(0, 5, size=(n, 3)).astype(np.int64)}
+    red = FieldReduce({"k": "first", "m": "sum"})
+    rows = _run_reduce(1, red, data)
+    model = {}
+    for i in range(n):
+        k = int(data["k"][i])
+        model[k] = model.get(k, 0) + data["m"][i]
+    got = {int(r["k"]): np.asarray(r["m"]) for r in rows}
+    assert set(got) == set(model)
+    for k in model:
+        assert (got[k] == model[k]).all()
+
+
+def test_field_reduce_nan_min_parity():
+    """NaN-poisoned groups: fused path must propagate NaN exactly like
+    np.minimum (and hence like the generic engines)."""
+    n = 1000
+    rng = np.random.default_rng(8)
+    data = {"k": rng.integers(0, 10, size=n).astype(np.int64),
+            "f": rng.standard_normal(n)}
+    data["f"][::97] = np.nan
+    red = FieldReduce({"k": "first", "f": "min"})
+    rows = _run_reduce(1, red, data)
+    model = {}
+    for i in range(n):
+        k = int(data["k"][i])
+        model[k] = (np.minimum(model[k], data["f"][i])
+                    if k in model else data["f"][i])
+    got = {int(r["k"]): float(r["f"]) for r in rows}
+    for k, v in model.items():
+        assert np.isnan(got[k]) if np.isnan(v) else got[k] == v
+
+
+def test_field_reduce_bad_op_raises():
+    with pytest.raises(ValueError):
+        FieldReduce({"k": "first", "v": "product"})
+
+
+def test_field_reduce_content_equality():
+    """Content-equal functors must hash equal (executable-cache reuse
+    across pipelines constructing fresh instances inline)."""
+    a = FieldReduce({"k": "first", "v": "sum"})
+    b = FieldReduce({"k": "first", "v": "sum"})
+    c = FieldReduce({"k": "first", "v": "max"})
+    assert a == b and hash(a) == hash(b)
+    assert a != c and a != "FieldReduce"
+
+
+def test_malformed_reduce_fn_structure_raises():
+    """A reduce_fn returning a differently-structured tree must raise,
+    never silently mispair leaves (on any engine)."""
+    rng = np.random.default_rng(2)
+    n = 2000
+    data = {"k": rng.integers(0, 7, size=n).astype(np.int64),
+            "c": np.ones(n, dtype=np.int64)}
+
+    def bad(a, b):
+        return {"a": a["k"], "b": a["c"] + b["c"]}   # wrong structure
+
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    with pytest.raises(Exception):
+        ctx.Distribute(data).ReduceByKey(lambda t: t["k"], bad).AllGather()
+    ctx.close()
+
+
+def test_inplace_mutating_reduce_fn_still_correct():
+    """A black-box reduce_fn that mutates its left argument in place
+    and returns it (``a['c'] += b['c']; return a``) must still produce
+    correct results on the host fold engine — the identity write-back
+    skip is reserved for provably pure functors."""
+    rng = np.random.default_rng(13)
+    n = 5000
+    data = {"k": rng.integers(0, 43, size=n).astype(np.int64),
+            "c": np.ones(n, dtype=np.int64)}
+
+    def red(a, b):
+        a["c"] += b["c"]
+        return a
+
+    rows = _run_reduce(1, red, data)
+    got = {int(r["k"]): int(r["c"]) for r in rows}
+    model = {}
+    for k in data["k"]:
+        model[int(k)] = model.get(int(k), 0) + 1
+    assert got == model
+
+
+def test_field_reduce_wordcount_matches_counter():
+    """End-to-end WordCount (the bench.py configuration, small n) is
+    EXACTLY collections.Counter."""
+    import collections
+    n = 20000
+    rng = np.random.default_rng(1)
+    ids = np.minimum(rng.zipf(1.3, size=n) - 1, 1023)
+    words = np.zeros((n, 16), dtype=np.uint8)
+    digits = np.char.zfill(ids.astype("U8"), 8)
+    words[:, :8] = np.frombuffer(
+        "".join(digits.tolist()).encode("ascii"),
+        dtype=np.uint8).reshape(n, 8)
+    cres = collections.Counter(
+        "".join(map(chr, row)) for row in words)
+    data = {"w": words, "c": np.ones(n, dtype=np.int64)}
+    red = FieldReduce({"w": "first", "c": "sum"})
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    out = ctx.Distribute(data).ReduceByKey(lambda t: t["w"], red)
+    rows = out.AllGather()
+    ctx.close()
+    got = {"".join(map(chr, np.asarray(r["w"]))): int(r["c"])
+           for r in rows}
+    assert got == dict(cres)

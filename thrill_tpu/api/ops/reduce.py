@@ -75,16 +75,19 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
 
 def _host_reduce_shards(shards: DeviceShards, key_fn: Callable,
                         reduce_fn: Callable) -> Optional[DeviceShards]:
-    """CPU-backend mirror of :func:`_local_reduce_device`: native radix
-    sort (core/host_radix.py) + a geometric pairwise run fold.
+    """CPU-backend mirror of :func:`_local_reduce_device`: native
+    hash-grouping (core/host_radix.py) + a strided in-place run fold.
 
     On the CPU backend device buffers are host memory and XLA's
     single-core sort + associative_scan are the wrong engines (a 1.2M
-    row WordCount reduce spent ~17s there). Here each equal-key run is
-    folded by combining adjacent pairs per level — run lengths halve
-    every level, so total gathered rows are geometric in n and
-    ``reduce_fn`` is called log2(longest run) times on whole arrays
-    (same associativity contract as the device segmented scan).
+    row WordCount reduce spent ~17s there). Grouping uses the native
+    open-addressing table (ONE pass; the engine class of the
+    reference's ReducePrePhase, thrill/core/reduce_pre_phase.hpp:94)
+    rather than the radix argsort — ReduceByKey only needs equal keys
+    adjacent, not sorted. The fold then combines each group to its head
+    row in log2(longest run) vectorized ``reduce_fn`` calls (same
+    associativity contract as the device segmented scan) with a total
+    gathered-row volume of ~1n (see :func:`_strided_run_fold`).
 
     Returns None when inapplicable (non-CPU, multi-controller, trace-
     only key_fn) so the caller falls through to the jitted engine."""
@@ -110,48 +113,145 @@ def _host_reduce_shards(shards: DeviceShards, key_fn: Callable,
                 per_worker.append(tree)
                 continue
             words = keymod.encode_key_words_np(key_fn(tree))
-            perm, same_next = host_radix.sorted_runs(words)
-            tree = jax.tree.map(
-                lambda a: host_radix.gather_rows(np.ascontiguousarray(a),
-                                                 perm), tree)
-            run_id = np.concatenate(([0], np.cumsum(~same_next)))
-            tree, nruns = _pairwise_run_fold(tree, run_id, reduce_fn)
+            fused = _fused_field_reduce(tree, treedef, words, reduce_fn)
+            if fused is not None:
+                tree, ngroups = fused
+            else:
+                perm, lens = host_radix.hash_group(words)
+                tree = jax.tree.map(
+                    lambda a: host_radix.gather_rows(
+                        np.ascontiguousarray(a), perm), tree)
+                # identity write-back skip is only sound for functors
+                # known pure; a black-box reduce_fn may mutate its
+                # left argument in place and return it
+                from ..functors import FieldReduce
+                tree = _strided_run_fold(
+                    tree, lens, reduce_fn,
+                    allow_identity_skip=isinstance(reduce_fn, FieldReduce))
+                ngroups = len(lens)
             per_worker.append(tree)
-            out_counts[w] = nruns
+            out_counts[w] = ngroups
+    except host_radix.NativeEngineError:
+        # the native engine itself is broken (bad rc / plan mismatch) —
+        # not an inapplicable-input case. Warn loudly before falling
+        # back so a real bug doesn't masquerade as slowness.
+        import warnings
+        import traceback
+        warnings.warn("native reduce engine failed; falling back to the "
+                      "jitted engine:\n" + traceback.format_exc(),
+                      RuntimeWarning)
+        return None
     except Exception:
         return None
     return DeviceShards.from_worker_arrays(mex, per_worker,
                                            counts=out_counts)
 
 
-def _pairwise_run_fold(tree, run_id: np.ndarray, reduce_fn: Callable):
-    """Fold each equal-run of key-sorted rows to one row by repeatedly
-    combining adjacent in-run pairs (rows at even in-run positions
-    absorb their right neighbor). Returns (tree, num_runs)."""
-    while True:
-        m = run_id.shape[0]
-        same_next = run_id[1:] == run_id[:-1]
-        if not same_next.any():
-            return tree, m
-        starts = np.concatenate(([True], ~same_next))
-        idx = np.arange(m)
-        run_start = np.maximum.accumulate(np.where(starts, idx, 0))
-        is_left = ((idx - run_start) & 1) == 0
-        has_right = np.zeros(m, dtype=bool)
-        has_right[:-1] = is_left[:-1] & same_next
-        li = np.flatnonzero(has_right)
-        merged = reduce_fn(jax.tree.map(lambda a: a[li], tree),
-                           jax.tree.map(lambda a: a[li + 1], tree))
-        kept = jax.tree.map(lambda a: np.ascontiguousarray(a[is_left]),
-                            tree)
-        hr = has_right[is_left]
+def _fused_field_reduce(tree, treedef, words, reduce_fn):
+    """FieldReduce fast path: when the reduce functor is declarative
+    (api/functors.py) and every accumulated leaf is a supported scalar
+    column, the ENTIRE local reduction runs as one native hash-probe
+    pass (hash_group_acc_u64) — grouping and accumulation fused, no
+    permutation/gather/fold afterwards. This is the runtime analog of
+    the reference's templates inlining the functor into the probing
+    table (thrill/core/reduce_pre_phase.hpp:94). Returns
+    ``(out_tree, ngroups)`` or None to fall back to the generic fold."""
+    from ..functors import FieldReduce, acc_plan
+    from ...core import host_radix
 
-        def scatter(dst, src):
-            dst[hr] = np.asarray(src)
-            return dst
+    if not isinstance(reduce_fn, FieldReduce):
+        return None
+    specs = reduce_fn.flat_spec(treedef)
+    if specs is None:
+        return None
+    leaves = jax.tree.leaves(tree)
+    plans = []
+    for s, a in zip(specs, leaves):
+        p = acc_plan(s, a.dtype, a.ndim)
+        if p is None:
+            return None
+        plans.append(p)
+    cols, ops = [], []
+    for (opcode, conv), a in zip(plans, leaves):
+        if opcode < 0:
+            continue                       # "first": gathered below
+        ops.append(opcode)
+        cols.append(a.astype(conv, copy=False))
+    heads, accs = host_radix.hash_group_acc(words, cols, ops)
+    out_leaves, ai = [], 0
+    for (opcode, conv), a in zip(plans, leaves):
+        if opcode < 0:
+            out_leaves.append(
+                host_radix.gather_rows(np.ascontiguousarray(a), heads))
+        else:
+            acc = accs[ai]
+            ai += 1
+            out_leaves.append(acc if acc.dtype == a.dtype
+                              else acc.astype(a.dtype))
+    return jax.tree.unflatten(treedef, out_leaves), len(heads)
 
-        tree = jax.tree.map(scatter, kept, merged)
-        run_id = run_id[is_left]
+
+def _strided_run_fold(tree, lens: np.ndarray, reduce_fn: Callable,
+                      allow_identity_skip: bool = False):
+    """Fold each contiguous run of group-clustered rows into its head
+    row, in place, then gather the heads.
+
+    Classic power-of-two strided up-sweep over stable row indices: the
+    row at in-run position p > 0 is absorbed exactly once, at step
+    s = p & -p, into the row s slots left of it (which by then holds
+    the fold of positions [p-s, p)), so after all steps each run head
+    holds the left-to-right fold of its whole run. Compared to a
+    compact-every-level scheme this needs NO per-level position
+    recomputation (the native ``fold_plan`` emits all per-level index
+    lists in one O(n) pass) and no whole-tree compaction per level:
+    total gathered+scattered rows across all levels is exactly
+    3*(n - num_runs) plus one final head gather. ``reduce_fn`` sees
+    (left_rows, right_rows) with left rows earlier in the run, so
+    non-commutative (associative) functions are safe.
+
+    MUTATES the leaves of ``tree`` (callers pass freshly gathered
+    arrays). Returns the head-compacted tree (len(lens) rows)."""
+    from ...core import host_radix
+
+    leaves, td = jax.tree.flatten(tree)
+    leaves = [np.ascontiguousarray(a) for a in leaves]
+    ri_all, level_counts = host_radix.fold_plan(lens)
+    off = 0
+    for lvl in range(32):
+        lc = int(level_counts[lvl])
+        if lc == 0:
+            continue
+        ri = ri_all[off:off + lc]
+        off += lc
+        li = (ri - np.uint32(1 << lvl)).astype(np.uint32, copy=False)
+        left = jax.tree.unflatten(
+            td, [host_radix.gather_rows(a, li) for a in leaves])
+        right = jax.tree.unflatten(
+            td, [host_radix.gather_rows(a, ri) for a in leaves])
+        left_leaves = jax.tree.leaves(left)
+        merged = reduce_fn(left, right)
+        if jax.tree.structure(merged) != td:
+            # positional zip below would silently scatter mispaired
+            # leaves; a malformed reduce_fn must be a hard error (the
+            # jitted engine's tree.map raises on this too)
+            raise ValueError(
+                f"reduce_fn returned tree structure "
+                f"{jax.tree.structure(merged)} != item structure {td}")
+        for a, m, ll in zip(leaves, jax.tree.leaves(merged), left_leaves):
+            if allow_identity_skip and m is ll:
+                # a PURE functor (FieldReduce "first") passed the left
+                # rows through unchanged: scattering a[li] back to
+                # a[li] is a no-op. Gated on provable purity — a
+                # black-box reduce_fn returning `m is ll` may have
+                # MUTATED the gathered left leaf in place, and its
+                # merged values must still be written back.
+                continue
+            host_radix.scatter_rows(
+                a, li, np.ascontiguousarray(np.asarray(m), dtype=a.dtype))
+    starts = np.zeros(len(lens), dtype=np.uint32)
+    np.cumsum(lens[:-1], dtype=np.uint32, out=starts[1:])
+    return jax.tree.unflatten(
+        td, [host_radix.gather_rows(a, starts) for a in leaves])
 
 
 def _fold_reduce_device(acc: DeviceShards, block: DeviceShards,

@@ -26,6 +26,13 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
+class NativeEngineError(RuntimeError):
+    """A native engine call itself failed (bad return code, plan size
+    mismatch). Callers that fall back to a slower engine on arbitrary
+    exceptions must NOT swallow this silently — it means the fast path
+    is broken, not inapplicable."""
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _LIB, _TRIED
     with _LOCK:
@@ -43,6 +50,25 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.gather_rows_u8.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p]
+            lib.scatter_rows_u8.restype = None
+            lib.scatter_rows_u8.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p]
+            lib.hash_group_u64.restype = ctypes.c_int64
+            lib.hash_group_u64.argtypes = [
+                ctypes.c_int64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p,
+                ctypes.c_void_p]
+            lib.fold_plan_u32.restype = ctypes.c_int64
+            lib.fold_plan_u32.argtypes = [
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p]
+            lib.hash_group_acc_u64.restype = ctypes.c_int64
+            lib.hash_group_acc_u64.argtypes = [
+                ctypes.c_int64, ctypes.c_int32,
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int32,
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p]
         _LIB = lib
         return _LIB
 
@@ -95,6 +121,120 @@ def radix_argsort(words: List[np.ndarray]) -> np.ndarray:
     if rc < 0:
         raise ValueError(f"radix_argsort_u64 failed (rc={rc}, n={n})")
     return perm
+
+
+def hash_group(words: List[np.ndarray]):
+    """Group rows by exact key-word equality via the native
+    open-addressing table (the reference ReducePrePhase's engine class,
+    thrill/core/reduce_pre_phase.hpp:94). Returns ``(perm, lens)``:
+    ``perm`` (uint32) clusters rows group-contiguously in
+    first-appearance order, stable within each group; ``lens`` (uint32)
+    is rows per group. Unlike :func:`sorted_runs` the output group
+    order is NOT key-sorted — callers that only need equal keys
+    adjacent (ReduceByKey, GroupByKey) get a one-pass engine instead of
+    4+ counting passes."""
+    lib = _load()
+    assert lib is not None
+    n = int(words[0].shape[0])
+    cols = [np.ascontiguousarray(w, dtype=np.uint64) for w in words]
+    ptrs = (ctypes.c_void_p * len(cols))(
+        *[c.ctypes.data_as(ctypes.c_void_p).value for c in cols])
+    perm = np.empty(n, dtype=np.uint32)
+    lens = np.empty(max(n, 1), dtype=np.uint32)
+    ng = lib.hash_group_u64(
+        n, len(cols), ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
+        perm.ctypes.data_as(ctypes.c_void_p),
+        lens.ctypes.data_as(ctypes.c_void_p))
+    if ng < 0:
+        raise NativeEngineError(f"hash_group_u64 failed (rc={ng}, n={n})")
+    return perm, lens[:ng].copy()
+
+
+def hash_group_acc(words: List[np.ndarray], cols: List[np.ndarray],
+                   ops: List[int]):
+    """Fused grouping + per-column accumulation in ONE native pass (the
+    FieldReduce fast path; see api/functors.py). ``cols`` are 1-D
+    arrays with 8-byte items (pre-converted by the caller), ``ops`` the
+    matching ``hash_group_acc_u64`` opcodes. Returns
+    ``(heads, acc_list)``: ``heads`` (uint32, one per group) is the
+    original row index of each group's first row; ``acc_list[c]`` the
+    accumulated values per group, in the same (first-appearance) group
+    order."""
+    lib = _load()
+    assert lib is not None
+    n = int(words[0].shape[0])
+    kcols = [np.ascontiguousarray(w, dtype=np.uint64) for w in words]
+    kptrs = (ctypes.c_void_p * len(kcols))(
+        *[c.ctypes.data_as(ctypes.c_void_p).value for c in kcols])
+    vcols = [np.ascontiguousarray(c) for c in cols]
+    for c in vcols:
+        if c.ndim != 1 or c.dtype.itemsize != 8:
+            # the native pass reads/writes fixed 8-byte strides; a
+            # narrower or multi-dim column would read out of bounds
+            raise ValueError(
+                f"hash_group_acc: columns must be 1-D 8-byte scalars, "
+                f"got ndim={c.ndim} dtype={c.dtype}")
+    vptrs = (ctypes.c_void_p * max(len(vcols), 1))(
+        *([c.ctypes.data_as(ctypes.c_void_p).value for c in vcols] or [0]))
+    ops_arr = np.ascontiguousarray(ops, dtype=np.int32)
+    accs = [np.empty(max(n, 1), dtype=c.dtype) for c in vcols]
+    aptrs = (ctypes.c_void_p * max(len(accs), 1))(
+        *([a.ctypes.data_as(ctypes.c_void_p).value for a in accs] or [0]))
+    heads = np.empty(max(n, 1), dtype=np.uint32)
+    ng = lib.hash_group_acc_u64(
+        n, len(kcols), ctypes.cast(kptrs, ctypes.POINTER(ctypes.c_void_p)),
+        len(vcols), ops_arr.ctypes.data_as(ctypes.c_void_p),
+        ctypes.cast(vptrs, ctypes.POINTER(ctypes.c_void_p)),
+        ctypes.cast(aptrs, ctypes.POINTER(ctypes.c_void_p)),
+        heads.ctypes.data_as(ctypes.c_void_p))
+    if ng < 0:
+        raise NativeEngineError(
+            f"hash_group_acc_u64 failed (rc={ng}, n={n})")
+    return heads[:ng].copy(), [a[:ng].copy() for a in accs]
+
+
+def fold_plan(lens: np.ndarray):
+    """Native plan for the strided run fold: returns
+    ``(ri, level_counts)`` where ``ri`` (uint32) holds the absorbed
+    right-operand global row indices concatenated level by level
+    (level l = rows at in-run position p with p & -p == 1 << l,
+    ascending within a level) and ``level_counts`` (int64[32]) the
+    per-level slice sizes. ``sum(level_counts) == sum(lens) - len(lens)``."""
+    lib = _load()
+    assert lib is not None
+    lens_c = np.ascontiguousarray(lens, dtype=np.uint32)
+    total = int(lens_c.sum(dtype=np.int64)) - len(lens_c)
+    ri = np.empty(max(total, 1), dtype=np.uint32)
+    level_counts = np.empty(32, dtype=np.int64)
+    got = lib.fold_plan_u32(
+        len(lens_c), lens_c.ctypes.data_as(ctypes.c_void_p),
+        ri.ctypes.data_as(ctypes.c_void_p),
+        level_counts.ctypes.data_as(ctypes.c_void_p))
+    if got != total:
+        raise NativeEngineError(
+            f"fold_plan_u32 size mismatch (got={got}, expected={total})")
+    return ri[:total], level_counts
+
+
+def scatter_rows(dst: np.ndarray, idx: np.ndarray, src: np.ndarray) -> None:
+    """dst[idx[r]] = src[r] along axis 0 (in place). Native when both
+    sides are C-contiguous; numpy fancy assignment otherwise."""
+    lib = _load()
+    n = int(idx.shape[0])
+    if (lib is None or not dst.flags.c_contiguous
+            or not src.flags.c_contiguous or dst.dtype != src.dtype
+            or src.shape != (n,) + dst.shape[1:]):
+        dst[idx] = src          # numpy handles broadcasts / casts
+        return
+    row_bytes = int(dst.dtype.itemsize
+                    * int(np.prod(dst.shape[1:], dtype=np.int64)))
+    if n == 0 or row_bytes == 0:
+        return
+    lib.scatter_rows_u8(
+        n, row_bytes, src.ctypes.data_as(ctypes.c_void_p),
+        np.ascontiguousarray(idx, dtype=np.uint32).ctypes.data_as(
+            ctypes.c_void_p),
+        dst.ctypes.data_as(ctypes.c_void_p))
 
 
 def gather_rows(arr: np.ndarray, perm: np.ndarray) -> np.ndarray:
