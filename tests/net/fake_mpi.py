@@ -1,0 +1,281 @@
+"""Strict-rendezvous fake mpi4py runtime over real sockets.
+
+Test double for thrill_tpu.net.mpi (mpi4py is not installable in this
+image). EVERY message uses the rendezvous protocol — RTS -> CTS ->
+DATA, where an Isend completes only after the receiver posts a matching
+receive and the payload drains. Real MPI is laxer (small messages
+complete eagerly), so any transport discipline that survives this fake
+survives real MPI, while a send that blocks on completion before its
+peer receives DEADLOCKS here, in tests — exactly the bug the round-3
+advisor found in the backend's old spin-until-complete send.
+
+Two modes over one protocol:
+
+* ``make_inprocess_world(P)`` — socketpair full mesh, one fake module
+  per thread-rank (the collective-suite tests).
+* ``connect_world(rank, P, ports)`` — TCP localhost full mesh, one OS
+  process per rank: the backend's queueing/reaping state machine
+  itself runs multi-process (the round-3 verdict's ask).
+
+Surface implemented: COMM_WORLD, Get_rank/Get_size, Isend/Irecv with
+``[buf, BYTE]`` specs, Iprobe(source, tag, status), Status.Get_count,
+Request.Test, Query_thread/THREAD_SERIALIZED. Single-threaded per
+rank-comm, which the backend's serialized-call lock guarantees.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from typing import Dict, List, Optional
+
+_HDR = struct.Struct("<BIIq")        # type, tag, sid, length
+_RTS, _CTS, _DATA = 1, 2, 3
+
+
+def _unwrap(bufspec):
+    """Accept mpi4py-style [buffer, datatype] specs or raw buffers."""
+    if isinstance(bufspec, (list, tuple)):
+        return bufspec[0]
+    return bufspec
+
+
+class FakeStatus:
+    def __init__(self) -> None:
+        self.count = 0
+
+    def Get_count(self, _dtype) -> int:
+        return self.count
+
+
+class _SendReq:
+    def __init__(self, comm: "FakeComm", sid: int) -> None:
+        self._comm = comm
+        self._sid = sid
+
+    def Test(self) -> bool:
+        self._comm._progress()
+        return self._sid in self._comm._send_done
+
+
+class _RecvReq:
+    def __init__(self, comm: "FakeComm", source: int, sid: int,
+                 buf) -> None:
+        self._comm = comm
+        self._source = source
+        self._sid = sid
+        self._buf = buf
+        self._done = False
+
+    def Test(self) -> bool:
+        if self._done:
+            return True
+        self._comm._progress()
+        payload = self._comm._data.pop((self._source, self._sid), None)
+        if payload is None:
+            return False
+        mv = memoryview(self._buf)
+        mv[:len(payload)] = payload
+        self._done = True
+        return True
+
+
+class FakeComm:
+    """One rank's endpoint of the fake world (NOT thread-safe; the
+    backend's global MPI lock serializes all calls)."""
+
+    def __init__(self, rank: int, size: int,
+                 socks: Dict[int, socket.socket]) -> None:
+        self._rank = rank
+        self._size = size
+        self._socks = socks
+        for s in socks.values():
+            s.setblocking(False)
+        self._rbuf: Dict[int, bytearray] = {p: bytearray() for p in socks}
+        self._outbox: Dict[int, list] = {p: [] for p in socks}
+        self._rts: Dict[int, list] = {p: [] for p in socks}  # (tag,sid,len)
+        self._data: Dict[tuple, bytes] = {}
+        self._send_payload: Dict[int, bytes] = {}
+        self._send_done: set = set()
+        self._next_sid = 0
+
+    # -- mpi4py surface -------------------------------------------------
+    def Get_rank(self) -> int:
+        return self._rank
+
+    def Get_size(self) -> int:
+        return self._size
+
+    def Isend(self, bufspec, dest: int, tag: int) -> _SendReq:
+        payload = bytes(_unwrap(bufspec))
+        sid = self._next_sid
+        self._next_sid += 1
+        self._send_payload[sid] = payload
+        self._outbox[dest].append(
+            [_HDR.pack(_RTS, tag, sid, len(payload)), None])
+        self._progress()
+        return _SendReq(self, sid)
+
+    def Iprobe(self, source: int, tag: int,
+               status: Optional[FakeStatus] = None) -> bool:
+        self._progress()
+        for (t, sid, length) in self._rts[source]:
+            if t == tag:
+                if status is not None:
+                    status.count = length
+                return True
+        return False
+
+    def Irecv(self, bufspec, source: int, tag: int) -> _RecvReq:
+        self._progress()
+        lst = self._rts[source]
+        for i, (t, sid, _length) in enumerate(lst):
+            if t == tag:
+                del lst[i]
+                # grant: the sender's Isend may now complete
+                self._outbox[source].append(
+                    [_HDR.pack(_CTS, 0, sid, 0), None])
+                self._progress()
+                return _RecvReq(self, source, sid, _unwrap(bufspec))
+        raise RuntimeError(
+            "fake MPI: Irecv with no matching probed message (the "
+            "backend always Iprobes first)")
+
+    # -- protocol pump --------------------------------------------------
+    def _progress(self) -> None:
+        for peer, sock in self._socks.items():
+            # writes
+            out = self._outbox[peer]
+            while out:
+                chunk = out[0]
+                try:
+                    sent = sock.send(chunk[0])
+                except (BlockingIOError, InterruptedError):
+                    break
+                except (ConnectionResetError, BrokenPipeError):
+                    out.clear()   # peer gone; recv timeouts surface it
+                    break
+                if sent == len(chunk[0]):
+                    if chunk[1] is not None:   # DATA fully written
+                        self._send_done.add(chunk[1])
+                    out.pop(0)
+                else:
+                    chunk[0] = chunk[0][sent:]
+                    break
+            # reads (reset == peer exited after drain: treat as EOF —
+            # if data was still owed, the caller's poll loop times out
+            # and surfaces the failure)
+            rbuf = self._rbuf[peer]
+            while True:
+                try:
+                    got = sock.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    break
+                except (ConnectionResetError, BrokenPipeError):
+                    break
+                if not got:
+                    break
+                rbuf.extend(got)
+            # parse
+            while len(rbuf) >= _HDR.size:
+                ftype, tag, sid, length = _HDR.unpack_from(rbuf)
+                if ftype == _DATA:
+                    if len(rbuf) < _HDR.size + length:
+                        break
+                    payload = bytes(rbuf[_HDR.size:_HDR.size + length])
+                    del rbuf[:_HDR.size + length]
+                    self._data[(peer, sid)] = payload
+                elif ftype == _RTS:
+                    del rbuf[:_HDR.size]
+                    self._rts[peer].append((tag, sid, length))
+                elif ftype == _CTS:
+                    del rbuf[:_HDR.size]
+                    payload = self._send_payload.pop(sid)
+                    if payload:
+                        self._outbox[peer].append(
+                            [_HDR.pack(_DATA, 0, sid, len(payload))
+                             + payload, sid])
+                    else:
+                        self._outbox[peer].append(
+                            [_HDR.pack(_DATA, 0, sid, 0), sid])
+                else:
+                    raise RuntimeError(f"fake MPI: bad frame {ftype}")
+
+    def close(self) -> None:
+        # drain queued frames first so a graceful exit never cuts off
+        # a peer mid-message (TCP delivers written bytes after close)
+        deadline = time.monotonic() + 5.0
+        while (any(self._outbox.values())
+               and time.monotonic() < deadline):
+            self._progress()
+            time.sleep(1e-4)
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class FakeMPIModule:
+    """The mpi4py-module surface thrill_tpu.net.mpi consumes."""
+
+    BYTE = "byte"
+    THREAD_SERIALIZED = 2
+    Status = FakeStatus
+
+    def __init__(self, comm: FakeComm) -> None:
+        self.COMM_WORLD = comm
+
+    def Query_thread(self) -> int:
+        return self.THREAD_SERIALIZED
+
+
+def make_inprocess_world(P: int) -> List[FakeMPIModule]:
+    """Socketpair full mesh; module i is rank i (use one per thread)."""
+    socks: List[Dict[int, socket.socket]] = [dict() for _ in range(P)]
+    for a in range(P):
+        for b in range(a + 1, P):
+            sa, sb = socket.socketpair()
+            socks[a][b] = sa
+            socks[b][a] = sb
+    return [FakeMPIModule(FakeComm(r, P, socks[r])) for r in range(P)]
+
+
+def connect_world(rank: int, P: int, ports: List[int],
+                  timeout_s: float = 20.0) -> FakeMPIModule:
+    """TCP localhost full-mesh bootstrap for real multi-process ranks:
+    rank r listens on ports[r], connects to every lower rank (sending
+    its rank byte), accepts from every higher rank."""
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", ports[rank]))
+    srv.listen(P)
+    socks: Dict[int, socket.socket] = {}
+    deadline = time.monotonic() + timeout_s
+    for j in range(rank):
+        while True:
+            try:
+                s = socket.create_connection(("127.0.0.1", ports[j]),
+                                             timeout=1.0)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"rank {rank}: cannot reach "
+                                       f"rank {j} on port {ports[j]}")
+                time.sleep(0.05)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.sendall(bytes([rank]))
+        socks[j] = s
+    srv.settimeout(timeout_s)
+    for _ in range(P - 1 - rank):
+        c, _addr = srv.accept()
+        c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        c.settimeout(timeout_s)          # dead peer -> clean timeout
+        hello = c.recv(1)
+        if not hello:
+            raise ConnectionError(
+                f"rank {rank}: peer closed before sending its rank byte")
+        socks[hello[0]] = c
+    srv.close()
+    return FakeMPIModule(FakeComm(rank, P, socks))

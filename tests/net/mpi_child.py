@@ -1,0 +1,64 @@
+"""Child body for the real-multi-process MPI backend test.
+
+Launched by tests/net/test_mpi.py as:
+    python mpi_child.py <rank> <nproc> <port,port,...>
+
+Connects the fake rendezvous world over localhost TCP, injects it as
+the backend's MPI module, then runs the REAL backend (construct(),
+MpiGroup collectives, a bulk byte-frame exchange where every rank
+sends before it receives, flush) and prints one RESULT line.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+import fake_mpi
+from thrill_tpu.net import mpi as mpi_backend
+
+
+def main():
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    ports = [int(p) for p in sys.argv[3].split(",")]
+
+    mpi_backend.MPI = fake_mpi.connect_world(rank, nproc, ports)
+    groups = mpi_backend.construct(2)
+    g0 = groups[0]
+    assert g0.my_rank == rank and g0.num_hosts == nproc
+
+    prefix = g0.prefix_sum(rank + 1)
+    gathered = g0.all_gather(rank * 3)
+    bcast = g0.broadcast(1234 if rank == 0 else None, origin=0)
+
+    # bulk byte-frame exchange on the data group: every rank issues all
+    # sends before any receive (the host_exchange shape) — deadlocks
+    # under strict rendezvous unless isend completion is lazy
+    g1 = groups[1]
+    arr = np.arange(50_000, dtype=np.int64) + rank * 7
+    for d in range(1, nproc):
+        g1.send_to((rank + d) % nproc, arr)
+    bulk = []
+    for d in range(1, nproc):
+        frm = (rank - d) % nproc
+        got = g1.recv_from(frm)
+        assert got.shape == (50_000,) and int(got[1]) == frm * 7 + 1
+        bulk.append(int(got[0]))
+    for g in groups:
+        g.flush()
+    g0.barrier()
+    # the barrier's own final isend is completed lazily — flush again
+    # so no frame is still queued in the engine when the process exits
+    for g in groups:
+        g.flush()
+
+    print("RESULT " + json.dumps({
+        "rank": rank, "prefix": int(prefix),
+        "gathered": [int(x) for x in gathered],
+        "bulk": sorted(bulk, key=lambda v: v),
+        "bcast": int(bcast)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

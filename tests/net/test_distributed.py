@@ -43,18 +43,16 @@ def _golden_wordcount():
     return sorted(c.items()), len(_TEXT.split()), sorted(_TEXT.split())
 
 
-@pytest.mark.parametrize("nproc", [2, 3])
-def test_multi_process_wordcount_agrees(nproc, tmp_path):
-    """The reference sweeps real process counts (mpirun -np {1,2,3,7});
-    sweep {2,3} controllers here, 2 CPU devices each. Covers both the
-    device pipeline (XLA collectives) and a host-storage text WordCount
-    whose shuffle rides the multiplexer over the TCP group."""
+def _launch_children(nproc, tmp_path, net="tcp"):
+    """Spawn nproc distributed_child.py processes wired for the given
+    control-plane backend ('tcp' = authenticated sockets, 'mpi' = the
+    MPI backend over the strict-rendezvous fake world)."""
     text_file = tmp_path / "words.txt"
     text_file.write_text(_TEXT)
     ports = _free_ports(1 + nproc)
-    coord_port, tcp_ports = ports[0], ports[1:]
+    coord_port, net_ports = ports[0], ports[1:]
     coordinator = f"127.0.0.1:{coord_port}"
-    hostlist = " ".join(f"127.0.0.1:{p}" for p in tcp_ports)
+    hostlist = " ".join(f"127.0.0.1:{p}" for p in net_ports)
     procs = []
     for rank in range(nproc):
         env = dict(os.environ)
@@ -64,15 +62,38 @@ def test_multi_process_wordcount_agrees(nproc, tmp_path):
         env.update({
             "PYTHONPATH": repo_root + os.pathsep
             + env.get("PYTHONPATH", ""),
-            "THRILL_TPU_HOSTLIST": hostlist,
-            "THRILL_TPU_RANK": str(rank),
             "THRILL_TPU_SECRET": "test-cluster-secret",
             "THRILL_TPU_TEST_TEXT": str(text_file),
         })
+        if net == "mpi":
+            env.update({
+                "THRILL_TPU_NET": "mpi",
+                "THRILL_TPU_TEST_FAKEMPI":
+                    ",".join(map(str, net_ports)),
+            })
+        else:
+            env.update({
+                "THRILL_TPU_HOSTLIST": hostlist,
+                "THRILL_TPU_RANK": str(rank),
+            })
         procs.append(subprocess.Popen(
             [sys.executable, CHILD, coordinator, str(rank), str(nproc)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, env=env))
+    return procs
+
+
+@pytest.mark.parametrize("nproc,net", [(2, "tcp"), (3, "tcp"),
+                                       (2, "mpi")])
+def test_multi_process_wordcount_agrees(nproc, net, tmp_path):
+    """The reference sweeps real process counts (mpirun -np {1,2,3,7});
+    sweep {2,3} controllers here, 2 CPU devices each. Covers both the
+    device pipeline (XLA collectives) and a host-storage text WordCount
+    whose shuffle rides the multiplexer over the selected net backend —
+    including THRILL_TPU_NET=mpi, where the control plane AND the
+    multiplexer bulk frames run the MPI backend's byte-frame
+    Isend/Irecv data plane across real processes."""
+    procs = _launch_children(nproc, tmp_path, net=net)
     # drain every child's pipes CONCURRENTLY: children exit through a
     # collective shutdown barrier, so one child blocked writing into a
     # full stdout pipe would deadlock the whole group

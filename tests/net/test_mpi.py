@@ -1,123 +1,53 @@
-"""MPI backend: collective suite over an injected in-process MPI.
+"""MPI backend over the STRICT-rendezvous fake world (fake_mpi.py).
 
 mpi4py is not in this image (the backend is SDK-gated like vfs/s3), so
-these tests inject a faithful in-process fake of the mpi4py surface the
-backend uses — per-rank COMM_WORLD, pickled send/recv, Iprobe, thread
-level — and run the same collective assertions as the mock/tcp suites
-(reference: tests/net/group_test_base.hpp included per backend).
+these tests inject a socket-backed fake whose EVERY message requires
+rendezvous: an Isend completes only when the matching receive posts.
+A send() that waits for its isend (the round-3 advisor's deadlock)
+hangs here and fails the join timeout — the fake is strictly harder
+than real MPI, not easier. The same collective assertions as the
+mock/tcp suites run (reference: tests/net/group_test_base.hpp included
+per backend), plus a bulk byte-frame exchange where every rank sends
+before it receives, and a real-multi-process run
+(test_mpi_real_processes) where 2/3 OS processes each run the
+backend's queueing/reaping state machine over localhost sockets.
 """
 
-import collections
+import json
+import operator
+import os
+import socket
+import subprocess
+import sys
 import threading
 
+import numpy as np
 import pytest
 
 from thrill_tpu.net import mpi as mpi_backend
 
-
-class _FakeStore:
-    def __init__(self):
-        self.lock = threading.Lock()
-        self.cond = threading.Condition(self.lock)
-        self.queues = collections.defaultdict(collections.deque)
+import fake_mpi
 
 
-class _FakeComm:
-    """mpi4py.Comm surface used by the backend, over shared queues."""
-
-    def __init__(self, store: _FakeStore, rank: int, size: int):
-        self._store = store
-        self._rank = rank
-        self._size = size
-
-    def Get_rank(self):
-        return self._rank
-
-    def Get_size(self):
-        return self._size
-
-    def send(self, obj, dest, tag):
-        import pickle
-        with self._store.cond:
-            self._store.queues[(self._rank, dest, tag)].append(
-                pickle.dumps(obj))      # pickle like mpi4py does
-            self._store.cond.notify_all()
-
-    def isend(self, obj, dest, tag):
-        # rendezvous simulation: delivery happens on the SECOND
-        # completion poll, so the backend's isend+test loop is actually
-        # exercised (a blocking send would deadlock real MPI here)
-        return _FakeRequest(self, obj, dest, tag)
-
-    def Iprobe(self, source, tag):
-        with self._store.lock:
-            return bool(self._store.queues[(source, self._rank, tag)])
-
-    def recv(self, source, tag):
-        import pickle
-        with self._store.cond:
-            q = self._store.queues[(source, self._rank, tag)]
-            while not q:
-                self._store.cond.wait(timeout=10)
-            return pickle.loads(q.popleft())
-
-
-class _FakeRequest:
-    def __init__(self, comm, obj, dest, tag):
-        self._comm = comm
-        self._args = (obj, dest, tag)
-        self._polls = 0
-
-    def test(self):
-        self._polls += 1
-        if self._polls < 2:
-            return (False, None)
-        if self._args is not None:
-            obj, dest, tag = self._args
-            self._args = None
-            self._comm.send(obj, dest, tag)
-        return (True, None)
-
-
-class _FakeMPI:
-    THREAD_SERIALIZED = 2
-
-    def __init__(self, store, size):
-        self._store = store
-        self._size = size
-        self._local = threading.local()
-
-    def Query_thread(self):
-        return self.THREAD_SERIALIZED
-
-    def bind_rank(self, rank):
-        self._local.comm = _FakeComm(self._store, rank, self._size)
-
-    @property
-    def COMM_WORLD(self):
-        return self._local.comm          # per-rank, like real MPI
-
-
-@pytest.fixture
-def inject_mpi():
-    def make(size):
-        fake = _FakeMPI(_FakeStore(), size)
-        mpi_backend.MPI = fake
-        return fake
-    yield make
-    mpi_backend.MPI = None
-
-
-def run_mpi_group(fake, num_hosts, job):
+def run_mpi_group(num_hosts, job, group_count=2, timeout=30):
+    """Run ``job(groups)`` on num_hosts daemon threads, one fake-MPI
+    rank each; surface per-rank exceptions; flag deadlocks by join
+    timeout. Returns results by rank."""
+    modules = fake_mpi.make_inprocess_world(num_hosts)
     results = [None] * num_hosts
     errors = [None] * num_hosts
 
     def target(rank):
         try:
-            fake.bind_rank(rank)
-            groups = mpi_backend.construct(2)
-            results[rank] = job(groups[0])
-        except Exception as e:              # surfaced below
+            engine = mpi_backend._SendEngine()
+            groups = [mpi_backend.MpiGroup(modules[rank],
+                                           modules[rank].COMM_WORLD,
+                                           group_tag=g, engine=engine)
+                      for g in range(group_count)]
+            results[rank] = job(groups)
+            for grp in groups:
+                grp.flush()
+        except Exception as e:
             errors[rank] = e
 
     threads = [threading.Thread(target=target, args=(r,), daemon=True)
@@ -126,76 +56,199 @@ def run_mpi_group(fake, num_hosts, job):
         t.start()
     stuck = []
     for t in threads:
-        t.join(timeout=20)
+        t.join(timeout=timeout)
         if t.is_alive():
             stuck.append(t)
     for e in errors:
         if e is not None:
             raise e
-    assert not stuck, "collective deadlocked"
+    assert not stuck, ("deadlock: a collective or send blocked past the "
+                       "join timeout under strict rendezvous")
+    for m in modules:
+        m.COMM_WORLD.close()
     return results
 
 
-SIZES = [1, 2, 3, 5, 8]
+SIZES = [1, 2, 3, 7]
 
 
 @pytest.mark.parametrize("p", SIZES)
-def test_mpi_prefix_sum(p, inject_mpi):
-    fake = inject_mpi(p)
-    res = run_mpi_group(fake, p, lambda g: g.prefix_sum(g.my_rank + 1))
+def test_prefix_sum(p):
+    res = run_mpi_group(p, lambda gs: gs[0].prefix_sum(gs[0].my_rank + 1))
     assert res == [sum(range(1, r + 2)) for r in range(p)]
 
 
 @pytest.mark.parametrize("p", SIZES)
-def test_mpi_broadcast_and_all_gather(p, inject_mpi):
-    fake = inject_mpi(p)
-    res = run_mpi_group(
-        fake, p, lambda g: (g.broadcast(g.my_rank * 10 + 7, origin=0),
-                            g.all_gather(g.my_rank)))
-    for bc, ag in res:
-        assert bc == 7
-        assert ag == list(range(p))
+def test_broadcast(p):
+    res = run_mpi_group(p, lambda gs: gs[0].broadcast(
+        42 if gs[0].my_rank == 0 else None, origin=0))
+    assert res == [42] * p
 
 
 @pytest.mark.parametrize("p", SIZES)
-def test_mpi_all_reduce(p, inject_mpi):
-    fake = inject_mpi(p)
-    res = run_mpi_group(fake, p, lambda g: g.all_reduce(g.my_rank + 1))
-    assert res == [p * (p + 1) // 2] * p
+def test_all_gather(p):
+    res = run_mpi_group(p, lambda gs: gs[0].all_gather(gs[0].my_rank * 2))
+    assert res == [[i * 2 for i in range(p)]] * p
 
 
-def test_mpi_groups_are_tag_isolated(inject_mpi):
-    """Two groups over one COMM_WORLD must not steal each other's
-    messages (reference: group = MPI tag namespace)."""
-    fake = inject_mpi(2)
-
-    def job(rank):
-        fake.bind_rank(rank)
-        flow, data = mpi_backend.construct(2)
-        other = 1 - rank
-        # send on BOTH groups before receiving either: wrong tag
-        # matching would cross the streams
-        flow.send_to(other, ("flow", rank))
-        data.send_to(other, ("data", rank))
-        got_data = data.recv_from(other)
-        got_flow = flow.recv_from(other)
-        return got_flow, got_data
-
-    results = [None, None]
-    ts = [threading.Thread(target=lambda r=r: results.__setitem__(
-        r, job(r)), daemon=True) for r in (0, 1)]
-    for t in ts:
-        t.start()
-    for t in ts:
-        t.join(timeout=20)
-        assert not t.is_alive()
-    assert results[0] == (("flow", 1), ("data", 1))
-    assert results[1] == (("flow", 0), ("data", 0))
+@pytest.mark.parametrize("p", SIZES)
+def test_all_reduce_noncommutative_concat(p):
+    res = run_mpi_group(
+        p, lambda gs: gs[0].all_reduce(str(gs[0].my_rank), operator.add))
+    assert res == ["".join(map(str, range(p)))] * p
 
 
-def test_mpi_unavailable_message():
-    assert mpi_backend.MPI is None
+@pytest.mark.parametrize("p", [2, 3, 7])
+def test_groups_are_independent_tag_namespaces(p):
+    """Traffic on group 0 must not cross into group 1 (the reference's
+    flow/data group split over one MPI world)."""
+
+    def job(gs):
+        g0, g1 = gs[0], gs[1]
+        r, peer = g0.my_rank, (g0.my_rank + 1) % g0.num_hosts
+        g0.send_to(peer, ("g0", r))
+        g1.send_to(peer, ("g1", r))
+        frm = (r - 1) % g0.num_hosts
+        m1 = g1.recv_from(frm)      # drain group 1 FIRST
+        m0 = g0.recv_from(frm)
+        return m0, m1
+
+    res = run_mpi_group(p, job)
+    for r, (m0, m1) in enumerate(res):
+        frm = (r - 1) % p
+        assert m0 == ("g0", frm) and m1 == ("g1", frm)
+
+
+@pytest.mark.parametrize("p", [2, 3])
+def test_bulk_exchange_every_rank_sends_first(p):
+    """~600 KiB numpy frames, ring pattern where EVERY rank issues all
+    its sends before any receive — the host_exchange shape. Under
+    strict rendezvous this deadlocks unless isend completion is lazy
+    (the round-3 advisor finding)."""
+    n = 75_000
+
+    def job(gs):
+        g = gs[0]
+        r = g.my_rank
+        arr = np.arange(n, dtype=np.int64) + r * 1_000_000
+        for d in range(1, p):
+            g.send_to((r + d) % p, arr)
+        got = {}
+        for d in range(1, p):
+            frm = (r - d) % p
+            got[frm] = g.recv_from(frm)
+        return {frm: int(a[0]) for frm, a in got.items()}
+
+    res = run_mpi_group(p, job, timeout=60)
+    for r, got in enumerate(res):
+        assert got == {frm: frm * 1_000_000
+                       for frm in range(p) if frm != r}
+
+
+def test_send_returns_before_peer_receives():
+    """Regression for the advisor deadlock: send() must RETURN while
+    the peer has not yet posted its receive (lazy isend completion);
+    the payload must still arrive intact afterwards."""
+    P = 2
+    sent_event = threading.Event()
+
+    def job(gs):
+        g = gs[0]
+        if g.my_rank == 0:
+            payload = np.arange(200_000, dtype=np.int64)
+            g.send_to(1, payload)       # peer is not receiving yet
+            sent_event.set()
+            return True
+        # rank 1: refuse to receive until rank 0's send has RETURNED
+        assert sent_event.wait(timeout=20), \
+            "send() blocked until the matching recv posted"
+        got = g.recv_from(0)
+        return int(got[-1])
+
+    res = run_mpi_group(P, job, timeout=40)
+    assert res == [True, 199_999]
+
+
+def test_flush_completes_pending_isends():
+    """After the peer drains, flush() empties the engine ledger."""
+
+    def job(gs):
+        g = gs[0]
+        if g.my_rank == 0:
+            g.send_to(1, b"x" * 100_000)
+            g.flush()                   # peer recv is concurrent
+            assert not g.engine.pending
+            return "flushed"
+        return len(g.recv_from(0))
+
+    res = run_mpi_group(2, job, timeout=40)
+    assert res == ["flushed", 100_000]
+
+
+def test_construct_without_mpi_raises_actionable():
+    mpi_backend.MPI = None
     assert not mpi_backend.available()
-    with pytest.raises(mpi_backend.MpiUnavailable,
-                       match="mpi4py|mpirun"):
+    with pytest.raises(mpi_backend.MpiUnavailable, match="mpirun"):
         mpi_backend.construct()
+
+
+# ---------------------------------------------------------------------------
+# real multi-process: the backend state machine across OS processes
+# ---------------------------------------------------------------------------
+
+CHILD = os.path.join(os.path.dirname(__file__), "mpi_child.py")
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.mark.parametrize("nproc", [2, 3])
+def test_mpi_real_processes(nproc):
+    """The reference runs its suite under mpirun -np {1,2,3,7}
+    (tests/CMakeLists.txt:116-120). mpirun does not exist here, so the
+    'world' is the fake rendezvous transport — but each RANK is a real
+    OS process running the actual backend (construct() via injection,
+    MpiGroup collectives, bulk byte-frame exchange, flush)."""
+    ports = _free_ports(nproc)
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = (repo_root + os.pathsep
+                         + os.path.dirname(__file__) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, CHILD, str(rank), str(nproc),
+         ",".join(map(str, ports))],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env) for rank in range(nproc)]
+    import concurrent.futures as cf
+    with cf.ThreadPoolExecutor(len(procs)) as ex:
+        futs = [ex.submit(p.communicate, None, 120) for p in procs]
+        try:
+            drained = [f.result(timeout=140) for f in futs]
+        except (cf.TimeoutError, subprocess.TimeoutExpired):
+            for q in procs:
+                q.kill()
+            pytest.fail("MPI child process timed out (deadlock?)")
+    results = []
+    for p, (out, err) in zip(procs, drained):
+        assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, f"no RESULT line:\n{out}\n{err[-2000:]}"
+        results.append(json.loads(lines[-1][len("RESULT "):]))
+    for rank, res in enumerate(results):
+        assert res["rank"] == rank
+        assert res["prefix"] == sum(range(1, rank + 2))
+        assert res["gathered"] == [i * 3 for i in range(nproc)]
+        assert res["bulk"] == [frm * 7 for frm in range(nproc)
+                               if frm != rank]
+        assert res["bcast"] == 1234
