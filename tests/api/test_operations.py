@@ -658,6 +658,46 @@ def test_inner_join_device_location_detection():
     assert ld == base == [(k, 2 * k) for k in range(19_900, 20_000)]
     assert moved_ld < moved_base / 3, (moved_ld, moved_base)
 
+
+def test_inner_join_location_detection_device_host_parity():
+    """The device LD path (presence registers + pmax,
+    ops/join.py:_location_filter) and the host LD path (Golomb
+    fingerprint exchange, core/location_detection.py) must agree on
+    the same skewed, partially-overlapping workload."""
+    import jax
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    rng = np.random.default_rng(17)
+    lk = rng.integers(0, 3000, size=4000).astype(np.int64)
+    rk = rng.integers(2000, 6000, size=4000).astype(np.int64)
+
+    def run(storage):
+        ctx = Context(MeshExec(devices=jax.devices("cpu")[:4]))
+        if storage == "host":
+            l = ctx.Distribute([(int(k), int(k)) for k in lk],
+                               storage="host")
+            r = ctx.Distribute([(int(k), -int(k)) for k in rk],
+                               storage="host")
+        else:
+            l = ctx.Distribute(lk).Map(lambda x: (x, x))
+            r = ctx.Distribute(rk).Map(lambda x: (x, -x))
+        j = InnerJoin(l, r, lambda kv: kv[0], lambda kv: kv[0],
+                      lambda a, b: (a[0], a[1], b[1]),
+                      location_detection=True)
+        got = sorted((int(a), int(b), int(c)) for a, b, c in j.AllGather())
+        ctx.close()
+        return got
+
+    dev = run("device")
+    host = run("host")
+    assert dev == host
+    # model: multiset join
+    from collections import Counter
+    lc, rc = Counter(lk.tolist()), Counter(rk.tolist())
+    expect = sorted((k, k, -k) for k in lc for _ in range(lc[k] * rc.get(k, 0)))
+    assert dev == expect
+
 def test_zip_window_device_default_schema():
     """ZipWindow with NO fns on device inputs stays on device with the
     reference's default tuple-of-chunks schema (zip_window.hpp:175):

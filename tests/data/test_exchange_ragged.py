@@ -33,6 +33,43 @@ def test_ragged_path_traces_and_lowers():
         os.environ.pop("THRILL_TPU_EXCHANGE", None)
 
 
+def test_lower_ragged_exchange_plan():
+    """The dryrun's plan validation (lower WITHOUT compiling): the
+    lowered module must contain the ragged collective, for multiple
+    leaf schemas and skewed send matrices."""
+    from thrill_tpu.parallel.mesh import MeshExec
+    from thrill_tpu.data.exchange import lower_ragged_exchange
+
+    mex = MeshExec(devices=jax.devices("cpu")[:4])
+    S = np.array([[5, 0, 0, 1], [0, 1, 1, 2], [2, 0, 1, 0],
+                  [1, 7, 1, 1]], dtype=np.int64)
+    hlo = lower_ragged_exchange(
+        mex, [(np.uint64, ()), (np.uint8, (10,)), (np.float32, (2, 2))],
+        S)
+    assert "ragged" in hlo.lower()
+
+
+def test_ragged_off_tpu_warns_loudly(capsys):
+    """Forcing ragged on a CPU backend prints the untested-path gate
+    before the compile error surfaces."""
+    from thrill_tpu.parallel.mesh import MeshExec
+    from thrill_tpu.data import exchange
+
+    mex = MeshExec(devices=jax.devices("cpu")[:2])
+    S = np.array([[1, 1], [1, 1]], dtype=np.int64)
+    leaves = [jnp.zeros((2, 4), jnp.int64)]
+    treedef = jax.tree.structure(0)
+    import os
+    os.environ["THRILL_TPU_EXCHANGE"] = "ragged"
+    try:
+        with pytest.raises(Exception):
+            exchange._exchange_planned(mex, treedef, None, leaves, S)
+    finally:
+        os.environ.pop("THRILL_TPU_EXCHANGE", None)
+    err = capsys.readouterr().err
+    assert "UNIMPLEMENTED" in err and "ragged" in err
+
+
 def test_landing_offsets_math():
     S = np.array([[3, 1], [2, 4]], dtype=np.int64)
     landing = np.cumsum(S, axis=0) - S

@@ -145,10 +145,13 @@ class FakeComm:
     # -- protocol pump --------------------------------------------------
     def _progress(self) -> None:
         for peer, sock in self._socks.items():
-            # writes
+            # writes (memoryview offsets: partial sends never copy the
+            # remaining tail, so big DATA frames stay O(n) total)
             out = self._outbox[peer]
             while out:
                 chunk = out[0]
+                if not isinstance(chunk[0], memoryview):
+                    chunk[0] = memoryview(chunk[0])
                 try:
                     sent = sock.send(chunk[0])
                 except (BlockingIOError, InterruptedError):

@@ -56,7 +56,7 @@ def main():
     print("RESULT " + json.dumps({
         "rank": rank, "prefix": int(prefix),
         "gathered": [int(x) for x in gathered],
-        "bulk": sorted(bulk, key=lambda v: v),
+        "bulk": sorted(bulk),
         "bcast": int(bcast)}), flush=True)
 
 

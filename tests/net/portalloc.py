@@ -1,0 +1,16 @@
+"""Shared localhost port allocation for the net test suite (one copy;
+every bind/close/rebind-race fix lands here once)."""
+
+import socket
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports

@@ -17,17 +17,8 @@ import sys
 
 import pytest
 
+from portalloc import free_ports
 
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
 
 
 CHILD = os.path.join(os.path.dirname(__file__), "distributed_child.py")
@@ -49,7 +40,7 @@ def _launch_children(nproc, tmp_path, net="tcp"):
     MPI backend over the strict-rendezvous fake world)."""
     text_file = tmp_path / "words.txt"
     text_file.write_text(_TEXT)
-    ports = _free_ports(1 + nproc)
+    ports = free_ports(1 + nproc)
     coord_port, net_ports = ports[0], ports[1:]
     coordinator = f"127.0.0.1:{coord_port}"
     hostlist = " ".join(f"127.0.0.1:{p}" for p in net_ports)

@@ -1,0 +1,205 @@
+"""Fault injection on the TCP data plane.
+
+The reference's only failure story is die-with-parent process hygiene
+(api/context.cpp:849-878); these tests pin down something stronger for
+this framework: a peer dying mid-bulk-exchange surfaces a clean
+ConnectionError (DispatcherError is a subclass) on every surviving
+worker — no hang, no partial-frame acceptance, nothing past a bad MAC
+— and the failure composes through the multiplexer's replication
+helpers rather than wedging them.
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from thrill_tpu.net import wire
+from thrill_tpu.net.tcp import TcpConnection, construct_tcp_group
+
+from portalloc import free_ports
+
+
+
+def test_peer_death_mid_bulk_exchange():
+    """Rank 2 dies (abrupt socket close) while ranks 0/1 are mid
+    bulk-exchange with it: both survivors must surface ConnectionError
+    on dead-peer traffic within the timeout — no hang — while their
+    OWN pairwise traffic keeps working."""
+    P = 3
+    ports = free_ports(P)
+    hosts = [("127.0.0.1", p) for p in ports]
+    barrier = threading.Barrier(P)
+    results = [None] * P
+    errors = [None] * P
+
+    def target(r):
+        g = None
+        try:
+            g = construct_tcp_group(r, hosts, timeout=20)
+            if r == 2:
+                barrier.wait()
+                for peer in (0, 1):          # die: no goodbye protocol
+                    g.connection(peer).sock.close()
+                results[r] = "died"
+                return
+            blob = b"\xcd" * (1 << 20)
+            barrier.wait()
+            # survivor pair stays healthy around the dead peer
+            other = 1 - r
+            g.send_to(other, blob)
+            assert g.recv_from(other) == blob
+            # traffic to the dead peer must ERROR, not hang: sends may
+            # land in kernel buffers for a while, so push until the
+            # error surfaces, then the recv must fail too
+            def poke():
+                for _ in range(64):
+                    g.send_to(2, blob)
+                    g.connection(2).flush()
+                g.recv_from(2)
+            with pytest.raises(ConnectionError):
+                poke()
+            # the surviving pair is STILL healthy afterwards
+            g.send_to(other, b"after")
+            assert g.recv_from(other) == b"after"
+            results[r] = "survived"
+        except BaseException as e:
+            errors[r] = e
+        finally:
+            if g is not None:
+                try:
+                    g.close()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=target, args=(r,), daemon=True)
+               for r in range(P)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for e in errors:
+        if e is not None:
+            raise e
+    assert all(not t.is_alive() for t in threads), \
+        "a worker HUNG on the dead peer instead of erroring"
+    assert results == ["survived", "survived", "died"]
+
+
+def _authed_pair():
+    a, b = socket.socketpair()
+    ca, cb = TcpConnection(a), TcpConnection(b)
+    errs = []
+
+    def auth(conn, role):
+        try:
+            conn.authenticate(b"fault-secret", role)
+        except Exception as e:
+            errs.append(e)
+
+    t = threading.Thread(target=auth, args=(ca, "client"), daemon=True)
+    t.start()
+    cb.authenticate(b"fault-secret", "server")
+    t.join(timeout=10)
+    assert not errs and not t.is_alive()
+    return a, b, ca, cb
+
+
+def test_truncated_frame_peer_death_mid_frame():
+    """Peer writes a frame header + part of the payload, then dies:
+    recv() must raise ConnectionError — never return a partial or
+    zero-filled object."""
+    a, b, ca, cb = _authed_pair()
+    try:
+        payload = wire.dumps(b"x" * 100_000)
+        a.sendall(struct.pack("<I", len(payload)) + payload[:1000])
+        a.close()                            # died mid-frame
+        with pytest.raises(ConnectionError):
+            cb.recv()
+    finally:
+        b.close()
+
+
+def test_bad_mac_rejected_never_accepted():
+    """A complete, well-formed frame whose MAC does not verify must
+    raise AuthError — the payload is never deserialized/returned (no
+    acceptance past the MAC)."""
+    a, b, ca, cb = _authed_pair()
+    try:
+        payload = wire.dumps("forged-message")
+        frame = (struct.pack("<I", len(payload)) + payload
+                 + b"\x00" * wire._MAC_LEN)
+        a.sendall(frame)
+        with pytest.raises(wire.AuthError):
+            cb.recv()
+        # and a GOOD frame from the real connection still fails closed:
+        # the stream is not resynchronizable after a MAC failure, the
+        # caller must tear the connection down (fail-stop, like the
+        # dispatcher's errored-fd latch)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_replication_helper_surfaces_peer_death():
+    """multiplexer.ensure_replicated (the all_gather replication path
+    every host-storage demotion uses) over a 3-process control plane
+    with a dead rank: survivors get ConnectionError, not a hang."""
+    from types import SimpleNamespace
+
+    from thrill_tpu.data import multiplexer
+    from thrill_tpu.data.shards import HostShards
+    from thrill_tpu.net import FlowControlChannel
+
+    P = 3
+    ports = free_ports(P)
+    hosts = [("127.0.0.1", p) for p in ports]
+    barrier = threading.Barrier(P)
+    errors = [None] * P
+    outcomes = [None] * P
+
+    def target(r):
+        g = None
+        try:
+            g = construct_tcp_group(r, hosts, timeout=20)
+            net = FlowControlChannel(g)
+            mex = SimpleNamespace(
+                num_processes=P, num_workers=P, process_index=r,
+                local_workers=[r], worker_process=list(range(P)),
+                host_net=net, logger=None)
+            shards = HostShards(P, [[f"item-{w}"] if w == r else []
+                                    for w in range(P)])
+            if r == 2:
+                barrier.wait()
+                for peer in (0, 1):
+                    g.connection(peer).sock.close()
+                outcomes[r] = "died"
+                return
+            barrier.wait()
+            with pytest.raises(ConnectionError):
+                multiplexer.ensure_replicated(mex, shards,
+                                              reason="fault-test")
+            outcomes[r] = "errored-cleanly"
+        except BaseException as e:
+            errors[r] = e
+        finally:
+            if g is not None:
+                try:
+                    g.close()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=target, args=(r,), daemon=True)
+               for r in range(P)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for e in errors:
+        if e is not None:
+            raise e
+    assert all(not t.is_alive() for t in threads), \
+        "replication helper hung on the dead peer"
+    assert outcomes == ["errored-cleanly", "errored-cleanly", "died"]

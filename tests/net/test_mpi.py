@@ -28,6 +28,8 @@ from thrill_tpu.net import mpi as mpi_backend
 
 import fake_mpi
 
+from portalloc import free_ports
+
 
 def run_mpi_group(num_hosts, job, group_count=2, timeout=30):
     """Run ``job(groups)`` on num_hosts daemon threads, one fake-MPI
@@ -199,17 +201,6 @@ def test_construct_without_mpi_raises_actionable():
 CHILD = os.path.join(os.path.dirname(__file__), "mpi_child.py")
 
 
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
 
 @pytest.mark.parametrize("nproc", [2, 3])
 def test_mpi_real_processes(nproc):
@@ -218,7 +209,7 @@ def test_mpi_real_processes(nproc):
     'world' is the fake rendezvous transport — but each RANK is a real
     OS process running the actual backend (construct() via injection,
     MpiGroup collectives, bulk byte-frame exchange, flush)."""
-    ports = _free_ports(nproc)
+    ports = free_ports(nproc)
     env = dict(os.environ)
     repo_root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))

@@ -10,21 +10,12 @@ import pytest
 from thrill_tpu.net import FlowControlChannel
 from thrill_tpu.net.tcp import construct_tcp_group, parse_hostlist
 
+from portalloc import free_ports
 
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
 
 
 def run_tcp(num_hosts, job):
-    ports = _free_ports(num_hosts)
+    ports = free_ports(num_hosts)
     hosts = [("127.0.0.1", p) for p in ports]
     results = [None] * num_hosts
     errors = [None] * num_hosts

@@ -11,6 +11,8 @@ import pytest
 from thrill_tpu.net import wire
 from thrill_tpu.net.tcp import TcpConnection, construct_tcp_group
 
+from portalloc import free_ports
+
 
 def _roundtrip(obj, allow_pickle=False):
     return wire.loads(wire.dumps(obj, allow_pickle), allow_pickle)
@@ -126,20 +128,9 @@ def test_mutual_auth_reflection_attack_fails():
     b.close()
 
 
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
-
 
 def test_tcp_group_with_secret():
-    hosts = [("127.0.0.1", p) for p in _free_ports(3)]
+    hosts = [("127.0.0.1", p) for p in free_ports(3)]
     results = [None] * 3
     errors = [None] * 3
 
@@ -188,7 +179,7 @@ def test_dumps_parts_concat_equals_dumps():
 def test_tcp_group_secret_large_frames():
     """Authenticated connections MAC big scatter-gather frames
     correctly across the lazy async cutover."""
-    hosts = [("127.0.0.1", p) for p in _free_ports(2)]
+    hosts = [("127.0.0.1", p) for p in free_ports(2)]
     results = [None] * 2
     errors = [None] * 2
     blob = b"q" * (3 << 20)

@@ -556,6 +556,50 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     return DeviceShards(mex, tree, new_counts)
 
 
+def _ragged_builder(mex: MeshExec, out_cap: int, num_leaves: int):
+    """The jitted ragged-exchange program (shared by the execution path
+    and by :func:`lower_ragged_exchange`, which plan-validates it on
+    builds whose XLA backend cannot execute the op)."""
+
+    def f(srow, scol, olanding, *ls):
+        from ..core import rowmove
+        S_row = srow[0].astype(jnp.int32)     # my sends by dest
+        S_col = scol[0].astype(jnp.int32)     # my recvs by source
+        in_off = _ex_cumsum(S_row)
+        # where MY chunk lands inside each destination's buffer:
+        # sources before me writing to that destination
+        out_off = olanding[0].astype(jnp.int32)
+        pack = rowmove.enabled()
+        outs = []
+        for l in ls:
+            x, m = rowmove.pack_rows(l[0]) if pack else (l[0], None)
+            out = jnp.zeros((out_cap,) + x.shape[1:], x.dtype)
+            res = lax.ragged_all_to_all(
+                x, out, in_off, S_row, out_off, S_col,
+                axis_name=AXIS)
+            outs.append(rowmove.unpack_rows(res, m)[None])
+        return tuple(outs)
+
+    return mex.smap(f, 3 + num_leaves)
+
+
+def _warn_ragged_untested(mex: MeshExec) -> None:
+    """Loud one-time gate: the ragged path cannot RUN on this image
+    (XLA:CPU lacks the op), so a user forcing it off-TPU must know the
+    path is lowering-validated only (see __graft_entry__ dryrun)."""
+    if getattr(mex, "_warned_ragged", False):
+        return
+    mex._warned_ragged = True
+    plat = mex.devices[0].platform if mex.devices else "?"
+    if plat not in ("tpu",):
+        import sys
+        print(f"thrill_tpu: THRILL_TPU_EXCHANGE=ragged on platform "
+              f"'{plat}' — lax.ragged_all_to_all is UNIMPLEMENTED on "
+              f"XLA:CPU; this path is plan/lowering-validated on this "
+              f"build but has never executed here. Expect a compile "
+              f"error; use dense/onefactor off-TPU.", file=sys.stderr)
+
+
 def _exchange_ragged(mex: MeshExec, treedef, sorted_leaves, S: np.ndarray,
                      min_cap: int = 1) -> DeviceShards:
     """TPU fast path: ``lax.ragged_all_to_all`` — no per-pair padding.
@@ -567,36 +611,14 @@ def _exchange_ragged(mex: MeshExec, treedef, sorted_leaves, S: np.ndarray,
     deterministic item order as the dense path. XLA:CPU lacks this op,
     so the path is only selected via THRILL_TPU_EXCHANGE=ragged.
     """
-    W = mex.num_workers
+    _warn_ragged_untested(mex)
     R = S.sum(axis=0)
     new_counts = R.astype(np.int64)
     out_cap = round_up_pow2(max(int(R.max()), min_cap, 1))
     key = ("xchg_ragged", out_cap, treedef,
            tuple((l.dtype, l.shape[1:]) for l in sorted_leaves))
-
-    def build():
-        def f(srow, scol, olanding, *ls):
-            from ..core import rowmove
-            S_row = srow[0].astype(jnp.int32)     # my sends by dest
-            S_col = scol[0].astype(jnp.int32)     # my recvs by source
-            in_off = _ex_cumsum(S_row)
-            # where MY chunk lands inside each destination's buffer:
-            # sources before me writing to that destination
-            out_off = olanding[0].astype(jnp.int32)
-            pack = rowmove.enabled()
-            outs = []
-            for l in ls:
-                x, m = rowmove.pack_rows(l[0]) if pack else (l[0], None)
-                out = jnp.zeros((out_cap,) + x.shape[1:], x.dtype)
-                res = lax.ragged_all_to_all(
-                    x, out, in_off, S_row, out_off, S_col,
-                    axis_name=AXIS)
-                outs.append(rowmove.unpack_rows(res, m)[None])
-            return tuple(outs)
-
-        return mex.smap(f, 3 + len(sorted_leaves))
-
-    fb = mex.cached(key, build)
+    fb = mex.cached(key, lambda: _ragged_builder(mex, out_cap,
+                                                 len(sorted_leaves)))
     srow = mex.put(S.astype(np.int32))
     scol = mex.put(S.T.copy().astype(np.int32))
     # landing[w, d] = sum of S[0:w, d] (receiver-side offset of w's chunk)
@@ -604,6 +626,34 @@ def _exchange_ragged(mex: MeshExec, treedef, sorted_leaves, S: np.ndarray,
     out_leaves = list(fb(srow, scol, mex.put(landing), *sorted_leaves))
     tree = jax.tree.unflatten(treedef, out_leaves)
     return DeviceShards(mex, tree, new_counts)
+
+
+def lower_ragged_exchange(mex: MeshExec, leaf_specs, S: np.ndarray,
+                          min_cap: int = 1) -> str:
+    """Trace + lower (NOT compile) the ragged exchange program over the
+    current mesh and return its StableHLO text.
+
+    This is the strongest validation available on builds whose XLA
+    backend lacks the op: the full plan — offset/size computation,
+    packed row movement, shard_map specs, static shapes — is traced
+    exactly as the execution path would (same builder), and the caller
+    can assert the ragged-all-to-all collective is present. Executed by
+    the driver's ``dryrun_multichip`` so a pod user is not the first
+    trace of this code.
+
+    ``leaf_specs``: [(dtype, row_shape), ...] for the phase-A sorted
+    leaves (leading dims [W, cap] are derived from ``S``).
+    """
+    W = mex.num_workers
+    cap = int(round_up_pow2(max(int(S.sum(axis=1).max()), min_cap, 1)))
+    out_cap = int(round_up_pow2(max(int(S.sum(axis=0).max()),
+                                    min_cap, 1)))
+    fb = _ragged_builder(mex, out_cap, len(leaf_specs))
+    i32 = jax.ShapeDtypeStruct((W, W), jnp.int32)
+    leaves = [jax.ShapeDtypeStruct((W, cap) + tuple(shape), dtype)
+              for dtype, shape in leaf_specs]
+    lowered = fb.lower(i32, i32, i32, *leaves)
+    return lowered.as_text()
 
 
 # The host-path shuffle lives in data/multiplexer.py (host_exchange):

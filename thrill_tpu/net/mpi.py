@@ -30,10 +30,16 @@ defining disciplines:
   inside the codec is enabled: MPI ranks are co-launched instances of
   one program under mpirun, the identical trust model the reference
   assumes for its MPI world. The engine keeps an in-flight byte
-  account mirroring the TCP engine's cap, but reaps opportunistically
-  rather than blocking — blocking over the cap would re-create the
-  rendezvous deadlock, so the cap bounds memory while the network
-  drains, never liveness.
+  account: over the cap, send() reaps aggressively while completions
+  keep arriving, but it NEVER blocks — blocking over the cap would
+  re-create the rendezvous deadlock. The cap is therefore a drain
+  accelerator, not a hard memory bound; the actual bound is
+  structural: each group queues at most one exchange's outgoing
+  frames (the collectives and host_exchange are phase-synchronous,
+  so a rank's pending set peaks at its own per-phase send volume —
+  data the caller holds materialized anyway). The reference's async
+  MPI dispatcher queues posted Isends the same unbounded way
+  (net/mpi/dispatcher.cpp:67).
 
 Groups share ``COMM_WORLD`` as tag namespaces (group_tag = the MPI
 message tag), exactly how the reference multiplexes its kGroupCount
@@ -115,9 +121,10 @@ class _SendEngine:
     matching receive is already posted or will be without our help.
     """
 
-    #: opportunistic in-flight cap (bytes): over this, send() keeps
-    #: reaping while completions arrive, but never blocks without
-    #: progress (see module docstring)
+    #: drain-accelerator threshold (bytes), NOT a hard memory bound:
+    #: over this, send() keeps reaping while completions arrive, but
+    #: never blocks without progress (see module docstring — the hard
+    #: bound is the caller's per-phase send volume)
     CAP_BYTES = int(os.environ.get("THRILL_TPU_MPI_INFLIGHT_CAP",
                                    str(32 << 20)))
 
