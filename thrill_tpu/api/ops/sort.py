@@ -180,6 +180,7 @@ class SortNode(DIABase):
         """
         from ...common.sampling import ReservoirSamplingGrow
         from ...data.block_pool import BlockPool
+        from ...core import native_merge, order_key
         from ...core.multiway_merge import multiway_merge_files
 
         owns_input = self.parents[0].node.state == "DISPOSED"
@@ -195,49 +196,128 @@ class SortNode(DIABase):
         # every duplicate onto one worker (the reference breaks splitter
         # ties by global index the same way, api/sort.hpp:487-502)
         pair_key = lambda t: (sort_key(t[1]), t[0])  # noqa: E731
-        files = []
-        run = []
+        # native merge path: when the key schema byte-encodes
+        # (core/order_key.py), runs sort by raw key bytes and the merge
+        # selection loop runs in C++ (native/mwmerge.cpp) instead of
+        # heapq + per-item Python key calls. ``enc`` is probed from the
+        # first item and demoted to None on ANY schema deviation —
+        # item files always hold plain (pos, item) records in key
+        # order, so runs spilled before a demotion merge fine on the
+        # generic path.
+        enc = None
+        enc_state = "probe" if native_merge.available() else "off"
+        files = []          # item Files, (pos, item) records
+        key_files = []      # parallel key-byte Files (native path)
+        run = []            # native: (kb, pos, item); generic: (pos, item)
         pos = 0
         # real-memory feedback: run_size is an ESTIMATE from one
         # pickled item; the RSS budget is ground truth and spills the
         # run early when actual interpreter growth passes the grant
         # (reference: ReceiveItems spills on mem::memory_exceeded,
         # api/sort.hpp:679)
+        from ...data.file import File
         from ...mem.manager import RssBudget
         budget = RssBudget(self.mem_limit or 0)
+
+        def spill():
+            nonlocal run
+            if enc is not None:
+                run.sort()               # kb unique (pos suffix): pure
+                f = File(pool=pool)      # memcmp, items never compared
+                with f.writer() as w:
+                    for kb, p, it in run:
+                        w.put((p, it))
+                kf = File(pool=pool)
+                native_merge.write_key_chunks(kf, [t[0] for t in run])
+                files.append(f)
+                key_files.append(kf)
+            else:
+                files.append(_spill_run(pool, run, pair_key))
+                key_files.append(None)
+            run = []
+
+        def demote():
+            """Schema deviation: strip key decoration from the live run
+            and stop encoding; spilled runs stay valid as-is."""
+            nonlocal enc, enc_state, run
+            enc, enc_state = None, "off"
+            run = [(p, it) for _kb, p, it in run]
+
+        def append_batch(batch):
+            """Batch-at-a-time spill-side processing: ONE encoding
+            listcomp and ONE vectorized reservoir call per slice —
+            per-item Python bookkeeping was the profiled bottleneck of
+            the whole EM sort, bigger than the merge it feeds."""
+            nonlocal enc, enc_state, pos
+            if enc_state == "probe" and batch:
+                enc = order_key.make_batch_encoder(sort_key(batch[0]))
+                enc_state = "on" if enc is not None else "off"
+            if enc is not None:
+                try:
+                    # kbs built fully BEFORE touching run: a mid-batch
+                    # schema deviation leaves no partial decoration
+                    kbs = enc(list(map(sort_key, batch)),
+                              range(pos, pos + len(batch)))
+                    run.extend(zip(kbs, range(pos, pos + len(batch)),
+                                   batch))
+                except order_key.BATCH_ENCODE_ERRORS:
+                    demote()
+                    run.extend(zip(range(pos, pos + len(batch)), batch))
+            else:
+                run.extend(zip(range(pos, pos + len(batch)), batch))
+            sampler.add_batch_indexed(pos, batch)
+            pos += len(batch)
+
+        # batch bound: one real RSS check per batch keeps the grant
+        # feedback responsive even when run_size is huge, and caps the
+        # transient key-bytes list a single encode pass builds
+        MAX_BATCH = 1 << 16
         try:
             for lst in shards.lists:
-                for it in lst:
-                    run.append((pos, it))
-                    sampler.add((pos, it))
-                    pos += 1
+                idx = 0
+                while idx < len(lst):
+                    take = min(run_size - len(run), len(lst) - idx,
+                               MAX_BATCH)
+                    append_batch(lst[idx:idx + take])
+                    idx += take
                     if len(run) >= run_size or \
-                            (budget.exceeded() and len(run) >= 16):
-                        files.append(_spill_run(pool, run, pair_key))
-                        run = []
+                            (budget.exceeded_now() and len(run) >= 16):
+                        spill()
                         budget.reset()
                 if owns_input:
                     lst.clear()
             if run:
-                files.append(_spill_run(pool, run, pair_key))
+                spill()
 
-            # W-1 (key, position) splitters from the reservoir
             samples = sorted(sampler.samples, key=pair_key)
-            split_keys = [pair_key(samples[min(len(samples) - 1,
-                                               (j * len(samples)) // W)])
-                          for j in range(1, W)] if samples else []
-
+            sample_at = [min(len(samples) - 1, (j * len(samples)) // W)
+                         for j in range(1, W)] if samples else []
             out = [[] for _ in range(W)]
             w = 0
-            for t in multiway_merge_files(files, key=pair_key,
-                                          consume=True):
-                k = pair_key(t)
-                while w < len(split_keys) and k > split_keys[w]:
-                    w += 1
-                out[w].append(t[1])
+            if enc is not None and all(kf is not None
+                                       for kf in key_files):
+                # byte splitters fed as an extra merge run: partition
+                # advances when a splitter pops — no per-item key
+                # comparison or key-byte copy in Python at all
+                split_kb = [enc([sort_key(samples[i][1])],
+                                [samples[i][0]])[0]
+                            for i in sample_at]
+                native_merge.merge_partitioned(files, key_files,
+                                               split_kb, out,
+                                               consume=True)
+            else:
+                # W-1 (key, position) splitters from the reservoir
+                split_keys = [pair_key(samples[i]) for i in sample_at]
+                for t in multiway_merge_files(files, key=pair_key,
+                                              consume=True):
+                    k = pair_key(t)
+                    while w < len(split_keys) and k > split_keys[w]:
+                        w += 1
+                    out[w].append(t[1])
         finally:
-            for f in files:
-                f.clear()
+            for f in files + key_files:
+                if f is not None:
+                    f.clear()
             pool.close()
         return out
 

@@ -63,6 +63,44 @@ class ReservoirSamplingGrow(Generic[T]):
         for it in items:
             self.add(it)
 
+    def add_batch_indexed(self, start: int, items) -> None:
+        """Vectorized batch add of ``(start + i, items[i])`` pairs.
+
+        Same admission distribution as per-item :meth:`add` (each item
+        draws j ~ U[0, its running count) and is admitted iff
+        j < desired_size at that count) with ONE vectorized draw per
+        batch; pair tuples are only constructed for admitted items.
+        The EM sort's spill loop calls this per run chunk — per-item
+        Python sampling was a profiled hotspot there."""
+        m = len(items)
+        if m == 0:
+            return
+        i = 0
+        # fill phase (stream shorter than the growing reservoir):
+        # bounded by max(min_size, ...) early counts — rare past startup
+        while i < m:
+            self.count += 1
+            if self.count > self.desired_size():
+                self.count -= 1
+                break
+            self.samples.append((start + i, items[i]))
+            i += 1
+        if i == m:
+            return
+        counts = np.arange(self.count + 1, self.count + (m - i) + 1)
+        sizes = np.clip(
+            np.ceil(self.growth_factor * np.sqrt(counts)),
+            self.min_size, self.max_size).astype(np.int64)
+        draws = self.rng.integers(0, counts)
+        self.count += m - i
+        for k in np.flatnonzero(draws < sizes):
+            item = (start + i + int(k), items[i + int(k)])
+            j = int(draws[k])
+            if len(self.samples) < int(sizes[k]):
+                self.samples.append(item)
+            else:
+                self.samples[j] = item
+
     def sample_rate(self) -> float:
         if self.count == 0:
             return 1.0

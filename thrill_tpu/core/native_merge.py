@@ -1,0 +1,301 @@
+"""Native k-way merge of spilled runs over byte-encoded keys.
+
+Driver for native/mwmerge.cpp (see its header): runs are pairs of
+block-store Files — an ITEM file holding the run's (pos, item) records
+in key order, and a KEY file holding the matching order-encoded key
+bytes (core/order_key.py) as (offsets, blob) chunks. The native engine
+consumes key chunks and emits the merged order as run indices plus the
+winners' key bytes; items never leave Python, and only one key chunk
+per run is resident, so the merge stays external-memory-friendly
+(reference: the partial multiway merge bound, thrill/api/sort.hpp:229-
+260, core/multiway_merge.hpp:132).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..data.file import File
+
+#: keys per spilled chunk item (a few hundred KB of key bytes for
+#: typical keys — one chunk per run resident during the merge)
+KEY_CHUNK = 8192
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        from ..common.native_build import build_and_load
+        lib = build_and_load("mwmerge.cpp")
+        if lib is not None:
+            lib.mwm_create.restype = ctypes.c_void_p
+            lib.mwm_create.argtypes = [ctypes.c_int32]
+            lib.mwm_destroy.restype = None
+            lib.mwm_destroy.argtypes = [ctypes.c_void_p]
+            lib.mwm_done.restype = ctypes.c_int32
+            lib.mwm_done.argtypes = [ctypes.c_void_p]
+            lib.mwm_set_chunk.restype = ctypes.c_int32
+            lib.mwm_set_chunk.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32]
+            lib.mwm_next.restype = ctypes.c_int64
+            lib.mwm_next.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int64]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    if os.environ.get("THRILL_TPU_EM_MERGE", "native") == "py":
+        return False
+    return _load() is not None
+
+
+def write_key_chunks(keys_file: File, key_bytes: List[bytes]) -> None:
+    """Spill a sorted run's key bytes as (offsets, blob) chunk items."""
+    with keys_file.writer() as w:
+        for i in range(0, len(key_bytes), KEY_CHUNK):
+            chunk = key_bytes[i:i + KEY_CHUNK]
+            offs = np.zeros(len(chunk) + 1, dtype=np.int64)
+            np.cumsum([len(b) for b in chunk], out=offs[1:])
+            w.put((offs, b"".join(chunk)))
+
+
+class _RunFeed:
+    """One run's key-chunk stream; owns the live buffers the native
+    engine points into (they must outlive the chunk's consumption)."""
+
+    def __init__(self, reader) -> None:
+        self.reader = reader
+        self.offs: Optional[np.ndarray] = None
+        self.blob: Optional[bytes] = None
+
+    def feed(self, lib, handle, r: int) -> None:
+        nxt = next(self.reader, None)
+        if nxt is None:
+            self.offs = np.zeros(1, dtype=np.int64)
+            self.blob = b""
+            rc = lib.mwm_set_chunk(
+                handle, r, 0, self.offs.ctypes.data_as(ctypes.c_void_p),
+                None, 1)
+        else:
+            offs, blob = nxt
+            self.offs = np.ascontiguousarray(offs, dtype=np.int64)
+            self.blob = bytes(blob)
+            rc = lib.mwm_set_chunk(
+                handle, r, len(self.offs) - 1,
+                self.offs.ctypes.data_as(ctypes.c_void_p),
+                ctypes.cast(ctypes.c_char_p(self.blob), ctypes.c_void_p),
+                0)
+        if rc != 0:
+            raise RuntimeError(f"mwm_set_chunk failed for run {r}")
+
+
+def _merge_group(item_files: List[File], key_files: List[File],
+                 consume: bool) -> Iterator[Tuple[bytes, object]]:
+    """Stream the native merge of one group: yields (key_bytes, item)
+    in merged order."""
+    lib = _load()
+    assert lib is not None
+    k = len(item_files)
+    handle = lib.mwm_create(k)
+    if not handle:
+        raise RuntimeError("mwm_create failed")
+    out_cap = 8192
+    out_runs = np.empty(out_cap, dtype=np.uint32)
+    out_offs = np.empty(out_cap + 1, dtype=np.int64)
+    blob_cap = 1 << 20
+    need = ctypes.c_int32(-1)
+    try:
+        feeds = [_RunFeed(kf.consume_reader() if consume
+                          else kf.keep_reader()) for kf in key_files]
+        item_readers = [f.consume_reader() if consume else f.keep_reader()
+                        for f in item_files]
+        for r, feed in enumerate(feeds):
+            feed.feed(lib, handle, r)
+        out_blob = ctypes.create_string_buffer(blob_cap)
+        while True:
+            cnt = lib.mwm_next(
+                handle, out_runs.ctypes.data_as(ctypes.c_void_p),
+                out_cap, ctypes.byref(need),
+                out_offs.ctypes.data_as(ctypes.c_void_p),
+                out_blob, blob_cap)
+            if cnt < 0:
+                raise RuntimeError("mwm_next failed")
+            if cnt:
+                # copy only the used prefix (blob_cap can be MBs after
+                # a growth; .raw would copy all of it every round)
+                blob = ctypes.string_at(out_blob, int(out_offs[cnt]))
+                offs = out_offs
+                runs = out_runs
+                for i in range(cnt):
+                    kb = blob[offs[i]:offs[i + 1]]
+                    yield kb, next(item_readers[runs[i]])
+            if need.value >= 0:
+                feeds[need.value].feed(lib, handle, need.value)
+                continue
+            if lib.mwm_done(handle):
+                return
+            if cnt == 0:
+                # next key alone exceeds the blob buffer: grow it
+                blob_cap *= 4
+                out_blob = ctypes.create_string_buffer(blob_cap)
+    finally:
+        lib.mwm_destroy(handle)
+
+
+def _resolve_degree(max_merge_degree: int) -> int:
+    if max_merge_degree <= 0:
+        max_merge_degree = int(
+            os.environ.get("THRILL_TPU_MAX_MERGE_DEGREE", "64") or 64)
+    return max(max_merge_degree, 2)
+
+
+def _reduce_degree(pairs: List[Tuple[File, File]], max_merge_degree: int,
+                   consume: bool, made: List[File]) -> List[Tuple[File, File]]:
+    """Partially merge the smallest (item, key) file pairs into
+    intermediate pairs until at most ``max_merge_degree`` remain
+    (reference: the partial multiway merge bound, api/sort.hpp:229-260).
+    Intermediates are appended to ``made`` (caller clears them);
+    ``consume=False`` reads input runs with keep semantics so the
+    caller's Files survive."""
+    while len(pairs) > max_merge_degree:
+        pairs.sort(key=lambda p: p[0].num_items)
+        group, pairs = pairs[:max_merge_degree], pairs[max_merge_degree:]
+        pool = group[0][0].pool
+        mi, mk = File(pool=pool), File(pool=pool)
+        kb_buf: List[bytes] = []
+        with mi.writer() as wi, mk.writer() as wk:
+            for kb, item in _merge_group(
+                    [p[0] for p in group], [p[1] for p in group],
+                    consume=consume):
+                wi.put(item)
+                kb_buf.append(kb)
+                if len(kb_buf) >= KEY_CHUNK:
+                    _put_chunk(wk, kb_buf)
+                    kb_buf = []
+            if kb_buf:
+                _put_chunk(wk, kb_buf)
+        if consume:
+            for fi, fk in group:
+                fi.clear()
+                fk.clear()
+        made.extend([mi, mk])
+        # intermediates are always consumable (they are ours)
+        pairs.append((mi, mk))
+    return pairs
+
+
+def merge_partitioned(item_files: List[File], key_files: List[File],
+                      splitters_kb: List[bytes], out_lists: List[list],
+                      consume: bool = True,
+                      max_merge_degree: int = 0) -> None:
+    """Merge + splitter-partition in one pass, appending items into
+    ``out_lists`` directly (the EM sort's final phase).
+
+    The splitters ride as ONE EXTRA RUN of the native merge: when the
+    engine emits the splitter run, the partition index advances — so
+    partitioning costs zero key comparisons in Python and the final
+    merge never copies key bytes out of the engine at all. Tie
+    semantics match the generic path exactly: the splitter run has the
+    HIGHEST run index, so items whose key equals a splitter pop first
+    (run-id tiebreak) and land in the current partition, like the
+    generic ``k > split_keys[w]`` advance."""
+    max_merge_degree = _resolve_degree(max_merge_degree)
+    pairs = list(zip(item_files, key_files))
+    made: List[File] = []
+    lib = _load()
+    assert lib is not None
+    try:
+        pairs = _reduce_degree(pairs, max_merge_degree, consume, made)
+        k = len(pairs)
+        handle = lib.mwm_create(k + 1)      # +1: the splitter run
+        if not handle:
+            raise RuntimeError("mwm_create failed")
+        out_cap = 8192
+        out_runs = np.empty(out_cap, dtype=np.uint32)
+        need = ctypes.c_int32(-1)
+        try:
+            feeds = [_RunFeed(p[1].consume_reader() if consume
+                              else p[1].keep_reader()) for p in pairs]
+            item_readers = [p[0].consume_reader() if consume
+                            else p[0].keep_reader() for p in pairs]
+            for r, feed in enumerate(feeds):
+                feed.feed(lib, handle, r)
+            sp_offs = np.zeros(len(splitters_kb) + 1, dtype=np.int64)
+            np.cumsum([len(b) for b in splitters_kb], out=sp_offs[1:])
+            sp_blob = b"".join(splitters_kb)
+            rc = lib.mwm_set_chunk(
+                handle, k, len(splitters_kb),
+                sp_offs.ctypes.data_as(ctypes.c_void_p),
+                ctypes.cast(ctypes.c_char_p(sp_blob), ctypes.c_void_p)
+                if sp_blob else None, 1)
+            if rc != 0:
+                raise RuntimeError("mwm_set_chunk(splitters) failed")
+            w = 0
+            while True:
+                cnt = lib.mwm_next(
+                    handle, out_runs.ctypes.data_as(ctypes.c_void_p),
+                    out_cap, ctypes.byref(need), None, None, 0)
+                if cnt < 0:
+                    raise RuntimeError("mwm_next failed")
+                if cnt:
+                    cur = out_lists[w]
+                    for r in out_runs[:cnt].tolist():
+                        if r == k:
+                            w += 1
+                            cur = out_lists[w]
+                        else:
+                            cur.append(next(item_readers[r])[1])
+                if need.value >= 0:
+                    feeds[need.value].feed(lib, handle, need.value)
+                    continue
+                if lib.mwm_done(handle):
+                    return
+        finally:
+            lib.mwm_destroy(handle)
+    finally:
+        for f in made:
+            f.clear()
+
+
+def merge_key_files(item_files: List[File], key_files: List[File],
+                    consume: bool = True,
+                    max_merge_degree: int = 0
+                    ) -> Iterator[Tuple[bytes, object]]:
+    """Merge sorted (item, key) file pairs; yields (key_bytes, item).
+
+    Mirrors multiway_merge_files' bounded-degree strategy: when there
+    are more runs than ``max_merge_degree``, the smallest runs are
+    partially merged into intermediate item+key Files first, so at most
+    max_merge_degree key chunks are resident at once."""
+    max_merge_degree = _resolve_degree(max_merge_degree)
+    pairs = list(zip(item_files, key_files))
+    made: List[File] = []
+    try:
+        pairs = _reduce_degree(pairs, max_merge_degree, consume, made)
+        yield from _merge_group([p[0] for p in pairs],
+                                [p[1] for p in pairs], consume=consume)
+    finally:
+        for f in made:
+            f.clear()
+
+
+def _put_chunk(writer, kb_buf: List[bytes]) -> None:
+    offs = np.zeros(len(kb_buf) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in kb_buf], out=offs[1:])
+    writer.put((offs, b"".join(kb_buf)))

@@ -1,0 +1,247 @@
+"""Order-preserving byte encoding of sort keys.
+
+The host EM sort spills sorted runs and k-way merges them; comparing
+Python keys per item in that merge is the round-3 bottleneck. This
+module maps common key schemas — str, bytes, int64-range ints, floats,
+and (nested) tuples of those — to byte strings whose memcmp order
+equals the Python comparison order, so the merge can run in native
+code over raw bytes (native/mwmerge.cpp) and run sorting can compare
+plain bytes objects (C memcmp) instead of calling key functions.
+
+Encodings (each self-delimiting, so tuple concatenation compares
+element-wise, and a shorter tuple that is a prefix compares smaller —
+matching Python):
+
+* bytes/str: 0x00 bytes escaped as 0x00 0xFF, terminated by 0x00
+  (the FoundationDB tuple-layer scheme); str encodes as UTF-8 first,
+  whose byte order equals code-point order.
+* int in [-2**63, 2**63): 8 bytes big-endian of value + 2**63.
+* float: 8 bytes big-endian of the monotone IEEE-754 transform (the
+  same mapping core/keys.py uses for device sort words).
+* tuple: concatenation of element encodings.
+
+A schema is derived from ONE sample key; the returned encoder raises
+:class:`OrderKeyError` on any later key that deviates (different type,
+int overflow, tuple arity change), and the caller falls back to the
+generic Python-comparison path. Mixed int/float at one position is
+supported via the float encoding with an exactness check (an int that
+float() cannot represent exactly raises, because numeric comparison
+order could differ).
+
+Reference analog: the C++ framework compares typed keys inline in its
+tournament tree (core/multiway_merge.hpp:132); byte-encoding them is
+how a dynamic language buys back those typed comparisons.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class OrderKeyError(TypeError):
+    """Key does not fit the schema probed from the first item."""
+
+
+def _enc_bytes(b: bytes) -> bytes:
+    return b.replace(b"\x00", b"\x00\xff") + b"\x00"
+
+
+def _enc_int(v: int) -> bytes:
+    if not -(1 << 63) <= v < (1 << 63):
+        raise OrderKeyError(f"int out of int64 range: {v}")
+    return struct.pack(">Q", v + (1 << 63))
+
+
+_F64 = struct.Struct(">d")
+_Q = struct.Struct(">Q")
+
+
+def _enc_float(v: float) -> bytes:
+    if v == 0:
+        v = 0.0        # -0.0 == 0.0 in Python: one encoding for both
+    (bits,) = _Q.unpack(_F64.pack(v))
+    if bits >> 63:
+        bits = ~bits & 0xFFFFFFFFFFFFFFFF
+    else:
+        bits |= 1 << 63
+    return _Q.pack(bits)
+
+
+def _enc_numeric(v) -> bytes:
+    """Mixed int/float position: compare as floats, exactly or not at
+    all."""
+    if isinstance(v, float):
+        return _enc_float(v)
+    if isinstance(v, int):
+        f = float(v)
+        if int(f) != v:
+            raise OrderKeyError(f"int {v} not exactly representable "
+                                f"as float in mixed numeric key")
+        return _enc_float(f)
+    raise OrderKeyError(f"non-numeric {type(v).__name__} in numeric key")
+
+
+def _schema_of(key: Any):
+    if isinstance(key, (np.generic,)):
+        key = key.item()
+    if isinstance(key, bytes):
+        return "bytes"
+    if isinstance(key, str):
+        return "str"
+    if isinstance(key, bool):
+        return "int"                   # bool is an int in comparisons
+    if isinstance(key, int):
+        return "int"
+    if isinstance(key, float):
+        return "float"
+    if isinstance(key, tuple):
+        return ("tuple", tuple(_schema_of(e) for e in key))
+    raise OrderKeyError(f"unsupported key type {type(key).__name__}")
+
+
+def _encoder_for(schema) -> Callable[[Any], bytes]:
+    if schema == "bytes":
+        def enc(k):
+            if isinstance(k, np.generic):
+                k = k.item()
+            if not isinstance(k, bytes):
+                raise OrderKeyError(f"expected bytes, got "
+                                    f"{type(k).__name__}")
+            return _enc_bytes(k)
+        return enc
+    if schema == "str":
+        def enc(k):
+            if isinstance(k, np.generic):
+                k = k.item()
+            if not isinstance(k, str):
+                raise OrderKeyError(f"expected str, got "
+                                    f"{type(k).__name__}")
+            return _enc_bytes(k.encode("utf-8"))
+        return enc
+    if schema == "int":
+        def enc(k):
+            if isinstance(k, np.generic):
+                k = k.item()
+            if isinstance(k, int):          # bool included
+                return _enc_int(k)
+            # an int-schema position meeting a float: re-route both
+            # sides through the numeric encoding
+            if isinstance(k, float):
+                raise _MixedNumeric()
+            raise OrderKeyError(f"expected int, got {type(k).__name__}")
+        return enc
+    if schema == "float":
+        def enc(k):
+            if isinstance(k, np.generic):
+                k = k.item()
+            return _enc_numeric(k)
+        return enc
+    if isinstance(schema, tuple) and schema[0] == "tuple":
+        subs = [_encoder_for(s) for s in schema[1]]
+
+        def enc(k):
+            if not isinstance(k, tuple) or len(k) != len(subs):
+                raise OrderKeyError(
+                    f"expected {len(subs)}-tuple, got {k!r:.60}")
+            return b"".join(e(v) for e, v in zip(subs, k))
+        return enc
+    raise OrderKeyError(f"no encoder for schema {schema!r}")
+
+
+class _MixedNumeric(Exception):
+    """Signal: int-schema met a float; retry with the float schema."""
+
+
+def make_encoder(sample_key: Any) -> Optional[Callable[[Any], bytes]]:
+    """Encoder for ``sample_key``'s schema, or None if unsupported.
+
+    The returned callable raises :class:`OrderKeyError` for keys that
+    do not fit the schema. An int-schema position that later meets a
+    float widens to the numeric (float) schema transparently — but the
+    WIDENING invalidates earlier encodings, so it raises
+    ``OrderKeyError`` too; callers treat it as a schema mismatch."""
+    try:
+        schema = _schema_of(sample_key)
+        enc = _encoder_for(schema)
+        enc(sample_key)                    # self-check on the sample
+        return enc
+    except (OrderKeyError, _MixedNumeric, UnicodeError):
+        return None
+
+
+def encode_or_raise(enc: Callable[[Any], bytes], key: Any) -> bytes:
+    try:
+        return enc(key)
+    except _MixedNumeric:
+        raise OrderKeyError("int key position met a float key")
+
+
+#: everything an encoder call can raise on a schema deviation — batch
+#: callers catch this tuple around a whole-run listcomp instead of
+#: paying a wrapper call per item
+ENCODE_ERRORS = (OrderKeyError, _MixedNumeric, UnicodeError)
+
+#: the batch encoders below additionally surface deviations as the
+#: underlying C-level errors (struct.error is a Exception subclass)
+BATCH_ENCODE_ERRORS = ENCODE_ERRORS + (AttributeError, TypeError,
+                                       struct.error, OverflowError)
+
+_PK = _Q.pack
+_BIAS = 1 << 63
+
+
+def make_batch_encoder(sample_key: Any):
+    """Batch encoder ``fn(keys_list, positions) -> list[bytes]`` where
+    each output is the order encoding of the key plus an 8-byte
+    big-endian position suffix (the EM sort's stability/splitter
+    tiebreak). Flat str/bytes/int schemas run as ONE type-checked
+    listcomp with zero per-item Python dispatch — the per-item closure
+    of :func:`make_encoder` was a profiled hotspot of the spill loop.
+    Other schemas wrap the per-item encoder in a single comp. Returns
+    None when the schema is unsupported; raises a member of
+    ``BATCH_ENCODE_ERRORS`` on any later schema deviation (the caller
+    demotes to the generic merge)."""
+    try:
+        schema = _schema_of(sample_key)
+    except OrderKeyError:
+        return None
+    # exact-type specializations only (a numpy-scalar sample routes to
+    # the per-item branch, which unboxes it); the up-front set(map(type))
+    # pass is one C-level scan that keeps look-alike custom key types
+    # (anything with .encode/.replace) out of the fast comp
+    if schema == "str" and type(sample_key) is str:
+        def f(keys, poss):
+            if set(map(type, keys)) - {str}:
+                raise OrderKeyError("non-str key in str batch")
+            return [k.encode("utf-8").replace(b"\x00", b"\x00\xff")
+                    + b"\x00" + _PK(p)
+                    for k, p in zip(keys, poss)]
+    elif schema == "bytes" and type(sample_key) is bytes:
+        def f(keys, poss):
+            if set(map(type, keys)) - {bytes}:
+                raise OrderKeyError("non-bytes key in bytes batch")
+            return [k.replace(b"\x00", b"\x00\xff") + b"\x00" + _PK(p)
+                    for k, p in zip(keys, poss)]
+    elif schema == "int" and type(sample_key) in (int, bool):
+        def f(keys, poss):
+            if set(map(type, keys)) - {int, bool}:
+                raise OrderKeyError("non-int key in int batch")
+            # struct.error surfaces out-of-int64-range values
+            return [_PK(k + _BIAS) + _PK(p)
+                    for k, p in zip(keys, poss)]
+    else:
+        enc = _encoder_for(schema)
+
+        def f(keys, poss):
+            return [enc(k) + _PK(p) for k, p in zip(keys, poss)]
+    try:
+        got = f([sample_key], [0])          # self-check on the sample
+        per_item = make_encoder(sample_key)
+        if per_item is None or got[0] != per_item(sample_key) + _PK(0):
+            return None
+    except BATCH_ENCODE_ERRORS:
+        return None
+    return f

@@ -102,6 +102,12 @@ class RssBudget:
         self._n += 1
         if self._n % self.stride:
             return False
+        return self.exceeded_now()
+
+    def exceeded_now(self) -> bool:
+        """Unconditional /proc check (~1us) — for BATCH loops, where
+        one call covers thousands of items and the call-count
+        decimation of :meth:`exceeded` would defeat the trigger."""
         if self.grant <= 0 or self.base <= 0:
             return False
         rss = process_rss()
