@@ -177,3 +177,20 @@ def test_multi_host_log_merge():
         assert "cumulative 1.0 MB" in html
         # span = min start .. max end of #2: 3.2s total span
         assert "total span 3.200s" in html
+
+
+def test_stage_table_single_attribution():
+    """Overlapping stage spans (merged multi-host records) must not
+    double-count exchange bytes: each exchange lands in exactly one
+    stage row (the tightest covering span)."""
+    from thrill_tpu.tools.json2profile import _render_stage_table
+    rows = [(1, "outer", 0.0, 10.0, 100),
+            (2, "inner", 2.0, 4.0, 50)]
+    exchanges = [(3.0, {"bytes": 1_000_000}),
+                 (8.0, {"bytes": 2_000_000})]
+    html_out = _render_stage_table(rows, exchanges, {})
+    # inner (starts later, covers t=3) gets 1 MB; outer gets only the
+    # t=8 exchange -> 2 MB. A double-count would show 3 MB on outer.
+    assert "<td>1.00</td>" in html_out
+    assert "<td>2.00</td>" in html_out
+    assert "<td>3.00</td>" not in html_out

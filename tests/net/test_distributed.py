@@ -34,12 +34,14 @@ def _golden_wordcount():
     return sorted(c.items()), len(_TEXT.split()), sorted(_TEXT.split())
 
 
-def _launch_children(nproc, tmp_path, net="tcp"):
-    """Spawn nproc distributed_child.py processes wired for the given
-    control-plane backend ('tcp' = authenticated sockets, 'mpi' = the
-    MPI backend over the strict-rendezvous fake world)."""
-    text_file = tmp_path / "words.txt"
-    text_file.write_text(_TEXT)
+OPS_CHILD = os.path.join(os.path.dirname(__file__),
+                         "ops_sweep_child.py")
+
+
+def _launch_children(nproc, net="tcp", child=CHILD, extra_env=None):
+    """Spawn nproc child processes wired for the given control-plane
+    backend ('tcp' = authenticated sockets, 'mpi' = the MPI backend
+    over the strict-rendezvous fake world)."""
     ports = free_ports(1 + nproc)
     coord_port, net_ports = ports[0], ports[1:]
     coordinator = f"127.0.0.1:{coord_port}"
@@ -54,8 +56,8 @@ def _launch_children(nproc, tmp_path, net="tcp"):
             "PYTHONPATH": repo_root + os.pathsep
             + env.get("PYTHONPATH", ""),
             "THRILL_TPU_SECRET": "test-cluster-secret",
-            "THRILL_TPU_TEST_TEXT": str(text_file),
         })
+        env.update(extra_env or {})
         if net == "mpi":
             env.update({
                 "THRILL_TPU_NET": "mpi",
@@ -68,10 +70,49 @@ def _launch_children(nproc, tmp_path, net="tcp"):
                 "THRILL_TPU_RANK": str(rank),
             })
         procs.append(subprocess.Popen(
-            [sys.executable, CHILD, coordinator, str(rank), str(nproc)],
+            [sys.executable, child, coordinator, str(rank), str(nproc)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, env=env))
     return procs
+
+
+def _drain_results(procs, timeout_s, what):
+    """Concurrently drain every child's pipes (children exit through a
+    collective shutdown barrier, so one child blocked writing into a
+    full stdout pipe would deadlock the whole group), assert success
+    and parse the RESULT lines."""
+    import concurrent.futures as cf
+    with cf.ThreadPoolExecutor(len(procs)) as ex:
+        futs = [ex.submit(p.communicate, None, timeout_s)
+                for p in procs]
+        try:
+            drained = [f.result(timeout=timeout_s + 20) for f in futs]
+        except (cf.TimeoutError, subprocess.TimeoutExpired):
+            for q in procs:
+                q.kill()
+            pytest.fail(f"{what} child timed out")
+    results = []
+    for p, (out, err) in zip(procs, drained):
+        assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, f"no RESULT line:\n{out}\n{err[-2000:]}"
+        results.append(json.loads(lines[-1][len("RESULT "):]))
+    return results
+
+
+@pytest.mark.parametrize("nproc", [2, 3])
+def test_multi_process_ops_sweep(nproc):
+    """The op-surface sweep over REAL processes (round-3 verdict item
+    4): Sort/Reduce/Group/Zip/Window/Concat + mini-fuzz chains on both
+    storages, every rank asserting against Python models in-child and
+    the parent asserting cross-rank agreement of result digests."""
+    procs = _launch_children(nproc, child=OPS_CHILD)
+    results = _drain_results(procs, 300, "ops sweep")
+    r0 = results[0]
+    for r in results[1:]:
+        assert r == r0, "controllers disagree on op results"
+    assert r0["stats_exchanges"] == 1   # the data plane actually moved
+    assert len(r0) >= 13                # every battery entry reported
 
 
 @pytest.mark.parametrize("nproc,net", [(2, "tcp"), (3, "tcp"),
@@ -84,29 +125,12 @@ def test_multi_process_wordcount_agrees(nproc, net, tmp_path):
     including THRILL_TPU_NET=mpi, where the control plane AND the
     multiplexer bulk frames run the MPI backend's byte-frame
     Isend/Irecv data plane across real processes."""
-    procs = _launch_children(nproc, tmp_path, net=net)
-    # drain every child's pipes CONCURRENTLY: children exit through a
-    # collective shutdown barrier, so one child blocked writing into a
-    # full stdout pipe would deadlock the whole group
-    import concurrent.futures as cf
-    outs = []
-    with cf.ThreadPoolExecutor(len(procs)) as ex:
-        futs = [ex.submit(p.communicate, None, 240) for p in procs]
-        try:
-            drained = [f.result(timeout=260) for f in futs]
-        except (cf.TimeoutError, subprocess.TimeoutExpired):
-            for q in procs:
-                q.kill()
-            pytest.fail("distributed child timed out")
-    for p, (out, err) in zip(procs, drained):
-        assert p.returncode == 0, f"child failed:\n{err[-3000:]}"
-        outs.append((out, err))
-
-    results = []
-    for out, err in outs:
-        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
-        assert lines, f"no RESULT line:\n{out}\n{err[-2000:]}"
-        results.append(json.loads(lines[-1][len("RESULT "):]))
+    text_file = tmp_path / "words.txt"
+    text_file.write_text(_TEXT)
+    procs = _launch_children(
+        nproc, net=net,
+        extra_env={"THRILL_TPU_TEST_TEXT": str(text_file)})
+    results = _drain_results(procs, 240, "distributed wordcount")
 
     # per-process traffic counters: each controller counts its OWN
     # sent items, so compare them per rank, not across ranks

@@ -14,7 +14,11 @@ from __future__ import annotations
 
 import numpy as np
 
-#: ASCII whitespace — the same set str.split() treats as separators.
+#: ASCII whitespace ONLY. This is narrower than str.split(): Unicode
+#: whitespace (U+00A0, U+2028, ...) does NOT separate words here, so
+#: UTF-8 text using such separators tokenizes differently from the
+#: host path's line.split(). The byte-level contract is deliberate —
+#: it is what a static-shape device scan can evaluate per byte.
 SEPARATORS = b" \t\n\r\x0b\x0c"
 
 _SEP = np.zeros(256, dtype=bool)
@@ -35,7 +39,13 @@ def find_first_sep(data: bytes) -> int:
 def tokenize_packed(data, max_word: int = 16) -> np.ndarray:
     """Pack every whitespace-delimited word of ``data`` into a
     [n_words, max_word] uint8 matrix (zero padded, clipped at
-    ``max_word`` bytes — matching the device WordCount contract)."""
+    ``max_word`` bytes — matching the device WordCount contract).
+
+    Contract (byte-level, see SEPARATORS): words split on ASCII
+    whitespace only, and clipping at ``max_word`` BYTES may cut a
+    multi-byte UTF-8 sequence mid-character (unpack_words decodes with
+    errors='replace'). ASCII and single-byte-encoded text round-trips
+    exactly; general Unicode text gets byte-truncation semantics."""
     if isinstance(data, (bytes, bytearray, memoryview)):
         a = np.frombuffer(data, dtype=np.uint8)
     else:

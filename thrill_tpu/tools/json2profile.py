@@ -193,10 +193,23 @@ def _render_stage_table(rows, exchanges, nodes) -> str:
     per-worker item counts — 1.0 is perfectly even)."""
     if not rows:
         return ""
+    # attribute each exchange to exactly ONE stage: merged multi-host
+    # records widen stage spans until they overlap, and summing every
+    # exchange into every covering window counted the same bytes in
+    # multiple rows. The tightest (latest-starting) covering stage wins.
+    per_stage_bytes: dict = {}
+    for t, e in exchanges:
+        best = None
+        for nid, _label, start, dur, _items in rows:
+            if start <= t <= start + dur and (
+                    best is None or (start, -dur) > (best[1], -best[2])):
+                best = (nid, start, dur)
+        if best is not None:
+            per_stage_bytes[best[0]] = (per_stage_bytes.get(best[0], 0)
+                                        + (e.get("bytes", 0) or 0))
     trs = []
     for nid, label, start, dur, items in rows:
-        xb = sum(e.get("bytes", 0) or 0 for t, e in exchanges
-                 if start <= t <= start + dur)
+        xb = per_stage_bytes.get(nid, 0)
         rate = f"{items / dur / 1e6:.2f}" if items and dur > 0 else ""
         pw = nodes.get(nid, {}).get("per_worker")
         bal = ""
