@@ -370,3 +370,55 @@ def test_concurrent_stress_many_threads(disp):
             disp.unregister(b)
             a.close()
             b.close()
+
+
+def test_timer_facility_oneshot_recurring_cancel():
+    """AddTimer analog (reference: net/dispatcher.hpp:42-62): recurring
+    while the callback returns True, one-shot via returning False,
+    cancel_timer drops a pending timer."""
+    import threading
+    import time
+    from thrill_tpu.net.dispatcher import Dispatcher
+    disp = Dispatcher(force_py=True)
+    try:
+        fired = []
+        done = threading.Event()
+
+        def recurring():
+            fired.append(time.monotonic())
+            if len(fired) >= 3:
+                done.set()
+                return False            # disarm after 3 firings
+            return True
+
+        disp.add_timer(0.02, recurring)
+        assert done.wait(timeout=10), "recurring timer starved"
+        n_after = len(fired)
+        time.sleep(0.1)
+        assert len(fired) == n_after    # returning False disarmed it
+
+        never = threading.Event()
+        tid = disp.add_timer(5.0, lambda: never.set() or True)
+        disp.cancel_timer(tid)
+        oneshot = threading.Event()
+        disp.add_timer(0.02, lambda: oneshot.set() and False)
+        assert oneshot.wait(timeout=10)
+        assert not never.is_set()
+    finally:
+        disp.close()
+
+
+def test_timer_on_native_engine():
+    """The native engine exposes the same timer surface."""
+    import threading
+    from thrill_tpu.net.dispatcher import Dispatcher, _NativeDispatcher
+    disp = Dispatcher()
+    try:
+        if not isinstance(disp, _NativeDispatcher):
+            import pytest
+            pytest.skip("native engine unavailable")
+        ev = threading.Event()
+        disp.add_timer(0.02, lambda: ev.set() and False)
+        assert ev.wait(timeout=10)
+    finally:
+        disp.close()

@@ -77,14 +77,110 @@ def _load_native() -> Optional[ctypes.CDLL]:
     return _LIB
 
 
+class _TimerFacility:
+    """Timer service shared by both engines (reference:
+    net/dispatcher.hpp:42-62 ``AddTimer``): ``add_timer(period,
+    callback)`` fires ``callback()`` every ``period`` seconds for as
+    long as it returns True; returning False (or ``cancel_timer``)
+    drops it. The reference dispatches timer callbacks on its event
+    loop thread; the native engine's loop is C++, so callbacks here run
+    on ONE dedicated daemon thread per dispatcher — the same
+    serialization guarantee (no two callbacks of one dispatcher run
+    concurrently), started lazily on the first add_timer."""
+
+    def _timer_init(self) -> None:
+        self._tlock = threading.Lock()
+        self._tcv = threading.Condition(self._tlock)
+        self._theap: list = []            # (deadline, tid)
+        self._tcb: Dict[int, Tuple[float, object]] = {}
+        self._tnext = 0
+        self._tstop = False
+        self._tthread: Optional[threading.Thread] = None
+
+    def add_timer(self, period_s: float, callback) -> int:
+        """Schedule ``callback`` every ``period_s`` seconds; returns a
+        timer id for cancel_timer. Re-arms while callback() is true."""
+        import heapq
+        import time
+        with self._tcv:
+            if self._tstop:
+                raise DispatcherError("add_timer on closed dispatcher")
+            tid = self._tnext
+            self._tnext += 1
+            self._tcb[tid] = (float(period_s), callback)
+            heapq.heappush(self._theap,
+                           (time.monotonic() + period_s, tid))
+            if self._tthread is None:
+                self._tthread = threading.Thread(
+                    target=self._timer_run, daemon=True,
+                    name="thrill-tpu-timers")
+                self._tthread.start()
+            self._tcv.notify()
+        return tid
+
+    def cancel_timer(self, tid: int) -> None:
+        with self._tcv:
+            self._tcb.pop(tid, None)
+            self._tcv.notify()
+
+    def _timer_run(self) -> None:
+        import heapq
+        import time
+        while True:
+            with self._tcv:
+                while True:
+                    if self._tstop:
+                        return
+                    now = time.monotonic()
+                    # drop heap entries for cancelled timers
+                    while self._theap and \
+                            self._theap[0][1] not in self._tcb:
+                        heapq.heappop(self._theap)
+                    if self._theap and self._theap[0][0] <= now:
+                        _, tid = heapq.heappop(self._theap)
+                        period, cb = self._tcb[tid]
+                        break
+                    delay = (self._theap[0][0] - now
+                             if self._theap else None)
+                    self._tcv.wait(timeout=delay)
+            # fire OUTSIDE the lock: callbacks may add/cancel timers
+            try:
+                again = bool(cb())
+            except Exception:
+                # a raising timer disarms — LOUDLY, or a dead periodic
+                # task (heartbeat, flush) degrades the system silently
+                import sys
+                import traceback
+                print(f"thrill_tpu: timer {tid} raised and was "
+                      f"disarmed:\n{traceback.format_exc()}",
+                      file=sys.stderr)
+                again = False
+            with self._tcv:
+                if tid not in self._tcb:
+                    continue              # cancelled while firing
+                if again:
+                    heapq.heappush(self._theap,
+                                   (time.monotonic() + period, tid))
+                else:
+                    del self._tcb[tid]
+
+    def _timer_close(self) -> None:
+        with self._tcv:
+            self._tstop = True
+            self._tcv.notify_all()
+        if self._tthread is not None:
+            self._tthread.join(timeout=5)
+
+
 class DispatcherError(ConnectionError):
     pass
 
 
-class _NativeDispatcher:
+class _NativeDispatcher(_TimerFacility):
     """ctypes front for the epoll engine."""
 
     def __init__(self, lib: ctypes.CDLL) -> None:
+        self._timer_init()
         self._lib = lib
         self._h = lib.disp_create()
         if not self._h:
@@ -187,15 +283,17 @@ class _NativeDispatcher:
         return int(self._lib.disp_pending(self._h))
 
     def close(self) -> None:
+        self._timer_close()
         if self._h:
             self._lib.disp_destroy(self._h)
             self._h = None
 
 
-class _PyDispatcher:
+class _PyDispatcher(_TimerFacility):
     """Pure-Python fallback: ``selectors`` loop on a daemon thread."""
 
     def __init__(self) -> None:
+        self._timer_init()
         self._sel = selectors.DefaultSelector()
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -355,6 +453,7 @@ class _PyDispatcher:
                     + sum(len(q) for q in self._reads.values()))
 
     def close(self) -> None:
+        self._timer_close()
         self._stop = True
         self._wake()
         self._thread.join(timeout=5)

@@ -92,27 +92,105 @@ class _S3ReadStream(io.RawIOBase):
             super().close()
 
 
-class _S3WriteStream(io.RawIOBase):
-    """Buffered whole-object PUT on close (small coordination files and
-    per-worker output chunks; multipart upload is a follow-up)."""
+#: streamed PUT switchover: below this, one put_object; above, the
+#: multipart protocol (reference: the streamed PUT path of
+#: thrill/vfs/s3_file.cpp). S3's minimum non-final part size is 5 MiB.
+MULTIPART_PART_SIZE = 8 << 20
 
-    def __init__(self, bucket: str, key: str) -> None:
+
+class _S3WriteStream(io.RawIOBase):
+    """Streamed object writer with an abort-on-error contract.
+
+    Small outputs (< one part) land as a single ``put_object``. Larger
+    ones stream through the multipart protocol — create_multipart_
+    upload, one ``upload_part`` per part_size slice (a single huge
+    write() is sliced too, so parts never exceed part_size and RAM
+    stays bounded), ``complete_multipart_upload`` on a CLEAN close —
+    so output size is bounded by S3's 10,000-part limit, not this
+    process's RAM. ``abort()`` drops a half-written upload (no
+    orphaned parts, no partial object committed); after an abort,
+    writes are silently discarded and close() commits NOTHING —
+    :func:`s3_open_write`'s wrapper aborts on any exception inside a
+    ``with`` block so a failed producer never publishes a truncated
+    object as complete."""
+
+    def __init__(self, bucket: str, key: str,
+                 part_size: int = MULTIPART_PART_SIZE) -> None:
         self._bucket = bucket
         self._key = key
-        self._buf = io.BytesIO()
+        self._part_size = max(int(part_size), 5 << 20)
+        self._pending = bytearray()
+        self._client = _boto3().client("s3")
+        self._upload_id = None
+        self._parts: List[dict] = []
+        self._aborted = False
 
     def writable(self) -> bool:
         return True
 
     def write(self, b) -> int:
-        return self._buf.write(b)
+        if self._aborted:
+            # discard, don't raise: the buffered wrapper's close()
+            # flushes after an abort, and raising here would mask the
+            # exception that CAUSED the abort
+            return len(b)
+        self._pending += b
+        while len(self._pending) >= self._part_size:
+            chunk = bytes(self._pending[:self._part_size])
+            del self._pending[:self._part_size]
+            self._upload_part(chunk)
+        return len(b)
+
+    def _upload_part(self, data: bytes) -> None:
+        if self._upload_id is None:
+            resp = self._client.create_multipart_upload(
+                Bucket=self._bucket, Key=self._key)
+            self._upload_id = resp["UploadId"]
+        num = len(self._parts) + 1
+        resp = self._client.upload_part(
+            Bucket=self._bucket, Key=self._key,
+            UploadId=self._upload_id, PartNumber=num, Body=data)
+        self._parts.append({"ETag": resp["ETag"], "PartNumber": num})
+
+    def abort(self) -> None:
+        """Drop the output: abort any open multipart upload (no
+        orphaned parts) and ensure close() will NOT commit anything."""
+        self._aborted = True
+        self._pending = bytearray()
+        if self._upload_id is not None:
+            try:
+                self._client.abort_multipart_upload(
+                    Bucket=self._bucket, Key=self._key,
+                    UploadId=self._upload_id)
+            finally:
+                self._upload_id = None
 
     def close(self) -> None:
-        if not self.closed:
-            client = _boto3().client("s3")
-            client.put_object(Bucket=self._bucket, Key=self._key,
-                              Body=self._buf.getvalue())
-        super().close()
+        if self.closed:
+            return
+        try:
+            if self._aborted:
+                return                   # nothing is committed
+            if self._upload_id is None:
+                # never crossed a part boundary: single PUT
+                self._client.put_object(Bucket=self._bucket,
+                                        Key=self._key,
+                                        Body=bytes(self._pending))
+            else:
+                try:
+                    if self._pending:    # the (short) final part
+                        self._upload_part(bytes(self._pending))
+                        self._pending = bytearray()
+                    self._client.complete_multipart_upload(
+                        Bucket=self._bucket, Key=self._key,
+                        UploadId=self._upload_id,
+                        MultipartUpload={"Parts": self._parts})
+                    self._upload_id = None
+                except Exception:
+                    self.abort()
+                    raise
+        finally:
+            super().close()
 
 
 def s3_open_read(path: str, offset: int = 0) -> IO[bytes]:
@@ -120,6 +198,21 @@ def s3_open_read(path: str, offset: int = 0) -> IO[bytes]:
     return io.BufferedReader(_S3ReadStream(bucket, key, offset))
 
 
+class _AbortingWriter(io.BufferedWriter):
+    """BufferedWriter whose ``with`` block ABORTS the upload when the
+    body raises: an exception must never publish a truncated object as
+    a complete output (the raw stream then discards the close-flush and
+    commits nothing)."""
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            try:
+                self.raw.abort()
+            except Exception:
+                pass                      # surface the ORIGINAL error
+        return super().__exit__(exc_type, exc, tb)
+
+
 def s3_open_write(path: str) -> IO[bytes]:
     bucket, key = parse_s3_path(path)
-    return io.BufferedWriter(_S3WriteStream(bucket, key))
+    return _AbortingWriter(_S3WriteStream(bucket, key))
