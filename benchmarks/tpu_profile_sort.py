@@ -112,8 +112,9 @@ def main():
 
     # 4. payload gather only: [n, 90] u8 take along axis 0
     f_gather = jax.jit(lambda v, p: jnp.take(v, p, axis=0))
-    dt = timeit(lambda: f_gather(vals_d, perm_d))
-    print(f"RESULT step=gather_90b_u8 time_ms={dt*1000:.1f}", flush=True)
+    dt_gather = timeit(lambda: f_gather(vals_d, perm_d))
+    print(f"RESULT step=gather_90b_u8 time_ms={dt_gather*1000:.1f}",
+          flush=True)
 
     # 4b. payload gather with payload packed as u32 words
     vals_u32 = jax.jit(
@@ -128,6 +129,16 @@ def main():
     dt = timeit(lambda: f_gather(keys_d, perm_d))
     print(f"RESULT step=gather_10b_u8 time_ms={dt*1000:.1f}", flush=True)
 
+    # 4d. HBM-bandwidth utilization (the roofline check the BASELINE.md
+    # analysis needs a measured point for): the payload gather's
+    # traffic model is exact — 90 B random-read + 90 B stream-write
+    # per row — so measured GB/s = 180n/t, derived from step 4's
+    # timing (no re-run: healthy-chip windows are scarce minutes).
+    # Utilization is quoted against v5e-class peak (~820 GB/s).
+    gbs = 180 * n / dt_gather / 1e9
+    print(f"RESULT step=hbm_bandwidth_gather gb_s={gbs:.1f} "
+          f"util_vs_820={gbs / 820:.3f}", flush=True)
+
     # 5. fused whole program (encode + sort + both gathers), like the
     #    W=1 Sort program — A/B over the packed-movement flag
     from thrill_tpu.core.rowmove import take_rows
@@ -137,13 +148,22 @@ def main():
         perm = argsort_words(list(words))
         return take_rows(k, perm), take_rows(v, perm)
 
+    best_fused = None
     for mode in ("1", "0"):
         os.environ["THRILL_TPU_PACK_MOVE"] = mode
         f_all = jax.jit(lambda k, v: fused(k, v))  # fresh trace per mode
         dt = timeit(lambda: f_all(keys_d, vals_d))
+        best_fused = dt if best_fused is None else min(best_fused, dt)
         print(f"RESULT step=fused_sort_gather pack={mode} "
               f"time_ms={dt*1000:.1f} mrec_s={n/dt/1e6:.2f}", flush=True)
     os.environ.pop("THRILL_TPU_PACK_MOVE", None)
+    # modeled traffic for the fused W=1 program (BASELINE.md roofline
+    # rows: ~480 B argsort state + 20 B key gather + 180 B payload
+    # gather ≈ 680 B/row) — softer than the gather-only figure but the
+    # one comparable to the 0.25 s/100 GB floor analysis
+    gbs_f = 680 * n / best_fused / 1e9
+    print(f"RESULT step=hbm_bandwidth_fused_model gb_s={gbs_f:.1f} "
+          f"util_vs_820={gbs_f / 820:.3f}", flush=True)
 
     # 6. per-dispatch overhead through the tunnel (tiny program)
     f_tiny = jax.jit(lambda x: x + 1)

@@ -422,3 +422,20 @@ def test_timer_on_native_engine():
         assert ev.wait(timeout=10)
     finally:
         disp.close()
+
+
+def test_close_from_timer_callback_does_not_raise():
+    """close() called FROM a timer callback (watchdog pattern) must not
+    join the current thread; resources still release."""
+    import threading
+    from thrill_tpu.net.dispatcher import Dispatcher
+    disp = Dispatcher(force_py=True)
+    closed = threading.Event()
+
+    def watchdog():
+        disp.close()                 # runs ON the timer thread
+        closed.set()
+        return False
+
+    disp.add_timer(0.02, watchdog)
+    assert closed.wait(timeout=10), "close() from timer callback hung"

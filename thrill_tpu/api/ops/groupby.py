@@ -183,10 +183,14 @@ class GroupByKeyNode(DIABase):
 
 
 def _group_host_radix_impl(shards, key_fn, group_fn):
-    """CPU-backend grouping: native radix sort + numpy boundary scan
-    (core/host_radix.py), mirroring reduce._host_reduce_shards — the
+    """CPU-backend grouping: native hash-group (one probe pass,
+    core/host_radix.py), mirroring reduce._host_reduce_shards — the
     XLA single-core sort is the wrong engine when device buffers are
-    host memory. Returns None when inapplicable."""
+    host memory, and GroupByKey only needs equal keys ADJACENT, not
+    key-sorted, so the open-addressing table replaces the 4-pass radix
+    argsort (groups come out in first-appearance order, which the
+    GroupByKey contract — like the reference's hash-partitioned
+    grouping — does not constrain). Returns None when inapplicable."""
     import jax
 
     from ...core import host_radix
@@ -211,10 +215,10 @@ def _group_host_radix_impl(shards, key_fn, group_fn):
             tree = jax.tree.unflatten(treedef,
                                       [l[w][:cnt] for l in leaves_np])
             words = keymod.encode_key_words_np(key_fn(tree))
-            perm, same = host_radix.sorted_runs(words)
+            perm, lens = host_radix.hash_group(words)
             srt = [host_radix.gather_rows(np.ascontiguousarray(a), perm)
                    for a in jax.tree.leaves(tree)]
-            bounds = [0] + (np.flatnonzero(~same) + 1).tolist() + [cnt]
+            bounds = [0] + np.cumsum(lens).tolist()
             per_worker.append((cnt, srt, bounds))
     except Exception:
         return None

@@ -168,7 +168,10 @@ class _TimerFacility:
         with self._tcv:
             self._tstop = True
             self._tcv.notify_all()
-        if self._tthread is not None:
+        if (self._tthread is not None
+                and self._tthread is not threading.current_thread()):
+            # join, unless close() was called FROM a timer callback
+            # (the run loop sees _tstop and exits on its own)
             self._tthread.join(timeout=5)
 
 

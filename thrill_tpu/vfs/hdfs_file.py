@@ -78,16 +78,30 @@ def hdfs_glob(path_or_glob: str) -> List[Tuple[str, int]]:
 def hdfs_open_read(path: str, offset: int = 0) -> IO[bytes]:
     host, port, p = parse_hdfs_path(path)
     client = _connect(host, port)
-    f = client.open_input_stream(p)
     if offset:
-        # input streams are sequential; skip to the requested offset
-        remaining = offset
-        while remaining > 0:
-            chunk = f.read(min(remaining, 1 << 20))
-            if not chunk:
-                break
-            remaining -= len(chunk)
-    return f
+        # random-access open + seek: ReadLines' byte-range split opens
+        # every chunk at its offset, and skipping sequentially through
+        # an HDFS stream would re-read the whole prefix per worker
+        f = None
+        try:
+            f = client.open_input_file(p)
+            f.seek(offset)
+            return f
+        except (NotImplementedError, AttributeError):
+            if f is not None:          # opened but seek unsupported:
+                try:                   # close before the fallback or
+                    f.close()          # ReadLines leaks one handle per
+                except Exception:      # byte-range chunk per worker
+                    pass
+            f = client.open_input_stream(p)
+            remaining = offset
+            while remaining > 0:
+                chunk = f.read(min(remaining, 1 << 20))
+                if not chunk:
+                    break
+                remaining -= len(chunk)
+            return f
+    return client.open_input_stream(p)
 
 
 def hdfs_open_write(path: str) -> IO[bytes]:
