@@ -28,8 +28,8 @@ def _apply_ref(ops, data):
             cur = [x for x in cur if x % arg != 0]
         elif op == "sort":
             cur = sorted(cur)
-        elif op == "reduce":
-            acc = {}
+        elif op in ("reduce", "freduce"):   # same semantics, two
+            acc = {}                        # framework spellings
             for x in cur:
                 acc[x % arg] = acc.get(x % arg, 0) + x
             cur = sorted(acc.values())
@@ -63,6 +63,12 @@ def _apply_dia(ops, data, W):
             # model does
             d = d.Map(lambda x, m=arg: (x % m, x)).ReducePair(
                 lambda a, b: a + b).Map(lambda kv: kv[1]).Sort()
+        elif op == "freduce":
+            # declarative spelling: FieldReduce via ReducePair("sum")
+            # (the fused native path at W=1, the jitted functor path
+            # on the mesh) must agree with the generic lambda above
+            d = d.Map(lambda x, m=arg: (x % m, x)).ReducePair(
+                "sum").Map(lambda kv: kv[1]).Sort()
         elif op == "prefix":
             d = d.PrefixSum()
         elif op == "union":
@@ -81,7 +87,8 @@ def _gen_ops(rng):
     n_union = 0
     for _ in range(int(rng.integers(2, 6))):
         kind = str(rng.choice(["map", "filter", "sort", "reduce",
-                               "prefix", "union", "rebalance"]))
+                               "freduce", "prefix", "union",
+                               "rebalance"]))
         if kind == "union":
             if n_union >= 2:                # cap data blowup at 4x
                 continue
@@ -92,8 +99,8 @@ def _gen_ops(rng):
                                 int(rng.integers(-3, 4)))))
         elif kind == "filter":
             ops.append(("filter", int(rng.integers(2, 6))))
-        elif kind == "reduce":
-            ops.append(("reduce", int(rng.integers(2, 10))))
+        elif kind in ("reduce", "freduce"):
+            ops.append((kind, int(rng.integers(2, 10))))
         else:
             ops.append((kind, None))
     return ops

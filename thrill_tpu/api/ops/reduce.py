@@ -498,11 +498,25 @@ def ReduceByKey(dia: DIA, key_fn: Callable, reduce_fn: Callable,
                           dup_detection=dup_detection))
 
 
-def ReducePair(dia: DIA, value_reduce_fn: Callable) -> DIA:
+def ReducePair(dia: DIA, value_reduce_fn) -> DIA:
     """Items are (key, value) pairs; combine values of equal keys.
-    Reference: ReducePair, api/reduce_by_key.hpp."""
+    Reference: ReducePair, api/reduce_by_key.hpp.
+
+    ``value_reduce_fn`` may be a callable, or a declarative op string
+    ("sum"/"min"/"max") — the spelling of the reference's common
+    functors (std::plus, common::minimum) that unlocks the fused
+    native aggregation path (api/functors.py FieldReduce)."""
     def key_fn(kv):
         return kv[0]
+
+    if isinstance(value_reduce_fn, str):
+        from ..functors import FieldReduce
+        red = FieldReduce(("first", value_reduce_fn))
+        # token carries the content-hashed functor, NOT the per-call
+        # key_fn closure — identical specs share compiled executables
+        return DIA(ReduceNode(dia.context, dia._link(), key_fn, red,
+                              label="ReducePair",
+                              token=("ReducePair", red)))
 
     def reduce_fn(a, b):
         return (a[0], value_reduce_fn(a[1], b[1]))
