@@ -163,6 +163,64 @@ def test_inplace_mutating_reduce_fn_still_correct():
     assert got == model
 
 
+@pytest.mark.parametrize("W", [1, 4])
+@pytest.mark.parametrize("red_kind", ["field", "lambda"])
+def test_reduce_to_index_host_engine_parity(W, red_kind, monkeypatch):
+    """The CPU host mirror of ReduceToIndex (ufunc.at scatter for
+    FieldReduce, hash-group + fold for generic fns) must agree with
+    the jitted engine, including neutral fill of untouched indices."""
+    rng = np.random.default_rng(23)
+    n, size = 5000, 300                  # some indices never hit
+    data = {"i": rng.integers(0, size, size=n).astype(np.int64),
+            "v": rng.integers(-9, 9, size=n).astype(np.int64)}
+    if red_kind == "field":
+        red = FieldReduce({"i": "first", "v": "sum"})
+    else:
+        def red(a, b):
+            return {"i": a["i"], "v": a["v"] + b["v"]}
+
+    def run():
+        mex = MeshExec(num_workers=W)
+        ctx = Context(mex)
+        out = ctx.Distribute(data).ReduceToIndex(
+            lambda t: t["i"], red, size,
+            neutral={"i": -1, "v": -77})
+        rows = [(int(r["i"]), int(r["v"])) for r in out.AllGather()]
+        ctx.close()
+        return rows
+
+    host = run()
+    monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "0")
+    jit = run()
+    assert host == jit
+    model = {}
+    for i, v in zip(data["i"].tolist(), data["v"].tolist()):
+        model[i] = model.get(i, 0) + v
+    assert host == [(i if i in model else -1,
+                     model.get(i, -77)) for i in range(size)]
+
+
+def test_reduce_to_index_min_sentinels_never_leak(monkeypatch):
+    """min spec: untouched indices must show the neutral (or 0), never
+    the internal +inf/int-max sentinel — on BOTH engines."""
+    data = {"i": np.array([2, 2, 5], np.int64),
+            "v": np.array([7, 3, 9], np.int64)}
+
+    def run():
+        mex = MeshExec(num_workers=1)
+        ctx = Context(mex)
+        out = ctx.Distribute(dict(data)).ReduceToIndex(
+            lambda t: t["i"], FieldReduce({"i": "first", "v": "min"}),
+            8)
+        rows = [int(r["v"]) for r in out.AllGather()]
+        ctx.close()
+        return rows
+
+    assert run() == [0, 0, 3, 0, 0, 9, 0, 0]
+    monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "0")
+    assert run() == [0, 0, 3, 0, 0, 9, 0, 0]
+
+
 def test_field_reduce_wordcount_matches_counter():
     """End-to-end WordCount (the bench.py configuration, small n) is
     EXACTLY collections.Counter."""

@@ -526,6 +526,146 @@ def ReducePair(dia: DIA, value_reduce_fn) -> DIA:
                           token=("ReducePair", value_reduce_fn)))
 
 
+def _host_reduce_to_index(shards: DeviceShards, index_fn, reduce_fn,
+                          bounds: np.ndarray, neutral):
+    """CPU-backend mirror of ReduceToIndex's dense scatter-reduce (the
+    same engine-selection argument as :func:`_host_reduce_shards`).
+
+    FieldReduce specs run as numpy ufunc.at scatter-accumulations per
+    column (no grouping pass at all); generic reduce functions group
+    via the native hash table + strided fold, then scatter group heads
+    by index. Unset indices fill with ``neutral`` (zeros when None,
+    matching the device program's zero base). Returns None when
+    inapplicable."""
+    from ...core import host_radix
+    from ..functors import FieldReduce, acc_plan
+
+    mex = shards.mesh_exec
+    if not host_radix.eligible(mex):
+        return None
+    leaves, treedef = jax.tree.flatten(shards.tree)
+    leaves_np = [np.asarray(l) for l in leaves]
+    W = mex.num_workers
+    local_sizes = (bounds[1:] - bounds[:-1]).astype(np.int64)
+    neutral_leaves = None
+    if neutral is not None:
+        if jax.tree.structure(neutral) != treedef:
+            # positional pairing below would silently mismatch fields;
+            # the jitted engine raises loudly on this — let it
+            return None
+        neutral_leaves = jax.tree.leaves(neutral)
+    specs = None
+    if isinstance(reduce_fn, FieldReduce):
+        specs = reduce_fn.flat_spec(treedef)
+        if specs is not None:
+            for s, a in zip(specs, leaves_np):
+                if s == "first":
+                    continue         # any shape scatters fine
+                # ufunc.at path needs 1-D numeric columns for the
+                # accumulated fields (per-worker ndim = a.ndim - 1)
+                if acc_plan(s, a.dtype, a.ndim - 1) is None:
+                    specs = None
+                    break
+    per_worker = []
+    try:
+        for w in range(W):
+            cnt = int(shards.counts[w])
+            lo = int(bounds[w])
+            size = int(local_sizes[w])
+            tree = jax.tree.unflatten(treedef,
+                                      [l[w][:cnt] for l in leaves_np])
+            cols = jax.tree.leaves(tree)
+            idx = (np.asarray(index_fn(tree)).astype(np.int64) - lo
+                   if cnt else np.zeros(0, np.int64))
+            if cnt and (idx.min() < 0 or idx.max() >= size):
+                return None          # out-of-range: let the jitted
+                                     # engine's clip semantics apply
+            present = np.zeros(size, dtype=bool)
+            present[idx] = True
+            out_leaves = []
+            if specs is not None:
+                for s, col in zip(specs, cols):
+                    out_leaves.append(
+                        _scatter_field(s, col, idx, size))
+            else:
+                if cnt:
+                    perm, lens = host_radix.hash_group(
+                        [idx.astype(np.uint64)])
+                    gtree = jax.tree.map(
+                        lambda a: host_radix.gather_rows(
+                            np.ascontiguousarray(a), perm), tree)
+                    gtree = _strided_run_fold(
+                        gtree, lens, reduce_fn,
+                        allow_identity_skip=isinstance(reduce_fn,
+                                                       FieldReduce))
+                    starts = np.zeros(len(lens), dtype=np.uint32)
+                    np.cumsum(lens[:-1], dtype=np.uint32,
+                              out=starts[1:])
+                    gidx = idx[perm[starts]]
+                    for col in jax.tree.leaves(gtree):
+                        base = np.zeros((size,) + col.shape[1:],
+                                        col.dtype)
+                        base[gidx] = col
+                        out_leaves.append(base)
+                else:
+                    out_leaves = [np.zeros((size,) + a.shape[2:],
+                                           a.dtype) for a in leaves_np]
+            # fill indices no item mapped to: the neutral value, or 0
+            # (the device program's zero scatter base) — ALWAYS applied
+            # so min/max sentinel fills never leak into the output
+            for i, ol in enumerate(out_leaves):
+                nv = (neutral_leaves[i] if neutral_leaves is not None
+                      else 0)
+                ol[~present] = nv
+            per_worker.append(jax.tree.unflatten(treedef, out_leaves))
+    except host_radix.NativeEngineError:
+        # same loud-fallback policy as _host_reduce_shards: a broken
+        # native engine must not masquerade as slowness
+        import traceback
+        import warnings
+        warnings.warn("native ReduceToIndex engine failed; falling "
+                      "back to the jitted engine:\n"
+                      + traceback.format_exc(), RuntimeWarning)
+        return None
+    except Exception:
+        return None
+    return DeviceShards.from_worker_arrays(mex, per_worker,
+                                           counts=local_sizes)
+
+
+def _scatter_field(op: str, col: np.ndarray, idx: np.ndarray,
+                   size: int) -> np.ndarray:
+    """One FieldReduce column as a dense scatter-accumulate."""
+    if op == "first":
+        out = np.zeros((size,) + col.shape[1:], col.dtype)
+        # reversed assignment: the FIRST occurrence wins
+        out[idx[::-1]] = col[::-1]
+        return out
+    out = np.zeros(size, col.dtype)
+    if op == "sum":
+        np.add.at(out, idx, col)
+        return out
+    if op == "min":
+        out.fill(_type_max(col.dtype))
+        np.minimum.at(out, idx, col)
+    else:
+        out.fill(_type_min(col.dtype))
+        np.maximum.at(out, idx, col)
+    # untouched slots hold sentinels; the caller's neutral fill (or the
+    # zero default) overwrites them via the presence mask
+    return out
+
+
+def _type_max(dt):
+    return (np.inf if np.issubdtype(dt, np.floating)
+            else np.iinfo(dt).max)
+
+
+def _type_min(dt):
+    return (-np.inf if np.issubdtype(dt, np.floating)
+            else np.iinfo(dt).min)
+
+
 class ReduceToIndexNode(DIABase):
     """Key = dense index in [0, size); output is the dense array with
     ``neutral`` at unused indices (reference: api/reduce_to_index.hpp:60)."""
@@ -557,6 +697,11 @@ class ReduceToIndexNode(DIABase):
                         ).astype(jnp.int32)
 
             shards = exchange.exchange(shards, dest, ("r2i_dest", token, W))
+
+        host = _host_reduce_to_index(shards, index_fn, reduce_fn,
+                                     bounds, self.neutral)
+        if host is not None:
+            return host
 
         # dense scatter-reduce into the local index range
         cap = shards.cap
