@@ -308,6 +308,26 @@ def test_em_sort_schema_deviation_mid_stream():
     assert got == sorted(items)
 
 
+def test_order_key_unicode_strings():
+    """UTF-8 byte order equals code-point order: non-ASCII strings must
+    sort identically under the encoding and under Python compare."""
+    keys = (["", "a", "z", "é", "è", "中文",
+             "中", "abcÿ", "abcĀ", "\U0001F600",
+             "￿", "zz"] * 3 + ["café", "cafe", "caf"])
+    _check_order(keys)
+
+
+def test_em_sort_unicode_items():
+    """End-to-end EM sort of non-ASCII strings through the native
+    byte-key engine matches sorted()."""
+    rng = random.Random(6)
+    alphabet = "abéè中\U0001F600z"
+    items = ["".join(rng.choices(alphabet, k=rng.randrange(0, 6)))
+             for _ in range(8000)]
+    got = _em_sort_job(items, 700)
+    assert got == sorted(items)
+
+
 def test_em_sort_duplicate_heavy_stability():
     """Low-cardinality keys: splitters must still cut inside equal-key
     runs (pos suffix), and the native merge must keep stream order
