@@ -141,6 +141,25 @@ def test_malformed_reduce_fn_structure_raises():
     ctx.close()
 
 
+def test_field_reduce_bool_first_leaf_device_engine(monkeypatch):
+    """bool 'first' leaves must work on the segment-op device engine
+    (segment_sum rejects bool; the engine casts through int32)."""
+    monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "0")
+    n = 2000
+    rng = np.random.default_rng(3)
+    data = {"k": rng.integers(0, 9, size=n).astype(np.int64),
+            "b": (rng.integers(0, 2, size=n) == 1),
+            "c": np.ones(n, dtype=np.int64)}
+    red = FieldReduce({"k": "first", "b": "first", "c": "sum"})
+    rows = _run_reduce(1, red, data)
+    model = {}
+    for k, b in zip(data["k"].tolist(), data["b"].tolist()):
+        model.setdefault(int(k), bool(b))      # first occurrence wins
+    got = {int(r["k"]): bool(r["b"]) for r in rows}
+    assert got == model
+    assert sum(int(r["c"]) for r in rows) == n
+
+
 def test_inplace_mutating_reduce_fn_still_correct():
     """A black-box reduce_fn that mutates its left argument in place
     and returns it (``a['c'] += b['c']; return a``) must still produce

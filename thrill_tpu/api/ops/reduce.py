@@ -36,6 +36,19 @@ from ...parallel.mesh import AXIS
 _DUP_REGISTERS = 1 << 17
 
 
+def _device_fold_specs(reduce_fn, treedef, leaves):
+    """Flat FieldReduce specs when the DEVICE segment-op specialization
+    applies (core/segmented.py segmented_reduce_fields), else None."""
+    from ..functors import FieldReduce
+    if not isinstance(reduce_fn, FieldReduce):
+        return None
+    specs = reduce_fn.flat_spec(treedef)
+    if specs is None or not segmented.fields_specializable(
+            specs, [l.dtype for l in leaves]):
+        return None
+    return specs
+
+
 def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
                          reduce_fn: Callable, phase: str,
                          token) -> DeviceShards:
@@ -46,6 +59,7 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
         return out
     cap = shards.cap
     leaves, treedef = jax.tree.flatten(shards.tree)
+    specs = _device_fold_specs(reduce_fn, treedef, leaves)
     key = ("reduce_local", phase, token, cap, treedef,
            tuple((l.dtype, l.shape[2:]) for l in leaves))
 
@@ -56,8 +70,12 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
             words = keymod.encode_key_words(key_fn(tree))
             words, tree, valid, _ = segmented.sort_by_key_words(
                 words, tree, valid)
-            words, tree, rep = segmented.segmented_reduce(
-                words, tree, valid, reduce_fn)
+            if specs is not None:
+                words, tree, rep = segmented.segmented_reduce_fields(
+                    words, tree, valid, specs)
+            else:
+                words, tree, rep = segmented.segmented_reduce(
+                    words, tree, valid, reduce_fn)
             tree, new_count = compact_valid(tree, rep)
             out_leaves = jax.tree.leaves(tree)
             return (new_count[None, None].astype(jnp.int32),
@@ -277,6 +295,7 @@ def _fold_reduce_device(acc: DeviceShards, block: DeviceShards,
     capA, capB = acc.cap, block.cap
     out_cap = round_up_pow2(capA + capB)
     nA = len(leaves_a)
+    specs = _device_fold_specs(reduce_fn, td, leaves_a)
     key = ("reduce_fold", token, capA, capB, out_cap, td,
            tuple((l.dtype, l.shape[2:]) for l in leaves_a))
 
@@ -293,8 +312,12 @@ def _fold_reduce_device(acc: DeviceShards, block: DeviceShards,
             words = keymod.encode_key_words(key_fn(tree))
             words, tree, valid, _ = segmented.sort_by_key_words(
                 words, tree, valid)
-            words, tree, rep = segmented.segmented_reduce(
-                words, tree, valid, reduce_fn)
+            if specs is not None:
+                words, tree, rep = segmented.segmented_reduce_fields(
+                    words, tree, valid, specs)
+            else:
+                words, tree, rep = segmented.segmented_reduce(
+                    words, tree, valid, reduce_fn)
             tree, new_count = compact_valid(tree, rep)
             pad = out_cap - (capA + capB)
             tree = jax.tree.map(

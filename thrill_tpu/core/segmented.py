@@ -79,15 +79,99 @@ def segmented_reduce(words: List[jnp.ndarray], tree: Any,
         return keep_b, flag_a | flag_b
 
     scanned, _ = jax.lax.associative_scan(combine, (tree, starts), axis=0)
-    # representative = last item of each run = position before next start,
-    # or the last valid item overall
+    return words, scanned, _rep_mask(starts, valid)
+
+
+def _rep_mask(starts: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Representative = last item of each run (position before the next
+    start), or the last valid item overall. Shared by both segmented
+    reduce engines so the contract cannot diverge."""
+    n = valid.shape[0]
     next_start = jnp.roll(starts, -1).at[-1].set(True)
     count = jnp.sum(valid.astype(jnp.int32))
     is_last_valid = jnp.arange(n) == count - 1
-    rep = valid & (next_start | is_last_valid)
-    return words, scanned, rep
+    return valid & (next_start | is_last_valid)
 
 
 def _bshape(flag, leaf):
     """Broadcast [n] flag against leaf [n, ...]."""
     return flag.reshape(flag.shape + (1,) * (leaf.ndim - 1))
+
+
+def fields_specializable(flat_specs, leaf_dtypes) -> bool:
+    """Can :func:`segmented_reduce_fields` handle this FieldReduce
+    spec? "first" takes any dtype; "sum" needs numeric (bool addition
+    differs between numpy and the scan's `+`); "min"/"max" need
+    INTEGER dtypes — float segment-min/max via scatter does not
+    guarantee the NaN-propagation order jnp.minimum gives the generic
+    scan, so floats keep the scan."""
+    import numpy as np
+    for s, dt in zip(flat_specs, leaf_dtypes):
+        if s == "first":
+            continue
+        if s == "sum":
+            if not (np.issubdtype(dt, np.integer)
+                    or np.issubdtype(dt, np.floating)):
+                return False
+        elif s in ("min", "max"):
+            if not np.issubdtype(dt, np.integer):
+                return False
+        else:
+            return False
+    return True
+
+
+def segmented_reduce_fields(words: List[jnp.ndarray], tree: Any,
+                            valid: jnp.ndarray, flat_specs
+                            ) -> Tuple[List[jnp.ndarray], Any,
+                                       jnp.ndarray]:
+    """FieldReduce specialization of :func:`segmented_reduce` — same
+    inputs and (words, tree, rep_mask) contract, different engine: each
+    field folds with ONE sorted segment reduction plus one gather
+    instead of the O(log n)-round associative scan over the whole tree.
+    On TPU that is a single scatter pass per field through HBM rather
+    than log2(n) combine rounds; the reference reaches the same shape
+    by accumulating std::plus directly in its probing table.
+
+    "first" is computed as segment_sum of a start-row-masked
+    contribution (each segment receives exactly one addend — its first
+    row — so the sum IS the first value, exactly). Caller gates with
+    :func:`fields_specializable`.
+    """
+    import jax.ops as jops
+
+    n = valid.shape[0]
+    starts = segment_boundaries(words, valid)
+    seg = jnp.clip(jnp.cumsum(starts.astype(jnp.int32)) - 1, 0, n - 1)
+    leaves, td = jax.tree.flatten(tree)
+    out_leaves = []
+    for s, leaf in zip(flat_specs, leaves):
+        v = _bshape(valid, leaf)
+        if s == "first":
+            st = _bshape(starts, leaf)
+            # segment_sum rejects bool; route bools through int32 and
+            # cast back (exactly one addend per segment, so lossless)
+            src = (leaf.astype(jnp.int32) if leaf.dtype == jnp.bool_
+                   else leaf)
+            contrib = jnp.where(st, src, jnp.zeros_like(src))
+            res = jops.segment_sum(contrib, seg, num_segments=n,
+                                   indices_are_sorted=True)
+            if leaf.dtype == jnp.bool_:
+                res = res.astype(jnp.bool_)
+        elif s == "sum":
+            contrib = jnp.where(v, leaf, jnp.zeros_like(leaf))
+            res = jops.segment_sum(contrib, seg, num_segments=n,
+                                   indices_are_sorted=True)
+        elif s == "min":
+            fill = jnp.array(jnp.iinfo(leaf.dtype).max, leaf.dtype)
+            contrib = jnp.where(v, leaf, fill)
+            res = jops.segment_min(contrib, seg, num_segments=n,
+                                   indices_are_sorted=True)
+        else:  # "max"
+            fill = jnp.array(jnp.iinfo(leaf.dtype).min, leaf.dtype)
+            contrib = jnp.where(v, leaf, fill)
+            res = jops.segment_max(contrib, seg, num_segments=n,
+                                   indices_are_sorted=True)
+        out_leaves.append(jnp.take(res, seg, axis=0))
+    return (words, jax.tree.unflatten(td, out_leaves),
+            _rep_mask(starts, valid))
