@@ -70,12 +70,8 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
             words = keymod.encode_key_words(key_fn(tree))
             words, tree, valid, _ = segmented.sort_by_key_words(
                 words, tree, valid)
-            if specs is not None:
-                words, tree, rep = segmented.segmented_reduce_fields(
-                    words, tree, valid, specs)
-            else:
-                words, tree, rep = segmented.segmented_reduce(
-                    words, tree, valid, reduce_fn)
+            words, tree, rep = segmented.reduce_runs(
+                words, tree, valid, reduce_fn, specs)
             tree, new_count = compact_valid(tree, rep)
             out_leaves = jax.tree.leaves(tree)
             return (new_count[None, None].astype(jnp.int32),
@@ -312,12 +308,8 @@ def _fold_reduce_device(acc: DeviceShards, block: DeviceShards,
             words = keymod.encode_key_words(key_fn(tree))
             words, tree, valid, _ = segmented.sort_by_key_words(
                 words, tree, valid)
-            if specs is not None:
-                words, tree, rep = segmented.segmented_reduce_fields(
-                    words, tree, valid, specs)
-            else:
-                words, tree, rep = segmented.segmented_reduce(
-                    words, tree, valid, reduce_fn)
+            words, tree, rep = segmented.reduce_runs(
+                words, tree, valid, reduce_fn, specs)
             tree, new_count = compact_valid(tree, rep)
             pad = out_cap - (capA + capB)
             tree = jax.tree.map(
@@ -732,6 +724,7 @@ class ReduceToIndexNode(DIABase):
         local_sizes = (bounds[1:] - bounds[:-1]).astype(np.int64)
         out_cap = max(1, int(local_sizes.max()))
         neutral = self.neutral
+        specs = _device_fold_specs(reduce_fn, treedef, leaves)
         key = ("r2i_post", token, cap, out_cap, treedef,
                tuple((l.dtype, l.shape[2:]) for l in leaves))
 
@@ -743,8 +736,8 @@ class ReduceToIndexNode(DIABase):
                 words = [idx.astype(jnp.uint64)]
                 words, tree, valid, _ = segmented.sort_by_key_words(
                     words, tree, valid)
-                words, tree, rep = segmented.segmented_reduce(
-                    words, tree, valid, reduce_fn)
+                words, tree, rep = segmented.reduce_runs(
+                    words, tree, valid, reduce_fn, specs)
                 local_idx = (words[0].astype(jnp.int64) - range_start[0, 0])
                 pos = jnp.where(rep, local_idx, out_cap)
                 pos = jnp.clip(pos, 0, out_cap)

@@ -66,7 +66,6 @@ def segmented_reduce(words: List[jnp.ndarray], tree: Any,
     segmented inclusive scan, so ``reduce_fn`` must be associative
     (same contract as the reference's reduce function).
     """
-    n = valid.shape[0]
     starts = segment_boundaries(words, valid)
 
     def combine(a, b):
@@ -96,6 +95,16 @@ def _rep_mask(starts: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
 def _bshape(flag, leaf):
     """Broadcast [n] flag against leaf [n, ...]."""
     return flag.reshape(flag.shape + (1,) * (leaf.ndim - 1))
+
+
+def reduce_runs(words, tree, valid, reduce_fn, specs):
+    """One dispatch point for every device reduce program: the
+    segment-op engine when ``specs`` (from FieldReduce, pre-gated by
+    :func:`fields_specializable`) is available, else the generic
+    associative scan. Same (words, tree, rep) contract either way."""
+    if specs is not None:
+        return segmented_reduce_fields(words, tree, valid, specs)
+    return segmented_reduce(words, tree, valid, reduce_fn)
 
 
 def fields_specializable(flat_specs, leaf_dtypes) -> bool:
