@@ -210,6 +210,59 @@ def _text_wordcount():
             f"({dt * 1000:.0f} ms, {len(got)} keys, golden)")
 
 
+@check("fieldreduce_segment_engine")
+def _fieldreduce_segment_engine():
+    """Round-4 engine A/B on real hardware: the declarative FieldReduce
+    segment-op fold (core/segmented.py segmented_reduce_fields — one
+    scatter pass per field) vs the generic associative scan (O(log n)
+    HBM combine rounds), identical results asserted, speedup reported."""
+    import jax
+
+    from thrill_tpu.api import Context, FieldReduce
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    n = 1 << 19
+    rng = np.random.default_rng(11)
+    data = {"k": rng.integers(0, 4096, size=n).astype(np.int64),
+            "v": rng.integers(0, 1000, size=n).astype(np.int64)}
+    ctx = Context(MeshExec())
+    try:
+        d = ctx.Distribute(data)
+        d.Keep()
+        d.Keep()
+
+        def key_fn(t):          # ONE key_fn object: the executable
+            return t["k"]       # cache token is (key_fn, reduce_fn)
+
+        def run(red):
+            d.Keep()
+            sh = d.ReduceByKey(key_fn, red).node.materialize()
+            jax.block_until_ready(jax.tree.leaves(sh.tree))
+            np.asarray(jax.tree.leaves(sh.tree)[0])[:1]
+            return sh
+
+        def timed(red):
+            run(red)                        # warmup/compile
+            t0 = time.perf_counter()
+            sh = run(red)
+            return time.perf_counter() - t0, sh
+
+        dt_seg, sh_seg = timed(FieldReduce({"k": "first", "v": "sum"}))
+        dt_scan, sh_scan = timed(
+            lambda a, b: {"k": a["k"], "v": a["v"] + b["v"]})
+
+        def pairs(sh):
+            hs = sh.to_host_shards("tpu-check")
+            return sorted((int(it["k"]), int(it["v"]))
+                          for l in hs.lists for it in l)
+
+        assert pairs(sh_seg) == pairs(sh_scan), "engines disagree"
+    finally:
+        ctx.close()
+    return (f"segment={dt_seg*1e3:.0f}ms scan={dt_scan*1e3:.0f}ms "
+            f"speedup={dt_scan/dt_seg:.2f}x (parity)")
+
+
 @check("ragged_all_to_all")
 def _ragged():
     import jax

@@ -160,6 +160,21 @@ def test_field_reduce_bool_first_leaf_device_engine(monkeypatch):
     assert sum(int(r["c"]) for r in rows) == n
 
 
+def test_field_reduce_first_preserves_negative_zero(monkeypatch):
+    """float 'first' on the segment-op engine must be bit-exact: a
+    -0.0 first value keeps its sign bit (the engine bitcasts through
+    uints; a float sum would canonicalize -0.0 + 0.0 -> +0.0)."""
+    monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "0")
+    data = {"k": np.array([1, 1, 2, 2], np.int64),
+            "f": np.array([-0.0, 5.0, 3.0, -0.0], np.float64),
+            "c": np.ones(4, np.int64)}
+    red = FieldReduce({"k": "first", "f": "first", "c": "sum"})
+    rows = _run_reduce(1, red, data)
+    got = {int(r["k"]): float(r["f"]) for r in rows}
+    assert got == {1: -0.0, 2: 3.0}
+    assert np.signbit(got[1]), "-0.0 sign bit lost by the engine"
+
+
 def test_inplace_mutating_reduce_fn_still_correct():
     """A black-box reduce_fn that mutates its left argument in place
     and returns it (``a['c'] += b['c']; return a``) must still produce

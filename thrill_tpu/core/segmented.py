@@ -117,6 +117,11 @@ def fields_specializable(flat_specs, leaf_dtypes) -> bool:
     import numpy as np
     for s, dt in zip(flat_specs, leaf_dtypes):
         if s == "first":
+            # bool/int/uint/float all route through an exact integer
+            # segment_sum (floats via bitcast); complex has no clean
+            # bitcast target — keep the scan for it
+            if np.issubdtype(dt, np.complexfloating):
+                return False
             continue
         if s == "sum":
             if not (np.issubdtype(dt, np.integer)
@@ -158,15 +163,27 @@ def segmented_reduce_fields(words: List[jnp.ndarray], tree: Any,
         v = _bshape(valid, leaf)
         if s == "first":
             st = _bshape(starts, leaf)
-            # segment_sum rejects bool; route bools through int32 and
-            # cast back (exactly one addend per segment, so lossless)
-            src = (leaf.astype(jnp.int32) if leaf.dtype == jnp.bool_
-                   else leaf)
+            # exactly one addend lands in each segment, so segment_sum
+            # IS a select — but only over INTEGERS: bools cast through
+            # int32, and floats BITCAST to same-width uints (a float
+            # sum would canonicalize -0.0 + 0.0 to +0.0, silently
+            # diverging from the scan engine on sign-bit-sensitive
+            # consumers) and bitcast back
+            fdt = leaf.dtype
+            if fdt == jnp.bool_:
+                src = leaf.astype(jnp.int32)
+            elif jnp.issubdtype(fdt, jnp.floating):
+                src = jax.lax.bitcast_convert_type(
+                    leaf, jnp.dtype(f"uint{fdt.itemsize * 8}"))
+            else:
+                src = leaf
             contrib = jnp.where(st, src, jnp.zeros_like(src))
             res = jops.segment_sum(contrib, seg, num_segments=n,
                                    indices_are_sorted=True)
-            if leaf.dtype == jnp.bool_:
+            if fdt == jnp.bool_:
                 res = res.astype(jnp.bool_)
+            elif jnp.issubdtype(fdt, jnp.floating):
+                res = jax.lax.bitcast_convert_type(res, fdt)
         elif s == "sum":
             contrib = jnp.where(v, leaf, jnp.zeros_like(leaf))
             res = jops.segment_sum(contrib, seg, num_segments=n,
