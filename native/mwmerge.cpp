@@ -157,6 +157,9 @@ int64_t mwm_next(void* h, uint32_t* out_runs, int64_t out_cap,
   if (!m) return -1;
   if (!m->started) {
     m->started = true;
+    // Re-entry after an aborted start would re-push runs already in
+    // the heap and duplicate rows; start from an empty heap always.
+    m->heap.clear();
     for (int32_t r = 0;
          r < static_cast<int32_t>(m->runs.size()); ++r) {
       Chunk& c = m->runs[r];

@@ -279,3 +279,12 @@ def test_field_reduce_wordcount_matches_counter():
     got = {"".join(map(chr, np.asarray(r["w"]))): int(r["c"])
            for r in rows}
     assert got == dict(cres)
+
+
+def test_field_reduce_structure_mismatch_is_descriptive():
+    """ReducePair("sum") over pytree values (round-4 advisor): the
+    structure mismatch must raise an actionable TypeError naming
+    FieldReduce, not jax.tree.map's internal ValueError."""
+    red = FieldReduce(("first", "sum"))
+    with pytest.raises(TypeError, match="FieldReduce spec structure"):
+        red(("k", {"a": 1, "b": 2}), ("k", {"a": 3, "b": 4}))

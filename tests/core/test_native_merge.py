@@ -335,3 +335,62 @@ def test_em_sort_duplicate_heavy_stability():
     items = [f"k{v % 3}" for v in range(9000)]
     got = _em_sort_job(items, 700)
     assert got == sorted(items)
+
+
+def test_native_merge_aborted_start_no_duplicates():
+    """C-API latent trap (round-4 advisor): if the lazy-start loop in
+    mwm_next aborts because a run's first chunk is empty-non-final,
+    runs already pushed must not be pushed AGAIN on re-entry — that
+    would emit duplicate rows. The Python driver never produces an
+    empty non-final first chunk, so this drives the C API directly."""
+    import ctypes
+
+    from thrill_tpu.core import native_merge
+
+    lib = native_merge._load()
+    assert lib is not None          # module-level skipif guards this
+
+    handle = lib.mwm_create(2)
+    assert handle
+
+    def set_chunk(r, keys, final):
+        blob = b"".join(keys)
+        offs = np.zeros(len(keys) + 1, dtype=np.int64)
+        np.cumsum([len(k) for k in keys], out=offs[1:])
+        rc = lib.mwm_set_chunk(
+            handle, r, len(keys),
+            offs.ctypes.data_as(ctypes.c_void_p),
+            ctypes.cast(ctypes.c_char_p(blob), ctypes.c_void_p),
+            1 if final else 0)
+        assert rc == 0
+        return offs, blob          # keep buffers alive for the call
+
+    out_runs = np.empty(16, dtype=np.uint32)
+    out_offs = np.empty(17, dtype=np.int64)
+    out_blob = ctypes.create_string_buffer(1 << 12)
+    need = ctypes.c_int32(-1)
+
+    def step():
+        cnt = lib.mwm_next(
+            handle, out_runs.ctypes.data_as(ctypes.c_void_p), 16,
+            ctypes.byref(need),
+            out_offs.ctypes.data_as(ctypes.c_void_p), out_blob, 1 << 12)
+        assert cnt >= 0
+        blob = ctypes.string_at(out_blob, int(out_offs[cnt]) if cnt else 0)
+        return [(int(out_runs[i]), blob[out_offs[i]:out_offs[i + 1]])
+                for i in range(cnt)]
+
+    try:
+        keep = []
+        # run 0 has data, run 1's first chunk is empty NON-final: the
+        # start loop (index order) pushes run 0, then aborts at run 1
+        # with run 0 LEFT IN THE HEAP — re-entry must not push it again
+        keep.append(set_chunk(0, [b"a", b"c"], final=True))
+        keep.append(set_chunk(1, [], final=False))
+        assert step() == [] and need.value == 1
+        keep.append(set_chunk(1, [b"b", b"d"], final=True))
+        got = step()
+        assert got == [(0, b"a"), (1, b"b"), (0, b"c"), (1, b"d")]
+        assert need.value == -1 and lib.mwm_done(handle)
+    finally:
+        lib.mwm_destroy(handle)

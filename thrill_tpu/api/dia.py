@@ -60,7 +60,13 @@ class DIA:
         """``dup_detection`` (reference: DuplicateDetectionTag) skips
         shuffling globally-unique keys — host-storage path only; the
         device path ignores it (its pre-reduce already bounds shuffle
-        volume at one item per local distinct key)."""
+        volume at one item per local distinct key).
+
+        Output order is UNSPECIFIED (as in the reference's
+        hash-partitioned tables): the device engine emits key-sorted
+        order, the CPU-backend native hash-group emits
+        first-appearance order — sort before comparing across
+        backends."""
         from .ops import reduce as _r
         return _r.ReduceByKey(self, key_fn, reduce_fn, dup_detection)
 
@@ -76,6 +82,10 @@ class DIA:
 
     def GroupByKey(self, key_fn: Callable, group_fn: Callable = None,
                    device_fn: Callable = None) -> "DIA":
+        """Group order is UNSPECIFIED (reference: hash-partitioned
+        grouping): the device engine yields key-sorted groups, the
+        CPU-backend hash-group yields first-appearance order — sort
+        before comparing across backends."""
         from .ops import groupby
         return groupby.GroupByKey(self, key_fn, group_fn,
                                   device_fn=device_fn)

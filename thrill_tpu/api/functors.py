@@ -64,7 +64,28 @@ class FieldReduce:
                 return jnp.minimum(x, y) if op == "min" else jnp.maximum(x, y)
             return np.minimum(x, y) if op == "min" else np.maximum(x, y)
 
-        return jax.tree.map(comb, self.spec, a, b)
+        try:
+            return jax.tree.map(comb, self.spec, a, b)
+        except (ValueError, TypeError) as e:
+            # A spec/item structure mismatch surfaces either as
+            # tree.map's ValueError or — because the spec is the
+            # structure argument and item subtrees then reach comb
+            # whole — as a TypeError from `dict + dict` deep inside a
+            # jitted engine, with no hint of which functor. Translate
+            # to an actionable API error (ReducePair("sum") on pytree
+            # values is the common way here); errors with MATCHING
+            # structures are real and re-raise unchanged.
+            spec_td = jax.tree.structure(self.spec)
+            td_a, td_b = jax.tree.structure(a), jax.tree.structure(b)
+            if td_a == spec_td and td_b == spec_td:
+                raise
+            raise TypeError(
+                f"FieldReduce spec structure {spec_td} does not match "
+                f"the item structure "
+                f"{td_a if td_a != spec_td else td_b}; for "
+                f"ReducePair with a string op the value must be a single "
+                f"leaf — pass an explicit FieldReduce spec mirroring the "
+                f"item tree instead") from e
 
     def flat_spec(self, treedef):
         """Per-leaf op strings in ``treedef``'s leaf order, or None if

@@ -185,6 +185,13 @@ def segmented_reduce_fields(words: List[jnp.ndarray], tree: Any,
             elif jnp.issubdtype(fdt, jnp.floating):
                 res = jax.lax.bitcast_convert_type(res, fdt)
         elif s == "sum":
+            # Float sums mask invalid rows to +0.0, which IEEE adds
+            # as identity EXCEPT for the sign of zero: a group whose
+            # true sum is -0.0 comes back +0.0 here (the scan engine,
+            # folding only real rows, preserves -0.0). Accepted
+            # divergence — the unordered-reduce contract never
+            # promised sign-of-zero, and excluding float sums would
+            # forfeit the specialization for the dominant use case.
             contrib = jnp.where(v, leaf, jnp.zeros_like(leaf))
             res = jops.segment_sum(contrib, seg, num_segments=n,
                                    indices_are_sorted=True)

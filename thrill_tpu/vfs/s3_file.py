@@ -151,6 +151,14 @@ class _S3WriteStream(io.RawIOBase):
             Bucket=self._bucket, Key=self._key,
             UploadId=self._upload_id, PartNumber=num, Body=data)
         self._parts.append({"ETag": resp["ETag"], "PartNumber": num})
+        # S3 caps uploads at 10,000 parts. Past the half-way mark,
+        # double the part size every 500 parts (the reference likewise
+        # grows part size with the object): 500 parts at each of
+        # 16 MiB..5 GiB covers S3's 5 TiB object maximum before part
+        # 10,000, while the in-RAM pending buffer (one part) grows
+        # only as the object actually does. 5 GiB is S3's per-part max.
+        if num >= 5000 and num % 500 == 0 and self._part_size < (5 << 30):
+            self._part_size = min(self._part_size * 2, 5 << 30)
 
     def abort(self) -> None:
         """Drop the output: abort any open multipart upload (no
