@@ -35,6 +35,12 @@ from ..dia_base import DIABase
 
 
 class GroupByKeyNode(DIABase):
+    # grouping wants workspace (reference: GroupByKey registers
+    # DIAMemUse::Max for its sort-and-spill buffer,
+    # api/group_by_key.hpp); the host path sizes its EM group buffer
+    # from the grant, the device paths bound memory by construction
+    MEM_USE = "max"
+
     def __init__(self, ctx, link, key_fn: Callable, group_fn: Callable,
                  device_fn: Optional[Callable] = None) -> None:
         super().__init__(ctx, "GroupByKey", [link])
@@ -59,17 +65,54 @@ class GroupByKeyNode(DIABase):
             raise ValueError(
                 "GroupByKey over host storage requires group_fn "
                 "(device_fn needs columnar device shards)")
+        from ...core.em_table import EMGroupBuffer
         from ...data import multiplexer
+        from ...data.block_pool import spill_pool
+        # hash and hashable key computed ONCE per item and carried
+        # through the exchange as (h, k, item) — the shuffle dest and
+        # the group buffer reuse them (the reduce path's carry scheme).
+        # When this node owns its input, each source list is released
+        # as soon as its decorated copy exists, so decoration never
+        # doubles peak RAM (Sort's release discipline).
+        owns_input = self.parents[0].node.state == "DISPOSED"
+        pre_lists = []
+        for lst in shards.lists:
+            pre_lists.append([(hashing.stable_host_hash(
+                kh := _hashable(key_fn(it))), kh, it) for it in lst])
+            if owns_input:
+                lst.clear()
+        pre = HostShards(W, pre_lists)
+        del pre_lists
         shards = multiplexer.host_exchange(
-            self.context.mesh_exec, shards,
-            lambda it: hashing.stable_host_hash(key_fn(it)),
+            self.context.mesh_exec, pre, lambda t: t[0],
             reason="groupby")
+        # grouping phase is memory-bounded: over the negotiated grant,
+        # the buffer spills (hash, seq)-sorted runs and the emit merges
+        # them so each group streams through RAM (reference:
+        # api/group_by_key.hpp:188-216 sorted-run spill + multiway
+        # merge); with no spill this is the historical dict path
+        pool = spill_pool(self.context.config.spill_dir,
+                          self.mem_limit)
+        stats: dict = {}
         out = []
-        for items in shards.lists:
-            groups = {}
-            for it in items:
-                groups.setdefault(_hashable(key_fn(it)), []).append(it)
-            out.append([self.group_fn(k, vs) for k, vs in groups.items()])
+        try:
+            for items in shards.lists:
+                buf = EMGroupBuffer(pool, self.mem_limit,
+                                    stats=stats or None)
+                stats = buf.stats
+                for h, k, it in items:
+                    buf.add(k, it, h=h)
+                items.clear()    # exchange output is ours: free as we go
+                out.append([self.group_fn(k, vs)
+                            for k, vs in buf.groups()])
+                buf.close()
+        finally:
+            pool.close()
+        self._em_stats = stats
+        if stats.get("spills") and self.context.logger.enabled:
+            self.context.logger.line(event="groupby_spill",
+                                     node=self.label, dia_id=self.id,
+                                     **stats)
         return HostShards(W, out)
 
     # -- device phases --------------------------------------------------
@@ -198,6 +241,13 @@ def _group_host_radix_impl(shards, key_fn, group_fn):
     mex = shards.mesh_exec
     if not host_radix.eligible(mex):
         return None
+    # this path itemizes into host lists without going through
+    # to_host_shards — log the storage demotion with the same event so
+    # the DEVICE_COVERAGE audit sees every device->host transition
+    log = getattr(mex, "logger", None)
+    if log is not None and log.enabled:
+        log.line(event="device_to_host", reason="groupbykey-group-fn",
+                 items=int(shards.counts.sum()))
     leaves, treedef = jax.tree.flatten(shards.tree)
     leaves_np = [np.asarray(l) for l in leaves]
     W = mex.num_workers

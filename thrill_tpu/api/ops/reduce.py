@@ -329,6 +329,12 @@ def _fold_reduce_device(acc: DeviceShards, block: DeviceShards,
 
 
 class ReduceNode(DIABase):
+    # both phase tables want workspace (reference: ReduceByKey registers
+    # DIAMemUse::Max for its pre/post tables, api/reduce_by_key.hpp);
+    # the host path sizes its EM tables from the grant, the device path
+    # bounds memory by construction and leaves the grant unused
+    MEM_USE = "max"
+
     def __init__(self, ctx, link, key_fn: Callable, reduce_fn: Callable,
                  label: str = "ReduceByKey",
                  dup_detection: bool = False, token=None) -> None:
@@ -444,24 +450,38 @@ class ReduceNode(DIABase):
         W = shards.num_workers
         mex = self.context.mesh_exec
         key_fn, reduce_fn = self.key_fn, self.reduce_fn
+        from ...core.em_table import EMReduceTable
         from ...data import multiplexer
+        from ...data.block_pool import spill_pool
+        owns_input = self.parents[0].node.state == "DISPOSED"
         # pre-phase per worker (local combine cuts shuffle volume, the
-        # reference's ReducePrePhase table)
-        pre_tables = []
-        for items in shards.lists:
-            table = {}
-            for it in items:
+        # reference's ReducePrePhase table). Deliberately NOT
+        # grant-flushed: the input it folds is already RAM-resident, so
+        # the table's footprint is bounded by the input itself (at most
+        # one folded aggregate per distinct key), while flushing
+        # partials to the outgoing list — the in-RAM analog of the
+        # reference's flush-to-NETWORK (core/reduce_pre_phase.hpp) —
+        # would regress high-duplication workloads from O(distinct) to
+        # O(items) decorated tuples in RAM and on the wire (round-5
+        # review). The grant-bounded EM machinery lives in the POST
+        # phase below, where spills leave RAM for the block store.
+        pre_entries: List[list] = []      # per worker: [(k, v), ...]
+        for lst in shards.lists:
+            table: dict = {}
+            for it in lst:
                 k = key_fn(it)
                 table[k] = reduce_fn(table[k], it) if k in table else it
-            pre_tables.append(table)
-        # one hash per key, computed once and carried with the item
+            pre_entries.append(list(table.items()))
+            if owns_input:
+                lst.clear()       # spill-free analog of Sort's release
+        # one hash per entry, computed once and carried with the item
         # through detection, keep-check and the shuffle dest
-        pre_hashes = [{k: hashing.stable_host_hash(k) for k in t}
-                      for t in pre_tables]
+        pre_hashes = [[hashing.stable_host_hash(k) for k, _ in entries]
+                      for entries in pre_entries]
         non_unique = None
         if self.dup_detection and W > 1:
             from ...core import duplicate_detection as dd
-            hash_lists = [list(ph.values()) for ph in pre_hashes]
+            hash_lists = pre_hashes
             if multiplexer.multiprocess(mex):
                 # fingerprint exchange over the control plane: ship the
                 # hashes (not the items) so every process agrees on the
@@ -487,23 +507,44 @@ class ReduceNode(DIABase):
             return h % W
 
         pre_lists = []
-        for w, table in enumerate(pre_tables):
+        for w, entries in enumerate(pre_entries):
+            hs = pre_hashes[w]
             lst = []
-            for k, v in table.items():
-                h = pre_hashes[w][k]
+            for (k, v), h in zip(entries, hs):
                 keep = None
                 if non_unique is not None and dd.is_unique(h, non_unique):
                     keep = w              # globally unique: stays local
                 lst.append((keep, h, k, v))
+            entries.clear()
             pre_lists.append(lst)
+        del pre_entries, pre_hashes
         ex = multiplexer.host_exchange(mex, HostShards(W, pre_lists),
                                        dest, reason="reduce")
+        # post-phase: EM reduce tables sized by the grant — spilled
+        # partitions re-reduce recursively, so distinct keys beyond the
+        # grant stream through bounded RAM (reference:
+        # core/reduce_by_hash_post_phase.hpp:44-120)
+        pool = spill_pool(self.context.config.spill_dir,
+                          self.mem_limit)
+        stats: dict = {}
         post_lists = []
-        for items in ex.lists:
-            t: dict = {}
-            for _, _, k, v in items:
-                t[k] = reduce_fn(t[k], v) if k in t else v
-            post_lists.append(list(t.values()))
+        try:
+            for items in ex.lists:
+                t = EMReduceTable(reduce_fn, pool, self.mem_limit,
+                                  stats=stats or None)
+                stats = t.stats
+                for _, h, k, v in items:
+                    t.insert(k, v, h)
+                items.clear()    # exchange output is ours: free as we go
+                post_lists.append(list(t.emit()))
+                t.close()
+        finally:
+            pool.close()
+        self._em_stats = stats
+        if stats.get("spills") and self.context.logger.enabled:
+            self.context.logger.line(event="reduce_post_spill",
+                                     node=self.label, dia_id=self.id,
+                                     **stats)
         return HostShards(W, post_lists)
 
 

@@ -1,0 +1,284 @@
+"""External-memory host tables for ReduceByKey / GroupByKey.
+
+Reference: thrill/core/reduce_by_hash_post_phase.hpp:44-120 — the post
+table splits into partitions, spills the fullest partition's items to a
+data::File when over the memory budget, and on PushData re-reduces each
+spilled partition RECURSIVELY (deeper hash bits, smaller slices) until
+a slice fits in RAM. GroupByKey's analog (api/group_by_key.hpp:188-216)
+spills (key-)sorted runs and multiway-merges them so each group streams.
+
+TPU-native framing: these are the HOST-storage backstops. The device
+engines bound memory by construction (fixed-cap shards, segment ops);
+host Python dicts do not — so the host reduce/group phases get the same
+spill ladder Sort already has (api/ops/sort.py _em_sort): a negotiated
+RAM grant (api/context.py negotiate_mem) sizes a deterministic entry
+cap from one pickled sample, with /proc RSS growth (mem/manager.py
+RssBudget) as ground-truth backstop, and the block store
+(data/block_pool.py) absorbs spills RAM-first, disk beyond its soft
+limit.
+
+Hash-partition recursion uses DISJOINT 4-bit slices of the 64-bit
+stable host hash per depth (top bits first), so a re-reduced partition
+re-splits 16 ways on fresh bits; at MAX_DEPTH (48 consumed bits) a
+slice holds only hash-colliding distinct keys — vanishing for 64-bit
+hashes — and stays in RAM unconditionally.
+"""
+
+from __future__ import annotations
+
+import heapq
+import os
+import pickle
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..common import hashing
+from ..data.file import File
+from ..mem.manager import RssBudget
+
+PARTITION_BITS = 4
+NUM_PARTITIONS = 1 << PARTITION_BITS
+MAX_DEPTH = 12
+
+#: test hook — forces the deterministic in-RAM entry cap regardless of
+#: the negotiated grant (the analog of THRILL_TPU_HOST_SORT_RUN)
+_CAP_ENV = "THRILL_TPU_HOST_TABLE_CAP"
+
+
+def entry_cap(mem_limit: int, sample: Any, floor: int = 64) -> int:
+    """In-RAM entry budget for one host table: the negotiated grant
+    over one pickled sample's size (the reference sizes its table from
+    the DIAMemUse grant over sizeof(KeyValuePair) the same way,
+    reduce_by_hash_post_phase.hpp:44). Estimates, not truth — RssBudget
+    backstops the difference."""
+    env = os.environ.get(_CAP_ENV)
+    if env:
+        return max(int(env), 8)
+    if not mem_limit:
+        return 1 << 22
+    try:
+        est = len(pickle.dumps(
+            sample, protocol=pickle.HIGHEST_PROTOCOL)) + 96
+    except Exception:
+        est = 256
+    return max(floor, min(mem_limit // est, 1 << 26))
+
+
+def _new_stats() -> Dict[str, int]:
+    return {"spills": 0, "spilled_entries": 0, "max_depth": 0,
+            "peak_entries": 0}
+
+
+class EMReduceTable:
+    """Memory-bounded reducing hash table with recursive re-reduce.
+
+    ``insert`` folds (key, value) under ``reduce_fn``; when the in-RAM
+    entry count passes the cap (or RSS passes the grant), the fullest
+    partitions spill to block-store Files. ``emit`` yields the reduced
+    values partition by partition, re-reducing spilled partitions
+    through child tables keyed on deeper hash bits — working memory
+    stays one table slice regardless of total distinct keys.
+
+    Values inserted may themselves be partial aggregates (the post
+    phase receives pre-reduced rows); associativity of ``reduce_fn``
+    makes re-reducing spilled partials exact.
+    """
+
+    def __init__(self, reduce_fn: Callable[[Any, Any], Any], pool,
+                 mem_limit: Optional[int], depth: int = 0,
+                 stats: Optional[Dict[str, int]] = None) -> None:
+        self.reduce_fn = reduce_fn
+        self.pool = pool
+        self.mem_limit = int(mem_limit or 0)
+        self.depth = depth
+        self.tables: List[dict] = [dict() for _ in range(NUM_PARTITIONS)]
+        self.files: List[Optional[File]] = [None] * NUM_PARTITIONS
+        self.stats = _new_stats() if stats is None else stats
+        if depth > self.stats["max_depth"]:
+            self.stats["max_depth"] = depth
+        self.budget = RssBudget(self.mem_limit)
+        self.cap: Optional[int] = None
+        self.n = 0
+
+    def _pidx(self, h: int) -> int:
+        shift = 64 - PARTITION_BITS * (self.depth + 1)
+        return (h >> shift) & (NUM_PARTITIONS - 1)
+
+    def insert(self, key, value, h: Optional[int] = None) -> None:
+        if h is None:
+            h = hashing.stable_host_hash(key)
+        t = self.tables[self._pidx(h)]
+        cur = t.get(key)
+        if cur is not None:
+            t[key] = (h, self.reduce_fn(cur[1], value))
+            # the combine path must ALSO watch real memory: aggregates
+            # that grow (list/str concatenation, set union) blow the
+            # grant with a constant entry count (round-5 reviewer)
+            if self.depth < MAX_DEPTH and self.n >= 16 \
+                    and self.budget.exceeded():
+                self._spill_over_budget()
+            return
+        if self.cap is None:
+            self.cap = entry_cap(self.mem_limit, (key, value))
+        t[key] = (h, value)
+        self.n += 1
+        if self.n > self.stats["peak_entries"]:
+            self.stats["peak_entries"] = self.n
+        if self.depth < MAX_DEPTH and self.n >= 16 and (
+                self.n >= self.cap or self.budget.exceeded()):
+            self._spill_over_budget()
+
+    def _spill_over_budget(self) -> None:
+        """Spill fullest partitions until under half the cap — fewer,
+        larger writes than the reference's one-partition-per-overflow,
+        same invariant (reference: SpillAnyPartition,
+        reduce_by_hash_post_phase.hpp:92). ALWAYS spills at least the
+        fullest partition: an RSS-triggered call may arrive with few
+        entries whose aggregates grew huge — the entry-count target
+        alone would make it a no-op and the grant would keep blowing."""
+        target = max((self.cap or 64) // 2, 8)
+        order = sorted(range(NUM_PARTITIONS),
+                       key=lambda p: -len(self.tables[p]))
+        spilled_any = False
+        for p in order:
+            if spilled_any and self.n <= target:
+                break
+            t = self.tables[p]
+            if not t:
+                break
+            f = self.files[p]
+            if f is None:
+                f = self.files[p] = File(pool=self.pool)
+            with f.writer() as w:
+                for k, (h, v) in t.items():
+                    w.put((h, k, v))
+            self.stats["spills"] += 1
+            self.stats["spilled_entries"] += len(t)
+            self.n -= len(t)
+            t.clear()
+            spilled_any = True
+        self.budget.reset()
+
+    def emit(self) -> Iterator[Any]:
+        """Yield every reduced value exactly once. RAM-only partitions
+        stream straight out; spilled ones flush their RAM remainder and
+        re-reduce through a depth+1 child table."""
+        for p in range(NUM_PARTITIONS):
+            t = self.tables[p]
+            f = self.files[p]
+            if f is None:
+                for (_h, v) in t.values():
+                    yield v
+                self.n -= len(t)
+                t.clear()
+                continue
+            if t:
+                with f.writer() as w:
+                    for k, (h, v) in t.items():
+                        w.put((h, k, v))
+                self.n -= len(t)
+                t.clear()
+            child = EMReduceTable(self.reduce_fn, self.pool,
+                                  self.mem_limit, self.depth + 1,
+                                  self.stats)
+            for h, k, v in f.consume_reader():
+                child.insert(k, v, h)
+            f.clear()
+            self.files[p] = None
+            yield from child.emit()
+            child.close()
+
+    def close(self) -> None:
+        for t in self.tables:
+            t.clear()
+        for f in self.files:
+            if f is not None:
+                f.clear()
+        self.files = [None] * NUM_PARTITIONS
+        self.n = 0
+
+
+def _run_order(row: Tuple[int, int, Any, Any]) -> Tuple[int, int]:
+    return (row[0], row[1])
+
+
+class EMGroupBuffer:
+    """Arrival-order-preserving grouping with sorted-run spill.
+
+    ``add`` buffers (hash, seq, key, item) rows; over budget, the
+    buffer spills as a (hash, seq)-sorted run. ``groups`` yields
+    ``(key, [items])`` per distinct key: with no spills, straight from
+    an insertion-ordered dict (identical to the historical in-RAM
+    path); with spills, a k-way merge of the runs on (hash, seq) makes
+    all rows of one hash adjacent — one hash bucket (almost always one
+    group) is materialized at a time, and the seq tiebreak keeps each
+    group's items in ARRIVAL order across runs. The analog of the
+    reference's sorted-run spill + multiway merge
+    (api/group_by_key.hpp:188-216); working memory is one run buffer
+    plus the largest single group.
+    """
+
+    def __init__(self, pool, mem_limit: Optional[int],
+                 stats: Optional[Dict[str, int]] = None) -> None:
+        self.pool = pool
+        self.mem_limit = int(mem_limit or 0)
+        self.rows: List[Tuple[int, int, Any, Any]] = []
+        self.runs: List[File] = []
+        self.seq = 0
+        self.cap: Optional[int] = None
+        self.budget = RssBudget(self.mem_limit)
+        self.stats = _new_stats() if stats is None else stats
+
+    def add(self, key, item, h: Optional[int] = None) -> None:
+        if h is None:
+            h = hashing.stable_host_hash(key)
+        if self.cap is None:
+            self.cap = entry_cap(self.mem_limit, (h, 0, key, item))
+        self.rows.append((h, self.seq, key, item))
+        self.seq += 1
+        if len(self.rows) > self.stats["peak_entries"]:
+            self.stats["peak_entries"] = len(self.rows)
+        if len(self.rows) >= 16 and (len(self.rows) >= self.cap
+                                     or self.budget.exceeded()):
+            self._spill()
+
+    def _spill(self) -> None:
+        # (hash, seq) sort: pure int compares, items never touched
+        self.rows.sort(key=_run_order)
+        f = File(pool=self.pool)
+        with f.writer() as w:
+            for r in self.rows:
+                w.put(r)
+        self.runs.append(f)
+        self.stats["spills"] += 1
+        self.stats["spilled_entries"] += len(self.rows)
+        self.rows = []
+        self.budget.reset()
+
+    def groups(self) -> Iterator[Tuple[Any, List[Any]]]:
+        if not self.runs:
+            g: dict = {}
+            for _h, _s, k, v in self.rows:
+                g.setdefault(k, []).append(v)
+            self.rows = []
+            yield from g.items()
+            return
+        if self.rows:
+            self._spill()
+        stream = heapq.merge(*[f.consume_reader() for f in self.runs],
+                             key=_run_order)
+        bucket_h: Optional[int] = None
+        bucket: dict = {}
+        for h, _s, k, v in stream:
+            if h != bucket_h and bucket:
+                yield from bucket.items()
+                bucket = {}
+            bucket_h = h
+            bucket.setdefault(k, []).append(v)
+        if bucket:
+            yield from bucket.items()
+
+    def close(self) -> None:
+        for f in self.runs:
+            f.clear()
+        self.runs = []
+        self.rows = []

@@ -63,6 +63,16 @@ def _load_native() -> Optional[ctypes.CDLL]:
         return _LIB
 
 
+def spill_pool(spill_dir: str, mem_limit) -> "BlockPool":
+    """The EM operators' shared spill-store sizing policy: keep a
+    quarter of the negotiated grant resident before evicting to disk
+    (floor 8 MiB; 64 MiB residency when ungranted). One definition so
+    Sort/Reduce/GroupBy spill behavior can never silently diverge."""
+    return BlockPool(spill_dir=spill_dir,
+                     soft_limit=max((mem_limit or 256 << 20) // 4,
+                                    8 << 20))
+
+
 class BlockPool:
     """Byte-block store with a soft RAM limit and disk spill.
 
