@@ -21,10 +21,34 @@ import numpy as np
 from thrill_tpu.api import Context
 
 
+# Module-level stacked/keyed functions (identity-stable -> executable
+# cache hits across iterations AND across k_means calls); the moving
+# centroids enter through Bind as a runtime-bound operand, tokened by
+# SHAPE — the trace-once analog of the reference's by-reference lambda
+# capture (k-means.hpp:176-259), which would otherwise recompile the
+# classify program every Lloyd iteration (20-40s each on TPU).
+
+def _label(x, c):                       # x: [n_local, dim] batched
+    import jax.numpy as jnp
+    d2 = (jnp.sum(x * x, axis=1, keepdims=True)
+          - 2.0 * x @ c.T
+          + jnp.sum(c * c, axis=1)[None, :])
+    return {"i": jnp.argmin(d2, axis=1).astype(jnp.int64), "x": x,
+            "cnt": x[:, 0] * 0 + 1.0}
+
+
+def _cluster_i(t):
+    return t["i"]
+
+
+def _cluster_sum(a, b):
+    return {"i": a["i"], "x": a["x"] + b["x"], "cnt": a["cnt"] + b["cnt"]}
+
+
 def k_means(ctx: Context, points: np.ndarray, k: int, iterations: int = 10,
             seed: int = 0):
     """points: [n, dim] float64. Returns (centers [k, dim], labels DIA)."""
-    import jax.numpy as jnp
+    from thrill_tpu.api import Bind
 
     n, dim = points.shape
     rng = np.random.default_rng(seed)
@@ -34,20 +58,9 @@ def k_means(ctx: Context, points: np.ndarray, k: int, iterations: int = 10,
         .Keep(2 * iterations + 1)
 
     for _ in range(iterations):
-        c = jnp.asarray(centers)            # [k, dim] replicated constant
-
-        def classify(x):                    # x: [n_local, dim] batched
-            d2 = (jnp.sum(x * x, axis=1, keepdims=True)
-                  - 2.0 * x @ c.T
-                  + jnp.sum(c * c, axis=1)[None, :])
-            return jnp.argmin(d2, axis=1).astype(jnp.int64)
-
-        labeled = pts.Map(lambda x: {"i": classify(x), "x": x,
-                                     "cnt": x[:, 0] * 0 + 1.0})
+        labeled = pts.Map(Bind(_label, centers))
         sums = labeled.ReduceToIndex(
-            lambda t: t["i"],
-            lambda a, b: {"i": a["i"], "x": a["x"] + b["x"],
-                          "cnt": a["cnt"] + b["cnt"]},
+            _cluster_i, _cluster_sum,
             k, neutral={"i": 0, "x": np.zeros(dim), "cnt": 0.0})
         agg = sums.AllGather()
         new_centers = np.stack([np.asarray(t["x"]) for t in agg])

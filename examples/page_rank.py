@@ -18,52 +18,104 @@ import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
 
 import numpy as np
 
-from thrill_tpu.api import Context, InnerJoin
+from thrill_tpu.api import Bind, Context, InnerJoin
 
 DAMPENING = 0.85
+
+
+# Every stacked/keyed function is MODULE-LEVEL (identity-stable): the
+# executable caches key on function identity, so in-loop lambdas would
+# recompile every iteration — 20-40s per program on TPU. Per-call
+# constants (1/num_pages) enter through Bind, which tokens on operand
+# SHAPE, so repeated page_rank calls reuse the same executables too.
+
+def _src_one(s):
+    return (s, 1)
+
+
+def _page_first(kv):
+    return kv[0]
+
+
+def _add_pairs(a, b):
+    return (a[0], a[1] + b[1])
+
+
+def _fill(x, v):
+    return x * 0.0 + v[0]
+
+
+def _rank_pair(r, i):
+    return {"p": i, "r": r}
+
+
+def _deg_pair(kv, i):
+    return {"p": i, "deg": kv[1]}
+
+
+def _edge_src(e):
+    return e["s"]
+
+
+def _page_p(p):
+    return p["p"]
+
+
+def _join_rank(e, p):
+    return {"d": e["d"], "r": p["r"], "s": e["s"]}
+
+
+def _contrib_src(c):
+    return c["s"]
+
+
+def _join_deg(c, dp):
+    import jax.numpy as jnp
+    return {"d": c["d"], "v": c["r"] / jnp.maximum(dp["deg"], 1)}
+
+
+def _contrib_dst(c):
+    return c["d"]
+
+
+def _sum_v(a, b):
+    return {"d": a["d"], "v": a["v"] + b["v"]}
+
+
+def _dampen(t, base):
+    return base[0] + DAMPENING * t["v"]
 
 
 def page_rank(ctx: Context, edges: np.ndarray, num_pages: int,
               iterations: int = 10):
     """edges: [m, 2] int64 (src, dst). Returns np.ndarray of ranks."""
-    m = len(edges)
     src = edges[:, 0].astype(np.int64)
     dst = edges[:, 1].astype(np.int64)
 
     # out-degree per page (dangling pages keep degree 0)
-    deg_dia = ctx.Distribute(src).Map(lambda s: (s, 1)).ReduceToIndex(
-        lambda kv: kv[0], lambda a, b: (a[0], a[1] + b[1]), num_pages,
+    deg_dia = ctx.Distribute(src).Map(_src_one).ReduceToIndex(
+        _page_first, _add_pairs, num_pages,
         neutral=(0, 0)).Cache().Keep(iterations + 1)
 
     edges_dia = ctx.Distribute({"s": src, "d": dst}).Cache() \
         .Keep(iterations + 1)
 
-    ranks = ctx.Generate(
-        num_pages, fn=lambda i: i * 0.0 + 1.0 / num_pages).Cache()
+    inv_n = np.array([1.0 / num_pages])
+    base = np.array([(1.0 - DAMPENING) / num_pages])
+    ranks = ctx.Generate(num_pages).Map(Bind(_fill, inv_n)).Cache()
 
     for _ in range(iterations):
         # rank/degree per page, joined to edges by source page
-        ranks_idx = ranks.ZipWithIndex(lambda r, i: {"p": i, "r": r})
-        contrib = InnerJoin(
-            edges_dia, ranks_idx,
-            lambda e: e["s"], lambda p: p["p"],
-            lambda e, p: {"d": e["d"], "r": p["r"], "s": e["s"]})
+        ranks_idx = ranks.ZipWithIndex(_rank_pair)
+        contrib = InnerJoin(edges_dia, ranks_idx,
+                            _edge_src, _page_p, _join_rank)
         # divide by out-degree: join against degree table
-        deg_idx = deg_dia  # (page, deg) dense by index
-        deg_pairs = deg_idx.ZipWithIndex(lambda kv, i: {"p": i,
-                                                        "deg": kv[1]})
-        import jax.numpy as jnp
-        contrib2 = InnerJoin(
-            contrib, deg_pairs,
-            lambda c: c["s"], lambda dp: dp["p"],
-            lambda c, dp: {"d": c["d"],
-                           "v": c["r"] / jnp.maximum(dp["deg"], 1)})
+        deg_pairs = deg_dia.ZipWithIndex(_deg_pair)
+        contrib2 = InnerJoin(contrib, deg_pairs,
+                             _contrib_src, _page_p, _join_deg)
         sums = contrib2.ReduceToIndex(
-            lambda c: c["d"], lambda a, b: {"d": a["d"], "v": a["v"] + b["v"]},
-            num_pages, neutral={"d": 0, "v": 0.0})
-        ranks = sums.Map(
-            lambda t: (1.0 - DAMPENING) / num_pages + DAMPENING * t["v"]
-        ).Cache()
+            _contrib_dst, _sum_v, num_pages, neutral={"d": 0, "v": 0.0})
+        ranks = sums.Map(Bind(_dampen, base)).Cache()
 
     return np.asarray(ranks.AllGather(), dtype=np.float64)
 

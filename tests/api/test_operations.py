@@ -713,3 +713,83 @@ def test_zip_window_device_default_schema():
             assert [int(v) for v in cb] == [10 * k for k in
                                             range(3 * j, 3 * j + 3)]
     sweep(job)
+
+
+def _ij_lkey(a):
+    return a[0]
+
+
+def _ij_rkey(b):
+    return b[0]
+
+
+def _ij_join(a, b):
+    return (a[0], a[1] + b[1])
+
+
+def test_inner_join_executable_cache_hit():
+    """Second identical InnerJoin (module-level stable fns) must reuse
+    cached executables (regression: phase-2 holder KeyError on cache
+    hit — found when page_rank moved to identity-stable functions)."""
+    import jax
+    from thrill_tpu.api import Context, InnerJoin
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    ctx = Context(MeshExec(devices=jax.devices("cpu")[:2]))
+    for _ in range(2):
+        a = ctx.Distribute({"k": np.arange(16, dtype=np.int64),
+                            "v": np.arange(16, dtype=np.int64)})
+        b = ctx.Distribute({"k": np.arange(16, dtype=np.int64),
+                            "v": np.full(16, 10, dtype=np.int64)})
+        j = InnerJoin(a.Map(_pair_of), b.Map(_pair_of),
+                      _ij_lkey, _ij_rkey, _ij_join)
+        got = sorted((int(k), int(v)) for k, v in j.AllGather())
+        assert got == [(i, i + 10) for i in range(16)]
+    ctx.close()
+
+
+def _pair_of(t):
+    return (t["k"], t["v"])
+
+
+def _bind_scale(x, c):
+    return x * c[0]
+
+
+def _bind_thresh(x, c):
+    return x >= c[0]
+
+
+def test_bind_rebinds_without_recompile():
+    """Bind operands are runtime arguments: changing VALUES reuses the
+    executable (cache size stays flat), changing SHAPES recompiles."""
+    import jax
+    from thrill_tpu.api import Bind, Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    ctx = Context(MeshExec(devices=jax.devices("cpu")[:2]))
+    d = ctx.Distribute(np.arange(32, dtype=np.int64)).Cache().Keep(3)
+    out1 = d.Map(Bind(_bind_scale, np.array([2]))).AllGather()
+    size1 = len(ctx.mesh_exec._cache)
+    out2 = d.Map(Bind(_bind_scale, np.array([7]))).AllGather()
+    size2 = len(ctx.mesh_exec._cache)
+    assert [int(x) for x in out1] == [2 * i for i in range(32)]
+    assert [int(x) for x in out2] == [7 * i for i in range(32)]
+    assert size1 == size2, "value rebind must hit the executable cache"
+    # filter through Bind, fused in one stack with the map
+    out3 = d.Filter(Bind(_bind_thresh, np.array([20]))) \
+        .Map(Bind(_bind_scale, np.array([1]))).AllGather()
+    assert [int(x) for x in out3] == list(range(20, 32))
+    ctx.close()
+
+
+def test_bind_host_path():
+    from thrill_tpu.api import Bind, Context
+    import jax
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    ctx = Context(MeshExec(devices=jax.devices("cpu")[:2]))
+    h = ctx.Distribute(list(range(10)), storage="host")
+    got = h.Map(Bind(_bind_scale, np.array([3]))).AllGather()
+    assert [int(x) for x in got] == [3 * i for i in range(10)]
+    ctx.close()

@@ -25,9 +25,10 @@ __version__ = "0.1.0"
 #: top-level convenience surface (the reference exposes thrill::Run /
 #: thrill::DIA the same way); resolved lazily so importing thrill_tpu
 #: stays light
-_API_NAMES = ("Context", "DIA", "FieldReduce", "Run", "RunDistributed",
-              "RunLocalMock", "RunLocalTests", "Concat", "InnerJoin",
-              "Merge", "Union", "Zip", "ZipWindow")
+_API_NAMES = ("Bind", "Context", "DIA", "FieldReduce", "Run",
+              "RunDistributed", "RunLocalMock", "RunLocalTests",
+              "Concat", "InnerJoin", "Merge", "Union", "Zip",
+              "ZipWindow")
 
 
 def __getattr__(name):

@@ -9,7 +9,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.shards import DeviceShards, compact_valid
-from .stack import Stack, apply_stack_traced, stack_cache_token
+from ..parallel.mesh import AXIS
+from .stack import (Stack, apply_stack_traced, stack_bound_operands,
+                    stack_cache_token)
 
 
 def apply_stack_device(shards: DeviceShards, stack: Stack) -> DeviceShards:
@@ -17,33 +19,47 @@ def apply_stack_device(shards: DeviceShards, stack: Stack) -> DeviceShards:
 
     Compacts valid items to the front; the refreshed per-worker counts
     stay device-resident (DeviceShards fetches them lazily only where a
-    plan step needs host values).
+    plan step needs host values). Bind ops' operands enter as
+    REPLICATED program arguments — the executable is shape-cached, so
+    iterative re-binds (k-means centroids) skip recompilation.
     """
     if not stack:
         return shards
+    from jax.sharding import PartitionSpec as P
+
     mex = shards.mesh_exec
     cap = shards.cap
     leaves, treedef = jax.tree.flatten(shards.tree)
+    bound = stack_bound_operands(stack)
+    b_leaves, b_treedef = jax.tree.flatten(bound)
+    b_leaves = [jnp.asarray(l) for l in b_leaves]
     key = ("stack", stack_cache_token(stack), cap, treedef,
            tuple((l.dtype, l.shape[2:]) for l in leaves))
     holder = {}
 
     def build():
-        def f(counts_dev, *ls):
+        nd = 1 + len(leaves)
+
+        def f(counts_dev, *args):
+            ls, bls = args[:len(leaves)], args[len(leaves):]
             count = counts_dev[0, 0]
             mask = jnp.arange(cap) < count
             tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
-            tree, mask = apply_stack_traced(tree, mask, stack)
+            bound_t = jax.tree.unflatten(b_treedef, list(bls))
+            tree, mask = apply_stack_traced(tree, mask, stack,
+                                            bound=bound_t)
             tree, new_count = compact_valid(tree, mask)
             out_leaves, out_treedef = jax.tree.flatten(tree)
             holder["treedef"] = out_treedef
             return (new_count[None, None].astype(jnp.int32),
                     *[l[None] for l in out_leaves])
 
-        return mex.smap(f, 1 + len(leaves)), holder
+        in_specs = (P(AXIS),) * nd + (P(),) * len(b_leaves)
+        return mex.smap(f, nd + len(b_leaves),
+                        in_specs=in_specs), holder
 
     fn, h = mex.cached(key, build)
-    out = fn(shards.counts_device(), *leaves)
+    out = fn(shards.counts_device(), *leaves, *b_leaves)
     tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
     # counts stay on device: no host sync between chained programs
     return DeviceShards(mex, tree, out[0])

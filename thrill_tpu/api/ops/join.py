@@ -222,11 +222,15 @@ class InnerJoinNode(DIABase):
                 holder["treedef"] = out_td
                 return tuple(x[None] for x in out_leaves)
 
-            return mex.smap(f, 2 + nl + len(rleaves))
+            # (fn, holder) pair is what gets cached: a cache HIT must
+            # read the FIRST build's holder (filled at trace time) —
+            # a fresh local dict would be empty (the Merge regression,
+            # test_merge_executable_cache_hit, same class)
+            return mex.smap(f, 2 + nl + len(rleaves)), holder
 
-        f2 = mex.cached(key2, build2)
+        f2, h2 = mex.cached(key2, build2)
         out2 = f2(matches_dev, lo_dev, *lsorted, *rsorted)
-        tree = jax.tree.unflatten(holder["treedef"], list(out2))
+        tree = jax.tree.unflatten(h2["treedef"], list(out2))
         return DeviceShards(mex, tree, totals)
 
 
