@@ -179,16 +179,15 @@ class SortNode(DIABase):
         the spilled copy replaces — not duplicates — the resident items.
         """
         from ...common.sampling import ReservoirSamplingGrow
-        from ...data.block_pool import BlockPool
+        from ...data.block_pool import spill_pool
         from ...core import native_merge, order_key
         from ...core.multiway_merge import multiway_merge_files
 
         owns_input = self.parents[0].node.state == "DISPOSED"
         # spilled-run store keeps a quarter of the grant resident
         # before evicting runs to disk
-        pool = BlockPool(spill_dir=self.context.config.spill_dir,
-                         soft_limit=max((self.mem_limit or 256 << 20) // 4,
-                                        8 << 20))
+        pool = spill_pool(self.context.config.spill_dir,
+                          self.mem_limit)
         sampler = ReservoirSamplingGrow(np.random.default_rng(17))
         # items carry their stream position: the (key, position)
         # tiebreak makes the EM sort stable AND lets splitters cut
@@ -206,9 +205,20 @@ class SortNode(DIABase):
         # generic path.
         enc = None
         enc_state = "probe" if native_merge.available() else "off"
+        enc_arr = None      # vectorized S-array encoder (int/str)
         files = []          # item Files, (pos, item) records
         key_files = []      # parallel key-byte Files (native path)
         run = []            # native: (kb, pos, item); generic: (pos, item)
+        # columnar run state (native fast path): kb rows live in S-w
+        # numpy arrays, items in a parallel list, positions implicit
+        # (col_pos0 + index) — zero per-item Python objects until the
+        # vectorized spill. Any batch the array encoder can't handle
+        # exactly folds the columnar state into `run` tuples and
+        # continues on the listcomp path; a full schema deviation
+        # demotes to the generic engine as before.
+        col_arrs: list = []
+        col_items: list = []
+        col_pos0 = 0
         pos = 0
         # real-memory feedback: run_size is an ESTIMATE from one
         # pickled item; the RSS budget is ground truth and spills the
@@ -219,9 +229,63 @@ class SortNode(DIABase):
         from ...mem.manager import RssBudget
         budget = RssBudget(self.mem_limit or 0)
 
+        def run_len():
+            return len(run) + len(col_items)
+
+        def decolumnize():
+            """Fold columnar batches into (kb, pos, item) tuples so the
+            mixed-width tuple path can continue the run."""
+            nonlocal col_arrs, col_items, col_pos0
+            p = col_pos0
+            for arr in col_arrs:
+                w_ = arr.dtype.itemsize
+                raw = arr.tobytes()     # raw memory: no NUL stripping
+                n_ = len(arr)
+                run.extend(zip(
+                    (raw[i * w_:(i + 1) * w_] for i in range(n_)),
+                    range(p, p + n_), col_items[p - col_pos0:
+                                                p - col_pos0 + n_]))
+                p += n_
+            col_arrs, col_items, col_pos0 = [], [], 0
+
         def spill():
             nonlocal run
-            if enc is not None:
+            if col_items and run:
+                decolumnize()           # mixed run: one representation
+            if col_items:
+                # fully-columnar run: ordering is ONE argsort over the
+                # S-w rows (C memcmp — no Python compares, no per-key
+                # objects); the key file writes vectorized slices of
+                # the sorted array. The pos suffix makes every row
+                # distinct, so argsort stability is immaterial.
+                # Batches may carry different widths (str batches pad
+                # to their own max): widen with zero pads — order-safe
+                # by the padding argument in order_key
+                # make_array_batch_encoder — then concatenate.
+                W_ = max(a.dtype.itemsize for a in col_arrs)
+                for j, a in enumerate(col_arrs):
+                    w_ = a.dtype.itemsize
+                    if w_ != W_:
+                        buf = np.zeros((len(a), W_), np.uint8)
+                        buf[:, :w_] = a.view(np.uint8).reshape(
+                            len(a), w_)           # zero-copy source
+                        col_arrs[j] = buf.reshape(-1).view(f"S{W_}")
+                arr = (col_arrs[0] if len(col_arrs) == 1
+                       else np.concatenate(col_arrs))
+                order = np.argsort(arr)
+                f = File(pool=pool)
+                with f.writer() as w:
+                    p0 = col_pos0
+                    items_ = col_items
+                    for i in order.tolist():
+                        w.put((p0 + i, items_[i]))
+                kf = File(pool=pool)
+                native_merge.write_key_chunks_fixed(kf, arr[order])
+                files.append(f)
+                key_files.append(kf)
+                col_arrs.clear()
+                col_items.clear()
+            elif enc is not None:
                 run.sort()               # kb unique (pos suffix): pure
                 f = File(pool=pool)      # memcmp, items never compared
                 with f.writer() as w:
@@ -239,27 +303,53 @@ class SortNode(DIABase):
         def demote():
             """Schema deviation: strip key decoration from the live run
             and stop encoding; spilled runs stay valid as-is."""
-            nonlocal enc, enc_state, run
-            enc, enc_state = None, "off"
-            run = [(p, it) for _kb, p, it in run]
+            nonlocal enc, enc_state, enc_arr, run
+            enc, enc_state, enc_arr = None, "off", None
+            if col_items:
+                run.extend(zip(range(col_pos0,
+                                     col_pos0 + len(col_items)),
+                               col_items))
+                col_arrs.clear()
+                col_items.clear()
+            else:
+                run = [(p, it) for _kb, p, it in run]
 
         def append_batch(batch):
-            """Batch-at-a-time spill-side processing: ONE encoding
-            listcomp and ONE vectorized reservoir call per slice —
-            per-item Python bookkeeping was the profiled bottleneck of
-            the whole EM sort, bigger than the merge it feeds."""
-            nonlocal enc, enc_state, pos
+            """Batch-at-a-time spill-side processing: ONE vectorized
+            encode (or one listcomp) and ONE vectorized reservoir call
+            per slice — per-item Python bookkeeping was the profiled
+            bottleneck of the whole EM sort, bigger than the merge it
+            feeds."""
+            nonlocal enc, enc_state, enc_arr, pos, col_pos0
             if enc_state == "probe" and batch:
                 enc = order_key.make_batch_encoder(sort_key(batch[0]))
                 enc_state = "on" if enc is not None else "off"
+                if enc is not None:
+                    enc_arr = order_key.make_array_batch_encoder(
+                        sort_key(batch[0]))
             if enc is not None:
+                keys = list(map(sort_key, batch))
                 try:
-                    # kbs built fully BEFORE touching run: a mid-batch
-                    # schema deviation leaves no partial decoration
-                    kbs = enc(list(map(sort_key, batch)),
-                              range(pos, pos + len(batch)))
-                    run.extend(zip(kbs, range(pos, pos + len(batch)),
-                                   batch))
+                    arr = None
+                    if enc_arr is not None and not run:
+                        # batches of different widths coexist; spill
+                        # widens them with order-safe zero pads
+                        arr = enc_arr(keys, pos)
+                    if arr is not None:
+                        if not col_items:
+                            col_pos0 = pos
+                        col_arrs.append(arr)
+                        col_items.extend(batch)
+                    else:
+                        if col_items:
+                            decolumnize()
+                        # kbs built fully BEFORE touching run: a
+                        # mid-batch schema deviation leaves no partial
+                        # decoration
+                        kbs = enc(keys, range(pos, pos + len(batch)))
+                        run.extend(zip(kbs,
+                                       range(pos, pos + len(batch)),
+                                       batch))
                 except order_key.BATCH_ENCODE_ERRORS:
                     demote()
                     run.extend(zip(range(pos, pos + len(batch)), batch))
@@ -272,22 +362,31 @@ class SortNode(DIABase):
         # feedback responsive even when run_size is huge, and caps the
         # transient key-bytes list a single encode pass builds
         MAX_BATCH = 1 << 16
+        # phase decomposition for perf evidence: the run-formation
+        # (encode+sort+spill) phase is engine-independent machinery;
+        # the merge phase is where the native k-way engine replaces
+        # heapq + per-item Python key calls (ref hot loop:
+        # api/sort.hpp:216-271) — bench.py reports the phase times so
+        # the engine win is pinned, not inferred from noisy totals
+        import time as _time
+        t_phase0 = _time.perf_counter()
         try:
             for lst in shards.lists:
                 idx = 0
                 while idx < len(lst):
-                    take = min(run_size - len(run), len(lst) - idx,
+                    take = min(run_size - run_len(), len(lst) - idx,
                                MAX_BATCH)
                     append_batch(lst[idx:idx + take])
                     idx += take
-                    if len(run) >= run_size or \
-                            (budget.exceeded_now() and len(run) >= 16):
+                    if run_len() >= run_size or \
+                            (budget.exceeded_now() and run_len() >= 16):
                         spill()
                         budget.reset()
                 if owns_input:
                     lst.clear()
-            if run:
+            if run_len():
                 spill()
+            t_phase1 = _time.perf_counter()
 
             samples = sorted(sampler.samples, key=pair_key)
             sample_at = [min(len(samples) - 1, (j * len(samples)) // W)
@@ -314,6 +413,11 @@ class SortNode(DIABase):
                     while w < len(split_keys) and k > split_keys[w]:
                         w += 1
                     out[w].append(t[1])
+            self._em_stats = {
+                "runs": len(files), "engine":
+                    "native" if enc is not None else "py",
+                "spill_s": round(t_phase1 - t_phase0, 3),
+                "merge_s": round(_time.perf_counter() - t_phase1, 3)}
         finally:
             for f in files + key_files:
                 if f is not None:

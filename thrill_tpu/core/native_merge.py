@@ -75,6 +75,18 @@ def write_key_chunks(keys_file: File, key_bytes: List[bytes]) -> None:
             w.put((offs, b"".join(chunk)))
 
 
+def write_key_chunks_fixed(keys_file: File, arr: np.ndarray) -> None:
+    """Fixed-width variant of :func:`write_key_chunks`: ``arr`` is a
+    key-sorted ``S{w}`` array; offsets are an arange and the blob is
+    one raw-memory copy — no per-key Python objects at all."""
+    w_ = arr.dtype.itemsize
+    with keys_file.writer() as wtr:
+        for i in range(0, len(arr), KEY_CHUNK):
+            chunk = arr[i:i + KEY_CHUNK]
+            offs = np.arange(len(chunk) + 1, dtype=np.int64) * w_
+            wtr.put((offs, chunk.tobytes()))
+
+
 class _RunFeed:
     """One run's key-chunk stream; owns the live buffers the native
     engine points into (they must outlive the chunk's consumption)."""

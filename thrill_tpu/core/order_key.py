@@ -193,6 +193,101 @@ _PK = _Q.pack
 _BIAS = 1 << 63
 
 
+def _pos_rows(pos0: int, n: int) -> "np.ndarray":
+    """[n, 8] uint8 big-endian rows of positions pos0..pos0+n-1."""
+    import numpy as np
+    # astype to an EXPLICIT big-endian dtype is endian-correct on any
+    # host (native dtypes report byteorder '=', so a != '>' test would
+    # byteswap wrongly on big-endian machines)
+    p = np.arange(pos0, pos0 + n, dtype=np.uint64).astype(">u8")
+    return p.view(np.uint8).reshape(n, 8)
+
+
+def make_array_batch_encoder(sample_key: Any):
+    """Vectorized sibling of :func:`make_batch_encoder`:
+    ``g(keys_list, pos0) -> np.ndarray(S{w}) | None``, producing the
+    IDENTICAL bytes per key as the listcomp encoder but as rows of one
+    fixed-width numpy array — zero per-item Python objects, so the EM
+    sort's run formation (encode + order) stays in C (np.argsort over
+    the S view is pure memcmp). Returns a callable for int and str
+    schemas, else None. The callable returns None for a batch it cannot
+    vectorize exactly (non-ASCII, unequal lengths, embedded NULs —
+    where escaping/termination make widths vary); the caller then uses
+    the listcomp encoder for that batch. Schema DEVIATIONS raise
+    ``BATCH_ENCODE_ERRORS`` exactly like the listcomp encoder."""
+    import numpy as np
+    try:
+        schema = _schema_of(sample_key)
+    except OrderKeyError:
+        return None
+    if schema == "int" and type(sample_key) in (int, bool):
+        def g(keys, pos0):
+            if set(map(type, keys)) - {int, bool}:
+                raise OrderKeyError("non-int key in int batch")
+            n = len(keys)
+            # OverflowError (in BATCH_ENCODE_ERRORS) on > int64 range
+            a = np.fromiter(keys, dtype=np.int64, count=n)
+            biased = (a.view(np.uint64)
+                      + np.uint64(_BIAS)).astype(">u8")  # wraps: k+BIAS
+            out = np.empty((n, 16), dtype=np.uint8)
+            out[:, :8] = biased.view(np.uint8).reshape(n, 8)
+            out[:, 8:] = _pos_rows(pos0, n)
+            return out.reshape(-1).view("S16")   # zero-copy rows view
+        return g
+    if schema == "str" and type(sample_key) is str:
+        # Variable-length batches emit NUL-PADDED rows: row i is
+        # content + \x00 terminator + 8-byte pos + zero padding to the
+        # batch max. Padding is ORDER-SAFE against both padded rows of
+        # any width and the exact variable-length kbs (mixed runs /
+        # splitters): content bytes are NUL-free (the exact encoder
+        # escapes \x00, and batches containing NULs fall back), so the
+        # first memcmp mismatch always lands in content, terminator, or
+        # the globally-unique pos field — never in padding — and there
+        # it agrees with the variable-length comparison byte for byte.
+        # Data rows carry globally-unique positions, so no data-data
+        # comparison ever reaches the pads with everything equal; the
+        # one same-(key, pos) pairing that exists — a splitter kb
+        # against its own sampled twin row — ties toward the exact
+        # (shorter, prefix) form, which only shifts that one item
+        # across a partition boundary, never breaking sortedness.
+        def g(keys, pos0):
+            if set(map(type, keys)) - {str}:
+                raise OrderKeyError("non-str key in str batch")
+            n = len(keys)
+            u = np.array(keys)
+            try:
+                s = u.astype(f"S{max(u.dtype.itemsize // 4, 1)}")
+            except (UnicodeEncodeError, UnicodeError):
+                return None                  # non-ASCII: listcomp batch
+            w = s.dtype.itemsize
+            view = s.view(np.uint8).reshape(n, w)
+            nz = view != 0
+            # content NULs (the exact encoder escapes them, changing
+            # widths) make padding ambiguous — detect and fall back:
+            # an interior zero followed by a nonzero byte, or a key
+            # ENDING in U+0000 (its padding-like suffix would encode
+            # differently), cannot take this path
+            if (~nz[:, :-1] & nz[:, 1:]).any():
+                return None
+            lens = np.count_nonzero(nz, axis=1)
+            # numpy's U dtype itself drops trailing NULs at np.array(),
+            # so compare against the PYTHON lengths: any key whose true
+            # length disagrees (trailing U+0000) must fall back
+            if (lens != np.fromiter(map(len, keys), dtype=np.int64,
+                                    count=n)).any():
+                return None
+            out = np.zeros((n, w + 9), dtype=np.uint8)
+            out[:, :w] = view                # content, zero-padded
+            rows = np.arange(n)
+            # terminator is the zero already at out[rows, lens]; the
+            # pos field lands right after it, pads stay zero
+            out[rows[:, None],
+                lens[:, None] + 1 + np.arange(8)] = _pos_rows(pos0, n)
+            return out.reshape(-1).view(f"S{w + 9}")  # zero-copy view
+        return g
+    return None
+
+
 def make_batch_encoder(sample_key: Any):
     """Batch encoder ``fn(keys_list, positions) -> list[bytes]`` where
     each output is the order encoding of the key plus an 8-byte
