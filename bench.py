@@ -38,6 +38,15 @@ RESULT = {
     "unit": "Mrecords/s",
     "vs_baseline": 0.0,
     "platform": "none",
+    # measurement-quality contract (round-5): "ok" means the machine
+    # looked idle at start AND the timed iterations were stable;
+    # "loaded" = loadavg said another process was competing before we
+    # started; "noisy" = some timed section's best-of-N dispersion
+    # exceeded _MAX_DISP (don't
+    # trust round-over-round comparisons of this line). Every timed
+    # section reports best-of-N with dispersion so background load
+    # inflates the spread, not the headline.
+    "quality": "ok",
 }
 _STATE_LOCK = threading.Lock()
 _emitted = False
@@ -100,6 +109,35 @@ def _probe_accelerator(timeout_s: float) -> str | None:
     return None
 
 
+#: dispersion past this flags the line as "noisy". Calibrated on this
+#: 1-core box: idle-machine best-of-3 spreads reach ~0.4 from GC and
+#: jax worker-thread scheduling alone; genuine contention (a parallel
+#: jax process) pushes past 2x. The loadavg guard is the primary load
+#: detector; dispersion is the backstop for mid-run arrivals.
+_MAX_DISP = 0.6
+
+
+def _best_of(fn, iters: int = 3):
+    """Best-of-N timing: returns (min_seconds, dispersion). The min is
+    the load-robust estimator (background processes only ever ADD
+    time); dispersion = (max-min)/min feeds the quality flag."""
+    times = []
+    for _ in range(max(iters, 1)):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    disp = (max(times) - best) / best if best > 0 else 0.0
+    return best, round(disp, 3)
+
+
+def _note_dispersion(disp: float) -> None:
+    """Escalate quality to "noisy" when any timed section's spread
+    says the numbers are load-contaminated."""
+    if disp > _MAX_DISP and RESULT.get("quality") == "ok":
+        _set(quality="noisy")
+
+
 def _host_terasort(keys: np.ndarray, values: np.ndarray):
     """numpy proxy baseline: pack key words, lexsort, gather."""
     w0 = np.zeros(len(keys), dtype=np.uint64)
@@ -148,6 +186,18 @@ def _run_bench() -> None:
 
     platform = jax.default_backend()
     _set(platform=platform)
+    # load guard: on a contended machine the line must SAY so (the
+    # round-4 driver capture read as a phantom 2.5x regression purely
+    # from background load)
+    try:
+        load1 = os.getloadavg()[0]
+        _set(loadavg=round(load1, 2))
+        if load1 > 1.5:
+            _set(quality="loaded")
+            print(f"bench: loadavg {load1:.2f} > 1.5 — machine is "
+                  f"contended, numbers are suspect", file=sys.stderr)
+    except OSError:
+        pass
     default_n = 1 << 20 if platform != "cpu" else 1 << 18
     try:
         n = int(os.environ.get("THRILL_TPU_BENCH_N", "") or default_n)
@@ -189,16 +239,16 @@ def _run_bench() -> None:
         return shards
 
     run_once()                      # warmup + compile
-    iters = 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        run_once()
-    dt = (time.perf_counter() - t0) / iters
+    run_once()                      # second warmup: steady-state HBM/GC
+    dt, disp = _best_of(run_once, iters=3)
+    _set(terasort_disp=disp)
+    _note_dispersion(disp)
 
-    # host proxy baseline on identical data
-    t0 = time.perf_counter()
-    _host_terasort(recs["key"], recs["value"])
-    host_dt = time.perf_counter() - t0
+    # host proxy baseline on identical data (best-of-2: one spike in
+    # the BASELINE leg would otherwise inflate vs_baseline)
+    host_dt, host_disp = _best_of(
+        lambda: _host_terasort(recs["key"], recs["value"]), iters=2)
+    _note_dispersion(host_disp)
 
     mrec_s = n / dt / 1e6
     host_mrec_s = n / host_dt / 1e6
@@ -206,13 +256,18 @@ def _run_bench() -> None:
     # secondary north-star metric (BASELINE.md): WordCount ReduceByKey
     # items/sec on the device path, vs a collections.Counter host proxy
     wc = _wordcount_metric(ctx, n)
-    # tertiary: host-storage EM sort (spill + native k-way merge) vs
-    # Python sorted() on the same strings — platform-independent, so it
+    # iterative north stars (BASELINE.md): PageRank and k-means —
+    # Collapse loops over InnerJoin/ReduceToIndex, vs numpy proxies
+    prm = _pagerank_metric(ctx)
+    kmm = _kmeans_metric(ctx)
+    # host-storage EM sort (spill + native k-way merge) A/B vs the
+    # generic python-heap engine — platform-independent, so it
     # reports the host engine even in a TPU window
     em = _em_sort_metric(ctx)
 
     _emit(value=round(mrec_s, 3),
-          vs_baseline=round(mrec_s / host_mrec_s, 3), **wc, **em)
+          vs_baseline=round(mrec_s / host_mrec_s, 3),
+          **wc, **prm, **kmm, **em)
     ctx.close()
 
 
@@ -252,30 +307,129 @@ def _wordcount_metric(ctx, n: int) -> dict:
             jax.block_until_ready(jax.tree.leaves(sh.tree))
             np.asarray(jax.tree.leaves(sh.tree)[0])[:1]
 
-        once()
-        t0 = time.perf_counter()
-        once()
-        dt = time.perf_counter() - t0
+        once()                                   # warmup + compile
+        dt, disp = _best_of(once, iters=3)
+        _note_dispersion(disp)
         strs = ["".join(map(chr, row)) for row in words]
-        t0 = time.perf_counter()
-        collections.Counter(strs)
-        host_dt = time.perf_counter() - t0
+        host_dt, host_disp = _best_of(
+            lambda: collections.Counter(strs), iters=2)
+        _note_dispersion(host_disp)
         return {"wordcount_mitems_s": round(n / dt / 1e6, 3),
-                "wordcount_vs_counter": round(host_dt / dt, 3)}
+                "wordcount_vs_counter": round(host_dt / dt, 3),
+                "wordcount_disp": disp}
     except Exception as e:  # secondary metric never kills the line
         return {"wordcount_error": repr(e)[:200]}
 
 
-def _em_sort_metric(ctx) -> dict:
-    """Host EM sort throughput (forced spills, ~40 runs of 1M string
-    items): native byte-key engine (core/order_key.py +
-    native/mwmerge.cpp) A/B'd in-run against the generic
-    Python-comparison engine on identical machinery. (The headline
-    speedup vs the ROUND-3 code is 3.6x at 10M — BASELINE.md; an
-    in-memory sorted() is not a meaningful baseline for an
-    external-memory spill+merge pipeline.)"""
+def _examples_path():
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "examples")
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def _pagerank_metric(ctx) -> dict:
+    """PageRank end-to-end: per-iteration edge throughput of the full
+    DIA pipeline (InnerJoin + ReduceToIndex + Collapse loop,
+    examples/page_rank.py; reference:
+    examples/page_rank/page_rank.hpp:71-131) against the numpy
+    scatter-add proxy on identical data, with output parity checked."""
     try:
-        n = 1 << 20
+        _examples_path()
+        import page_rank as pr
+        pages, m, iters = 4096, 1 << 16, 5
+        try:
+            m = int(os.environ.get("THRILL_TPU_BENCH_PR_EDGES", "") or m)
+        except ValueError:
+            pass
+        edges = pr.zipf_graph(pages, m, seed=2)
+        holder = {}
+
+        def once():
+            holder["ranks"] = pr.page_rank(ctx, edges, pages,
+                                           iterations=iters)
+
+        once()                                   # warmup + compile
+        dt, disp = _best_of(once, iters=2)
+        _note_dispersion(disp)
+        hh = {}
+
+        def host_once():
+            hh["want"] = pr.page_rank_dense(ctx, edges, pages, iters)
+
+        host_dt, host_disp = _best_of(host_once, iters=2)
+        _note_dispersion(host_disp)
+        want = hh["want"]
+        if not np.allclose(holder["ranks"], want, rtol=1e-6, atol=1e-9):
+            return {"pagerank_error": "parity mismatch vs numpy"}
+        return {"pagerank_medges_s": round(m * iters / dt / 1e6, 3),
+                "pagerank_vs_numpy": round(host_dt / dt, 3),
+                "pagerank_disp": disp}
+    except Exception as e:  # secondary metric never kills the line
+        return {"pagerank_error": repr(e)[:200]}
+
+
+def _kmeans_metric(ctx) -> dict:
+    """k-means end-to-end: per-iteration point throughput of the DIA
+    classify + ReduceToIndex loop (examples/k_means.py; reference:
+    examples/k-means/k-means.hpp:176-259) against the numpy Lloyd
+    proxy, with centroid parity checked."""
+    try:
+        _examples_path()
+        import k_means as km
+        n, dim, k, iters = 1 << 17, 8, 16, 5
+        try:
+            n = int(os.environ.get("THRILL_TPU_BENCH_KM_N", "") or n)
+        except ValueError:
+            pass
+        rng = np.random.default_rng(4)
+        points = rng.normal(size=(n, dim))
+        holder = {}
+
+        def once():
+            holder["centers"] = km.k_means(ctx, points, k,
+                                           iterations=iters, seed=0)
+
+        once()                                   # warmup + compile
+        dt, disp = _best_of(once, iters=2)
+        _note_dispersion(disp)
+        # identical seed-0 start centers for the proxy
+        rng0 = np.random.default_rng(0)
+        centers0 = points[rng0.choice(n, size=k, replace=False)].copy()
+        hh = {}
+
+        def host_once():
+            hh["want"] = km.k_means_dense(points, centers0, iters)
+
+        host_dt, host_disp = _best_of(host_once, iters=2)
+        _note_dispersion(host_disp)
+        want = hh["want"]
+        if not np.allclose(holder["centers"], want, rtol=1e-6,
+                           atol=1e-8):
+            return {"kmeans_error": "parity mismatch vs numpy"}
+        return {"kmeans_mitems_s": round(n * iters / dt / 1e6, 3),
+                "kmeans_vs_numpy": round(host_dt / dt, 3),
+                "kmeans_disp": disp}
+    except Exception as e:  # secondary metric never kills the line
+        return {"kmeans_error": repr(e)[:200]}
+
+
+def _em_sort_metric(ctx) -> dict:
+    """Host EM sort (forced spills, ~40 runs of string items): native
+    byte-key engine (core/order_key.py + native/mwmerge.cpp) A/B'd
+    in-run against the generic Python-heap engine on identical
+    machinery. Two forms of evidence: the TOTAL ratio
+    (em_sort_vs_py_engine) and the MERGE-PHASE ratio
+    (em_merge_vs_py, from the sort's phase decomposition) — the spill
+    phase is engine-independent, so the phase ratio pins the native
+    engine's win even at scales where spill time dominates the total
+    (ref hot loop: api/sort.hpp:216-271)."""
+    try:
+        n = 1 << 22
+        try:
+            n = int(os.environ.get("THRILL_TPU_BENCH_EM_N", "") or n)
+        except ValueError:
+            pass
         rng = np.random.default_rng(3)
         items = [f"key-{v:014d}" for v in
                  rng.integers(0, 1 << 48, size=n).tolist()]
@@ -286,19 +440,21 @@ def _em_sort_metric(ctx) -> dict:
         def run_once(data):
             d = ctx.Distribute(list(data), storage="host")
             t0 = time.perf_counter()
-            hs = d.Sort().node.materialize()
+            node = d.Sort().node
+            hs = node.materialize()
             dt = time.perf_counter() - t0
-            return dt, sum(len(l) for l in hs.lists)
+            return (dt, sum(len(l) for l in hs.lists),
+                    getattr(node, "_em_stats", {}))
 
         try:
             # warmup: a small EM sort pays the one-time native build /
             # ctypes load OUTSIDE the timed window (_wordcount_metric
             # warms up the same way). Must exceed run_size (n/40) or
             # the warmup takes the in-memory path and loads nothing.
-            run_once(items[: 1 << 15])
-            dt, got_n = run_once(items)
+            run_once(items[: max(1 << 17, n // 40 + 1)])
+            dt, got_n, stats = run_once(items)
             os.environ["THRILL_TPU_EM_MERGE"] = "py"
-            py_dt, _ = run_once(items)
+            py_dt, _, py_stats = run_once(items)
         finally:
             for k, v in prev.items():
                 if v is None:
@@ -307,8 +463,14 @@ def _em_sort_metric(ctx) -> dict:
                     os.environ[k] = v
         if got_n != n:
             return {"em_sort_error": f"lost items: {got_n}/{n}"}
-        return {"em_sort_mitems_s": round(n / dt / 1e6, 3),
-                "em_sort_vs_py_engine": round(py_dt / dt, 3)}
+        out = {"em_sort_mitems_s": round(n / dt / 1e6, 3),
+               "em_sort_vs_py_engine": round(py_dt / dt, 3)}
+        if stats.get("merge_s") and py_stats.get("merge_s") \
+                and stats.get("engine") == "native":
+            out["em_merge_s"] = stats["merge_s"]
+            out["em_merge_vs_py"] = round(
+                py_stats["merge_s"] / stats["merge_s"], 3)
+        return out
     except Exception as e:  # tertiary metric never kills the line
         return {"em_sort_error": repr(e)[:200]}
 
