@@ -1,0 +1,112 @@
+"""Device-residency coverage: which pipelines never demote to host.
+
+The TPU-first completeness criterion — our analog of the reference's
+"no per-item virtual call" invariant (SURVEY §7): a pipeline of
+device-capable operators must run as jitted device programs end to
+end, demoting to host Python ONLY at its action/egress point. Every
+demotion is logged (data/shards.py to_host_shards, event
+``device_to_host`` with a reason), so this test drives the pipelines
+the DEVICE_COVERAGE table in ARCHITECTURE.md advertises and asserts
+the log shows exactly the expected egress demotion and nothing else.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from thrill_tpu.api import Context, FieldReduce, InnerJoin, Zip
+from thrill_tpu.common.config import Config
+from thrill_tpu.parallel.mesh import MeshExec
+
+
+def _demotions(tmp_path, job, W=4):
+    log = tmp_path / "events-host0.jsonl"   # default_log_path naming
+    cfg = Config.from_env()
+    cfg.log_path = str(tmp_path / "events.jsonl")
+    ctx = Context(MeshExec(devices=jax.devices("cpu")[:W]), config=cfg)
+    try:
+        job(ctx)
+    finally:
+        ctx.close()
+    out = []
+    with open(log) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("event") == "device_to_host":
+                out.append(rec["reason"])
+    return out
+
+
+def test_zip_pad_unequal_sizes_stays_on_device(tmp_path):
+    """Pad-mode Zip of unequal sizes realigns ON DEVICE (round-4
+    verdict candidate demotion — eliminated): only the final AllGather
+    egress may demote."""
+    def job(ctx):
+        a = ctx.Generate(25)
+        b = ctx.Generate(10, fn=lambda i: i * 3)
+        z = Zip(a, b, zip_fn=lambda x, y: x + y, mode="pad")
+        want = [i + (i * 3 if i < 10 else 0) for i in range(25)]
+        assert [int(v) for v in z.AllGather()] == want
+
+    assert _demotions(tmp_path, job) == ["allgather-action"]
+
+
+def test_sort_reduce_join_chain_stays_on_device(tmp_path):
+    """Map/Filter stack -> Sort -> ReduceByKey(FieldReduce) ->
+    InnerJoin: all device programs; one egress demotion at the end."""
+    def job(ctx):
+        rng = np.random.default_rng(5)
+        vals = rng.integers(0, 40, 600).astype(np.int64)
+        d = ctx.Distribute(vals).Map(lambda x: x * 2) \
+            .Filter(lambda x: x % 4 == 0).Sort()
+        d = d.Map(lambda x: {"k": x % 10, "c": x * 0 + 1})
+        red = d.ReduceByKey(lambda t: t["k"],
+                            FieldReduce({"k": "first", "c": "sum"}))
+        red.Keep()
+        idx = ctx.Distribute({"k": np.arange(10, dtype=np.int64),
+                              "w": np.arange(10, dtype=np.int64) * 7})
+        j = InnerJoin(red, idx, lambda t: t["k"], lambda t: t["k"],
+                      lambda a, b: (a["k"], a["c"], b["w"]))
+        got = sorted((int(k), int(c), int(w))
+                     for k, c, w in j.AllGather())
+        # model
+        doubled = [v * 2 for v in vals.tolist() if (v * 2) % 4 == 0]
+        want: dict = {}
+        for x in doubled:
+            want[x % 10] = want.get(x % 10, 0) + 1
+        assert got == sorted((k, c, k * 7) for k, c in want.items())
+        # second egress for the kept reduce (demotion log must show
+        # exactly the two action egresses)
+        assert len(red.AllGather()) == len(want)
+
+    assert _demotions(tmp_path, job) == ["allgather-action"] * 2
+
+
+def test_prefix_window_pipeline_stays_on_device(tmp_path):
+    """PrefixSum + device Window + ZipWithIndex: device end to end."""
+    import jax.numpy as jnp
+
+    def job(ctx):
+        d = ctx.Generate(64).PrefixSum()
+        w = d.Window(3, lambda i, win: sum(win),
+                     device_fn=lambda wins: jnp.sum(wins, axis=1))
+        got = [int(x) for x in w.AllGather()]
+        ps = np.cumsum(np.arange(64))
+        want = [int(ps[i] + ps[i + 1] + ps[i + 2]) for i in range(62)]
+        assert got == want
+
+    assert _demotions(tmp_path, job) == ["allgather-action"]
+
+
+def test_host_group_fn_demotes_with_reason(tmp_path):
+    """Counter-case: an arbitrary host group_fn MUST demote, and the
+    log must say why (the audit's 'inherent' class)."""
+    def job(ctx):
+        g = ctx.Generate(50).GroupByKey(lambda x: x % 5,
+                                        lambda k, vs: (int(k), len(list(vs))))
+        assert sorted(g.AllGather()) == [(k, 10) for k in range(5)]
+
+    reasons = _demotions(tmp_path, job)
+    assert "groupbykey-group-fn" in reasons

@@ -81,7 +81,10 @@ class ZipNode(DIABase):
     def compute(self):
         pulls = [l.pull() for l in self.parents]
         if any(isinstance(p, HostShards) for p in pulls):
-            pulls = [p.to_host_shards("zip-unequal-pad") if isinstance(p, DeviceShards) else p
+            # only MIXED storage demotes; unequal sizes (cut/pad) stay
+            # device-resident via the realign exchange below
+            pulls = [p.to_host_shards("zip-mixed-storage")
+                     if isinstance(p, DeviceShards) else p
                      for p in pulls]
             return self._compute_host(pulls)
         return self._compute_device(pulls)
