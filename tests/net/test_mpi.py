@@ -28,13 +28,14 @@ from thrill_tpu.net import mpi as mpi_backend
 
 import fake_mpi
 
-from portalloc import free_ports
+from portalloc import free_ports, load_scaled
 
 
 def run_mpi_group(num_hosts, job, group_count=2, timeout=30):
     """Run ``job(groups)`` on num_hosts daemon threads, one fake-MPI
     rank each; surface per-rank exceptions; flag deadlocks by join
-    timeout. Returns results by rank."""
+    timeout (load-scaled). Returns results by rank."""
+    timeout = load_scaled(timeout)
     modules = fake_mpi.make_inprocess_world(num_hosts)
     results = [None] * num_hosts
     errors = [None] * num_hosts
@@ -222,10 +223,11 @@ def test_mpi_real_processes(nproc):
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env) for rank in range(nproc)]
     import concurrent.futures as cf
+    budget = load_scaled(120)
     with cf.ThreadPoolExecutor(len(procs)) as ex:
-        futs = [ex.submit(p.communicate, None, 120) for p in procs]
+        futs = [ex.submit(p.communicate, None, budget) for p in procs]
         try:
-            drained = [f.result(timeout=140) for f in futs]
+            drained = [f.result(timeout=budget + 20) for f in futs]
         except (cf.TimeoutError, subprocess.TimeoutExpired):
             for q in procs:
                 q.kill()
