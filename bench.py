@@ -446,15 +446,22 @@ def _em_sort_metric(ctx) -> dict:
             return (dt, sum(len(l) for l in hs.lists),
                     getattr(node, "_em_stats", {}))
 
+        def best_leg(data):
+            """Best-of-2 per engine leg: the A/B ratio was observed to
+            swing 2x run-over-run on single shots (page cache, GC)."""
+            a = run_once(data)
+            b = run_once(data)
+            return a if a[0] <= b[0] else b
+
         try:
             # warmup: a small EM sort pays the one-time native build /
             # ctypes load OUTSIDE the timed window (_wordcount_metric
             # warms up the same way). Must exceed run_size (n/40) or
             # the warmup takes the in-memory path and loads nothing.
             run_once(items[: max(1 << 17, n // 40 + 1)])
-            dt, got_n, stats = run_once(items)
+            dt, got_n, stats = best_leg(items)
             os.environ["THRILL_TPU_EM_MERGE"] = "py"
-            py_dt, _, py_stats = run_once(items)
+            py_dt, _, py_stats = best_leg(items)
         finally:
             for k, v in prev.items():
                 if v is None:

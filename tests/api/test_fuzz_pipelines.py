@@ -43,13 +43,17 @@ def _apply_ref(ops, data):
             cur = sorted(cur + [x + arg for x in cur])
         elif op == "rebalance":
             pass                            # repartition only
+        else:
+            raise ValueError(f"unknown fuzz op {op!r} — extend "
+                             f"apply_ops AND _apply_ref together")
     return cur
 
 
-def _apply_dia(ops, data, W):
-    mex = MeshExec(num_workers=W)
-    ctx = Context(mex)
-    d = ctx.Distribute(np.asarray(data, dtype=np.int64))
+def apply_ops(d, ops):
+    """Run a generated op chain against a starting DIA — the ONE
+    framework-side interpreter for `_gen_ops` chains (the in-process
+    sweep here and the multi-process fuzz children share it, so a new
+    op cannot silently diverge between them)."""
     for op, arg in ops:
         if op == "map":
             a, b = arg
@@ -77,6 +81,16 @@ def _apply_dia(ops, data, W):
             d = Union(d, d.Map(lambda x, k=arg: x + k)).Sort()
         elif op == "rebalance":
             d = d.Rebalance()
+        else:
+            raise ValueError(f"unknown fuzz op {op!r} — extend "
+                             f"apply_ops AND _apply_ref together")
+    return d
+
+
+def _apply_dia(ops, data, W):
+    mex = MeshExec(num_workers=W)
+    ctx = Context(mex)
+    d = apply_ops(ctx.Distribute(np.asarray(data, dtype=np.int64)), ops)
     out = [int(x) for x in d.AllGather()]
     ctx.close()
     return out

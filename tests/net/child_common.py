@@ -1,0 +1,23 @@
+"""Shared bootstrap for net-test child processes.
+
+One definition of the fake-MPI world injection so the wordcount, ops
+sweep and fuzz children can never diverge in how they wire
+THRILL_TPU_NET=mpi (the strict-rendezvous transport from fake_mpi.py).
+"""
+
+import os
+import sys
+
+
+def maybe_inject_fake_mpi(rank: int, nproc: int) -> None:
+    """THRILL_TPU_NET=mpi mode: connect the strict-rendezvous fake
+    world across the real processes and inject it as the backend's MPI
+    module BEFORE Context construction selects the net backend."""
+    fakempi = os.environ.get("THRILL_TPU_TEST_FAKEMPI")
+    if not fakempi:
+        return
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fake_mpi
+    from thrill_tpu.net import mpi as mpi_backend
+    ports = [int(p) for p in fakempi.split(",")]
+    mpi_backend.MPI = fake_mpi.connect_world(rank, nproc, ports)

@@ -117,17 +117,8 @@ def job(ctx):
 def main():
     coordinator, rank = sys.argv[1], int(sys.argv[2])
     nproc = int(sys.argv[3]) if len(sys.argv) > 3 else 2
-    fakempi = os.environ.get("THRILL_TPU_TEST_FAKEMPI")
-    if fakempi:
-        # THRILL_TPU_NET=mpi mode: connect the strict-rendezvous fake
-        # world (tests/net/fake_mpi.py) across the real processes and
-        # inject it as the backend's MPI module BEFORE Context
-        # construction selects the net backend
-        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-        import fake_mpi
-        from thrill_tpu.net import mpi as mpi_backend
-        ports = [int(p) for p in fakempi.split(",")]
-        mpi_backend.MPI = fake_mpi.connect_world(rank, nproc, ports)
+    from child_common import maybe_inject_fake_mpi
+    maybe_inject_fake_mpi(rank, nproc)
     res = RunDistributed(job, coordinator_address=coordinator,
                          num_processes=nproc, process_id=rank)
     print("RESULT " + json.dumps(res), flush=True)
