@@ -246,16 +246,23 @@ def make_inprocess_world(P: int) -> List[FakeMPIModule]:
 
 
 def connect_world(rank: int, P: int, ports: List[int],
-                  timeout_s: float = 20.0) -> FakeMPIModule:
+                  timeout_s: Optional[float] = None) -> FakeMPIModule:
     """TCP localhost full-mesh bootstrap for real multi-process ranks:
     rank r listens on ports[r], connects to every lower rank (sending
     its rank byte), accepts from every higher rank."""
+    # dead-peer diagnostic, load-scaled and RE-evaluated as the loops
+    # progress (fixed when the caller passed an explicit timeout):
+    # under contention peer children take minutes to reach their
+    # connect loop, and a load spike arriving mid-bootstrap must
+    # stretch an already-started wait
+    from thrill_tpu.common.timeouts import budget_fn
+    budget = budget_fn(timeout_s, 30.0)
     srv = socket.socket()
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     srv.bind(("127.0.0.1", ports[rank]))
     srv.listen(P)
     socks: Dict[int, socket.socket] = {}
-    deadline = time.monotonic() + timeout_s
+    start = time.monotonic()
     for j in range(rank):
         while True:
             try:
@@ -263,22 +270,30 @@ def connect_world(rank: int, P: int, ports: List[int],
                                              timeout=1.0)
                 break
             except OSError:
-                if time.monotonic() > deadline:
+                if time.monotonic() - start > budget():
                     raise TimeoutError(f"rank {rank}: cannot reach "
                                        f"rank {j} on port {ports[j]}")
                 time.sleep(0.05)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.sendall(bytes([rank]))
         socks[j] = s
-    srv.settimeout(timeout_s)
-    for _ in range(P - 1 - rank):
-        c, _addr = srv.accept()
+    srv.settimeout(1.0)                  # poll slice; budget below
+    accepted = 0
+    while accepted < P - 1 - rank:
+        if time.monotonic() - start > budget():
+            raise TimeoutError(f"rank {rank}: bootstrap accept "
+                               f"timed out")
+        try:
+            c, _addr = srv.accept()
+        except socket.timeout:
+            continue
         c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        c.settimeout(timeout_s)          # dead peer -> clean timeout
+        c.settimeout(budget())           # dead peer -> clean timeout
         hello = c.recv(1)
         if not hello:
             raise ConnectionError(
                 f"rank {rank}: peer closed before sending its rank byte")
         socks[hello[0]] = c
+        accepted += 1
     srv.close()
     return FakeMPIModule(FakeComm(rank, P, socks))

@@ -20,11 +20,9 @@ def load_scaled(base_s: float) -> float:
     """Scale a child/deadlock budget by observed machine load: a
     contended 1-core box (full suite + a background jax process) runs
     children several times slower, and a suite whose pass/fail depends
-    on background load erodes trust in green (round-4 verdict). Shared
-    by test_distributed and test_mpi so the policy cannot diverge."""
-    import os
-    try:
-        load = os.getloadavg()[0]
-    except OSError:
-        return base_s
-    return base_s * max(1.0, min(load, 6.0))
+    on background load erodes trust in green (round-4 verdict).
+    Delegates to the library's one copy of the policy
+    (thrill_tpu/common/timeouts.py) so parent-side drain budgets and
+    child-side distress deadlines can never diverge."""
+    from thrill_tpu.common.timeouts import scaled
+    return scaled(base_s)

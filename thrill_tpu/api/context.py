@@ -376,9 +376,22 @@ def RunDistributed(job: Callable[[Context], Any],
     WordCount test (tests/net/test_distributed.py).
     """
     if num_processes is not None and num_processes > 1:
+        # the coordinator handshake is a distress deadline like the
+        # net bootstraps: on a contended host a peer controller can
+        # take minutes of imports/compiles to reach it (see
+        # common/timeouts.py)
+        import inspect
+        from ..common.timeouts import scaled
+        kw = {}
+        try:
+            if "initialization_timeout" in inspect.signature(
+                    jax.distributed.initialize).parameters:
+                kw["initialization_timeout"] = int(scaled(300.0))
+        except (TypeError, ValueError):
+            pass            # builtins without introspectable signature
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
+            num_processes=num_processes, process_id=process_id, **kw)
     mex = MeshExec(devices=jax.devices())
     ctx = Context(mex, config, host_rank=process_id or 0)
     try:

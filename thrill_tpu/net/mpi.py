@@ -159,19 +159,30 @@ class _SendEngine:
                 if self.reap_locked() == 0:
                     return
 
-    def flush(self, timeout_s: float = 60.0) -> None:
-        """Complete every pending isend (group close / barrier point)."""
-        deadline = time.monotonic() + timeout_s
+    def flush(self, timeout_s: float | None = None) -> None:
+        """Complete every pending isend (group close / barrier point).
+
+        The deadline is a DIAGNOSTIC for a vanished peer, not flow
+        control — real MPI_Waitall blocks forever here — so it scales
+        with machine load (common/timeouts.py): a peer that is merely
+        slow under contention must not read as dead."""
+        from ..common.timeouts import budget_fn
+        # RE-evaluated each poll when defaulted (cadence-limited
+        # loadavg read): a load spike arriving near the distress point
+        # must stretch an already-started wait, not just future ones
+        budget = budget_fn(timeout_s, 120.0)
+        start = time.monotonic()
         while True:
             with _MPI_LOCK:
                 self.reap_locked()
                 if not self.pending:
                     return
-            if time.monotonic() > deadline:
+            b = budget()
+            if time.monotonic() - start > b:
                 raise TimeoutError(
                     f"MPI flush: {len(self.pending)} isends still "
-                    f"pending after {timeout_s}s (peer gone or matching "
-                    f"recv never posted)")
+                    f"pending after {b:.0f}s (peer gone or "
+                    f"matching recv never posted)")
             time.sleep(MpiConnection.POLL_S)
 
 

@@ -474,7 +474,7 @@ def parse_hostlist(s: str) -> List[Tuple[str, int]]:
 
 
 def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
-                        timeout: float = 30.0,
+                        timeout: Optional[float] = None,
                         secret: Optional[bytes] = None) -> TcpGroup:
     """Full-mesh bootstrap: rank j dials every i < j; i accepts j..p-1.
 
@@ -485,6 +485,18 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
     p = len(hosts)
     if p == 1:
         return TcpGroup(0, 1, {})
+    # bootstrap deadline is dead-peer DIAGNOSTIC, load-scaled and
+    # RE-evaluated as loops progress (fixed when the caller passed an
+    # explicit timeout): under contention peer processes legitimately
+    # take minutes to even reach their connect loop (imports + jax
+    # init), and a load spike arriving mid-bootstrap must stretch an
+    # already-started wait. The per-connection HANDSHAKE cap guards
+    # against a silent/rogue connection parking the accept thread —
+    # it scales too (a healthy peer can be descheduled >10 s at 6x).
+    from ..common.timeouts import budget_fn
+    budget = budget_fn(timeout, 60.0)
+    hs_cap = (budget_fn(None, 10.0) if timeout is None
+              else (lambda: min(10.0, float(timeout))))
     conns: Dict[int, TcpConnection] = {}
     lock = threading.Lock()
     errors: List[BaseException] = []
@@ -496,19 +508,22 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
             srv.bind((hosts[rank][0] if hosts[rank][0] != "localhost"
                       else "127.0.0.1", hosts[rank][1]))
             srv.listen(p)
-            srv.settimeout(timeout)
+            srv.settimeout(1.0)              # poll slice; budget below
             expected = p - 1 - rank          # ranks > mine dial in
             accepted = 0
-            accept_deadline = time.time() + timeout
+            accept_start = time.time()
             while accepted < expected:
-                if time.time() > accept_deadline:
+                if time.time() - accept_start > budget():
                     raise TimeoutError(
                         f"rank {rank}: bootstrap accept timed out")
-                s, addr = srv.accept()
+                try:
+                    s, addr = srv.accept()
+                except socket.timeout:
+                    continue
                 # accepted sockets do NOT inherit the listener timeout;
                 # without one, a silent connection would park this
                 # thread in recv forever and wedge the whole bootstrap
-                s.settimeout(min(10.0, timeout))
+                s.settimeout(hs_cap())
                 conn = TcpConnection(s)
                 try:
                     _exchange_auth_flag(conn, secret is not None)
@@ -537,12 +552,12 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
     acceptor = threading.Thread(target=accept_side, daemon=True)
     acceptor.start()
 
-    deadline = time.time() + timeout
+    dial_start = time.time()
     for peer in range(rank):                 # dial every lower rank
         while True:
             try:
                 s = socket.create_connection(hosts[peer], timeout=2.0)
-                s.settimeout(min(10.0, timeout))
+                s.settimeout(hs_cap())
                 conn = TcpConnection(s)
                 _exchange_auth_flag(conn, secret is not None)
                 if secret is not None:
@@ -557,13 +572,15 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
                 # transient dial error — fail fast with the real cause
                 raise
             except OSError:
-                if time.time() > deadline:
+                if time.time() - dial_start > budget():
                     raise TimeoutError(
                         f"rank {rank}: cannot reach rank {peer} at "
                         f"{hosts[peer]}")
                 time.sleep(0.05)
 
-    acceptor.join(timeout=timeout)
+    join_start = time.time()
+    while acceptor.is_alive() and time.time() - join_start <= budget():
+        acceptor.join(timeout=1.0)
     if acceptor.is_alive():
         raise TimeoutError(f"rank {rank}: bootstrap accept timed out")
     if errors:
