@@ -52,8 +52,19 @@ def budget_fn(override: Optional[float],
     """The one policy for distress-deadline dispatch: an explicit
     override is a FIXED budget (tests rely on determinism); otherwise
     the load-scaled base, re-evaluated on every call so a load spike
-    arriving mid-wait stretches an already-started deadline."""
+    arriving mid-wait stretches an already-started deadline. The
+    stretch is a RATCHET: once granted, a budget never contracts —
+    otherwise a wait started under load would spuriously expire the
+    moment the 1-minute loadavg decays (elapsed > newly-shrunk budget)
+    even though the now-unloaded peer is about to complete."""
     if override is not None:
         fixed = float(override)
         return lambda: fixed
-    return lambda: scaled(base_s)
+    best = scaled(base_s)
+
+    def ratchet() -> float:
+        nonlocal best
+        best = max(best, scaled(base_s))
+        return best
+
+    return ratchet

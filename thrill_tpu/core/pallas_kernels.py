@@ -5,7 +5,20 @@ segment sums are the innermost device loops of every shuffle (reference
 analog: the per-partition counters of ReducePrePhase,
 core/reduce_pre_phase.hpp:94). These kernels keep the accumulator in
 VMEM across a sequential grid over row blocks, and express the one-hot
-accumulation as a matmul so the MXU does the counting.
+accumulation as lane-parallel VPU compares and reductions (the
+stable-partition kernel in pallas_sort.py additionally rides the MXU
+for its within-row triangular prefix).
+
+Layout (settled by an on-chip round-5 lowering session — the original
+(1, BLOCK) row blocks violated Mosaic's (8, 128) trailing-dims rule,
+and the ``d.reshape(BLOCK, 1)`` one-hot pivot is a lane->sublane
+transpose Mosaic won't lower):
+
+* data tiles are ``(SUBLANES, COLS)`` = (8, 64) — 512 elements per
+  sequential grid step, elements ALWAYS on the lane axis;
+* bin/segment counters are ``(bins, 1)`` columns — bins on the
+  SUBLANE axis — so one-hot compares are pure broadcasts
+  ``iota(bins, COLS) == d_row(1, COLS)`` with no transposes anywhere.
 
 Usage is gated: ``partition_histogram`` dispatches to the Pallas kernel
 when THRILL_TPU_PALLAS=1 and the platform is a TPU, else to the jnp
@@ -22,8 +35,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-BLOCK = 512          # rows per grid step (multiple of the 128 lane width)
+BLOCK = 512          # elements per sequential grid step
 LANES = 128
+SUBLANES = 8         # Mosaic block rule: trailing dims divisible by
+                     # (8, 128) or equal to the array's dims
+COLS = BLOCK // SUBLANES   # 64 lanes per tile row
 
 
 def pallas_enabled() -> bool:
@@ -44,16 +60,17 @@ def _hist_kernel(dest_ref, out_ref, *, num_bins_padded: int):
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    d = dest_ref[:]                                   # [1, BLOCK] int32
     bins = jax.lax.broadcasted_iota(
-        jnp.int32, (BLOCK, num_bins_padded), 1)       # [BLOCK, B]
-    onehot = (d.reshape(BLOCK, 1) == bins).astype(jnp.float32)
-    # MXU-friendly: per-block count = ones[1,BLOCK] @ onehot[BLOCK,B].
-    # Block partials are <= BLOCK (exact in f32); the cross-block
-    # accumulator is int32 so totals never lose precision past 2^24.
-    ones = jnp.ones((1, BLOCK), jnp.float32)
-    partial = jnp.dot(ones, onehot, preferred_element_type=jnp.float32)
-    out_ref[:] += partial.astype(jnp.int32)
+        jnp.int32, (num_bins_padded, COLS), 0)        # [B, COLS]
+    acc = jnp.zeros((num_bins_padded, 1), jnp.float32)
+    for r in range(SUBLANES):                          # static unroll
+        d_r = dest_ref[r:r + 1, :]                     # [1, COLS] int32
+        onehot = (bins == d_r).astype(jnp.float32)     # [B, COLS]
+        # per-row count = lane reduce; partials <= BLOCK (exact in f32),
+        # the cross-block accumulator is int32 so totals never lose
+        # precision past 2^24
+        acc += jnp.sum(onehot, axis=1, keepdims=True)
+    out_ref[:] += acc.astype(jnp.int32)
 
 
 def partition_histogram_pallas(dest: jnp.ndarray, num_bins: int,
@@ -68,18 +85,18 @@ def partition_histogram_pallas(dest: jnp.ndarray, num_bins: int,
     n_pad = _round_up(max(n, 1), BLOCK)
     bpad = _round_up(max(num_bins, 1), LANES)
     d = jnp.full(n_pad, -1, jnp.int32).at[:n].set(dest.astype(jnp.int32))
-    d2 = d.reshape(n_pad // BLOCK, BLOCK)
+    d2 = d.reshape(n_pad // COLS, COLS)
 
     kernel = functools.partial(_hist_kernel, num_bins_padded=bpad)
     out = pl.pallas_call(
         kernel,
         grid=(n_pad // BLOCK,),
-        in_specs=[pl.BlockSpec((1, BLOCK), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((1, bpad), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((1, bpad), jnp.int32),
+        in_specs=[pl.BlockSpec((SUBLANES, COLS), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((bpad, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bpad, 1), jnp.int32),
         interpret=interpret,
     )(d2)
-    return out[0, :num_bins]
+    return out[:num_bins, 0]
 
 
 def partition_histogram(dest: jnp.ndarray, num_bins: int) -> jnp.ndarray:
@@ -104,13 +121,15 @@ def _segsum_kernel(seg_ref, val_ref, out_ref, *, num_segs_padded: int):
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    s = seg_ref[:]                                    # [1, BLOCK] int32
-    v = val_ref[:]                                    # [1, BLOCK] f32
     segs = jax.lax.broadcasted_iota(
-        jnp.int32, (BLOCK, num_segs_padded), 1)
-    onehot = (s.reshape(BLOCK, 1) == segs).astype(jnp.float32)
-    out_ref[:] += jnp.dot(v.reshape(1, BLOCK), onehot,
-                          preferred_element_type=jnp.float32)
+        jnp.int32, (num_segs_padded, COLS), 0)        # [S, COLS]
+    acc = jnp.zeros((num_segs_padded, 1), jnp.float32)
+    for r in range(SUBLANES):                          # static unroll
+        s_r = seg_ref[r:r + 1, :]                      # [1, COLS]
+        v_r = val_ref[r:r + 1, :]                      # [1, COLS] f32
+        onehot = (segs == s_r).astype(jnp.float32)     # [S, COLS]
+        acc += jnp.sum(onehot * v_r, axis=1, keepdims=True)
+    out_ref[:] += acc
 
 
 def segment_sum(seg_ids: jnp.ndarray, values: jnp.ndarray,
@@ -130,10 +149,9 @@ def segment_sum_pallas(seg_ids: jnp.ndarray, values: jnp.ndarray,
                        interpret: bool = False) -> jnp.ndarray:
     """Sum float32 ``values`` into ``num_segments`` buckets by seg id.
 
-    The one-hot matmul runs the accumulation on the MXU. This is the
-    specialized fast path for additive float reductions (dense
-    ReduceToIndex-style sums); the generic reduce pipeline keeps the
-    segmented associative scan, which supports arbitrary reduce
+    This is the specialized fast path for additive float reductions
+    (dense ReduceToIndex-style sums); the generic reduce pipeline keeps
+    the segmented associative scan, which supports arbitrary reduce
     functions.
     """
     from jax.experimental import pallas as pl
@@ -148,10 +166,10 @@ def segment_sum_pallas(seg_ids: jnp.ndarray, values: jnp.ndarray,
     out = pl.pallas_call(
         kernel,
         grid=(n_pad // BLOCK,),
-        in_specs=[pl.BlockSpec((1, BLOCK), lambda i: (i, 0)),
-                  pl.BlockSpec((1, BLOCK), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((1, spad), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((1, spad), jnp.float32),
+        in_specs=[pl.BlockSpec((SUBLANES, COLS), lambda i: (i, 0)),
+                  pl.BlockSpec((SUBLANES, COLS), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((spad, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((spad, 1), jnp.float32),
         interpret=interpret,
-    )(s.reshape(-1, BLOCK), v.reshape(-1, BLOCK))
-    return out[0, :num_segments]
+    )(s.reshape(-1, COLS), v.reshape(-1, COLS))
+    return out[:num_segments, 0]

@@ -41,14 +41,19 @@ from typing import List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from .pallas_kernels import (BLOCK, LANES, _round_up, pallas_enabled,
-                             partition_histogram)
+from .pallas_kernels import (BLOCK, COLS, LANES, SUBLANES, _round_up,
+                             pallas_enabled, partition_histogram)
 
 _F32_EXACT = 1 << 24
 
 
 def _part_kernel(base_ref, dest_ref, out_ref, run_ref, *,
                  num_bins_padded: int):
+    # Layout contract (see pallas_kernels module docstring): elements on
+    # the LANE axis in (SUBLANES, COLS) tiles, bins on the SUBLANE axis
+    # as (B, 1) columns — no transposes anywhere. The tile's sublane
+    # rows are processed in order (row-major element order) so the
+    # running per-digit counters stay sequentially consistent.
     from jax.experimental import pallas as pl
 
     pi = pl.program_id(0)
@@ -57,22 +62,24 @@ def _part_kernel(base_ref, dest_ref, out_ref, run_ref, *,
     def _init():
         run_ref[:] = base_ref[:].astype(jnp.float32)
 
-    d = dest_ref[:]                                    # [1, BLOCK]
     bins = jax.lax.broadcasted_iota(
-        jnp.int32, (BLOCK, num_bins_padded), 1)
-    onehot = (d.reshape(BLOCK, 1) == bins).astype(jnp.float32)
-    # strict lower-triangular matmul = exclusive within-tile prefix
-    rows = jax.lax.broadcasted_iota(jnp.float32, (BLOCK, BLOCK), 0)
-    cols = jax.lax.broadcasted_iota(jnp.float32, (BLOCK, BLOCK), 1)
-    tri = (rows > cols).astype(jnp.float32)
-    prefix = jnp.dot(tri, onehot,
-                     preferred_element_type=jnp.float32)  # [BLOCK, B]
-    within = jnp.sum(prefix * onehot, axis=1)             # [BLOCK]
-    start = jnp.sum(onehot * run_ref[:], axis=1)          # gather by digit
-    out_ref[:] = (start + within).reshape(1, BLOCK).astype(jnp.int32)
-    counts = jnp.dot(jnp.ones((1, BLOCK), jnp.float32), onehot,
-                     preferred_element_type=jnp.float32)
-    run_ref[:] += counts
+        jnp.int32, (num_bins_padded, COLS), 0)         # [B, COLS]
+    # upper-triangular matmul = exclusive within-row prefix along lanes:
+    # prefix[b, j] = #{k < j : d_k == b}
+    rows = jax.lax.broadcasted_iota(jnp.float32, (COLS, COLS), 0)
+    cols = jax.lax.broadcasted_iota(jnp.float32, (COLS, COLS), 1)
+    tri_u = (rows < cols).astype(jnp.float32)
+    for r in range(SUBLANES):                          # static unroll
+        d_r = dest_ref[r:r + 1, :]                     # [1, COLS]
+        onehot = (bins == d_r).astype(jnp.float32)     # [B, COLS]
+        prefix = jnp.dot(onehot, tri_u,
+                         preferred_element_type=jnp.float32)
+        within = jnp.sum(prefix * onehot, axis=0,
+                         keepdims=True)                # [1, COLS]
+        start = jnp.sum(onehot * run_ref[:], axis=0,
+                        keepdims=True)                 # gather by digit
+        out_ref[r:r + 1, :] = (start + within).astype(jnp.int32)
+        run_ref[:] += jnp.sum(onehot, axis=1, keepdims=True)
 
 
 def stable_partition_offsets_pallas(dest: jnp.ndarray, num_bins: int,
@@ -96,20 +103,20 @@ def stable_partition_offsets_pallas(dest: jnp.ndarray, num_bins: int,
         jnp.zeros(1, jnp.int32),
         jnp.cumsum(hist.astype(jnp.int32))])           # [num_bins + 1]
     base = jnp.pad(base, (0, bpad - num_bins - 1))
-    d2 = d.reshape(n_pad // BLOCK, BLOCK)
+    d2 = d.reshape(n_pad // COLS, COLS)
 
     kernel = functools.partial(_part_kernel, num_bins_padded=bpad)
     out = pl.pallas_call(
         kernel,
         grid=(n_pad // BLOCK,),
-        in_specs=[pl.BlockSpec((1, bpad), lambda i: (0, 0)),
-                  pl.BlockSpec((1, BLOCK), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((1, BLOCK), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n_pad // BLOCK, BLOCK),
+        in_specs=[pl.BlockSpec((bpad, 1), lambda i: (0, 0)),
+                  pl.BlockSpec((SUBLANES, COLS), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((SUBLANES, COLS), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad // COLS, COLS),
                                        jnp.int32),
-        scratch_shapes=[pltpu.VMEM((1, bpad), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bpad, 1), jnp.float32)],
         interpret=interpret,
-    )(base.reshape(1, bpad), d2)
+    )(base.reshape(bpad, 1), d2)
     return out.reshape(-1)[:n]
 
 
