@@ -57,20 +57,28 @@ def k_means(ctx: Context, points: np.ndarray, k: int, iterations: int = 10,
     pts = ctx.Distribute(points.astype(np.float64)).Cache() \
         .Keep(2 * iterations + 1)
 
+    # The Lloyd loop stays entirely in jax's async dispatch stream:
+    # AllGatherArrays returns the per-cluster sums as DEVICE arrays,
+    # the centroid update is eager device math, and the updated
+    # centers re-enter the classify program through Bind (device
+    # operands pass straight through). Zero blocking host syncs per
+    # iteration — on a tunneled chip each sync is a link round trip
+    # (BASELINE.md r5); the reference's AllReduce/broadcast step
+    # (k-means.hpp:176-259) is host-side and has no such cost.
+    import jax.numpy as jnp
+    centers = jnp.asarray(centers)
     for _ in range(iterations):
         labeled = pts.Map(Bind(_label, centers))
         sums = labeled.ReduceToIndex(
             _cluster_i, _cluster_sum,
             k, neutral={"i": 0, "x": np.zeros(dim), "cnt": 0.0})
-        agg = sums.AllGather()
-        new_centers = np.stack([np.asarray(t["x"]) for t in agg])
-        cnts = np.array([float(t["cnt"]) for t in agg])
-        nonzero = cnts > 0
-        new_centers[nonzero] /= cnts[nonzero, None]
-        new_centers[~nonzero] = centers[~nonzero]
-        centers = new_centers
+        cols = sums.AllGatherArrays()
+        cnt = cols["cnt"]
+        centers = jnp.where((cnt > 0)[:, None],
+                            cols["x"] / jnp.maximum(cnt, 1.0)[:, None],
+                            centers)
 
-    return centers
+    return np.asarray(centers)
 
 
 def k_means_dense(points: np.ndarray, centers0: np.ndarray,

@@ -104,15 +104,26 @@ def page_rank(ctx: Context, edges: np.ndarray, num_pages: int,
     base = np.array([(1.0 - DAMPENING) / num_pages])
     ranks = ctx.Generate(num_pages).Map(Bind(_fill, inv_n)).Cache()
 
+    # both joins are index joins with known multiplicity — every edge
+    # matches exactly one page row — so each worker emits at most its
+    # edge count. At W == 1 that bound is exact: pass it as
+    # out_size_hint so the joins skip their blocking size sync (one
+    # tunnel RTT per join per iteration, BASELINE.md r5). At W > 1 the
+    # hash exchange can skew edges onto one worker, where the only
+    # safe global bound would W-fold the padding — not worth it there.
+    hint = len(src) if ctx.num_workers == 1 else None
+
     for _ in range(iterations):
         # rank/degree per page, joined to edges by source page
         ranks_idx = ranks.ZipWithIndex(_rank_pair)
         contrib = InnerJoin(edges_dia, ranks_idx,
-                            _edge_src, _page_p, _join_rank)
+                            _edge_src, _page_p, _join_rank,
+                            out_size_hint=hint)
         # divide by out-degree: join against degree table
         deg_pairs = deg_dia.ZipWithIndex(_deg_pair)
         contrib2 = InnerJoin(contrib, deg_pairs,
-                             _contrib_src, _page_p, _join_deg)
+                             _contrib_src, _page_p, _join_deg,
+                             out_size_hint=hint)
         sums = contrib2.ReduceToIndex(
             _contrib_dst, _sum_v, num_pages, neutral={"d": 0, "v": 0.0})
         ranks = sums.Map(Bind(_dampen, base)).Cache()

@@ -1,0 +1,194 @@
+"""Dispatch-budget regression tests.
+
+Round-5 on-chip profiling (BASELINE.md) measured the axon tunnel's
+per-dispatch round trip at 140.7 ms — on a tunneled chip DISPATCH AND
+SYNC COUNT, not FLOPs or bytes, governs small-to-medium pipeline cost.
+These tests pin the budgets so a future change can't silently add a
+mid-pipeline host sync or an uncached plan upload. (The reference has
+no analog: its workers run host-side, a "dispatch" is a function call.
+This is the TPU-native counterpart of its no-per-item-virtual-call
+discipline, SURVEY.md §7.)
+
+THRILL_TPU_HOST_RADIX=0 forces the jitted device engines on the CPU
+test mesh (otherwise W=1 sorts/reduces run in the native host engine
+with zero device dispatches, which is correct but not what these tests
+measure).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from thrill_tpu.api import Bind, Context, FieldReduce, InnerJoin
+from thrill_tpu.parallel.mesh import MeshExec
+
+
+@pytest.fixture(autouse=True)
+def _force_device_engines(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "0")
+
+
+def _snap(mex):
+    return np.array([mex.stats_dispatches, mex.stats_uploads,
+                     mex.stats_fetches])
+
+
+def _key(t):
+    return t["key"]
+
+
+def _wc_key(t):
+    return t["w"]
+
+
+def _terasort_data(n):
+    rng = np.random.default_rng(0)
+    return {"key": rng.integers(0, 256, size=(n, 10)).astype(np.uint8),
+            "value": rng.integers(0, 256, size=(n, 90)).astype(np.uint8)}
+
+
+def test_terasort_w1_single_dispatch():
+    """The whole W=1 sort (encode + argsort + payload gather) is ONE
+    fused program, zero plan uploads, zero syncs in steady state."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    inp = ctx.Distribute(_terasort_data(2048))
+    jax.block_until_ready(jax.tree.leaves(
+        inp.node.materialize(consume=False).tree))
+
+    def run():
+        inp.Keep()
+        sh = inp.Sort(key_fn=_key).node.materialize()
+        jax.block_until_ready(jax.tree.leaves(sh.tree))
+
+    run()                                     # warm (compile + caches)
+    s0 = _snap(mex)
+    run()
+    assert tuple(_snap(mex) - s0) == (1, 0, 0)
+
+
+def test_wordcount_w1_single_dispatch():
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    n = 2048
+    rng = np.random.default_rng(1)
+    words = rng.integers(0, 64, size=(n, 8)).astype(np.uint8)
+    d = ctx.Distribute({"w": words, "c": np.ones(n, np.int64)})
+    d.Keep()
+    red = FieldReduce({"w": "first", "c": "sum"})
+
+    def run():
+        d.Keep()
+        sh = d.ReduceByKey(_wc_key, red).node.materialize()
+        jax.block_until_ready(jax.tree.leaves(sh.tree))
+
+    run()
+    s0 = _snap(mex)
+    run()
+    assert tuple(_snap(mex) - s0) == (1, 0, 0)
+
+
+def test_pagerank_full_run_budget():
+    """A full 4-iteration PageRank run: plan uploads stay cached
+    (put_small), join size syncs are skipped (out_size_hint), map
+    stacks hand host counts through — at most one blocking fetch for
+    the entire run (the final AllGather egress)."""
+    sys.path.insert(0, "examples")
+    import page_rank as pr
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    edges = pr.zipf_graph(512, 4096)
+    want = pr.page_rank_dense(ctx, edges, 512, iterations=4)
+    got = pr.page_rank(ctx, edges, 512, iterations=4)   # warm + parity
+    assert np.allclose(got, want, rtol=1e-6)
+    s0 = _snap(mex)
+    pr.page_rank(ctx, edges, 512, iterations=4)
+    disp, up, fetch = (_snap(mex) - s0).tolist()
+    assert disp <= 40, disp
+    assert up <= 4, up
+    assert fetch <= 2, fetch
+
+
+def test_kmeans_full_run_zero_syncs():
+    """The Lloyd loop never blocks: device-resident centroids via
+    AllGatherArrays + Bind; ZERO fetches for the whole run."""
+    sys.path.insert(0, "examples")
+    import k_means as km
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    rng = np.random.default_rng(0)
+    pts = rng.random((2048, 8)).astype(np.float64)
+    centers0 = pts[np.random.default_rng(3).choice(
+        2048, size=4, replace=False)].copy()
+    want = km.k_means_dense(pts, centers0, 3)
+    got = km.k_means(ctx, pts, 4, iterations=3, seed=3)   # warm + parity
+    assert np.allclose(got, want, rtol=1e-8)
+    s0 = _snap(mex)
+    km.k_means(ctx, pts, 4, iterations=3, seed=3)
+    disp, up, fetch = (_snap(mex) - s0).tolist()
+    assert fetch == 0, fetch
+    assert disp <= 10, disp
+    assert up <= 2, up
+
+
+def test_put_small_content_cache():
+    mex = MeshExec(num_workers=2)
+    a = np.arange(4, dtype=np.int64)[:, None].repeat(2, 0)[:2]
+    u0 = mex.stats_uploads
+    b1 = mex.put_small(np.array([[3], [4]], np.int32))
+    b2 = mex.put_small(np.array([[3], [4]], np.int32))
+    assert b1 is b2
+    assert mex.stats_uploads == u0 + 1
+    b3 = mex.put_small(np.array([[3], [5]], np.int32))
+    assert b3 is not b1
+    del a
+
+
+def test_allgather_arrays_device_and_host():
+    mex = MeshExec(num_workers=4)
+    ctx = Context(mex)
+    d = ctx.Distribute(np.arange(37, dtype=np.int64)).Keep()
+    cols = d.AllGatherArrays()
+    assert isinstance(cols, jax.Array)
+    assert np.array_equal(np.sort(np.asarray(cols)), np.arange(37))
+    # host-storage path returns numpy-stacked leaves
+    h = ctx.Distribute(list(range(10)), storage="host")
+    cols_h = h.AllGatherArrays()
+    assert sorted(np.asarray(cols_h).tolist()) == list(range(10))
+
+
+def test_allgather_arrays_empty():
+    mex = MeshExec(num_workers=2)
+    ctx = Context(mex)
+    d = ctx.Distribute(np.arange(8, dtype=np.int64)).Filter(
+        lambda x: x < 0)
+    cols = d.AllGatherArrays()
+    assert np.asarray(cols).shape[0] == 0
+
+
+def _idkey(x):
+    return x
+
+
+def _takeleft(a, b):
+    return a
+
+
+def test_join_out_size_hint_correct_and_overflow():
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    l = ctx.Distribute(np.arange(16, dtype=np.int64))
+    r = ctx.Distribute(np.arange(8, 16, dtype=np.int64))
+    j = InnerJoin(l, r, _idkey, _idkey, _takeleft, out_size_hint=8)
+    assert sorted(j.AllGather()) == list(range(8, 16))
+
+    l2 = ctx.Distribute([1, 1, 1, 1])
+    r2 = ctx.Distribute([1, 1, 1, 1])
+    j2 = InnerJoin(l2, r2, _idkey, _idkey, _takeleft, out_size_hint=4)
+    with pytest.raises(ValueError, match="out_size_hint"):
+        j2.AllGather()

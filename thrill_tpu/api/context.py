@@ -265,6 +265,12 @@ class Context:
             "exchanges": mex.stats_exchanges,
             "items_moved": mex.stats_items_moved,
             "bytes_moved": mex.stats_bytes_moved,
+            # on a tunneled chip each dispatch/upload costs one link
+            # RTT (140.7 ms measured, BASELINE.md r5) — the governing
+            # pipeline cost; see tests/api/test_dispatch_budget.py
+            "device_dispatches": mex.stats_dispatches,
+            "device_uploads": mex.stats_uploads,
+            "device_fetches": mex.stats_fetches,
             "host_mem_peak": self.mem.peak,
             "hbm_peak": self.hbm.mem.peak,
             "hbm_spills": self.hbm.spill_count,

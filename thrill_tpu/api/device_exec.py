@@ -61,5 +61,12 @@ def apply_stack_device(shards: DeviceShards, stack: Stack) -> DeviceShards:
     fn, h = mex.cached(key, build)
     out = fn(shards.counts_device(), *leaves, *b_leaves)
     tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
-    # counts stay on device: no host sync between chained programs
+    # counts stay on device: no host sync between chained programs.
+    # All-'map' stacks preserve counts exactly — when the input counts
+    # are already host-known, hand them through so a downstream plan
+    # step (ZipWithIndex offsets, exchange sizing) doesn't owe a
+    # device->host sync for numbers the host never lost
+    if shards._counts_host is not None and \
+            all(op.kind == "map" for op in stack):
+        return DeviceShards(mex, tree, shards._counts_host.copy())
     return DeviceShards(mex, tree, out[0])

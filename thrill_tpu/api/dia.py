@@ -224,6 +224,13 @@ class DIA:
         from .ops import actions
         return actions.AllGather(self)
 
+    def AllGatherArrays(self):
+        """Columnar AllGather: one pytree of stacked leaves [total, ...]
+        — device arrays on the device path (no host sync; feed them to
+        the next iteration's Bind directly)."""
+        from .ops import actions
+        return actions.AllGatherArrays(self)
+
     def Gather(self, root: int = 0) -> list:
         from .ops import actions
         return actions.Gather(self, root)
@@ -299,10 +306,15 @@ def Union(*dias: DIA) -> DIA:
 
 def InnerJoin(left: DIA, right: DIA, left_key_fn: Callable,
               right_key_fn: Callable, join_fn: Callable,
-              location_detection: bool = False) -> DIA:
+              location_detection: bool = False,
+              out_size_hint=None) -> DIA:
     """``location_detection`` (reference: LocationDetectionTag) prunes
     items whose key exists on only one side before the shuffle —
-    host-storage path only; the device path ignores the flag."""
+    host-storage path only; the device path ignores the flag.
+    ``out_size_hint``: optional per-worker match-count upper bound —
+    the device path then skips its blocking size sync (overflow raises
+    at the next host fetch, never silently truncates)."""
     from .ops import join as _j
     return _j.InnerJoin(left, right, left_key_fn, right_key_fn, join_fn,
-                        location_detection=location_detection)
+                        location_detection=location_detection,
+                        out_size_hint=out_size_hint)

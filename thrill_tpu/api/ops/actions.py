@@ -40,6 +40,51 @@ def AllGather(dia) -> list:
     return multiplexer.all_items(dia.context.mesh_exec, shards)
 
 
+def AllGatherArrays(dia):
+    """Columnar egress: the DIA's items as ONE pytree of stacked
+    arrays, leaves ``[total, ...]``. On the device path the leaves are
+    DEVICE arrays assembled by async slicing — no host fetch, no
+    per-item boxing — so an iterative driver (the k-means centroid
+    update) can compute on the result and feed it straight back into
+    the next ``Bind`` without ever leaving jax's dispatch stream.
+    TPU-native extension: the reference's AllGather materializes a
+    std::vector of items host-side (api/all_gather.hpp:28), which on a
+    tunneled chip costs a link round trip per iteration.
+
+    Host-storage DIAs return numpy-stacked leaves (same tree shape);
+    an EMPTY host-storage DIA returns ``[]`` (item structure is
+    unknowable without items — the device path, whose columns carry
+    their structure, returns zero-length leaves instead). Scalar items
+    come back as a single stacked array."""
+    shards = _pull(dia)
+    mex = dia.context.mesh_exec
+    if isinstance(shards, HostShards):
+        items = multiplexer.all_items(mex, shards)
+        if not items:
+            return items
+        return jax.tree.map(lambda *ls: np.stack(ls), *items)
+    counts = shards.counts               # host plan values (often known)
+    W = len(counts)
+    tree = shards.tree
+    if multiplexer.multiprocess(mex):
+        # leaves span non-addressable devices: realize on every
+        # controller (numpy result — the zero-sync device contract
+        # only holds single-controller, where the tunnel RTT lives)
+        tree = jax.tree.map(mex.fetch, tree)
+
+    def cat(leaf):
+        parts = [leaf[w, :int(counts[w])] for w in range(W)
+                 if int(counts[w])]
+        if not parts:
+            return leaf[0, :0]
+        if len(parts) == 1:
+            return parts[0]
+        xp = np if isinstance(leaf, np.ndarray) else jnp
+        return xp.concatenate(parts, axis=0)
+
+    return jax.tree.map(cat, tree)
+
+
 def Gather(dia, root: int = 0) -> list:
     """Items of the whole DIA, delivered to worker ``root`` only
     (reference: api/gather.hpp:28). Single-controller runs ARE every

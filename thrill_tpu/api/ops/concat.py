@@ -55,7 +55,7 @@ def rebalance_to_even(mex, parts: List[DeviceShards], token) -> DeviceShards:
             return mex.smap(f, 1 + nleaves), holder
 
         fn, h = mex.cached(key, build)
-        out = fn(mex.put(offs.astype(np.int64)[:, None]), *leaves)
+        out = fn(mex.put_small(offs.astype(np.int64)[:, None]), *leaves)
         tree = jax.tree.unflatten(h["treedef"], list(out))
         carriers.append(DeviceShards(mex, tree, p.counts.copy()))
 

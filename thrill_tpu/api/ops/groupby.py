@@ -452,7 +452,7 @@ class GroupToIndexNode(DIABase):
 
         fn, h = mex.cached(key, build)
         out = fn(shards.counts_device(),
-                 mex.put(bounds[:-1].astype(np.int64)[:, None]), *leaves)
+                 mex.put_small(bounds[:-1].astype(np.int64)[:, None]), *leaves)
         tree = jax.tree.unflatten(h["treedef"], list(out))
         # per-worker result counts are the host-known range sizes — no
         # device round trip needed

@@ -32,7 +32,8 @@ from ..dia_base import DIABase
 
 class InnerJoinNode(DIABase):
     def __init__(self, ctx, llink, rlink, lkey, rkey, join_fn,
-                 location_detection: bool = False) -> None:
+                 location_detection: bool = False,
+                 out_size_hint=None) -> None:
         super().__init__(ctx, "InnerJoin", [llink, rlink])
         self.lkey = lkey
         self.rkey = rkey
@@ -41,6 +42,18 @@ class InnerJoinNode(DIABase):
         # prune items whose key hash exists on only one side before the
         # shuffle (host path)
         self.location_detection = location_detection
+        # PER-WORKER output capacity hint: when the caller knows an
+        # upper bound on each worker's match count (index joins with
+        # known multiplicity — PageRank's edges-by-src join emits
+        # exactly one pair per edge), the device path skips its
+        # blocking device->host size sync and keeps the whole join in
+        # jax's async-dispatch stream. On a tunneled chip that sync is
+        # a full link RTT per join per iteration (BASELINE.md r5).
+        # Overflow is detected at the next natural counts realization
+        # and raises (never silently truncates). TPU-native extension:
+        # the reference sizes from its spilled files host-side
+        # (api/inner_join.hpp:208) and has no such sync to skip.
+        self.out_size_hint = out_size_hint
 
     def compute(self):
         left = self.parents[0].pull()
@@ -186,12 +199,16 @@ class InnerJoinNode(DIABase):
         f1 = mex.cached(key1, build1)
         out1 = f1(left.counts_device(), right.counts_device(),
                   *lleaves, *rleaves)
-        totals = mex.fetch(out1[0]).reshape(-1).astype(np.int64)
         matches_dev, lo_dev = out1[1], out1[2]
         lsorted = list(out1[3:3 + nl])
         rsorted = list(out1[3 + nl:])
 
-        out_cap = round_up_pow2(max(int(totals.max()), 1))
+        totals = None
+        if self.out_size_hint is not None:
+            out_cap = round_up_pow2(max(int(self.out_size_hint), 1))
+        else:
+            totals = mex.fetch(out1[0]).reshape(-1).astype(np.int64)
+            out_cap = round_up_pow2(max(int(totals.max()), 1))
 
         # phase 2: expand pairs and apply join_fn
         key2 = ("join_expand", token, lcap, rcap, out_cap, ltd, rtd,
@@ -231,7 +248,36 @@ class InnerJoinNode(DIABase):
         f2, h2 = mex.cached(key2, build2)
         out2 = f2(matches_dev, lo_dev, *lsorted, *rsorted)
         tree = jax.tree.unflatten(h2["treedef"], list(out2))
-        return DeviceShards(mex, tree, totals)
+        if totals is not None:
+            return DeviceShards(mex, tree, totals)
+        # hint path: counts stay on device (no host sync; the eager
+        # astype is one more async device op in the stream)
+        out = DeviceShards(mex, tree, out1[0].astype(jnp.int32))
+        cap, hint, totals_dev = out_cap, self.out_size_hint, out1[0]
+        fired = [False]
+
+        def validate(counts: np.ndarray) -> None:
+            if fired[0]:
+                return
+            fired[0] = True
+            if counts.max(initial=0) > cap:
+                raise ValueError(
+                    f"InnerJoin out_size_hint={hint} (cap {cap}) "
+                    f"overflowed: a worker produced "
+                    f"{int(counts.max())} pairs; results were "
+                    f"truncated — raise the hint or drop it")
+
+        out._counts_check = validate
+        # fetch drains catch chains that never realize THIS shards'
+        # counts (the join output feeding device programs only). The
+        # fired guard comes FIRST so an already-validated join never
+        # pays the totals transfer again, and the transfer goes
+        # through mex.fetch for multi-controller safety (re-entrancy
+        # is fine: the drain swaps _pending_checks out before running)
+        mex._pending_checks.append(
+            lambda: None if fired[0]
+            else validate(mex._fetch_raw(totals_dev).reshape(-1)))
+        return out
 
 
 # presence-register width for device LocationDetection (false positives
@@ -362,7 +408,12 @@ def _h(k):
 
 
 def InnerJoin(left: DIA, right: DIA, left_key_fn, right_key_fn,
-              join_fn, location_detection: bool = False) -> DIA:
+              join_fn, location_detection: bool = False,
+              out_size_hint=None) -> DIA:
+    """``out_size_hint``: optional per-worker upper bound on match
+    count; lets the device path skip its blocking size sync (overflow
+    raises at the next host fetch, never silently truncates)."""
     return DIA(InnerJoinNode(left.context, left._link(), right._link(),
                              left_key_fn, right_key_fn, join_fn,
-                             location_detection=location_detection))
+                             location_detection=location_detection,
+                             out_size_hint=out_size_hint))

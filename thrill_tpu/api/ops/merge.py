@@ -118,7 +118,7 @@ def _device_merge(inputs: List[DeviceShards], key_fn: Callable,
 
         f1, h1 = mex.cached(key1, build1)
         sw, si, sv = f1(shards.counts_device(),
-                        mex.put(offsets.astype(np.int64)[:, None]),
+                        mex.put_small(offsets.astype(np.int64)[:, None]),
                         *leaves)
         nwords_holder.update(h1)
         samples_per_input.append((mex.fetch(sw), mex.fetch(si),
@@ -163,7 +163,7 @@ def _device_merge(inputs: List[DeviceShards], key_fn: Callable,
 
             f2 = mex.cached(key2, build2a)
             out2 = f2(shards.counts_device(),
-                      mex.put(offsets.astype(np.int64)[:, None]),
+                      mex.put_small(offsets.astype(np.int64)[:, None]),
                       *leaves)
             carrier_tree = {"__words": out2[0], "__gidx": out2[1],
                             "tree": jax.tree.unflatten(treedef,
@@ -205,10 +205,10 @@ def _device_merge(inputs: List[DeviceShards], key_fn: Callable,
                             + (P(AXIS),) * (2 + nleaves))
 
         f2 = mex.cached(key2, build2)
-        spl_dev = mex.put(np.broadcast_to(
+        spl_dev = mex.put_small(np.broadcast_to(
             splitters, (W,) + splitters.shape).copy())
         out2 = f2(spl_dev, shards.counts_device(),
-                  mex.put(offsets.astype(np.int64)[:, None]), *leaves)
+                  mex.put_small(offsets.astype(np.int64)[:, None]), *leaves)
         sorted_dest, send_mat = out2[0], out2[1]
         carrier_tree = {"__words": out2[2], "__gidx": out2[3],
                         "tree": jax.tree.unflatten(treedef,

@@ -803,8 +803,8 @@ class ReduceToIndexNode(DIABase):
             return mex.smap(f, 3 + len(leaves))
 
         fn = mex.cached(key, build)
-        rs = mex.put(bounds[:W].astype(np.int64)[:, None])
-        rsz = mex.put(local_sizes[:, None])
+        rs = mex.put_small(bounds[:W].astype(np.int64)[:, None])
+        rsz = mex.put_small(local_sizes[:, None])
         out = fn(shards.counts_device(), rs, rsz, *leaves)
         tree = jax.tree.unflatten(treedef, list(out[1:]))
         return DeviceShards(mex, tree, local_sizes)

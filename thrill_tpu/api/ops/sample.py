@@ -117,7 +117,7 @@ class SampleNode(DIABase):
 
         fn = mex.cached(key, build)
         out = fn(shards.counts_device(),
-                 mex.put(takes.astype(np.int64)[:, None]), *leaves)
+                 mex.put_small(takes.astype(np.int64)[:, None]), *leaves)
         tree = jax.tree.unflatten(treedef, list(out[1:]))
         return DeviceShards(mex, tree, out[0])
 

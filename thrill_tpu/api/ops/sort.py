@@ -531,7 +531,7 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
 
     f1, h1 = mex.cached(key1, build1)
     out1 = f1(shards.counts_device(),
-              mex.put(offsets.astype(np.int64)[:, None]), *leaves)
+              mex.put_small(offsets.astype(np.int64)[:, None]), *leaves)
     words_mat, gidx_s, perm_dev, s_words, s_idx, s_valid = out1
     nwords = h1["nwords"]
 
@@ -577,7 +577,7 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
                         out_specs=(P(AXIS), P()) + (P(AXIS),) * len(leaves))
 
     f2 = mex.cached(key2, build2)
-    spl_dev = mex.put(np.broadcast_to(
+    spl_dev = mex.put_small(np.broadcast_to(
         splitters, (W,) + splitters.shape).copy())
     out2 = f2(spl_dev, words_mat, gidx_s, perm_dev,
               shards.counts_device(), *leaves)
@@ -775,8 +775,8 @@ def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
         return mex.smap(f, 5 + len(sorted_payload))
 
     fb = mex.cached(key, build)
-    srow = mex.put(S.astype(np.int32))
-    scol = mex.put(S.T.copy().astype(np.int32))
+    srow = mex.put_small(S.astype(np.int32))
+    scol = mex.put_small(S.T.copy().astype(np.int32))
     out = fb(sorted_dest, srow, scol, words_mat, gidx_s, *sorted_payload)
     tree = jax.tree.unflatten(treedef, list(out))
     return DeviceShards(mex, tree, new_counts)

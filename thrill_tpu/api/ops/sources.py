@@ -47,7 +47,7 @@ class GenerateNode(DIABase):
                           dtype=np.int64)
         cap = max(1, 1 << (int(counts.max()) - 1).bit_length()) \
             if counts.max() > 0 else 1
-        starts = mex.put(np.array(bounds[:W], dtype=np.int64)[:, None])
+        starts = mex.put_small(np.array(bounds[:W], dtype=np.int64)[:, None])
         fn = self.fn
         holder = {}
         key = ("generate", n, cap, fn)

@@ -89,7 +89,7 @@ def _windowed_device_program(shards: DeviceShards, k: int, cache_tag,
 
     f, h = mex.cached(key, build)
     out = f(shards.counts_device(),
-            mex.put(offsets.astype(np.int64)[:, None]), *leaves)
+            mex.put_small(offsets.astype(np.int64)[:, None]), *leaves)
     tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
     return DeviceShards(mex, tree, out[0])
 

@@ -298,7 +298,7 @@ class ZipWithIndexNode(DIABase):
             return mex.smap(f, 1 + len(leaves)), holder
 
         fn, h = mex.cached(key, build)
-        out = fn(mex.put(offsets.astype(np.int64)[:, None]), *leaves)
+        out = fn(mex.put_small(offsets.astype(np.int64)[:, None]), *leaves)
         tree = jax.tree.unflatten(h["treedef"], list(out))
         return DeviceShards(mex, tree, shards.counts.copy())
 

@@ -197,7 +197,7 @@ def exchange_stream(shards: DeviceShards, dest_builder: Callable,
     caps = _sticky_caps(mex, cap_ident, needed)
     mex.stats_padded_rows += sum(caps)
 
-    srow = mex.put(S.astype(np.int32))
+    srow = mex.put_small(S.astype(np.int32))
 
     def round_program(r: int, to, M_r: int):
         key = ("xchg_stream_round", cap, M_r, W,
@@ -465,8 +465,8 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
         return mex.smap(fb, 3 + len(sorted_leaves))
 
     fb = mex.cached(key_b, build_b)
-    srow = mex.put(S.astype(np.int32))            # row w on worker w
-    scol = mex.put(S.T.copy().astype(np.int32))   # col w on worker w
+    srow = mex.put_small(S.astype(np.int32))            # row w on worker w
+    scol = mex.put_small(S.T.copy().astype(np.int32))   # col w on worker w
     out_leaves = list(fb(sorted_dest, srow, scol, *sorted_leaves))
     tree = jax.tree.unflatten(treedef, out_leaves)
     return DeviceShards(mex, tree, new_counts)
@@ -549,8 +549,8 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
         return mex.smap(fb, 3 + len(sorted_leaves))
 
     fb = mex.cached(key_b, build_b)
-    srow = mex.put(S.astype(np.int32))
-    scol = mex.put(S.T.copy().astype(np.int32))
+    srow = mex.put_small(S.astype(np.int32))
+    scol = mex.put_small(S.T.copy().astype(np.int32))
     out_leaves = list(fb(sorted_dest, srow, scol, *sorted_leaves))
     tree = jax.tree.unflatten(treedef, out_leaves)
     return DeviceShards(mex, tree, new_counts)
@@ -619,11 +619,11 @@ def _exchange_ragged(mex: MeshExec, treedef, sorted_leaves, S: np.ndarray,
            tuple((l.dtype, l.shape[1:]) for l in sorted_leaves))
     fb = mex.cached(key, lambda: _ragged_builder(mex, out_cap,
                                                  len(sorted_leaves)))
-    srow = mex.put(S.astype(np.int32))
-    scol = mex.put(S.T.copy().astype(np.int32))
+    srow = mex.put_small(S.astype(np.int32))
+    scol = mex.put_small(S.T.copy().astype(np.int32))
     # landing[w, d] = sum of S[0:w, d] (receiver-side offset of w's chunk)
     landing = (np.cumsum(S, axis=0) - S).astype(np.int32)
-    out_leaves = list(fb(srow, scol, mex.put(landing), *sorted_leaves))
+    out_leaves = list(fb(srow, scol, mex.put_small(landing), *sorted_leaves))
     tree = jax.tree.unflatten(treedef, out_leaves)
     return DeviceShards(mex, tree, new_counts)
 

@@ -20,7 +20,7 @@ Two storage classes implement one concept:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -92,6 +92,11 @@ class DeviceShards:
         else:
             self._counts_host = None
             self._counts_dev = counts          # sharded [W, 1] int32
+        # optional deferred validation run when lazy device counts are
+        # first realized on the host (e.g. InnerJoin out_size_hint
+        # overflow detection — the op skipped its blocking size sync
+        # and owes the check at the next natural host realization)
+        self._counts_check: Optional[Callable[[np.ndarray], None]] = None
 
     @property
     def counts(self) -> np.ndarray:
@@ -99,6 +104,9 @@ class DeviceShards:
         if self._counts_host is None:
             self._counts_host = self.mesh_exec.fetch(
                 self._counts_dev).reshape(-1).astype(np.int64)
+            if self._counts_check is not None:
+                check, self._counts_check = self._counts_check, None
+                check(self._counts_host)
         return self._counts_host
 
     @property
@@ -117,7 +125,7 @@ class DeviceShards:
         """Counts as a sharded [W, 1] device array (one scalar per
         shard); cached so repeated programs reuse one transfer."""
         if self._counts_dev is None:
-            self._counts_dev = self.mesh_exec.put(
+            self._counts_dev = self.mesh_exec.put_small(
                 self.counts.astype(np.int32)[:, None])
         return self._counts_dev
 

@@ -50,6 +50,22 @@ class MeshExec:
         self.stats_bytes_moved = 0
         # padded rows allocated by exchange plans (skew diagnostics)
         self.stats_padded_rows = 0
+        # device-program dispatch / host<->device transfer counters.
+        # On a tunneled chip every dispatch pays the link round trip
+        # (measured 140.7 ms on the axon tunnel, BASELINE.md round 5),
+        # so DISPATCH COUNT — not FLOPs or bytes — is the governing
+        # cost model for small-to-medium pipelines; these counters make
+        # it observable and testable (tests/api/test_dispatch_budget.py)
+        self.stats_dispatches = 0
+        self.stats_uploads = 0
+        self.stats_fetches = 0
+        self.stats_upload_cache_hits = 0
+        self._put_small_cache: Dict[Any, jax.Array] = {}
+        # deferred device-side validations (e.g. InnerJoin
+        # out_size_hint overflow): ops that skip a blocking host sync
+        # enqueue a check here; every host fetch drains the queue, so
+        # no pipeline can reach its action egress past a failed check
+        self._pending_checks: list = []
         # ICI-vs-DCN split of bytes_moved (multi-slice meshes; equal to
         # bytes_moved/0 on a single slice)
         self.stats_bytes_ici = 0
@@ -139,6 +155,7 @@ class MeshExec:
         across processes — but builds like ReadWordsPacked/ReadBinary
         legitimately hold real data only for their own workers' rows,
         with agreed shapes/counts and zero padding elsewhere)."""
+        self.stats_uploads += 1
         if self.num_processes > 1:
             arr = np.asarray(arr)
             assert arr.shape[0] % self.num_workers == 0, arr.shape
@@ -153,6 +170,27 @@ class MeshExec:
     def put_tree(self, tree):
         return jax.tree.map(self.put, tree)
 
+    def put_small(self, arr) -> jax.Array:
+        """Content-cached ``put`` for small recurring plan arrays
+        (shard counts, zip offsets, range bounds). Iterative pipelines
+        re-upload identical tiny arrays every iteration — on a tunneled
+        chip each is a link round trip (BASELINE.md r5) — and device
+        buffers are immutable, so sharing one upload per distinct value
+        is safe. Falls through to plain put() above 4 KiB."""
+        arr = np.asarray(arr)
+        if arr.nbytes > 4096:
+            return self.put(arr)
+        key = (arr.shape, arr.dtype.str, arr.tobytes())
+        buf = self._put_small_cache.get(key)
+        if buf is None:
+            if len(self._put_small_cache) >= 4096:   # unbounded-growth cap
+                self._put_small_cache.clear()
+            buf = self.put(arr)
+            self._put_small_cache[key] = buf
+        else:
+            self.stats_upload_cache_hits += 1
+        return buf
+
     def fetch(self, arr) -> np.ndarray:
         """Device -> host fetch that is multi-controller safe.
 
@@ -160,6 +198,19 @@ class MeshExec:
         devices (other processes' chips); those are gathered across
         processes first. Single-process meshes take the direct path.
         """
+        if isinstance(arr, jax.Array):
+            self.stats_fetches += 1
+        if self._pending_checks:
+            checks, self._pending_checks = self._pending_checks, []
+            for c in checks:
+                c()
+        return self._fetch_raw(arr)
+
+    def _fetch_raw(self, arr) -> np.ndarray:
+        """fetch() without stats or check-draining — for the deferred
+        checks themselves (their transfers are tiny, ride a completed
+        program, and must not read as mid-pipeline syncs in the
+        dispatch-budget accounting)."""
         if getattr(arr, "is_fully_addressable", True):
             return np.asarray(arr)
         from jax.experimental import multihost_utils
@@ -181,7 +232,15 @@ class MeshExec:
             in_specs = (P(AXIS),) * num_args
         sm = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                        out_specs=out_specs, check_vma=check_vma)
-        return jax.jit(sm)
+        jitted = jax.jit(sm)
+
+        def counted(*args, **kwargs):
+            self.stats_dispatches += 1
+            return jitted(*args, **kwargs)
+
+        counted._jitted = jitted
+        counted.lower = jitted.lower      # AOT lowering passthrough
+        return counted
 
     def cached(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
         """Memoize a compiled program per (mesh, key).
