@@ -260,6 +260,9 @@ def _run_bench() -> None:
     # Collapse loops over InnerJoin/ReduceToIndex, vs numpy proxies
     prm = _pagerank_metric(ctx)
     kmm = _kmeans_metric(ctx)
+    # suffix sorting (BASELINE.md north-star #5): prefix-doubling
+    # rounds of the full Sort pipeline vs a numpy lexsort proxy
+    sfm = _suffix_metric(ctx)
     # host-storage EM sort (spill + native k-way merge) A/B vs the
     # generic python-heap engine — platform-independent, so it
     # reports the host engine even in a TPU window
@@ -267,7 +270,7 @@ def _run_bench() -> None:
 
     _emit(value=round(mrec_s, 3),
           vs_baseline=round(mrec_s / host_mrec_s, 3),
-          **wc, **prm, **kmm, **em)
+          **wc, **prm, **kmm, **sfm, **em)
     ctx.close()
 
 
@@ -412,6 +415,70 @@ def _kmeans_metric(ctx) -> dict:
                 "kmeans_disp": disp}
     except Exception as e:  # secondary metric never kills the line
         return {"kmeans_error": repr(e)[:200]}
+
+
+def _suffix_numpy_doubling(text: np.ndarray) -> np.ndarray:
+    """Host proxy: the same prefix-doubling algorithm in pure numpy
+    (lexsort per round). A slice-key ``sorted`` proxy is O(n^2 log n)
+    and unusable past ~20k chars; this is the strongest fair host
+    baseline for the sort-heavy recursion (reference:
+    examples/suffix_sorting/prefix_doubling.cpp)."""
+    n = len(text)
+    rank = text.astype(np.int64)
+    k = 1
+    while True:
+        r2 = np.zeros(n, np.int64)
+        if k < n:
+            r2[:-k] = rank[k:]
+        order = np.lexsort((r2, rank))
+        b = np.ones(n, np.int64)
+        b[1:] = ((rank[order][1:] != rank[order][:-1])
+                 | (r2[order][1:] != r2[order][:-1]))
+        nr = np.cumsum(b)
+        new_rank = np.empty(n, np.int64)
+        new_rank[order] = nr
+        rank = new_rank
+        if nr[-1] == n:
+            return order
+        k *= 2
+
+
+def _suffix_metric(ctx) -> dict:
+    """Suffix-array build throughput (prefix doubling over the DIA
+    Sort pipeline, examples/suffix_sorting.py) vs the numpy doubling
+    proxy, exact-parity checked. Chars/s counts one full build."""
+    try:
+        _examples_path()
+        import suffix_sorting as ss
+        n = 1 << 16
+        try:
+            n = int(os.environ.get("THRILL_TPU_BENCH_SUF_N", "") or n)
+        except ValueError:
+            pass
+        rng = np.random.default_rng(7)
+        text = rng.integers(97, 101, size=n).astype(np.uint8)  # a-d
+        holder = {}
+
+        def once():
+            holder["sa"] = ss.suffix_array(ctx, text)
+
+        once()                                   # warmup + compile
+        dt, disp = _best_of(once, iters=2)
+        _note_dispersion(disp)
+        hh = {}
+
+        def host_once():
+            hh["sa"] = _suffix_numpy_doubling(text)
+
+        host_dt, host_disp = _best_of(host_once, iters=2)
+        _note_dispersion(host_disp)
+        if not np.array_equal(holder["sa"], hh["sa"]):
+            return {"suffix_error": "suffix array mismatch vs numpy"}
+        return {"suffix_mchars_s": round(n / dt / 1e6, 3),
+                "suffix_vs_numpy": round(host_dt / dt, 3),
+                "suffix_disp": disp}
+    except Exception as e:  # secondary metric never kills the line
+        return {"suffix_error": repr(e)[:200]}
 
 
 def _em_sort_metric(ctx) -> dict:

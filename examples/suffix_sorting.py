@@ -19,6 +19,13 @@ import numpy as np
 from thrill_tpu.api import Context
 
 
+def _sa_rank_key(t):
+    # module-level (identity-stable): each doubling round reuses the
+    # same compiled sort executable — a fresh lambda per round would
+    # recompile every round (20-40 s each on TPU)
+    return (t["r1"], t["r2"])
+
+
 def suffix_array(ctx: Context, text: np.ndarray) -> np.ndarray:
     """text: [n] uint8. Returns the suffix array [n] int64."""
     n = len(text)
@@ -34,11 +41,13 @@ def suffix_array(ctx: Context, text: np.ndarray) -> np.ndarray:
         rank2[:-h if h < n else 0] = rank[h:] if h < n else 0
 
         d = ctx.Distribute({"i": idx, "r1": rank, "r2": rank2})
-        s = d.Sort(key_fn=lambda t: (t["r1"], t["r2"]))
-        got = s.AllGather()
-        si = np.array([int(t["i"]) for t in got])
-        r1 = np.array([int(t["r1"]) for t in got])
-        r2 = np.array([int(t["r2"]) for t in got])
+        s = d.Sort(key_fn=_sa_rank_key)
+        # columnar egress: sorted columns come back as arrays (ranked
+        # worker order = global sort order), not n boxed dicts
+        cols = s.AllGatherArrays()
+        si = np.asarray(cols["i"], dtype=np.int64)
+        r1 = np.asarray(cols["r1"], dtype=np.int64)
+        r2 = np.asarray(cols["r2"], dtype=np.int64)
 
         # new ranks: 1 + prefix count of strict (r1, r2) boundaries
         boundary = np.ones(n, dtype=np.int64)

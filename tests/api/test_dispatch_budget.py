@@ -138,7 +138,6 @@ def test_kmeans_full_run_zero_syncs():
 
 def test_put_small_content_cache():
     mex = MeshExec(num_workers=2)
-    a = np.arange(4, dtype=np.int64)[:, None].repeat(2, 0)[:2]
     u0 = mex.stats_uploads
     b1 = mex.put_small(np.array([[3], [4]], np.int32))
     b2 = mex.put_small(np.array([[3], [4]], np.int32))
@@ -146,7 +145,6 @@ def test_put_small_content_cache():
     assert mex.stats_uploads == u0 + 1
     b3 = mex.put_small(np.array([[3], [5]], np.int32))
     assert b3 is not b1
-    del a
 
 
 def test_allgather_arrays_device_and_host():
@@ -192,3 +190,29 @@ def test_join_out_size_hint_correct_and_overflow():
     j2 = InnerJoin(l2, r2, _idkey, _idkey, _takeleft, out_size_hint=4)
     with pytest.raises(ValueError, match="out_size_hint"):
         j2.AllGather()
+
+
+def test_join_overflow_is_sticky_and_drain_preserves_tail():
+    """A swallowed overflow error must not unlock truncated reads
+    (sticky re-raise), and one raising check must not discard other
+    joins' queued checks."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    l = ctx.Distribute([1, 1, 1, 1]).Keep(3)
+    r = ctx.Distribute([1, 1, 1, 1]).Keep(3)
+    j = InnerJoin(l, r, _idkey, _idkey, _takeleft, out_size_hint=4)
+    jn = j.node.materialize(consume=False)     # builds the hint path
+    # second overflowing join queues its own check behind the first
+    j2 = InnerJoin(l, r, _idkey, _idkey, _takeleft, out_size_hint=4)
+    j2n = j2.node.materialize(consume=False)
+    with pytest.raises(ValueError, match="out_size_hint"):
+        mex.fetch(np.zeros(1))                 # drain: first check fires
+    # swallowed once — but the tail survived: the next fetch raises
+    # for the SECOND join
+    with pytest.raises(ValueError, match="out_size_hint"):
+        mex.fetch(np.zeros(1))
+    # and the first join's counts stay poisoned (sticky), not silent
+    with pytest.raises(ValueError, match="out_size_hint"):
+        _ = jn.counts
+    with pytest.raises(ValueError, match="out_size_hint"):
+        _ = jn.counts                          # still raising, not cached

@@ -254,29 +254,39 @@ class InnerJoinNode(DIABase):
         # astype is one more async device op in the stream)
         out = DeviceShards(mex, tree, out1[0].astype(jnp.int32))
         cap, hint, totals_dev = out_cap, self.out_size_hint, out1[0]
-        fired = [False]
+        # state is STICKY on failure: once an overflow is detected,
+        # every later validation re-raises — a caller that swallows the
+        # first error (bench metric wrappers catch Exception) can never
+        # silently read the truncated data afterwards
+        state = {"ok": False, "err": None}
 
         def validate(counts: np.ndarray) -> None:
-            if fired[0]:
+            if state["err"] is not None:
+                raise state["err"]
+            if state["ok"]:
                 return
-            fired[0] = True
             if counts.max(initial=0) > cap:
-                raise ValueError(
+                state["err"] = ValueError(
                     f"InnerJoin out_size_hint={hint} (cap {cap}) "
                     f"overflowed: a worker produced "
                     f"{int(counts.max())} pairs; results were "
                     f"truncated — raise the hint or drop it")
+                raise state["err"]
+            state["ok"] = True
 
         out._counts_check = validate
-        # fetch drains catch chains that never realize THIS shards'
-        # counts (the join output feeding device programs only). The
-        # fired guard comes FIRST so an already-validated join never
-        # pays the totals transfer again, and the transfer goes
-        # through mex.fetch for multi-controller safety (re-entrancy
-        # is fine: the drain swaps _pending_checks out before running)
-        mex._pending_checks.append(
-            lambda: None if fired[0]
-            else validate(mex._fetch_raw(totals_dev).reshape(-1)))
+
+        def pending_check() -> None:
+            # fetch drains catch chains that never realize THIS
+            # shards' counts. Skip the totals transfer once validated;
+            # the transfer uses _fetch_raw (multi-controller safe, no
+            # stats, and the drain already swapped the queue out so
+            # re-entrancy cannot loop)
+            if state["ok"]:
+                return
+            validate(mex._fetch_raw(totals_dev).reshape(-1))
+
+        mex._pending_checks.append(pending_check)
         return out
 
 

@@ -102,11 +102,15 @@ class DeviceShards:
     def counts(self) -> np.ndarray:
         """Host counts; fetches (and caches) from device on first use."""
         if self._counts_host is None:
-            self._counts_host = self.mesh_exec.fetch(
+            counts = self.mesh_exec.fetch(
                 self._counts_dev).reshape(-1).astype(np.int64)
             if self._counts_check is not None:
-                check, self._counts_check = self._counts_check, None
-                check(self._counts_host)
+                # validate BEFORE caching: if the check raises (sticky
+                # overflow), the next access re-validates instead of
+                # silently serving truncated counts
+                self._counts_check(counts)
+                self._counts_check = None
+            self._counts_host = counts
         return self._counts_host
 
     @property

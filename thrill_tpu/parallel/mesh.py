@@ -202,8 +202,15 @@ class MeshExec:
             self.stats_fetches += 1
         if self._pending_checks:
             checks, self._pending_checks = self._pending_checks, []
-            for c in checks:
-                c()
+            try:
+                while checks:
+                    checks.pop(0)()
+            except BaseException:
+                # a raising check must not discard the unrun tail —
+                # a second hinted join's overflow still gets detected
+                # at the next fetch even if the caller swallows this one
+                self._pending_checks.extend(checks)
+                raise
         return self._fetch_raw(arr)
 
     def _fetch_raw(self, arr) -> np.ndarray:
