@@ -27,40 +27,45 @@ def _sa_rank_key(t):
 
 
 def suffix_array(ctx: Context, text: np.ndarray) -> np.ndarray:
-    """text: [n] uint8. Returns the suffix array [n] int64."""
+    """text: [n] uint8. Returns the suffix array [n] int64.
+
+    The doubling loop is device-resident: sorted columns come back as
+    device arrays (AllGatherArrays), the rank recomputation is eager
+    jnp math, and Distribute re-splits device arrays without a host
+    round trip — the only per-round sync is the scalar
+    distinct-rank count that decides loop termination."""
+    import jax.numpy as jnp
+
     n = len(text)
     if n == 0:
         return np.array([], dtype=np.int64)
 
     # initial ranks = byte values; sentinel handling via +1
-    rank = text.astype(np.int64) + 1
-    idx = np.arange(n, dtype=np.int64)
+    rank = jnp.asarray(text.astype(np.int64) + 1)
+    idx = jnp.arange(n, dtype=jnp.int64)
     h = 1
     while True:
-        rank2 = np.zeros(n, dtype=np.int64)
-        rank2[:-h if h < n else 0] = rank[h:] if h < n else 0
+        rank2 = jnp.zeros(n, dtype=jnp.int64)
+        if h < n:
+            rank2 = rank2.at[:n - h].set(rank[h:])
 
         d = ctx.Distribute({"i": idx, "r1": rank, "r2": rank2})
         s = d.Sort(key_fn=_sa_rank_key)
-        # columnar egress: sorted columns come back as arrays (ranked
-        # worker order = global sort order), not n boxed dicts
+        # columnar egress in ranked worker order = global sort order
         cols = s.AllGatherArrays()
-        si = np.asarray(cols["i"], dtype=np.int64)
-        r1 = np.asarray(cols["r1"], dtype=np.int64)
-        r2 = np.asarray(cols["r2"], dtype=np.int64)
+        si, r1, r2 = cols["i"], cols["r1"], cols["r2"]
 
         # new ranks: 1 + prefix count of strict (r1, r2) boundaries
-        boundary = np.ones(n, dtype=np.int64)
-        boundary[1:] = ((r1[1:] != r1[:-1]) | (r2[1:] != r2[:-1])).astype(
-            np.int64)
-        new_rank_sorted = np.cumsum(boundary)
-        rank = np.zeros(n, dtype=np.int64)
-        rank[si] = new_rank_sorted
-        if new_rank_sorted[-1] == n:
-            return si
+        boundary = jnp.concatenate([
+            jnp.ones(1, jnp.int64),
+            ((r1[1:] != r1[:-1]) | (r2[1:] != r2[:-1])).astype(jnp.int64)])
+        new_rank_sorted = jnp.cumsum(boundary)
+        rank = jnp.zeros(n, dtype=jnp.int64).at[si].set(new_rank_sorted)
+        if int(new_rank_sorted[-1]) == n:       # termination sync
+            return np.asarray(si, dtype=np.int64)
         h *= 2
         if h >= 2 * n:
-            return si
+            return np.asarray(si, dtype=np.int64)
 
 
 def suffix_array_quadrupling(ctx: Context, text: np.ndarray) -> np.ndarray:

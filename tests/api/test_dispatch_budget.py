@@ -17,6 +17,7 @@ measure).
 
 from __future__ import annotations
 
+import os
 import sys
 
 import numpy as np
@@ -26,6 +27,9 @@ import jax
 
 from thrill_tpu.api import Bind, Context, FieldReduce, InnerJoin
 from thrill_tpu.parallel.mesh import MeshExec
+
+_EXAMPLES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "..", "examples")
 
 
 @pytest.fixture(autouse=True)
@@ -98,7 +102,7 @@ def test_pagerank_full_run_budget():
     (put_small), join size syncs are skipped (out_size_hint), map
     stacks hand host counts through — at most one blocking fetch for
     the entire run (the final AllGather egress)."""
-    sys.path.insert(0, "examples")
+    sys.path.insert(0, _EXAMPLES)
     import page_rank as pr
     mex = MeshExec(num_workers=1)
     ctx = Context(mex)
@@ -117,7 +121,7 @@ def test_pagerank_full_run_budget():
 def test_kmeans_full_run_zero_syncs():
     """The Lloyd loop never blocks: device-resident centroids via
     AllGatherArrays + Bind; ZERO fetches for the whole run."""
-    sys.path.insert(0, "examples")
+    sys.path.insert(0, _EXAMPLES)
     import k_means as km
     mex = MeshExec(num_workers=1)
     ctx = Context(mex)
@@ -134,6 +138,29 @@ def test_kmeans_full_run_zero_syncs():
     assert fetch == 0, fetch
     assert disp <= 10, disp
     assert up <= 2, up
+
+
+def test_suffix_doubling_zero_syncs():
+    """The suffix-array doubling loop re-Distributes DEVICE arrays:
+    zero uploads and zero mesh fetches for a whole build at W=1 (the
+    only per-round sync is the scalar termination read)."""
+    sys.path.insert(0, _EXAMPLES)
+    import suffix_sorting as ss
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    rng = np.random.default_rng(7)
+    text = rng.integers(97, 101, size=4096).astype(np.uint8)
+    sa = ss.suffix_array(ctx, text)               # warm + parity
+    sb = bytes(text)
+    assert sorted(sa.tolist()) == list(range(len(text)))
+    assert all(sb[sa[i]:] < sb[sa[i + 1]:]
+               for i in range(0, len(sa) - 1, 29))
+    s0 = _snap(mex)
+    ss.suffix_array(ctx, text)
+    disp, up, fetch = (_snap(mex) - s0).tolist()
+    assert up == 0, up
+    assert fetch == 0, fetch
+    assert disp <= 8, disp        # one fused sort per doubling round
 
 
 def test_put_small_content_cache():
@@ -158,6 +185,23 @@ def test_allgather_arrays_device_and_host():
     h = ctx.Distribute(list(range(10)), storage="host")
     cols_h = h.AllGatherArrays()
     assert sorted(np.asarray(cols_h).tolist()) == list(range(10))
+
+
+def test_distribute_device_arrays_uneven_split():
+    """Device-array Distribute splits on device for ANY n/W (no fetch,
+    no upload), preserving order and counts."""
+    mex = MeshExec(num_workers=3)
+    ctx = Context(mex)
+    src = jax.numpy.arange(37, dtype=jax.numpy.int64) * 3
+    s0 = _snap(mex)
+    d = ctx.Distribute(src)
+    sh = d._link().pull(True)
+    assert sh.counts.tolist() == [12, 12, 13]
+    disp, up, fetch = (_snap(mex) - s0).tolist()
+    assert (up, fetch) == (0, 0), (up, fetch)
+    got = np.concatenate([np.asarray(jax.tree.leaves(sh.tree)[0][w, :c])
+                          for w, c in enumerate(sh.counts)])
+    assert np.array_equal(got, np.arange(37) * 3)
 
 
 def test_allgather_arrays_empty():

@@ -158,6 +158,13 @@ def test_fuzz_two_chain_zip_join(seed):
         expect = sorted((xa, y) for y in b_ref
                         for xa in keys_a.get(y % 7, []))
 
+    # round-5 API coverage rides the same seeds: joins randomly carry
+    # an adequate out_size_hint (must not change results) or a
+    # deliberately-too-small one (must raise, never truncate); zip
+    # egress randomly goes through columnar AllGatherArrays
+    hint_mode = str(rng.choice(["none", "bound", "overflow"]))
+    arrays_egress = bool(rng.integers(0, 2))
+
     for W in (1, 2, 5):
         mex = MeshExec(num_workers=W)
         ctx = Context(mex)
@@ -167,10 +174,25 @@ def test_fuzz_two_chain_zip_join(seed):
         b = base.Map(lambda x, k=b_add: x + k)
         if combine == "zip":
             out = Zip(a, b, zip_fn=lambda x, y: x + y)
-            got = sorted(int(v) for v in out.AllGather())
+            if arrays_egress:
+                cols = out.AllGatherArrays()
+                got = sorted(int(v) for v in np.asarray(cols))
+            else:
+                got = sorted(int(v) for v in out.AllGather())
         else:
+            if hint_mode == "overflow" and len(expect) > W:
+                # pigeonhole: some worker emits >= 2 pairs > cap(1)
+                bad = InnerJoin(a, b, lambda x: x % 7,
+                                lambda y: y % 7,
+                                lambda x, y: (x, y), out_size_hint=1)
+                with pytest.raises(ValueError,
+                                   match="out_size_hint"):
+                    bad.AllGather()
+                ctx.close()
+                continue
+            hint = max(len(expect), 1) if hint_mode == "bound" else None
             out = InnerJoin(a, b, lambda x: x % 7, lambda y: y % 7,
-                            lambda x, y: (x, y))
+                            lambda x, y: (x, y), out_size_hint=hint)
             got = sorted((int(p[0]), int(p[1]))
                          for p in out.AllGather())
         assert got == expect, (seed, W, combine, n)

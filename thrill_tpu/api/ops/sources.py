@@ -155,7 +155,12 @@ def _infer_storage(ctx, items) -> str:
 def _columnarize(items):
     """Columnar pytree passthrough, or list of item pytrees -> columns."""
     if _is_columnar(items):
-        return jax.tree.map(np.asarray, items)
+        # device arrays pass through UNFETCHED — from_global_numpy
+        # splits them on device (np.asarray here would be a blocking
+        # device->host round trip per leaf)
+        return jax.tree.map(
+            lambda l: l if isinstance(l, jax.Array) else np.asarray(l),
+            items)
     items = list(items)
     if not items:
         raise ValueError("cannot infer schema of empty device DIA; "

@@ -166,10 +166,39 @@ class DeviceShards:
 
     @staticmethod
     def from_global_numpy(mesh_exec: MeshExec, tree: Any) -> "DeviceShards":
-        """Evenly range-split one global pytree (item axis 0) across workers."""
+        """Evenly range-split one global pytree (item axis 0) across workers.
+
+        Leaves that are ALREADY device arrays (single-controller) split
+        on device for any n/W: one eager gather per leaf, all async —
+        no device->host round trip. An iterative driver can therefore
+        feed an ``AllGatherArrays`` result (or any eager jnp math on
+        it) straight back into ``Distribute`` without leaving jax's
+        dispatch stream (the suffix-sorting doubling loop pattern)."""
         W = mesh_exec.num_workers
         leaves = tree_leaves(tree)
         n = leaves[0].shape[0] if leaves else 0
+        all_device = bool(leaves) and all(
+            isinstance(l, jax.Array) for l in leaves) and \
+            getattr(mesh_exec, "num_processes", 1) == 1
+        if all_device and n > 0:
+            # device-side split for ANY n/W: one eager gather per leaf
+            # builds the [W, cap] layout (rows past each worker's count
+            # repeat row n-1 — masked by counts like all pad rows).
+            # Validity counts are host-known (n is), so no sync.
+            bnd = np.array([(w * n) // W for w in range(W + 1)],
+                           dtype=np.int64)
+            counts = np.diff(bnd)
+            cap = max(1, round_up_pow2(int(counts.max())))
+            idx = jnp.asarray(np.minimum(
+                np.arange(cap)[None, :] + bnd[:W, None], n - 1
+            ).reshape(-1))
+
+            def place(leaf):
+                arr = jnp.take(leaf, idx, axis=0).reshape(
+                    (W, cap) + leaf.shape[1:])
+                return jax.device_put(arr, mesh_exec.sharded)
+
+            return DeviceShards(mesh_exec, tree_map(place, tree), counts)
         bounds = [(w * n) // W for w in range(W + 1)]
         per_worker = [tree_map(lambda a: np.asarray(a)[bounds[w]:bounds[w + 1]], tree)
                       for w in range(W)]

@@ -245,7 +245,6 @@ class MeshExec:
             self.stats_dispatches += 1
             return jitted(*args, **kwargs)
 
-        counted._jitted = jitted
         counted.lower = jitted.lower      # AOT lowering passthrough
         return counted
 
