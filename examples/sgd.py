@@ -13,28 +13,34 @@ import numpy as np
 from thrill_tpu.api import Context
 
 
+def _sgd_grad(tr, w):
+    # module-level + Bind (see logistic_regression._lr_grad)
+    err = tr["x"] @ w - tr["y"]
+    return err[:, None] * tr["x"]
+
+
 def sgd_linear(ctx: Context, X: np.ndarray, y: np.ndarray,
                iterations: int = 40, lr: float = 0.1,
                batch_fraction: float = 0.25, seed: int = 0):
     import jax.numpy as jnp
 
+    from thrill_tpu.api import Bind
+
     n, dim = X.shape
     data = ctx.Distribute({"x": X.astype(np.float64),
                            "y": y.astype(np.float64)}).Cache() \
         .Keep(iterations + 1)
-    w = np.zeros(dim)
+    # device-resident descent: Bind re-binds w without recompiling,
+    # Sum returns a device vector (its empty-guard stays lazy for the
+    # sampled batch's device-resident counts), the update is eager
+    # device math — zero blocking syncs per iteration
+    w = jnp.zeros(dim)
+    m = max(int(n * batch_fraction), 1)
     for t in range(iterations):
-        wj = jnp.asarray(w)
         batch = data.BernoulliSample(batch_fraction, seed=seed + t)
-
-        def grad(tr):
-            err = tr["x"] @ wj - tr["y"]
-            return err[:, None] * tr["x"]
-
-        m = max(int(n * batch_fraction), 1)
-        gsum = batch.Map(grad).Sum()
-        w = w - lr * np.asarray(gsum) / m
-    return w
+        gsum = batch.Map(Bind(_sgd_grad, w)).Sum(device=True)
+        w = w - lr * gsum / m
+    return np.asarray(w)
 
 
 def main():

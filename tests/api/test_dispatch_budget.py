@@ -140,6 +140,31 @@ def test_kmeans_full_run_zero_syncs():
     assert up <= 2, up
 
 
+def test_sgd_and_logreg_zero_syncs():
+    """Gradient-descent loops (Bind model vector + Sum(device=True)):
+    zero blocking fetches for whole runs."""
+    sys.path.insert(0, _EXAMPLES)
+    import logistic_regression as lr
+    import sgd
+    mex = MeshExec(num_workers=2)
+    ctx = Context(mex)
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1024, 4))
+    y = (X @ np.ones(4) > 0).astype(np.float64)
+    w = lr.logistic_regression(ctx, X, y, iterations=5)      # warm
+    assert np.mean((X @ w > 0) == (y > 0.5)) > 0.9
+    s0 = _snap(mex)
+    lr.logistic_regression(ctx, X, y, iterations=5)
+    disp, up, fetch = (_snap(mex) - s0).tolist()
+    assert fetch == 0, fetch
+    assert up <= 2, up
+    sgd.sgd_linear(ctx, X, y * 2 - 1, iterations=5)          # warm
+    s0 = _snap(mex)
+    sgd.sgd_linear(ctx, X, y * 2 - 1, iterations=5)
+    disp, up, fetch = (_snap(mex) - s0).tolist()
+    assert fetch == 0, fetch
+
+
 def test_suffix_doubling_zero_syncs():
     """The suffix-array doubling loop re-Distributes DEVICE arrays:
     zero uploads and zero mesh fetches for a whole build at W=1 (the

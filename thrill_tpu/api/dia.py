@@ -244,11 +244,15 @@ class DIA:
         from .ops import actions
         return actions.AllReduce(self, fn, initial)
 
-    def Sum(self, fn: Callable = None, initial: Any = 0) -> Any:
+    def Sum(self, fn: Callable = None, initial: Any = 0,
+            device: bool = False) -> Any:
+        """``device=True`` (device storage, no custom fn): the summed
+        pytree stays on device — feed it back into a Bind without a
+        host sync (zero-sync iterative loops)."""
         from .ops import actions
         if fn is not None:
             return actions.AllReduce(self, fn, initial)
-        return actions.Sum(self, initial)
+        return actions.Sum(self, initial, device=device)
 
     def Min(self) -> Any:
         from .ops import actions

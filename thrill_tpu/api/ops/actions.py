@@ -110,8 +110,13 @@ def Print(dia, label: str = "", limit: int = 100) -> None:
     print(f"[{label or 'DIA'}] n={len(items)}: {head}{suffix}")
 
 
-def _device_reduce(shards: DeviceShards, mode: str):
-    """One SPMD program: masked local fold + cross-worker collective."""
+def _device_reduce(shards: DeviceShards, mode: str,
+                   keep_device: bool = False):
+    """One SPMD program: masked local fold + cross-worker collective.
+
+    ``keep_device``: return the reduced leaves as (replicated) DEVICE
+    arrays with no host fetch — iterative drivers feed them straight
+    back into a Bind (the SGD/logistic-regression update pattern)."""
     mex = shards.mesh_exec
     cap = shards.cap
     leaves, treedef = jax.tree.flatten(shards.tree)
@@ -143,6 +148,8 @@ def _device_reduce(shards: DeviceShards, mode: str):
 
     fn = mex.cached(key, build)
     out = fn(shards.counts_device(), *leaves)
+    if keep_device:
+        return jax.tree.unflatten(treedef, list(out))
     vals = [mex.fetch(o) for o in out]
     vals = [v.item() if v.ndim == 0 else v for v in vals]
     return jax.tree.unflatten(treedef, vals)
@@ -156,12 +163,27 @@ def _dtype_min(dt):
     return -jnp.inf if jnp.issubdtype(dt, jnp.floating) else jnp.iinfo(dt).min
 
 
-def Sum(dia, initial: Any = 0) -> Any:
+def Sum(dia, initial: Any = 0, device: bool = False) -> Any:
+    """``device=True`` (device-storage DIAs): return the summed pytree
+    as replicated DEVICE arrays, no host fetch — feed it straight back
+    into a ``Bind`` (zero-sync iterative loops)."""
     shards = _pull(dia)
     if isinstance(shards, DeviceShards):
-        if shards.total == 0:
+        # Single-controller with device-resident counts: SKIP the
+        # empty-guard — forcing a counts sync here would stall
+        # iterative loops (SGD's per-round sampled batch), and the
+        # masked device reduce returns exact zeros for empty shards
+        # anyway. Multi-controller keeps the eager guard: there the
+        # counts fetch is a cheap collective the group performs in
+        # lock-step, while skipping it costs far more (per-shape
+        # reduce compiles + a process_allgather of the result for
+        # sums that used to early-return — measured 7x on the
+        # 2-process fuzz suite).
+        lazy = shards._counts_host is None and \
+            not multiplexer.multiprocess(dia.context.mesh_exec)
+        if not lazy and shards.total == 0:
             return initial
-        reduced = _device_reduce(shards, "sum")
+        reduced = _device_reduce(shards, "sum", keep_device=device)
         if initial is None or (np.isscalar(initial) and initial == 0):
             return reduced
         # fold the initial value like the host path does; accept either
