@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Chaos sweep: randomized fault injection over the fuzz pipelines.
+#
+# Usage:
+#   run-scripts/chaos_sweep.sh [N_SEEDS] [pytest-args...]
+#
+# Runs the `chaos`-marked tests (tests/api/test_chaos.py): N_SEEDS
+# randomly composed pipelines, each under a random arming of the
+# in-process injection sites (common/faults.py) plus HBM pressure,
+# asserting EXACT results and clean recovery. The socket-level sites
+# (net.tcp.*, net.multiplexer.*, net.dispatcher.timer) are swept by
+# tests/net/test_fault_injection.py, included here too.
+#
+# Tuning knobs (exported through to the harness):
+#   THRILL_TPU_RETRY_ATTEMPTS / _BASE_S / _MAX_S  retry policy
+#   THRILL_TPU_RETRY=0   disable retries (detection-only sweep: every
+#                        armed fault must SURFACE, not hang)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+N_SEEDS=${1:-25}
+shift || true
+
+exec env JAX_PLATFORMS=cpu THRILL_TPU_CHAOS_SEEDS="$N_SEEDS" \
+    python -m pytest -m chaos -q -p no:cacheprovider \
+    tests/api/test_chaos.py tests/net/test_fault_injection.py "$@"

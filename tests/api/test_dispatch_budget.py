@@ -246,25 +246,37 @@ def _takeleft(a, b):
     return a
 
 
-def test_join_out_size_hint_correct_and_overflow():
+def test_join_out_size_hint_correct_and_overflow(monkeypatch):
     mex = MeshExec(num_workers=1)
     ctx = Context(mex)
     l = ctx.Distribute(np.arange(16, dtype=np.int64))
     r = ctx.Distribute(np.arange(8, 16, dtype=np.int64))
     j = InnerJoin(l, r, _idkey, _idkey, _takeleft, out_size_hint=8)
     assert sorted(j.AllGather()) == list(range(8, 16))
+    assert mex.stats_join_overflow_retries == 0
 
+    # an overflowing hint RECOVERS by default: the join re-runs its
+    # expansion un-hinted (lineage retry) and the results are exact
     l2 = ctx.Distribute([1, 1, 1, 1])
     r2 = ctx.Distribute([1, 1, 1, 1])
     j2 = InnerJoin(l2, r2, _idkey, _idkey, _takeleft, out_size_hint=4)
+    assert j2.AllGather() == [1] * 16
+    assert mex.stats_join_overflow_retries == 1
+
+    # with recovery disabled the overflow raises (never truncates)
+    monkeypatch.setenv("THRILL_TPU_JOIN_RECOVER", "0")
+    l3 = ctx.Distribute([1, 1, 1, 1])
+    r3 = ctx.Distribute([1, 1, 1, 1])
+    j3 = InnerJoin(l3, r3, _idkey, _idkey, _takeleft, out_size_hint=4)
     with pytest.raises(ValueError, match="out_size_hint"):
-        j2.AllGather()
+        j3.AllGather()
 
 
-def test_join_overflow_is_sticky_and_drain_preserves_tail():
-    """A swallowed overflow error must not unlock truncated reads
-    (sticky re-raise), and one raising check must not discard other
-    joins' queued checks."""
+def test_join_overflow_is_sticky_and_drain_preserves_tail(monkeypatch):
+    """With recovery disabled, a swallowed overflow error must not
+    unlock truncated reads (sticky re-raise), and one raising check
+    must not discard other joins' queued checks."""
+    monkeypatch.setenv("THRILL_TPU_JOIN_RECOVER", "0")
     mex = MeshExec(num_workers=1)
     ctx = Context(mex)
     l = ctx.Distribute([1, 1, 1, 1]).Keep(3)
@@ -285,3 +297,70 @@ def test_join_overflow_is_sticky_and_drain_preserves_tail():
         _ = jn.counts
     with pytest.raises(ValueError, match="out_size_hint"):
         _ = jn.counts                          # still raising, not cached
+
+
+def test_join_overflow_recovery_survives_hbm_spill():
+    """HBM pressure must not leak truncated columns to disk: spilling
+    a hint-carrying result validates (and recovers) BEFORE
+    serializing, so the restored shards are the healed ones."""
+    from thrill_tpu.common.config import Config
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex, Config(hbm_limit=1))        # always exceeded
+    l = ctx.Distribute([1, 1, 1, 1])
+    r = ctx.Distribute([1, 1, 1, 1])
+    j = InnerJoin(l, r, _idkey, _idkey, _takeleft, out_size_hint=4)
+    j.node.materialize(consume=False)    # cached, check still pending
+    # caching another node pressures the join result out to the store
+    other = ctx.Distribute(np.arange(32, dtype=np.int64))
+    other.node.materialize(consume=False)
+    assert ctx.hbm.spill_count >= 1
+    assert mex.stats_join_overflow_retries == 1    # healed pre-spill
+    assert j.AllGather() == [1] * 16               # restored + exact
+    ctx.close()
+
+
+def test_two_overflowed_joins_under_pressure_recover_exactly_once():
+    """Re-entrancy: two unresolved hinted joins under HBM pressure
+    spill each other during recovery (validate -> maybe_spill ->
+    spill(other) -> validate ...). Each join must recover EXACTLY once
+    (mutual recursion used to re-run recovery hundreds of times) and
+    both must still read back exact."""
+    from thrill_tpu.common.config import Config
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex, Config(hbm_limit=1))        # always exceeded
+    l = ctx.Distribute([1, 1, 1, 1]).Keep(1)
+    r = ctx.Distribute([1, 1, 1, 1]).Keep(1)
+    j1 = InnerJoin(l, r, _idkey, _idkey, _takeleft, out_size_hint=4)
+    j1.node.materialize(consume=False)
+    j2 = InnerJoin(l, r, _idkey, _idkey, _takeleft, out_size_hint=4)
+    j2.node.materialize(consume=False)
+    # a third cached node turns the pressure into spills of the joins
+    other = ctx.Distribute(np.arange(32, dtype=np.int64))
+    other.node.materialize(consume=False)
+    assert mex.stats_join_overflow_retries == 2    # once per join
+    assert j1.AllGather() == [1] * 16
+    assert j2.AllGather() == [1] * 16
+    ctx.close()
+
+
+def test_join_overflow_recovery_heals_downstream_pipeline():
+    """The dispatch-budget contract of the recovery: a page_rank-style
+    chain (hinted join -> device map -> reduce -> egress) with a WRONG
+    hint produces exact results with exactly one lineage retry, no
+    counted mid-pipeline fetch, and one extra dispatch (the re-run
+    expansion); a RIGHT hint stays zero-retry."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    keys = [1, 2, 1, 2, 1]
+    l = ctx.Distribute(np.asarray(keys, dtype=np.int64))
+    r = ctx.Distribute(np.asarray([1, 2], dtype=np.int64))
+    j = InnerJoin(l, r, _idkey, _idkey, lambda a, b: a + b,
+                  out_size_hint=2)             # true per-worker max: 5
+    s0 = _snap(mex)
+    got = sorted(int(x) for x in
+                 j.Map(lambda x: x * 10).AllGather())
+    assert got == sorted((k + k) * 10 for k in keys)
+    assert mex.stats_join_overflow_retries == 1
+    disp, up, fetch = (_snap(mex) - s0).tolist()
+    assert fetch <= 1, fetch                   # egress only; no sync
+    ctx.close()

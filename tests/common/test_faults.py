@@ -1,0 +1,368 @@
+"""Fault-injection registry, retry policy, and the fault MATRIX.
+
+The matrix is the point: every injection site registered by the
+framework must have an exerciser here (or in
+tests/net/test_fault_injection.py for the socket-level sites) proving
+bounded-time behavior — a TRANSIENT fault recovers (correct results,
+retry visible in the counters) and a fault surviving the retry budget
+surfaces as a clean root-cause error, never a hang or silent
+corruption. A new ``faults.declare`` without a matrix entry fails
+``test_every_registered_site_is_covered``.
+"""
+
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from thrill_tpu.common import faults
+from thrill_tpu.common.retry import RetryPolicy, default_policy
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+# ----------------------------------------------------------------------
+# registry semantics
+# ----------------------------------------------------------------------
+
+def test_spec_probability_count_seed_after(monkeypatch):
+    faults.declare("t.spec")
+    monkeypatch.setenv(faults.ENV_VAR, "t.spec:n=2:after=1")
+    fired = [False] * 5
+    for i in range(5):
+        try:
+            faults.check("t.spec")
+        except faults.InjectedFault:
+            fired[i] = True
+    # first hit skipped (after=1), then exactly n=2 fires
+    assert fired == [False, True, True, False, False]
+
+
+def test_spec_is_deterministic_per_seed(monkeypatch):
+    faults.declare("t.det")
+
+    def pattern(seed):
+        faults.REGISTRY.reset()
+        monkeypatch.setenv(faults.ENV_VAR, f"t.det:p=0.4:n=0:seed={seed}")
+        out = []
+        for _ in range(32):
+            try:
+                faults.check("t.det")
+                out.append(0)
+            except faults.InjectedFault:
+                out.append(1)
+        return out
+
+    a, b, c = pattern(7), pattern(7), pattern(8)
+    assert a == b                       # same seed -> same stream
+    assert a != c                       # different seed -> different
+    assert 0 < sum(a) < 32              # actually probabilistic
+
+
+def test_wildcard_patterns_and_malformed_entries(monkeypatch, capsys):
+    faults.declare("t.wild.one")
+    faults.declare("t.wild.two")
+    monkeypatch.setenv(faults.ENV_VAR, "t.wild.*:n=1;oops:p=zz")
+    hits = 0
+    for name in ("t.wild.one", "t.wild.two"):
+        with pytest.raises(faults.InjectedFault):
+            faults.check(name)
+        hits += 1
+    assert hits == 2                    # each site fires independently
+    assert "malformed" in capsys.readouterr().err
+
+
+def test_fault_events_are_logged_as_json_lines(monkeypatch, tmp_path):
+    from thrill_tpu.common.logger import JsonLogger
+    log = JsonLogger(str(tmp_path / "ev.json"))
+    faults.REGISTRY.set_logger(log.line)
+    try:
+        faults.declare("t.log")
+        monkeypatch.setenv(faults.ENV_VAR, "t.log:n=1")
+        with pytest.raises(faults.InjectedFault):
+            faults.check("t.log", peer=3)
+        log.close()
+        import json
+        recs = [json.loads(l) for l in
+                (tmp_path / "ev.json").read_text().splitlines()]
+        ev = [r for r in recs if r.get("event") == "fault_injected"]
+        assert ev and ev[0]["site"] == "t.log" and ev[0]["peer"] == 3
+    finally:
+        faults.REGISTRY.set_logger(None)
+
+
+# ----------------------------------------------------------------------
+# retry policy
+# ----------------------------------------------------------------------
+
+def test_retry_recovers_transient_within_budget():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("blip")
+        return "ok"
+
+    p = RetryPolicy(max_attempts=4, base_delay_s=0.0, max_delay_s=0.0)
+    assert p.run(flaky, what="t", seed=0) == "ok"
+    assert calls["n"] == 3
+    assert faults.REGISTRY.stats()["retries"] == 2
+
+
+def test_retry_never_retries_permanent():
+    from thrill_tpu.net import wire
+    from thrill_tpu.net.group import ClusterAbort
+    for exc in (wire.AuthError("bad mac"), ClusterAbort(1, "boom"),
+                ValueError("logic")):
+        calls = {"n": 0}
+
+        def fail(exc=exc):
+            calls["n"] += 1
+            raise exc
+
+        p = RetryPolicy(max_attempts=5, base_delay_s=0.0)
+        with pytest.raises(type(exc)):
+            p.run(fail, what="t", seed=0)
+        assert calls["n"] == 1, exc     # exactly one attempt
+
+
+def test_retry_exhaustion_reraises_the_real_error():
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.0, max_delay_s=0.0)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise TimeoutError("still down")
+
+    with pytest.raises(TimeoutError, match="still down"):
+        p.run(always, what="t", seed=0)
+    assert calls["n"] == 3
+
+
+def test_full_jitter_is_bounded_and_exponential():
+    import random
+    p = RetryPolicy(base_delay_s=0.1, max_delay_s=1.0)
+    rng = random.Random(0)
+    for attempt in range(12):
+        cap = min(1.0, 0.1 * 2 ** attempt)
+        for _ in range(50):
+            d = p.delay(attempt, rng)
+            assert 0.0 <= d <= cap
+
+
+def test_global_retry_kill_switch(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_RETRY", "0")
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        raise ConnectionError("blip")
+
+    p = RetryPolicy(max_attempts=5, base_delay_s=0.0)
+    with pytest.raises(ConnectionError):
+        p.run(flaky, what="t", seed=0)
+    assert calls["n"] == 1
+
+
+# ----------------------------------------------------------------------
+# the fault matrix
+# ----------------------------------------------------------------------
+
+def _ex_mesh_dispatch():
+    """api.mesh.dispatch: transient dispatch fault -> retried, results
+    exact, fault + retry visible in counters."""
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+    with faults.inject("api.mesh.dispatch", n=2, seed=1):
+        mex = MeshExec(num_workers=2)
+        ctx = Context(mex)
+        got = sorted(int(x) for x in ctx.Distribute(
+            np.arange(16, dtype=np.int64)).Map(
+                lambda x: x * 3).AllGather())
+        ctx.close()
+    assert got == [x * 3 for x in range(16)]
+    assert faults.REGISTRY.injected >= 1
+    assert faults.REGISTRY.stats()["retries"] >= 1
+
+
+def _ex_mesh_dispatch_exhausted():
+    """api.mesh.dispatch surviving the budget: clean root-cause error,
+    not a hang and not a wrong answer."""
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+    os.environ["THRILL_TPU_RETRY_ATTEMPTS"] = "2"
+    try:
+        with faults.inject("api.mesh.dispatch", n=0, seed=1):
+            mex = MeshExec(num_workers=2)
+            ctx = Context(mex)
+            with pytest.raises(faults.InjectedFault) as ei:
+                ctx.Distribute(np.arange(8, dtype=np.int64)).Map(
+                    lambda x: x + 1).AllGather()
+            assert ei.value.site == "api.mesh.dispatch"
+    finally:
+        del os.environ["THRILL_TPU_RETRY_ATTEMPTS"]
+
+
+def _ex_blockstore():
+    """data.blockstore.put/get: spill-store I/O retries transparently."""
+    from thrill_tpu.data.block_pool import BlockPool
+    pool = BlockPool(spill_dir="/tmp")
+    with faults.inject("data.blockstore.put", n=1, seed=2):
+        bid = pool.put(b"payload-bytes")
+    with faults.inject("data.blockstore.get", n=1, seed=2):
+        assert pool.get(bid) == b"payload-bytes"
+    pool.close()
+    assert faults.REGISTRY.injected == 2
+    assert faults.REGISTRY.stats()["retries"] == 2
+
+
+def _hbm_pressure_run():
+    """Two cached nodes under an hbm_limit of 1 byte: caching the
+    second evicts the first; reading the first back restores it.
+    Returns the eviction/restore counters alongside correctness."""
+    from thrill_tpu.api import Context
+    from thrill_tpu.common.config import Config
+    from thrill_tpu.parallel.mesh import MeshExec
+    mex = MeshExec(num_workers=2)
+    ctx = Context(mex, Config(hbm_limit=1))       # always exceeded
+    d1 = ctx.Distribute(np.arange(64, dtype=np.int64)).Cache().Keep(2)
+    assert int(d1.Sum()) == int(np.arange(64).sum())    # caches d1
+    d2 = ctx.Distribute(np.arange(64, 128,
+                                  dtype=np.int64)).Cache().Keep(2)
+    assert int(d2.Sum()) == int(np.arange(64, 128).sum())  # evicts d1
+    # reads stay exact whether d1 was spilled, spill-skipped, or
+    # restored through a retried fault
+    assert sorted(int(x) for x in d1.AllGather()) == list(range(64))
+    assert sorted(int(x) for x in d2.AllGather()) == list(range(64,
+                                                                128))
+    spills, restores = ctx.hbm.spill_count, ctx.hbm.restore_count
+    ctx.close()
+    return spills, restores
+
+
+def _ex_hbm_spill_and_restore():
+    """mem.hbm.spill skips the eviction (resident beats lost) and the
+    pipeline stays correct; mem.hbm.restore retries through."""
+    # baseline sanity: the pressure run genuinely spills and restores
+    spills, restores = _hbm_pressure_run()
+    assert spills >= 1 and restores >= 1
+
+    # spill fault: the injected failure makes the governor keep the
+    # node resident (recovery event) — correctness unaffected
+    with faults.inject("mem.hbm.spill", n=1, seed=3):
+        _hbm_pressure_run()
+    assert faults.REGISTRY.injected >= 1
+    assert any(e.get("event") == "recovery"
+               and e.get("what") == "hbm.spill_skipped"
+               for e in faults.REGISTRY.events)
+
+    # restore fault: a genuinely spilled node re-uploads through retry
+    faults.REGISTRY.reset()
+    with faults.inject("mem.hbm.restore", n=1, seed=3):
+        spills, restores = _hbm_pressure_run()
+    assert spills >= 1 and restores >= 1
+    assert faults.REGISTRY.injected >= 1
+    assert faults.REGISTRY.stats()["retries"] >= 1
+
+
+def _ex_vfs_read_reopen(tmp_path=None):
+    """vfs.open_read / vfs.read: a mid-stream transient fault reopens
+    at the tracked offset — the bytes come back complete and in
+    order."""
+    import tempfile
+    from thrill_tpu.vfs import file_io
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "data.txt")
+        payload = b"".join(b"line-%04d\n" % i for i in range(500))
+        with open(p, "wb") as f:
+            f.write(payload)
+        with faults.inject("vfs.open_read", n=1, seed=4):
+            with file_io.OpenReadStream(p) as f:
+                assert f.read() == payload
+        # fault on the SECOND read: offset tracking must resume exactly
+        with faults.inject("vfs.read", n=1, seed=4, after=1):
+            with file_io.OpenReadStream(p) as f:
+                chunks = []
+                while True:
+                    b = f.read(1024)
+                    if not b:
+                        break
+                    chunks.append(b)
+                assert b"".join(chunks) == payload
+    assert faults.REGISTRY.injected == 2
+    assert faults.REGISTRY.stats()["retries"] == 2
+
+
+def _ex_vfs_scheme_sites():
+    """vfs.s3.read / vfs.hdfs.open: the scheme backends raise the
+    declared transient class at their ranged-read sites (the generic
+    reopen-at-offset recovery above is scheme-agnostic)."""
+    for site in ("vfs.s3.read", "vfs.hdfs.open"):
+        with faults.inject(site, n=1, seed=5):
+            with pytest.raises(faults.InjectedIOError) as ei:
+                faults.check(site)
+            assert ei.value.site == site
+            assert default_policy().classify(ei.value) == faults.TRANSIENT
+
+
+# sites whose exercisers live in tests/net/test_fault_injection.py
+# (they need real sockets / multi-rank groups)
+_NET_SITES = {
+    "net.tcp.connect", "net.tcp.send", "net.tcp.flush",
+    "net.dispatcher.timer",
+    "net.multiplexer.frame_send", "net.multiplexer.frame_recv",
+}
+
+_MATRIX = {
+    "api.mesh.dispatch": _ex_mesh_dispatch,
+    "data.blockstore.put": _ex_blockstore,
+    "data.blockstore.get": _ex_blockstore,
+    "mem.hbm.spill": _ex_hbm_spill_and_restore,
+    "mem.hbm.restore": _ex_hbm_spill_and_restore,
+    "vfs.open_read": _ex_vfs_read_reopen,
+    "vfs.read": _ex_vfs_read_reopen,
+    "vfs.s3.read": _ex_vfs_scheme_sites,
+    "vfs.hdfs.open": _ex_vfs_scheme_sites,
+}
+
+
+@pytest.mark.parametrize("site", sorted(_MATRIX),
+                         ids=lambda s: s.replace(".", "-"))
+def test_fault_matrix(site):
+    _MATRIX[site]()
+
+
+def test_fault_matrix_exhausted_budget_is_clean():
+    _ex_mesh_dispatch_exhausted()
+
+
+def test_every_registered_site_is_covered():
+    """Declaring a site without adding a matrix exerciser fails here:
+    import every layer, then require full coverage."""
+    import thrill_tpu.api.context  # noqa: F401
+    import thrill_tpu.data.block_pool  # noqa: F401
+    import thrill_tpu.data.multiplexer  # noqa: F401
+    import thrill_tpu.mem.hbm  # noqa: F401
+    import thrill_tpu.net.dispatcher  # noqa: F401
+    import thrill_tpu.net.tcp  # noqa: F401
+    import thrill_tpu.parallel.mesh  # noqa: F401
+    import thrill_tpu.vfs.file_io  # noqa: F401
+    import thrill_tpu.vfs.hdfs_file  # noqa: F401
+    import thrill_tpu.vfs.s3_file  # noqa: F401
+    registered = {n for n in faults.REGISTRY.sites if not
+                  n.startswith(("t.", "demo."))}      # test-local sites
+    covered = set(_MATRIX) | _NET_SITES
+    missing = registered - covered
+    assert not missing, (
+        f"injection sites without a fault-matrix exerciser: {missing} "
+        f"— add one to tests/common/test_faults.py (_MATRIX) or "
+        f"tests/net/test_fault_injection.py (_NET_SITES)")

@@ -14,6 +14,7 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
 
 import pytest
 
@@ -22,6 +23,11 @@ from portalloc import free_ports, load_scaled
 
 
 CHILD = os.path.join(os.path.dirname(__file__), "distributed_child.py")
+
+# shared across children and repeat runs so the second child reuses the
+# first's compiles (see the comment at the env block below)
+_COMPILE_CACHE_DIR = os.path.join(
+    tempfile.gettempdir(), "thrill-tpu-test-xla-cache")
 
 
 _TEXT = "\n".join(
@@ -62,7 +68,7 @@ def _launch_children(nproc, net="tcp", child=CHILD, extra_env=None):
             # their load-scaled deadlines on a contended 1-core box —
             # with the cache, the second child reuses the first's
             # compiles within a run and repeat suite runs start warm
-            "THRILL_TPU_COMPILE_CACHE": "off",  # A/B probe
+            "THRILL_TPU_COMPILE_CACHE": _COMPILE_CACHE_DIR,
         })
         env.update(extra_env or {})
         if net == "mpi":

@@ -16,10 +16,24 @@ import threading
 import numpy as np
 import pytest
 
+from thrill_tpu.common import faults
 from thrill_tpu.net import wire
+from thrill_tpu.net.group import ClusterAbort, poison_on_error
 from thrill_tpu.net.tcp import TcpConnection, construct_tcp_group
 
-from portalloc import free_ports
+from portalloc import free_ports, load_scaled
+
+# the whole module is part of the chaos sweep entry point
+# (run-scripts/chaos_sweep.sh) AND of tier-1 (none of it is slow)
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
 
 
 
@@ -203,3 +217,308 @@ def test_replication_helper_surfaces_peer_death():
     assert all(not t.is_alive() for t in threads), \
         "replication helper hung on the dead peer"
     assert outcomes == ["errored-cleanly", "errored-cleanly", "died"]
+
+
+# ----------------------------------------------------------------------
+# coordinated abort: poison control frames carry the ROOT CAUSE
+# ----------------------------------------------------------------------
+
+def test_poison_broadcast_surfaces_root_cause_on_every_peer():
+    """Rank 0 hits an unrecoverable application error mid-job and
+    poisons the group: ranks 1 and 2, blocked in a recv, surface a
+    ClusterAbort naming rank 0's REAL error within their deadline —
+    not a secondary timeout, not a hang."""
+    P = 3
+    ports = free_ports(P)
+    hosts = [("127.0.0.1", p) for p in ports]
+    barrier = threading.Barrier(P)
+    outcomes = [None] * P
+    errors = [None] * P
+
+    def target(r):
+        g = None
+        try:
+            g = construct_tcp_group(r, hosts, timeout=20)
+            barrier.wait()
+            if r == 0:
+                with pytest.raises(RuntimeError, match="disk exploded"):
+                    with poison_on_error(g, "job"):
+                        raise RuntimeError("disk exploded on host 0")
+                outcomes[r] = "poisoned"
+                return
+            # peers are parked in a recv when the poison lands
+            with pytest.raises(ClusterAbort) as ei:
+                g.recv_from(0)
+            assert ei.value.origin == 0
+            assert "disk exploded on host 0" in ei.value.cause
+            assert "RuntimeError" in ei.value.cause
+            outcomes[r] = "got-root-cause"
+        except BaseException as e:
+            errors[r] = e
+        finally:
+            if g is not None:
+                try:
+                    g.close()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=target, args=(r,), daemon=True)
+               for r in range(P)]
+    for t in threads:
+        t.start()
+    deadline = load_scaled(60)
+    for t in threads:
+        t.join(timeout=deadline)
+    for e in errors:
+        if e is not None:
+            raise e
+    assert all(not t.is_alive() for t in threads), \
+        "a peer missed the poison frame and hung"
+    assert outcomes == ["poisoned", "got-root-cause", "got-root-cause"]
+    assert faults.REGISTRY.stats()["aborts"] >= 1
+
+
+def test_poison_relays_to_ranks_that_never_recv_from_origin():
+    """Transitivity: rank 2 only ever receives from rank 1 (the shape
+    of tree/hypercube collectives), yet must still surface rank 0's
+    ROOT CAUSE — rank 1 relays the poison frame once before aborting."""
+    P = 3
+    ports = free_ports(P)
+    hosts = [("127.0.0.1", p) for p in ports]
+    barrier = threading.Barrier(P)
+    outcomes = [None] * P
+    errors = [None] * P
+
+    def target(r):
+        g = None
+        try:
+            g = construct_tcp_group(r, hosts, timeout=20)
+            barrier.wait()
+            if r == 0:
+                with pytest.raises(RuntimeError):
+                    with poison_on_error(g, "job"):
+                        raise RuntimeError("root cause on rank 0")
+                outcomes[r] = "poisoned"
+                return
+            with pytest.raises(ClusterAbort) as ei:
+                # rank 1 recvs from the origin; rank 2 ONLY from rank 1
+                g.recv_from(0 if r == 1 else 1)
+            assert ei.value.origin == 0, ei.value
+            assert "root cause on rank 0" in ei.value.cause
+            outcomes[r] = "got-root-cause"
+        except BaseException as e:
+            errors[r] = e
+        finally:
+            if g is not None:
+                try:
+                    g.close()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=target, args=(r,), daemon=True)
+               for r in range(P)]
+    for t in threads:
+        t.start()
+    deadline = load_scaled(60)
+    for t in threads:
+        t.join(timeout=deadline)
+    for e in errors:
+        if e is not None:
+            raise e
+    assert all(not t.is_alive() for t in threads), \
+        "a rank outside the origin's recv set hung (no relay)"
+    assert outcomes == ["poisoned", "got-root-cause", "got-root-cause"]
+
+
+def test_poison_during_collective_beats_secondary_timeouts():
+    """A rank failing INSIDE a replication collective poisons the
+    others: survivors in ensure_replicated surface the root cause as a
+    ClusterAbort instead of waiting out dead-peer timeouts."""
+    from types import SimpleNamespace
+
+    from thrill_tpu.data import multiplexer
+    from thrill_tpu.data.shards import HostShards
+    from thrill_tpu.net import FlowControlChannel
+
+    P = 3
+    ports = free_ports(P)
+    hosts = [("127.0.0.1", p) for p in ports]
+    barrier = threading.Barrier(P)
+    errors = [None] * P
+    outcomes = [None] * P
+
+    def target(r):
+        g = None
+        try:
+            g = construct_tcp_group(r, hosts, timeout=20)
+            net = FlowControlChannel(g)
+            mex = SimpleNamespace(
+                num_processes=P, num_workers=P, process_index=r,
+                local_workers=[r], worker_process=list(range(P)),
+                host_net=net, logger=None)
+            shards = HostShards(P, [[f"item-{w}"] if w == r else []
+                                    for w in range(P)])
+            barrier.wait()
+            if r == 2:
+                # unrecoverable local failure before entering the
+                # collective: broadcast the cause, then fail
+                with pytest.raises(OSError, match="quota exhausted"):
+                    with poison_on_error(g, "replicate"):
+                        raise OSError("spill quota exhausted")
+                outcomes[r] = "poisoned"
+                return
+            with pytest.raises(ClusterAbort) as ei:
+                multiplexer.ensure_replicated(mex, shards,
+                                              reason="fault-test")
+            assert ei.value.origin == 2
+            assert "quota exhausted" in ei.value.cause
+            outcomes[r] = "got-root-cause"
+        except BaseException as e:
+            errors[r] = e
+        finally:
+            if g is not None:
+                try:
+                    g.close()
+                except Exception:
+                    pass
+
+    threads = [threading.Thread(target=target, args=(r,), daemon=True)
+               for r in range(P)]
+    for t in threads:
+        t.start()
+    deadline = load_scaled(60)
+    for t in threads:
+        t.join(timeout=deadline)
+    for e in errors:
+        if e is not None:
+            raise e
+    assert all(not t.is_alive() for t in threads)
+    assert outcomes == ["got-root-cause", "got-root-cause", "poisoned"]
+
+
+# ----------------------------------------------------------------------
+# injected net-site matrix (the socket half of the fault matrix in
+# tests/common/test_faults.py — _NET_SITES there names these)
+# ----------------------------------------------------------------------
+
+def test_injected_tcp_send_and_flush_recover():
+    """net.tcp.send / net.tcp.flush: the injected pre-wire fault is
+    retried under the shared policy — the frame arrives intact."""
+    a, b = socket.socketpair()
+    ca, cb = TcpConnection(a), TcpConnection(b)
+    try:
+        with faults.inject("net.tcp.send", n=2, seed=11):
+            ca.send({"k": np.arange(4).tolist()})
+        assert cb.recv() == {"k": [0, 1, 2, 3]}
+        with faults.inject("net.tcp.flush", n=1, seed=11):
+            ca.flush()
+        assert faults.REGISTRY.injected == 3
+        assert faults.REGISTRY.stats()["retries"] == 3
+    finally:
+        a.close()
+        b.close()
+
+
+def test_injected_tcp_send_exhausted_surfaces_cleanly(monkeypatch):
+    """A send fault outliving the retry budget surfaces as the
+    injected ConnectionError — and nothing was put on the wire."""
+    monkeypatch.setenv("THRILL_TPU_RETRY_ATTEMPTS", "2")
+    a, b = socket.socketpair()
+    ca, cb = TcpConnection(a), TcpConnection(b)
+    try:
+        with faults.inject("net.tcp.send", n=0, seed=11):
+            with pytest.raises(faults.InjectedConnectionError):
+                ca.send("payload")
+        # the stream carries no partial frame: a real send now arrives
+        ca.send("after")
+        assert cb.recv() == "after"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_injected_tcp_connect_recovers_bootstrap():
+    """net.tcp.connect: injected dial faults ride the bootstrap's
+    budgeted backoff loop — the full mesh still comes up."""
+    P = 2
+    ports = free_ports(P)
+    hosts = [("127.0.0.1", p) for p in ports]
+    results = [None] * P
+    errors = [None] * P
+
+    def target(r):
+        try:
+            g = construct_tcp_group(r, hosts, timeout=20)
+            if r == 1:
+                g.send_to(0, "hello")
+            else:
+                assert g.recv_from(1) == "hello"
+            results[r] = "up"
+            g.close()
+        except BaseException as e:
+            errors[r] = e
+
+    with faults.inject("net.tcp.connect", n=2, seed=13):
+        threads = [threading.Thread(target=target, args=(r,),
+                                    daemon=True) for r in range(P)]
+        for t in threads:
+            t.start()
+        deadline = load_scaled(60)
+        for t in threads:
+            t.join(timeout=deadline)
+    for e in errors:
+        if e is not None:
+            raise e
+    assert results == ["up", "up"]
+    assert faults.REGISTRY.injected >= 1
+
+
+def test_injected_multiplexer_frame_faults_recover():
+    """net.multiplexer.frame_send/recv: the frame helpers retry the
+    injected pre-wire fault and deliver the message."""
+    from thrill_tpu.data.multiplexer import _recv_frame, _send_frame
+
+    class LoopGroup:
+        def __init__(self):
+            self.q = []
+
+        def send_to(self, peer, msg):
+            self.q.append((peer, msg))
+
+        def recv_from(self, peer):
+            return self.q.pop(0)[1]
+
+    g = LoopGroup()
+    with faults.inject("net.multiplexer.frame_send", n=1, seed=17):
+        _send_frame(g, 1, {"x": 1}, "test")
+    with faults.inject("net.multiplexer.frame_recv", n=1, seed=17):
+        assert _recv_frame(g, 1, "test") == {"x": 1}
+    assert faults.REGISTRY.injected == 2
+    assert faults.REGISTRY.stats()["retries"] == 2
+
+
+def test_injected_timer_fault_keeps_timer_armed():
+    """net.dispatcher.timer: a transient fault in the periodic-callback
+    dispatch skips one tick; the timer keeps firing afterwards."""
+    from thrill_tpu.net.dispatcher import Dispatcher
+
+    disp = Dispatcher(force_py=True)
+    fired = threading.Event()
+    count = [0]
+
+    def cb():
+        count[0] += 1
+        if count[0] >= 3:
+            fired.set()
+        return True
+
+    try:
+        with faults.inject("net.dispatcher.timer", n=1, seed=19):
+            disp.add_timer(0.02, cb)
+            assert fired.wait(timeout=load_scaled(20)), \
+                "timer died after a transient fault instead of re-arming"
+        assert any(e.get("event") == "recovery"
+                   and e.get("what") == "dispatcher.timer"
+                   for e in faults.REGISTRY.events)
+    finally:
+        disp.close()

@@ -77,6 +77,15 @@ class Context:
         # storage-layer events (device->host demotions) log through the
         # mesh the shards carry a reference to
         self.mesh_exec.logger = self.logger
+        # fault-injection / retry / abort events from every layer ride
+        # the same JSON stream (tools/json2profile.py renders them);
+        # counters are process-lifetime, so snapshot a baseline and
+        # report per-job deltas (sequential Run()s must not inherit a
+        # previous job's retries)
+        from ..common import faults
+        if self.logger.enabled:
+            faults.REGISTRY.set_logger(self.logger.line)
+        self._faults_base = faults.REGISTRY.stats()
         self.mem = MemoryManager(name="context")
         from ..mem.hbm import HbmGovernor
         self.hbm = HbmGovernor(self, limit=self.config.hbm_limit)
@@ -275,21 +284,45 @@ class Context:
             "hbm_peak": self.hbm.mem.peak,
             "hbm_spills": self.hbm.spill_count,
             "hbm_restores": self.hbm.restore_count,
+            # robustness layer: lineage retries of hinted joins plus
+            # the process-wide fault/retry/abort counters
+            # (common/faults.py)
+            "join_overflow_retries": mex.stats_join_overflow_retries,
         }
+        from ..common import faults
+        stats.update({k: v - self._faults_base.get(k, 0)
+                      for k, v in faults.REGISTRY.stats().items()})
         if self.net.num_workers > 1:
             per_host = self.net.all_gather(stats)
             # almost every counter is a per-controller view of one
             # global value (exchange stats derive from the replicated
             # send matrix, the mesh spans all hosts, the DAG is one
             # logical graph) — take host 0's copy, don't sum. Only the
-            # host-process-local peaks genuinely differ across hosts.
+            # host-process-local peaks (and the per-process fault/
+            # retry/abort counters) genuinely differ across hosts.
             local_peaks = {"host_mem_peak"}
+            local_sums = {"faults_injected", "retries", "recoveries",
+                          "aborts"}
             stats = {
                 k: (max(h[k] for h in per_host) if k in local_peaks
-                    else per_host[0][k])
+                    else sum(h.get(k, 0) for h in per_host)
+                    if k in local_sums else per_host[0][k])
                 for k in stats}
             stats["hosts"] = len(per_host)
         return stats
+
+    def abort(self, cause: Any) -> None:
+        """Coordinated abort: broadcast ``cause`` as a poison control
+        frame to every controller (each peer surfaces it as a
+        ClusterAbort carrying this ROOT CAUSE within its own recv
+        deadline — no cascade of secondary timeouts), then raise it
+        locally."""
+        from ..net.group import ClusterAbort
+        if self.net.num_workers > 1:
+            self.net.group.poison_peers(cause)
+        if isinstance(cause, BaseException):
+            raise cause
+        raise ClusterAbort(self.host_rank, str(cause))
 
     def collective_mean_stdev(self, value: float):
         """(mean, stdev) of a per-controller scalar across the cluster
@@ -317,6 +350,9 @@ class Context:
         stats = self.overall_stats()
         if self.logger.enabled:
             self.logger.line(event="overall_stats", **stats)
+        from ..common import faults
+        if faults.REGISTRY._log == self.logger.line:
+            faults.REGISTRY.set_logger(None)
         self.logger.close()
         self.hbm.close()
         if self.net.num_workers > 1:

@@ -37,6 +37,12 @@ class ParentLink:
 
     def pull(self, consume: bool = True) -> Shards:
         shards = self.node.materialize(consume=consume)
+        if isinstance(shards, DeviceShards):
+            # deferred producer validations (hinted-join overflow) run
+            # BEFORE any consumer — downstream op or action — reads the
+            # columns: a recovering check heals shards.tree in place,
+            # so truncation can neither propagate nor be consumed
+            shards.validate_pending()
         if not self.stack:
             return shards
         if isinstance(shards, HostShards):

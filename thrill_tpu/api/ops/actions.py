@@ -58,6 +58,10 @@ def AllGatherArrays(dia):
     come back as a single stacked array."""
     shards = _pull(dia)
     mex = dia.context.mesh_exec
+    # device-native egress never goes through mex.fetch on a single
+    # controller: drain deferred validations here so a hinted-join
+    # overflow can never ride out through columnar results
+    mex.drain_checks()
     if isinstance(shards, HostShards):
         items = multiplexer.all_items(mex, shards)
         if not items:
@@ -93,6 +97,7 @@ def Gather(dia, root: int = 0) -> list:
     (the reference's non-root workers likewise emit nothing)."""
     shards = _pull(dia)
     mex = dia.context.mesh_exec
+    mex.drain_checks()                   # egress: no unrun validations
     root = root % max(mex.num_workers, 1)
     if isinstance(shards, DeviceShards):
         shards = shards.to_host_shards("gather-action")
@@ -166,8 +171,17 @@ def _dtype_min(dt):
 def Sum(dia, initial: Any = 0, device: bool = False) -> Any:
     """``device=True`` (device-storage DIAs): return the summed pytree
     as replicated DEVICE arrays, no host fetch — feed it straight back
-    into a ``Bind`` (zero-sync iterative loops)."""
+    into a ``Bind`` (zero-sync iterative loops). Single-controller
+    only by contract: on a multi-process mesh the request falls back
+    to the fetched path (the device result would span non-addressable
+    devices and fail confusingly under eager math / np.asarray)."""
     shards = _pull(dia)
+    if device and multiplexer.multiprocess(dia.context.mesh_exec):
+        device = False
+    if device:
+        # device-array egress bypasses mex.fetch: run deferred
+        # validations before handing columns back to the caller
+        dia.context.mesh_exec.drain_checks()
     if isinstance(shards, DeviceShards):
         # Single-controller with device-resident counts: SKIP the
         # empty-guard — forcing a counts sync here would stall

@@ -49,8 +49,10 @@ class InnerJoinNode(DIABase):
         # blocking device->host size sync and keeps the whole join in
         # jax's async-dispatch stream. On a tunneled chip that sync is
         # a full link RTT per join per iteration (BASELINE.md r5).
-        # Overflow is detected at the next natural counts realization
-        # and raises (never silently truncates). TPU-native extension:
+        # Overflow is detected before any consumer reads the columns
+        # and recovers by re-running the expansion un-hinted (or raises
+        # with THRILL_TPU_JOIN_RECOVER=0 — never silently truncates).
+        # TPU-native extension:
         # the reference sizes from its spilled files host-side
         # (api/inner_join.hpp:208) and has no such sync to skip.
         self.out_size_hint = out_size_hint
@@ -210,81 +212,161 @@ class InnerJoinNode(DIABase):
             totals = mex.fetch(out1[0]).reshape(-1).astype(np.int64)
             out_cap = round_up_pow2(max(int(totals.max()), 1))
 
-        # phase 2: expand pairs and apply join_fn
-        key2 = ("join_expand", token, lcap, rcap, out_cap, ltd, rtd,
-                tuple((l.dtype, l.shape[2:]) for l in lleaves),
-                tuple((l.dtype, l.shape[2:]) for l in rleaves))
-        holder = {}
+        # phase 2: expand pairs and apply join_fn. ``expand`` is the
+        # re-runnable half of the join's lineage: phase-1 outputs
+        # (sorted sides + per-item match runs) plus a capacity fully
+        # determine the result, so the overflow recovery below can
+        # re-execute it at the TRUE capacity without touching parents.
+        def expand(cap_: int):
+            key2 = ("join_expand", token, lcap, rcap, cap_, ltd, rtd,
+                    tuple((l.dtype, l.shape[2:]) for l in lleaves),
+                    tuple((l.dtype, l.shape[2:]) for l in rleaves))
+            holder = {}
 
-        def build2():
-            def f(matches, lo, *ls):
-                m = matches[0]                       # [rcap] pair counts
-                lo_ = lo[0]                          # [rcap] left run start
-                ltree = jax.tree.unflatten(ltd, [x[0] for x in ls[:nl]])
-                rtree = jax.tree.unflatten(rtd, [x[0] for x in ls[nl:]])
-                ends = jnp.cumsum(m)                 # [rcap]
-                total = ends[-1] if m.shape[0] else jnp.int64(0)
-                p = jnp.arange(out_cap, dtype=jnp.int64)
-                ridx = jnp.searchsorted(ends, p, side="right")
-                ridx = jnp.clip(ridx, 0, rcap - 1)
-                starts = ends - m
-                lidx = lo_[ridx] + (p - starts[ridx])
-                lidx = jnp.clip(lidx, 0, lcap - 1)
-                lsel = jax.tree.map(lambda x: jnp.take(x, lidx, axis=0),
-                                    ltree)
-                rsel = jax.tree.map(lambda x: jnp.take(x, ridx, axis=0),
-                                    rtree)
-                out = jfn(lsel, rsel)
-                out_leaves, out_td = jax.tree.flatten(out)
-                holder["treedef"] = out_td
-                return tuple(x[None] for x in out_leaves)
+            def build2():
+                def f(matches, lo, *ls):
+                    m = matches[0]                   # [rcap] pair counts
+                    lo_ = lo[0]                      # [rcap] left run start
+                    ltree = jax.tree.unflatten(ltd,
+                                               [x[0] for x in ls[:nl]])
+                    rtree = jax.tree.unflatten(rtd,
+                                               [x[0] for x in ls[nl:]])
+                    ends = jnp.cumsum(m)             # [rcap]
+                    p = jnp.arange(cap_, dtype=jnp.int64)
+                    ridx = jnp.searchsorted(ends, p, side="right")
+                    ridx = jnp.clip(ridx, 0, rcap - 1)
+                    starts = ends - m
+                    lidx = lo_[ridx] + (p - starts[ridx])
+                    lidx = jnp.clip(lidx, 0, lcap - 1)
+                    lsel = jax.tree.map(
+                        lambda x: jnp.take(x, lidx, axis=0), ltree)
+                    rsel = jax.tree.map(
+                        lambda x: jnp.take(x, ridx, axis=0), rtree)
+                    out = jfn(lsel, rsel)
+                    out_leaves, out_td = jax.tree.flatten(out)
+                    holder["treedef"] = out_td
+                    return tuple(x[None] for x in out_leaves)
 
-            # (fn, holder) pair is what gets cached: a cache HIT must
-            # read the FIRST build's holder (filled at trace time) —
-            # a fresh local dict would be empty (the Merge regression,
-            # test_merge_executable_cache_hit, same class)
-            return mex.smap(f, 2 + nl + len(rleaves)), holder
+                # (fn, holder) pair is what gets cached: a cache HIT
+                # must read the FIRST build's holder (filled at trace
+                # time) — a fresh local dict would be empty (the Merge
+                # regression, test_merge_executable_cache_hit)
+                return mex.smap(f, 2 + nl + len(rleaves)), holder
 
-        f2, h2 = mex.cached(key2, build2)
-        out2 = f2(matches_dev, lo_dev, *lsorted, *rsorted)
-        tree = jax.tree.unflatten(h2["treedef"], list(out2))
+            f2, h2 = mex.cached(key2, build2)
+            out2 = f2(matches_dev, lo_dev, *lsorted, *rsorted)
+            return jax.tree.unflatten(h2["treedef"], list(out2))
+
+        tree = expand(out_cap)
         if totals is not None:
             return DeviceShards(mex, tree, totals)
         # hint path: counts stay on device (no host sync; the eager
-        # astype is one more async device op in the stream)
+        # astype is one more async device op in the stream). Kick the
+        # totals' device->host copy off NOW so the deferred validation
+        # at the consumer's pull confirms an already-landed value
+        # instead of stalling the dispatch stream.
         out = DeviceShards(mex, tree, out1[0].astype(jnp.int32))
         cap, hint, totals_dev = out_cap, self.out_size_hint, out1[0]
-        # state is STICKY on failure: once an overflow is detected,
-        # every later validation re-raises — a caller that swallows the
-        # first error (bench metric wrappers catch Exception) can never
-        # silently read the truncated data afterwards
-        state = {"ok": False, "err": None}
+        try:
+            totals_dev.copy_to_host_async()
+        except Exception:
+            pass                   # overlap is best-effort, not needed
+        # state is STICKY on failure: once an overflow is detected with
+        # recovery disabled, every later validation re-raises — a
+        # caller that swallows the first error (bench metric wrappers
+        # catch Exception) can never silently read truncated data.
+        # COST, accepted deliberately: until the first consumer
+        # validates (normally the very next pull), the ``expand``
+        # closure pins the phase-1 outputs (sorted copies of both
+        # sides + match runs, ~the join's input size) in HBM as the
+        # recovery lineage, and that validation blocks the host on
+        # phase-1 completion (overlapped with phase-2's already-
+        # dispatched execution; the D2H copy itself was started async
+        # above). ALL device refs live in ``state`` and are nulled the
+        # moment the check resolves, so the entry that may linger in
+        # mex._pending_checks until the next drain pins nothing — a
+        # spilled node's HBM really frees.
+        state = {"ok": False, "err": None, "expand": expand,
+                 "out": out, "totals": totals_dev}
+        label, dia_id = self.label, self.id
+        node, hbm = self, self.context.hbm
+
+        def _resolve() -> None:
+            state["ok"] = state["err"] is None
+            state["expand"] = None
+            state["out"] = None
+            state["totals"] = None
 
         def validate(counts: np.ndarray) -> None:
             if state["err"] is not None:
                 raise state["err"]
             if state["ok"]:
                 return
-            if counts.max(initial=0) > cap:
+            worst = int(counts.max(initial=0))
+            if worst > cap:
+                import os
+                if os.environ.get("THRILL_TPU_JOIN_RECOVER",
+                                  "1") != "0":
+                    # lineage retry: re-run the expansion at the true
+                    # capacity and heal the shards IN PLACE — every
+                    # consumer validates before reading the columns
+                    # (ParentLink.pull / counts / egress drains), so
+                    # the truncated tree was never observable
+                    true_cap = round_up_pow2(max(worst, 1))
+                    o = state["out"]
+                    o.tree = state["expand"](true_cap)
+                    mex.stats_join_overflow_retries += 1
+                    if (node._shards is o
+                            and getattr(node, "_hbm_bytes", 0)):
+                        # the healed tree is larger than what on_cache
+                        # accounted: resync the governor or the budget
+                        # drifts under-counted forever. ACCOUNTING
+                        # ONLY — no maybe_spill from in here:
+                        # validation runs inside arbitrary frames
+                        # (another node's spill, a parent pull
+                        # mid-materialize), and evicting from this
+                        # depth can re-enter an unresolved sibling's
+                        # recovery or spill shards an ancestor frame
+                        # is actively returning. The next natural
+                        # pressure event (on_cache/touch) evicts.
+                        nb = hbm._device_bytes(o)
+                        hbm.mem.subtract(node._hbm_bytes)
+                        node._hbm_bytes = nb
+                        hbm.mem.add(nb)
+                    # resolve before the note so a re-entrant
+                    # validation is a no-op, never a second recovery
+                    _resolve()
+                    # ONE emission: note() counts the recovery and
+                    # forwards to the Context's JSON logger (attached
+                    # in Context.__init__)
+                    from ...common import faults
+                    faults.note("recovery", what="join_out_size_hint",
+                                node=label, dia_id=dia_id,
+                                hint=int(hint), true_max=worst,
+                                new_cap=true_cap)
+                    return
                 state["err"] = ValueError(
                     f"InnerJoin out_size_hint={hint} (cap {cap}) "
-                    f"overflowed: a worker produced "
-                    f"{int(counts.max())} pairs; results were "
-                    f"truncated — raise the hint or drop it")
+                    f"overflowed: a worker produced {worst} pairs; "
+                    f"results were truncated — raise the hint or "
+                    f"drop it")
+                _resolve()
                 raise state["err"]
-            state["ok"] = True
+            _resolve()
 
         out._counts_check = validate
 
         def pending_check() -> None:
             # fetch drains catch chains that never realize THIS
-            # shards' counts. Skip the totals transfer once validated;
+            # shards' counts. Skip the totals transfer once resolved;
             # the transfer uses _fetch_raw (multi-controller safe, no
             # stats, and the drain already swapped the queue out so
             # re-entrancy cannot loop)
+            if state["err"] is not None:
+                raise state["err"]      # sticky: a drain surfaces it
             if state["ok"]:
                 return
-            validate(mex._fetch_raw(totals_dev).reshape(-1))
+            validate(mex._fetch_raw(state["totals"]).reshape(-1))
 
         mex._pending_checks.append(pending_check)
         return out
@@ -421,8 +503,13 @@ def InnerJoin(left: DIA, right: DIA, left_key_fn, right_key_fn,
               join_fn, location_detection: bool = False,
               out_size_hint=None) -> DIA:
     """``out_size_hint``: optional per-worker upper bound on match
-    count; lets the device path skip its blocking size sync (overflow
-    raises at the next host fetch, never silently truncates)."""
+    count; lets the device path skip its blocking size sync. A wrong
+    hint is SAFE: overflow is detected before any consumer reads the
+    columns and the join phase transparently re-runs without the hint
+    (lineage retry; ``event=recovery`` logged, counted in
+    ``ctx.overall_stats()['join_overflow_retries']``). Set
+    THRILL_TPU_JOIN_RECOVER=0 to raise instead of recovering — either
+    way it never silently truncates."""
     return DIA(InnerJoinNode(left.context, left._link(), right._link(),
                              left_key_fn, right_key_fn, join_fn,
                              location_detection=location_detection,

@@ -1,0 +1,325 @@
+"""Deterministic, seeded fault injection.
+
+The reference framework's only failure story is die-with-parent
+process hygiene (reference: thrill/api/context.cpp:849-878) — its
+recovery paths are untestable because nothing can *provoke* a fault on
+demand. This registry makes every failure mode in this framework a
+named, seeded, reproducible event:
+
+* Code declares **sites** at import time (``declare("net.tcp.send",
+  kind="transient")``) and calls ``check("net.tcp.send")`` at the
+  matching operation. With no injection configured the check is a dict
+  lookup — effectively free.
+* Operators/tests arm sites via ``THRILL_TPU_FAULTS`` (or the
+  :func:`inject` context manager). Spec grammar, semicolon-separated::
+
+      THRILL_TPU_FAULTS="net.tcp.send:p=0.5:n=2:seed=7;vfs.*:n=1"
+
+  - site name or ``fnmatch`` pattern (``net.*``)
+  - ``p=<float>``  per-hit fire probability (default 1.0)
+  - ``n=<int>``    max fires for this entry (default 1; ``n=0`` =
+    unbounded)
+  - ``seed=<int>`` RNG seed; the stream is derived from
+    ``(seed, site)`` so two sites armed by one pattern fire
+    independently but reproducibly (default 0)
+  - ``after=<int>`` skip the first k eligible hits (default 0)
+* Every trigger is recorded in :data:`REGISTRY` and logged as a JSON
+  ``event=fault_injected`` line (visible to tools/json2profile.py)
+  when a logger is attached (api/context.py attaches the Context's).
+
+A fired check raises :class:`InjectedConnectionError` /
+:class:`InjectedIOError` / :class:`InjectedFault` per the site's
+declared exception class, so the *real* error-handling paths — the
+retry policy in common/retry.py, the poison-abort protocol in
+net/group.py — are what the injection exercises; nothing is mocked.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+ENV_VAR = "THRILL_TPU_FAULTS"
+
+TRANSIENT = "transient"
+PERMANENT = "permanent"
+
+
+class InjectedFault(Exception):
+    """Base class of every injected error; ``site`` names the origin."""
+
+    def __init__(self, site: str, kind: str = TRANSIENT) -> None:
+        super().__init__(f"injected fault at site '{site}' ({kind})")
+        self.site = site
+        self.kind = kind
+
+
+class InjectedConnectionError(InjectedFault, ConnectionError):
+    """Injected transport fault (dropped socket, failed frame)."""
+
+
+class InjectedIOError(InjectedFault, IOError):
+    """Injected storage fault (flaky object-store read, spill I/O)."""
+
+
+class Site:
+    """A declared injection point."""
+
+    def __init__(self, name: str, kind: str, exc: type) -> None:
+        self.name = name
+        self.kind = kind            # failure class the site simulates
+        self.exc = exc
+        self.hits = 0               # check() calls while armed
+        self.fires = 0              # faults actually raised
+
+
+class _Arm:
+    """One armed spec entry (pattern, probability, budget, RNG)."""
+
+    def __init__(self, pattern: str, p: float, n: int, seed: int,
+                 after: int) -> None:
+        self.pattern = pattern
+        self.p = p
+        self.n = n                  # 0 = unbounded
+        self.seed = seed
+        self.after = after
+        self._rngs: Dict[str, random.Random] = {}
+        self._fired: Dict[str, int] = {}
+        self._seen: Dict[str, int] = {}
+
+    def matches(self, site: str) -> bool:
+        return site == self.pattern or fnmatch.fnmatchcase(site,
+                                                           self.pattern)
+
+    def fire(self, site: str) -> bool:
+        """Deterministic per-(entry, site) decision stream."""
+        seen = self._seen.get(site, 0)
+        self._seen[site] = seen + 1
+        if seen < self.after:
+            return False
+        if self.n and self._fired.get(site, 0) >= self.n:
+            return False
+        rng = self._rngs.get(site)
+        if rng is None:
+            rng = self._rngs[site] = random.Random(f"{self.seed}:{site}")
+        if self.p < 1.0 and rng.random() >= self.p:
+            return False
+        self._fired[site] = self._fired.get(site, 0) + 1
+        return True
+
+
+class FaultRegistry:
+    """Site table + armed spec, re-parsed when the env string changes."""
+
+    def __init__(self) -> None:
+        self.sites: Dict[str, Site] = {}
+        self.events: List[dict] = []      # recent fault_injected records
+        self.injected = 0                 # total faults raised
+        self.retries = 0                  # retry-policy sleeps taken
+        self.recoveries = 0               # successful recovery events
+        self.aborts = 0                   # poison frames broadcast
+        self._arms: List[_Arm] = []
+        self._spec: Optional[str] = None
+        self._log: Optional[Callable[..., None]] = None
+        self._lock = threading.Lock()
+
+    # -- declaration ---------------------------------------------------
+    def declare(self, name: str, kind: str = TRANSIENT,
+                exc: type = InjectedIOError) -> str:
+        site = self.sites.get(name)
+        if site is None:
+            self.sites[name] = Site(name, kind, exc)
+        return name
+
+    # -- arming --------------------------------------------------------
+    def _sync(self) -> None:
+        spec = os.environ.get(ENV_VAR, "")
+        if spec == self._spec:
+            return
+        self._spec = spec
+        self._arms = parse_spec(spec)
+
+    def armed(self, site: str) -> bool:
+        with self._lock:
+            self._sync()
+            return any(a.matches(site) for a in self._arms)
+
+    def active(self) -> bool:
+        """Cheap lock-free predicate: is ANY injection possibly armed?
+        Hot call sites (per-frame, per-dispatch) gate their policy
+        wrapping on it so the disarmed steady state pays one env read."""
+        return bool(os.environ.get(ENV_VAR)) or bool(self._arms)
+
+    # -- the hot check -------------------------------------------------
+    def check(self, name: str, **detail: Any) -> None:
+        """Raise the site's exception when an armed entry fires.
+
+        ``detail`` fields ride into the log record (peer rank, path...).
+        Disarmed fast path is lock-free: one env read + two attribute
+        reads (benign race — a spec change mid-read just takes the
+        locked slow path on the next call).
+        """
+        spec = os.environ.get(ENV_VAR, "")
+        if spec == self._spec and not self._arms:
+            return
+        with self._lock:
+            self._sync()
+            if not self._arms:
+                return
+            site = self.sites.get(name)
+            if site is None:
+                site = self.sites[name] = Site(name, TRANSIENT,
+                                               InjectedIOError)
+            fired = False
+            for arm in self._arms:
+                if arm.matches(name):
+                    site.hits += 1
+                    if arm.fire(name):
+                        fired = True
+                        break
+            if not fired:
+                return
+            site.fires += 1
+            self.injected += 1
+            rec = {"event": "fault_injected", "site": name,
+                   "kind": site.kind, "fire": site.fires}
+            rec.update(detail)
+            self.events.append(rec)
+            if len(self.events) > 1024:
+                del self.events[:512]
+            log = self._log
+        self._emit(log, rec)
+        raise site.exc(name, site.kind)
+
+    # -- observability -------------------------------------------------
+    def note(self, event: str, _quiet: bool = False,
+             **detail: Any) -> None:
+        """Record a recovery-layer event (retry / recovery / abort)
+        into the same JSON stream the injections use. ``_quiet`` bumps
+        the counter WITHOUT an event record — high-frequency callers
+        (bootstrap dials) log sparsely but must never under-count."""
+        with self._lock:
+            if event == "retry":
+                self.retries += 1
+            elif event == "recovery":
+                self.recoveries += 1
+            elif event == "abort":
+                self.aborts += 1
+            if _quiet:
+                return
+            rec = {"event": event}
+            rec.update(detail)
+            self.events.append(rec)
+            if len(self.events) > 1024:
+                del self.events[:512]
+            log = self._log
+        self._emit(log, rec)
+
+    @staticmethod
+    def _emit(log: Optional[Callable[..., None]], rec: dict) -> None:
+        if log is None:
+            return
+        try:
+            log(**rec)
+        except Exception:
+            pass                  # logging must never mask the fault
+
+    def set_logger(self, line: Optional[Callable[..., None]]) -> None:
+        """``line(**fields)`` sink for JSON events (JsonLogger.line)."""
+        with self._lock:
+            self._log = line
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"faults_injected": self.injected,
+                    "retries": self.retries,
+                    "recoveries": self.recoveries,
+                    "aborts": self.aborts}
+
+    def reset(self) -> None:
+        """Forget armed state + counters (tests)."""
+        with self._lock:
+            self._spec = None
+            self._arms = []
+            self.events = []
+            self.injected = self.retries = 0
+            self.recoveries = self.aborts = 0
+            for s in self.sites.values():
+                s.hits = s.fires = 0
+
+
+def parse_spec(spec: str) -> List[_Arm]:
+    """Parse a THRILL_TPU_FAULTS value; malformed entries are skipped
+    loudly (a typo must not silently disable the whole chaos run)."""
+    arms: List[_Arm] = []
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        pattern, p, n, seed, after = parts[0].strip(), 1.0, 1, 0, 0
+        ok = bool(pattern)
+        for kv in parts[1:]:
+            k, _, v = kv.partition("=")
+            try:
+                if k == "p":
+                    p = float(v)
+                elif k == "n":
+                    n = int(v)
+                elif k == "seed":
+                    seed = int(v)
+                elif k == "after":
+                    after = int(v)
+                else:
+                    raise ValueError(k)
+            except ValueError:
+                ok = False
+        if ok:
+            arms.append(_Arm(pattern, p, n, seed, after))
+        else:
+            import sys
+            print(f"thrill_tpu.faults: malformed {ENV_VAR} entry "
+                  f"{entry!r} ignored", file=sys.stderr)
+    return arms
+
+
+#: process-wide registry: sites declare here, Context attaches its
+#: JsonLogger here, overall_stats() reads the counters here
+REGISTRY = FaultRegistry()
+
+declare = REGISTRY.declare
+check = REGISTRY.check
+note = REGISTRY.note
+armed = REGISTRY.armed
+
+
+class inject:
+    """Context manager arming sites programmatically (tests)::
+
+        with faults.inject("api.mesh.dispatch", n=1, seed=3):
+            ...
+
+    Composes with an existing env spec by appending; restores the
+    previous value on exit.
+    """
+
+    def __init__(self, pattern: str, p: float = 1.0, n: int = 1,
+                 seed: int = 0, after: int = 0) -> None:
+        self.entry = f"{pattern}:p={p}:n={n}:seed={seed}:after={after}"
+        self._prev: Optional[str] = None
+
+    def __enter__(self) -> "inject":
+        self._prev = os.environ.get(ENV_VAR)
+        merged = (f"{self._prev};{self.entry}" if self._prev
+                  else self.entry)
+        os.environ[ENV_VAR] = merged
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._prev is None:
+            os.environ.pop(ENV_VAR, None)
+        else:
+            os.environ[ENV_VAR] = self._prev

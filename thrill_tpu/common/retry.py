@@ -1,0 +1,131 @@
+"""Bounded exponential backoff with full jitter — THE retry policy.
+
+Like common/timeouts.py is the one distress-deadline policy, this is
+the one transient-fault retry policy: tcp bootstrap dials, s3/hdfs/
+posix ranged reads, multiplexer frame I/O and device dispatch all
+retry through :class:`RetryPolicy` instead of hand-rolling loops, so
+attempt budgets and backoff shape can never silently diverge between
+layers.
+
+Shape: attempt k sleeps ``uniform(0, min(max_delay, base * 2**k))`` —
+"full jitter" (the AWS Architecture Blog analysis: equal-jitter and
+no-jitter herd retries into synchronized spikes; full jitter spreads
+them). Deterministic under test via an explicit ``seed``.
+
+Classification is explicit and *permanent wins*: an exception listed
+(or derived from a class listed) in ``permanent`` never retries even
+if it also matches ``transient`` — a bad MAC is a ConnectionError, but
+retrying authentication failures would turn a key mismatch into a
+slow, noisy mystery. Injected faults (common/faults.py) carry their
+class in ``.kind`` and are classified by it, whatever they subclass.
+
+Env overrides (cluster-wide tuning without code changes):
+``THRILL_TPU_RETRY_ATTEMPTS``, ``THRILL_TPU_RETRY_BASE_S``,
+``THRILL_TPU_RETRY_MAX_S``; ``THRILL_TPU_RETRY=0`` disables retries
+globally (every fault surfaces on first hit — chaos runs use it to
+assert the *detection* half of the story).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Any, Callable, Optional, Tuple
+
+from . import faults
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        v = os.environ.get(name)
+        return float(v) if v not in (None, "") else default
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        v = os.environ.get(name)
+        return int(v) if v not in (None, "") else default
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Immutable retry policy; ``run()`` executes a callable under it."""
+
+    max_attempts: int = 4           # total tries (1 = no retry)
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    transient: Tuple[type, ...] = (ConnectionError, TimeoutError,
+                                   OSError)
+    permanent: Tuple[type, ...] = ()
+
+    def classify(self, exc: BaseException) -> str:
+        """'transient' | 'permanent' — permanent wins ties.
+
+        Deterministic OSError subclasses (missing file, permissions,
+        wrong node type) are permanent even though OSError is in the
+        default transient set: retrying them could never succeed and
+        only delays + mislabels the real error."""
+        from ..net import wire
+        from ..net.group import ClusterAbort
+        if isinstance(exc, (wire.AuthError, ClusterAbort,
+                            FileNotFoundError, PermissionError,
+                            IsADirectoryError, NotADirectoryError)
+                      + tuple(self.permanent)):
+            return faults.PERMANENT
+        if isinstance(exc, faults.InjectedFault):
+            return exc.kind          # injection declares its own class
+        if isinstance(exc, tuple(self.transient)):
+            return faults.TRANSIENT
+        return faults.PERMANENT
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Full-jitter backoff for ``attempt`` (0-based)."""
+        cap = min(self.max_delay_s, self.base_delay_s * (2 ** attempt))
+        return rng.uniform(0.0, cap)
+
+    def run(self, fn: Callable[[], Any], *, what: str,
+            seed: Optional[int] = None,
+            sleep: Callable[[float], None] = time.sleep) -> Any:
+        """Call ``fn()`` retrying transient failures with backoff.
+
+        ``what`` names the operation in retry logs; ``seed`` pins the
+        jitter stream (tests); ``sleep`` is injectable for zero-delay
+        unit tests. The last failure re-raises unchanged, so callers'
+        except clauses see the real error type.
+        """
+        attempts = self.max_attempts
+        if os.environ.get("THRILL_TPU_RETRY", "1") == "0":
+            attempts = 1
+        rng = None                   # lazy: the happy path never pays
+        for attempt in range(attempts):
+            try:
+                return fn()
+            except BaseException as e:
+                if (attempt + 1 >= attempts
+                        or self.classify(e) != faults.TRANSIENT):
+                    raise
+                if rng is None:
+                    rng = random.Random(seed if seed is not None
+                                        else random.getrandbits(32))
+                d = self.delay(attempt, rng)
+                faults.note("retry", what=what, attempt=attempt + 1,
+                            delay_s=round(d, 4), error=repr(e))
+                sleep(d)
+        raise AssertionError("unreachable")     # pragma: no cover
+
+
+def default_policy(**overrides: Any) -> RetryPolicy:
+    """Policy with env-tuned knobs; keyword args override per site."""
+    kw = dict(
+        max_attempts=_env_int("THRILL_TPU_RETRY_ATTEMPTS", 4),
+        base_delay_s=_env_float("THRILL_TPU_RETRY_BASE_S", 0.05),
+        max_delay_s=_env_float("THRILL_TPU_RETRY_MAX_S", 2.0),
+    )
+    kw.update(overrides)
+    return RetryPolicy(**kw)

@@ -13,6 +13,18 @@ import ctypes
 import threading
 from typing import Dict, Optional
 
+from ..common import faults
+from ..common.retry import default_policy
+
+# spill-store I/O: both operations are idempotent (put allocates a
+# fresh id; get re-reads immutable bytes), so transient storage faults
+# retry under the shared backoff policy before surfacing. Unlike the
+# injection-only frame/dispatch sites there is no active() fast-path
+# gate here: REAL disk faults on the native spill files are retryable
+# too, and the policy cost is noise against per-block I/O.
+_F_PUT = faults.declare("data.blockstore.put")
+_F_GET = faults.declare("data.blockstore.get")
+
 _LIB_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_FAILED = False
@@ -85,6 +97,9 @@ class BlockPool:
                  async_io: bool = True) -> None:
         self._lib = _load_native()
         self.native = self._lib is not None
+        # one policy per pool, not per block (env knobs are stable for
+        # a pool's lifetime)
+        self._policy = default_policy()
         self._refs: Dict[int, int] = {}   # shared-Block refcounts (>1)
         self._ref_lock = threading.Lock()
         if self.native:
@@ -96,6 +111,11 @@ class BlockPool:
             self._soft = soft_limit
 
     def put(self, data: bytes) -> int:
+        return self._policy.run(lambda: self._put_once(data),
+                                what="blockstore.put")
+
+    def _put_once(self, data: bytes) -> int:
+        faults.check(_F_PUT, nbytes=len(data))
         if self.native:
             return self._lib.bs_put(self._h, data, len(data))
         bid = self._next
@@ -104,6 +124,11 @@ class BlockPool:
         return bid
 
     def get(self, block_id: int) -> bytes:
+        return self._policy.run(lambda: self._get_once(block_id),
+                                what="blockstore.get")
+
+    def _get_once(self, block_id: int) -> bytes:
+        faults.check(_F_GET, block=block_id)
         if self.native:
             size = self._lib.bs_size(self._h, block_id)
             if size < 0:

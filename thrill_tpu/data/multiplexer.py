@@ -33,10 +33,42 @@ from typing import Any, Callable, List, Optional
 
 import numpy as np
 
+from ..common import faults
 from ..common.config import round_up_pow2
+from ..common.retry import default_policy
+from ..net.group import poison_on_error
 from .shards import DeviceShards, HostShards
 
 _MISSING = "__thrill_tpu_missing__"
+
+# frame-level injection: fires before the frame hits the transport, so
+# a retry is safe (nothing was sent); real mid-stream transport errors
+# are permanent here (the stream position is unrecoverable)
+_F_SEND = faults.declare("net.multiplexer.frame_send",
+                         exc=faults.InjectedConnectionError)
+_F_RECV = faults.declare("net.multiplexer.frame_recv",
+                         exc=faults.InjectedConnectionError)
+_FRAME_RETRY = dict(transient=(faults.InjectedConnectionError,))
+
+
+def _send_frame(group, peer: int, msg: Any, what: str) -> None:
+    if not faults.REGISTRY.active():     # disarmed hot path: direct
+        return group.send_to(peer, msg)
+
+    def op():
+        faults.check(_F_SEND, peer=peer, what=what)
+        group.send_to(peer, msg)
+    default_policy(**_FRAME_RETRY).run(op, what=f"{what}:send")
+
+
+def _recv_frame(group, peer: int, what: str) -> Any:
+    if not faults.REGISTRY.active():
+        return group.recv_from(peer)
+
+    def op():
+        faults.check(_F_RECV, peer=peer, what=what)
+        return group.recv_from(peer)
+    return default_policy(**_FRAME_RETRY).run(op, what=f"{what}:recv")
 
 
 def multiprocess(mex) -> bool:
@@ -109,12 +141,13 @@ def host_exchange(mex, shards: HostShards, dest_fn: Callable[[Any], int],
     received = [outgoing[me]]
     sent_items = 0
     group = net.group
-    for r in range(1, P):
-        to, frm = (me + r) % P, (me - r) % P
-        sent_items += sum(len(b) for dws in outgoing[to].values()
-                          for b in dws.values())
-        group.send_to(to, outgoing[to])
-        received.append(group.recv_from(frm))
+    with poison_on_error(group, "host_exchange"):
+        for r in range(1, P):
+            to, frm = (me + r) % P, (me - r) % P
+            sent_items += sum(len(b) for dws in outgoing[to].values()
+                              for b in dws.values())
+            _send_frame(group, to, outgoing[to], "host_exchange")
+            received.append(_recv_frame(group, frm, "host_exchange"))
 
     lists: List[List[Any]] = [[] for _ in range(W)]
     for w in mex.local_workers:
@@ -147,7 +180,8 @@ def ensure_replicated(mex, shards: HostShards,
     W = shards.num_workers
     local = {w: shards.lists[w] for w in mex.local_workers
              if shards.lists[w]}
-    gathered = net.all_gather(local)
+    with poison_on_error(net.group, "host_replicate"):
+        gathered = net.all_gather(local)
     lists: List[List[Any]] = [[] for _ in range(W)]
     for msg in gathered:
         for w, items in msg.items():
@@ -177,7 +211,9 @@ def global_counts(mex, shards: HostShards) -> np.ndarray:
     net = _net(mex)
     counts = np.zeros(shards.num_workers, dtype=np.int64)
     local = {w: len(shards.lists[w]) for w in mex.local_workers}
-    for msg in net.all_gather(local):
+    with poison_on_error(net.group, "global_counts"):
+        gathered = net.all_gather(local)
+    for msg in gathered:
         for w, n in msg.items():
             counts[int(w)] = int(n)
     return counts
@@ -207,7 +243,9 @@ def net_fold(mex, local: Any, op: Callable[[Any, Any], Any],
         if empty:
             raise ValueError("fold over an empty DIA")
         return local
-    vals = _net(mex).all_gather(_MISSING if empty else local)
+    net = _net(mex)
+    with poison_on_error(net.group, "net_fold"):
+        vals = net.all_gather(_MISSING if empty else local)
     vals = [v for v in vals if not (isinstance(v, str) and v == _MISSING)]
     if not vals:
         raise ValueError("fold over an empty DIA")

@@ -107,11 +107,37 @@ class DeviceShards:
             if self._counts_check is not None:
                 # validate BEFORE caching: if the check raises (sticky
                 # overflow), the next access re-validates instead of
-                # silently serving truncated counts
+                # silently serving truncated counts. A RECOVERING check
+                # (hinted-join lineage retry) heals self.tree in place
+                # and returns normally.
                 self._counts_check(counts)
                 self._counts_check = None
             self._counts_host = counts
         return self._counts_host
+
+    def validate_pending(self) -> None:
+        """Run a deferred counts check NOW (no-op without one).
+
+        Called by the stage driver when these shards flow into a
+        downstream operator (api/dia_base.py ParentLink.pull): a
+        hinted-join overflow must be detected — and recovered — BEFORE
+        any consumer bakes truncated columns into its own program. The
+        transfer rides ``_fetch_raw`` (untracked): the producing op
+        started it asynchronously at compute time, so by pull time it
+        usually only confirms an already-landed host copy instead of
+        stalling the dispatch stream like a plan sync would.
+        """
+        if self._counts_check is None:
+            return
+        if self._counts_host is not None:
+            counts = self._counts_host
+        else:
+            counts = self.mesh_exec._fetch_raw(
+                self._counts_dev).reshape(-1).astype(np.int64)
+        self._counts_check(counts)    # sticky: stays set if it raises
+        self._counts_check = None
+        if self._counts_host is None:
+            self._counts_host = counts
 
     @property
     def num_workers(self) -> int:
@@ -210,6 +236,10 @@ class DeviceShards:
         ``local_only`` (multi-controller): read only this process's
         addressable device shards — no cross-process allgather of the
         bulk data — and return ``None`` for non-local workers."""
+        # deferred producer validation BEFORE the bulk fetch: a
+        # recovering check swaps self.tree, and fetching first would
+        # materialize the pre-recovery columns
+        self.validate_pending()
         if local_only and getattr(self.mesh_exec, "num_processes", 1) > 1:
             return self._local_worker_arrays()
         host_tree = self.mesh_exec.fetch_tree(self.tree)

@@ -29,7 +29,16 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common import faults
+from ..common.retry import default_policy
 from .manager import MemoryManager
+
+# spill is BEST-EFFORT: a failed spill keeps the node device-resident
+# (over budget but correct) and logs a recovery event — memory
+# pressure must never turn into data loss. restore is MANDATORY: it
+# retries transient storage faults and only then surfaces the error.
+_F_SPILL = faults.declare("mem.hbm.spill")
+_F_RESTORE = faults.declare("mem.hbm.restore")
 
 
 class SpilledShards:
@@ -62,6 +71,15 @@ class SpilledShards:
             shard_shape = (1,) + tuple(shape[1:])
             singles = []
             for dev_pos, bid in blocks:
+                # injection-only site (real storage faults retry
+                # inside pool.get, data.blockstore.get — wrapping it
+                # here would nest two backoff budgets), so the
+                # disarmed steady state skips the policy machinery
+                if faults.REGISTRY.active():
+                    default_policy().run(
+                        lambda bid=bid: faults.check(_F_RESTORE,
+                                                     block=bid),
+                        what="hbm.restore")
                 raw = self.pool.get(bid)
                 arr = np.frombuffer(raw, dtype=dt).reshape(shard_shape)
                 singles.append(jax.device_put(arr, mex.devices[dev_pos]))
@@ -166,7 +184,14 @@ class HbmGovernor:
         for nid in list(self._lru.keys()):
             if nid == exclude:
                 continue
-            self.spill(self._lru[nid])
+            # spill() can recurse into maybe_spill (a hinted-join
+            # validation recovering mid-spill resyncs + re-checks the
+            # budget), so entries from this snapshot may already be
+            # gone
+            node = self._lru.get(nid)
+            if node is None:
+                continue
+            self.spill(node)
             if not self.mem.exceeded:
                 break
 
@@ -176,18 +201,64 @@ class HbmGovernor:
         shards = node._shards
         if not isinstance(shards, DeviceShards):
             return
+        if getattr(shards, "_counts_check", None) is not None:
+            # run the deferred validation BEFORE serializing: a
+            # recovering check (hinted-join overflow) swaps
+            # shards.tree, and spilling first would park the
+            # pre-recovery columns in the block store.
+            if getattr(shards.mesh_exec, "num_processes", 1) > 1:
+                # spilling is a PER-PROCESS decision; the validation
+                # fetch would be a cross-process collective (counts
+                # span non-addressable devices) and could hang against
+                # a controller that didn't choose to spill. Keep the
+                # node resident instead — same degraded mode as a
+                # failed spill.
+                return
+            try:
+                shards.validate_pending()
+            except Exception:
+                # sticky no-recover overflow: leave the error for the
+                # CONSUMER to surface (spill must not raise out of an
+                # unrelated node's materialize) and never serialize
+                # the truncated columns
+                return
+            if node._shards is not shards:
+                # validation recursed into maybe_spill and a nested
+                # pass already parked THIS node — serializing again
+                # would leak the first SpilledShards' blocks
+                return
         pool = self._spill_pool()
         mex = shards.mesh_exec
         dev_pos = {d: i for i, d in enumerate(mex.devices)}
         leaves, treedef = jax.tree.flatten(shards.tree)
         leaf_blocks, meta = [], []
-        for leaf in leaves:
-            blocks = []
-            for sh in leaf.addressable_shards:
-                arr = np.asarray(sh.data)
-                blocks.append((dev_pos[sh.device], pool.put(arr.tobytes())))
-            leaf_blocks.append(blocks)
-            meta.append((leaf.dtype, tuple(leaf.shape)))
+        try:
+            for leaf in leaves:
+                blocks: List[Tuple[int, int]] = []
+                # registered BEFORE filling: a failure mid-leaf must
+                # see (and free) this leaf's already-written blocks
+                leaf_blocks.append(blocks)
+                for sh in leaf.addressable_shards:
+                    faults.check(_F_SPILL, node=node.label)
+                    arr = np.asarray(sh.data)
+                    blocks.append((dev_pos[sh.device],
+                                   pool.put(arr.tobytes())))
+                meta.append((leaf.dtype, tuple(leaf.shape)))
+        except Exception as e:
+            # spill failed mid-way: free the partial blocks and keep
+            # the node DEVICE-RESIDENT — over budget beats data loss.
+            # The LRU entry stays so a later pass can try again.
+            for written in leaf_blocks:
+                for _, bid in written:
+                    try:
+                        pool.drop(bid)
+                    except Exception:
+                        pass
+            # ONE emission: note() counts the recovery and forwards to
+            # the Context's JSON logger
+            faults.note("recovery", what="hbm.spill_skipped",
+                        node=node.label, dia_id=node.id, error=repr(e))
+            return
         node._shards = SpilledShards(mex, treedef, shards.counts.copy(),
                                      pool, leaf_blocks, meta)
         nb = getattr(node, "_hbm_bytes", 0)

@@ -30,6 +30,14 @@ import threading
 from collections import deque
 from typing import Deque, Dict, Optional, Tuple
 
+from ..common import faults
+
+# fires inside the periodic-callback dispatch: a transient fault skips
+# ONE tick and keeps the timer armed (a heartbeat must survive a flaky
+# beat); any other exception still disarms loudly below
+_F_TIMER = faults.declare("net.dispatcher.timer",
+                          exc=faults.InjectedIOError)
+
 _LIB_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_FAILED = False
@@ -145,16 +153,26 @@ class _TimerFacility:
                     self._tcv.wait(timeout=delay)
             # fire OUTSIDE the lock: callbacks may add/cancel timers
             try:
+                faults.check(_F_TIMER, timer=tid)
                 again = bool(cb())
-            except Exception:
-                # a raising timer disarms — LOUDLY, or a dead periodic
-                # task (heartbeat, flush) degrades the system silently
-                import sys
-                import traceback
-                print(f"thrill_tpu: timer {tid} raised and was "
-                      f"disarmed:\n{traceback.format_exc()}",
-                      file=sys.stderr)
-                again = False
+            except Exception as exc:
+                if (isinstance(exc, faults.InjectedFault)
+                        and exc.kind == faults.TRANSIENT):
+                    # skip this tick, stay armed: periodic services
+                    # (heartbeats, spill flushes) ride out one glitch
+                    faults.note("recovery", what="dispatcher.timer",
+                                timer=tid)
+                    again = True
+                else:
+                    # any other raising timer disarms — LOUDLY, or a
+                    # dead periodic task (heartbeat, flush) degrades
+                    # the system silently
+                    import sys
+                    import traceback
+                    print(f"thrill_tpu: timer {tid} raised and was "
+                          f"disarmed:\n{traceback.format_exc()}",
+                          file=sys.stderr)
+                    again = False
             with self._tcv:
                 if tid not in self._tcb:
                     continue              # cancelled while firing

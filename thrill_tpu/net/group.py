@@ -19,8 +19,29 @@ barriers), the role MPI plays for jax.distributed.
 from __future__ import annotations
 
 import abc
+import contextlib
 import operator
 from typing import Any, Callable, List, Optional
+
+from ..common import faults
+
+#: magic key of a poison control frame (a plain dict so it passes the
+#: non-executing wire codec unauthenticated)
+POISON_KEY = "__thrill_tpu_poison__"
+
+
+class ClusterAbort(ConnectionError):
+    """A peer broadcast a poison frame: its ROOT CAUSE, not a local
+    secondary symptom. ConnectionError subclass so existing dead-peer
+    handling (tests, cleanup paths) treats an abort as fatal transport
+    loss — but the retry policy classifies it permanent (never retry
+    a coordinated shutdown)."""
+
+    def __init__(self, origin: int, cause: str) -> None:
+        super().__init__(
+            f"cluster abort from rank {origin}: {cause}")
+        self.origin = origin
+        self.cause = cause
 
 
 class Connection(abc.ABC):
@@ -39,6 +60,11 @@ class Group(abc.ABC):
     def __init__(self, my_rank: int, num_hosts: int) -> None:
         self.my_rank = my_rank
         self._num_hosts = num_hosts
+        # poison frames relay AT MOST ONCE per (origin, cause)
+        # (transitivity without ping-pong, while a LATER unrelated
+        # abort on a surviving group still relays): keys added by
+        # poison_peers and by received poison frames
+        self._poison_relayed: set = set()
 
     @property
     def num_hosts(self) -> int:
@@ -51,7 +77,66 @@ class Group(abc.ABC):
         self.connection(peer).send(obj)
 
     def recv_from(self, peer: int) -> Any:
-        return self.connection(peer).recv()
+        """Receive one message; a poison control frame surfaces as
+        :class:`ClusterAbort` carrying the originator's root cause
+        (reference has no analog — a dead peer hangs its job until the
+        runtime kills it, api/context.cpp:849-878)."""
+        obj = self.connection(peer).recv()
+        if isinstance(obj, dict) and POISON_KEY in obj:
+            info = obj[POISON_KEY]
+            origin = int(info.get("origin", peer))
+            cause = str(info.get("cause", "unknown"))
+            if (origin, cause) not in self._poison_relayed:
+                # RELAY once before aborting: in tree/hypercube
+                # collectives most ranks never recv from the origin
+                # directly — without the relay they would block on a
+                # healthy partner that already aborted and surface a
+                # secondary 'peer closed' instead of the root cause
+                try:
+                    self.poison_peers(cause, origin=origin)
+                except Exception:
+                    pass
+            raise ClusterAbort(origin, cause)
+        return obj
+
+    # ------------------------------------------------------------------
+    # coordinated abort (poison control frames)
+    # ------------------------------------------------------------------
+
+    def poison_peers(self, cause: Any, origin: Optional[int] = None) -> int:
+        """Best-effort broadcast of a poison frame to every peer.
+
+        A worker hitting an unrecoverable error calls this before
+        re-raising, so every peer blocked in a collective surfaces the
+        ROOT CAUSE within its own recv deadline instead of a cascade of
+        secondary timeouts; receivers relay once (recv_from), so ranks
+        that never recv from the origin directly still get the cause.
+        Returns the number of peers notified; failures to notify (the
+        cause may be the transport itself) are swallowed — the
+        caller's re-raise is the authoritative error. ``origin`` is
+        set by relays to preserve the ORIGINATING rank.
+        """
+        org = self.my_rank if origin is None else origin
+        self._poison_relayed.add((org, _cause_str(cause)))
+        frame = {POISON_KEY: {"origin": org,
+                              "cause": _cause_str(cause)}}
+        notified = 0
+        for peer in range(self.num_hosts):
+            if peer == self.my_rank:
+                continue
+            try:
+                # send only, never flush: a flush would wait on bulk
+                # frames already queued to a DEAD peer and hang the
+                # abort itself. Dispatcher-attached connections drain
+                # the queued poison frame asynchronously; blocking
+                # connections wrote it synchronously in send().
+                self.connection(peer).send(frame)
+                notified += 1
+            except Exception:
+                continue
+        faults.note("abort", origin=self.my_rank, notified=notified,
+                    cause=_cause_str(cause))
+        return notified
 
     # ------------------------------------------------------------------
     # collectives (generic over connections; reference net/collective.hpp)
@@ -208,3 +293,30 @@ class Group(abc.ABC):
 
     def barrier(self) -> None:
         self.all_reduce(0, operator.add)
+
+
+def _cause_str(cause: Any) -> str:
+    if isinstance(cause, BaseException):
+        return f"{type(cause).__name__}: {cause}"
+    return str(cause)
+
+
+@contextlib.contextmanager
+def poison_on_error(group: Optional[Group], what: str = ""):
+    """Run a collective phase under the abort protocol: any error that
+    escapes (except an abort we *received* — relaying those would ping-
+    pong poison frames) is broadcast to every peer before re-raising.
+
+    The no-op cases (group is None, single-host group) make the guard
+    safe to wrap around code that also runs single-controller."""
+    try:
+        yield
+    except ClusterAbort:
+        raise
+    except BaseException as e:
+        if group is not None and group.num_hosts > 1:
+            try:
+                group.poison_peers(e)
+            except Exception:
+                pass                 # original error stays authoritative
+        raise

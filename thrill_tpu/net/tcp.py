@@ -33,8 +33,33 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..common import faults
+from ..common.retry import default_policy
 from . import wire
 from .group import Connection, Group
+
+# Injection sites fire BEFORE any bytes hit the wire, so the internal
+# retry (shared backoff policy) is safe: nothing was transmitted. Real
+# transport errors on an established stream classify PERMANENT at this
+# layer — a partially sent frame leaves the stream unrecoverable, and
+# resynchronizing would accept corrupt framing.
+_F_CONNECT = faults.declare("net.tcp.connect",
+                            exc=faults.InjectedConnectionError)
+_F_SEND = faults.declare("net.tcp.send",
+                         exc=faults.InjectedConnectionError)
+_F_FLUSH = faults.declare("net.tcp.flush",
+                          exc=faults.InjectedConnectionError)
+_FRAME_TRANSIENT = (faults.InjectedConnectionError,)
+
+
+def _frame_site_check(site: str) -> None:
+    """Per-frame injection gate. Only injected faults are retryable at
+    this layer (real stream errors are permanent), so with no
+    injection active the policy machinery is skipped entirely — the
+    disarmed hot path costs one env read."""
+    if faults.REGISTRY.active():
+        default_policy(transient=_FRAME_TRANSIENT).run(
+            lambda: faults.check(site), what=site)
 
 
 def _wait_fd(sock: socket.socket, write: bool, timeout: float) -> bool:
@@ -162,6 +187,7 @@ class TcpConnection(Connection):
 
     def flush(self) -> None:
         """Block until every queued async send has hit the socket."""
+        _frame_site_check(_F_FLUSH)
         if self._disp is None:
             return
         with self._send_lock:
@@ -180,6 +206,7 @@ class TcpConnection(Connection):
         ``flush()`` is the synchronization point. Collectives in
         net/group.py never mutate sent values; callers reusing staging
         arrays across rounds must flush between them."""
+        _frame_site_check(_F_SEND)
         parts = wire.dumps_parts(obj, allow_pickle=self.authenticated)
         total = sum(len(p) for p in parts)
         bufs = [struct.pack("<I", total), *parts]
@@ -219,34 +246,50 @@ class TcpConnection(Connection):
         With a dispatcher supplier configured, a stalled send (peer not
         draining — e.g. both sides of a pairwise exchange sending
         first) hands the unsent tail to the async engine instead of
-        blocking forever on kernel buffers."""
+        blocking forever on kernel buffers. The socket runs
+        NON-blocking under the poll loop for the duration: a blocking
+        sendmsg can park inside the kernel mid-frame (partial bytes
+        queued, peer not draining) where the stall probe below could
+        never run again — exactly the symmetric deadlock this escape
+        hatch exists to prevent. The concurrent reader tolerates the
+        mode flip (see _recv_exact)."""
         mvs = [memoryview(b).cast("B") for b in bufs]
         can_escape = self._disp_supplier is not None
-        while mvs:
-            if can_escape:
-                if not _wait_fd(self.sock, write=True,
-                                timeout=self._BLOCKING_SEND_STALL_S):
-                    # no progress possible: switch this connection to
-                    # the engine and enqueue the remaining tail. The
-                    # tail is COPIED — this frame was sent under
-                    # blocking semantics, so the caller may reuse its
-                    # buffer the moment send() returns (and blocking
-                    # here for the drain could deadlock symmetrically)
-                    self._attach_locked(self._disp_supplier())
-                    for mv in mvs:
-                        b = bytes(mv)
-                        self._enqueue_send(
-                            self._disp.async_write(self.sock, b), len(b))
-                    return
-            try:
-                n = self.sock.sendmsg(mvs)
-            except (BlockingIOError, InterruptedError):
-                continue
-            while mvs and n >= len(mvs[0]):
-                n -= len(mvs[0])
-                mvs.pop(0)
-            if mvs and n:
-                mvs[0] = mvs[0][n:]
+        if can_escape:
+            self.sock.setblocking(False)
+        try:
+            while mvs:
+                if can_escape:
+                    if not _wait_fd(self.sock, write=True,
+                                    timeout=self._BLOCKING_SEND_STALL_S):
+                        # no progress possible: switch this connection
+                        # to the engine and enqueue the remaining tail.
+                        # The tail is COPIED — this frame was sent
+                        # under blocking semantics, so the caller may
+                        # reuse its buffer the moment send() returns
+                        # (and blocking here for the drain could
+                        # deadlock symmetrically)
+                        self._attach_locked(self._disp_supplier())
+                        for mv in mvs:
+                            b = bytes(mv)
+                            self._enqueue_send(
+                                self._disp.async_write(self.sock, b),
+                                len(b))
+                        return
+                try:
+                    n = self.sock.sendmsg(mvs)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                while mvs and n >= len(mvs[0]):
+                    n -= len(mvs[0])
+                    mvs.pop(0)
+                if mvs and n:
+                    mvs[0] = mvs[0][n:]
+        finally:
+            # restore blocking semantics unless the engine took the fd
+            # (it owns non-blocking mode from then on)
+            if can_escape and self._disp is None:
+                self.sock.setblocking(True)
 
     def recv(self) -> Any:
         with self._recv_lock:
@@ -552,10 +595,19 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
     acceptor = threading.Thread(target=accept_side, daemon=True)
     acceptor.start()
 
+    # dials retry under the shared backoff policy (full jitter spreads
+    # a whole cluster's simultaneous restarts instead of herding them);
+    # the load-scaled budget stays the overall deadline, so attempts
+    # continue until the budget expires, not a fixed count.
+    dial_policy = default_policy(max_attempts=1 << 30,
+                                 base_delay_s=0.05, max_delay_s=1.0)
     dial_start = time.time()
     for peer in range(rank):                 # dial every lower rank
+        attempt = 0
+        rng = None
         while True:
             try:
+                faults.check(_F_CONNECT, peer=peer)
                 s = socket.create_connection(hosts[peer], timeout=2.0)
                 s.settimeout(hs_cap())
                 conn = TcpConnection(s)
@@ -571,12 +623,33 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
                 # auth failure is definitive (secret mismatch), not a
                 # transient dial error — fail fast with the real cause
                 raise
-            except OSError:
+            except OSError as e:
+                if (isinstance(e, faults.InjectedFault)
+                        and os.environ.get("THRILL_TPU_RETRY",
+                                           "1") == "0"):
+                    # detection-only runs: injected dial faults must
+                    # SURFACE. (Plain connection-refused keeps the
+                    # budgeted loop — waiting for peers that haven't
+                    # started listening is bootstrap, not retry.)
+                    raise
                 if time.time() - dial_start > budget():
                     raise TimeoutError(
                         f"rank {rank}: cannot reach rank {peer} at "
-                        f"{hosts[peer]}")
-                time.sleep(0.05)
+                        f"{hosts[peer]}") from e
+                if rng is None:
+                    import random
+                    rng = random.Random(f"dial:{rank}:{peer}")
+                d = dial_policy.delay(min(attempt, 6), rng)
+                # staggered starts make many dial retries NORMAL at
+                # bootstrap: count every one, log only sparsely
+                faults.note("retry",
+                            _quiet=not (attempt < 3
+                                        or attempt % 32 == 0),
+                            what="tcp.bootstrap_dial",
+                            attempt=attempt + 1, peer=peer,
+                            delay_s=round(d, 4), error=repr(e))
+                attempt += 1
+                time.sleep(d)
 
     join_start = time.time()
     while acceptor.is_alive() and time.time() - join_start <= budget():

@@ -17,14 +17,69 @@ SPMD from the start.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
-from jax import shard_map
+
+from ..common import faults
+from ..common.retry import default_policy
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:                                    # jax >= 0.6: top-level export,
+    from jax import shard_map as _shard_map   # replication kwarg is
+    _SM_CHECK_KW = "check_vma"                # 'check_vma'
+except ImportError:                     # 0.4.x: experimental module,
+    from jax.experimental.shard_map import (  # kwarg is 'check_rep'
+        shard_map as _shard_map)
+    _SM_CHECK_KW = "check_rep"
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
+    """Version-portable jax.shard_map (one shim for both spellings)."""
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_SM_CHECK_KW: check_vma})
+
+
 AXIS = "w"
+
+# device dispatch is PURE (jitted functional program over immutable
+# buffers), so a transient runtime/transport fault — a dropped tunnel
+# RPC, a preempted PJRT stream — retries safely under the shared
+# backoff policy before surfacing
+_F_DISPATCH = faults.declare("api.mesh.dispatch")
+
+
+class _CountedJit:
+    """Dispatch-counting proxy around a ``jax.jit`` callable.
+
+    Every attribute other than ``__call__`` delegates to the jitted
+    function (``.lower``, ``.trace``, ``.clone``, cost analysis...), so
+    AOT/introspection callers see the real jit object — only calls gain
+    the dispatch counter and the fault-injected retry."""
+
+    def __init__(self, mex: "MeshExec", jitted: Callable) -> None:
+        self._mex = mex
+        self._jitted = jitted
+        functools.update_wrapper(self, jitted, updated=())
+
+    def __call__(self, *args, **kwargs):
+        self._mex.stats_dispatches += 1
+        if not faults.REGISTRY.active():
+            # disarmed hot path: dispatch-per-iteration is the budgeted
+            # cost in this codebase — no policy construction, no env
+            # reads beyond active()'s one
+            return self._jitted(*args, **kwargs)
+
+        def dispatch():
+            faults.check(_F_DISPATCH)
+            return self._jitted(*args, **kwargs)
+
+        return default_policy().run(dispatch, what="mesh.dispatch")
+
+    def __getattr__(self, name):
+        return getattr(self._jitted, name)
 
 
 class MeshExec:
@@ -66,6 +121,9 @@ class MeshExec:
         # enqueue a check here; every host fetch drains the queue, so
         # no pipeline can reach its action egress past a failed check
         self._pending_checks: list = []
+        # lineage recoveries: hinted joins transparently re-run without
+        # their hint after a detected overflow (api/ops/join.py)
+        self.stats_join_overflow_retries = 0
         # ICI-vs-DCN split of bytes_moved (multi-slice meshes; equal to
         # bytes_moved/0 on a single slice)
         self.stats_bytes_ici = 0
@@ -200,18 +258,27 @@ class MeshExec:
         """
         if isinstance(arr, jax.Array):
             self.stats_fetches += 1
-        if self._pending_checks:
-            checks, self._pending_checks = self._pending_checks, []
-            try:
-                while checks:
-                    checks.pop(0)()
-            except BaseException:
-                # a raising check must not discard the unrun tail —
-                # a second hinted join's overflow still gets detected
-                # at the next fetch even if the caller swallows this one
-                self._pending_checks.extend(checks)
-                raise
+        self.drain_checks()
         return self._fetch_raw(arr)
+
+    def drain_checks(self) -> None:
+        """Run every queued deferred validation (hinted-join overflow
+        recovery and the like). Called by fetch() and by every action
+        egress — AllGatherArrays, Sum/_device_reduce(keep_device=True),
+        Gather — so no pipeline output can be consumed past an unrun
+        check, whatever path it leaves the device by."""
+        if not self._pending_checks:
+            return
+        checks, self._pending_checks = self._pending_checks, []
+        try:
+            while checks:
+                checks.pop(0)()
+        except BaseException:
+            # a raising check must not discard the unrun tail —
+            # a second hinted join's overflow still gets detected
+            # at the next fetch even if the caller swallows this one
+            self._pending_checks.extend(checks)
+            raise
 
     def _fetch_raw(self, arr) -> np.ndarray:
         """fetch() without stats or check-draining — for the deferred
@@ -239,14 +306,10 @@ class MeshExec:
             in_specs = (P(AXIS),) * num_args
         sm = shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                        out_specs=out_specs, check_vma=check_vma)
-        jitted = jax.jit(sm)
-
-        def counted(*args, **kwargs):
-            self.stats_dispatches += 1
-            return jitted(*args, **kwargs)
-
-        counted.lower = jitted.lower      # AOT lowering passthrough
-        return counted
+        # full attribute delegation (not a copied .lower): AOT and
+        # introspection callers (.trace, .clone, cost analysis) see
+        # the real jit object through the counting proxy
+        return _CountedJit(self, jax.jit(sm))
 
     def cached(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
         """Memoize a compiled program per (mesh, key).

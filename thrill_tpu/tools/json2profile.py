@@ -99,6 +99,7 @@ def render_html(events: List[dict]) -> str:
     exchanges = []
     device_xchg: dict = {}   # host -> ordered device-plane exchanges
     memory = []        # hbm_spill / hbm_restore / mem_negotiate / demotion
+    faults = []        # fault_injected / retry / recovery / abort
     t0 = min((e["ts"] for e in events), default=0)
     for e in events:
         t = (e["ts"] - t0) / 1e6
@@ -126,6 +127,9 @@ def render_html(events: List[dict]) -> str:
                                 "mem_negotiate", "device_to_host",
                                 "host_replicate"):
             memory.append((t, e))
+        elif e.get("event") in ("fault_injected", "retry", "recovery",
+                                "abort"):
+            faults.append((t, e))
     if device_xchg:
         best = max(sorted(device_xchg), key=lambda h: len(device_xchg[h]))
         exchanges.extend(device_xchg[best])
@@ -182,8 +186,38 @@ td.hm {{ min-width: 3em; }}
 {_render_exchange_volume(exchanges, total)}
 {_render_worker_lanes(exchanges, total)}
 {_render_memory_events(memory, total)}
+{_render_fault_events(faults)}
 {_render_host_overlay(profiles, total)}
 </body></html>"""
+
+
+def _render_fault_events(faults) -> str:
+    """Robustness-layer timeline: every injected fault, retry sleep,
+    recovery and coordinated abort as a chronological table (the
+    observability half of the fault-injection harness in
+    common/faults.py)."""
+    if not faults:
+        return ""
+    trs = []
+    for t, e in faults:
+        what = e.get("site") or e.get("what") or ""
+        detail = ", ".join(
+            f"{k}={v}" for k, v in sorted(e.items())
+            if k not in ("ts", "event", "site", "what", "host",
+                         "program", "workers"))
+        trs.append(
+            f'<tr><td>{t * 1e3:.1f}</td>'
+            f'<td class="l">{html.escape(str(e.get("event")))}</td>'
+            f'<td class="l">{html.escape(str(what))}</td>'
+            f'<td class="l">{html.escape(detail)}</td></tr>')
+    counts = {}
+    for _, e in faults:
+        counts[e.get("event")] = counts.get(e.get("event"), 0) + 1
+    head = ", ".join(f"{v} {k}" for k, v in sorted(counts.items()))
+    return (f'<h2>faults &amp; recovery ({html.escape(head)})</h2>'
+            '<table><tr><th>ms</th><th class="l">event</th>'
+            '<th class="l">site</th><th class="l">detail</th></tr>'
+            + "".join(trs) + "</table>")
 
 
 def _render_stage_table(rows, exchanges, nodes) -> str:

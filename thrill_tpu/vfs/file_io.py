@@ -18,7 +18,18 @@ import lzma
 import os
 from typing import IO, List, Optional
 
+from ..common import faults
+from ..common.retry import default_policy
+
 COMPRESSED_SUFFIXES = (".gz", ".bz2", ".xz")
+
+# ranged reads are idempotent — every stream here can be reopened at
+# an absolute offset (posix seek, s3 ranged GET, hdfs seek; compressed
+# streams re-skip decompressed bytes) — so transient storage faults
+# retry with a fresh handle under the shared backoff policy instead of
+# failing a whole pipeline for one flaky read
+_F_OPEN = faults.declare("vfs.open_read")
+_F_READ = faults.declare("vfs.read")
 
 
 @dataclasses.dataclass
@@ -102,26 +113,184 @@ def Glob(path_or_glob: str) -> FileList:
     return FileList(files)
 
 
-def OpenReadStream(path: str, offset: int = 0) -> IO[bytes]:
-    """Open for reading, transparently decompressing by suffix.
-
-    Compressed files do not support nonzero offsets (whole-file
-    granularity, like the reference's ReadLines on compressed input).
-    """
-    if _scheme(path) == "s3":
+def _open_at(path: str, offset: int) -> IO[bytes]:
+    """One stream positioned at ``offset``, any scheme (the reopenable
+    primitive the retrying reader is built on)."""
+    faults.check(_F_OPEN, path=path, offset=offset)
+    scheme = _scheme(path)
+    if scheme == "s3":
         if path.endswith(COMPRESSED_SUFFIXES):
             raise ValueError("compressed s3 objects are read whole-file")
         from . import s3_file
         return s3_file.s3_open_read(path, offset)
-    if _scheme(path) == "hdfs":
+    if scheme == "hdfs":
         from . import hdfs_file
         return hdfs_file.hdfs_open_read(path, offset)
     f = _open_filtered(path, "rb")
     if offset:
         if path.endswith(COMPRESSED_SUFFIXES):
-            raise ValueError("cannot seek into compressed file")
-        f.seek(offset)
+            # whole-file granularity on disk, but the RETRY reopen may
+            # legitimately land mid-stream: skip decompressed bytes
+            skipped = 0
+            while skipped < offset:
+                b = f.read(min(offset - skipped, 1 << 20))
+                if not b:
+                    break
+                skipped += len(b)
+        else:
+            f.seek(offset)
     return f
+
+
+class RetryingReader:
+    """Self-healing read stream: tracks the absolute (decompressed)
+    position and, when a read or open fails transiently, reopens the
+    source at that position and resumes — the vfs-level recovery the
+    reference cannot express (its ReadStream dies with the job,
+    vfs/file_io.hpp:140).
+
+    A thin proxy, not an io subclass. Every CONSUMING read
+    (``read``/``readinto``/``readline``/``readlines``/``read1``/
+    iteration) and ``seek`` are implemented here so ``_pos`` stays
+    exact — a delegated consuming read would advance the stream behind
+    the tracker and make a post-fault reopen replay bytes.
+    Non-consuming attributes delegate to the wrapped stream so
+    existing callers (ReadLines' delimiter probing does seek+read on
+    posix files) see unchanged behavior."""
+
+    def __init__(self, path: str, offset: int = 0) -> None:
+        self._path = path
+        self._pos = offset
+        self._closed = False
+        # one policy per reader, not per read: the env knobs are fixed
+        # for a stream's lifetime, and ReadLines drives this per line
+        self._policy = default_policy()
+        self._f = self._policy.run(
+            lambda: _open_at(path, offset), what="vfs.open_read")
+
+    def _consume(self, read_fn) -> bytes:
+        """THE retry-and-reopen invariant, in one place: run one
+        consuming read under the policy (injection gate, reopen at the
+        tracked offset after any failure, advance ``_pos`` by what was
+        actually returned). Every consuming method routes here so the
+        byte-replay protection cannot silently diverge between them."""
+        if self._closed:
+            raise ValueError("I/O operation on closed file")
+
+        def op():
+            faults.check(_F_READ, path=self._path, pos=self._pos)
+            if self._f is None:       # previous attempt lost the handle
+                self._f = _open_at(self._path, self._pos)
+            try:
+                return read_fn(self._f)
+            except Exception:
+                # the handle is suspect after ANY failure: drop it so a
+                # retry resumes from a fresh stream at self._pos
+                self._drop()
+                raise
+        data = self._policy.run(op, what="vfs.read")
+        self._pos += len(data)
+        return data
+
+    def read(self, n: int = -1) -> bytes:
+        # read-to-EOF is spelled read() for pyarrow streams
+        # (read(-1) trips their size check)
+        if n is None or n < 0:
+            return self._consume(lambda f: f.read())
+        return self._consume(lambda f: f.read(n))
+
+    def readinto(self, b) -> int:
+        data = self.read(len(b))
+        b[:len(data)] = data
+        return len(data)
+
+    def readline(self, n: int = -1) -> bytes:
+        return self._consume(lambda f: f.readline(n))
+
+    def readlines(self, hint: int = -1) -> list:
+        out = []
+        total = 0
+        while True:
+            line = self.readline()
+            if not line:
+                return out
+            out.append(line)
+            total += len(line)
+            if 0 < hint <= total:     # io semantics: hint<=0 = no cap
+                return out
+
+    def read1(self, n: int = -1) -> bytes:
+        return self.read(n if n is not None and n >= 0 else 1 << 16)
+
+    def __iter__(self) -> "RetryingReader":
+        return self
+
+    def __next__(self) -> bytes:
+        line = self.readline()
+        if not line:
+            raise StopIteration
+        return line
+
+    def seek(self, pos: int, whence: int = os.SEEK_SET) -> int:
+        if self._closed:
+            raise ValueError("I/O operation on closed file")
+        if self._f is None:
+            self._f = _open_at(self._path, self._pos)
+        out = self._f.seek(pos, whence)
+        self._pos = out
+        return out
+
+    def tell(self) -> int:
+        return self._pos
+
+    def _drop(self) -> None:
+        f, self._f = self._f, None
+        if f is not None:
+            try:
+                f.close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        self._drop()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __enter__(self) -> "RetryingReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __getattr__(self, name):
+        # private names never delegate (and must not recurse through
+        # __getattr__ during __init__/unpickling)
+        if name.startswith("_"):
+            raise AttributeError(name)
+        # no handle (closed, or dropped after a fault): AttributeError,
+        # not ValueError — hasattr/getattr-with-default probes on a
+        # closed reader must behave like on any other object, and a
+        # mere attribute probe must never reopen the stream
+        f = self.__dict__.get("_f")
+        if f is None:
+            raise AttributeError(name)
+        return getattr(f, name)
+
+
+def OpenReadStream(path: str, offset: int = 0) -> IO[bytes]:
+    """Open for reading, transparently decompressing by suffix, with
+    transient-fault retry (reopen at offset) built in.
+
+    Compressed files do not support nonzero offsets (whole-file
+    granularity, like the reference's ReadLines on compressed input).
+    """
+    if offset and path.endswith(COMPRESSED_SUFFIXES):
+        if _scheme(path) in ("file",):
+            raise ValueError("cannot seek into compressed file")
+    return RetryingReader(path, offset)
 
 
 def OpenWriteStream(path: str) -> IO[bytes]:

@@ -15,6 +15,12 @@ from __future__ import annotations
 from typing import IO, List, Tuple
 from urllib.parse import urlparse
 
+from ..common import faults
+
+# fires at ranged-open: file_io.py's retrying reader reopens at the
+# tracked offset on a transient failure here
+_F_HDFS_OPEN = faults.declare("vfs.hdfs.open")
+
 
 def _connect(host: str, port: int):
     try:
@@ -76,6 +82,7 @@ def hdfs_glob(path_or_glob: str) -> List[Tuple[str, int]]:
 
 
 def hdfs_open_read(path: str, offset: int = 0) -> IO[bytes]:
+    faults.check(_F_HDFS_OPEN, path=path, offset=offset)
     host, port, p = parse_hdfs_path(path)
     client = _connect(host, port)
     if offset:

@@ -16,6 +16,13 @@ from __future__ import annotations
 import io
 from typing import IO, List, Tuple
 
+from ..common import faults
+
+# scheme-level injection inside the ranged GET itself; the generic
+# vfs.read/vfs.open_read sites in file_io.py wrap this stream and
+# recover by reopening the range at the tracked offset
+_F_S3_READ = faults.declare("vfs.s3.read")
+
 
 def _boto3():
     try:
@@ -78,9 +85,11 @@ class _S3ReadStream(io.RawIOBase):
         return True
 
     def read(self, n: int = -1) -> bytes:
+        faults.check(_F_S3_READ)
         return self._body.read(None if n is None or n < 0 else n)
 
     def readinto(self, b) -> int:
+        faults.check(_F_S3_READ)
         data = self._body.read(len(b))
         b[:len(data)] = data
         return len(data)
