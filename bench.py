@@ -86,9 +86,16 @@ def _watchdog(seconds: float):
     return t
 
 
-def _probe_accelerator(timeout_s: float) -> str | None:
-    """Ask a throwaway subprocess which backend jax picks. Returns the
-    platform name, or None if init fails OR hangs past the timeout."""
+class _ProbeDeadline(Exception):
+    """Overall probe deadline exhausted — classified PERMANENT by the
+    retry policy (not a ConnectionError/TimeoutError), so it ends the
+    loop immediately."""
+
+
+def _probe_once(timeout_s: float) -> str:
+    """One probe attempt; returns the platform name or raises
+    TimeoutError/ConnectionError (transient — the retry policy
+    classifies and backs off)."""
     code = ("import jax; d = jax.devices(); "
             "print('PLATFORM=' + d[0].platform)")
     try:
@@ -96,16 +103,65 @@ def _probe_accelerator(timeout_s: float) -> str | None:
             [sys.executable, "-c", code], capture_output=True, text=True,
             timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        print("bench: accelerator probe timed out; forcing CPU",
-              file=sys.stderr)
-        return None
+        raise TimeoutError(
+            f"accelerator probe timed out after {timeout_s:.0f}s")
     for line in out.stdout.splitlines():
         if line.startswith("PLATFORM="):
             plat = line.split("=", 1)[1].strip()
-            if plat and plat != "cpu":
+            if plat:
                 return plat
-    print(f"bench: accelerator probe failed (rc={out.returncode}); "
-          f"forcing CPU", file=sys.stderr)
+    tail = (out.stderr or "").strip().splitlines()[-3:]
+    raise ConnectionError(
+        f"accelerator probe failed (rc={out.returncode}): "
+        + " | ".join(tail))
+
+
+def _probe_accelerator(timeout_s: float) -> str | None:
+    """Ask a throwaway subprocess which backend jax picks, retrying
+    transient failures with the shared backoff policy (five rounds of
+    capture artifacts said "probe timed out; forcing CPU" — an
+    unhealthy tunnel often recovers within seconds, so one cold probe
+    must not condemn the whole run to CPU). The retry loop is bounded
+    by an OVERALL deadline (THRILL_TPU_BENCH_PROBE_DEADLINE, default
+    2x the per-attempt timeout) so a permanently wedged tunnel delays
+    the CPU fallback by a bounded amount, not attempts x timeout.
+    Returns the platform name, or None; either way the probe outcome
+    (attempts actually made, error, timings) is recorded in the JSON
+    line (``probe`` field) so the artifact says WHY a CPU number was
+    captured."""
+    from thrill_tpu.common.retry import default_policy
+    t0 = time.perf_counter()
+    try:
+        deadline = float(os.environ.get(
+            "THRILL_TPU_BENCH_PROBE_DEADLINE", "") or 2 * timeout_s)
+    except ValueError:
+        deadline = 2 * timeout_s
+    attempts = [0]
+
+    def attempt() -> str:
+        if attempts[0] and time.perf_counter() - t0 > deadline:
+            raise _ProbeDeadline(
+                f"probe deadline {deadline:.0f}s exceeded after "
+                f"{attempts[0]} attempts")
+        attempts[0] += 1
+        return _probe_once(timeout_s)
+
+    try:
+        plat = default_policy(max_delay_s=10.0).run(
+            attempt, what="bench.accel_probe")
+    except Exception as e:
+        reason = f"{type(e).__name__}: {e}"
+        print(f"bench: accelerator probe gave up ({attempts[0]} "
+              f"attempts): {reason}; forcing CPU", file=sys.stderr)
+        _set(probe={"platform": None, "error": reason,
+                    "attempts": attempts[0], "timeout_s": timeout_s,
+                    "elapsed_s": round(time.perf_counter() - t0, 1)})
+        return None
+    _set(probe={"platform": plat, "attempts": attempts[0],
+                "elapsed_s": round(time.perf_counter() - t0, 1)})
+    if plat != "cpu":
+        return plat
+    print("bench: probe found only CPU devices", file=sys.stderr)
     return None
 
 
@@ -160,10 +216,14 @@ def _key_fn(r):
 def _run_bench() -> None:
     want_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
     if not want_cpu:
+        raw = (os.environ.get("THRILL_TPU_BENCH_PROBE_TIMEOUT")
+               or os.environ.get("THRILL_TPU_BENCH_PROBE_TIMEOUT_S")
+               or "150")
         try:
-            probe_timeout = float(
-                os.environ.get("THRILL_TPU_BENCH_PROBE_TIMEOUT_S", "150"))
+            probe_timeout = float(raw)
         except ValueError:
+            print(f"bench: bad probe timeout {raw!r}; using 150s",
+                  file=sys.stderr)
             probe_timeout = 150.0
         platform = _probe_accelerator(probe_timeout)
         want_cpu = platform is None

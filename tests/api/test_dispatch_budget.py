@@ -188,6 +188,86 @@ def test_suffix_doubling_zero_syncs():
     assert disp <= 8, disp        # one fused sort per doubling round
 
 
+def _wc_text_file(tmp_path):
+    rng = np.random.default_rng(5)
+    vocab = ["w%03d" % i for i in range(97)]
+    path = tmp_path / "words.txt"
+    path.write_text(" ".join(rng.choice(vocab, size=2048)) + "\n")
+    return str(path)
+
+
+def _wc_run(ctx, mex, path):
+    """One WordCount example pipeline run; returns (result, dispatches)."""
+    sys.path.insert(0, _EXAMPLES)
+    import word_count as wc
+    d0 = mex.stats_dispatches
+    cols = jax.tree.map(np.asarray,
+                        wc.word_count_text_device(ctx, path)
+                        .AllGatherArrays())
+    order = np.lexsort(tuple(cols["w"].T))
+    return ({k: v[order] for k, v in cols.items()},
+            mex.stats_dispatches - d0)
+
+
+def test_wordcount_pipeline_fusion_budget(monkeypatch):
+    """Pinned dispatch budget for the WordCount example pipeline
+    (ReadWordsPacked -> Map -> ReduceByKey): program stitching fuses
+    the Map stack into the reduce's local phase — ONE dispatch where
+    the per-op model pays two. THRILL_TPU_FUSE=0 must restore the old
+    count exactly."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    import tempfile
+    import pathlib
+    with tempfile.TemporaryDirectory() as td:
+        path = _wc_text_file(pathlib.Path(td))
+        _wc_run(ctx, mex, path)                      # warm (fused)
+        fused_res, fused = _wc_run(ctx, mex, path)
+        monkeypatch.setenv("THRILL_TPU_FUSE", "0")
+        _wc_run(ctx, mex, path)                      # warm (unfused)
+        unfused_res, unfused = _wc_run(ctx, mex, path)
+    for k in fused_res:
+        assert np.array_equal(fused_res[k], unfused_res[k]), k
+    assert fused == 1, fused
+    assert unfused == 2, unfused
+    assert unfused >= 2 * fused
+
+
+def test_pagerank_pipeline_fusion_budget(monkeypatch):
+    """Pinned dispatch budgets for the PageRank example pipeline:
+    stitching (hinted join + ReduceToIndex + dampen stack +
+    ZipWithIndex per iteration) must cut device dispatches >= 2x vs
+    the per-op model, and THRILL_TPU_FUSE=0 must restore the old
+    count exactly."""
+    sys.path.insert(0, _EXAMPLES)
+    import page_rank as pr
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    edges = pr.zipf_graph(512, 4096)
+    want = pr.page_rank_dense(ctx, edges, 512, iterations=4)
+
+    def run():
+        d0 = mex.stats_dispatches
+        got = pr.page_rank(ctx, edges, 512, iterations=4)
+        return got, mex.stats_dispatches - d0
+
+    run()                                            # warm (fused)
+    got_f, fused = run()
+    assert np.allclose(got_f, want, rtol=1e-6)
+    monkeypatch.setenv("THRILL_TPU_FUSE", "0")
+    run()                                            # warm (unfused)
+    got_u, unfused = run()
+    assert np.allclose(got_u, want, rtol=1e-6)
+    assert fused <= 18, fused            # 15 on the 1-chip mesh today
+    assert unfused == 36, unfused        # the pre-fusion per-op count
+    assert unfused >= 2 * fused, (unfused, fused)
+    # the stitched run reports its stage compositions
+    stats = ctx.overall_stats()
+    assert stats["fused_dispatches"] > 0
+    assert stats["fused_ops"] > stats["fused_dispatches"]
+    assert any(" + " in k for k in stats["fused_stages"])
+
+
 def test_put_small_content_cache():
     mex = MeshExec(num_workers=2)
     u0 = mex.stats_uploads

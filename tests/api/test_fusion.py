@@ -1,0 +1,303 @@
+"""Program stitching (api/fusion.py): parity, budgets and recovery.
+
+Every test compares THRILL_TPU_FUSE=1 (default) against the
+THRILL_TPU_FUSE=0 escape hatch on identical pipelines — results must
+match exactly while the fused mode issues fewer device dispatches.
+THRILL_TPU_HOST_RADIX=0 forces the jitted engines on the CPU test mesh
+(the native host fallbacks are fusion barriers by design).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from thrill_tpu.api import Bind, Context, FieldReduce, InnerJoin
+from thrill_tpu.api.dia import Zip
+from thrill_tpu.parallel.mesh import MeshExec
+
+
+@pytest.fixture(autouse=True)
+def _force_device_engines(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "0")
+
+
+def _both_modes(monkeypatch, build):
+    """Run ``build(ctx)`` fused and unfused; return both results and
+    the dispatch counts."""
+    out = {}
+    for fuse in ("1", "0"):
+        monkeypatch.setenv("THRILL_TPU_FUSE", fuse)
+        mex = MeshExec(num_workers=3)
+        ctx = Context(mex)
+        res = build(ctx)
+        out[fuse] = (res, mex.stats_dispatches)
+    return out["1"][0], out["0"][0], out["1"][1], out["0"][1]
+
+
+def _k5(t):
+    return t["k"]
+
+
+def _mk(x):
+    return {"k": x % 5, "v": x}
+
+
+def _even(t):
+    return t["v"] % 2 == 0
+
+
+def test_stack_reduce_chain_parity(monkeypatch):
+    def build(ctx):
+        d = ctx.Distribute(np.arange(200, dtype=np.int64))
+        r = d.Map(_mk).Filter(_even).ReduceByKey(
+            _k5, FieldReduce({"k": "first", "v": "sum"}))
+        return sorted(tuple(t.items()) for t in r.AllGather())
+
+    f, u, df, du = _both_modes(monkeypatch, build)
+    assert f == u
+    assert df < du
+
+
+def _x3(x):
+    return x * 3
+
+
+def test_prefix_zwi_sort_chain_parity(monkeypatch):
+    def build(ctx):
+        d = ctx.Distribute(np.arange(100, dtype=np.int64))
+        return (d.Map(_x3).PrefixSum()
+                 .ZipWithIndex(lambda x, i: x + i).AllGather())
+
+    f, u, df, du = _both_modes(monkeypatch, build)
+    assert f == u
+    assert df < du
+
+
+def test_filter_zipwithindex_positions(monkeypatch):
+    """Indices follow the POST-filter positions, fused or not (the
+    fused segment computes them from the mask, not the layout)."""
+    def build(ctx):
+        d = ctx.Distribute(np.arange(57, dtype=np.int64))
+        return d.Filter(lambda x: x % 3 != 0).ZipWithIndex(
+            lambda x, i: (x, i)).AllGather()
+
+    f, u, df, du = _both_modes(monkeypatch, build)
+    assert f == u
+    idxs = sorted(i for _, i in f)
+    assert idxs == list(range(len(f)))
+
+
+def test_sort_w1_chain_single_dispatch(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_FUSE", "1")
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    rng = np.random.default_rng(0)
+    vals = rng.integers(0, 1000, 500)
+
+    def run():
+        dd = ctx.Distribute(vals).Map(_x3).Sort(key_fn=lambda x: x)
+        d0 = mex.stats_dispatches
+        got = dd.AllGather()
+        return got, mex.stats_dispatches - d0
+
+    run()                                       # warm
+    got, disp = run()
+    assert got == sorted((vals * 3).tolist())
+    assert disp == 1, disp                      # stack + sort fused
+
+
+def test_window_chain_parity(monkeypatch):
+    def dev_win(t):
+        return t.sum(axis=1)
+
+    def build(ctx):
+        d = ctx.Distribute(np.arange(64, dtype=np.int64))
+        return d.Map(_x3).Window(4, fn=lambda i, w: sum(w),
+                                 device_fn=dev_win).AllGather()
+
+    f, u, df, du = _both_modes(monkeypatch, build)
+    assert f == u
+    assert df <= du
+
+
+def test_zip_downstream_fusion_parity(monkeypatch):
+    def build(ctx):
+        a = ctx.Distribute(np.arange(40, dtype=np.int64))
+        b = ctx.Distribute(np.arange(40, dtype=np.int64) * 2)
+        z = Zip(a, b, zip_fn=lambda x, y: x + y)
+        return z.Map(_x3).PrefixSum().AllGather()
+
+    f, u, df, du = _both_modes(monkeypatch, build)
+    assert f == u
+    assert df < du
+
+
+def _idk(x):
+    return x
+
+
+def _addp(a, b):
+    return a + b
+
+
+def test_hinted_join_fused_single_dispatch_and_chain(monkeypatch):
+    """The hinted join's two phases stitch into one dispatch, and
+    downstream device ops ride in the same program."""
+    monkeypatch.setenv("THRILL_TPU_FUSE", "1")
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+
+    def run():
+        l = ctx.Distribute(np.arange(32, dtype=np.int64))
+        r = ctx.Distribute(np.arange(16, 48, dtype=np.int64))
+        j = InnerJoin(l, r, _idk, _idk, _addp, out_size_hint=32)
+        d0 = mex.stats_dispatches
+        got = sorted(j.Map(_x3).AllGather())
+        return got, mex.stats_dispatches - d0
+
+    run()                                       # warm
+    got, disp = run()
+    assert got == sorted((x + x) * 3 for x in range(16, 32))
+    assert disp == 1, disp                      # join + stack, fused
+    assert mex.stats_join_overflow_retries == 0
+
+
+def test_hinted_join_fused_overflow_recovers_with_downstream(monkeypatch):
+    """Overflow inside a stitched chain (join + downstream segments):
+    the deferred check drains at the fused boundary, recovery
+    re-dispatches the plan at the true capacity, and BOTH the columns
+    and the downstream-derived counts heal."""
+    monkeypatch.setenv("THRILL_TPU_FUSE", "1")
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    l = ctx.Distribute([1, 1, 1, 1])
+    r = ctx.Distribute([1, 1, 1, 1])
+    j = InnerJoin(l, r, _idk, _idk, _addp, out_size_hint=4)
+    got = j.Map(_x3).AllGather()
+    assert got == [6] * 16
+    assert mex.stats_join_overflow_retries == 1
+
+
+def test_hinted_join_overflow_drains_before_exchange_barrier(monkeypatch):
+    """W>1 regression: a fused hinted join whose output feeds a fusion
+    BARRIER consumer (ReduceByKey's hash exchange reads the columns via
+    counts_device, never the host counts) must drain its overflow check
+    at the fused boundary — truncated pairs must never cross the
+    exchange (the unfused pull's validate-before-any-consumer
+    invariant)."""
+    for fuse in ("1", "0"):
+        monkeypatch.setenv("THRILL_TPU_FUSE", fuse)
+        mex = MeshExec(num_workers=2)
+        ctx = Context(mex)
+        l = ctx.Distribute([1, 1, 1, 1])
+        r = ctx.Distribute([1, 1, 1, 1])
+        j = InnerJoin(l, r, _idk, _idk, _addp, out_size_hint=4)
+        got = sorted((int(t[0]), int(t[1])) for t in
+                     j.Map(lambda x: (x * 0 + 1, x)).ReduceByKey(
+                         lambda t: t[0],
+                         lambda a, b: (a[0], a[1] + b[1])).AllGather())
+        assert got == [(1, 32)], (fuse, got)
+        assert mex.stats_join_overflow_retries == 1, fuse
+
+
+def test_hinted_join_fused_overflow_raises_without_recovery(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_FUSE", "1")
+    monkeypatch.setenv("THRILL_TPU_JOIN_RECOVER", "0")
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    l = ctx.Distribute([1, 1, 1, 1])
+    r = ctx.Distribute([1, 1, 1, 1])
+    j = InnerJoin(l, r, _idk, _idk, _addp, out_size_hint=4)
+    with pytest.raises(ValueError, match="out_size_hint"):
+        j.AllGather()
+
+
+def test_keep_prevents_deferral(monkeypatch):
+    """A multi-consumer (Keep'd) node must materialize — fusing it into
+    one consumer would lose the cached result for the other."""
+    monkeypatch.setenv("THRILL_TPU_FUSE", "1")
+    mex = MeshExec(num_workers=2)
+    ctx = Context(mex)
+    base = ctx.Distribute(np.arange(30, dtype=np.int64)).Map(
+        _x3).Cache().Keep(1)
+    a = base.PrefixSum().AllGather()
+    b = base.PrefixSum().AllGather()
+    assert a == b
+
+
+def test_fused_stats_and_log_events(monkeypatch, tmp_path):
+    monkeypatch.setenv("THRILL_TPU_FUSE", "1")
+    monkeypatch.setenv("THRILL_TPU_LOG", str(tmp_path / "log.json"))
+    from thrill_tpu.common.config import Config
+    mex = MeshExec(num_workers=2)
+    ctx = Context(mex, Config(log_path=str(tmp_path / "log.json")))
+    d = ctx.Distribute(np.arange(64, dtype=np.int64))
+    d.Map(_mk).ReduceByKey(_k5, FieldReduce({"k": "first",
+                                             "v": "sum"})).AllGather()
+    stats = ctx.overall_stats()
+    assert stats["fused_dispatches"] >= 1
+    assert stats["fused_ops"] >= stats["fused_dispatches"]
+    ctx.close()
+    import json
+    evs = [json.loads(l) for l in
+           (tmp_path / "log-host0.json").read_text().splitlines()
+           if l.strip()]
+    fused = [e for e in evs if e.get("event") == "fused_dispatch"]
+    assert fused and all(isinstance(e["ops"], list) for e in fused)
+
+
+def test_fuse_fault_site_recovers(monkeypatch):
+    """A transient fault injected at a fused per-op site retries the
+    (pure) stitched dispatch and the pipeline completes exactly."""
+    from thrill_tpu.common import faults
+    monkeypatch.setenv("THRILL_TPU_FUSE", "1")
+    faults.REGISTRY.reset()
+    mex = MeshExec(num_workers=2)
+    ctx = Context(mex)
+    # n=1 per matched site: a k-segment chain fires k times total,
+    # within the 4-attempt retry budget (recovery by construction)
+    with faults.inject("api.fuse.*", n=1, seed=3):
+        d = ctx.Distribute(np.arange(100, dtype=np.int64))
+        got = d.Map(_mk).Filter(_even).ReduceByKey(
+            _k5, FieldReduce({"k": "first", "v": "sum"})).AllGather()
+    faults.REGISTRY.reset()
+    want = {}
+    for x in range(100):
+        if x % 2 == 0:
+            want[x % 5] = want.get(x % 5, 0) + x
+    assert sorted((t["k"], t["v"]) for t in got) == sorted(want.items())
+
+
+def test_take_rows_multi_parity(monkeypatch):
+    """Batched packed gathers (core/rowmove.py) move every leaf
+    exactly like per-leaf jnp.take."""
+    monkeypatch.setenv("THRILL_TPU_PACK_MOVE", "1")
+    from thrill_tpu.core import rowmove
+    rng = np.random.default_rng(1)
+    n = 64
+    leaves = [
+        rng.integers(0, 256, size=(n, 10)).astype(np.uint8),
+        rng.integers(0, 256, size=(n, 90)).astype(np.uint8),
+        rng.integers(-1000, 1000, size=n).astype(np.int64),
+        rng.random(n).astype(np.float64),
+        rng.random((n, 3)).astype(np.float32),
+        rng.integers(0, 2, size=n).astype(bool),          # unpackable
+        rng.integers(0, 9000, size=n).astype(np.uint16),
+    ]
+    perm = rng.permutation(n)
+
+    @jax.jit
+    def gather(ls):
+        return rowmove.take_rows_multi(ls, jnp.asarray(perm))
+
+    out = gather([jnp.asarray(l) for l in leaves])
+    for l, o in zip(leaves, out):
+        assert np.array_equal(np.asarray(o), l[perm]), l.dtype
+    # wide round-trip of a lone >=4-byte column
+    w, m = rowmove.pack_rows_wide(jnp.asarray(leaves[2]))
+    assert np.array_equal(np.asarray(rowmove.unpack_rows_wide(w, m)),
+                          leaves[2])

@@ -194,6 +194,40 @@ def _ex_mesh_dispatch():
     assert faults.REGISTRY.stats()["retries"] >= 1
 
 
+def _ex_fused_per_op_sites():
+    """api.fuse.<OpLabel> (program stitching, api/fusion.py): per-op
+    sites inside a stitched dispatch — a transient fire retries the
+    whole (pure) fused program, results exact, fault + retry counted.
+    Deeper coverage: tests/api/test_fusion.py and the chaos sweep."""
+    from thrill_tpu.api import Context, FieldReduce
+    from thrill_tpu.parallel.mesh import MeshExec
+    prev_radix = os.environ.get("THRILL_TPU_HOST_RADIX")
+    os.environ["THRILL_TPU_HOST_RADIX"] = "0"   # jitted (fusible) engines
+    try:
+        with faults.inject("api.fuse.*", n=1, seed=2):
+            mex = MeshExec(num_workers=2)
+            ctx = Context(mex)
+            got = sorted(
+                (int(t["k"]), int(t["v"])) for t in ctx.Distribute(
+                    np.arange(40, dtype=np.int64)).Map(
+                        lambda x: {"k": x % 4, "v": x}).ReduceByKey(
+                        lambda t: t["k"],
+                        FieldReduce({"k": "first",
+                                     "v": "sum"})).AllGather())
+            ctx.close()
+    finally:
+        if prev_radix is None:
+            os.environ.pop("THRILL_TPU_HOST_RADIX", None)
+        else:
+            os.environ["THRILL_TPU_HOST_RADIX"] = prev_radix
+    want = {k: sum(x for x in range(40) if x % 4 == k)
+            for k in range(4)}
+    assert got == sorted(want.items())
+    assert mex.stats_fused_dispatches >= 1
+    assert faults.REGISTRY.injected >= 1
+    assert faults.REGISTRY.stats()["retries"] >= 1
+
+
 def _ex_mesh_dispatch_exhausted():
     """api.mesh.dispatch surviving the budget: clean root-cause error,
     not a hang and not a wrong answer."""
@@ -324,6 +358,9 @@ _NET_SITES = {
 
 _MATRIX = {
     "api.mesh.dispatch": _ex_mesh_dispatch,
+    # the fused per-op site family (api.fuse.<OpLabel>) shares one
+    # exerciser: every member retries the same pure stitched dispatch
+    "api.fuse.*": _ex_fused_per_op_sites,
     "data.blockstore.put": _ex_blockstore,
     "data.blockstore.get": _ex_blockstore,
     "mem.hbm.spill": _ex_hbm_spill_and_restore,
@@ -361,7 +398,12 @@ def test_every_registered_site_is_covered():
     registered = {n for n in faults.REGISTRY.sites if not
                   n.startswith(("t.", "demo."))}      # test-local sites
     covered = set(_MATRIX) | _NET_SITES
-    missing = registered - covered
+    # pattern entries cover their whole dynamically-named family
+    # (api.fuse.<OpLabel> sites materialize on first armed check)
+    import fnmatch
+    missing = {n for n in registered - covered
+               if not any("*" in pat and fnmatch.fnmatchcase(n, pat)
+                          for pat in _MATRIX)}
     assert not missing, (
         f"injection sites without a fault-matrix exerciser: {missing} "
         f"— add one to tests/common/test_faults.py (_MATRIX) or "

@@ -280,6 +280,14 @@ class Context:
             "device_dispatches": mex.stats_dispatches,
             "device_uploads": mex.stats_uploads,
             "device_fetches": mex.stats_fetches,
+            # program stitching (api/fusion.py): how many dispatches
+            # the fused runner launched, how many DOp segments they
+            # carried (ops/dispatch > 1 means chains actually fused),
+            # and the per-stage composition table
+            "fused_dispatches": mex.stats_fused_dispatches,
+            "fused_ops": mex.stats_fused_ops,
+            "fused_stages": {" + ".join(ops): n for ops, n in
+                             mex.fused_stage_counts.items()},
             "host_mem_peak": self.mem.peak,
             "hbm_peak": self.hbm.mem.peak,
             "hbm_spills": self.hbm.spill_count,

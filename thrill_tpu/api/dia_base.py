@@ -27,6 +27,9 @@ Shards = Union[DeviceShards, HostShards]
 NEW = "NEW"
 EXECUTED = "EXECUTED"
 DISPOSED = "DISPOSED"
+# the node's program was traced into its sole consumer's stitched
+# dispatch (api/fusion.py) — consumed without ever materializing
+FUSED = "FUSED"
 
 
 @dataclasses.dataclass
@@ -36,6 +39,15 @@ class ParentLink:
     stack: Stack
 
     def pull(self, consume: bool = True) -> Shards:
+        from . import fusion
+        if fusion.enabled():
+            # fused pull: upstream chains deferred into one stitched
+            # dispatch execute here; the edge stack rides along instead
+            # of paying its own dispatch
+            return fusion.pull_plan(self, consume=consume).finish()
+        return self._pull_unfused(consume)
+
+    def _pull_unfused(self, consume: bool = True) -> Shards:
         shards = self.node.materialize(consume=consume)
         if isinstance(shards, DeviceShards):
             # deferred producer validations (hinted-join overflow) run
@@ -90,9 +102,47 @@ class DIABase:
         """Produce this node's output shards (the DOp main op + push)."""
         raise NotImplementedError
 
+    def compute_plan(self):
+        """Fusible DOps override: return a :class:`fusion.FusionPlan`
+        whose tail carries this node's traced segment (so a consumer
+        can stitch it into one dispatch), or None when statically
+        ineligible. Implementations that pull parents must ALWAYS
+        return a plan afterwards (wrapping an eagerly computed result
+        when the input turned out host-resident) — the pull consumed
+        the parent."""
+        return None
+
     # -- driver ---------------------------------------------------------
+    def materialize_plan(self, consume: bool = False):
+        """Fused-stage entry: defer this node's program into its sole
+        consumer's stitched dispatch when safe (sole consumer, nothing
+        cached, fusion on), else materialize normally. Returns a
+        FusionPlan (deferred) or Shards."""
+        from . import fusion
+        if (fusion.enabled() and consume and self._shards is None
+                and self.state == NEW and self.consume_budget <= 1
+                and type(self).compute_plan is not DIABase.compute_plan):
+            # the legacy path would negotiate around compute(); plans
+            # may fall back to mem-hungry host bodies, so grant here too
+            negotiated = self.context.negotiate_mem(self)
+            try:
+                plan = self.compute_plan()
+            finally:
+                if negotiated:
+                    self.context.release_mem(self)
+            if plan is not None:
+                self.consume_budget = 0
+                self.state = FUSED
+                log = self.context.logger
+                if log.enabled:
+                    log.line(event="node_fused", node=self.label,
+                             dia_id=self.id,
+                             parents=[p.node.id for p in self.parents])
+                return plan
+        return self.materialize(consume=consume)
+
     def materialize(self, consume: bool = False) -> Shards:
-        if self.state == DISPOSED:
+        if self.state in (DISPOSED, FUSED):
             raise RuntimeError(
                 f"DIA node {self.label}#{self.id} was consumed/disposed "
                 f"(consume budget exhausted); call .Keep() before reusing "

@@ -1,0 +1,392 @@
+"""Cross-op program stitching: fuse device DOp chains into one dispatch.
+
+The function-stack machinery (api/stack.py) already fuses chained
+Map/Filter/FlatMap lambdas into one traced program, but every device
+DOp still issued its OWN jitted dispatch — and on a tunneled chip each
+dispatch pays the link round trip (140.7 ms measured, BASELINE.md r5),
+so a six-op pipeline paid six RTTs where one would do. This module is
+the cross-op generalization of the stack: at stage-build time the pull
+recursion assembles a :class:`FusionPlan` — a chain of traced
+:class:`Segment`s over one (or, for Zip/Join heads, several) input
+``DeviceShards`` — and the whole chain compiles ONCE via
+``MeshExec.cached()`` under a composite plan key and dispatches through
+ONE ``smap`` call.
+
+Mechanics, mirroring the reference's template function stacks
+(thrill/api/dia.hpp:358-387) one level up the operator hierarchy:
+
+* A fusible DOp implements ``compute_plan()`` (api/dia_base.py): pull
+  the parent as a plan, append its own traced segment, hand the plan
+  on. A sole-consumer parent in state NEW *defers* — its program is
+  traced into the consumer's dispatch instead of running on its own
+  (``materialize_plan``); anything else materializes normally and
+  becomes a plan *source*.
+* Fusion barriers: all-to-all exchanges, host fallbacks, spills,
+  actions, multi-consumer results (``Keep``), and any op without a
+  traced segment. A barrier simply ends the chain — the plan executes
+  and its output shards seed the next chain.
+* State inside a stitched program is ``(tree, mask)`` exactly like the
+  stack contract; the final program compacts valid rows once and
+  returns device-resident counts. Cross-worker plan values that the
+  legacy per-op programs fetched via host counts (ZipWithIndex offsets,
+  Window halos) are computed IN-TRACE from collectives over the mask,
+  so fused chains need no mid-chain host syncs at all.
+* PR-1 failure semantics are preserved: the dispatch retries transient
+  faults under the shared policy (the program is pure), every fused
+  segment keeps a per-op fault site (``api.fuse.<OpLabel>``), and
+  deferred validations (hinted-join overflow) attach to the fused
+  program's OUTPUT — checks drain at the fused boundary, recovery
+  re-dispatches the plan at the true capacity (lineage = the plan's
+  immutable sources).
+
+``THRILL_TPU_FUSE=0`` restores the exact per-op dispatch behavior
+(every code path falls back to the pre-fusion implementations).
+Observability: ``stats_fused_dispatches`` / ``stats_fused_ops`` on the
+mesh, per-stage fused-op lists as ``event=fused_dispatch`` JSON lines,
+both surfaced by ``ctx.overall_stats()`` and tools/json2profile.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..common import faults
+from ..common.retry import default_policy
+from ..data.shards import DeviceShards, HostShards, compact_valid
+from ..parallel.mesh import AXIS
+from .stack import (Stack, apply_stack_host_list, apply_stack_traced,
+                    stack_bound_operands, stack_cache_token)
+
+
+def enabled() -> bool:
+    """THRILL_TPU_FUSE=0 restores per-op dispatches exactly."""
+    return os.environ.get("THRILL_TPU_FUSE", "1") not in ("0", "off",
+                                                          "false")
+
+
+class TraceCtx:
+    """Per-trace context handed to segment trace functions."""
+
+    def __init__(self, W: int) -> None:
+        self.W = W
+        self.aux: dict = {}          # name -> per-worker scalar output
+
+    @staticmethod
+    def count(mask: jnp.ndarray) -> jnp.ndarray:
+        return jnp.sum(mask.astype(jnp.int32))
+
+    def exclusive_offset(self, mask: jnp.ndarray) -> jnp.ndarray:
+        """Global item offset of this worker's valid items, computed
+        in-trace (an all_gather of local counts — the fused analog of
+        the host-counts prefix the legacy per-op programs uploaded)."""
+        cnt = jnp.sum(mask.astype(jnp.int64))
+        if self.W == 1:
+            return jnp.int64(0)
+        totals = lax.all_gather(cnt, AXIS)              # [W]
+        widx = lax.axis_index(AXIS)
+        return jnp.where(jnp.arange(self.W) < widx, totals, 0).sum()
+
+    def emit_aux(self, name: str, value: jnp.ndarray) -> None:
+        """Expose a per-worker SCALAR as an extra program output (e.g.
+        a hinted join's true match totals for the deferred check)."""
+        self.aux[name] = value
+
+
+@dataclasses.dataclass
+class Segment:
+    """One fusible device-DOp phase, traceable into a stitched program.
+
+    ``trace(fctx, tree, mask, bound)`` runs per worker inside shard_map
+    and returns the new ``(tree, mask)``; collectives over AXIS are
+    allowed. Head segments (multi-input ops) instead receive the list
+    of source ``(tree, mask)`` states. ``bound`` carries the traced
+    form of :attr:`bound` (runtime pytrees entering the program as
+    replicated arguments — the Bind contract, so iterative re-binds
+    never recompile).
+    """
+
+    label: str
+    token: Tuple
+    trace: Callable
+    bound: Tuple = ()
+    # output counts == input counts (all-map stacks, ZipWithIndex...):
+    # lets the plan hand host-known counts through, like the legacy
+    # apply_stack_device counts passthrough
+    preserves_counts: bool = False
+    # output already has all valid rows in a prefix (sorts): the final
+    # compaction scatter is skipped
+    already_compact: bool = False
+    # host-known output counts this segment imposes (ReduceToIndex's
+    # dense range sizes); replaces the plan's known counts
+    sets_counts: Optional[np.ndarray] = None
+    # multi-input head refit hook: rebuild this segment with a new
+    # static output capacity (hinted-join overflow recovery)
+    refit: Optional[Callable[[int], "Segment"]] = None
+    # called by execute() with (plan, out_shards): attaches deferred
+    # checks (hinted-join overflow) to the fused boundary
+    finalize: Optional[Callable[["FusionPlan", DeviceShards], None]] = None
+    dia_id: Optional[int] = None
+
+
+def _src_sig(shards: DeviceShards, flat) -> Tuple:
+    leaves, treedef = flat
+    return (shards.cap, treedef,
+            tuple((jnp.dtype(l.dtype), l.shape[2:]) for l in leaves))
+
+
+class FusionPlan:
+    """A pending chain of traced segments over source DeviceShards.
+
+    ``head`` (optional) consumes ALL sources (Zip/Join); the tail
+    segments are linear. ``stitchable=False`` marks a plain wrapper
+    around already-computed shards (host storage, or fusion disabled)
+    — ``finish()`` then just unwraps.
+    """
+
+    def __init__(self, mesh_exec, sources: List[Any],
+                 head: Optional[Segment] = None,
+                 stitchable: bool = True,
+                 known_counts: Optional[np.ndarray] = None) -> None:
+        self.mex = mesh_exec
+        self.sources = sources
+        self.head = head
+        self.segments: List[Segment] = []
+        # the THRILL_TPU_FUSE=0 escape hatch gates stitchability at the
+        # root: every wrapped plan then refuses segments and each op
+        # falls back to its per-op dispatch path exactly
+        self.stitchable = stitchable and enabled() and all(
+            isinstance(s, DeviceShards) for s in sources)
+        if head is not None:
+            known_counts = head.sets_counts if head.sets_counts is not None \
+                else known_counts
+        elif known_counts is None and self.stitchable \
+                and len(sources) == 1:
+            known_counts = sources[0]._counts_host
+        self.known_counts = known_counts
+        self.aux: dict = {}          # last execute()'s aux outputs
+        self._no_finalize = False    # recovery re-runs skip finalizers
+
+    # -- building -------------------------------------------------------
+    def append(self, seg: Segment) -> None:
+        assert self.stitchable, "cannot extend a non-stitchable plan"
+        self.segments.append(seg)
+        if seg.sets_counts is not None:
+            self.known_counts = seg.sets_counts
+        elif not seg.preserves_counts:
+            self.known_counts = None
+
+    @property
+    def all_segments(self) -> List[Segment]:
+        return ([self.head] if self.head is not None else []) \
+            + self.segments
+
+    def counts_preserved(self) -> bool:
+        """Every pending segment keeps per-worker counts unchanged."""
+        return self.head is None and all(s.preserves_counts
+                                         for s in self.segments)
+
+    # -- execution ------------------------------------------------------
+    def finish(self):
+        """Produce this plan's shards (host or device) for NON-TRACED
+        consumption. This is the fused boundary: deferred checks a
+        segment attached (hinted-join overflow) drain HERE, before any
+        consumer — exchange plan step, action egress, host fallback —
+        can read the columns (the unfused pull's validate_pending
+        invariant, dia_base.ParentLink._pull_unfused)."""
+        if not self.stitchable:
+            return self.sources[0]
+        shards = self.execute()
+        shards.validate_pending()
+        return shards
+
+    def execute(self) -> DeviceShards:
+        mex = self.mex
+        srcs = self.sources
+        segs = self.all_segments
+        if not segs:
+            return srcs[0]
+        src_flat = [jax.tree.flatten(s.tree) for s in srcs]
+        sigs = tuple(_src_sig(s, f) for s, f in zip(srcs, src_flat))
+        bound_flat = []
+        bound_sig = []
+        for seg in segs:
+            bl, bt = jax.tree.flatten(seg.bound)
+            bl = [jnp.asarray(l) for l in bl]
+            bound_flat.append((bl, bt))
+            bound_sig.append((bt, tuple((jnp.dtype(l.dtype),
+                                         tuple(l.shape)) for l in bl)))
+        key = ("fused", sigs, tuple(s.token for s in segs),
+               tuple(bound_sig))
+        holder: dict = {}
+        W = mex.num_workers
+        caps = [s[0] for s in sigs]
+        head, tail, last = self.head, self.segments, segs[-1]
+
+        def build():
+            def f(*args):
+                nsrc = len(srcs)
+                counts = args[:nsrc]
+                pos = nsrc
+                states = []
+                for k, (leaves_, td_) in enumerate(src_flat):
+                    ls = args[pos:pos + len(leaves_)]
+                    pos += len(leaves_)
+                    tree = jax.tree.unflatten(td_, [l[0] for l in ls])
+                    mask = jnp.arange(caps[k]) < counts[k][0, 0]
+                    states.append((tree, mask))
+                bounds_t = []
+                for bl, bt in bound_flat:
+                    bs = args[pos:pos + len(bl)]
+                    pos += len(bl)
+                    bounds_t.append(jax.tree.unflatten(bt, list(bs)))
+                fctx = TraceCtx(W)
+                si = 0
+                if head is not None:
+                    tree, mask = head.trace(fctx, states, bounds_t[0])
+                    si = 1
+                else:
+                    tree, mask = states[0]
+                for seg, bound_t in zip(tail, bounds_t[si:]):
+                    tree, mask = seg.trace(fctx, tree, mask, bound_t)
+                if last.already_compact:
+                    out_tree = tree
+                    new_count = jnp.sum(mask.astype(jnp.int32))
+                else:
+                    out_tree, new_count = compact_valid(tree, mask)
+                out_leaves, out_td = jax.tree.flatten(out_tree)
+                holder["treedef"] = out_td
+                holder["n_out"] = len(out_leaves)
+                holder["aux_names"] = tuple(sorted(fctx.aux))
+                return (new_count[None, None].astype(jnp.int32),
+                        *[l[None] for l in out_leaves],
+                        *[fctx.aux[n][None, None]
+                          for n in holder["aux_names"]])
+
+            nd = len(srcs) + sum(len(f_[0]) for f_ in src_flat)
+            nb = sum(len(bf[0]) for bf in bound_flat)
+            in_specs = (P(AXIS),) * nd + (P(),) * nb
+            return mex.smap(f, nd + nb, in_specs=in_specs), holder
+
+        fn, h = mex.cached(key, build)
+        args = ([s.counts_device() for s in srcs]
+                + [l for f_ in src_flat for l in f_[0]]
+                + [l for bf in bound_flat for l in bf[0]])
+        if faults.REGISTRY.active():
+            # per-op fault sites survive fusion: each constituent op
+            # keeps a named site, and a transient fire at the stage
+            # boundary retries under the shared policy. The dispatch
+            # itself stays OUTSIDE this policy — _CountedJit already
+            # retries api.mesh.dispatch under its own run, and nesting
+            # the two would multiply the documented attempt budget
+            # (4 -> 16) for dispatch faults inside stitched programs,
+            # silently diverging from the THRILL_TPU_FUSE=0 path
+            def site_checks():
+                for seg in segs:
+                    faults.check("api.fuse." + seg.label,
+                                 dia_id=seg.dia_id, fused_ops=len(segs))
+
+            default_policy().run(site_checks, what="fuse.dispatch")
+        out = fn(*args)
+        mex.stats_fused_dispatches += 1
+        mex.stats_fused_ops += len(segs)
+        ops = tuple(s.label for s in segs)
+        counts_map = getattr(mex, "fused_stage_counts", None)
+        if counts_map is not None:
+            counts_map[ops] = counts_map.get(ops, 0) + 1
+        log = getattr(mex, "logger", None)
+        if log is not None and log.enabled:
+            log.line(event="fused_dispatch", ops=list(ops),
+                     dia_ids=[s.dia_id for s in segs])
+        n_out = h["n_out"]
+        tree = jax.tree.unflatten(h["treedef"], list(out[1:1 + n_out]))
+        self.aux = dict(zip(h["aux_names"], out[1 + n_out:]))
+        if self.known_counts is not None:
+            shards = DeviceShards(mex, tree, self.known_counts.copy())
+        else:
+            shards = DeviceShards(mex, tree, out[0])
+        if not self._no_finalize:
+            for seg in segs:
+                if seg.finalize is not None:
+                    seg.finalize(self, shards)
+        return shards
+
+    def reexecute(self, new_cap: int) -> DeviceShards:
+        """Recovery re-dispatch with the head refit to ``new_cap``
+        (hinted-join overflow): same sources, same tail, finalizers
+        suppressed so checks are not re-attached."""
+        assert self.head is not None and self.head.refit is not None
+        plan = FusionPlan(self.mex, self.sources,
+                          head=self.head.refit(new_cap))
+        plan.segments = list(self.segments)
+        plan.known_counts = None
+        plan._no_finalize = True
+        return plan.execute()
+
+
+def wrap(shards) -> FusionPlan:
+    """Plan-shaped wrapper around computed shards (host or device)."""
+    mex = getattr(shards, "mesh_exec", None)
+    return FusionPlan(mex, [shards],
+                      stitchable=isinstance(shards, DeviceShards))
+
+
+def stack_segment(stack: Stack, dia_id: Optional[int] = None) -> Segment:
+    """The LOp function stack as a fused segment (same traced math as
+    api/device_exec.apply_stack_device, minus its own dispatch)."""
+    bound = tuple(stack_bound_operands(stack))
+
+    def trace(fctx, tree, mask, bound_t):
+        return apply_stack_traced(tree, mask, stack,
+                                  bound=list(bound_t) if bound_t
+                                  else None)
+
+    return Segment(label="Stack",
+                   token=("stack", stack_cache_token(stack)),
+                   trace=trace, bound=bound,
+                   preserves_counts=all(op.kind == "map" for op in stack),
+                   dia_id=dia_id)
+
+
+def pull_plan(link, consume: bool = True) -> FusionPlan:
+    """Pull a parent edge as a fusion plan.
+
+    The fused counterpart of ``ParentLink.pull``: the parent either
+    defers (its segments arrive pending in the plan) or materializes
+    (its shards become the plan source, deferred validations drained at
+    this boundary); the edge's LOp stack joins the chain as a segment.
+    With fusion disabled this is exactly ``wrap(link.pull())``.
+    """
+    if not enabled():
+        return wrap(link.pull(consume))
+    res = link.node.materialize_plan(consume=consume)
+    if isinstance(res, FusionPlan):
+        plan = res
+    elif isinstance(res, DeviceShards):
+        # overflow checks drain at the fused boundary (the legacy
+        # pull's validate_pending contract)
+        res.validate_pending()
+        plan = FusionPlan(res.mesh_exec, [res])
+    else:
+        plan = wrap(res)
+    if link.stack:
+        if plan.stitchable:
+            plan.append(stack_segment(link.stack, dia_id=link.node.id))
+        else:
+            shards = plan.finish()
+            if isinstance(shards, HostShards):
+                shards = HostShards(shards.num_workers,
+                                    [apply_stack_host_list(l, link.stack)
+                                     for l in shards.lists])
+            else:                      # pragma: no cover — defensive
+                from .device_exec import apply_stack_device
+                shards = apply_stack_device(shards, link.stack)
+            plan = wrap(shards)
+    return plan

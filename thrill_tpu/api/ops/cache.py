@@ -15,6 +15,12 @@ class CacheNode(DIABase):
     def __init__(self, ctx, link) -> None:
         super().__init__(ctx, "Cache", [link])
 
+    def compute_plan(self):
+        # pure pass-through: the folded stack (and any deferred parent
+        # chain) rides into the consumer's stitched dispatch
+        from .. import fusion
+        return fusion.pull_plan(self.parents[0])
+
     def compute(self):
         return self.parents[0].pull()
 
@@ -25,6 +31,10 @@ class CollapseNode(DIABase):
 
     def __init__(self, ctx, link) -> None:
         super().__init__(ctx, "Collapse", [link])
+
+    def compute_plan(self):
+        from .. import fusion
+        return fusion.pull_plan(self.parents[0])
 
     def compute(self):
         return self.parents[0].pull()

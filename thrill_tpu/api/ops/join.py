@@ -143,11 +143,13 @@ class InnerJoinNode(DIABase):
         return HostShards(W, out)
 
     # -- device path ----------------------------------------------------
-    def _compute_device(self, left: DeviceShards, right: DeviceShards):
+    def _prep_device(self, left: DeviceShards, right: DeviceShards,
+                     token):
+        """Location filter + hash-partition exchange (fusion barriers
+        shared by the phased and the stitched join paths)."""
         mex = left.mesh_exec
         W = mex.num_workers
-        lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
-        token = (lkey, rkey, jfn)
+        lkey, rkey = self.lkey, self.rkey
 
         if self.location_detection and W > 1:
             left, right = _location_filter(left, right, lkey, rkey,
@@ -165,6 +167,179 @@ class InnerJoinNode(DIABase):
                                      ("join_l", token, W))
             right = exchange.exchange(right, mk_dest(rkey),
                                       ("join_r", token, W))
+        return left, right
+
+    def compute_plan(self):
+        """Hinted joins stitch (api/fusion.py): both phases trace into
+        ONE program, and the plan defers so downstream device ops ride
+        in the same dispatch. Un-hinted joins need their host size
+        agreement — a fusion barrier — and stay on the phased path."""
+        from .. import fusion
+        if not fusion.enabled() or self.out_size_hint is None:
+            return None
+        left = self.parents[0].pull()
+        right = self.parents[1].pull()
+        if isinstance(left, HostShards) or isinstance(right, HostShards):
+            return fusion.wrap(self._compute_host(left, right))
+        token = (self.lkey, self.rkey, self.join_fn)
+        left, right = self._prep_device(left, right, token)
+        return self._fused_plan(left, right, token)
+
+    def _fused_plan(self, left: DeviceShards, right: DeviceShards,
+                    token):
+        """One-dispatch hinted join: sort both sides, count match runs,
+        expand pairs — phase 1 + phase 2 of the phased path as a single
+        head segment. The true per-worker totals ride out as an aux
+        output feeding the deferred overflow check; recovery
+        re-dispatches the plan (sources are immutable device buffers —
+        the lineage) at the true capacity."""
+        from .. import fusion
+        mex = left.mesh_exec
+        lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
+        out_cap = round_up_pow2(max(int(self.out_size_hint), 1))
+        node = self
+
+        def make_head(cap_):
+            def trace(fctx, states, _bound):
+                (ltree, lmask), (rtree, rmask) = states
+                lcap = lmask.shape[0]
+                rcap = rmask.shape[0]
+                lw = keymod.encode_key_words(lkey(ltree))
+                rw = keymod.encode_key_words(rkey(rtree))
+                lw, ltree_s, lvalid, _ = segmented.sort_by_key_words(
+                    lw, ltree, lmask)
+                rw, rtree_s, rvalid, _ = segmented.sort_by_key_words(
+                    rw, rtree, rmask)
+                lo, hi = _run_bounds(lw, lvalid, rw, rvalid)
+                matches = jnp.where(rvalid, hi - lo, 0)      # [rcap]
+                total = jnp.sum(matches)
+                fctx.emit_aux("join_totals", total)
+                ends = jnp.cumsum(matches)
+                p = jnp.arange(cap_, dtype=jnp.int64)
+                ridx = jnp.searchsorted(ends, p, side="right")
+                ridx = jnp.clip(ridx, 0, rcap - 1)
+                starts = ends - matches
+                lidx = lo[ridx] + (p - starts[ridx])
+                lidx = jnp.clip(lidx, 0, lcap - 1)
+                lsel = jax.tree.map(
+                    lambda x: jnp.take(x, lidx, axis=0), ltree_s)
+                rsel = jax.tree.map(
+                    lambda x: jnp.take(x, ridx, axis=0), rtree_s)
+                return jfn(lsel, rsel), jnp.arange(cap_) < total
+
+            def finalize(plan, out):
+                node._attach_fused_check(mex, plan, out, cap_)
+
+            return fusion.Segment(label="InnerJoin",
+                                  token=("join_fused", token, cap_),
+                                  trace=trace, already_compact=True,
+                                  refit=make_head, finalize=finalize,
+                                  dia_id=node.id)
+
+        return fusion.FusionPlan(mex, [left, right],
+                                 head=make_head(out_cap))
+
+    def _attach_fused_check(self, mex, plan, out: DeviceShards,
+                            cap: int) -> None:
+        """PR-1 recovery semantics for the stitched join: deferred
+        overflow check draining at the fused boundary, sticky error
+        state, in-place heal by re-dispatching the plan at the true
+        capacity (counts replaced too — a fused tail's output counts
+        depend on the healed pairs).
+
+        TWIN of the phased path's check in ``_compute_device`` below
+        (same sticky/resolve/re-entrancy discipline, different heal:
+        plan re-dispatch vs expand-closure re-run) — a change to
+        either must be mirrored in the other."""
+        totals_dev = plan.aux.get("join_totals")
+        try:
+            totals_dev.copy_to_host_async()
+        except Exception:
+            pass                   # overlap is best-effort, not needed
+        hint = self.out_size_hint
+        label, dia_id = self.label, self.id
+        hbm = self.context.hbm
+        state = {"ok": False, "err": None, "plan": plan, "out": out,
+                 "totals": totals_dev}
+
+        def _resolve() -> None:
+            state["ok"] = state["err"] is None
+            state["plan"] = None
+            state["out"] = None
+            state["totals"] = None
+
+        def validate(_counts):
+            if state["err"] is not None:
+                raise state["err"]
+            if state["ok"]:
+                return None
+            totals = mex._fetch_raw(
+                state["totals"]).reshape(-1).astype(np.int64)
+            if int(totals.max(initial=0)) <= cap:
+                _resolve()
+                return None
+            worst = int(totals.max(initial=0))
+            import os
+            if os.environ.get("THRILL_TPU_JOIN_RECOVER", "1") != "0":
+                true_cap = round_up_pow2(max(worst, 1))
+                o, plan_ = state["out"], state["plan"]
+                # resolve FIRST: the re-dispatch below realizes counts,
+                # and a drain fired from inside it must see a resolved
+                # check, never start a second recovery
+                _resolve()
+                healed = plan_.reexecute(true_cap)
+                o.tree = healed.tree
+                o._counts_dev = healed._counts_dev
+                # _fetch_raw: no drain (re-entrancy) and no counted
+                # mid-pipeline sync in the dispatch budget
+                new_counts = mex._fetch_raw(
+                    healed._counts_dev).reshape(-1).astype(np.int64)
+                mex.stats_join_overflow_retries += 1
+                # resync the governor if some node tracks these shards
+                # (the consumer of a deferred chain cached them)
+                for n in list(hbm._lru.values()):
+                    if n._shards is o and getattr(n, "_hbm_bytes", 0):
+                        nb = hbm._device_bytes(o)
+                        hbm.mem.subtract(n._hbm_bytes)
+                        n._hbm_bytes = nb
+                        hbm.mem.add(nb)
+                        break
+                from ...common import faults
+                faults.note("recovery", what="join_out_size_hint",
+                            node=label, dia_id=dia_id, hint=int(hint),
+                            true_max=worst, new_cap=true_cap,
+                            fused=True)
+                return new_counts
+            state["err"] = ValueError(
+                f"InnerJoin out_size_hint={hint} (cap {cap}) "
+                f"overflowed: a worker produced {worst} pairs; "
+                f"results were truncated — raise the hint or drop it")
+            _resolve()
+            raise state["err"]
+
+        out._counts_check = validate
+
+        def pending_check() -> None:
+            if state["err"] is not None:
+                raise state["err"]       # sticky: a drain surfaces it
+            if state["ok"]:
+                return
+            validate(None)
+
+        mex._pending_checks.append(pending_check)
+
+    def _compute_device(self, left: DeviceShards, right: DeviceShards):
+        mex = left.mesh_exec
+        W = mex.num_workers
+        lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
+        token = (lkey, rkey, jfn)
+
+        left, right = self._prep_device(left, right, token)
+
+        if self.out_size_hint is not None:
+            from .. import fusion
+            if fusion.enabled():
+                return self._fused_plan(left, right, token).execute()
 
         lcap, rcap = left.cap, right.cap
         lleaves, ltd = jax.tree.flatten(left.tree)

@@ -31,8 +31,54 @@ class PrefixSumNode(DIABase):
         self.initial = initial
         self.inclusive = inclusive
 
+    def _fuse_segment(self):
+        """The masked local-cumsum + cross-worker offset trace as a
+        fused segment (the all_gather of local totals rides inside the
+        stitched program)."""
+        from .. import fusion
+        inclusive = self.inclusive
+        initial = self.initial
+
+        def trace(fctx, tree, mask, _bound):
+            def one(x):
+                m = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+                xm = jnp.where(m, x, 0)
+                incl = jnp.cumsum(xm, axis=0, dtype=x.dtype)
+                local_total = incl[-1]
+                totals = lax.all_gather(local_total, AXIS)   # [W, ...]
+                widx = lax.axis_index(AXIS)
+                prev = jnp.where(
+                    (jnp.arange(totals.shape[0]) < widx
+                     ).reshape((-1,) + (1,) * (totals.ndim - 1)),
+                    totals, 0).sum(axis=0)
+                scan = incl if inclusive else incl - xm
+                return scan + prev + jnp.asarray(initial).astype(x.dtype)
+
+            return jax.tree.map(one, tree), mask
+
+        return fusion.Segment(
+            label=self.label,
+            token=("prefix_sum_fused", inclusive,
+                   np.asarray(initial).tobytes()),
+            trace=trace, preserves_counts=True, dia_id=self.id)
+
+    def compute_plan(self):
+        from .. import fusion
+        if self.fn is not None:
+            return None              # generic fold: host path only
+        plan = fusion.pull_plan(self.parents[0])
+        if not plan.stitchable:
+            return fusion.wrap(self._compute_on(plan.finish()))
+        plan.append(self._fuse_segment())
+        return plan
+
     def compute(self):
-        shards = self.parents[0].pull()
+        plan = self.compute_plan()
+        if plan is not None:
+            return plan.finish()
+        return self._compute_on(self.parents[0].pull())
+
+    def _compute_on(self, shards):
         if isinstance(shards, HostShards) or self.fn is not None:
             if isinstance(shards, DeviceShards):
                 shards = shards.to_host_shards("prefixsum-nonnumeric-op")

@@ -350,20 +350,79 @@ class ReduceNode(DIABase):
         # shuffling keys whose hash is globally unique (host path)
         self.dup_detection = dup_detection
 
+    def _fuse_segment(self, phase: str):
+        """This node's local combine phase as a fused segment
+        (api/fusion.py): the same encode + sort + segmented-reduce
+        trace as :func:`_local_reduce_device`, stitched into a larger
+        program instead of paying its own dispatch. The FieldReduce
+        specs are derived at trace time from the actual traced tree
+        (the composite plan key pins treedef/dtypes, so the choice is
+        deterministic per executable)."""
+        from ...core import host_radix
+        from .. import fusion
+        if host_radix.eligible(self.context.mesh_exec):
+            return None      # the native CPU engine beats the jitted one
+        key_fn, reduce_fn = self.key_fn, self.reduce_fn
+
+        def trace(fctx, tree, mask, _bound):
+            leaves, td = jax.tree.flatten(tree)
+            specs = _device_fold_specs(reduce_fn, td, leaves)
+            words = keymod.encode_key_words(key_fn(tree))
+            words, tree_s, valid, _ = segmented.sort_by_key_words(
+                words, tree, mask)
+            words, tree_s, rep = segmented.reduce_runs(
+                words, tree_s, valid, reduce_fn, specs)
+            return tree_s, rep
+
+        return fusion.Segment(label="ReduceLocal",
+                              token=("reduce_local", phase, self.token),
+                              trace=trace, dia_id=self.id)
+
+    def compute_plan(self):
+        from .. import fusion
+        plan = fusion.pull_plan(self.parents[0])
+        seg = self._fuse_segment("pre") if plan.stitchable else None
+        if seg is None:
+            return fusion.wrap(self._compute_on(plan.finish()))
+        plan.append(seg)
+        if self.context.num_workers == 1:
+            # the pre-phase IS the whole reduce at W == 1: hand the
+            # plan on so downstream ops stitch onto it
+            return plan
+        # finish(), not execute(): the exchange below is a fusion
+        # barrier consuming the columns — pending checks drain first
+        pre = plan.finish()
+        return self._post_exchange(pre)
+
     def compute(self):
-        shards = self.parents[0].pull()
+        plan = self.compute_plan()
+        return plan.finish()
+
+    def _compute_on(self, shards):
+        """Pre-fusion compute body over pulled shards (the
+        THRILL_TPU_FUSE=0 path, and the host/native fallbacks)."""
         if isinstance(shards, HostShards):
             return self._compute_host(shards)
         key_fn, reduce_fn = self.key_fn, self.reduce_fn
         token = self.token
         W = self.context.num_workers
-        dup = self.dup_detection
         # pre-phase: local combine (reference: ReducePrePhase)
         pre = _local_reduce_device(shards, key_fn, reduce_fn, "pre", token)
         if W == 1:
             # the pre-phase already combined every key; with no
             # exchange there is nothing for a post phase to merge
             return pre
+        return self._post_exchange(pre).finish()
+
+    def _post_exchange(self, pre: "DeviceShards"):
+        """Shuffle the pre-reduced shards and run the post combine.
+        Returns a FusionPlan (post phase pending when fusible, so
+        downstream ops can stitch onto it)."""
+        from .. import fusion
+        key_fn, reduce_fn = self.key_fn, self.reduce_fn
+        token = self.token
+        W = self.context.num_workers
+        dup = self.dup_detection
         # shuffle by key hash (reference: Mix/CatStream exchange).
         # With DuplicateDetection, globally-unique key hashes skip the
         # shuffle: a register psum inside the destination program finds
@@ -396,11 +455,20 @@ class ReduceNode(DIABase):
                 # are still in flight (reference: use_post_thread_
                 # overlap, api/reduce_by_key.hpp:142-168, over
                 # MixStream's arbitrary-order delivery)
-                return self._compute_device_stream(pre, dest, token)
+                return fusion.wrap(
+                    self._compute_device_stream(pre, dest, token))
             pre = exchange.exchange(pre, dest,
                                     ("reduce_dest", token, W, dup))
-        # post-phase: final combine (reference: ReduceByHashPostPhase)
-        return _local_reduce_device(pre, key_fn, reduce_fn, "post", token)
+        # post-phase: final combine (reference: ReduceByHashPostPhase);
+        # fusible, so the chain continues across the exchange barrier
+        if fusion.enabled():
+            seg = self._fuse_segment("post")
+            if seg is not None:
+                plan = fusion.FusionPlan(pre.mesh_exec, [pre])
+                plan.append(seg)
+                return plan
+        return fusion.wrap(
+            _local_reduce_device(pre, key_fn, reduce_fn, "post", token))
 
     def _compute_device_stream(self, pre: DeviceShards, dest, token):
         """Streamed post-phase: per-round receive + incremental fold.
@@ -733,26 +801,117 @@ class ReduceToIndexNode(DIABase):
         self.size = int(size)
         self.neutral = neutral
 
-    def compute(self):
-        shards = self.parents[0].pull()
+    def _bounds(self):
         W = self.context.num_workers
         n = self.size
-        bounds = np.array([(w * n) // W for w in range(W + 1)], dtype=np.int64)
+        return np.array([(w * n) // W for w in range(W + 1)],
+                        dtype=np.int64)
+
+    def _exchange_by_index(self, shards, bounds, token):
+        W = self.context.num_workers
+        index_fn = self.index_fn
+        bounds_dev = jnp.asarray(bounds)
+
+        def dest(tree, mask, widx):
+            idx = jnp.asarray(index_fn(tree)).astype(jnp.int64)
+            return (jnp.searchsorted(bounds_dev[1:], idx, side="right")
+                    ).astype(jnp.int32)
+
+        return exchange.exchange(shards, dest, ("r2i_dest", token, W))
+
+    def _fuse_segment(self, bounds: np.ndarray):
+        """The dense scatter-reduce (post-exchange local phase) as a
+        fused segment: sort by index, segmented-reduce, scatter into
+        this worker's dense [range_size] rows."""
+        from .. import fusion
+        index_fn, reduce_fn = self.index_fn, self.reduce_fn
+        neutral = self.neutral
+        W = self.context.num_workers
+        local_sizes = (bounds[1:] - bounds[:-1]).astype(np.int64)
+        out_cap = max(1, int(local_sizes.max()))
+        ntok = None
+        if neutral is not None:
+            ntok = (str(jax.tree.structure(neutral)),
+                    tuple(np.asarray(l).tobytes()
+                          for l in jax.tree.leaves(neutral)))
+        bound = (bounds[:W].astype(np.int64),
+                 local_sizes.astype(np.int64))
+
+        def trace(fctx, tree, mask, bound_t):
+            starts, sizes = bound_t            # replicated [W] plans
+            widx = lax.axis_index(AXIS)
+            range_start = starts[widx]
+            range_size = sizes[widx]
+            leaves, td = jax.tree.flatten(tree)
+            specs = _device_fold_specs(reduce_fn, td, leaves)
+            idx = jnp.asarray(index_fn(tree)).astype(jnp.int64)
+            words = [idx.astype(jnp.uint64)]
+            words, tree_s, valid, _ = segmented.sort_by_key_words(
+                words, tree, mask)
+            words, tree_s, rep = segmented.reduce_runs(
+                words, tree_s, valid, reduce_fn, specs)
+            local_idx = words[0].astype(jnp.int64) - range_start
+            pos = jnp.where(rep, local_idx, out_cap)
+            pos = jnp.clip(pos, 0, out_cap)
+
+            def scatter(leaf):
+                base = jnp.zeros((out_cap + 1,) + leaf.shape[1:],
+                                 leaf.dtype)
+                return base.at[pos].set(leaf)[:out_cap]
+
+            if neutral is None:
+                out_tree = jax.tree.map(scatter, tree_s)
+            else:
+                def scatter_n(leaf, nval):
+                    base = jnp.full((out_cap + 1,) + leaf.shape[1:],
+                                    nval, leaf.dtype)
+                    return base.at[pos].set(leaf)[:out_cap]
+                out_tree = jax.tree.map(scatter_n, tree_s, neutral)
+            return out_tree, jnp.arange(out_cap) < range_size
+
+        return fusion.Segment(
+            label="ReduceToIndex",
+            token=("r2i_post_fused", (index_fn, reduce_fn, self.size),
+                   out_cap, ntok),
+            trace=trace, bound=bound, already_compact=True,
+            sets_counts=local_sizes, dia_id=self.id)
+
+    def compute_plan(self):
+        from .. import fusion
+        from ...core import host_radix
+        plan = fusion.pull_plan(self.parents[0])
+        bounds = self._bounds()
+        if not plan.stitchable or \
+                host_radix.eligible(self.context.mesh_exec):
+            return fusion.wrap(self._compute_on(plan.finish(), bounds))
+        W = self.context.num_workers
+        token = (self.index_fn, self.reduce_fn, self.size)
+        if W > 1:
+            # exchange barrier: finish the upstream chain, shuffle,
+            # start a fresh chain with the local scatter phase pending
+            shards = self._exchange_by_index(plan.finish(), bounds,
+                                             token)
+            plan = fusion.FusionPlan(shards.mesh_exec, [shards])
+        plan.append(self._fuse_segment(bounds))
+        return plan
+
+    def compute(self):
+        plan = self.compute_plan()
+        return plan.finish()
+
+    def _compute_on(self, shards, bounds):
+        """Pre-fusion compute body over pulled shards."""
+        W = self.context.num_workers
+        n = self.size
         if isinstance(shards, HostShards):
             return self._compute_host(shards, bounds)
 
         mex = shards.mesh_exec
         index_fn, reduce_fn = self.index_fn, self.reduce_fn
         token = (index_fn, reduce_fn, n)
-        bounds_dev = jnp.asarray(bounds)
 
         if W > 1:
-            def dest(tree, mask, widx):
-                idx = jnp.asarray(index_fn(tree)).astype(jnp.int64)
-                return (jnp.searchsorted(bounds_dev[1:], idx, side="right")
-                        ).astype(jnp.int32)
-
-            shards = exchange.exchange(shards, dest, ("r2i_dest", token, W))
+            shards = self._exchange_by_index(shards, bounds, token)
 
         host = _host_reduce_to_index(shards, index_fn, reduce_fn,
                                      bounds, self.neutral)

@@ -86,8 +86,56 @@ class SortNode(DIABase):
         self.compare_fn = compare_fn
         self.stable = stable
 
+    def _fuse_segment(self):
+        """W == 1 local sort (key-only argsort + one payload gather) as
+        a fused segment. The W > 1 sample sort needs its splitter
+        agreement and all-to-all — a fusion barrier — and stays on the
+        phased path."""
+        from .. import fusion
+        from ...core import host_radix
+        if self.context.num_workers != 1 or self.compare_fn is not None \
+                or host_radix.eligible(self.context.mesh_exec):
+            return None
+        key_fn = self.key_fn
+
+        def trace(fctx, tree, mask, _bound):
+            cap = mask.shape[0]
+            words = keymod.encode_key_words(key_fn(tree))
+            iota = jnp.arange(cap, dtype=jnp.uint64)
+            from ...core.device_sort import argsort_words
+            sort_words = ([(~mask).astype(jnp.uint32)] + list(words)
+                          + [iota])
+            perm = argsort_words(sort_words)
+            from ...core import rowmove
+            leaves, td = jax.tree.flatten(tree)
+            out = rowmove.take_rows_multi(leaves, perm)
+            count = jnp.sum(mask.astype(jnp.int32))
+            return (jax.tree.unflatten(td, out),
+                    jnp.arange(cap) < count)
+
+        return fusion.Segment(label="Sort",
+                              token=("sort_w1_fused", self.key_fn),
+                              trace=trace, preserves_counts=True,
+                              already_compact=True, dia_id=self.id)
+
+    def compute_plan(self):
+        from .. import fusion
+        seg = self._fuse_segment()
+        if seg is None:
+            return None
+        plan = fusion.pull_plan(self.parents[0])
+        if not plan.stitchable:
+            return fusion.wrap(self._compute_on(plan.finish()))
+        plan.append(seg)
+        return plan
+
     def compute(self):
-        shards = self.parents[0].pull()
+        plan = self.compute_plan()
+        if plan is not None:
+            return plan.finish()
+        return self._compute_on(self.parents[0].pull())
+
+    def _compute_on(self, shards):
         if isinstance(shards, HostShards):
             return self._compute_host(shards)
         if self.compare_fn is not None:
@@ -481,8 +529,10 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
                     sort_words = ([(~valid).astype(jnp.uint32)]
                                   + list(words) + [iota])
                 perm = argsort_words(sort_words)
-                from ...core.rowmove import take_rows
-                return tuple(take_rows(l[0], perm)[None] for l in ls)
+                from ...core.rowmove import take_rows_multi
+                return tuple(
+                    o[None] for o in take_rows_multi([l[0] for l in ls],
+                                                     perm))
 
             return mex.smap(f, 1 + len(leaves))
 
@@ -567,8 +617,8 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
             dest = jnp.where(valid, d, W)
             all_send = exchange.send_counts(dest, W)
             # the ONE payload gather of this phase
-            from ...core.rowmove import take_rows
-            sorted_ls = [take_rows(l[0], p) for l in ls]
+            from ...core.rowmove import take_rows_multi
+            sorted_ls = take_rows_multi([l[0] for l in ls], p)
             return (dest[None], all_send,
                     *[sl[None] for sl in sorted_ls])
 
@@ -625,10 +675,11 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
             invalid_word = (~valid).astype(jnp.uint32)
             perm = argsort_words([invalid_word] + words
                                  + [gi.astype(jnp.uint64)])
-            # the ONE payload gather of this phase
-            from ...core.rowmove import take_rows
-            out_leaves = [take_rows(l, perm)
-                          for l in jax.tree.leaves(tree["tree"])]
+            # the ONE payload gather of this phase — all leaves batched
+            # through one packed word matrix (core/rowmove.py)
+            from ...core.rowmove import take_rows_multi
+            out_leaves = take_rows_multi(
+                jax.tree.leaves(tree["tree"]), perm)
             return tuple(l[None] for l in out_leaves)
 
         return mex.smap(f, 1 + len(leaves3))

@@ -94,6 +94,22 @@ def _windowed_device_program(shards: DeviceShards, k: int, cache_tag,
     return DeviceShards(mex, tree, out[0])
 
 
+def _fused_window_plan(node):
+    """Shared Window/FlatWindow fusion gate: the halo eligibility check
+    (every non-last worker holds at least k-1 items) needs host counts,
+    so the op fuses only when the pending chain provably preserves the
+    source's KNOWN counts; anything else finishes the chain and takes
+    the per-op path."""
+    from .. import fusion
+    plan = fusion.pull_plan(node.parents[0])
+    if plan.stitchable and plan.counts_preserved() \
+            and plan.known_counts is not None \
+            and bool(np.all(plan.known_counts[:-1] >= node.k - 1)):
+        plan.append(node._fuse_segment())
+        return plan
+    return fusion.wrap(node._compute_on(plan.finish()))
+
+
 class WindowNode(DIABase):
     def __init__(self, ctx, link, k: int, fn: Optional[Callable],
                  device_fn: Optional[Callable], disjoint: bool,
@@ -113,8 +129,39 @@ class WindowNode(DIABase):
                 "Window has no trailing partial block)")
         self.partial_fn = partial_fn
 
+    def _fuse_segment(self):
+        from .. import fusion
+        k = self.k
+        disjoint = self.disjoint
+        fn = self.device_fn
+        W = self.context.num_workers
+
+        def trace(fctx, tree, mask, _bound):
+            cap = mask.shape[0]
+            count = jnp.sum(mask.astype(jnp.int32))
+            off = fctx.exclusive_offset(mask)
+            windows, valid, g_start = _device_windows(
+                tree, cap, count, off, k, W)
+            if disjoint:
+                valid = valid & (g_start % k == 0)
+            return fn(windows), valid
+
+        return fusion.Segment(label=self.label,
+                              token=("window_fused", fn, disjoint, k),
+                              trace=trace, dia_id=self.id)
+
+    def compute_plan(self):
+        if self.device_fn is None or self.partial_fn is not None:
+            return None
+        return _fused_window_plan(self)
+
     def compute(self):
-        shards = self.parents[0].pull()
+        plan = self.compute_plan()
+        if plan is not None:
+            return plan.finish()
+        return self._compute_on(self.parents[0].pull())
+
+    def _compute_on(self, shards):
         k = self.k
         if isinstance(shards, DeviceShards) and self.device_fn is not None \
                 and self.partial_fn is None \
@@ -194,8 +241,40 @@ class FlatWindowNode(DIABase):
         if fn is None and device_fn is None:
             raise ValueError("FlatWindow needs fn and/or device_fn")
 
+    def _fuse_segment(self):
+        from .. import fusion
+        k = self.k
+        factor = self.factor
+        fn = self.device_fn
+        W = self.context.num_workers
+
+        def trace(fctx, tree, mask, _bound):
+            cap = mask.shape[0]
+            count = jnp.sum(mask.astype(jnp.int32))
+            off = fctx.exclusive_offset(mask)
+            windows, valid, g_start = _device_windows(
+                tree, cap, count, off, k, W)
+            out, fmask = fn(windows)         # [cap, factor, ...]
+            flat_tree = jax.tree.map(
+                lambda l: l.reshape((cap * factor,) + l.shape[2:]), out)
+            return flat_tree, (valid[:, None] & fmask).reshape(-1)
+
+        return fusion.Segment(label="FlatWindow",
+                              token=("flatwindow_fused", fn, factor, k),
+                              trace=trace, dia_id=self.id)
+
+    def compute_plan(self):
+        if self.device_fn is None or self.factor <= 0:
+            return None
+        return _fused_window_plan(self)
+
     def compute(self):
-        shards = self.parents[0].pull()
+        plan = self.compute_plan()
+        if plan is not None:
+            return plan.finish()
+        return self._compute_on(self.parents[0].pull())
+
+    def _compute_on(self, shards):
         k = self.k
         if isinstance(shards, DeviceShards) and self.device_fn is not None \
                 and self.factor > 0 \

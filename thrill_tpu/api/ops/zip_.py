@@ -78,7 +78,21 @@ class ZipNode(DIABase):
         self.zip_fn = zip_fn
         self.mode = mode
 
+    def compute_plan(self):
+        from .. import fusion
+        res = self._compute_any()
+        if isinstance(res, fusion.FusionPlan):
+            return res
+        return fusion.wrap(res)
+
     def compute(self):
+        from .. import fusion
+        res = self._compute_any()
+        if isinstance(res, fusion.FusionPlan):
+            return res.finish()
+        return res
+
+    def _compute_any(self):
         pulls = [l.pull() for l in self.parents]
         if any(isinstance(p, HostShards) for p in pulls):
             # only MIXED storage demotes; unequal sizes (cut/pad) stay
@@ -144,6 +158,26 @@ class ZipNode(DIABase):
         # fused local zip
         cap = max(a.cap for a in aligned)
         aligned = [_repad(a, cap) for a in aligned]
+        from .. import fusion
+        if fusion.enabled():
+            # multi-source head plan: the local zip traces into the
+            # consumer's stitched program (downstream ops ride along)
+            zip_fn = self.zip_fn
+
+            def trace(fctx, states, _bound):
+                trees = [t for t, _m in states]
+                out = zip_fn(*trees) if zip_fn else tuple(trees)
+                return out, states[0][1]
+
+            head = fusion.Segment(label="Zip",
+                                  token=("zip_fuse_head", zip_fn,
+                                         self.mode),
+                                  trace=trace, already_compact=True,
+                                  dia_id=self.id)
+            for a in aligned:
+                a.validate_pending()
+            return fusion.FusionPlan(mex, aligned, head=head,
+                                     known_counts=counts)
         tree = _fused_map_trees(mex, [a.tree for a in aligned],
                                 self.zip_fn, "zip_fuse")
         return DeviceShards(mex, tree, counts)
@@ -257,6 +291,10 @@ def _repad(shards: DeviceShards, cap: int) -> DeviceShards:
     return DeviceShards(shards.mesh_exec, tree, shards.counts)
 
 
+def _zwi_default(it, i):
+    return (it, i)
+
+
 class ZipWithIndexNode(DIABase):
     """zip_fn(item, global_index) (reference: api/zip_with_index.hpp:38)."""
 
@@ -264,9 +302,37 @@ class ZipWithIndexNode(DIABase):
         super().__init__(ctx, "ZipWithIndex", [link])
         self.zip_fn = zip_fn
 
+    def _fuse_segment(self):
+        """Global indices computed IN-TRACE: position within the valid
+        mask plus the cross-worker exclusive offset (an all_gather of
+        counts inside the stitched program) — no host counts, no
+        offsets upload."""
+        from .. import fusion
+        zf = self.zip_fn or _zwi_default
+
+        def trace(fctx, tree, mask, _bound):
+            pos = jnp.cumsum(mask.astype(jnp.int64)) - 1
+            g = fctx.exclusive_offset(mask) + pos
+            return zf(tree, g), mask
+
+        return fusion.Segment(label="ZipWithIndex",
+                              token=("zip_index_fused", self.zip_fn),
+                              trace=trace, preserves_counts=True,
+                              dia_id=self.id)
+
+    def compute_plan(self):
+        from .. import fusion
+        plan = fusion.pull_plan(self.parents[0])
+        if not plan.stitchable:
+            return fusion.wrap(self._compute_on(plan.finish()))
+        plan.append(self._fuse_segment())
+        return plan
+
     def compute(self):
-        shards = self.parents[0].pull()
-        zf = self.zip_fn or (lambda it, i: (it, i))
+        return self.compute_plan().finish()
+
+    def _compute_on(self, shards):
+        zf = self.zip_fn or _zwi_default
         if isinstance(shards, HostShards):
             from ...data import multiplexer
             counts = multiplexer.global_counts(

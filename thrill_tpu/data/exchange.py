@@ -125,10 +125,10 @@ def _phase_a(shards: DeviceShards, dest_builder: Callable,
             dest = dest_builder(tree, mask, widx).astype(jnp.int32)
             dest = jnp.where(mask, jnp.clip(dest, 0, W - 1), W)
             from ..core.device_sort import argsort_words
-            from ..core.rowmove import take_rows
+            from ..core.rowmove import take_rows_multi
             perm = argsort_words([dest.astype(jnp.uint64)])
             sorted_dest = jnp.take(dest, perm)
-            sorted_ls = [take_rows(l[0], perm) for l in ls]
+            sorted_ls = take_rows_multi([l[0] for l in ls], perm)
             # replicate the [W, W] send-count matrix: every process can
             # then fetch it locally (multi-controller safe host step)
             all_send = send_counts(sorted_dest, W)

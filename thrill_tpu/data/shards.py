@@ -109,9 +109,12 @@ class DeviceShards:
                 # overflow), the next access re-validates instead of
                 # silently serving truncated counts. A RECOVERING check
                 # (hinted-join lineage retry) heals self.tree in place
-                # and returns normally.
-                self._counts_check(counts)
+                # and may return REPLACEMENT counts (a fused-chain
+                # recovery recomputes downstream counts too).
+                fixed = self._counts_check(counts)
                 self._counts_check = None
+                if fixed is not None:
+                    counts = fixed
             self._counts_host = counts
         return self._counts_host
 
@@ -134,9 +137,11 @@ class DeviceShards:
         else:
             counts = self.mesh_exec._fetch_raw(
                 self._counts_dev).reshape(-1).astype(np.int64)
-        self._counts_check(counts)    # sticky: stays set if it raises
+        fixed = self._counts_check(counts)  # sticky: stays set on raise
         self._counts_check = None
-        if self._counts_host is None:
+        if fixed is not None:
+            self._counts_host = fixed
+        elif self._counts_host is None:
             self._counts_host = counts
 
     @property

@@ -115,6 +115,13 @@ class MeshExec:
         self.stats_uploads = 0
         self.stats_fetches = 0
         self.stats_upload_cache_hits = 0
+        # program stitching (api/fusion.py): dispatches launched by the
+        # fused runner, total DOp segments they carried, and per-stage
+        # composition (tuple of op labels -> launch count) — the
+        # dispatch budget's observability surface
+        self.stats_fused_dispatches = 0
+        self.stats_fused_ops = 0
+        self.fused_stage_counts: Dict[Tuple[str, ...], int] = {}
         self._put_small_cache: Dict[Any, jax.Array] = {}
         # deferred device-side validations (e.g. InnerJoin
         # out_size_hint overflow): ops that skip a blocking host sync

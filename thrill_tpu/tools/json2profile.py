@@ -97,6 +97,8 @@ def render_html(events: List[dict]) -> str:
     per_host_nodes: dict = {}
     profiles = []
     exchanges = []
+    fused = []         # fused_dispatch (api/fusion.py program stitching)
+    overall = []       # overall_stats summary lines
     device_xchg: dict = {}   # host -> ordered device-plane exchanges
     memory = []        # hbm_spill / hbm_restore / mem_negotiate / demotion
     faults = []        # fault_injected / retry / recovery / abort
@@ -130,6 +132,10 @@ def render_html(events: List[dict]) -> str:
         elif e.get("event") in ("fault_injected", "retry", "recovery",
                                 "abort"):
             faults.append((t, e))
+        elif e.get("event") == "fused_dispatch":
+            fused.append(e)
+        elif e.get("event") == "overall_stats":
+            overall.append(e)
     if device_xchg:
         best = max(sorted(device_xchg), key=lambda h: len(device_xchg[h]))
         exchanges.extend(device_xchg[best])
@@ -186,9 +192,53 @@ td.hm {{ min-width: 3em; }}
 {_render_exchange_volume(exchanges, total)}
 {_render_worker_lanes(exchanges, total)}
 {_render_memory_events(memory, total)}
+{_render_fused_dispatches(fused, overall)}
 {_render_fault_events(faults)}
 {_render_host_overlay(profiles, total)}
 </body></html>"""
+
+
+def _render_fused_dispatches(fused, overall) -> str:
+    """Program-stitching table: per-stage fused-op lists with launch
+    counts, and the fused-vs-unfused dispatch budget. Each fused
+    dispatch carrying k ops saved k-1 link round trips versus the
+    per-op dispatch model (THRILL_TPU_FUSE=0), so the 'saved' column
+    IS the dispatch delta the fusion planner bought."""
+    if not fused and not overall:
+        return ""
+    by_stage: dict = {}
+    for e in fused:
+        ops = tuple(e.get("ops") or ())
+        by_stage[ops] = by_stage.get(ops, 0) + 1
+    rows = []
+    tot_disp = tot_ops = 0
+    for ops, n in sorted(by_stage.items(),
+                         key=lambda kv: -kv[1] * len(kv[0])):
+        tot_disp += n
+        tot_ops += n * len(ops)
+        rows.append(
+            f"<tr><td class=l>{html.escape(' + '.join(ops))}</td>"
+            f"<td>{len(ops)}</td><td>{n}</td>"
+            f"<td>{n * (len(ops) - 1)}</td></tr>")
+    summary = ""
+    if overall:
+        o = overall[-1]
+        fd = o.get("fused_dispatches", tot_disp)
+        fo = o.get("fused_ops", tot_ops)
+        dd = o.get("device_dispatches")
+        summary = (f"<p>device dispatches: <b>{dd}</b> total, "
+                   f"{fd} launched by the fusion runner carrying "
+                   f"{fo} DOp segments (unfused they would have cost "
+                   f"{(dd or 0) + max(fo - fd, 0)} dispatches)</p>")
+    elif tot_disp:
+        summary = (f"<p>{tot_disp} fused dispatches carrying "
+                   f"{tot_ops} DOp segments "
+                   f"({tot_ops - tot_disp} dispatches saved)</p>")
+    return f"""
+<h2>fused dispatches (program stitching)</h2>
+{summary}
+<table><tr><th class=l>stage composition</th><th>ops</th>
+<th>dispatches</th><th>saved</th></tr>{''.join(rows)}</table>"""
 
 
 def _render_fault_events(faults) -> str:
