@@ -327,10 +327,14 @@ def _run_bench() -> None:
     # generic python-heap engine — platform-independent, so it
     # reports the host engine even in a TPU window
     em = _em_sort_metric(ctx)
+    # durability cost (api/checkpoint.py), opt-in: epoch-write overhead
+    # and resume/restore time on the Sort pipeline
+    ck = (_ckpt_metric(n)
+          if os.environ.get("THRILL_TPU_BENCH_CKPT") == "1" else {})
 
     _emit(value=round(mrec_s, 3),
           vs_baseline=round(mrec_s / host_mrec_s, 3),
-          **wc, **prm, **kmm, **sfm, **em)
+          **wc, **prm, **kmm, **sfm, **em, **ck)
     ctx.close()
 
 
@@ -607,6 +611,83 @@ def _em_sort_metric(ctx) -> dict:
         return out
     except Exception as e:  # tertiary metric never kills the line
         return {"em_sort_error": repr(e)[:200]}
+
+
+def _ckpt_metric(n: int) -> dict:
+    """Opt-in (THRILL_TPU_BENCH_CKPT=1) durability-cost metric: the
+    same Sort pipeline run bare vs with a per-stage Checkpoint()
+    (api/checkpoint.py), plus a resumed run. Records
+    ``ckpt_overhead_frac`` (fractional slowdown the epoch writes add)
+    and ``recovery_time_s`` (restore cost on resume) so the BENCH_*
+    trajectory tracks what durability costs as the engine gets
+    faster."""
+    try:
+        import shutil
+        import tempfile
+
+        from thrill_tpu.api import Run
+        from thrill_tpu.common.config import Config
+        n = min(n, 1 << 16)           # durability cost, not throughput
+        rng = np.random.default_rng(7)
+        recs = {
+            "key": rng.integers(0, 256, size=(n, 10)).astype(np.uint8),
+            "value": rng.integers(0, 256, size=(n, 22)).astype(np.uint8),
+        }
+
+        bytes_holder = {}
+
+        def job(ctx, ckpt):
+            d = ctx.Distribute(recs).Sort(key_fn=_key_fn)
+            if ckpt:
+                d = d.Checkpoint("bench-sort")
+            shards = d.node.materialize()
+            import jax
+            jax.block_until_ready(jax.tree.leaves(shards.tree))
+            if ckpt and ctx.checkpoint is not None \
+                    and ctx.checkpoint.bytes_written:
+                bytes_holder["b"] = ctx.checkpoint.bytes_written
+            return None
+
+        td = tempfile.mkdtemp(prefix="ttpu-bench-ckpt-")
+        try:
+            import dataclasses
+            # both legs inherit the SAME env-tuned engine config
+            # (worker count, sort engine, exchange...) but the
+            # checkpoint knobs are pinned per leg: the plain leg must
+            # not auto-checkpoint because the operator happens to have
+            # THRILL_TPU_CKPT_DIR/_AUTO/_RESUME exported, and the
+            # bench must never write epochs into a real checkpoint dir
+            base = dataclasses.replace(Config.from_env(), ckpt_dir="",
+                                       ckpt_auto=False, resume=False)
+            cfg = dataclasses.replace(base, ckpt_dir=td)
+            Run(lambda ctx: job(ctx, False), base)    # warmup/compile
+            dt_plain, _ = _best_of(
+                lambda: Run(lambda ctx: job(ctx, False), base), iters=2)
+            dt_ckpt, _ = _best_of(
+                lambda: Run(lambda ctx: job(ctx, True), cfg), iters=2)
+
+            # recovery: a fresh resumed run restores the newest epoch
+            rec_holder = {}
+
+            def resumed(ctx):
+                job(ctx, True)
+                rec_holder.update(ctx.overall_stats())
+                return None
+
+            Run(resumed, cfg, resume=True)
+            return {
+                "ckpt_overhead_frac": round(
+                    max(dt_ckpt / dt_plain - 1.0, 0.0), 4),
+                "ckpt_bytes": int(bytes_holder.get("b", 0)),
+                "recovery_time_s": rec_holder.get("recovery_time_s",
+                                                  0.0),
+                "resume_skipped_ops": int(rec_holder.get(
+                    "resume_skipped_ops", 0)),
+            }
+        finally:
+            shutil.rmtree(td, ignore_errors=True)
+    except Exception as e:  # opt-in metric never kills the line
+        return {"ckpt_error": repr(e)[:200]}
 
 
 def main():

@@ -18,10 +18,13 @@
 //
 // Build: g++ -O3 -shared -fPIC -std=c++17 blockstore.cpp -o libblockstore.so
 
+#include <unistd.h>
+
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cctype>
 #include <cstring>
 #include <deque>
 #include <list>
@@ -251,10 +254,27 @@ class BlockStore {
   }
 
   std::string SpillPath(int64_t victim) {
+    // the owning host+pid ride in the name so an external sweeper
+    // (data/block_pool.py purge_stale_spills) can reclaim files whose
+    // process died without running the destructor (kill -9, abort) —
+    // and, on a spill dir shared across hosts, never judge a REMOTE
+    // process's file by local pid liveness. Hostname sanitized to
+    // [A-Za-z0-9_] so the dash-delimited name stays parseable.
+    static const std::string host = [] {
+      char h[128] = "unknown";
+      gethostname(h, sizeof(h) - 1);
+      std::string s(h);
+      for (char& c : s)
+        if (!std::isalnum(static_cast<unsigned char>(c))) c = '_';
+      return s.empty() ? std::string("unknown") : s;
+    }();
     char path[512];
-    std::snprintf(path, sizeof(path), "%s/ttpu-blk-%p-%lld.spill",
-                  spill_dir_.c_str(), static_cast<void*>(this),
-                  static_cast<long long>(victim));
+    std::snprintf(path, sizeof(path),
+                  "%s/ttpu-blk-%lld-%p-%lld-%s.spill",
+                  spill_dir_.c_str(),
+                  static_cast<long long>(getpid()),
+                  static_cast<void*>(this),
+                  static_cast<long long>(victim), host.c_str());
     return path;
   }
 

@@ -11,6 +11,12 @@
 # (net.tcp.*, net.multiplexer.*, net.dispatcher.timer) are swept by
 # tests/net/test_fault_injection.py, included here too.
 #
+# Kill-and-resume mode (CHAOS_KILL=1): additionally sweeps the
+# checkpoint/resume chaos cases (tests/api/test_checkpoint.py,
+# chaos-marked): seeded runs die after a random committed epoch and a
+# supervised relaunch must resume to bit-identical results. N_SEEDS
+# scales both sweeps.
+#
 # Tuning knobs (exported through to the harness):
 #   THRILL_TPU_RETRY_ATTEMPTS / _BASE_S / _MAX_S  retry policy
 #   THRILL_TPU_RETRY=0   disable retries (detection-only sweep: every
@@ -21,6 +27,12 @@ cd "$(dirname "$0")/.."
 N_SEEDS=${1:-25}
 shift || true
 
+TARGETS=(tests/api/test_chaos.py tests/net/test_fault_injection.py)
+if [[ "${CHAOS_KILL:-0}" == "1" ]]; then
+  TARGETS+=(tests/api/test_checkpoint.py)
+fi
+
 exec env JAX_PLATFORMS=cpu THRILL_TPU_CHAOS_SEEDS="$N_SEEDS" \
+    THRILL_TPU_CHAOS_KILL_SEEDS="$N_SEEDS" \
     python -m pytest -m chaos -q -p no:cacheprovider \
-    tests/api/test_chaos.py tests/net/test_fault_injection.py "$@"
+    "${TARGETS[@]}" "$@"

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Supervised re-launch: survive worker loss by restarting with resume.
+#
+# Usage:
+#   THRILL_TPU_CKPT_DIR=/shared/ckpt run-scripts/supervise.sh \
+#       [-n MAX_RESTARTS] -- <command> [args...]
+#
+# Runs <command> (a thrill_tpu job — typically one rank of a
+# RunDistributed launch, or a whole single-host Run). If it exits
+# nonzero (a SIGKILL'd worker, a ClusterAbort from the hang watchdog
+# or heartbeat failure detector, an OOM kill), the command is
+# relaunched with THRILL_TPU_RESUME=1 so the job restores the newest
+# committed checkpoint epoch (api/checkpoint.py) and replays only
+# post-checkpoint work. Without THRILL_TPU_CKPT_DIR the relaunch
+# simply recomputes from scratch.
+#
+# The in-process analog (single-controller jobs and tests) is
+# thrill_tpu.api.RunSupervised. Cluster launchers (launch_ssh.sh /
+# launch_slurm.sbatch) can wrap their per-rank command in this script
+# so one lost rank tears the group down (fast, attributable abort via
+# poison frames + THRILL_TPU_HANG_TIMEOUT_S) and the whole set
+# relaunches from the last epoch.
+set -uo pipefail
+
+MAX_RESTARTS=3
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -n) MAX_RESTARTS="$2"; shift 2 ;;
+    --) shift; break ;;
+    *)  break ;;
+  esac
+done
+
+if [[ $# -eq 0 ]]; then
+  echo "usage: supervise.sh [-n MAX_RESTARTS] -- <command> [args...]" >&2
+  exit 2
+fi
+
+attempt=0
+while :; do
+  if [[ $attempt -gt 0 ]]; then
+    export THRILL_TPU_RESUME=1
+    echo "supervise: restart $attempt/$MAX_RESTARTS (resume enabled," \
+         "ckpt dir: ${THRILL_TPU_CKPT_DIR:-<unset: recompute>})" >&2
+  fi
+  "$@"
+  rc=$?
+  [[ $rc -eq 0 ]] && exit 0
+  attempt=$((attempt + 1))
+  if [[ $attempt -gt $MAX_RESTARTS ]]; then
+    echo "supervise: giving up after $MAX_RESTARTS restarts (rc=$rc)" >&2
+    exit "$rc"
+  fi
+  echo "supervise: command failed (rc=$rc); relaunching in 2s" >&2
+  sleep 2
+done

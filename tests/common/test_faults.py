@@ -336,6 +336,73 @@ def _ex_vfs_read_reopen(tmp_path=None):
     assert faults.REGISTRY.stats()["retries"] == 2
 
 
+def _ckpt_roundtrip(tmp_dir):
+    """One checkpointed run + one resumed run in tmp_dir; returns the
+    two results (must be equal) and the resumed run's stats."""
+    from thrill_tpu.api import Run
+    from thrill_tpu.common.config import Config
+    cfg = Config(ckpt_dir=tmp_dir)
+
+    def job(ctx):
+        d = ctx.Distribute(np.arange(24, dtype=np.int64)) \
+            .Map(lambda x: x * 5).Checkpoint()
+        return (sorted(int(x) for x in d.AllGather()),
+                ctx.overall_stats())
+
+    r1, _ = Run(job, cfg)
+    r2, s2 = Run(job, cfg, resume=True)
+    return r1, r2, s2
+
+
+def _ex_ckpt_write_and_manifest():
+    """ckpt.write / ckpt.manifest: transient faults while sealing an
+    epoch retry under the shared policy — the epoch commits and a
+    resumed run restores it exactly."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        with faults.inject("ckpt.write", n=1, seed=6), \
+                faults.inject("ckpt.manifest", n=1, seed=6):
+            r1, r2, s2 = _ckpt_roundtrip(td)
+    assert r1 == r2 == [x * 5 for x in range(24)]
+    assert s2["resume_skipped_ops"] >= 1    # the restore really ran
+    assert faults.REGISTRY.injected >= 2
+    assert faults.REGISTRY.stats()["retries"] >= 2
+
+
+def _ex_ckpt_read():
+    """ckpt.read: a transient fault while loading a shard on resume
+    retries through; the restored result is exact."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        with faults.inject("ckpt.read", n=1, seed=7):
+            r1, r2, s2 = _ckpt_roundtrip(td)
+    assert r1 == r2
+    assert s2["resume_skipped_ops"] >= 1
+    assert faults.REGISTRY.injected >= 1
+    assert faults.REGISTRY.stats()["retries"] >= 1
+
+
+def _ex_ckpt_read_exhausted_recomputes():
+    """ckpt.read surviving the retry budget: the restore is abandoned
+    LOUDLY and the run recomputes from lineage — never a crash, never
+    corrupt data."""
+    import tempfile
+    prev = os.environ.get("THRILL_TPU_RETRY_ATTEMPTS")
+    os.environ["THRILL_TPU_RETRY_ATTEMPTS"] = "2"
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            with faults.inject("ckpt.read", n=0, seed=7):
+                r1, r2, _ = _ckpt_roundtrip(td)
+        assert r1 == r2
+        assert any(e.get("what") == "ckpt.restore_failed"
+                   for e in faults.REGISTRY.events)
+    finally:
+        if prev is None:
+            os.environ.pop("THRILL_TPU_RETRY_ATTEMPTS", None)
+        else:
+            os.environ["THRILL_TPU_RETRY_ATTEMPTS"] = prev
+
+
 def _ex_vfs_scheme_sites():
     """vfs.s3.read / vfs.hdfs.open: the scheme backends raise the
     declared transient class at their ranged-read sites (the generic
@@ -354,6 +421,9 @@ _NET_SITES = {
     "net.tcp.connect", "net.tcp.send", "net.tcp.flush",
     "net.dispatcher.timer",
     "net.multiplexer.frame_send", "net.multiplexer.frame_recv",
+    # failure detector (PR 3): injected collective wedge + heartbeat
+    # probe faults — exercised against real socketpair groups
+    "net.group.recv_hang", "net.heartbeat",
 }
 
 _MATRIX = {
@@ -361,6 +431,9 @@ _MATRIX = {
     # the fused per-op site family (api.fuse.<OpLabel>) shares one
     # exerciser: every member retries the same pure stitched dispatch
     "api.fuse.*": _ex_fused_per_op_sites,
+    "ckpt.write": _ex_ckpt_write_and_manifest,
+    "ckpt.manifest": _ex_ckpt_write_and_manifest,
+    "ckpt.read": _ex_ckpt_read,
     "data.blockstore.put": _ex_blockstore,
     "data.blockstore.get": _ex_blockstore,
     "mem.hbm.spill": _ex_hbm_spill_and_restore,
@@ -382,11 +455,17 @@ def test_fault_matrix_exhausted_budget_is_clean():
     _ex_mesh_dispatch_exhausted()
 
 
+def test_fault_matrix_ckpt_read_exhausted_recomputes():
+    _ex_ckpt_read_exhausted_recomputes()
+
+
 def test_every_registered_site_is_covered():
     """Declaring a site without adding a matrix exerciser fails here:
     import every layer, then require full coverage."""
+    import thrill_tpu.api.checkpoint  # noqa: F401
     import thrill_tpu.api.context  # noqa: F401
     import thrill_tpu.data.block_pool  # noqa: F401
+    import thrill_tpu.net.heartbeat  # noqa: F401
     import thrill_tpu.data.multiplexer  # noqa: F401
     import thrill_tpu.mem.hbm  # noqa: F401
     import thrill_tpu.net.dispatcher  # noqa: F401

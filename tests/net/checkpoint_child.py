@@ -1,0 +1,95 @@
+"""Child body for the worker-loss + resume test.
+
+Launched by tests/net/test_checkpoint_resume.py with:
+  python checkpoint_child.py <coordinator_addr> <rank> <nproc>
+and THRILL_TPU_HOSTLIST/RANK/SECRET/CKPT_DIR in the environment.
+
+Runs a small PageRank (host storage, so every exchange and collective
+rides this framework's own control plane — the layer under test) with
+one ``Checkpoint()`` per iteration. Test hooks:
+
+* ``TEST_KILL_RANK`` + ``TEST_KILL_AT_EPOCH``: that rank SIGKILLs
+  itself on ENTERING the save of the given epoch — abrupt worker loss
+  with an uncommitted epoch on disk, exactly what a kill -9 leaves.
+* ``THRILL_TPU_RESUME=1``: a relaunch resumes from the newest
+  committed epoch (asserted via resume_skipped_ops in the RESULT).
+
+Prints one RESULT line with the final ranks (full float repr, so the
+parent can assert bit-identical resumption) and checkpoint stats.
+"""
+
+import json
+import os
+import signal
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+from thrill_tpu.common.platform import force_cpu_platform
+
+force_cpu_platform()
+
+from thrill_tpu.api import RunDistributed  # noqa: E402
+from thrill_tpu.api import checkpoint as _ck  # noqa: E402
+
+N = 24          # pages
+K = 5           # iterations (one checkpoint epoch each)
+
+
+def _install_kill_hook(my_rank: int) -> None:
+    kill_rank = int(os.environ.get("TEST_KILL_RANK", "-1"))
+    kill_epoch = int(os.environ.get("TEST_KILL_AT_EPOCH", "-1"))
+    if my_rank != kill_rank or kill_epoch < 0:
+        return
+    orig = _ck.CheckpointManager.save
+
+    def save(self, node, shards):
+        if self._next_epoch == kill_epoch:
+            sys.stdout.flush()
+            os.kill(os.getpid(), signal.SIGKILL)   # no goodbye protocol
+        return orig(self, node, shards)
+
+    _ck.CheckpointManager.save = save
+
+
+def job(ctx):
+    # PageRank over a fixed 2-out-regular graph: page i links to
+    # (7i+1)%N and (3i+2)%N (both coprime strides cover every target,
+    # so every index receives contributions)
+    ranks = ctx.Distribute([(i, 1.0 / N) for i in range(N)],
+                           storage="host")
+    for it in range(K):
+        ranks.Keep()
+        c1 = ranks.Map(lambda t: ((t[0] * 7 + 1) % N, t[1] / 2))
+        c2 = ranks.Map(lambda t: ((t[0] * 3 + 2) % N, t[1] / 2))
+        contribs = c1.Concat(c2)
+        summed = contribs.ReduceToIndex(
+            lambda t: t[0],
+            lambda a, b: (a[0], a[1] + b[1]),
+            size=N, neutral=(0, 0.0))
+        ranks = summed.Map(
+            lambda t: (t[0], 0.15 / N + 0.85 * t[1])) \
+            .Checkpoint(f"pr{it}")
+    out = sorted(ranks.AllGather())
+    stats = ctx.overall_stats()
+    return {
+        "ranks": [[int(p), repr(float(r))] for p, r in out],
+        "epochs": stats.get("checkpoint_epochs", 0),
+        "resume_skipped_ops": stats.get("resume_skipped_ops", 0),
+        "hosts": stats.get("hosts", 1),
+    }
+
+
+def main():
+    coordinator, rank, nproc = (sys.argv[1], int(sys.argv[2]),
+                                int(sys.argv[3]))
+    _install_kill_hook(rank)
+    result = RunDistributed(
+        job, coordinator_address=coordinator, num_processes=nproc,
+        process_id=rank,
+        resume=os.environ.get("THRILL_TPU_RESUME") == "1")
+    print("RESULT " + json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,7 @@ helpers rather than wedging them.
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -19,7 +20,8 @@ import pytest
 from thrill_tpu.common import faults
 from thrill_tpu.net import wire
 from thrill_tpu.net.group import ClusterAbort, poison_on_error
-from thrill_tpu.net.tcp import TcpConnection, construct_tcp_group
+from thrill_tpu.net.tcp import TcpConnection, TcpGroup, \
+    construct_tcp_group
 
 from portalloc import free_ports, load_scaled
 
@@ -495,6 +497,138 @@ def test_injected_multiplexer_frame_faults_recover():
         assert _recv_frame(g, 1, "test") == {"x": 1}
     assert faults.REGISTRY.injected == 2
     assert faults.REGISTRY.stats()["retries"] == 2
+
+
+def _socketpair_group_pair():
+    a, b = socket.socketpair()
+    return (TcpGroup(0, 2, {1: TcpConnection(a)}),
+            TcpGroup(1, 2, {0: TcpConnection(b)}), a, b)
+
+
+# ----------------------------------------------------------------------
+# collective hang watchdog + heartbeat failure detector
+# ----------------------------------------------------------------------
+
+def test_hung_collective_aborts_within_deadline(monkeypatch):
+    """A peer that never enters the collective: the survivor's recv
+    deadline (THRILL_TPU_HANG_TIMEOUT_S) fires, the abort names the
+    collective and the silent peer rank, and the wedged peer itself is
+    poisoned with the root cause — no hang anywhere."""
+    g0, g1, a, b = _socketpair_group_pair()
+    monkeypatch.setenv("THRILL_TPU_HANG_TIMEOUT_S", "0.5")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ClusterAbort) as ei:
+            g0.all_reduce(7)        # rank 1 is wedged: never responds
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0, "abort took far longer than the deadline"
+        assert "hang at all_reduce" in ei.value.cause
+        assert "rank 1" in ei.value.cause
+        assert faults.REGISTRY.stats()["aborts"] >= 1
+        # the wedged peer's stream now carries the data frame followed
+        # by the poison frame: when it finally recvs, it learns the
+        # ROOT CAUSE instead of waiting forever
+        g1.recv_from(0)             # the all_reduce's payload frame
+        with pytest.raises(ClusterAbort) as ei2:
+            g1.recv_from(0)
+        assert "hang at all_reduce" in ei2.value.cause
+    finally:
+        a.close()
+        b.close()
+
+
+def test_injected_recv_hang_site(monkeypatch):
+    """net.group.recv_hang: an armed fire makes the next collective
+    recv behave as a deadline expiry — the full hang-abort path runs
+    (poison + ClusterAbort naming site and peer) without any real
+    wedged peer or timeout wait."""
+    g0, g1, a, b = _socketpair_group_pair()
+    monkeypatch.setenv("THRILL_TPU_HANG_TIMEOUT_S", "30")
+    try:
+        with faults.inject("net.group.recv_hang", n=1, seed=23):
+            with pytest.raises(ClusterAbort) as ei:
+                g0.all_reduce(1)
+        assert "hang at all_reduce" in ei.value.cause
+        assert "rank 1" in ei.value.cause
+        assert faults.REGISTRY.injected >= 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_injected_heartbeat_transient_recovers():
+    """net.heartbeat: a transient probe fault is absorbed by the
+    shared retry policy — no peer is declared dead."""
+    from thrill_tpu.net.heartbeat import HeartbeatMonitor
+    g0, g1, a, b = _socketpair_group_pair()
+    try:
+        with faults.inject("net.heartbeat", n=1, seed=29):
+            mon = HeartbeatMonitor(g0, 0.05).start()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    faults.REGISTRY.injected < 1:
+                time.sleep(0.02)
+            time.sleep(0.2)          # give the retry time to land
+            mon.stop()
+        assert faults.REGISTRY.injected >= 1
+        assert faults.REGISTRY.stats()["retries"] >= 1
+        assert g0._pending_abort is None, \
+            "a transient heartbeat fault declared the peer dead"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_heartbeat_detects_dead_peer_and_poisons():
+    """A peer dying between collectives: the heartbeat monitor's send
+    fails at the kernel (RST/EPIPE), the peer is declared dead, the
+    group is latched with a ClusterAbort naming the rank, and the main
+    thread surfaces it at its next group operation."""
+    from thrill_tpu.net.heartbeat import HeartbeatMonitor
+    g0, g1, a, b = _socketpair_group_pair()
+    try:
+        mon = HeartbeatMonitor(g0, 0.05).start()
+        time.sleep(0.15)
+        b.close()                    # rank 1 dies, no goodbye
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and g0._pending_abort is None:
+            time.sleep(0.05)
+        mon.stop()
+        assert g0._pending_abort is not None, \
+            "heartbeat monitor never noticed the dead peer"
+        assert "rank 1" in g0._pending_abort.cause
+        with pytest.raises(ClusterAbort):
+            g0.send_to(1, "next-collective-frame")
+    finally:
+        a.close()
+
+
+def test_poison_peers_bounded_send_cannot_hang():
+    """Satellite invariant: poisoning a peer whose socket buffer is
+    FULL (wedged, not draining) must return within the bounded send
+    deadline instead of hanging the aborting worker."""
+    a, b = socket.socketpair()
+    ca = TcpConnection(a)
+    g0 = TcpGroup(0, 2, {1: ca})
+    try:
+        # fill the kernel buffers so the next blocking send would park
+        a.setblocking(False)
+        try:
+            while True:
+                a.send(b"\xee" * 65536)
+        except BlockingIOError:
+            pass
+        a.setblocking(True)
+        t0 = time.monotonic()
+        notified = g0.poison_peers("unrecoverable error")
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10.0, \
+            f"poison_peers blocked {elapsed:.1f}s on a full buffer"
+        assert notified == 0         # skipped, not hung
+        assert faults.REGISTRY.stats()["aborts"] >= 1
+    finally:
+        a.close()
+        b.close()
 
 
 def test_injected_timer_fault_keeps_timer_armed():

@@ -27,6 +27,7 @@ __version__ = "0.1.0"
 #: stays light
 _API_NAMES = ("Bind", "Context", "DIA", "FieldReduce", "Run",
               "RunDistributed", "RunLocalMock", "RunLocalTests",
+              "RunSupervised",
               "Concat", "InnerJoin", "Merge", "Union", "Zip",
               "ZipWindow")
 

@@ -37,7 +37,8 @@ class Context:
 
     def __init__(self, mesh_exec: Optional[MeshExec] = None,
                  config: Optional[Config] = None, seed: int = 0,
-                 host_rank: Optional[int] = None) -> None:
+                 host_rank: Optional[int] = None,
+                 resume: bool = False) -> None:
         self.config = config or Config.from_env()
         from ..common.config import DEFAULT_COMPILE_CACHE
         cc = self.config.compile_cache
@@ -96,6 +97,20 @@ class Context:
         self._mem_lock = threading.Lock()
         self.rng = np.random.default_rng(seed)
         self._nodes: List[Any] = []
+        # coordinated-abort latch: set by abort() (and by close() when
+        # an abort-class exception is in flight) so cleanup never runs
+        # collectives against dead peers and leaked run files get swept
+        self._aborted = False
+        # checkpoint/resume subsystem (api/checkpoint.py): fully off —
+        # ctx.checkpoint stays None, the stage driver pays one
+        # attribute read — unless THRILL_TPU_CKPT_DIR is set
+        self.checkpoint = None
+        if self.config.ckpt_dir:
+            from .checkpoint import CheckpointManager
+            self.checkpoint = CheckpointManager(
+                self, self.config.ckpt_dir,
+                resume=resume or self.config.resume,
+                auto=self.config.ckpt_auto)
         self._profiler = None
         if self.config.profile and self.logger.enabled:
             from ..common.profile import ProfileThread
@@ -297,10 +312,14 @@ class Context:
             # (common/faults.py)
             "join_overflow_retries": mex.stats_join_overflow_retries,
         }
+        # durability layer (api/checkpoint.py): epochs committed, bytes
+        # sealed, ops skipped by resume, time spent restoring
+        if self.checkpoint is not None:
+            stats.update(self.checkpoint.stats())
         from ..common import faults
         stats.update({k: v - self._faults_base.get(k, 0)
                       for k, v in faults.REGISTRY.stats().items()})
-        if self.net.num_workers > 1:
+        if self.net.num_workers > 1 and not self._aborted:
             per_host = self.net.all_gather(stats)
             # almost every counter is a per-controller view of one
             # global value (exchange stats derive from the replicated
@@ -308,9 +327,9 @@ class Context:
             # logical graph) — take host 0's copy, don't sum. Only the
             # host-process-local peaks (and the per-process fault/
             # retry/abort counters) genuinely differ across hosts.
-            local_peaks = {"host_mem_peak"}
+            local_peaks = {"host_mem_peak", "recovery_time_s"}
             local_sums = {"faults_injected", "retries", "recoveries",
-                          "aborts"}
+                          "aborts", "ckpt_bytes_written"}
             stats = {
                 k: (max(h[k] for h in per_host) if k in local_peaks
                     else sum(h.get(k, 0) for h in per_host)
@@ -326,6 +345,7 @@ class Context:
         deadline — no cascade of secondary timeouts), then raise it
         locally."""
         from ..net.group import ClusterAbort
+        self._aborted = True
         if self.net.num_workers > 1:
             self.net.group.poison_peers(cause)
         if isinstance(cause, BaseException):
@@ -349,13 +369,59 @@ class Context:
             print(f"{label}: mean {mean:.6g} stdev {stdev:.6g} over "
                   f"{self.net.num_workers} hosts", flush=True)
 
+    def note_failure(self, exc: BaseException) -> None:
+        """Called by the run wrappers with an exception PROPAGATING out
+        of the job (not sniffed from sys.exc_info(), which would also
+        see exceptions merely being handled further up the stack — a
+        successful nested retry Run inside an ``except ClusterAbort``
+        must not shut down as aborted). A framework-owned abort
+        (poisoned group, hung collective) switches close() to the
+        aborted shutdown: no collectives against dead peers, sweep the
+        run's leaked artifacts. Deliberately narrow: a user job's own
+        ConnectionError/TimeoutError must NOT skip the collective
+        shutdown the other ranks are entering (the detectors —
+        watchdog, heartbeat, poison frames — convert real worker loss
+        into ClusterAbort)."""
+        from ..net.group import ClusterAbort, CollectiveHangTimeout
+        if isinstance(exc, (ClusterAbort, CollectiveHangTimeout)):
+            self._aborted = True
+
     def close(self) -> None:
+        from ..net.group import ClusterAbort
+        # an abort DISCOVERED during close itself (heartbeat latch, or
+        # a peer's poison frame surfacing in the stats collective) must
+        # complete the cleanup AND still surface: a surviving rank
+        # whose job body already finished would otherwise exit 0 and a
+        # supervisor would relaunch only the dead rank — stranding it
+        # in bootstrap against a rank that never comes back
+        discovered: Optional[BaseException] = None
+        # a dead-peer verdict latched by the background heartbeat
+        # monitor (net/heartbeat.py mark_dead) may arrive with NO
+        # exception in flight (the job finished between collectives):
+        # entering the stats all_gather would raise it mid-close and
+        # skip all cleanup — honor the latch up front instead
+        pending = getattr(self.net.group, "_pending_abort", None)
+        if pending is not None:
+            if not self._aborted:
+                discovered = pending
+            self._aborted = True
         if self._profiler is not None:
             self._profiler.stop()
         # overall_stats() is a COLLECTIVE in multi-host runs: every host
         # must enter it regardless of its local logger setting, or
         # all_gather and barrier traffic would interleave across hosts
-        stats = self.overall_stats()
+        # (after an abort it degrades to the local view — see the
+        # _aborted guard inside). A PEER's abort can surface right
+        # here (its poison frame arrives in our stats all_gather even
+        # though our own job succeeded) — degrade to the local view
+        # instead of letting the abort skip the rest of the cleanup.
+        try:
+            stats = self.overall_stats()
+        except (ClusterAbort, ConnectionError, TimeoutError) as e:
+            if not self._aborted:
+                discovered = e
+            self._aborted = True
+            stats = self.overall_stats()      # local, collective-free
         if self.logger.enabled:
             self.logger.line(event="overall_stats", **stats)
         from ..common import faults
@@ -363,9 +429,32 @@ class Context:
             faults.REGISTRY.set_logger(None)
         self.logger.close()
         self.hbm.close()
+        if self._aborted:
+            # leaked-artifact hygiene: uncommitted epoch of THIS run,
+            # plus spill files whose owning process is gone (a
+            # kill -9'd worker cannot clean up after itself)
+            if self.checkpoint is not None:
+                self.checkpoint.abort_cleanup()
+            from ..data.block_pool import purge_stale_spills
+            purge_stale_spills(self.config.spill_dir)
         if self.net.num_workers > 1:
-            self.net.barrier()
+            if not self._aborted:
+                try:
+                    self.net.barrier()
+                except (ClusterAbort, ConnectionError,
+                        TimeoutError) as e:
+                    # a dying peer must not block shutdown, but the
+                    # loss must still surface (see ``discovered``)
+                    if discovered is None:
+                        discovered = e
             self.net.group.close()
+        if discovered is not None:
+            # re-raise ONLY when no other exception is propagating
+            # (close() runs in a finally: raising over an in-flight
+            # error would mask the real root cause)
+            import sys
+            if sys.exc_info()[1] is None:
+                raise discovered
 
 
 # ----------------------------------------------------------------------
@@ -373,15 +462,53 @@ class Context:
 # ----------------------------------------------------------------------
 
 def Run(job: Callable[[Context], Any], config: Optional[Config] = None,
-        devices: Optional[Sequence[Any]] = None, seed: int = 0) -> Any:
-    """Run a job on all (or the configured number of) local devices."""
+        devices: Optional[Sequence[Any]] = None, seed: int = 0,
+        resume: bool = False) -> Any:
+    """Run a job on all (or the configured number of) local devices.
+
+    ``resume=True`` (or ``THRILL_TPU_RESUME=1``) restores the newest
+    complete checkpoint epoch from ``THRILL_TPU_CKPT_DIR`` and replays
+    only post-checkpoint work (api/checkpoint.py)."""
     mex = MeshExec(devices=devices,
                    num_workers=(config or Config.from_env()).num_workers)
-    ctx = Context(mex, config, seed)
+    ctx = Context(mex, config, seed, resume=resume)
     try:
         return job(ctx)
+    except BaseException as e:
+        ctx.note_failure(e)
+        raise
     finally:
         ctx.close()
+
+
+def RunSupervised(job: Callable[[Context], Any],
+                  config: Optional[Config] = None,
+                  devices: Optional[Sequence[Any]] = None, seed: int = 0,
+                  max_restarts: int = 2) -> Any:
+    """Run with supervised re-execution: an abort-class failure
+    (ClusterAbort from a poisoned/hung group, transport loss, timeout)
+    tears the run down and relaunches the SAME job with resume enabled,
+    so a committed checkpoint epoch bounds the recomputation. The
+    multi-process analog lives in run-scripts/supervise.sh (process
+    relaunch); this is the in-process form for single-controller jobs
+    and tests."""
+    from ..common import faults
+    from ..net.group import ClusterAbort
+    attempt = 0
+    while True:
+        try:
+            return Run(job, config, devices, seed,
+                       resume=attempt > 0)
+        except (ClusterAbort, ConnectionError, TimeoutError) as e:
+            if attempt >= max_restarts:
+                raise
+            attempt += 1
+            faults.note("recovery", what="supervised_restart",
+                        attempt=attempt, error=repr(e))
+            import sys
+            print(f"thrill_tpu: supervised restart {attempt}/"
+                  f"{max_restarts} after {e!r} (resume=True)",
+                  file=sys.stderr)
 
 
 def RunLocalMock(job: Callable[[Context], Any], workers: int,
@@ -396,6 +523,9 @@ def RunLocalMock(job: Callable[[Context], Any], workers: int,
     ctx = Context(mex, config, seed)
     try:
         return job(ctx)
+    except BaseException as e:
+        ctx.note_failure(e)
+        raise
     finally:
         ctx.close()
 
@@ -404,7 +534,8 @@ def RunDistributed(job: Callable[[Context], Any],
                    coordinator_address: Optional[str] = None,
                    num_processes: Optional[int] = None,
                    process_id: Optional[int] = None,
-                   config: Optional[Config] = None) -> Any:
+                   config: Optional[Config] = None,
+                   resume: bool = False) -> Any:
     """Multi-host entry point: the mesh spans every host's devices.
 
     The reference reaches multiple hosts through its tcp/mpi backends
@@ -443,9 +574,13 @@ def RunDistributed(job: Callable[[Context], Any],
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id, **kw)
     mex = MeshExec(devices=jax.devices())
-    ctx = Context(mex, config, host_rank=process_id or 0)
+    ctx = Context(mex, config, host_rank=process_id or 0,
+                  resume=resume)
     try:
         return job(ctx)
+    except BaseException as e:
+        ctx.note_failure(e)
+        raise
     finally:
         ctx.close()
 

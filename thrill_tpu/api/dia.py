@@ -183,6 +183,16 @@ class DIA:
         from .ops import cache as _ca
         return _ca.Collapse(self)
 
+    def Checkpoint(self, name: Optional[str] = None) -> "DIA":
+        """Materialize here and seal the result into a durable epoch
+        (api/checkpoint.py) when ``THRILL_TPU_CKPT_DIR`` is set; a
+        resumed run (``resume=True`` / ``THRILL_TPU_RESUME=1``) reloads
+        the newest committed epoch and skips this node's entire
+        upstream subgraph. Without a checkpoint dir this is a plain
+        materialization barrier (Cache-like)."""
+        from .checkpoint import make_checkpoint_node
+        return make_checkpoint_node(self, name)
+
     def Execute(self) -> "DIA":
         self.node.materialize()
         return self

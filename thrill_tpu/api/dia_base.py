@@ -119,6 +119,17 @@ class DIABase:
         cached, fusion on), else materialize normally. Returns a
         FusionPlan (deferred) or Shards."""
         from . import fusion
+        mgr = getattr(self.context, "checkpoint", None)
+        if mgr is not None and self.state == NEW and (
+                mgr.restorable(self) or (mgr.auto and self.parents)):
+            # resume: this node's state is on disk — restoring beats
+            # deferring into a fused dispatch that would recompute the
+            # whole upstream subgraph. Auto-checkpoint mode likewise
+            # forces materialization: an epoch can only seal
+            # MATERIALIZED shards, so every DOp becomes a durable
+            # stage barrier (the documented fusion tradeoff of
+            # THRILL_TPU_CKPT_AUTO).
+            return self.materialize(consume=consume)
         if (fusion.enabled() and consume and self._shards is None
                 and self.state == NEW and self.consume_budget <= 1
                 and type(self).compute_plan is not DIABase.compute_plan):
@@ -154,16 +165,28 @@ class DIABase:
                 log.line(event="node_execute_start", node=self.label,
                          dia_id=self.id,
                          parents=[p.node.id for p in self.parents])
-            # stage memory negotiation: EM operators get a host-RAM
-            # grant split among concurrently computing max-requesters
-            # (nested pulls, e.g. recursive DC3 sorts, shrink the inner
-            # grants exactly like the reference's per-stage split)
-            negotiated = self.context.negotiate_mem(self)
-            try:
-                self._shards = self.compute()
-            finally:
-                if negotiated:
-                    self.context.release_mem(self)
+            # resume path (api/checkpoint.py): a committed epoch holds
+            # this node's shards — rebuild them instead of computing,
+            # and the pull recursion never touches the upstream graph
+            mgr = getattr(self.context, "checkpoint", None)
+            restored = mgr.try_restore(self) if mgr is not None else None
+            if restored is not None:
+                self._shards = restored
+            else:
+                # stage memory negotiation: EM operators get a host-RAM
+                # grant split among concurrently computing
+                # max-requesters (nested pulls, e.g. recursive DC3
+                # sorts, shrink the inner grants exactly like the
+                # reference's per-stage split)
+                negotiated = self.context.negotiate_mem(self)
+                try:
+                    self._shards = self.compute()
+                finally:
+                    if negotiated:
+                        self.context.release_mem(self)
+                if mgr is not None:
+                    # stage-barrier auto-checkpoint (opt-in)
+                    mgr.maybe_autosave(self, self._shards)
             self.state = EXECUTED
             if not (consume and self.consume_budget <= 1):
                 # a result released by this very pull is never worth

@@ -89,6 +89,16 @@ class Config:
     # The DEFAULT auto-enables off-CPU only; an explicit non-default
     # value is honored on every backend (api/context.py).
     compile_cache: str = DEFAULT_COMPILE_CACHE
+    # Durable checkpoint directory (api/checkpoint.py). Empty = the
+    # whole checkpoint/resume subsystem is OFF (zero overhead, zero
+    # behavior change — asserted by tests/api/test_checkpoint.py).
+    ckpt_dir: str = ""
+    # Resume from the newest complete checkpoint epoch on startup
+    # (THRILL_TPU_RESUME=1; Run()/RunDistributed(resume=True) override).
+    resume: bool = False
+    # Auto-checkpoint every materialized DOp stage barrier, not just
+    # explicit dia.Checkpoint() calls (THRILL_TPU_CKPT_AUTO=1).
+    ckpt_auto: bool = False
 
     @staticmethod
     def from_env() -> "Config":
@@ -108,6 +118,9 @@ class Config:
             profile=bool(_env_int("THRILL_TPU_PROFILE", 0)),
             compile_cache=_env_str("THRILL_TPU_COMPILE_CACHE",
                                    DEFAULT_COMPILE_CACHE),
+            ckpt_dir=_env_str("THRILL_TPU_CKPT_DIR", "") or "",
+            resume=bool(_env_int("THRILL_TPU_RESUME", 0)),
+            ckpt_auto=bool(_env_int("THRILL_TPU_CKPT_AUTO", 0)),
         )
 
 

@@ -10,6 +10,7 @@ Falls back to a pure-Python dict store when no compiler is available.
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 from typing import Dict, Optional
 
@@ -203,6 +204,57 @@ class BlockPool:
             self.close()
         except Exception:
             pass
+
+
+def purge_stale_spills(spill_dir: str) -> int:
+    """Remove spill files abandoned by DEAD processes.
+
+    The native store names its files ``ttpu-blk-<pid>-<store>-<id>-
+    <host>.spill`` and unlinks them in its destructor — but a kill
+    -9'd or aborted worker never runs destructors, leaking its spills
+    into the shared spill dir. Context.close() calls this after an
+    abort (and supervised relaunches inherit a clean dir): files whose
+    owning pid no longer exists ON THIS HOST are reclaimed; files
+    written by OTHER hosts (a spill dir on shared storage) are never
+    judged — a local pid probe says nothing about a remote process.
+    Returns the number removed."""
+    import glob as _glob
+    import socket as _socket
+    # ASCII-only sanitization, matching the C-locale std::isalnum the
+    # native writer uses — a non-ASCII hostname must map identically
+    # on both sides or the host tag never matches
+    my_host = "".join(c if (c.isascii() and c.isalnum()) else "_"
+                      for c in _socket.gethostname()) or "unknown"
+    removed = 0
+    for path in _glob.glob(os.path.join(spill_dir, "ttpu-blk-*.spill")):
+        parts = os.path.basename(path)[:-len(".spill")].split("-")
+        try:
+            pid = int(parts[2])
+            host = "-".join(parts[5:])
+        except (IndexError, ValueError):
+            continue                   # legacy/foreign name: leave it
+        if host != my_host:
+            continue                   # another host's file: not ours
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)
+            continue                   # owner is alive
+        except ProcessLookupError:
+            pass                       # owner is gone: reclaim
+        except PermissionError:
+            continue                   # alive, other user
+        except OSError:
+            continue
+        try:
+            os.unlink(path)
+            removed += 1
+        except OSError:
+            pass
+    if removed:
+        faults.note("recovery", what="spill.purge_stale",
+                    removed=removed, dir=spill_dir)
+    return removed
 
 
 def scan_line_offsets(data: bytes, max_lines: int = 1 << 22):

@@ -42,6 +42,38 @@ def deserialize_batch(data: bytes) -> List[Any]:
     return pickle.loads(payload)
 
 
+def serialize_leaves(leaves: List[np.ndarray]) -> bytes:
+    """Serialize an ordered list of numpy leaf arrays into one payload
+    (length-prefixed :func:`serialize_batch` per leaf, so every leaf
+    keeps the RAW fixed-size fast path regardless of dtype/shape
+    differences between leaves). The checkpoint layer
+    (api/checkpoint.py) stores one such payload per (node, worker)."""
+    parts = [struct.pack("<I", len(leaves))]
+    for leaf in leaves:
+        payload = serialize_batch([np.ascontiguousarray(leaf)])
+        parts.append(struct.pack("<Q", len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def deserialize_leaves(data: bytes) -> List[np.ndarray]:
+    """Inverse of :func:`serialize_leaves`."""
+    (n,) = struct.unpack_from("<I", data, 0)
+    pos = 4
+    leaves: List[np.ndarray] = []
+    for _ in range(n):
+        (plen,) = struct.unpack_from("<Q", data, pos)
+        pos += 8
+        batch = deserialize_batch(data[pos:pos + plen])
+        pos += plen
+        if len(batch) != 1:
+            raise ValueError(
+                f"corrupt leaf payload: {len(batch)} items in a "
+                f"1-item batch")
+        leaves.append(np.asarray(batch[0]))
+    return leaves
+
+
 def deserialize_slice(data: bytes, lo: int, hi: int) -> List[Any]:
     """Decode only items [lo, hi) of a batch payload.
 

@@ -21,6 +21,8 @@ from __future__ import annotations
 import abc
 import contextlib
 import operator
+import os
+import time
 from typing import Any, Callable, List, Optional
 
 from ..common import faults
@@ -28,6 +30,39 @@ from ..common import faults
 #: magic key of a poison control frame (a plain dict so it passes the
 #: non-executing wire codec unauthenticated)
 POISON_KEY = "__thrill_tpu_poison__"
+
+#: magic key of a heartbeat frame (net/heartbeat.py): liveness chatter
+#: multiplexed over the same connections — transports discard it before
+#: it can reach a collective's payload stream
+HEARTBEAT_KEY = "__thrill_tpu_hb__"
+
+#: injectable hang: an armed fire at this site makes the next blocking
+#: collective recv behave as if its deadline expired with no frame —
+#: the watchdog's abort path runs for real, no actual wedged peer needed
+_F_HANG = faults.declare("net.group.recv_hang")
+
+#: heartbeat-probe site (checked per heartbeat send, net/heartbeat.py)
+F_HEARTBEAT = faults.declare("net.heartbeat",
+                             exc=faults.InjectedConnectionError)
+
+
+class CollectiveHangTimeout(TimeoutError):
+    """A blocking collective recv exceeded THRILL_TPU_HANG_TIMEOUT_S
+    with no frame from the peer: the collective is wedged. Raised by
+    transports (tcp/mock); the Group watchdog converts it into a
+    ClusterAbort naming the collective and the silent peer rank."""
+
+
+def hang_timeout_s() -> Optional[float]:
+    """Collective-recv watchdog deadline (None = watchdog off — the
+    default: a healthy slow peer must never be declared hung unless
+    the operator opted into a bound)."""
+    v = os.environ.get("THRILL_TPU_HANG_TIMEOUT_S", "")
+    try:
+        t = float(v)
+    except ValueError:
+        return None
+    return t if t > 0 else None
 
 
 class ClusterAbort(ConnectionError):
@@ -53,6 +88,21 @@ class Connection(abc.ABC):
     @abc.abstractmethod
     def recv(self) -> Any: ...
 
+    def recv_deadline(self, deadline_s: float) -> Any:
+        """Receive one message, raising :class:`CollectiveHangTimeout`
+        after ``deadline_s`` with no complete frame. Transports without
+        timed receives fall back to a plain blocking recv (the watchdog
+        then covers only transports that implement it)."""
+        return self.recv()
+
+    def send_bounded(self, obj: Any, deadline_s: float) -> None:
+        """Send with a bounded blocking time, raising TimeoutError on
+        expiry. Used by the abort protocol: poisoning a peer whose
+        socket buffer is full must not hang the aborting worker. The
+        default delegates to plain send (queue-backed transports never
+        block)."""
+        self.send(obj)
+
 
 class Group(abc.ABC):
     """A p-way clique of connections; my_rank in [0, num_hosts)."""
@@ -65,6 +115,13 @@ class Group(abc.ABC):
         # abort on a surviving group still relays): keys added by
         # poison_peers and by received poison frames
         self._poison_relayed: set = set()
+        # failure detector state: which collective the caller is inside
+        # (named in hang-abort causes), last heartbeat seen per peer,
+        # and an abort latched by the background heartbeat monitor for
+        # the main thread to surface at its next group operation
+        self._collective_site: str = ""
+        self._hb_last: dict = {}
+        self._pending_abort: Optional[ClusterAbort] = None
 
     @property
     def num_hosts(self) -> int:
@@ -74,14 +131,91 @@ class Group(abc.ABC):
     def connection(self, peer: int) -> Connection: ...
 
     def send_to(self, peer: int, obj: Any) -> None:
+        self._check_pending_abort()
         self.connection(peer).send(obj)
+
+    @contextlib.contextmanager
+    def _at(self, site: str):
+        """Name the collective in flight so a hang-abort cause can say
+        WHERE the group wedged, not just that it did."""
+        prev = self._collective_site
+        self._collective_site = site
+        try:
+            yield
+        finally:
+            self._collective_site = prev
+
+    def _check_pending_abort(self) -> None:
+        ab = self._pending_abort
+        if ab is not None:
+            raise ab
+
+    def mark_dead(self, peer: int, cause: str) -> None:
+        """Failure-detector verdict (net/heartbeat.py): ``peer`` is
+        unreachable. Latch an abort for the main thread, poison the
+        surviving peers so the whole group converts to fast attributable
+        aborts instead of a cascade of timeouts."""
+        ab = ClusterAbort(self.my_rank, cause)
+        if self._pending_abort is None:
+            self._pending_abort = ab
+        try:
+            self.poison_peers(cause)
+        except Exception:
+            pass
 
     def recv_from(self, peer: int) -> Any:
         """Receive one message; a poison control frame surfaces as
         :class:`ClusterAbort` carrying the originator's root cause
         (reference has no analog — a dead peer hangs its job until the
-        runtime kills it, api/context.cpp:849-878)."""
-        obj = self.connection(peer).recv()
+        runtime kills it, api/context.cpp:849-878).
+
+        Collective watchdog: with ``THRILL_TPU_HANG_TIMEOUT_S`` set,
+        a recv that sees no frame within the deadline poisons the
+        group with a ClusterAbort naming the collective and the silent
+        peer rank — a wedged collective becomes a fast, attributable
+        abort a supervising re-launch can resume from."""
+        self._check_pending_abort()
+        deadline = hang_timeout_s()
+        # the deadline is ABSOLUTE across heartbeat-filter iterations:
+        # liveness chatter proves the peer process is alive but does
+        # not excuse a wedged collective (same semantics as the tcp
+        # transport's internal filter, TcpConnection._recv_msg)
+        deadline_at = (None if deadline is None
+                       else time.monotonic() + deadline)
+        site = self._collective_site or "recv"
+        while True:
+            try:
+                if faults.REGISTRY.active():
+                    try:
+                        faults.check(_F_HANG, peer=peer, site=site)
+                    except faults.InjectedFault:
+                        raise CollectiveHangTimeout(
+                            "injected wedge") from None
+                conn = self.connection(peer)
+                if deadline_at is None:
+                    obj = conn.recv()
+                else:
+                    remaining = deadline_at - time.monotonic()
+                    if remaining <= 0:
+                        raise CollectiveHangTimeout("deadline spent")
+                    obj = conn.recv_deadline(remaining)
+            except CollectiveHangTimeout:
+                cause = (f"hang at {site}: rank {self.my_rank} "
+                         f"received no frame from rank {peer} within "
+                         f"{deadline if deadline is not None else 0}s "
+                         f"(THRILL_TPU_HANG_TIMEOUT_S)")
+                try:
+                    self.poison_peers(cause)
+                except Exception:
+                    pass
+                raise ClusterAbort(self.my_rank, cause) from None
+            if isinstance(obj, dict) and HEARTBEAT_KEY in obj:
+                # liveness chatter from a transport without its own
+                # filter (mock queues): note it, keep waiting for the
+                # payload on the SAME deadline budget
+                self._hb_last[peer] = time.monotonic()
+                continue
+            break
         if isinstance(obj, dict) and POISON_KEY in obj:
             info = obj[POISON_KEY]
             origin = int(info.get("origin", peer))
@@ -120,6 +254,14 @@ class Group(abc.ABC):
         self._poison_relayed.add((org, _cause_str(cause)))
         frame = {POISON_KEY: {"origin": org,
                               "cause": _cause_str(cause)}}
+        # bounded send deadline (common/timeouts.py load scaling): a
+        # peer that stopped draining its socket (wedged, descheduled,
+        # dying) can have a FULL kernel buffer — a blocking send of the
+        # poison frame would then hang the aborting worker itself.
+        # Past the deadline that peer is skipped; it still learns the
+        # cause from another rank's relay or its own recv deadline.
+        from ..common.timeouts import scaled
+        deadline = min(scaled(1.0), 5.0)
         notified = 0
         for peer in range(self.num_hosts):
             if peer == self.my_rank:
@@ -129,8 +271,8 @@ class Group(abc.ABC):
                 # frames already queued to a DEAD peer and hang the
                 # abort itself. Dispatcher-attached connections drain
                 # the queued poison frame asynchronously; blocking
-                # connections wrote it synchronously in send().
-                self.connection(peer).send(frame)
+                # connections wrote it synchronously in send_bounded().
+                self.connection(peer).send_bounded(frame, deadline)
                 notified += 1
             except Exception:
                 continue
@@ -152,13 +294,14 @@ class Group(abc.ABC):
         r = self.my_rank
         acc = value        # running sum of [r - 2^k + 1 .. r]
         d = 1
-        while d < p:
-            if r + d < p:
-                self.send_to(r + d, acc)
-            if r - d >= 0:
-                received = self.recv_from(r - d)
-                acc = op(received, acc)
-            d <<= 1
+        with self._at("prefix_sum"):
+            while d < p:
+                if r + d < p:
+                    self.send_to(r + d, acc)
+                if r - d >= 0:
+                    received = self.recv_from(r - d)
+                    acc = op(received, acc)
+                d <<= 1
         return acc
 
     def _shift_right(self, incl: Any, op: Callable, initial: Any) -> Any:
@@ -168,11 +311,13 @@ class Group(abc.ABC):
         ``initial``, rank r returns op(initial, incl[r-1])."""
         p = self.num_hosts
         r = self.my_rank
-        if r + 1 < p:
-            self.send_to(r + 1, incl)
-        if r > 0:
-            received = self.recv_from(r - 1)
-            return received if initial is None else op(initial, received)
+        with self._at("ex_prefix_sum"):
+            if r + 1 < p:
+                self.send_to(r + 1, incl)
+            if r > 0:
+                received = self.recv_from(r - 1)
+                return received if initial is None \
+                    else op(initial, received)
         return initial
 
     def ex_prefix_sum(self, value: Any, op: Callable = operator.add,
@@ -192,12 +337,13 @@ class Group(abc.ABC):
         # binomial tree: parent = vr - lowbit(vr); children = vr + d for
         # powers of two d < lowbit(vr) (root: all d < p)
         lowbit = vr & -vr if vr != 0 else p
-        if vr != 0:
-            value = self.recv_from(((vr - lowbit) + origin) % p)
-        d = 1
-        while d < lowbit and vr + d < p:
-            self.send_to((vr + d + origin) % p, value)
-            d <<= 1
+        with self._at("broadcast"):
+            if vr != 0:
+                value = self.recv_from(((vr - lowbit) + origin) % p)
+            d = 1
+            while d < lowbit and vr + d < p:
+                self.send_to((vr + d + origin) % p, value)
+                d <<= 1
         return value
 
     def all_gather(self, value: Any) -> List[Any]:
@@ -210,11 +356,12 @@ class Group(abc.ABC):
         r = self.my_rank
         items: List[Any] = [value]
         d = 1
-        while len(items) < p:
-            cnt = min(d, p - len(items))
-            self.send_to((r - d) % p, items[:cnt])
-            items.extend(self.recv_from((r + d) % p))
-            d <<= 1
+        with self._at("all_gather"):
+            while len(items) < p:
+                cnt = min(d, p - len(items))
+                self.send_to((r - d) % p, items[:cnt])
+                items.extend(self.recv_from((r + d) % p))
+                d <<= 1
         # Bruck leaves items rotated: items[i] belongs to rank (r + i) % p.
         out: List[Any] = [None] * p
         for i, it in enumerate(items):
@@ -228,14 +375,15 @@ class Group(abc.ABC):
         vr = (self.my_rank - root) % p
         acc = value
         d = 1
-        while d < p:
-            if (vr & d) != 0:
-                self.send_to(((vr - d) + root) % p, acc)
-                return None
-            if vr + d < p:
-                other = self.recv_from(((vr + d) + root) % p)
-                acc = op(acc, other)
-            d <<= 1
+        with self._at("reduce"):
+            while d < p:
+                if (vr & d) != 0:
+                    self.send_to(((vr - d) + root) % p, acc)
+                    return None
+                if vr + d < p:
+                    other = self.recv_from(((vr + d) + root) % p)
+                    acc = op(acc, other)
+                d <<= 1
         return acc if vr == 0 else None
 
     def all_reduce(self, value: Any, op: Callable = operator.add) -> Any:
@@ -249,28 +397,29 @@ class Group(abc.ABC):
         p = self.num_hosts
         r = self.my_rank
         pp = 1 << (p.bit_length() - 1)      # largest power of two <= p
-        if pp == p:
-            return self._hypercube_all_reduce(value, op, p, r)
-        # ADJACENT ranks pair up (2i folds 2i+1), so the virtual-rank
-        # order equals the global rank order and non-commutative
-        # (associative) ops still combine left-to-right
-        extras = p - pp
-        if r < 2 * extras:
-            if r % 2 == 1:                   # eliminated: partner computes
-                self.send_to(r - 1, value)
-                return self.recv_from(r - 1)
-            acc = op(value, self.recv_from(r + 1))
-            vr = r // 2
-        else:
-            acc = value
-            vr = r - extras
+        with self._at("all_reduce"):
+            if pp == p:
+                return self._hypercube_all_reduce(value, op, p, r)
+            # ADJACENT ranks pair up (2i folds 2i+1), so the virtual-
+            # rank order equals the global rank order and non-
+            # commutative (associative) ops still combine left-to-right
+            extras = p - pp
+            if r < 2 * extras:
+                if r % 2 == 1:           # eliminated: partner computes
+                    self.send_to(r - 1, value)
+                    return self.recv_from(r - 1)
+                acc = op(value, self.recv_from(r + 1))
+                vr = r // 2
+            else:
+                acc = value
+                vr = r - extras
 
-        def to_real(v: int) -> int:
-            return 2 * v if v < extras else v + extras
+            def to_real(v: int) -> int:
+                return 2 * v if v < extras else v + extras
 
-        acc = self._hypercube_all_reduce(acc, op, pp, vr, to_real)
-        if r < 2 * extras:                   # fan the result back
-            self.send_to(r + 1, acc)
+            acc = self._hypercube_all_reduce(acc, op, pp, vr, to_real)
+            if r < 2 * extras:               # fan the result back
+                self.send_to(r + 1, acc)
         return acc
 
     def _hypercube_all_reduce(self, acc: Any, op: Callable, p: int,

@@ -1,0 +1,133 @@
+"""Liveness heartbeats multiplexed over the group's connections.
+
+The collective watchdog (net/group.py recv deadlines) only fires while
+a rank is *blocked in a recv* — a worker that died between collectives
+would go unnoticed until the next one wedges. This monitor closes that
+gap: a background thread sends a tiny heartbeat frame to every peer on
+a fixed cadence over the SAME authenticated connections the
+collectives use (transports filter the frames out before they can
+reach a payload stream — tcp: ``TcpConnection._recv_msg``; any other
+transport: ``Group.recv_from``).
+
+A heartbeat send that still fails after the shared retry policy means
+the kernel reported the peer's socket dead (RST/EPIPE — the OS-level
+verdict on a kill -9'd process): the monitor latches a
+:class:`~thrill_tpu.net.group.ClusterAbort` on the group naming the
+dead rank and poisons the surviving peers, converting silent worker
+loss into a fast, attributable abort that a supervising re-launch
+(run-scripts/supervise.sh + checkpoint resume) can recover from.
+
+Opt-in via ``THRILL_TPU_HEARTBEAT_S=<seconds>`` (off by default: the
+control plane's frame streams stay byte-identical to the
+pre-heartbeat protocol unless the operator asks for liveness).
+Injection site ``net.heartbeat`` (common/faults.py) rides every probe:
+a transient fire is absorbed by the retry policy, a persistent one
+exercises the real dead-peer verdict path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..common import faults
+from ..common.retry import default_policy
+from .group import F_HEARTBEAT, HEARTBEAT_KEY, Group
+
+
+def heartbeat_interval_s() -> Optional[float]:
+    v = os.environ.get("THRILL_TPU_HEARTBEAT_S", "")
+    try:
+        t = float(v)
+    except ValueError:
+        return None
+    return t if t > 0 else None
+
+
+class HeartbeatMonitor:
+    """Background prober for one Group; one instance per process."""
+
+    def __init__(self, group: Group, interval_s: float) -> None:
+        self.group = group
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seq = 0
+        # one bounded-backoff policy for all probes: a single EAGAIN
+        # blip must not declare a peer dead
+        self._policy = default_policy()
+
+    def start(self) -> "HeartbeatMonitor":
+        if self.group.num_hosts <= 1 or self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name="thrill-tpu-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0 + self.interval_s)
+
+    # -- the probe loop -------------------------------------------------
+    def _run(self) -> None:
+        g = self.group
+        while not self._stop.wait(self.interval_s):
+            self._seq += 1
+            frame = {HEARTBEAT_KEY: {"seq": self._seq,
+                                     "rank": g.my_rank}}
+            for peer in range(g.num_hosts):
+                if peer == g.my_rank or self._stop.is_set():
+                    continue
+                try:
+                    self._probe(peer, frame)
+                except TimeoutError:
+                    # peer not draining but socket alive: slow, not
+                    # dead — the collective watchdog owns that verdict
+                    continue
+                except Exception as e:
+                    cause = (f"heartbeat: rank {peer} is unreachable "
+                             f"({type(e).__name__}: {e}"
+                             f"{self._staleness(peer)}) — worker "
+                             f"presumed dead")
+                    faults.note("recovery", what="heartbeat.peer_dead",
+                                peer=peer, error=repr(e))
+                    g.mark_dead(peer, cause)
+                    self._stop.set()
+                    return
+
+    def _staleness(self, peer: int) -> str:
+        """Last inbound heartbeat seen from ``peer``, for the verdict
+        cause: the transports stamp arrival times (TcpConnection.
+        last_heartbeat, Group._hb_last) and this is where they are
+        read."""
+        last = self.group._hb_last.get(peer, 0.0)
+        conn_last = getattr(self.group.connection(peer),
+                            "last_heartbeat", 0.0)
+        last = max(last, conn_last)
+        if not last:
+            return "; no heartbeat ever received from it"
+        return (f"; its last heartbeat was "
+                f"{time.monotonic() - last:.1f}s ago")
+
+    def _probe(self, peer: int, frame: dict) -> None:
+        conn = self.group.connection(peer)
+        bound = max(self.interval_s, 0.25)
+
+        def once():
+            faults.check(F_HEARTBEAT, peer=peer)
+            conn.send_bounded(frame, bound)
+
+        self._policy.run(once, what="net.heartbeat", seed=peer)
+
+
+def maybe_start(group: Group) -> Optional[HeartbeatMonitor]:
+    """Start a monitor when THRILL_TPU_HEARTBEAT_S is set (>0)."""
+    interval = heartbeat_interval_s()
+    if interval is None or group.num_hosts <= 1:
+        return None
+    return HeartbeatMonitor(group, interval).start()

@@ -13,7 +13,7 @@ from __future__ import annotations
 import queue
 from typing import Any, List
 
-from .group import Connection, Group
+from .group import CollectiveHangTimeout, Connection, Group
 
 
 class _MockConnection(Connection):
@@ -26,6 +26,16 @@ class _MockConnection(Connection):
 
     def recv(self) -> Any:
         return self._in.get()
+
+    def recv_deadline(self, deadline_s: float) -> Any:
+        """Timed receive for the collective watchdog (net/group.py) —
+        the mock transport honors THRILL_TPU_HANG_TIMEOUT_S too, so
+        the hang-abort protocol is testable without sockets."""
+        try:
+            return self._in.get(timeout=deadline_s)
+        except queue.Empty:
+            raise CollectiveHangTimeout(
+                "no frame within the recv deadline") from None
 
 
 class MockGroup(Group):

@@ -36,7 +36,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..common import faults
 from ..common.retry import default_policy
 from . import wire
-from .group import Connection, Group
+from .group import (HEARTBEAT_KEY, CollectiveHangTimeout, Connection,
+                    Group)
 
 # Injection sites fire BEFORE any bytes hit the wire, so the internal
 # retry (shared backoff policy) is safe: nothing was transmitted. Real
@@ -110,6 +111,9 @@ class TcpConnection(Connection):
         # async send failure observed outside send() (e.g. during the
         # opportunistic reap in recv); surfaced at the next send/flush
         self._send_error = None
+        # monotonic timestamp of the last heartbeat frame seen on this
+        # connection (net/heartbeat.py liveness chatter)
+        self.last_heartbeat = 0.0
 
     def set_dispatcher_supplier(self, supplier) -> None:
         """Enable lazy attach: ``supplier()`` returns the shared engine
@@ -234,6 +238,97 @@ class TcpConnection(Connection):
             else:
                 self._sendall_parts(bufs)
 
+    def send_bounded(self, obj: Any, deadline_s: float) -> None:
+        """Send one message with a hard bound on blocking time
+        (net/group.py poison_peers, net/heartbeat.py probes: writing
+        to a peer whose socket buffer is full must not hang the
+        caller). Expiry semantics keep the frame stream SAFE for
+        callers on healthy groups: a deadline that fires before any
+        byte hit the wire raises TimeoutError and leaves the stream
+        (and the MAC sequence) exactly as before the call; one that
+        fires mid-frame raises ConnectionError — the stream is torn
+        and the connection must be treated as lost. A deferred async
+        send failure (observed by recv's opportunistic reap) surfaces
+        here like in send(), not silently dropped. A wedged sender
+        already holding the send lock also counts against the
+        deadline."""
+        deadline_at = time.monotonic() + float(deadline_s)
+        if not self._send_lock.acquire(timeout=deadline_s):
+            raise TimeoutError("send_bounded: send lock busy past the "
+                               "deadline")
+        try:
+            if self._send_error is not None:
+                e, self._send_error = self._send_error, None
+                raise e
+            parts = wire.dumps_parts(obj,
+                                     allow_pickle=self.authenticated)
+            total = sum(len(p) for p in parts)
+            bufs = [struct.pack("<I", total), *parts]
+            if self._session_key is not None:
+                # MAC under the CURRENT seq; the counter only advances
+                # once the frame is fully written/enqueued, so a
+                # nothing-sent timeout leaves the stream resumable
+                bufs.append(wire.frame_mac_parts(
+                    self._session_key, self._send_dir, self._send_seq,
+                    parts))
+            if self._disp is not None:
+                # engine-attached: reap completed requests first —
+                # WITHOUT this, a dead peer's failed async writes would
+                # sit unfetched forever (heartbeat probes between
+                # collectives are the only traffic, and recv's
+                # opportunistic reap isn't running), leaving the
+                # failure detector blind and the in-flight queue
+                # growing. A prior write failure raises here — exactly
+                # the dead-peer verdict the prober needs. Then enqueue
+                # only (never block on the in-flight cap — an abort
+                # frame must not wait behind bulk traffic).
+                self._reap_sends(block=False)
+                for b in bufs:
+                    self._enqueue_send(
+                        self._disp.async_write(self.sock, b), len(b))
+                if self._session_key is not None:
+                    self._send_seq += 1
+                return
+            mvs = [memoryview(b).cast("B") for b in bufs]
+            frame_bytes = sum(len(m) for m in mvs)
+            sent = 0
+            self.sock.setblocking(False)
+            try:
+                while mvs:
+                    remaining = deadline_at - time.monotonic()
+                    if remaining <= 0:
+                        if sent == 0:
+                            raise TimeoutError(
+                                "send_bounded: peer not draining "
+                                "(no bytes written)")
+                        raise ConnectionError(
+                            f"send_bounded: frame torn mid-write "
+                            f"({sent}/{frame_bytes} bytes) — "
+                            f"connection unusable")
+                    if not _wait_fd(self.sock, write=True,
+                                    timeout=min(remaining, 0.5)):
+                        continue
+                    try:
+                        nb = self.sock.sendmsg(mvs)
+                    except (BlockingIOError, InterruptedError):
+                        continue
+                    sent += nb
+                    while mvs and nb >= len(mvs[0]):
+                        nb -= len(mvs[0])
+                        mvs.pop(0)
+                    if mvs and nb:
+                        mvs[0] = mvs[0][nb:]
+                if self._session_key is not None:
+                    self._send_seq += 1
+            finally:
+                if self._disp is None:
+                    try:
+                        self.sock.setblocking(True)
+                    except OSError:
+                        pass
+        finally:
+            self._send_lock.release()
+
     # a blocking send making no progress for this long escapes to the
     # async engine (symmetric small-frame exchanges that outgrow both
     # kernel buffers cannot deadlock, whatever the frame size)
@@ -292,33 +387,54 @@ class TcpConnection(Connection):
                 self.sock.setblocking(True)
 
     def recv(self) -> Any:
-        with self._recv_lock:
-            header = self._recv_exact(4)
-            (size,) = struct.unpack("<I", header)
-            payload = self._recv_exact(size)
-            if self._session_key is not None:
-                mac = self._recv_exact(wire._MAC_LEN)
-                want = wire.frame_mac(self._session_key, self._recv_dir,
-                                      self._recv_seq, payload)
-                import hmac as _hmac
-                if not _hmac.compare_digest(mac, want):
-                    raise wire.AuthError("wire: frame MAC mismatch")
-                self._recv_seq += 1
-            obj = wire.loads(payload, allow_pickle=self.authenticated)
-        # opportunistic: drop pins of completed async sends (send/recv
-        # alternate in every collective, so retention stays bounded by
-        # one phase instead of lasting until the next send). A send-
-        # side failure discovered here must NOT discard the received
-        # message — defer it to the next send()/flush()
-        if self._disp is not None and self._send_lock.acquire(
-                blocking=False):
-            try:
-                self._reap_sends(block=False)
-            except ConnectionError as e:
-                self._send_error = e
-            finally:
-                self._send_lock.release()
-        return obj
+        return self._recv_msg(None)
+
+    def recv_deadline(self, deadline_s: float) -> Any:
+        """Timed receive for the collective watchdog (net/group.py):
+        raises :class:`CollectiveHangTimeout` when no complete frame
+        lands within ``deadline_s``. The deadline is ABSOLUTE across
+        the call — heartbeat chatter proves the peer process is alive
+        but does not excuse a wedged collective."""
+        return self._recv_msg(time.monotonic() + float(deadline_s))
+
+    def _recv_msg(self, deadline_at: Optional[float]) -> Any:
+        while True:   # heartbeat frames are liveness chatter, not data
+            with self._recv_lock:
+                header = self._recv_exact(4, deadline_at)
+                (size,) = struct.unpack("<I", header)
+                payload = self._recv_exact(size, deadline_at)
+                if self._session_key is not None:
+                    mac = self._recv_exact(wire._MAC_LEN, deadline_at)
+                    want = wire.frame_mac(self._session_key,
+                                          self._recv_dir,
+                                          self._recv_seq, payload)
+                    import hmac as _hmac
+                    if not _hmac.compare_digest(mac, want):
+                        raise wire.AuthError("wire: frame MAC mismatch")
+                    self._recv_seq += 1
+                obj = wire.loads(payload,
+                                 allow_pickle=self.authenticated)
+            # opportunistic: drop pins of completed async sends (send/
+            # recv alternate in every collective, so retention stays
+            # bounded by one phase instead of lasting until the next
+            # send). A send-side failure discovered here must NOT
+            # discard the received message — defer it to the next
+            # send()/flush()
+            if self._disp is not None and self._send_lock.acquire(
+                    blocking=False):
+                try:
+                    self._reap_sends(block=False)
+                except ConnectionError as e:
+                    self._send_error = e
+                finally:
+                    self._send_lock.release()
+            if isinstance(obj, dict) and HEARTBEAT_KEY in obj:
+                # filtered at the TRANSPORT so every consumer —
+                # collectives, multiplexer bulk frames — stays
+                # oblivious to liveness chatter
+                self.last_heartbeat = time.monotonic()
+                continue
+            return obj
 
     def authenticate(self, secret: bytes, role: str) -> None:
         """Mutual role-bound HMAC challenge-response; raises on
@@ -333,13 +449,31 @@ class TcpConnection(Connection):
             self._recv_dir = b"s>" if role == "client" else b"c>"
         self.authenticated = True
 
-    def _recv_exact(self, n: int) -> bytes:
+    def _recv_exact(self, n: int,
+                    deadline_at: Optional[float] = None) -> bytes:
         if self._disp is not None:
             rid = self._disp.async_read(self.sock, n)
-            self._disp.wait(rid)
+            if deadline_at is None:
+                self._disp.wait(rid)
+            else:
+                remaining = deadline_at - time.monotonic()
+                if remaining <= 0 or self._disp.wait(
+                        rid, remaining) == 0:
+                    raise CollectiveHangTimeout(
+                        f"no frame within the recv deadline "
+                        f"({n} bytes outstanding)")
             return self._disp.fetch(rid)
         chunks = []
         while n > 0:
+            if deadline_at is not None:
+                remaining = deadline_at - time.monotonic()
+                if remaining <= 0:
+                    raise CollectiveHangTimeout(
+                        f"no frame within the recv deadline "
+                        f"({n} bytes outstanding)")
+                if not _wait_fd(self.sock, write=False,
+                                timeout=min(remaining, 0.5)):
+                    continue
             try:
                 b = self.sock.recv(n)
             except (BlockingIOError, InterruptedError):
@@ -386,6 +520,9 @@ class TcpGroup(Group):
         self._disp = None
         self._disp_owned = False
         self._disp_lock = threading.Lock()
+        # liveness prober (net/heartbeat.py); None unless
+        # THRILL_TPU_HEARTBEAT_S is set
+        self._heartbeat = None
 
     def connection(self, peer: int) -> TcpConnection:
         if peer == self.my_rank:
@@ -441,6 +578,9 @@ class TcpGroup(Group):
             c.flush()
 
     def close(self) -> None:
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
         for c in self._conns.values():
             c.close()
         if self._disp is not None and self._disp_owned:
@@ -666,6 +806,11 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
     # tunes the cutover)
     if os.environ.get("THRILL_TPU_ASYNC_NET", "1") != "0":
         group.enable_lazy_async()
+    # liveness heartbeats (net/heartbeat.py, THRILL_TPU_HEARTBEAT_S):
+    # a kill -9'd peer becomes a fast attributable ClusterAbort even
+    # between collectives, instead of a hang at the next one
+    from . import heartbeat
+    group._heartbeat = heartbeat.maybe_start(group)
     return group
 
 

@@ -98,6 +98,7 @@ def render_html(events: List[dict]) -> str:
     profiles = []
     exchanges = []
     fused = []         # fused_dispatch (api/fusion.py program stitching)
+    ckpt = []          # checkpoint / ckpt_restore / resume (durability)
     overall = []       # overall_stats summary lines
     device_xchg: dict = {}   # host -> ordered device-plane exchanges
     memory = []        # hbm_spill / hbm_restore / mem_negotiate / demotion
@@ -134,6 +135,8 @@ def render_html(events: List[dict]) -> str:
             faults.append((t, e))
         elif e.get("event") == "fused_dispatch":
             fused.append(e)
+        elif e.get("event") in ("checkpoint", "ckpt_restore", "resume"):
+            ckpt.append((t, e))
         elif e.get("event") == "overall_stats":
             overall.append(e)
     if device_xchg:
@@ -193,6 +196,7 @@ td.hm {{ min-width: 3em; }}
 {_render_worker_lanes(exchanges, total)}
 {_render_memory_events(memory, total)}
 {_render_fused_dispatches(fused, overall)}
+{_render_checkpoint_events(ckpt, overall)}
 {_render_fault_events(faults)}
 {_render_host_overlay(profiles, total)}
 </body></html>"""
@@ -239,6 +243,42 @@ def _render_fused_dispatches(fused, overall) -> str:
 {summary}
 <table><tr><th class=l>stage composition</th><th>ops</th>
 <th>dispatches</th><th>saved</th></tr>{''.join(rows)}</table>"""
+
+
+def _render_checkpoint_events(ckpt, overall) -> str:
+    """Durability timeline (api/checkpoint.py): every epoch commit,
+    resume decision, and restore, with the overall checkpoint/recovery
+    counters — rendered alongside the fused-dispatch table so the cost
+    of durability sits next to the dispatch budget it rides on."""
+    if not ckpt and not (overall and any(
+            "checkpoint_epochs" in o for o in overall)):
+        return ""
+    trs = []
+    for t, e in ckpt:
+        kind = e.get("event")
+        detail = ", ".join(
+            f"{k}={v}" for k, v in sorted(e.items())
+            if k not in ("ts", "event", "host"))
+        trs.append(
+            f'<tr><td>{t * 1e3:.1f}</td>'
+            f'<td class="l">{html.escape(str(kind))}</td>'
+            f'<td class="l">{html.escape(detail)}</td></tr>')
+    summary = ""
+    if overall:
+        o = overall[-1]
+        if "checkpoint_epochs" in o:
+            summary = (
+                f"<p>{o.get('checkpoint_epochs', 0)} epochs committed, "
+                f"{o.get('ckpt_bytes_written', 0)} bytes sealed; resume "
+                f"skipped {o.get('resume_skipped_ops', 0)} ops in "
+                f"{o.get('recovery_time_s', 0)}s of recovery</p>")
+    if not trs and not summary:
+        return ""
+    return f"""
+<h2>checkpoint &amp; recovery</h2>
+{summary}
+<table><tr><th>ms</th><th class="l">event</th>
+<th class="l">detail</th></tr>{''.join(trs)}</table>"""
 
 
 def _render_fault_events(faults) -> str:

@@ -293,6 +293,36 @@ def OpenReadStream(path: str, offset: int = 0) -> IO[bytes]:
     return RetryingReader(path, offset)
 
 
+def write_file_atomic(path: str, data: bytes) -> None:
+    """Write ``data`` so readers see either the old file or the whole
+    new one, never a torn prefix: write to a same-directory temp name,
+    fsync, then ``os.replace``. The checkpoint manifest commit
+    (api/checkpoint.py) rides this — a manifest present on disk IS the
+    epoch's commit record, so partial manifests must be impossible.
+    Non-posix schemes (s3://, hdfs://) fall back to a plain write (the
+    object stores' PUT is already all-or-nothing)."""
+    if _scheme(path) != "file":
+        with OpenWriteStream(path) as f:
+            f.write(data)
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def OpenWriteStream(path: str) -> IO[bytes]:
     if _scheme(path) == "s3":
         from . import s3_file
