@@ -395,12 +395,36 @@ def _examples_path():
         sys.path.insert(0, p)
 
 
+def _loop_phase_fields(ctx, name: str, prefix: str) -> dict:
+    """Per-iteration phase breakdown of the newest api/loop.py report
+    for loop ``name``: what fraction of loop wall went to the capture
+    iteration (graph build + pull recursion + fusion planning + its
+    dispatches) vs replayed iterations (pure dispatch), plus the
+    replay hit rate — so a PageRank/k-means speedup is ATTRIBUTABLE to
+    the iteration layer, not just asserted. The same numbers stream as
+    ``event=iteration`` / ``event=loop_replay`` profile lines when
+    THRILL_TPU_LOG is set (rendered by tools/json2profile.py)."""
+    reps = [r for r in getattr(ctx.mesh_exec, "loop_reports", [])
+            if r.get("name") == name]
+    if not reps:
+        return {}
+    r = reps[-1]
+    total = r["capture_s"] + r["replay_s"]
+    hit = (r["replays"] + r["fori_iters"]) / max(r["iters"], 1)
+    return {f"{prefix}_plan_frac": round(r["capture_s"] / total, 3)
+            if total > 0 else None,
+            f"{prefix}_replay_hit": round(hit, 3),
+            f"{prefix}_plan_builds": r["captures"],
+            f"{prefix}_replay_s": round(r["replay_s"], 4),
+            f"{prefix}_capture_s": round(r["capture_s"], 4)}
+
+
 def _pagerank_metric(ctx) -> dict:
     """PageRank end-to-end: per-iteration edge throughput of the full
-    DIA pipeline (InnerJoin + ReduceToIndex + Collapse loop,
-    examples/page_rank.py; reference:
-    examples/page_rank/page_rank.hpp:71-131) against the numpy
-    scatter-add proxy on identical data, with output parity checked."""
+    DIA pipeline (dense-gather InnerJoin + scatter ReduceToIndex,
+    LoopPlan-replayed via api/loop.py Iterate, examples/page_rank.py;
+    reference: examples/page_rank/page_rank.hpp:71-131) against the
+    numpy scatter-add proxy on identical data, with parity checked."""
     try:
         _examples_path()
         import page_rank as pr
@@ -431,7 +455,8 @@ def _pagerank_metric(ctx) -> dict:
             return {"pagerank_error": "parity mismatch vs numpy"}
         return {"pagerank_medges_s": round(m * iters / dt / 1e6, 3),
                 "pagerank_vs_numpy": round(host_dt / dt, 3),
-                "pagerank_disp": disp}
+                "pagerank_disp": disp,
+                **_loop_phase_fields(ctx, "page_rank", "pagerank")}
     except Exception as e:  # secondary metric never kills the line
         return {"pagerank_error": repr(e)[:200]}
 
@@ -476,7 +501,8 @@ def _kmeans_metric(ctx) -> dict:
             return {"kmeans_error": "parity mismatch vs numpy"}
         return {"kmeans_mitems_s": round(n * iters / dt / 1e6, 3),
                 "kmeans_vs_numpy": round(host_dt / dt, 3),
-                "kmeans_disp": disp}
+                "kmeans_disp": disp,
+                **_loop_phase_fields(ctx, "k_means", "kmeans")}
     except Exception as e:  # secondary metric never kills the line
         return {"kmeans_error": repr(e)[:200]}
 

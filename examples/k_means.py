@@ -41,8 +41,21 @@ def _cluster_i(t):
     return t["i"]
 
 
-def _cluster_sum(a, b):
-    return {"i": a["i"], "x": a["x"] + b["x"], "cnt": a["cnt"] + b["cnt"]}
+# declarative reduce spec ("i" carries the key, "x"/"cnt" accumulate):
+# unlocks the sort-free dense scatter engine in ReduceToIndex — a
+# device dispatch at any backend, so the loop body is fully recordable
+# for LoopPlan replay (a generic reduce lambda would demote to the
+# host engine on CPU and break the capture)
+def _cluster_sum():
+    from thrill_tpu.api import FieldReduce
+    return FieldReduce({"i": "first", "x": "sum", "cnt": "sum"})
+
+
+def _center_update(sum_x, cnt, centers):
+    import jax.numpy as jnp
+    return jnp.where((cnt > 0)[:, None],
+                     sum_x / jnp.maximum(cnt, 1.0)[:, None],
+                     centers)
 
 
 def k_means(ctx: Context, points: np.ndarray, k: int, iterations: int = 10,
@@ -59,25 +72,35 @@ def k_means(ctx: Context, points: np.ndarray, k: int, iterations: int = 10,
 
     # The Lloyd loop stays entirely in jax's async dispatch stream:
     # AllGatherArrays returns the per-cluster sums as DEVICE arrays,
-    # the centroid update is eager device math, and the updated
-    # centers re-enter the classify program through Bind (device
-    # operands pass straight through). Zero blocking host syncs per
-    # iteration — on a tunneled chip each sync is a link round trip
-    # (BASELINE.md r5); the reference's AllReduce/broadcast step
-    # (k-means.hpp:176-259) is host-side and has no such cost.
+    # the centroid update runs as a small cached program, and the
+    # updated centers re-enter the classify program through Bind
+    # (device operands pass straight through). Zero blocking host
+    # syncs per iteration — on a tunneled chip each sync is a link
+    # round trip (BASELINE.md r5); the reference's AllReduce/broadcast
+    # step (k-means.hpp:176-259) is host-side and has no such cost.
+    #
+    # The loop is driven by the iteration layer (api/loop.py): every
+    # device step of the body — classify+reduce, columnar egress,
+    # centroid update — is a recordable dispatch, so iterations 2..N
+    # replay a captured LoopPlan (and, the body being exchange-free at
+    # W=1, lower into one whole-loop fori_loop dispatch) instead of
+    # rebuilding the DIA graph per iteration.
+    from thrill_tpu.api import Iterate
     import jax.numpy as jnp
-    centers = jnp.asarray(centers)
-    for _ in range(iterations):
+    red = _cluster_sum()
+    update = ctx.mesh_exec.jit_cached(("kmeans_center_update",),
+                                      _center_update)
+
+    def body(centers):
         labeled = pts.Map(Bind(_label, centers))
         sums = labeled.ReduceToIndex(
-            _cluster_i, _cluster_sum,
+            _cluster_i, red,
             k, neutral={"i": 0, "x": np.zeros(dim), "cnt": 0.0})
         cols = sums.AllGatherArrays()
-        cnt = cols["cnt"]
-        centers = jnp.where((cnt > 0)[:, None],
-                            cols["x"] / jnp.maximum(cnt, 1.0)[:, None],
-                            centers)
+        return update(cols["x"], cols["cnt"], centers)
 
+    centers = Iterate(ctx, body, jnp.asarray(centers), iterations,
+                      name="k_means")
     return np.asarray(centers)
 
 

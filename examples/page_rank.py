@@ -18,7 +18,7 @@ import _bootstrap  # noqa: F401  (repo root on sys.path for CLI runs)
 
 import numpy as np
 
-from thrill_tpu.api import Bind, Context, InnerJoin
+from thrill_tpu.api import Bind, Context, FieldReduce, InnerJoin, Iterate, Zip
 
 DAMPENING = 0.85
 
@@ -37,49 +37,38 @@ def _page_first(kv):
     return kv[0]
 
 
-def _add_pairs(a, b):
-    return (a[0], a[1] + b[1])
+# declarative degree count: (page, 1) pairs scatter-added per page —
+# the sort-free ReduceToIndex engine (no host demotion, no XLA argsort)
+_ADD_PAIRS = FieldReduce(("first", "sum"))
 
 
 def _fill(x, v):
     return x * 0.0 + v[0]
 
 
-def _rank_pair(r, i):
-    return {"p": i, "r": r}
-
-
-def _deg_pair(kv, i):
-    return {"p": i, "deg": kv[1]}
-
-
 def _edge_src(e):
     return e["s"]
 
 
-def _page_p(p):
-    return p["p"]
-
-
-def _join_rank(e, p):
-    return {"d": e["d"], "r": p["r"], "s": e["s"]}
-
-
-def _contrib_src(c):
-    return c["s"]
-
-
-def _join_deg(c, dp):
+def _scale_rank(r, kv):
+    # rank / out-degree, degree clamped so dangling pages divide by 1
     import jax.numpy as jnp
-    return {"d": c["d"], "v": c["r"] / jnp.maximum(dp["deg"], 1)}
+    return r / jnp.maximum(kv[1], 1)
+
+
+def _join_scaled(e, s):
+    return {"d": e["d"], "v": s}
 
 
 def _contrib_dst(c):
     return c["d"]
 
 
-def _sum_v(a, b):
-    return {"d": a["d"], "v": a["v"] + b["v"]}
+# declarative reduce spec: "d" carries the key, "v" accumulates — the
+# FieldReduce spelling (like WordCount's) unlocks the sort-free dense
+# scatter engine in ReduceToIndex, the O(n) analog of the numpy
+# proxy's np.add.at
+_SUM_V = FieldReduce({"d": "first", "v": "sum"})
 
 
 def _dampen(t, base):
@@ -94,7 +83,7 @@ def page_rank(ctx: Context, edges: np.ndarray, num_pages: int,
 
     # out-degree per page (dangling pages keep degree 0)
     deg_dia = ctx.Distribute(src).Map(_src_one).ReduceToIndex(
-        _page_first, _add_pairs, num_pages,
+        _page_first, _ADD_PAIRS, num_pages,
         neutral=(0, 0)).Cache().Keep(iterations + 1)
 
     edges_dia = ctx.Distribute({"s": src, "d": dst}).Cache() \
@@ -104,29 +93,33 @@ def page_rank(ctx: Context, edges: np.ndarray, num_pages: int,
     base = np.array([(1.0 - DAMPENING) / num_pages])
     ranks = ctx.Generate(num_pages).Map(Bind(_fill, inv_n)).Cache()
 
-    # both joins are index joins with known multiplicity — every edge
-    # matches exactly one page row — so each worker emits at most its
-    # edge count. At W == 1 that bound is exact: pass it as
-    # out_size_hint so the joins skip their blocking size sync (one
-    # tunnel RTT per join per iteration, BASELINE.md r5). At W > 1 the
-    # hash exchange can skew edges onto one worker, where the only
-    # safe global bound would W-fold the padding — not worth it there.
-    hint = len(src) if ctx.num_workers == 1 else None
+    # One iteration = three dense-table steps, no sort and no exchange
+    # at any worker count:
+    #   1. Zip ranks with the degree table and pre-divide — each page's
+    #      outgoing contribution, one elementwise pass over [n] rows
+    #      (the reference divides per EDGE, m/n times more divisions);
+    #   2. a DENSE INDEX join: the right side is the dense per-page
+    #      contribution table (row at global position p has key p by
+    #      construction), so dense_right_index turns the join into a
+    #      pure device gather — no sort, no hash exchange, no size sync
+    #      (the generic sort-merge join pays two XLA argsorts per call);
+    #   3. scatter-add by destination (sort-free FieldReduce engine) and
+    #      dampen — the O(n+m) shape of the numpy proxy's np.add.at.
+    def body(ranks):
+        scaled = Zip(ranks, deg_dia, zip_fn=_scale_rank)
+        contrib = InnerJoin(edges_dia, scaled, _edge_src, None,
+                            _join_scaled, dense_right_index=num_pages)
+        sums = contrib.ReduceToIndex(
+            _contrib_dst, _SUM_V, num_pages, neutral={"d": 0, "v": 0.0})
+        return sums.Map(Bind(_dampen, base))
 
-    for _ in range(iterations):
-        # rank/degree per page, joined to edges by source page
-        ranks_idx = ranks.ZipWithIndex(_rank_pair)
-        contrib = InnerJoin(edges_dia, ranks_idx,
-                            _edge_src, _page_p, _join_rank,
-                            out_size_hint=hint)
-        # divide by out-degree: join against degree table
-        deg_pairs = deg_dia.ZipWithIndex(_deg_pair)
-        contrib2 = InnerJoin(contrib, deg_pairs,
-                             _contrib_src, _page_p, _join_deg,
-                             out_size_hint=hint)
-        sums = contrib2.ReduceToIndex(
-            _contrib_dst, _sum_v, num_pages, neutral={"d": 0, "v": 0.0})
-        ranks = sums.Map(Bind(_dampen, base)).Cache()
+    # the Collapse-loop idiom, loop-layer spelling (api/loop.py):
+    # iteration 1 runs the body through the pull recursion + fusion
+    # planner and CAPTURES the resulting dispatch tape as a LoopPlan;
+    # iterations 2..N replay the tape device-resident — zero Python
+    # graph construction, zero re-planning, zero host round trips
+    # (THRILL_TPU_LOOP_REPLAY=0 restores the plain per-iteration loop)
+    ranks = Iterate(ctx, body, ranks, iterations, name="page_rank")
 
     return np.asarray(ranks.AllGather(), dtype=np.float64)
 

@@ -9,7 +9,10 @@
 # in-process injection sites (common/faults.py) plus HBM pressure,
 # asserting EXACT results and clean recovery. The socket-level sites
 # (net.tcp.*, net.multiplexer.*, net.dispatcher.timer) are swept by
-# tests/net/test_fault_injection.py, included here too.
+# tests/net/test_fault_injection.py, included here too, and the
+# loop-replay site (api.loop.replay — a failed replayed dispatch must
+# degrade to full re-planning with bit-identical results) by the
+# chaos-marked cases in tests/api/test_loop.py.
 #
 # Kill-and-resume mode (CHAOS_KILL=1): additionally sweeps the
 # checkpoint/resume chaos cases (tests/api/test_checkpoint.py,
@@ -27,7 +30,8 @@ cd "$(dirname "$0")/.."
 N_SEEDS=${1:-25}
 shift || true
 
-TARGETS=(tests/api/test_chaos.py tests/net/test_fault_injection.py)
+TARGETS=(tests/api/test_chaos.py tests/net/test_fault_injection.py
+         tests/api/test_loop.py)
 if [[ "${CHAOS_KILL:-0}" == "1" ]]; then
   TARGETS+=(tests/api/test_checkpoint.py)
 fi

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Loop-replay report: runs the iterative example pipelines (PageRank,
+# k-means) with the iteration execution layer on (default) and with
+# THRILL_TPU_LOOP_REPLAY=0, checks exact result parity, and prints
+# replay hit rate, plan builds, whole-loop fori iterations, donated
+# loop-carry bytes, and the capture-vs-replay wall split per loop —
+# the mirror of fusion_report.sh one layer up (ARCHITECTURE.md
+# "Iterative execution & loop carry").
+#
+# Usage: run-scripts/loop_report.sh [--pages N] [--edges M]
+#            [--iters K] [--points N] [--clusters K]
+# Env:   JAX_PLATFORMS=cpu to force the host backend (default on a
+#        box without an accelerator).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m thrill_tpu.tools.loop_report "$@"

@@ -234,38 +234,55 @@ def test_wordcount_pipeline_fusion_budget(monkeypatch):
 
 
 def test_pagerank_pipeline_fusion_budget(monkeypatch):
-    """Pinned dispatch budgets for the PageRank example pipeline:
-    stitching (hinted join + ReduceToIndex + dampen stack +
-    ZipWithIndex per iteration) must cut device dispatches >= 2x vs
-    the per-op model, and THRILL_TPU_FUSE=0 must restore the old
-    count exactly."""
+    """Pinned dispatch budgets for the PageRank example pipeline
+    across BOTH execution layers: fusion (program stitching) and loop
+    replay (api/loop.py LoopPlan capture + whole-loop fori lowering).
+
+    4-iter run, per-op model (FUSE=0, REPLAY=0): 20 dispatches.
+    Stitching alone (REPLAY=0): 11 — upfront degree/edge/rank build 3
+    + 2 fused programs (Zip+scale, join+reduce+dampen) x 4 iterations.
+    Loop replay on top: 6 — upfront 3 + capture iteration 2 + ONE
+    whole-loop fori_loop dispatch for iterations 2..4."""
     sys.path.insert(0, _EXAMPLES)
     import page_rank as pr
-    mex = MeshExec(num_workers=1)
-    ctx = Context(mex)
     edges = pr.zipf_graph(512, 4096)
-    want = pr.page_rank_dense(ctx, edges, 512, iterations=4)
+    want = pr.page_rank_dense(None, edges, 512, iterations=4)
 
-    def run():
-        d0 = mex.stats_dispatches
-        got = pr.page_rank(ctx, edges, 512, iterations=4)
-        return got, mex.stats_dispatches - d0
+    def run_mode(fuse, replay):
+        monkeypatch.setenv("THRILL_TPU_FUSE", fuse)
+        monkeypatch.setenv("THRILL_TPU_LOOP_REPLAY", replay)
+        mex = MeshExec(num_workers=1)
+        ctx = Context(mex)
 
-    run()                                            # warm (fused)
-    got_f, fused = run()
-    assert np.allclose(got_f, want, rtol=1e-6)
-    monkeypatch.setenv("THRILL_TPU_FUSE", "0")
-    run()                                            # warm (unfused)
-    got_u, unfused = run()
-    assert np.allclose(got_u, want, rtol=1e-6)
-    assert fused <= 18, fused            # 15 on the 1-chip mesh today
-    assert unfused == 36, unfused        # the pre-fusion per-op count
-    assert unfused >= 2 * fused, (unfused, fused)
-    # the stitched run reports its stage compositions
-    stats = ctx.overall_stats()
+        def run():
+            d0 = mex.stats_dispatches
+            got = pr.page_rank(ctx, edges, 512, iterations=4)
+            return got, mex.stats_dispatches - d0
+
+        run()                                        # warm
+        got, disp = run()
+        assert np.allclose(got, want, rtol=1e-6)
+        stats = ctx.overall_stats()
+        ctx.close()
+        return got, disp, stats
+
+    got_f, fused, stats = run_mode("1", "1")
+    got_nr, fused_noreplay, _ = run_mode("1", "0")
+    got_u, unfused, _ = run_mode("0", "0")
+    assert fused == 6, fused
+    assert fused_noreplay == 11, fused_noreplay
+    assert unfused == 20, unfused        # the per-op dispatch count
+    assert unfused >= 3 * fused, (unfused, fused)
+    # every layer computes bit-identical ranks
+    assert np.array_equal(got_f, got_nr)
+    assert np.array_equal(got_f, got_u)
+    # the stitched run reports its stage compositions and the loop
+    # layer reports plan-once-replay semantics (2 runs = 2 captures)
     assert stats["fused_dispatches"] > 0
     assert stats["fused_ops"] > stats["fused_dispatches"]
     assert any(" + " in k for k in stats["fused_stages"])
+    assert stats["loop_plan_builds"] == 2
+    assert stats["loop_fori_iters"] == 6         # iterations 2..4, x2
 
 
 def test_put_small_content_cache():

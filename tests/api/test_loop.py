@@ -1,0 +1,568 @@
+"""Iteration execution layer (api/loop.py).
+
+Covers the LoopPlan lifecycle end to end: capture-once semantics on
+the PageRank example (plan once, replay 4x), the whole-loop fori_loop
+lowering, bit-exact parity across every escape-hatch combination
+(THRILL_TPU_LOOP_REPLAY / THRILL_TPU_LOOP_FORI / THRILL_TPU_FUSE),
+loud degradation — rejected captures and injected replay faults fall
+back to full re-planning, never to wrong results — buffer-donation
+position analysis, and checkpoint/resume composing with a loop carry
+mid-flight.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from thrill_tpu.api.context import Context
+from thrill_tpu.api.loop import Iterate, LoopPlan, _Call
+from thrill_tpu.common import faults
+from thrill_tpu.common.config import Config
+from thrill_tpu.parallel.mesh import MeshExec
+
+_EXAMPLES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "..", "examples")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in ("THRILL_TPU_LOOP_REPLAY", "THRILL_TPU_LOOP_FORI",
+                "THRILL_TPU_LOOP_DONATE", "THRILL_TPU_FUSE",
+                "THRILL_TPU_CKPT_DIR", "THRILL_TPU_RESUME",
+                faults.ENV_VAR):
+        monkeypatch.delenv(var, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+def _pagerank(ctx, edges, pages=512, iters=5):
+    sys.path.insert(0, _EXAMPLES)
+    import page_rank as pr
+    return pr.page_rank(ctx, edges, pages, iterations=iters)
+
+
+def _edges(pages=512, m=4096):
+    sys.path.insert(0, _EXAMPLES)
+    import page_rank as pr
+    return pr.zipf_graph(pages, m)
+
+
+# ----------------------------------------------------------------------
+# capture-once / replay semantics
+# ----------------------------------------------------------------------
+
+def test_pagerank_plan_once_replay_4x(monkeypatch):
+    """The ISSUE-4 acceptance shape: a 5-iteration PageRank builds ONE
+    LoopPlan and replays it for iterations 2..5 — zero plan builds
+    after the first iteration (fori disabled so each replayed
+    iteration is visible in the stats)."""
+    monkeypatch.setenv("THRILL_TPU_LOOP_FORI", "0")
+    edges = _edges()
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    got = _pagerank(ctx, edges)
+    stats = ctx.overall_stats()
+    assert stats["loop_plan_builds"] == 1
+    assert stats["loop_replays"] == 4
+    assert stats["loop_replay_fallbacks"] == 0
+    ctx.close()
+
+    # bit-identical to the un-replayed path
+    monkeypatch.setenv("THRILL_TPU_LOOP_REPLAY", "0")
+    mex2 = MeshExec(num_workers=1)
+    ctx2 = Context(mex2)
+    want = _pagerank(ctx2, edges)
+    stats2 = ctx2.overall_stats()
+    assert stats2["loop_plan_builds"] == 0
+    assert stats2["loop_replays"] == 0
+    ctx2.close()
+    assert np.array_equal(got, want)
+
+
+def test_pagerank_fori_whole_loop(monkeypatch):
+    """With the whole-loop lowering on (default), iterations 2..N run
+    as ONE fori_loop dispatch."""
+    edges = _edges()
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    got = _pagerank(ctx, edges)
+    stats = ctx.overall_stats()
+    assert stats["loop_plan_builds"] == 1
+    assert stats["loop_fori_iters"] == 4
+    ctx.close()
+
+    monkeypatch.setenv("THRILL_TPU_LOOP_FORI", "0")
+    mex2 = MeshExec(num_workers=1)
+    ctx2 = Context(mex2)
+    want = _pagerank(ctx2, edges)
+    ctx2.close()
+    assert np.array_equal(got, want)
+
+
+def test_pagerank_parity_vs_fuse0(monkeypatch):
+    edges = _edges()
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    got = _pagerank(ctx, edges)
+    ctx.close()
+    monkeypatch.setenv("THRILL_TPU_FUSE", "0")
+    mex2 = MeshExec(num_workers=1)
+    ctx2 = Context(mex2)
+    want = _pagerank(ctx2, edges)
+    assert ctx2.overall_stats()["loop_plan_builds"] == 1
+    ctx2.close()
+    assert np.array_equal(got, want)
+
+
+def test_pytree_carry_fori(monkeypatch):
+    """The k-means idiom: a pytree-of-arrays carry whose body is a
+    recordable cached program lowers the whole loop into one
+    dispatch."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+
+    step = mex.jit_cached(("test_loop_step",),
+                          lambda t: {"x": t["x"] * 0.5 + 1.0,
+                                     "n": t["n"] + 1})
+
+    def body(t):
+        return step(t)
+
+    carry = {"x": jnp.arange(8, dtype=jnp.float64), "n": jnp.int64(0)}
+    out = Iterate(ctx, body, carry, 6, name="pytree")
+    want_x = np.arange(8, dtype=np.float64)
+    for _ in range(6):
+        want_x = want_x * 0.5 + 1.0
+    assert np.allclose(np.asarray(out["x"]), want_x)
+    assert int(out["n"]) == 6
+    stats = ctx.overall_stats()
+    assert stats["loop_plan_builds"] == 1
+    assert stats["loop_fori_iters"] == 5
+    ctx.close()
+
+
+def test_invariant_producer_carry_leaf_folds_to_const(monkeypatch):
+    """A carry leaf recomputed each iteration from CONSTANTS only (no
+    carry dependence) is folded by the dataflow analysis — the tape
+    returns the captured value instead of re-running the producer, in
+    both per-iteration replay and whole-loop fori modes."""
+    base = jnp.arange(4, dtype=jnp.float64)
+    for fori in ("0", "1"):
+        monkeypatch.setenv("THRILL_TPU_LOOP_FORI", fori)
+        mex = MeshExec(num_workers=1)
+        ctx = Context(mex)
+        step_x = mex.jit_cached(("inv_step_x",), lambda x: x * 0.5 + 1.0)
+        step_t = mex.jit_cached(("inv_step_t",), lambda t: t * 2.0)
+
+        def body(c):
+            return {"x": step_x(c["x"]), "t": step_t(base)}
+
+        out = Iterate(ctx, body, {"x": base, "t": base}, 5,
+                      name="invariant")
+        want_x = np.arange(4, dtype=np.float64)
+        for _ in range(5):
+            want_x = want_x * 0.5 + 1.0
+        assert np.allclose(np.asarray(out["x"]), want_x)
+        assert np.allclose(np.asarray(out["t"]), np.arange(4) * 2.0)
+        stats = ctx.overall_stats()
+        assert stats["loop_plan_builds"] == 1
+        assert stats["loop_replay_fallbacks"] == 0
+        ctx.close()
+
+
+# ----------------------------------------------------------------------
+# loud degradation
+# ----------------------------------------------------------------------
+
+def test_eager_body_rejects_capture_not_correctness():
+    """A body whose carry is produced OUTSIDE the recorded dispatch
+    stream (eager host math) must reject the capture and run the
+    plain per-iteration loop — never a silent wrong tape."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+
+    def body(t):
+        return jnp.asarray(np.asarray(t) * 2.0)     # host round trip
+
+    out = Iterate(ctx, body, jnp.arange(4, dtype=jnp.float64), 3,
+                  name="eager")
+    assert np.allclose(np.asarray(out), np.arange(4) * 8.0)
+    stats = ctx.overall_stats()
+    assert stats["loop_plan_builds"] == 0
+    assert stats["loop_replays"] == 0
+    ctx.close()
+
+
+def test_data_dependent_exchange_rejects_capture():
+    """k-means at W>1: the per-iteration exchange's send matrix
+    derives from the (changing) cluster assignments — a tape would
+    freeze iteration-1's plan and compute WRONG sums. The plan-read
+    guard must reject the capture (loud miss, plain loop, exact
+    results), not replay a lying tape."""
+    sys.path.insert(0, _EXAMPLES)
+    import k_means as km
+    mex = MeshExec(num_workers=2)
+    ctx = Context(mex)
+    rng = np.random.default_rng(0)
+    pts = rng.normal(size=(512, 4))
+    c = km.k_means(ctx, pts, 8, iterations=4, seed=0)
+    rng0 = np.random.default_rng(0)
+    c0 = pts[rng0.choice(512, size=8, replace=False)].copy()
+    want = km.k_means_dense(pts, c0, 4)
+    assert np.allclose(c, want, rtol=1e-6, atol=1e-8)
+    stats = ctx.overall_stats()
+    assert stats["loop_plan_builds"] == 0    # capture rejected
+    assert stats["loop_replays"] == 0
+    ctx.close()
+
+
+@pytest.mark.chaos
+def test_replay_fault_degrades_to_replanning(monkeypatch):
+    """An injected failure at api.loop.replay must fall back to full
+    re-planning (a second capture) and still produce bit-identical
+    ranks; the fallback is counted and the loop completes."""
+    edges = _edges()
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    want = _pagerank(ctx, edges)
+    ctx.close()
+
+    monkeypatch.setenv(faults.ENV_VAR, "api.loop.replay:p=1.0:n=1")
+    faults.REGISTRY.reset()
+    mex2 = MeshExec(num_workers=1)
+    ctx2 = Context(mex2)
+    got = _pagerank(ctx2, edges)
+    stats = ctx2.overall_stats()
+    ctx2.close()
+    assert np.array_equal(got, want)
+    assert stats["loop_replay_fallbacks"] == 1
+    assert stats["loop_plan_builds"] == 2       # re-captured after it
+
+
+# ----------------------------------------------------------------------
+# donation analysis
+# ----------------------------------------------------------------------
+
+def test_donation_positions():
+    """Static donation plan: only loop-owned buffers at their LAST use
+    that do not survive into the next carry are donatable; a buffer
+    passed twice to one call never is."""
+    mex = MeshExec(num_workers=1)
+
+    class _Fn:                                   # raw-less stand-in
+        raw = None
+
+    f = _Fn()
+    # call0(carry0, carry0) -> v00 ; call1(v00, carry1) -> v10
+    # carry_out = [v10, carry1]
+    calls = [_Call(f, [("carry", 0), ("carry", 0)], [object()]),
+             _Call(f, [("val", (0, 0)), ("carry", 1)], [object()])]
+    plan = LoopPlan(mex, calls, [("val", (1, 0)), ("carry", 1)], 2)
+    # carry0 is passed twice to call0 -> not donatable; v00's last use
+    # is call1 arg0 and it dies there -> donatable; carry1 survives
+    # into the next carry -> never donatable
+    assert plan.calls[0].donate_pos == ()
+    assert plan.calls[1].donate_pos == (0,)
+
+
+def test_eager_device_math_rejects_capture():
+    """Regression: eager jnp math on the carry BETWEEN recorded
+    dispatches used to classify as a constant — the tape froze the
+    iteration-1 value and replays silently returned wrong results.
+    The recorder must reject arrays created during the body that no
+    recorded dispatch or host upload produced."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    step = mex.jit_cached(("test_loop_eager_feed",), lambda y: y + 1.0)
+
+    def body(x):
+        y = x * 2.0                 # eager op on the carry
+        return step(y)
+
+    out = Iterate(ctx, body, jnp.arange(4, dtype=jnp.float64), 4,
+                  name="eager_feed")
+    want = np.arange(4, dtype=np.float64)
+    for _ in range(4):
+        want = want * 2.0 + 1.0     # -> [15, 31, 47, 63]
+    assert np.allclose(np.asarray(out), want)
+    stats = ctx.overall_stats()
+    assert stats["loop_plan_builds"] == 0
+    assert stats["loop_replays"] == 0
+    ctx.close()
+
+
+def test_fori_with_checkpoint_every_but_no_manager(monkeypatch):
+    """checkpoint_every without THRILL_TPU_CKPT_DIR seals nothing — it
+    must not cost the whole-loop fori lowering."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    from thrill_tpu.api.dia import DIA
+
+    def body(d):
+        return d.Map(_step_half)
+
+    d = ctx.Distribute(np.arange(32, dtype=np.float64))
+    out = Iterate(ctx, body, d, 5, name="nockpt", checkpoint_every=2)
+    got = np.sort(np.asarray([float(x) for x in out.AllGather()]))
+    want = np.arange(32, dtype=np.float64)
+    for _ in range(5):
+        want = want * 0.5 + 1.0
+    assert np.allclose(got, np.sort(want))
+    assert ctx.overall_stats()["loop_fori_iters"] == 4
+    ctx.close()
+
+
+def test_nested_iterate_rejects_outer_capture():
+    """An inner Iterate inside a capturing body installs its own
+    recorder, so the inner loop's dispatches bypass the outer one —
+    the outer capture must reject loudly (a tape would silently skip
+    the whole inner loop on every replay)."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    step = mex.jit_cached(("test_loop_nested_step",), lambda x: x + 1.0)
+
+    def outer(x):
+        y = step(x)
+        return Iterate(ctx, lambda z: step(z), y, 2, name="inner")
+
+    out = Iterate(ctx, outer, jnp.zeros(4), 3, name="outer")
+    # +1 (step) + 2*(+1) (inner loop) per outer iteration, 3 iterations
+    assert np.allclose(np.asarray(out), np.full(4, 9.0))
+    reports = {r["name"]: r for r in mex.loop_reports}
+    assert reports["outer"]["captures"] == 0     # outer never tapes
+    ctx.close()
+
+
+def test_folded_const_carry_out_not_donated():
+    """Regression: a carry slot whose producer is iteration-invariant
+    folds to a ("const", buf) carry-out — that slot hands back the SAME
+    buffer every iteration (and holds it on entry), so its incoming
+    carry must never be donated; donating would free a buffer the loop
+    still returns, crashing the next replay on a deleted array."""
+    mex = MeshExec(num_workers=1)
+
+    class _Fn:                                   # raw-less stand-in
+        raw = None
+
+    f = _Fn()
+    # call0(const) -> T            (invariant: folds to a constant)
+    # call1(carry0, carry1) -> v10
+    # carry_out = [v10, T]         (slot 1 becomes ("const", T))
+    calls = [_Call(f, [("const", object())], [object()]),
+             _Call(f, [("carry", 0), ("carry", 1)], [object()])]
+    plan = LoopPlan(mex, calls, [("val", (1, 0)), ("val", (0, 0))], 2)
+    assert plan.carry_out[1][0] == "const"
+    # carry0 dies inside the iteration -> donatable; carry1 IS the
+    # folded constant on every replay -> pinned
+    assert plan.calls[0].donate_pos == (0,)
+
+
+def test_aliased_carry_out_not_donated():
+    """Regression: a body that returns ONE tape output into TWO carry
+    slots makes the next iteration's incoming carry leaves alias one
+    buffer — donating either slot's view would free the buffer the
+    other slot still reads mid-iteration. Both aliased slots must be
+    pinned in the donation plan."""
+    mex = MeshExec(num_workers=1)
+
+    class _Fn:
+        raw = None
+
+    f = _Fn()
+    # call0(carry0) -> s; call1(carry1, s) -> v
+    # carry_out = [v, v]  (aliased: slots 0 and 1 hand back ONE buffer)
+    calls = [_Call(f, [("carry", 0)], [object()]),
+             _Call(f, [("carry", 1), ("val", (0, 0))], [object()])]
+    plan = LoopPlan(mex, calls,
+                    [("val", (1, 0)), ("val", (1, 0))], 2)
+    # incoming carries 0 and 1 alias on every replay after the first:
+    # neither may be donated even at its last use; the intermediate s
+    # dies inside the iteration and stays donatable
+    assert plan.calls[0].donate_pos == ()
+    assert plan.calls[1].donate_pos == (1,)
+
+
+def test_aliased_carry_donation_end_to_end(monkeypatch):
+    """The review-reproduced crash: {'a': v, 'b': v} carry with
+    donation forced on died at replay 2 on a deleted array before the
+    aliased slots were pinned."""
+    monkeypatch.setenv("THRILL_TPU_LOOP_DONATE", "1")
+    monkeypatch.setenv("THRILL_TPU_LOOP_FORI", "0")
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    fa = mex.jit_cached(("test_loop_alias_f",), lambda x: x * 2.0)
+    fb = mex.jit_cached(("test_loop_alias_g",), lambda x, s: x + s)
+
+    def body(t):
+        s = fa(t["a"])
+        v = fb(t["b"], s)
+        return {"a": v, "b": v}
+
+    x0 = {"a": jnp.arange(8, dtype=jnp.float64),
+          "b": jnp.ones(8, dtype=jnp.float64)}
+    out = Iterate(ctx, body, x0, 5, name="alias")
+    a = np.arange(8, dtype=np.float64)
+    b = np.ones(8, dtype=np.float64)
+    for _ in range(5):
+        v = b + a * 2.0
+        a = b = v
+    assert np.allclose(np.asarray(out["a"]), a)
+    assert np.allclose(np.asarray(out["b"]), b)
+    stats = ctx.overall_stats()
+    assert stats["loop_plan_builds"] == 1
+    assert stats["loop_replays"] == 4
+    assert stats["loop_replay_fallbacks"] == 0
+    ctx.close()
+
+
+def test_count_changing_body_rejects_capture():
+    """Regression: a body that changes host-known carry counts while
+    leaf shapes/cap stay stable must MISS (the capture input's counts
+    are baked into the tape as constants — replaying them against the
+    grown carry would mask valid rows silently). Once counts stabilize
+    the next capture attempt may succeed; results must match the
+    un-replayed path bit for bit."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+
+    def body(d):
+        # 10 items in, 16 dense rows out: counts [10] -> [16], cap 16
+        return d.ReduceToIndex(lambda x: x % 16, lambda a, b: a + b,
+                               16, neutral=0)
+
+    carry = ctx.Distribute(np.arange(10, dtype=np.int64))
+    out = Iterate(ctx, body, carry, 4, name="countdrift")
+    got = np.array([int(x) for x in out.AllGather()])
+
+    os.environ["THRILL_TPU_LOOP_REPLAY"] = "0"
+    try:
+        ctx2 = Context(MeshExec(num_workers=1))
+        carry2 = ctx2.Distribute(np.arange(10, dtype=np.int64))
+        out2 = Iterate(ctx2, body, carry2, 4, name="countdrift")
+        want = np.array([int(x) for x in out2.AllGather()])
+        ctx2.close()
+    finally:
+        del os.environ["THRILL_TPU_LOOP_REPLAY"]
+    assert np.array_equal(got, want)
+    ctx.close()
+
+
+def test_capture_miss_stops_reattempting():
+    """A deterministic capture miss (eager host math in the body) must
+    not burn a carry copy + recorder pass on every remaining iteration:
+    after two consecutive misses the loop runs plain."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    attempts = []
+
+    def body(x):
+        attempts.append(1)
+        # numpy round trip -> capture rejects deterministically
+        return jnp.asarray(np.asarray(x) * 0.5 + 1.0)
+
+    out = Iterate(ctx, body, jnp.arange(8, dtype=jnp.float64), 6,
+                  name="missy")
+    want = np.arange(8, dtype=np.float64)
+    for _ in range(6):
+        want = want * 0.5 + 1.0
+    assert np.allclose(np.asarray(out), want)
+    stats = ctx.overall_stats()
+    assert stats["loop_plan_builds"] == 0
+    assert stats["loop_replays"] == 0
+    assert len(attempts) == 6                    # every iteration ran
+    ctx.close()
+
+
+def test_donated_bytes_counted(monkeypatch):
+    """With donation forced on (CPU no-ops the aliasing but the twin
+    program still runs), replayed dispatches report donated bytes."""
+    monkeypatch.setenv("THRILL_TPU_LOOP_DONATE", "1")
+    monkeypatch.setenv("THRILL_TPU_LOOP_FORI", "0")
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    step = mex.jit_cached(("test_loop_donate_step",),
+                          lambda x: x * 0.5 + 1.0)
+    out = Iterate(ctx, lambda x: step(x),
+                  jnp.arange(64, dtype=jnp.float64), 4, name="donate")
+    want = np.arange(64, dtype=np.float64)
+    for _ in range(4):
+        want = want * 0.5 + 1.0
+    assert np.allclose(np.asarray(out), want)
+    stats = ctx.overall_stats()
+    assert stats["loop_replays"] == 3
+    # first replay pins the capture's carry; replays 2..3 donate it
+    assert stats["loop_donated_bytes"] == 2 * 64 * 8
+    ctx.close()
+
+
+# ----------------------------------------------------------------------
+# checkpoint/resume composes with a loop carry
+# ----------------------------------------------------------------------
+
+def _step_half(x):
+    return x * 0.5 + 1.0
+
+
+_BODY_RUNS = []
+
+
+def _ckpt_job(ctx):
+    from thrill_tpu.api.dia import DIA
+
+    def body(d):
+        _BODY_RUNS.append(1)
+        return d.Map(_step_half)
+
+    d = ctx.Distribute(np.arange(32, dtype=np.float64))
+    out = Iterate(ctx, body, d, 6, name="ckpt_loop", checkpoint_every=2)
+    return [float(x) for x in out.AllGather()]
+
+
+def test_checkpoint_every_rejects_pytree_carry():
+    """checkpoint_every needs the shard-file epoch path; a pytree carry
+    cannot be sealed — refused up front rather than silently delivering
+    no durability."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        Iterate(ctx, lambda x: x, jnp.arange(4.0), 3,
+                checkpoint_every=2)
+    ctx.close()
+
+
+def test_loop_checkpoint_resume(tmp_path, monkeypatch):
+    """Iterate(..., checkpoint_every=2) seals the carry into durable
+    epochs; a resumed run restores the NEWEST loop epoch and re-runs
+    only the iterations after it (REPLAY=0 so body invocations count
+    iterations exactly)."""
+    from thrill_tpu.api import Run
+    monkeypatch.setenv("THRILL_TPU_LOOP_REPLAY", "0")
+    cfg = Config(ckpt_dir=str(tmp_path / "ckpt"))
+    _BODY_RUNS.clear()
+    want = Run(_ckpt_job, cfg)
+    assert len(_BODY_RUNS) == 6
+    # epochs sealed after iterations 2 and 4 (1-based)
+    edir = tmp_path / "ckpt"
+    assert len(list(edir.iterdir())) == 2
+
+    _BODY_RUNS.clear()
+    got = Run(_ckpt_job, cfg, resume=True)
+    assert got == want                       # bit-identical
+    # resumed AFTER the newest epoch (iteration 4): only 5 and 6 re-run
+    assert len(_BODY_RUNS) == 2
+
+
+def test_loop_checkpoint_resume_with_replay(tmp_path, monkeypatch):
+    """Same compose with replay ON: the resumed run restores mid-loop,
+    re-captures, and still produces bit-identical results."""
+    from thrill_tpu.api import Run
+    cfg = Config(ckpt_dir=str(tmp_path / "ckpt"))
+    _BODY_RUNS.clear()
+    want = Run(_ckpt_job, cfg)
+    got = Run(_ckpt_job, cfg, resume=True)
+    assert got == want

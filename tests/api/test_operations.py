@@ -330,6 +330,79 @@ def test_inner_join_all_ones_keys():
     sweep(job)
 
 
+def test_inner_join_dense_index_device():
+    """dense_right_index turns the join into a position gather: row g
+    of the right table has key g by construction; out-of-range left
+    keys produce no pair (inner-join semantics, no overflow)."""
+    def job(ctx):
+        n = 8
+        keys = np.array([0, 3, 3, 7, 9, 2], dtype=np.int64)
+        left = ctx.Distribute(keys).Map(lambda x: (x, x * 10))
+        right = ctx.Generate(n).Map(lambda g: g * 100)
+        j = InnerJoin(left, right, lambda kv: kv[0], None,
+                      lambda l, r: (l[1], r), dense_right_index=n)
+        got = sorted((int(a), int(b)) for a, b in j.AllGather())
+        want = sorted((int(x) * 10, int(x) * 100)
+                      for x in keys if x < n)    # 9 drops
+        assert got == want
+    sweep(job)
+
+
+def test_inner_join_dense_index_host():
+    """Host-path dense-index join: the per-shard enumeration offsets
+    must reproduce the device gather's global-position addressing
+    (including empty right shards at W > n)."""
+    def job(ctx):
+        n = 5
+        left = ctx.Distribute([0, 4, 4, 2, 6], storage="host").Map(
+            lambda x: (x, x * 10))
+        right = ctx.Distribute([100, 101, 102, 103, 104],
+                               storage="host")
+        j = InnerJoin(left, right, lambda kv: kv[0], None,
+                      lambda l, r: (l[1], r), dense_right_index=n)
+        got = sorted((int(a), int(b)) for a, b in j.AllGather())
+        want = sorted((x * 10, 100 + x) for x in [0, 4, 4, 2])
+        assert got == want
+    sweep(job)
+
+
+def test_inner_join_dense_index_host_split_offsets():
+    """Regression: the host-path enumeration must address worker w's
+    rows at dense_range_bounds[w] BY CONTRACT, never at the cumulative
+    length of the preceding lists — multi-controller HostShards keep
+    non-local workers' lists empty (multiplexer.localize), so
+    cumulative offsets would collapse a later worker's rows toward
+    global position 0 and join silently wrong pairs. Simulated here
+    with a leading empty right shard: worker 1 of W=2 holds dense rows
+    2..4 of n=5 regardless of worker 0's (locally invisible) rows."""
+    def job(ctx):
+        if ctx.num_workers != 2:
+            return
+        n = 5                      # dense split at W=2: [0, 2, 5]
+        left = ctx.Distribute([2, 4], storage="host").Map(
+            lambda x: (x, x * 10))
+        right = ctx.ConcatToDIA([[], [102, 103, 104]], storage="host")
+        j = InnerJoin(left, right, lambda kv: kv[0], None,
+                      lambda l, r: (l[1], r), dense_right_index=n)
+        got = sorted((int(a), int(b)) for a, b in j.AllGather())
+        assert got == [(20, 102), (40, 104)]
+    sweep(job)
+
+
+def test_inner_join_dense_index_rejects_right_key():
+    """The dense contract DEFINES the right key as the row position; a
+    caller-supplied right key would be silently ignored by the device
+    gather but honored by the host path — refused up front."""
+    def job(ctx):
+        l = ctx.Distribute(np.arange(4, dtype=np.int64)).Map(
+            lambda x: (x, x))
+        r = ctx.Generate(4)
+        with pytest.raises(ValueError, match="dense_right_index"):
+            InnerJoin(l, r, lambda kv: kv[0], lambda x: x,
+                      lambda a, b: (a, b), dense_right_index=4)
+    sweep(job)
+
+
 def test_inner_join_host():
     def job(ctx):
         l = ctx.Distribute([("a", 1), ("b", 2), ("a", 3)], storage="host")

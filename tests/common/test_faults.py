@@ -415,6 +415,36 @@ def _ex_vfs_scheme_sites():
             assert default_policy().classify(ei.value) == faults.TRANSIENT
 
 
+def _ex_loop_replay():
+    """api.loop.replay (iteration layer, api/loop.py): an injected
+    failure on a replayed dispatch degrades LOUDLY to full
+    re-planning — the body re-runs through the pull recursion (a
+    second capture), results exact, fallback counted. Deeper
+    coverage: tests/api/test_loop.py and the chaos sweep."""
+    import jax.numpy as jnp
+    from thrill_tpu.api.context import Context
+    from thrill_tpu.api.loop import Iterate
+    from thrill_tpu.parallel.mesh import MeshExec
+    with faults.inject("api.loop.replay", n=1, seed=3):
+        mex = MeshExec(num_workers=1)
+        ctx = Context(mex)
+        step = mex.jit_cached(("faults_loop_step",),
+                              lambda x: x * 2.0 + 1.0)
+        out = Iterate(ctx, lambda x: step(x),
+                      jnp.arange(8, dtype=jnp.float64), 4,
+                      name="faults_loop")
+        got = np.asarray(out)
+        stats = ctx.overall_stats()
+        ctx.close()
+    want = np.arange(8, dtype=np.float64)
+    for _ in range(4):
+        want = want * 2.0 + 1.0
+    assert np.allclose(got, want)
+    assert stats["loop_replay_fallbacks"] >= 1
+    assert stats["loop_plan_builds"] >= 2
+    assert faults.REGISTRY.injected >= 1
+
+
 # sites whose exercisers live in tests/net/test_fault_injection.py
 # (they need real sockets / multi-rank groups)
 _NET_SITES = {
@@ -431,6 +461,7 @@ _MATRIX = {
     # the fused per-op site family (api.fuse.<OpLabel>) shares one
     # exerciser: every member retries the same pure stitched dispatch
     "api.fuse.*": _ex_fused_per_op_sites,
+    "api.loop.replay": _ex_loop_replay,
     "ckpt.write": _ex_ckpt_write_and_manifest,
     "ckpt.manifest": _ex_ckpt_write_and_manifest,
     "ckpt.read": _ex_ckpt_read,

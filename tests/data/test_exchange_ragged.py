@@ -7,7 +7,17 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+# this container's jax/jaxlib predates lax.ragged_all_to_all entirely
+# (added in jax 0.5); the trace/lowering contract can only be checked
+# where the op exists — on platforms without it these cases are a
+# known environment limit, not a regression
+_NEEDS_RAGGED_OP = pytest.mark.skipif(
+    not hasattr(jax.lax, "ragged_all_to_all"),
+    reason="jax.lax.ragged_all_to_all not available in this jax "
+           "version (XLA:CPU container); execution is TPU-only anyway")
 
+
+@_NEEDS_RAGGED_OP
 def test_ragged_path_traces_and_lowers():
     from thrill_tpu.parallel.mesh import MeshExec
     from thrill_tpu.data import exchange
@@ -33,6 +43,7 @@ def test_ragged_path_traces_and_lowers():
         os.environ.pop("THRILL_TPU_EXCHANGE", None)
 
 
+@_NEEDS_RAGGED_OP
 def test_lower_ragged_exchange_plan():
     """The dryrun's plan validation (lower WITHOUT compiling): the
     lowered module must contain the ragged collective, for multiple

@@ -28,7 +28,7 @@ __version__ = "0.1.0"
 _API_NAMES = ("Bind", "Context", "DIA", "FieldReduce", "Run",
               "RunDistributed", "RunLocalMock", "RunLocalTests",
               "RunSupervised",
-              "Concat", "InnerJoin", "Merge", "Union", "Zip",
+              "Concat", "InnerJoin", "Iterate", "Merge", "Union", "Zip",
               "ZipWindow")
 
 

@@ -365,41 +365,12 @@ class CheckpointManager:
         if not self.restorable(node):
             return None
         m = self._manifest
-        rec = m["node"]
-        t0 = time.perf_counter()
-        try:
-            if rec["kind"] == "device":
-                shards = self._restore_device(rec, m["_dir"])
-            else:
-                shards = self._restore_host(rec, m["_dir"])
-        except Exception as e:
-            import sys
-            print(f"thrill_tpu.checkpoint: restore of {rec['key']} from "
-                  f"epoch {m['epoch']} failed ({e!r}); recomputing from "
-                  f"lineage", file=sys.stderr)
-            faults.note("recovery", what="ckpt.restore_failed",
-                        node=node.label, epoch=m["epoch"], error=repr(e))
-            shards = None
-        if self._multihost():
-            # restore is all-or-nothing ACROSS RANKS: one rank falling
-            # back to recompute while the others restore would re-enter
-            # upstream exchange collectives alone (deadlock) or finish
-            # on mixed-epoch data (wrong results). The agreement runs
-            # in lockstep: restorable() is deterministic after the
-            # startup epoch agreement, so every controller reaches
-            # this all_gather for the same node.
-            oks = self.ctx.net.all_gather(shards is not None)
-            if not all(oks) and shards is not None:
-                faults.note("recovery", what="ckpt.restore_abandoned",
-                            node=node.label, epoch=m["epoch"],
-                            peers_failed=oks.count(False))
-                shards = None
-        if shards is None:
+        res = self._restore_agreed(node.label, "recomputing from "
+                                               "lineage")
+        if res is None:
             self._manifest = None        # every rank recomputes
             return None
-        dt = time.perf_counter() - t0
-        self.restored_nodes += 1
-        self.recovery_time_s += dt
+        shards, dt = res
         skipped = _count_upstream_new(node)
         self.resume_skipped_ops += skipped
         # one restore per manifest: downstream re-executions of the
@@ -410,6 +381,89 @@ class CheckpointManager:
                     epoch=m["epoch"], skipped_ops=skipped,
                     seconds=round(dt, 4))
         return shards
+
+    # ------------------------------------------------------------------
+    # loop-carry epochs (api/loop.py Iterate(..., checkpoint_every=k))
+    # ------------------------------------------------------------------
+    def save_loop_state(self, name: str, iteration: int, shards) -> int:
+        """Seal a loop-carried state into a durable epoch. The label
+        encodes (loop name, iteration) so a resumed run can re-enter
+        the loop mid-flight without rebuilding the body graph."""
+        import types
+        shim = types.SimpleNamespace(
+            id=0, label=f"LoopState[{name}@{iteration}]", parents=())
+        return self.save(shim, shards)
+
+    def try_restore_loop(self, name: str):
+        """(shards, iteration) from the resume manifest when it holds a
+        loop epoch for ``name``, else None. Same all-or-nothing
+        multihost agreement and corrupt-epoch degradation as
+        :meth:`try_restore`."""
+        m = self._manifest
+        if m is None:
+            return None
+        rec = m["node"]
+        label = rec["key"].split(":", 1)[1]
+        prefix = f"LoopState[{name}@"
+        if not label.startswith(prefix) or not label.endswith("]"):
+            return None
+        try:
+            iteration = int(label[len(prefix):-1])
+        except ValueError:
+            return None
+        res = self._restore_agreed(label, "re-running the loop from "
+                                          "its start")
+        self._manifest = None
+        if res is None:
+            return None
+        shards, dt = res
+        faults.note("recovery", what="ckpt.restore", node=label,
+                    epoch=m["epoch"], loop=name, iteration=iteration,
+                    seconds=round(dt, 4))
+        return shards, iteration
+
+    def _restore_agreed(self, label: str, fallback: str):
+        """The shared restore core of :meth:`try_restore` /
+        :meth:`try_restore_loop`: rebuild the manifest node's shards
+        (corrupt epoch -> loud stderr + recovery note + None) and run
+        the all-or-nothing cross-rank agreement. Restore is
+        all-or-nothing ACROSS RANKS: one rank falling back to
+        recompute while the others restore would re-enter upstream
+        exchange collectives alone (deadlock) or finish on mixed-epoch
+        data (wrong results). The agreement runs in lockstep:
+        restorable() is deterministic after the startup epoch
+        agreement, so every controller reaches this all_gather for the
+        same node. Returns (shards, seconds) or None; the caller owns
+        clearing ``_manifest``."""
+        m = self._manifest
+        rec = m["node"]
+        t0 = time.perf_counter()
+        try:
+            if rec["kind"] == "device":
+                shards = self._restore_device(rec, m["_dir"])
+            else:
+                shards = self._restore_host(rec, m["_dir"])
+        except Exception as e:
+            import sys
+            print(f"thrill_tpu.checkpoint: restore of {rec['key']} "
+                  f"from epoch {m['epoch']} failed ({e!r}); {fallback}",
+                  file=sys.stderr)
+            faults.note("recovery", what="ckpt.restore_failed",
+                        node=label, epoch=m["epoch"], error=repr(e))
+            shards = None
+        if self._multihost():
+            oks = self.ctx.net.all_gather(shards is not None)
+            if not all(oks) and shards is not None:
+                faults.note("recovery", what="ckpt.restore_abandoned",
+                            node=label, epoch=m["epoch"],
+                            peers_failed=oks.count(False))
+                shards = None
+        if shards is None:
+            return None
+        dt = time.perf_counter() - t0
+        self.restored_nodes += 1
+        self.recovery_time_s += dt
+        return shards, dt
 
     def _read_file(self, edir: str, finfo: dict) -> bytes:
         path = os.path.join(edir, finfo["name"])

@@ -303,6 +303,15 @@ class Context:
             "fused_ops": mex.stats_fused_ops,
             "fused_stages": {" + ".join(ops): n for ops, n in
                              mex.fused_stage_counts.items()},
+            # iteration execution layer (api/loop.py): captures vs
+            # replayed iterations (zero graph build / planning), whole-
+            # loop fori_loop iterations, loud replay fallbacks, and
+            # HBM bytes donated back to XLA on replayed dispatches
+            "loop_plan_builds": mex.stats_loop_plan_builds,
+            "loop_replays": mex.stats_loop_replays,
+            "loop_fori_iters": mex.stats_loop_fori_iters,
+            "loop_replay_fallbacks": mex.stats_loop_fallbacks,
+            "loop_donated_bytes": mex.stats_loop_donated_bytes,
             "host_mem_peak": self.mem.peak,
             "hbm_peak": self.hbm.mem.peak,
             "hbm_spills": self.hbm.spill_count,

@@ -32,7 +32,7 @@ def apply_stack_device(shards: DeviceShards, stack: Stack) -> DeviceShards:
     leaves, treedef = jax.tree.flatten(shards.tree)
     bound = stack_bound_operands(stack)
     b_leaves, b_treedef = jax.tree.flatten(bound)
-    b_leaves = [jnp.asarray(l) for l in b_leaves]
+    b_leaves = mex.asarray_blessed(b_leaves)
     key = ("stack", stack_cache_token(stack), cap, treedef,
            tuple((l.dtype, l.shape[2:]) for l in leaves))
     holder = {}

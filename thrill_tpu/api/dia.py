@@ -321,14 +321,18 @@ def Union(*dias: DIA) -> DIA:
 def InnerJoin(left: DIA, right: DIA, left_key_fn: Callable,
               right_key_fn: Callable, join_fn: Callable,
               location_detection: bool = False,
-              out_size_hint=None) -> DIA:
+              out_size_hint=None, dense_right_index=None) -> DIA:
     """``location_detection`` (reference: LocationDetectionTag) prunes
     items whose key exists on only one side before the shuffle —
     host-storage path only; the device path ignores the flag.
     ``out_size_hint``: optional per-worker match-count upper bound —
     the device path then skips its blocking size sync (overflow raises
-    at the next host fetch, never silently truncates)."""
+    at the next host fetch, never silently truncates).
+    ``dense_right_index=n``: the right side is a dense index table
+    (row at global position g has key g, n rows total) — the join runs
+    as a pure device gather, no sort/exchange/sync at any W."""
     from .ops import join as _j
     return _j.InnerJoin(left, right, left_key_fn, right_key_fn, join_fn,
                         location_detection=location_detection,
-                        out_size_hint=out_size_hint)
+                        out_size_hint=out_size_hint,
+                        dense_right_index=dense_right_index)

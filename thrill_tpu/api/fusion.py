@@ -219,7 +219,7 @@ class FusionPlan:
         bound_sig = []
         for seg in segs:
             bl, bt = jax.tree.flatten(seg.bound)
-            bl = [jnp.asarray(l) for l in bl]
+            bl = mex.asarray_blessed(bl)
             bound_flat.append((bl, bt))
             bound_sig.append((bt, tuple((jnp.dtype(l.dtype),
                                          tuple(l.shape)) for l in bl)))

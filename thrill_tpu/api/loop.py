@@ -1,0 +1,1015 @@
+"""Device-resident iteration: LoopPlan capture, replay and donation.
+
+Thrill's iterative examples drive a Collapse'd loop DIA per iteration
+(reference: examples/page_rank/page_rank.hpp:71-131) — and so did this
+port: every iteration re-built the Python DIA graph, re-ran the pull
+recursion, re-planned fusion and re-entered the dispatch path. For a
+body whose compiled programs are cheap (the dense-gather join + the
+scatter ReduceToIndex engine), that host-side work IS the iteration
+cost. This module is the Pathways move for data-flow loops
+(arXiv:2203.12533): run the body ONCE through the existing pull
+recursion + fusion planner, record the resulting sequence of compiled
+dispatches as a :class:`LoopPlan` tape, and replay the tape for the
+remaining iterations with the loop-carried buffers threaded through —
+zero graph construction, zero re-planning, zero host round trips for
+iterations 2..N.
+
+How the tape stays correct:
+
+* Recording happens at the ONE choke point every device program passes
+  through (``parallel.mesh._CountedJit.__call__``). Each recorded call
+  classifies its arguments: a loop-carry leaf, the output of an
+  earlier recorded call, or a CONSTANT (anything else — materialized
+  upstream shards, ``put_small``-cached plan arrays, Bind operands).
+  Classification is by buffer identity, so the capture first copies
+  every carry leaf into a fresh buffer: an initial carry that aliases
+  a closure constant of the body (or another carry slot) must not get
+  the constant misclassified as loop-varying.
+* Dataflow pruning: calls whose outputs never reach the loop carry are
+  dropped; calls that are needed but do NOT depend on the carry are
+  iteration-invariant — their captured outputs become constants and
+  the calls are never re-run (this is what makes in-body pulls of
+  Keep'd upstream tables free on replay).
+* A carry-out leaf that is neither a recorded output nor a carry
+  passthrough means the body computed state OUTSIDE the recorded
+  dispatch stream (eager host math) — the capture is rejected loudly
+  and the loop falls back to plain per-iteration execution.
+* The tape assumes per-iteration plan values (exchange send matrices,
+  ZipWithIndex offsets, join capacities) are ITERATION-INVARIANT —
+  true for the fixed-shape loops this layer targets (PageRank,
+  k-means, SGD) where every such value derives from counts that do not
+  change across iterations. ``THRILL_TPU_LOOP_REPLAY=0`` restores the
+  exact per-iteration planning behavior.
+* KNOWN BLIND SPOT — carry-dependent Python control flow: a body that
+  branches on a scalar it computes with EAGER jnp math and converts
+  directly (``if float(jnp.sum(x)) < eps``, ``bool()``, ``.item()``,
+  ``np.asarray()`` on an eager result) freezes the iteration-1 branch
+  into the tape. The eager value never feeds a recorded dispatch (so
+  the constant-provenance guard never sees it) and bypasses
+  ``mex.fetch`` (so the fetch taint never fires) — scalar conversion
+  on a raw ``jax.Array`` is the one host read this layer cannot
+  intercept. Convergence checks belong OUTSIDE ``Iterate`` (run a
+  fixed block of iterations, test, repeat — the recipe in
+  examples/k_means.py), or read loop data through DIA actions /
+  ``mex.fetch``, both of which reject the capture loudly.
+
+Buffer donation: on replayed dispatches the previous iteration's
+carry and intermediates are owned by the loop, so their HBM is donated
+back to XLA (``donate_argnums`` twins of the compiled programs) instead
+of copied — disabled automatically on backends without donation
+support (XLA:CPU no-ops with a warning), while fault injection is
+armed (a retried dispatch must not have consumed its inputs), for the
+first replay (whose carry the capture graph still references), and for
+a carry that was just sealed into a checkpoint epoch.
+
+Whole-loop lowering: a body that collapses to ONE fused dispatch — no
+exchange, no host fallback, every argument a carry leaf or a constant
+— is lowered into a single ``jax.jit(lax.fori_loop)`` program over the
+remaining iterations: one dispatch for the whole loop.
+
+Failure semantics: every replayed iteration passes the
+``api.loop.replay`` fault site; an injected or real dispatch failure
+logs ``event=loop_replay_fallback``, counts in
+``ctx.overall_stats()['loop_replay_fallbacks']`` and degrades to full
+re-planning (the body runs again through the pull recursion, which
+re-captures), so a broken tape can slow the loop down but never
+corrupt it. ``Iterate(..., checkpoint_every=k)`` seals the carry into
+a durable epoch every k iterations via api/checkpoint.py; a resumed
+run restores the newest loop epoch and continues from the next
+iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..common import faults
+from ..data.shards import DeviceShards, HostShards
+from .dia import DIA
+from .dia_base import DIABase
+
+_F_REPLAY = faults.declare("api.loop.replay")
+
+
+def replay_enabled() -> bool:
+    """THRILL_TPU_LOOP_REPLAY=0 restores plain per-iteration planning."""
+    return os.environ.get("THRILL_TPU_LOOP_REPLAY", "1") not in (
+        "0", "off", "false")
+
+
+def donation_enabled() -> bool:
+    """THRILL_TPU_LOOP_DONATE overrides; default: on where XLA supports
+    input-output aliasing (donation on XLA:CPU is a no-op + warning)."""
+    v = os.environ.get("THRILL_TPU_LOOP_DONATE")
+    if v is not None:
+        return v not in ("0", "off", "false")
+    return jax.default_backend() != "cpu"
+
+
+def fori_enabled() -> bool:
+    """THRILL_TPU_LOOP_FORI=0 keeps replay per-iteration (tape calls
+    dispatched one by one) instead of lowering the remaining
+    iterations into one whole-loop ``lax.fori_loop`` program."""
+    return os.environ.get("THRILL_TPU_LOOP_FORI", "1") not in (
+        "0", "off", "false")
+
+
+# ----------------------------------------------------------------------
+# tape capture
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Call:
+    """One recorded dispatch: the counted-jit callable plus classified
+    argument references.  ``arg_refs``: ("carry", slot) | ("val",
+    (call_idx, out_idx)) | ("const", buffer) | ("tree", treedef,
+    [leaf refs]) for pytree arguments that MIX loop-owned leaves with
+    constants (a jit_cached body called on the carry dict).  Filled
+    during analysis: ``donate_pos`` — argument positions whose buffers
+    are loop-owned and dead after this call."""
+    fn: Any
+    arg_refs: List[Tuple]
+    out_buffers: List[Any]
+    donate_pos: Tuple[int, ...] = ()
+
+
+def _leaf_refs(refs):
+    """Iterate the leaf-level refs of an arg_refs list (trees
+    flattened)."""
+    for ref in refs:
+        if ref[0] == "tree":
+            for s in ref[2]:
+                yield s
+        else:
+            yield ref
+
+
+class _Recorder:
+    """Installed as ``mex.loop_recorder`` around the capture iteration's
+    body run; sees every ``_CountedJit`` dispatch."""
+
+    def __init__(self, carry_ids: Dict[int, int],
+                 known: Optional[list] = None) -> None:
+        self.carry_ids = carry_ids
+        self.calls: List[_Call] = []
+        self.produced: Dict[int, Tuple[int, int]] = {}
+        self.plan_reads: set = set()     # call idxs fetched to host
+        self.dispatch_s = 0.0            # issue time inside dispatches
+        self.dirty: Optional[str] = None
+        # constant provenance: device arrays live BEFORE the capture
+        # iteration (upstream tables, plan caches, Bind operands) and
+        # host uploads made during it (mesh.put blesses) are legitimate
+        # tape constants; any OTHER array created during the body is
+        # eager device math whose value could depend on the carry — a
+        # tape would freeze it at iteration-1 values, so reject. The
+        # snapshot holds WEAK refs so it cannot pin the process's HBM
+        # through the capture iteration; lookups verify identity, so a
+        # pre-live array that dies and hands its id to a fresh eager
+        # result reads as unknown (reject — slow but correct).
+        self._known: Dict[int, Any] = {}
+        for a in (known or []):
+            try:
+                self._known[id(a)] = weakref.ref(a)
+            except TypeError:
+                self._known[id(a)] = (lambda a=a: a)
+
+    def bless(self, buf) -> None:
+        """mesh.put uploaded ``buf`` during this capture. Blessed
+        buffers are held strongly: the tape's bound args reference
+        them anyway, and a blessing must not silently expire."""
+        self._known[id(buf)] = (lambda buf=buf: buf)
+
+    def _is_known(self, a) -> bool:
+        r = self._known.get(id(a))
+        return r is not None and r() is a
+
+    def on_fetch(self, arr) -> None:
+        """Host plan logic fetched ``arr`` during the capture run. If a
+        recorded dispatch produced it, the body's between-dispatch
+        host code READ loop data — remember the producer so analysis
+        can reject the tape when that producer is carry-dependent
+        (its fetched value would vary per iteration: a data-dependent
+        exchange send matrix, a join size agreement). A fetched CARRY
+        leaf is carry-dependent by definition (e.g. the carry's device
+        counts sizing an exchange) — reject outright."""
+        if id(arr) in self.carry_ids:
+            self.dirty = ("host plan logic fetched a carry leaf "
+                          "during capture (carry-dependent plan)")
+            return
+        src = self.produced.get(id(arr))
+        if src is not None:
+            self.plan_reads.add(src[0])
+
+    def _leaf_ref(self, a) -> Optional[Tuple]:
+        slot = self.carry_ids.get(id(a))
+        if slot is not None:
+            return ("carry", slot)
+        if id(a) in self.produced:
+            return ("val", self.produced[id(a)])
+        if isinstance(a, np.ndarray):
+            # a host array feeding a dispatch may be a fetched copy
+            # of loop-VARIANT data (multi-controller egress); a
+            # tape would freeze it — reject the capture instead
+            self.dirty = ("numpy argument entered a recorded "
+                          "dispatch (host round trip in the body)")
+            return None
+        if isinstance(a, jax.Array) and self._known \
+                and not self._is_known(a):
+            # created during the body but not by a recorded dispatch
+            # or a host upload: eager device math, possibly over the
+            # carry — its frozen value would corrupt every replay
+            self.dirty = ("eager device math fed a recorded dispatch "
+                          "during capture (unrecorded jax op in the "
+                          "body?)")
+            return None
+        return ("const", a)
+
+    def on_call(self, fn, args, kwargs, out) -> None:
+        if self.dirty is not None:
+            return
+        if kwargs:
+            self.dirty = "dispatch with keyword arguments"
+            return
+        refs: List[Tuple] = []
+        for a in args:
+            leaves, td = jax.tree.flatten(a)
+            if len(leaves) == 1 and leaves[0] is a:
+                ref = self._leaf_ref(a)
+                if ref is None:
+                    return
+                refs.append(ref)
+                continue
+            subs = []
+            for l in leaves:
+                s = self._leaf_ref(l)
+                if s is None:
+                    return
+                subs.append(s)
+            if all(s[0] == "const" for s in subs):
+                refs.append(("const", a))     # wholly-constant pytree
+            else:
+                refs.append(("tree", td, subs))
+        out_leaves = jax.tree.leaves(out)
+        idx = len(self.calls)
+        for j, o in enumerate(out_leaves):
+            self.produced[id(o)] = (idx, j)
+        self.calls.append(_Call(fn, refs, out_leaves))
+
+
+# ----------------------------------------------------------------------
+# the plan
+# ----------------------------------------------------------------------
+
+class LoopPlan:
+    """A replayable tape over one loop iteration.
+
+    ``carry_out``: per carry-leaf reference — ("val", (i, j)) into the
+    live tape or ("carry", s) passthrough. ``counts`` (shards mode):
+    the iteration-invariant host counts of the carry, or None when the
+    counts thread through the tape as a device leaf."""
+
+    def __init__(self, mex, calls: List[_Call], carry_out: List[Tuple],
+                 n_carry: int, plan_reads: Optional[set] = None,
+                 name: Optional[str] = None) -> None:
+        self.mex = mex
+        self.calls = calls
+        self.carry_out = carry_out
+        self.n_carry = n_carry
+        self.name = name
+        self.plan_reads = plan_reads or set()
+        # set by _analyze when the tape cannot be replayed safely
+        self.invalid: Optional[str] = None
+        # shards-mode carry counts: the iteration-invariant host counts
+        # replayed carries inherit (None = counts thread through the
+        # tape as the last carry leaf)
+        self.counts: Optional[np.ndarray] = None
+        self.pruned_invariant = 0
+        self.pruned_dead = 0
+        self._fori: Any = None           # lazily built whole-loop program
+        self._fori_failed = False
+        self._analyze()
+
+    # -- dataflow analysis ---------------------------------------------
+    def _analyze(self) -> None:
+        calls = self.calls
+        n = len(calls)
+        # carry dependence (forward)
+        dep = [False] * n
+        for i, c in enumerate(calls):
+            for ref in _leaf_refs(c.arg_refs):
+                if ref[0] == "carry" or (ref[0] == "val"
+                                         and dep[ref[1][0]]):
+                    dep[i] = True
+                    break
+        # host plan logic that read a CARRY-DEPENDENT value during
+        # capture (data-dependent exchange send matrix, a size
+        # agreement) would be frozen by the tape at iteration-1 values
+        # — reject instead; iteration-invariant reads (index-range
+        # exchange sizing over a fixed key column) are unverifiable by
+        # dataflow alone, so dependence is judged conservatively
+        for i in self.plan_reads:
+            if dep[i]:
+                self.invalid = ("host plan logic read a "
+                                "carry-dependent value during capture "
+                                "(data-dependent exchange plan?)")
+                break
+        # liveness (backward from the carry outputs)
+        needed = [False] * n
+        stack = [ref[1][0] for ref in self.carry_out if ref[0] == "val"]
+        while stack:
+            i = stack.pop()
+            if needed[i]:
+                continue
+            needed[i] = True
+            for ref in _leaf_refs(calls[i].arg_refs):
+                if ref[0] == "val":
+                    stack.append(ref[1][0])
+        live_idx = [i for i in range(n) if needed[i] and dep[i]]
+        self.pruned_invariant = sum(1 for i in range(n)
+                                    if needed[i] and not dep[i])
+        self.pruned_dead = n - sum(needed)
+        remap = {old: new for new, old in enumerate(live_idx)}
+
+        def rewrite(ref):
+            if ref[0] == "val":
+                src, j = ref[1]
+                if src in remap:
+                    return ("val", (remap[src], j))
+                # invariant producer: its captured output IS the
+                # value for every future iteration
+                return ("const", calls[src].out_buffers[j])
+            if ref[0] == "tree":
+                return ("tree", ref[1], [rewrite(s) for s in ref[2]])
+            return ref
+
+        live: List[_Call] = []
+        for i in live_idx:
+            c = calls[i]
+            live.append(_Call(c.fn, [rewrite(r) for r in c.arg_refs],
+                              c.out_buffers))
+        out: List[Tuple] = []
+        for ref in self.carry_out:
+            if ref[0] == "val":
+                src, j = ref[1]
+                if src in remap:
+                    out.append(("val", (remap[src], j)))
+                else:
+                    # invariant producer: this carry leaf is the SAME
+                    # value every iteration — fold it, like rewrite()
+                    out.append(("const", calls[src].out_buffers[j]))
+            else:
+                out.append(ref)
+        self.calls = live
+        self.carry_out = out
+        self._mark_donations()
+        # live calls must not pin the capture iteration's HBM: their
+        # recorded outputs are never read again (invariant producers'
+        # outputs were just folded into ("const", ...) refs above)
+        for c in self.calls:
+            c.out_buffers = None
+        # which (call, out) pairs later steps / the carry actually read
+        used: set = set()
+        for c in self.calls:
+            for ref in _leaf_refs(c.arg_refs):
+                if ref[0] == "val":
+                    used.add(ref[1])
+        for ref in self.carry_out:
+            if ref[0] == "val":
+                used.add(ref[1])
+        self.used_outputs = used
+
+    def _mark_donations(self) -> None:
+        """Static donation plan: an argument buffer is donatable when
+        it is loop-owned (a carry leaf or a live call's output), this
+        is its LAST use in the iteration, and it does not survive into
+        the next carry. Pytree arguments stay pinned (jax donates whole
+        arguments; a mixed tree would donate its constants too)."""
+        survivors = set()
+        for slot, ref in enumerate(self.carry_out):
+            if ref[0] in ("carry", "val"):
+                survivors.add((ref[0], ref[1]))
+            else:
+                # folded-const carry-out: slot hands back the SAME
+                # buffer every iteration (and holds it on entry from
+                # the previous iteration's carry) — donating it would
+                # free a buffer the loop still owns
+                survivors.add(("carry", slot))
+        by_ref: Dict[Tuple, List[int]] = {}
+        for slot, ref in enumerate(self.carry_out):
+            if ref[0] in ("carry", "val"):
+                by_ref.setdefault((ref[0], ref[1]), []).append(slot)
+        for slots in by_ref.values():
+            if len(slots) > 1:
+                # aliased carry-out: these slots hand back ONE buffer,
+                # so the next iteration's incoming carry leaves alias —
+                # donating any one view would free the buffer another
+                # slot still reads mid-iteration
+                for s in slots:
+                    survivors.add(("carry", s))
+        last_use: Dict[Tuple, Tuple[int, int]] = {}
+        for i, c in enumerate(self.calls):
+            seen_here: Dict[Tuple, int] = {}
+            for p, ref in enumerate(c.arg_refs):
+                if ref[0] not in ("carry", "val"):
+                    continue
+                key = (ref[0], ref[1])
+                seen_here[key] = seen_here.get(key, 0) + 1
+                last_use[key] = (i, p)
+            # a buffer passed twice to one call cannot be donated;
+            # neither can one this call ALSO reads through a pytree
+            # argument (donating would free a buffer the same dispatch
+            # reads) — position -1 never matches a donatable slot
+            for key, k in seen_here.items():
+                if k > 1:
+                    last_use.pop(key, None)
+            for ref in c.arg_refs:
+                if ref[0] == "tree":
+                    for s in ref[2]:
+                        if s[0] != "const":
+                            last_use[(s[0], s[1])] = (i, -1)
+        for i, c in enumerate(self.calls):
+            pos = tuple(sorted(
+                p for p, ref in enumerate(c.arg_refs)
+                if ref[0] in ("carry", "val")
+                and (ref[0], ref[1]) not in survivors
+                and last_use.get((ref[0], ref[1])) == (i, p)))
+            c.donate_pos = pos
+
+    # -- execution ------------------------------------------------------
+    def replay(self, carry: List[Any], donate: bool,
+               donate_carry: bool = True) -> List[Any]:
+        """Run one tape iteration over ``carry`` leaves; returns the
+        next carry leaves. ``donate_carry=False`` pins the incoming
+        carry buffers (first replay; the iteration after a checkpoint
+        seal)."""
+        mex = self.mex
+        vals: Dict[Tuple[int, int], Any] = {}
+
+        def resolve(ref):
+            kind = ref[0]
+            if kind == "const":
+                return ref[1]
+            if kind == "carry":
+                return carry[ref[1]]
+            if kind == "val":
+                return vals[ref[1]]
+            return jax.tree.unflatten(ref[1],
+                                      [resolve(s) for s in ref[2]])
+
+        for i, call in enumerate(self.calls):
+            args = [resolve(ref) for ref in call.arg_refs]
+            fn = call.fn
+            if donate and call.donate_pos:
+                pos = call.donate_pos
+                if not donate_carry:
+                    pos = tuple(p for p in pos
+                                if call.arg_refs[p][0] != "carry")
+                if pos:
+                    fn = call.fn.donating(pos)
+                    mex.stats_loop_donated_bytes += sum(
+                        getattr(args[p], "nbytes", 0) for p in pos)
+            out = fn(*args)
+            for j, o in enumerate(jax.tree.leaves(out)):
+                if (i, j) in self.used_outputs:
+                    vals[(i, j)] = o
+        return [carry[ref[1]] if ref[0] == "carry"
+                else ref[1] if ref[0] == "const"
+                else vals[ref[1]] for ref in self.carry_out]
+
+    # -- whole-loop fori_loop lowering ---------------------------------
+    def fori_eligible(self) -> bool:
+        """Every recorded call retains its raw (pre-jit) program, so
+        the whole tape can be re-traced inside ONE ``lax.fori_loop``
+        body — exchanges and host fallbacks never record, so any
+        all-device tape qualifies."""
+        return bool(self.calls) and all(
+            getattr(c.fn, "raw", None) is not None for c in self.calls)
+
+    def _fori_consts(self) -> Tuple:
+        """Constant operands in tape order (tree args contribute their
+        const LEAVES, in flatten order — the fori body consumes them
+        from the same traversal)."""
+        out = []
+        for c in self.calls:
+            for ref in c.arg_refs:
+                if ref[0] == "const":
+                    out.append(ref[1])
+                elif ref[0] == "tree":
+                    out.extend(s[1] for s in ref[2] if s[0] == "const")
+        return tuple(out)
+
+    def run_fori(self, carry: List[Any], k: int) -> Optional[List[Any]]:
+        """Lower the remaining ``k`` iterations into ONE jitted
+        ``lax.fori_loop`` dispatch over the whole tape, or return None
+        when the body cannot be lowered (version/topology limits).
+
+        The incoming carry is never donated here: fori only ever runs
+        as the FIRST replay after a (re)capture, whose carry buffers
+        the capture graph still references."""
+        if self._fori_failed or not self.fori_eligible():
+            return None
+        calls = self.calls
+        out_slots: List[Tuple] = list(self.carry_out)
+        used = self.used_outputs
+        cached = self._fori
+        if cached is None or cached[1] != k:
+            # two plans with the same per-call programs and wiring are
+            # the SAME loop — share one compiled fori program through
+            # the mesh cache (a fresh capture per driver call must not
+            # recompile the whole-loop dispatch)
+            def ref_sig(r):
+                if r[0] == "const":
+                    return ("const",)
+                if r[0] == "tree":
+                    return ("tree", r[1],
+                            tuple(ref_sig(s) for s in r[2]))
+                return r
+
+            # a const carry-out leaf is CLOSED OVER by the traced body
+            # (folded invariant producer), so the compiled program is
+            # keyed on that buffer's identity — never shared across
+            # captures holding different values
+            out_sig = tuple(("const", id(r[1])) if r[0] == "const"
+                            else r for r in out_slots)
+            key = ("loop_fori",
+                   tuple(getattr(c.fn, "cache_key", None)
+                         or ("rawid", id(c.fn.raw)) for c in calls),
+                   tuple(tuple(ref_sig(r) for r in c.arg_refs)
+                         for c in calls),
+                   tuple(sorted(used)), out_sig, k)
+
+            built = []
+
+            def build():
+                built.append(True)
+                # the compiled closure lives in the mesh cache for the
+                # MESH's lifetime — it must not pin this plan's const
+                # ARGUMENT buffers (they arrive through the runtime
+                # ``consts`` operand; only const carry-OUT leaves are
+                # intentionally closed over, that's what the id-keying
+                # above is for)
+                def strip(r):
+                    if r[0] == "const":
+                        return ("const", None)
+                    if r[0] == "tree":
+                        return ("tree", r[1], [strip(s) for s in r[2]])
+                    return r
+                call_plan = [(c.fn.raw, [strip(r) for r in c.arg_refs])
+                             for c in calls]
+
+                def loop_fn(carry_t, consts):
+                    def body(_, c):
+                        ci = iter(consts)
+                        vals: Dict[Tuple[int, int], Any] = {}
+
+                        def resolve(ref):
+                            if ref[0] == "carry":
+                                return c[ref[1]]
+                            if ref[0] == "val":
+                                return vals[ref[1]]
+                            if ref[0] == "const":
+                                return next(ci)
+                            return jax.tree.unflatten(
+                                ref[1], [resolve(s) for s in ref[2]])
+
+                        for i, (raw, refs) in enumerate(call_plan):
+                            args = [resolve(r) for r in refs]
+                            leaves = jax.tree.leaves(raw(*args))
+                            for j, o in enumerate(leaves):
+                                if (i, j) in used:
+                                    vals[(i, j)] = o
+                        return tuple(
+                            c[ref[1]] if ref[0] == "carry"
+                            else ref[1] if ref[0] == "const"
+                            else vals[ref[1]] for ref in out_slots)
+
+                    return lax.fori_loop(0, k, body, tuple(carry_t))
+
+                return jax.jit(loop_fn)
+
+            try:
+                fn = self.mex.cached(key, build)
+                if built:                        # fresh program: probe
+                    fn.lower(tuple(carry), self._fori_consts())
+            except Exception as e:               # version/topology limits
+                self._fori_failed = True
+                log = getattr(self.mex, "logger", None)
+                if log is not None and log.enabled:
+                    log.line(event="loop_fori_unavailable",
+                             loop=self.name, error=repr(e)[:200])
+                return None
+            self._fori = (fn, k)
+        fn = self._fori[0]
+        self.mex.stats_dispatches += 1
+        out = fn(tuple(carry), self._fori_consts())
+        return list(out)
+
+
+# ----------------------------------------------------------------------
+# carry plumbing
+# ----------------------------------------------------------------------
+
+class _LoopCarryNode(DIABase):
+    """Source node wrapping the loop-carried shards of one iteration."""
+
+    def __init__(self, ctx, shards) -> None:
+        super().__init__(ctx, "LoopCarry")
+        self._carry = shards
+
+    def compute(self):
+        return self._carry
+
+
+def _carry_dia(ctx, shards) -> DIA:
+    return DIA(_LoopCarryNode(ctx, shards))
+
+
+def _shards_carry_ids(shards: DeviceShards) -> Tuple[Dict[int, int], int]:
+    leaves = jax.tree.leaves(shards.tree)
+    ids = {id(l): s for s, l in enumerate(leaves)}
+    n = len(leaves)
+    if shards._counts_dev is not None and shards._counts_host is None:
+        ids[id(shards._counts_dev)] = n
+        n += 1
+    return ids, n
+
+
+def _leaf_sig(leaves: Sequence[Any]) -> Tuple:
+    return tuple((jnp.dtype(l.dtype), tuple(l.shape)) for l in leaves)
+
+
+# ----------------------------------------------------------------------
+# Iterate
+# ----------------------------------------------------------------------
+
+def Iterate(ctx, body: Callable, carry, n: int, *, name: str = "loop",
+            checkpoint_every: Optional[int] = None):
+    """Run ``body`` ``n`` times with ``carry`` threaded through,
+    replaying a captured LoopPlan for iterations 2..N.
+
+    ``carry`` is either a DIA / DeviceShards (``body(dia) -> dia``, the
+    Collapse-loop idiom) or a pytree of device arrays (``body(tree) ->
+    tree``, the k-means centroid idiom). The body must be
+    iteration-index-independent: same graph, same shapes every
+    iteration (the capture contract; violations reject the capture and
+    fall back to plain per-iteration planning, they cannot corrupt —
+    with ONE exception the recorder cannot see: Python control flow on
+    a directly-converted eager scalar (``if float(jnp.sum(x)) < eps``)
+    bakes the iteration-1 branch into the tape; see the module
+    docstring's "known blind spot" and keep convergence checks outside
+    ``Iterate``).
+
+    ``checkpoint_every=k`` (DIA/DeviceShards carries only — a pytree
+    carry raises) seals the carry into a durable epoch every k
+    iterations when the Context has a CheckpointManager
+    (THRILL_TPU_CKPT_DIR); a resumed run restores the newest loop epoch
+    for ``name`` and continues after it. Returns the final carry in
+    the same form it was given (DIA in, DIA out)."""
+    if n <= 0:
+        return carry
+    mex = ctx.mesh_exec
+    log = ctx.logger
+    mgr = getattr(ctx, "checkpoint", None)
+
+    # -- normalize the carry -------------------------------------------
+    dia_mode = isinstance(carry, (DIA, DIABase))
+    if dia_mode:
+        if isinstance(carry, DIABase):
+            carry = DIA(carry)
+        state = carry._link().pull(consume=True)
+    elif isinstance(carry, (DeviceShards, HostShards)):
+        dia_mode = True
+        state = carry
+    else:
+        state = jax.tree.map(jnp.asarray, carry)
+
+    if checkpoint_every and not dia_mode:
+        # sealing requires the shard-file epoch path (DIA/DeviceShards
+        # carries); silently skipping would deliver NO durability the
+        # caller asked for — refuse up front instead
+        raise ValueError(
+            "Iterate(checkpoint_every=...) requires a DIA/DeviceShards "
+            "carry; pytree carries cannot be sealed into checkpoint "
+            "epochs (wrap the state in a DIA, or drop checkpoint_every)")
+
+    start = 0
+    if mgr is not None and checkpoint_every and dia_mode:
+        restored = mgr.try_restore_loop(name)
+        if restored is not None:
+            state, start = restored
+            start += 1                       # resume AFTER the epoch
+
+    can_replay = (replay_enabled()
+                  and not (mgr is not None and mgr.auto)
+                  and (not dia_mode or isinstance(state, DeviceShards)))
+
+    def run_body(st):
+        """One plain iteration: st -> next st, through the full pull
+        recursion + fusion planner."""
+        if dia_mode:
+            out = body(_carry_dia(ctx, st))
+            if isinstance(out, DIABase):
+                out = DIA(out)
+            return out._link().pull(consume=True)
+        return body(st)
+
+    def seal(st, i):
+        if mgr is not None and checkpoint_every and dia_mode \
+                and (i + 1) % checkpoint_every == 0 and i + 1 < n:
+            mgr.save_loop_state(name, i, st)
+            return True
+        return False
+
+    plan: Optional[LoopPlan] = None
+    donate = donation_enabled()
+    miss_streak = 0          # consecutive capture misses: a miss is
+    # almost always deterministic (eager body math, data-dependent
+    # plan, W>1 shuffle) — re-attempting burns a full carry copy +
+    # recorder pass per iteration; two strikes and the rest of the
+    # loop runs plain (one retry tolerates a first iteration whose
+    # carry shape was still stabilizing)
+    report = {"name": name, "iters": n - start, "captures": 0, "replays": 0,
+              "fori_iters": 0, "fallbacks": 0, "capture_s": 0.0,
+              "replay_s": 0.0, "calls": 0, "pruned": 0,
+              "donated_bytes0": mex.stats_loop_donated_bytes}
+    i = start
+    while i < n:
+        if plan is None:
+            # ---- capture (or plain) iteration ------------------------
+            t0 = time.perf_counter()
+            d0 = mex.stats_dispatches
+            if can_replay and miss_streak < 2:
+                state, plan = _capture(ctx, run_body, state,
+                                       name=name, it=i)
+                if plan is not None:
+                    miss_streak = 0
+                    mex.stats_loop_plan_builds += 1
+                    report["captures"] += 1
+                    report["calls"] = len(plan.calls)
+                    report["pruned"] = (plan.pruned_invariant
+                                        + plan.pruned_dead)
+                else:
+                    miss_streak += 1
+            else:
+                state = run_body(state)
+            dt = time.perf_counter() - t0
+            report["capture_s"] += dt
+            if log.enabled:
+                log.line(event="iteration", loop=name, iter=i,
+                         mode="capture" if plan is not None else "plain",
+                         seconds=round(dt, 6),
+                         dispatches=mex.stats_dispatches - d0,
+                         plan_calls=(len(plan.calls)
+                                     if plan is not None else None))
+            ckpt = seal(state, i)
+            i += 1
+            fresh_plan = True
+            continue
+
+        # ---- replayed iterations -------------------------------------
+        leaves, treedef = _carry_leaves(state, dia_mode, plan)
+        if leaves is None:
+            plan = None                      # carry shape drifted
+            continue
+        remaining = n - i
+        # whole-loop lowering: only when no checkpoint epoch is due
+        # inside the window (an epoch needs the carry on the host) —
+        # checkpoint_every without a CheckpointManager seals nothing,
+        # so it must not cost the fori lowering either
+        fori_ok = fori_enabled() \
+            and not (checkpoint_every and mgr is not None) \
+            and plan.fori_eligible() and remaining > 1
+        t0 = time.perf_counter()
+        d0 = mex.stats_dispatches
+        try:
+            if faults.REGISTRY.active():
+                faults.check(_F_REPLAY, loop=name, iter=i)
+            if fori_ok:
+                out = plan.run_fori(leaves, remaining)
+                if out is not None:
+                    mex.stats_loop_fori_iters += remaining
+                    report["fori_iters"] += remaining
+                    state = _rebuild_carry(out, treedef, dia_mode,
+                                           mex, plan)
+                    dt = time.perf_counter() - t0
+                    report["replay_s"] += dt
+                    if log.enabled:
+                        log.line(event="loop_replay", loop=name,
+                                 iter=i, iters=remaining, fori=True,
+                                 seconds=round(dt, 6))
+                    i = n
+                    continue
+            out = plan.replay(
+                leaves,
+                donate and not faults.REGISTRY.active(),
+                donate_carry=not fresh_plan and not ckpt)
+        except Exception as e:
+            # LOUD degradation: a failed replayed dispatch falls back
+            # to full re-planning for this iteration (the body path,
+            # which re-captures); the loop slows down, it never lies.
+            # Unless donation already consumed part of the carry mid-
+            # iteration — then there is nothing to re-plan FROM, and
+            # the only honest outcome is a clear error, not a deleted-
+            # array crash deep inside the pull recursion.
+            if any(getattr(l, "is_deleted", lambda: False)()
+                   for l in leaves):
+                raise RuntimeError(
+                    f"loop '{name}' iteration {i}: a replayed dispatch "
+                    f"failed after part of the loop carry was donated; "
+                    f"cannot degrade to re-planning. Re-run with "
+                    f"THRILL_TPU_LOOP_DONATE=0 (or from the last "
+                    f"checkpoint epoch).") from e
+            mex.stats_loop_fallbacks += 1
+            report["fallbacks"] += 1
+            faults.note("recovery", what="loop_replay", loop=name,
+                        iter=i, error=repr(e)[:200])
+            if log.enabled:
+                log.line(event="loop_replay_fallback", loop=name,
+                         iter=i, error=repr(e)[:200])
+            plan = None
+            continue
+        mex.stats_loop_replays += 1
+        report["replays"] += 1
+        state = _rebuild_carry(out, treedef, dia_mode, mex, plan)
+        dt = time.perf_counter() - t0
+        report["replay_s"] += dt
+        if log.enabled:
+            log.line(event="loop_replay", loop=name, iter=i,
+                     dispatches=mex.stats_dispatches - d0,
+                     seconds=round(dt, 6))
+        ckpt = seal(state, i)
+        fresh_plan = False
+        i += 1
+
+    report["donated_bytes"] = (mex.stats_loop_donated_bytes
+                               - report.pop("donated_bytes0"))
+    mex.loop_reports.append(report)
+    if log.enabled:
+        log.line(event="loop_done", **{k: (round(v, 6)
+                                           if isinstance(v, float) else v)
+                                       for k, v in report.items()})
+    if dia_mode:
+        return _carry_dia(ctx, state)
+    return state
+
+
+def _capture(ctx, run_body, state, name="loop", it=0):
+    """Run one body iteration with the tape recorder installed.
+    Returns (next_state, LoopPlan or None)."""
+    mex = ctx.mesh_exec
+    log = ctx.logger
+
+    def miss(reason, out_state):
+        if log.enabled:
+            log.line(event="loop_capture_miss", loop=name, iter=it,
+                     reason=reason)
+        return out_state, None
+
+    # De-alias the carry before recording: classification is by buffer
+    # IDENTITY, so a carry leaf sharing its buffer with a closure
+    # constant of the body (or with another carry slot) would record a
+    # lying ("carry", s) ref for the constant — every leaf gets a
+    # fresh buffer only the carry can be holding. One eager copy per
+    # capture, nothing per replay.
+    try:
+        if isinstance(state, DeviceShards):
+            state.tree = jax.tree.map(jnp.copy, state.tree)
+            if state._counts_dev is not None \
+                    and state._counts_host is None:
+                state._counts_dev = jnp.copy(state._counts_dev)
+        else:
+            leaves = jax.tree.leaves(state)
+            if not all(isinstance(l, jax.Array) for l in leaves):
+                return miss("carry is not device-resident",
+                            run_body(state))
+            state = jax.tree.map(jnp.copy, state)
+    except Exception as e:                 # non-addressable shards
+        return miss(f"carry copy failed ({e!r})", run_body(state))
+    if isinstance(state, DeviceShards):
+        carry_ids, n_carry = _shards_carry_ids(state)
+    else:
+        leaves = jax.tree.leaves(state)
+        carry_ids = {id(l): s for s, l in enumerate(leaves)}
+        n_carry = len(leaves)
+    rec = _Recorder(carry_ids, known=list(jax.live_arrays()))
+    prev = mex.loop_recorder
+    if prev is not None:
+        # nested Iterate inside a capturing body: the inner loop's
+        # dispatches bypass the OUTER recorder (this capture replaces
+        # it), so the outer tape would silently skip the whole inner
+        # loop on replay — dirty the outer capture so it rejects
+        # loudly; the inner loop may still capture for itself
+        prev.dirty = "nested Iterate inside a capturing body"
+    mex.loop_recorder = rec
+    try:
+        out_state = run_body(state)
+    finally:
+        mex.loop_recorder = prev
+    if rec.dirty is not None:
+        return miss(rec.dirty, out_state)
+    if mex._pending_checks:
+        # an unresolved deferred validation (un-drained hinted-join
+        # overflow check) cannot be replayed — it would never run
+        return miss("pending deferred validations", out_state)
+
+    # map the produced carry back onto the tape
+    host_counts = None
+    if isinstance(out_state, DeviceShards):
+        if not isinstance(state, DeviceShards):
+            return miss("carry storage changed", out_state)
+        out_leaves = jax.tree.leaves(out_state.tree)
+        in_leaves = jax.tree.leaves(state.tree)
+        if _leaf_sig(out_leaves) != _leaf_sig(in_leaves) \
+                or out_state.cap != state.cap \
+                or (jax.tree.structure(out_state.tree)
+                    != jax.tree.structure(state.tree)):
+            return miss("carry schema/shape drifted", out_state)
+        if state._counts_host is not None:
+            # host-known input counts were baked into the tape's
+            # dispatches as blessed constants — they must provably hold
+            # for EVERY iteration's input, i.e. the body must hand the
+            # same host counts back (then by induction every replay's
+            # input matches the baked values); a count-changing body
+            # with stable leaf shapes/cap would otherwise replay a
+            # silently wrong valid mask
+            if out_state._counts_host is None:
+                return miss("carry counts went device-resident across "
+                            "the iteration (baked host count constants "
+                            "cannot be checked)", out_state)
+            if not np.array_equal(np.asarray(state._counts_host),
+                                  np.asarray(out_state._counts_host)):
+                return miss("carry counts changed across the iteration "
+                            "(baked count constants would lie on "
+                            "replay)", out_state)
+        if out_state._counts_host is not None:
+            host_counts = out_state._counts_host
+        else:
+            out_leaves = out_leaves + [out_state._counts_dev]
+    elif isinstance(out_state, HostShards):
+        return miss("body produced host storage", out_state)
+    else:
+        out_leaves = jax.tree.leaves(out_state)
+        if _leaf_sig(out_leaves) != _leaf_sig(jax.tree.leaves(state)) \
+                or (jax.tree.structure(out_state)
+                    != jax.tree.structure(state)):
+            return miss("carry schema/shape drifted", out_state)
+    carry_out = []
+    for leaf in out_leaves:
+        if id(leaf) in rec.produced:
+            carry_out.append(("val", rec.produced[id(leaf)]))
+        elif id(leaf) in carry_ids:
+            carry_out.append(("carry", carry_ids[id(leaf)]))
+        else:
+            return miss("carry leaf produced outside the recorded "
+                        "dispatch stream (eager host math in the "
+                        "body?)", out_state)
+    plan = LoopPlan(mex, rec.calls, carry_out, n_carry, name=name,
+                    plan_reads=rec.plan_reads)
+    if plan.invalid is not None:
+        return miss(plan.invalid, out_state)
+    if host_counts is not None:
+        plan.counts = host_counts.copy()
+    if log.enabled:
+        log.line(event="loop_plan", loop=name, calls=len(plan.calls),
+                 pruned_invariant=plan.pruned_invariant,
+                 pruned_dead=plan.pruned_dead,
+                 fori=plan.fori_eligible(),
+                 donatable=sum(len(c.donate_pos) for c in plan.calls))
+    return out_state, plan
+
+
+def _carry_leaves(state, dia_mode, plan):
+    """Current carry as tape-slot-ordered leaves (the capture's input
+    convention); (None, None) when the state no longer matches."""
+    if dia_mode:
+        leaves = list(jax.tree.leaves(state.tree))
+        treedef = jax.tree.structure(state.tree)
+        if plan.n_carry == len(leaves) + 1:
+            # the tape threads device-resident counts as a carry slot
+            leaves.append(state.counts_device())
+        elif plan.n_carry != len(leaves):
+            return None, None
+        return leaves, treedef
+    leaves = jax.tree.leaves(state)
+    if len(leaves) != plan.n_carry:
+        return None, None
+    return leaves, jax.tree.structure(state)
+
+
+def _rebuild_carry(out_leaves, treedef, dia_mode, mex, plan):
+    if not dia_mode:
+        return jax.tree.unflatten(treedef, out_leaves)
+    if plan.counts is not None:
+        tree = jax.tree.unflatten(treedef, out_leaves)
+        return DeviceShards(mex, tree, plan.counts.copy())
+    tree = jax.tree.unflatten(treedef, out_leaves[:-1])
+    return DeviceShards(mex, tree, out_leaves[-1])

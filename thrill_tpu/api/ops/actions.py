@@ -76,6 +76,39 @@ def AllGatherArrays(dia):
         # only holds single-controller, where the tunnel RTT lives)
         tree = jax.tree.map(mex.fetch, tree)
 
+    leaves, treedef = jax.tree.flatten(tree)
+    if mex.loop_recorder is not None and leaves \
+            and all(isinstance(l, jax.Array) for l in leaves):
+        # under an armed LoopPlan recorder (api/loop.py capture), run
+        # the egress as ONE cached program (slice valid prefixes,
+        # all_gather, concatenate): the whole action is then a
+        # RECORDABLE dispatch, so iterative drivers that close their
+        # loop through AllGatherArrays (k-means centroids) replay
+        # device-resident. Outside a capture the eager slicing below
+        # is equivalent (and compiles nothing), so dispatch budgets
+        # are untouched. Keyed on the counts vector — static shapes;
+        # loop-invariant counts compile once.
+        from jax.sharding import PartitionSpec as P
+        cap = shards.cap
+        cnt = tuple(int(c) for c in counts)
+        key = ("allgather_arrays", cap, cnt, treedef,
+               tuple((l.dtype, l.shape[2:]) for l in leaves))
+
+        def build():
+            def f(*ls):
+                outs = []
+                for l in ls:
+                    g = lax.all_gather(l[0], AXIS)      # [W, cap, ...]
+                    parts = [g[w, :cnt[w]] for w in range(W) if cnt[w]]
+                    outs.append(jnp.concatenate(parts, axis=0)
+                                if parts else g[0, :0])
+                return tuple(outs)
+
+            return mex.smap(f, len(leaves), out_specs=P())
+
+        fn = mex.cached(key, build)
+        return jax.tree.unflatten(treedef, list(fn(*leaves)))
+
     def cat(leaf):
         parts = [leaf[w, :int(counts[w])] for w in range(W)
                  if int(counts[w])]

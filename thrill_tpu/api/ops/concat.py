@@ -23,6 +23,7 @@ from ...data import exchange
 from ...data.shards import DeviceShards, HostShards
 from ..dia import DIA
 from ..dia_base import DIABase
+from ...common.partition import dense_range_bounds
 
 
 def rebalance_to_even(mex, parts: List[DeviceShards], token) -> DeviceShards:
@@ -61,8 +62,7 @@ def rebalance_to_even(mex, parts: List[DeviceShards], token) -> DeviceShards:
 
     merged = _local_concat(carriers) if len(carriers) > 1 else carriers[0]
 
-    bounds = np.array([(w * n_total) // W for w in range(W + 1)],
-                      dtype=np.int64)
+    bounds = dense_range_bounds(n_total, W)
     bdev = jnp.asarray(bounds[1:])
 
     def dest(tree, mask, widx):
@@ -171,7 +171,7 @@ class ConcatNode(DIABase):
                      for p in pulls]
             W = pulls[0].num_workers
             flat = [it for p in pulls for l in p.lists for it in l]
-            bounds = [(w * len(flat)) // W for w in range(W + 1)]
+            bounds = dense_range_bounds(len(flat), W).tolist()
             return multiplexer.localize(
                 mex, HostShards(W, [flat[bounds[w]:bounds[w + 1]]
                                     for w in range(W)]))
@@ -191,7 +191,7 @@ class RebalanceNode(DIABase):
                                                    "rebalance-host")
             W = shards.num_workers
             flat = [it for l in shards.lists for it in l]
-            bounds = [(w * len(flat)) // W for w in range(W + 1)]
+            bounds = dense_range_bounds(len(flat), W).tolist()
             return multiplexer.localize(
                 mex, HostShards(W, [flat[bounds[w]:bounds[w + 1]]
                                     for w in range(W)]))

@@ -32,6 +32,7 @@ from ...data import exchange
 from ...data.shards import DeviceShards, HostShards
 from ..dia import DIA
 from ..dia_base import DIABase
+from ...common.partition import dense_range_bounds
 
 
 class GroupByKeyNode(DIABase):
@@ -336,7 +337,7 @@ class GroupToIndexNode(DIABase):
         mex = self.context.mesh_exec
         n = self.size
         index_fn = self.index_fn
-        bounds = [(w * n) // W for w in range(W + 1)]
+        bounds = dense_range_bounds(n, W).tolist()
 
         from ...data import multiplexer
 
@@ -388,8 +389,7 @@ class GroupToIndexNode(DIABase):
         n = self.size
         index_fn, device_fn = self.index_fn, self.device_fn
         neutral = self.neutral
-        bounds = np.array([(w * n) // W for w in range(W + 1)],
-                          dtype=np.int64)
+        bounds = dense_range_bounds(n, W)
 
         if W > 1:
             bounds_dev = jnp.asarray(bounds)

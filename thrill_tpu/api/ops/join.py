@@ -19,8 +19,11 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ...common import hashing
+from ...common.partition import dense_range_bounds
+from ...parallel.mesh import AXIS
 from ...core import keys as keymod
 from ...core import segmented
 from ...data import exchange
@@ -33,11 +36,33 @@ from ..dia_base import DIABase
 class InnerJoinNode(DIABase):
     def __init__(self, ctx, llink, rlink, lkey, rkey, join_fn,
                  location_detection: bool = False,
-                 out_size_hint=None) -> None:
+                 out_size_hint=None, dense_right_index=None) -> None:
         super().__init__(ctx, "InnerJoin", [llink, rlink])
+        if dense_right_index is not None and rkey is not None:
+            # the dense contract DEFINES the right key as the row's
+            # global position; a caller-supplied right key would be
+            # honored by the host path but ignored by the device
+            # gather — storage-dependent results, so refuse it
+            raise ValueError(
+                "InnerJoin: dense_right_index defines the right key as "
+                "the row's dense position; right_key_fn must be None")
         self.lkey = lkey
         self.rkey = rkey
         self.join_fn = join_fn
+        # DENSE INDEX JOIN contract: the right side is a dense table of
+        # exactly ``dense_right_index`` rows whose key at global
+        # position g is g (a ZipWithIndex over a ReduceToIndex/Generate
+        # table — the PageRank rank/degree tables). The join is then a
+        # pure GATHER: no sort, no hash, no exchange — the device
+        # program all_gathers the (small) right table and indexes it by
+        # the left keys. O(n) like the numpy proxy's fancy-indexing,
+        # where the generic sort-merge join pays two XLA argsorts per
+        # call (~43 ms each at 64 k rows on XLA:CPU). Out-of-range left
+        # keys simply produce no pair (inner-join semantics); there is
+        # no overflow to detect, so no deferred check and no size sync
+        # at ANY worker count.
+        self.dense_right_index = (None if dense_right_index is None
+                                  else int(dense_right_index))
         # reference: LocationDetectionTag, api/inner_join.hpp:161-190 —
         # prune items whose key hash exists on only one side before the
         # shuffle (host path)
@@ -74,6 +99,25 @@ class InnerJoinNode(DIABase):
         mex = self.context.mesh_exec
         from ...data import multiplexer
         lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
+        if self.dense_right_index is not None and rkey is None:
+            # dense-index contract on the host path: the right key IS
+            # the row's global position in the dense table (the device
+            # gather's addressing), so enumerate and join on that.
+            # Worker w's first row sits at dense_range_bounds[w] BY THE
+            # CONTRACT — never at the cumulative length of the
+            # preceding lists, which is wrong multi-controller (the
+            # host-storage invariant keeps non-local workers' lists
+            # empty, so cumulative offsets would collapse toward 0)
+            bounds = dense_range_bounds(self.dense_right_index,
+                                        W).tolist()
+            enum_lists = []
+            for w, items in enumerate(right.lists):
+                enum_lists.append([(bounds[w] + i, it)
+                                   for i, it in enumerate(items)])
+            right = HostShards(W, enum_lists)
+            inner = jfn
+            rkey = _enum_key
+            jfn = lambda l, r: inner(l, r[1])  # noqa: E731
         # hash each item once; reuse for detection, pruning and shuffle
         lh = [[hashing.stable_host_hash(_h(lkey(it))) for it in l]
               for l in left.lists]
@@ -173,17 +217,116 @@ class InnerJoinNode(DIABase):
         """Hinted joins stitch (api/fusion.py): both phases trace into
         ONE program, and the plan defers so downstream device ops ride
         in the same dispatch. Un-hinted joins need their host size
-        agreement — a fusion barrier — and stay on the phased path."""
+        agreement — a fusion barrier — and stay on the phased path.
+        Dense-index joins stitch unconditionally (gather, no sync)."""
         from .. import fusion
-        if not fusion.enabled() or self.out_size_hint is None:
+        if not fusion.enabled() or (self.out_size_hint is None
+                                    and self.dense_right_index is None):
             return None
         left = self.parents[0].pull()
         right = self.parents[1].pull()
         if isinstance(left, HostShards) or isinstance(right, HostShards):
             return fusion.wrap(self._compute_host(left, right))
         token = (self.lkey, self.rkey, self.join_fn)
+        if self.dense_right_index is not None:
+            self._check_dense(right)
+            return fusion.FusionPlan(
+                left.mesh_exec, [left, right],
+                head=self._dense_head(right.cap, token))
         left, right = self._prep_device(left, right, token)
         return self._fused_plan(left, right, token)
+
+    # -- dense-index join ----------------------------------------------
+    def _dense_bounds(self) -> np.ndarray:
+        return dense_range_bounds(self.dense_right_index,
+                                  self.context.num_workers)
+
+    def _check_dense(self, right: DeviceShards) -> None:
+        """Validate the dense contract where it is free: host-known
+        right counts must match the dense range split (ReduceToIndex /
+        Generate layouts). Device-resident counts are trusted — forcing
+        a sync here would defeat the point of the gather join."""
+        counts = right._counts_host
+        if counts is None:
+            return
+        expect = np.diff(self._dense_bounds())
+        if not np.array_equal(np.asarray(counts), expect):
+            raise ValueError(
+                f"InnerJoin dense_right_index={self.dense_right_index}: "
+                f"right side counts {np.asarray(counts).tolist()} do not "
+                f"form the dense range split {expect.tolist()}")
+
+    def _dense_head(self, rcap: int, token):
+        from .. import fusion
+        n = self.dense_right_index
+        W = self.context.num_workers
+        bounds = self._dense_bounds()
+        lkey, jfn = self.lkey, self.join_fn
+
+        def trace(fctx, states, _bound):
+            (ltree, lmask), (rtree, _rmask) = states
+            key = jnp.asarray(lkey(ltree)).astype(jnp.int64)
+            if W == 1:
+                rall = rtree
+                gidx = jnp.clip(key, 0, rcap - 1)
+            else:
+                b = jnp.asarray(bounds)
+                w = jnp.clip(jnp.searchsorted(b[1:], key, side="right"),
+                             0, W - 1)
+                gidx = jnp.clip(w * rcap + (key - b[w]),
+                                0, W * rcap - 1)
+                rall = jax.tree.map(
+                    lambda x: lax.all_gather(x, AXIS).reshape(
+                        (W * rcap,) + x.shape[1:]), rtree)
+            rsel = jax.tree.map(lambda x: jnp.take(x, gidx, axis=0),
+                                rall)
+            out = jfn(ltree, rsel)
+            return out, lmask & (key >= 0) & (key < n)
+
+        return fusion.Segment(label="InnerJoin",
+                              token=("join_dense", token, n),
+                              trace=trace, dia_id=self.id)
+
+    def _compute_dense(self, left: DeviceShards,
+                       right: DeviceShards) -> DeviceShards:
+        """Unfused twin of the dense-index gather join (THRILL_TPU_FUSE=0
+        parity path): one program, same gather math, compacted output."""
+        from ...data.shards import compact_valid
+        mex = left.mesh_exec
+        self._check_dense(right)
+        head = self._dense_head(right.cap,
+                                (self.lkey, self.rkey, self.join_fn))
+        lcap, rcap = left.cap, right.cap
+        lleaves, ltd = jax.tree.flatten(left.tree)
+        rleaves, rtd = jax.tree.flatten(right.tree)
+        nl = len(lleaves)
+        key = ("join_dense_solo", (self.lkey, self.rkey, self.join_fn),
+               self.dense_right_index, lcap, rcap, ltd, rtd,
+               tuple((l.dtype, l.shape[2:]) for l in lleaves),
+               tuple((l.dtype, l.shape[2:]) for l in rleaves))
+        holder = {}
+
+        def build():
+            def f(lc, rc, *ls):
+                ltree = jax.tree.unflatten(ltd, [x[0] for x in ls[:nl]])
+                rtree = jax.tree.unflatten(rtd, [x[0] for x in ls[nl:]])
+                lmask = jnp.arange(lcap) < lc[0, 0]
+                rmask = jnp.arange(rcap) < rc[0, 0]
+                tree, mask = head.trace(None, [(ltree, lmask),
+                                               (rtree, rmask)], None)
+                tree, count = compact_valid(tree, mask)
+                out_leaves, out_td = jax.tree.flatten(tree)
+                holder["treedef"] = out_td
+                return (count[None, None].astype(jnp.int32),
+                        *[x[None] for x in out_leaves])
+
+            return mex.smap(f, 2 + nl + len(rleaves)), holder
+
+        fn, h = mex.cached(key, build)
+        out = fn(left.counts_device(), right.counts_device(),
+                 *lleaves, *rleaves)
+        tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
+        return DeviceShards(mex, tree, out[0])
 
     def _fused_plan(self, left: DeviceShards, right: DeviceShards,
                     token):
@@ -333,6 +476,10 @@ class InnerJoinNode(DIABase):
         W = mex.num_workers
         lkey, rkey, jfn = self.lkey, self.rkey, self.join_fn
         token = (lkey, rkey, jfn)
+
+        if self.dense_right_index is not None:
+            # gather join: no partition exchange, no size agreement
+            return self._compute_dense(left, right)
 
         left, right = self._prep_device(left, right, token)
 
@@ -674,9 +821,14 @@ def _h(k):
     return k
 
 
+def _enum_key(t):
+    """Key of a position-enumerated (g, item) pair (dense host path)."""
+    return t[0]
+
+
 def InnerJoin(left: DIA, right: DIA, left_key_fn, right_key_fn,
               join_fn, location_detection: bool = False,
-              out_size_hint=None) -> DIA:
+              out_size_hint=None, dense_right_index=None) -> DIA:
     """``out_size_hint``: optional per-worker upper bound on match
     count; lets the device path skip its blocking size sync. A wrong
     hint is SAFE: overflow is detected before any consumer reads the
@@ -684,8 +836,17 @@ def InnerJoin(left: DIA, right: DIA, left_key_fn, right_key_fn,
     (lineage retry; ``event=recovery`` logged, counted in
     ``ctx.overall_stats()['join_overflow_retries']``). Set
     THRILL_TPU_JOIN_RECOVER=0 to raise instead of recovering — either
-    way it never silently truncates."""
+    way it never silently truncates.
+
+    ``dense_right_index=n``: declares the right side a dense index
+    table — exactly n rows globally, the row at global position g has
+    key g (``table.ZipWithIndex(...)`` over a ReduceToIndex/Generate
+    result). The join then runs as a pure device GATHER: no sort, no
+    hash partition, no exchange, no size sync, at any worker count.
+    Host-known right counts are validated against the dense layout;
+    out-of-range left keys yield no pair (inner-join semantics)."""
     return DIA(InnerJoinNode(left.context, left._link(), right._link(),
                              left_key_fn, right_key_fn, join_fn,
                              location_detection=location_detection,
-                             out_size_hint=out_size_hint))
+                             out_size_hint=out_size_hint,
+                             dense_right_index=dense_right_index))

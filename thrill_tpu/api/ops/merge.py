@@ -45,6 +45,7 @@ from ...data.shards import DeviceShards, HostShards
 from ...parallel.mesh import AXIS
 from ..dia import DIA
 from ..dia_base import DIABase
+from ...common.partition import dense_range_bounds
 from .sort import (OVERSAMPLE, _lex_greater, choose_splitters,
                    quantile_positions)
 
@@ -66,7 +67,7 @@ class MergeNode(DIABase):
             W = pulls[0].num_workers
             seqs = [[it for lst in p.lists for it in lst] for p in pulls]
             merged = list(heapq.merge(*seqs, key=self.key_fn))
-            bounds = [(w * len(merged)) // W for w in range(W + 1)]
+            bounds = dense_range_bounds(len(merged), W).tolist()
             return multiplexer.localize(
                 mex, HostShards(W, [merged[bounds[w]:bounds[w + 1]]
                                     for w in range(W)]))

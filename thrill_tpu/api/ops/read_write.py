@@ -18,6 +18,7 @@ from ...data.shards import DeviceShards, HostShards
 from ...vfs import file_io
 from ..dia import DIA
 from ..dia_base import DIABase
+from ...common.partition import dense_range_bounds
 
 
 class ReadLinesNode(DIABase):
@@ -63,7 +64,7 @@ class ReadLinesNode(DIABase):
         total = fl.total_size
         from ...data.multiplexer import local_worker_set
         local = local_worker_set(self.context.mesh_exec)
-        bounds = [(w * total) // W for w in range(W + 1)]
+        bounds = dense_range_bounds(total, W).tolist()
         lists: List[List[str]] = []
         for w in range(W):
             if w not in local:
@@ -196,7 +197,7 @@ class ReadWordsPackedNode(DIABase):
                      for c in chunks[w]], axis=0)
                     if chunks[w] else empty)
         else:
-            bounds = [(w * total) // W for w in range(W + 1)]
+            bounds = dense_range_bounds(total, W).tolist()
             for w in range(W):
                 if w not in local:
                     per_worker.append(empty)
@@ -246,7 +247,7 @@ class ReadBinaryNode(DIABase):
             else 1
         rec_bytes = rec_items * self.dtype.itemsize
         total_recs = fl.total_size // rec_bytes
-        bounds = [(w * total_recs) // W for w in range(W + 1)]
+        bounds = dense_range_bounds(total_recs, W).tolist()
         # multi-controller: read only this process's workers' ranges;
         # counts derive from bounds, so no agreement round is needed
         from ...data.multiplexer import local_worker_set

@@ -22,6 +22,7 @@ from jax import lax
 import numpy as np
 
 from ...common import hashing
+from ...common.partition import dense_range_bounds
 from ...core import keys as keymod
 from ...core import segmented
 from ...data import exchange
@@ -790,6 +791,93 @@ def _type_min(dt):
             else np.iinfo(dt).min)
 
 
+def _scatter_fold_specs(reduce_fn, treedef, leaves):
+    """Flat FieldReduce specs when the SORT-FREE dense scatter engine
+    applies to every leaf (ReduceToIndex only): "sum"/"min"/"max" need
+    numeric non-bool leaves, "first" works for any dtype (scatter-min
+    arbitration over arrival order + one gather). Returns None when any
+    leaf must go through the sorted segmented engine instead."""
+    from ..functors import FieldReduce
+    if not isinstance(reduce_fn, FieldReduce):
+        return None
+    specs = reduce_fn.flat_spec(treedef)
+    if specs is None:
+        return None
+    for s, l in zip(specs, leaves):
+        if s != "first" and (l.dtype == jnp.bool_
+                             or not (jnp.issubdtype(l.dtype, jnp.number))):
+            return None
+    return specs
+
+
+def _scatter_reduce_apply(tree, valid, local_idx, range_size, out_cap,
+                          specs, neutral):
+    """The dense ReduceToIndex phase as pure scatters — NO sort.
+
+    The sorted engine pays an XLA argsort (~43 ms at 64 k rows on
+    XLA:CPU — the dominant cost of iterative PageRank/k-means bodies);
+    with declarative FieldReduce specs the same result is a direct
+    ``.at[idx].add/min/max`` (deterministic: XLA applies duplicate
+    updates in operand order) plus, for "first" fields, a scatter-min
+    over arrival positions and one gather. Out-of-range indices are
+    DROPPED (routed to the dump slot) rather than clamped like the
+    sorted engine's clip — they cannot occur through the public op
+    (the exchange routes every item into its worker's range).
+
+    ``local_idx``: range-start-relative indices [cap]; ``valid``: item
+    mask [cap]; ``range_size``: traced scalar (this worker's dense
+    rows); ``out_cap``: static padded output rows. Returns the dense
+    output tree ([out_cap, ...] leaves, neutral at untouched rows).
+    """
+    leaves, td = jax.tree.flatten(tree)
+    cap = valid.shape[0]
+    ok = valid & (local_idx >= 0) & (local_idx < range_size)
+    pos = jnp.where(ok, local_idx, out_cap).astype(jnp.int32)
+    win = None          # first-arrival winner per bin, computed lazily
+
+    def winners():
+        nonlocal win
+        if win is None:
+            arrival = jnp.where(ok, jnp.arange(cap, dtype=jnp.int32),
+                                cap)
+            win = jnp.full(out_cap + 1, cap,
+                           jnp.int32).at[pos].min(arrival)[:out_cap]
+        return win
+
+    nleaves = (jax.tree.leaves(neutral) if neutral is not None
+               else [None] * len(leaves))
+    outs = []
+    for s, leaf, nv in zip(specs, leaves, nleaves):
+        trail = leaf.shape[1:]
+        if s == "first":
+            w = winners()
+            col = jnp.take(leaf, jnp.clip(w, 0, cap - 1), axis=0)
+            present = w < cap
+        elif s == "sum":
+            col = jnp.zeros((out_cap + 1,) + trail,
+                            leaf.dtype).at[pos].add(leaf)[:out_cap]
+            if nv is None or not np.any(np.asarray(nv)):
+                # zero neutral == the scatter base: skip the presence
+                # arbitration entirely (the PageRank/k-means hot shape)
+                outs.append(col)
+                continue
+            present = winners() < cap
+        else:
+            big = jnp.asarray(_type_max(np.dtype(leaf.dtype))
+                              if s == "min"
+                              else _type_min(np.dtype(leaf.dtype)),
+                              leaf.dtype)
+            base = jnp.full((out_cap + 1,) + trail, big, leaf.dtype)
+            col = (base.at[pos].min(leaf) if s == "min"
+                   else base.at[pos].max(leaf))[:out_cap]
+            present = winners() < cap
+        fill = (jnp.zeros((), leaf.dtype) if nv is None
+                else jnp.asarray(nv, leaf.dtype))
+        pb = present.reshape(present.shape + (1,) * len(trail))
+        outs.append(jnp.where(pb, col, fill))
+    return jax.tree.unflatten(td, outs)
+
+
 class ReduceToIndexNode(DIABase):
     """Key = dense index in [0, size); output is the dense array with
     ``neutral`` at unused indices (reference: api/reduce_to_index.hpp:60)."""
@@ -802,10 +890,7 @@ class ReduceToIndexNode(DIABase):
         self.neutral = neutral
 
     def _bounds(self):
-        W = self.context.num_workers
-        n = self.size
-        return np.array([(w * n) // W for w in range(W + 1)],
-                        dtype=np.int64)
+        return dense_range_bounds(self.size, self.context.num_workers)
 
     def _exchange_by_index(self, shards, bounds, token):
         W = self.context.num_workers
@@ -824,11 +909,16 @@ class ReduceToIndexNode(DIABase):
         fused segment: sort by index, segmented-reduce, scatter into
         this worker's dense [range_size] rows."""
         from .. import fusion
+        from ...common.config import round_up_pow2
         index_fn, reduce_fn = self.index_fn, self.reduce_fn
         neutral = self.neutral
         W = self.context.num_workers
         local_sizes = (bounds[1:] - bounds[:-1]).astype(np.int64)
-        out_cap = max(1, int(local_sizes.max()))
+        # pow2 cap like every other DeviceShards producer: a dense
+        # result then has the SAME padded shape as a Generate'd table
+        # of the same size, so loop carries (api/loop.py) are shape-
+        # stable from iteration 0 and capture on the first pass
+        out_cap = max(1, round_up_pow2(int(local_sizes.max())))
         ntok = None
         if neutral is not None:
             ntok = (str(jax.tree.structure(neutral)),
@@ -843,8 +933,16 @@ class ReduceToIndexNode(DIABase):
             range_start = starts[widx]
             range_size = sizes[widx]
             leaves, td = jax.tree.flatten(tree)
-            specs = _device_fold_specs(reduce_fn, td, leaves)
             idx = jnp.asarray(index_fn(tree)).astype(jnp.int64)
+            sc = _scatter_fold_specs(reduce_fn, td, leaves)
+            if sc is not None:
+                # declarative specs: sort-free scatter engine (the
+                # iterative hot path — no XLA argsort per iteration)
+                out_tree = _scatter_reduce_apply(
+                    tree, mask, idx - range_start, range_size, out_cap,
+                    sc, neutral)
+                return out_tree, jnp.arange(out_cap) < range_size
+            specs = _device_fold_specs(reduce_fn, td, leaves)
             words = [idx.astype(jnp.uint64)]
             words, tree_s, valid, _ = segmented.sort_by_key_words(
                 words, tree, mask)
@@ -878,11 +976,24 @@ class ReduceToIndexNode(DIABase):
 
     def compute_plan(self):
         from .. import fusion
+        from ..functors import FieldReduce
         from ...core import host_radix
         plan = fusion.pull_plan(self.parents[0])
         bounds = self._bounds()
-        if not plan.stitchable or \
-                host_radix.eligible(self.context.mesh_exec):
+        # declarative FieldReduce specs unlock the sort-free scatter
+        # engine, which beats the native host engine EVEN on the CPU
+        # backend (no device->host demotion, no blocking column fetch,
+        # stays in jax's async dispatch stream — load-bearing for
+        # iterative loop replay, api/loop.py); everything else keeps
+        # the host-radix preference on CPU (XLA's single-core sort is
+        # the wrong engine there). Leaf dtypes are unknown until the
+        # plan materializes, so this gate trusts the FieldReduce shape
+        # alone: a spec the scatter engine later rejects (bool or
+        # non-numeric sum/min/max leaf) still runs correctly through the
+        # fused segment's sorted fallback, just on the slower engine
+        if not plan.stitchable or (
+                host_radix.eligible(self.context.mesh_exec)
+                and not isinstance(self.reduce_fn, FieldReduce)):
             return fusion.wrap(self._compute_on(plan.finish(), bounds))
         W = self.context.num_workers
         token = (self.index_fn, self.reduce_fn, self.size)
@@ -913,16 +1024,26 @@ class ReduceToIndexNode(DIABase):
         if W > 1:
             shards = self._exchange_by_index(shards, bounds, token)
 
-        host = _host_reduce_to_index(shards, index_fn, reduce_fn,
-                                     bounds, self.neutral)
-        if host is not None:
-            return host
-
-        # dense scatter-reduce into the local index range
         cap = shards.cap
         leaves, treedef = jax.tree.flatten(shards.tree)
+        sc = _scatter_fold_specs(reduce_fn, treedef, leaves)
+        if sc is None:
+            # the sort-free scatter engine only takes declarative specs
+            # over numeric leaves (and "first" anywhere); everything it
+            # rejects — generic reduce functions AND FieldReduce specs
+            # with unsupported leaf dtypes — still prefers the native
+            # host engine on the CPU backend over XLA's single-core
+            # sorted path
+            host = _host_reduce_to_index(shards, index_fn, reduce_fn,
+                                         bounds, self.neutral)
+            if host is not None:
+                return host
+
+        # dense scatter-reduce into the local index range (pow2 cap —
+        # shape-stable loop carries, see _fuse_segment)
+        from ...common.config import round_up_pow2
         local_sizes = (bounds[1:] - bounds[:-1]).astype(np.int64)
-        out_cap = max(1, int(local_sizes.max()))
+        out_cap = max(1, round_up_pow2(int(local_sizes.max())))
         neutral = self.neutral
         specs = _device_fold_specs(reduce_fn, treedef, leaves)
         key = ("r2i_post", token, cap, out_cap, treedef,
@@ -933,6 +1054,15 @@ class ReduceToIndexNode(DIABase):
                 valid = jnp.arange(cap) < counts_dev[0, 0]
                 tree = jax.tree.unflatten(treedef, [l[0] for l in ls])
                 idx = jnp.asarray(index_fn(tree)).astype(jnp.int64)
+                if sc is not None:
+                    # sort-free scatter engine (same math as the fused
+                    # segment — FUSE=0 runs produce identical results)
+                    out_tree = _scatter_reduce_apply(
+                        tree, valid, idx - range_start[0, 0],
+                        range_size[0, 0], out_cap, sc, neutral)
+                    out_leaves = jax.tree.leaves(out_tree)
+                    return (range_size[0].astype(jnp.int32)[None],
+                            *[l[None] for l in out_leaves])
                 words = [idx.astype(jnp.uint64)]
                 words, tree, valid, _ = segmented.sort_by_key_words(
                     words, tree, valid)

@@ -46,6 +46,7 @@ from ...data.shards import DeviceShards, HostShards
 from ...parallel.mesh import AXIS
 from ..dia import DIA
 from ..dia_base import DIABase
+from ...common.partition import dense_range_bounds
 
 OVERSAMPLE = 32  # samples per worker; splitter error ~ 1/OVERSAMPLE
 
@@ -178,7 +179,7 @@ class SortNode(DIABase):
         if n <= run_size:
             items = [it for l in shards.lists for it in l]
             items.sort(key=sort_key)
-            bounds = [(w * n) // W for w in range(W + 1)]
+            bounds = dense_range_bounds(n, W).tolist()
             return HostShards(W, [items[bounds[w]:bounds[w + 1]]
                                   for w in range(W)])
         try:
@@ -188,7 +189,7 @@ class SortNode(DIABase):
             # unpicklable items cannot spill; fall back in-memory
             items = [it for l in shards.lists for it in l]
             items.sort(key=sort_key)
-            bounds = [(w * n) // W for w in range(W + 1)]
+            bounds = dense_range_bounds(n, W).tolist()
             return HostShards(W, [items[bounds[w]:bounds[w + 1]]
                                   for w in range(W)])
 

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...common.partition import dense_range_bounds
 from ...data.shards import DeviceShards, HostShards
 from ..dia import DIA
 from ..dia_base import DIABase
@@ -32,7 +33,7 @@ class GenerateNode(DIABase):
     def compute(self):
         W = self.context.num_workers
         n = self.size
-        bounds = [(w * n) // W for w in range(W + 1)]
+        bounds = dense_range_bounds(n, W).tolist()
         if self.storage == "host":
             fn = self.fn or (lambda i: i)
             # multi-controller: materialize only this process's workers
@@ -85,7 +86,7 @@ class DistributeNode(DIABase):
             items = list(self.items) if not isinstance(self.items, list) \
                 else self.items
             n = len(items)
-            bounds = [(w * n) // W for w in range(W + 1)]
+            bounds = dense_range_bounds(n, W).tolist()
             # Distribute expects identical input on every controller
             # (see RunDistributed docstring); each keeps its own slice
             from ...data.multiplexer import local_worker_set

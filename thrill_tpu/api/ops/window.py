@@ -28,6 +28,7 @@ from ...data.shards import DeviceShards, HostShards, compact_valid
 from ...parallel.mesh import AXIS
 from ..dia import DIA
 from ..dia_base import DIABase
+from ...common.partition import dense_range_bounds
 
 
 def _device_windows(tree, cap, count, off, k, W):
@@ -195,7 +196,7 @@ class WindowNode(DIABase):
             rest = flat[len(flat) - len(flat) % k:]
             out.append(self.partial_fn(len(flat) - len(rest), rest))
         W = shards.num_workers
-        bounds = [(w * len(out)) // W for w in range(W + 1)]
+        bounds = dense_range_bounds(len(out), W).tolist()
         return multiplexer.localize(
             mex, HostShards(W, [out[bounds[w]:bounds[w + 1]]
                                 for w in range(W)]))
@@ -296,7 +297,7 @@ class FlatWindowNode(DIABase):
         for i in range(len(flat) - self.k + 1):
             out.extend(self.fn(i, flat[i:i + self.k]))
         W = shards.num_workers
-        bounds = [(w * len(out)) // W for w in range(W + 1)]
+        bounds = dense_range_bounds(len(out), W).tolist()
         return multiplexer.localize(
             mex, HostShards(W, [out[bounds[w]:bounds[w + 1]]
                                 for w in range(W)]))

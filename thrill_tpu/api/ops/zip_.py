@@ -22,6 +22,7 @@ from ...data import exchange
 from ...data.shards import DeviceShards, HostShards
 from ..dia import DIA
 from ..dia_base import DIABase
+from ...common.partition import dense_range_bounds
 
 
 def _realign_device(shards: DeviceShards, target_bounds: np.ndarray,
@@ -124,8 +125,7 @@ class ZipNode(DIABase):
             # zero-initialized, so the short inputs' missing tail slots
             # are already default-constructed (zero) items — exactly the
             # reference's ZipPad semantics (api/zip.hpp Pad variant)
-            tb = np.array([(w * n_out) // W for w in range(W + 1)],
-                          dtype=np.int64)
+            tb = dense_range_bounds(n_out, W)
             counts = (tb[1:] - tb[:-1]).astype(np.int64)
             aligned = []
             for i, p in enumerate(pulls):
@@ -199,7 +199,7 @@ class ZipNode(DIABase):
                      for i, l in enumerate(lists)]
         zf = self.zip_fn or (lambda *xs: tuple(xs))
         zipped = [zf(*vals) for vals in zip(*[l[:n_out] for l in lists])]
-        bounds = [(w * n_out) // W for w in range(W + 1)]
+        bounds = dense_range_bounds(n_out, W).tolist()
         return multiplexer.localize(
             mex, HostShards(W, [zipped[bounds[w]:bounds[w + 1]]
                                 for w in range(W)]))
@@ -427,7 +427,7 @@ class ZipWindowNode(DIABase):
         out = [zf(*[flats[i][j * w:(j + 1) * w]
                     for i, w in enumerate(self.window)])
                for j in range(n_out)]
-        bounds = [(w * n_out) // W for w in range(W + 1)]
+        bounds = dense_range_bounds(n_out, W).tolist()
         return multiplexer.localize(
             mex, HostShards(W, [out[bounds[w]:bounds[w + 1]]
                                 for w in range(W)]))
@@ -436,8 +436,7 @@ class ZipWindowNode(DIABase):
         mex = pulls[0].mesh_exec
         W = mex.num_workers
         n_out = min(p.total // w for p, w in zip(pulls, self.window))
-        cb = np.array([(w * n_out) // W for w in range(W + 1)],
-                      dtype=np.int64)                    # chunk bounds
+        cb = dense_range_bounds(n_out, W)                    # chunk bounds
         chunk_counts = (cb[1:] - cb[:-1]).astype(np.int64)
         chunk_cap = int(chunk_counts.max()) if n_out else 1
 

@@ -1,0 +1,28 @@
+"""The dense range split shared by every op that lays out (or
+addresses) a size-``n`` dense index space across ``W`` workers.
+
+``Generate`` (sources.py) materializes rows ``bounds[w]:bounds[w+1]``
+on worker ``w``, ``ReduceToIndex`` (reduce.py) scatters into exactly
+that layout, every re-laying-out op (concat, merge, groupby, sort's
+host path, window, zip, read_write, ``DeviceShards.from_host``) slices
+its output by the same split, and the dense-index gather join
+(join.py) computes ``gidx = w*rcap + (key - bounds[w])`` assuming the
+right table was laid out by exactly this split. The formula is
+load-bearing across ALL of them: if one site ever switched (say to
+ceil-div balancing) while the others kept this split, the dense join
+would silently address garbage rows whenever the right counts are
+device-resident (host-known counts are validated in
+``InnerJoinNode._check_dense``). One definition keeps the coupling
+explicit — do not inline the formula at new layout sites.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def dense_range_bounds(n: int, W: int) -> np.ndarray:
+    """``W+1`` split points of ``range(n)`` over ``W`` workers:
+    worker ``w`` owns ``[bounds[w], bounds[w+1])``."""
+    return np.array([(w * n) // W for w in range(W + 1)],
+                    dtype=np.int64)

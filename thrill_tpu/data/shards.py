@@ -28,6 +28,7 @@ import numpy as np
 
 from ..common.config import round_up, round_up_pow2
 from ..parallel.mesh import MeshExec
+from ..common.partition import dense_range_bounds
 
 
 def tree_leaves(tree):
@@ -216,8 +217,7 @@ class DeviceShards:
             # builds the [W, cap] layout (rows past each worker's count
             # repeat row n-1 — masked by counts like all pad rows).
             # Validity counts are host-known (n is), so no sync.
-            bnd = np.array([(w * n) // W for w in range(W + 1)],
-                           dtype=np.int64)
+            bnd = dense_range_bounds(n, W)
             counts = np.diff(bnd)
             cap = max(1, round_up_pow2(int(counts.max())))
             idx = jnp.asarray(np.minimum(
@@ -230,7 +230,7 @@ class DeviceShards:
                 return jax.device_put(arr, mesh_exec.sharded)
 
             return DeviceShards(mesh_exec, tree_map(place, tree), counts)
-        bounds = [(w * n) // W for w in range(W + 1)]
+        bounds = dense_range_bounds(n, W).tolist()
         per_worker = [tree_map(lambda a: np.asarray(a)[bounds[w]:bounds[w + 1]], tree)
                       for w in range(W)]
         return DeviceShards.from_worker_arrays(mesh_exec, per_worker)

@@ -21,6 +21,7 @@ import functools
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..common import faults
@@ -57,11 +58,23 @@ class _CountedJit:
     Every attribute other than ``__call__`` delegates to the jitted
     function (``.lower``, ``.trace``, ``.clone``, cost analysis...), so
     AOT/introspection callers see the real jit object — only calls gain
-    the dispatch counter and the fault-injected retry."""
+    the dispatch counter and the fault-injected retry.
 
-    def __init__(self, mex: "MeshExec", jitted: Callable) -> None:
+    ``raw`` keeps the pre-jit callable (the shard_map program) so the
+    loop-replay layer (api/loop.py) can build DONATING twins
+    (``jax.jit(raw, donate_argnums=...)``) and trace the program into a
+    whole-loop ``lax.fori_loop`` body."""
+
+    def __init__(self, mex: "MeshExec", jitted: Callable,
+                 raw: Optional[Callable] = None) -> None:
         self._mex = mex
         self._jitted = jitted
+        self.raw = raw
+        # the MeshExec.cached key this program was built under (stamped
+        # by cached()); the loop layer keys derived whole-loop programs
+        # on it so equal tapes share ONE compiled fori_loop
+        self.cache_key: Optional[Tuple] = None
+        self._donating: Dict[Tuple[int, ...], Callable] = {}
         functools.update_wrapper(self, jitted, updated=())
 
     def __call__(self, *args, **kwargs):
@@ -70,13 +83,32 @@ class _CountedJit:
             # disarmed hot path: dispatch-per-iteration is the budgeted
             # cost in this codebase — no policy construction, no env
             # reads beyond active()'s one
-            return self._jitted(*args, **kwargs)
+            out = self._jitted(*args, **kwargs)
+        else:
+            def dispatch():
+                faults.check(_F_DISPATCH)
+                return self._jitted(*args, **kwargs)
 
-        def dispatch():
-            faults.check(_F_DISPATCH)
-            return self._jitted(*args, **kwargs)
+            out = default_policy().run(dispatch, what="mesh.dispatch")
+        rec = self._mex.loop_recorder
+        if rec is not None:
+            rec.on_call(self, args, kwargs, out)
+        return out
 
-        return default_policy().run(dispatch, what="mesh.dispatch")
+    def donating(self, donate_argnums: Tuple[int, ...]) -> Callable:
+        """A twin executable that donates the given argument buffers
+        (loop-carried HBM reuse on replayed dispatches). Compiled once
+        per donation signature; requires ``raw``."""
+        fn = self._donating.get(donate_argnums)
+        if fn is None:
+            if self.raw is None:
+                raise ValueError("no raw program retained; cannot "
+                                 "build a donating twin")
+            fn = _CountedJit(self._mex,
+                             jax.jit(self.raw,
+                                     donate_argnums=donate_argnums))
+            self._donating[donate_argnums] = fn
+        return fn
 
     def __getattr__(self, name):
         return getattr(self._jitted, name)
@@ -122,6 +154,22 @@ class MeshExec:
         self.stats_fused_dispatches = 0
         self.stats_fused_ops = 0
         self.fused_stage_counts: Dict[Tuple[str, ...], int] = {}
+        # iteration execution layer (api/loop.py): LoopPlan captures,
+        # tape replays (iterations that paid ZERO graph construction /
+        # planning), whole-loop fori_loop dispatches, loud replay
+        # fallbacks to full re-planning, and HBM bytes donated back to
+        # XLA on replayed dispatches
+        self.stats_loop_plan_builds = 0
+        self.stats_loop_replays = 0
+        self.stats_loop_fori_iters = 0
+        self.stats_loop_fallbacks = 0
+        self.stats_loop_donated_bytes = 0
+        # active tape recorder (None = zero-overhead fast path); set by
+        # api/loop.py around a capture iteration's body run
+        self.loop_recorder = None
+        # per-Iterate reports (phase timings, replay hit rate) for
+        # bench.py / tools/loop_report.py
+        self.loop_reports: list = []
         self._put_small_cache: Dict[Any, jax.Array] = {}
         # deferred device-side validations (e.g. InnerJoin
         # out_size_hint overflow): ops that skip a blocking host sync
@@ -228,9 +276,41 @@ class MeshExec:
             local = [jax.device_put(arr[w * k:(w + 1) * k],
                                     self.devices[w])
                      for w in self.local_workers]
-            return jax.make_array_from_single_device_arrays(
-                arr.shape, self.sharded, local)
-        return jax.device_put(arr, self.sharded)
+            return self._bless(jax.make_array_from_single_device_arrays(
+                arr.shape, self.sharded, local))
+        return self._bless(jax.device_put(arr, self.sharded))
+
+    def _bless(self, buf: jax.Array) -> jax.Array:
+        """Mark a host-uploaded buffer as a legitimate tape constant.
+        The loop recorder (api/loop.py) rejects device arrays CREATED
+        during a capture iteration — they could be eager host math over
+        loop data, which a tape would freeze at iteration-1 values.
+        put() is the one host->device choke point, and its numpy input
+        is already covered by the fetch-taint + numpy-argument guards,
+        so its outputs are safe constants."""
+        rec = self.loop_recorder
+        if rec is not None:
+            rec.bless(buf)
+        return buf
+
+    def asarray_blessed(self, leaves):
+        """``jnp.asarray`` each non-jax leaf of a dispatch's bound
+        operands, blessing the conversions as tape constants. Host
+        plan leaves (np bounds/sizes, scalars) converted right before
+        a dispatch are legitimate constants by the same argument as
+        :meth:`put` uploads — fetched loop-variant values are already
+        rejected by the recorder's fetch taint and numpy-argument
+        guards. Device leaves pass through with identity preserved so
+        the recorder can classify them as carry/val."""
+        rec = self.loop_recorder
+        out = []
+        for l in leaves:
+            if not isinstance(l, jax.Array):
+                l = jnp.asarray(l)
+                if rec is not None:
+                    rec.bless(l)
+            out.append(l)
+        return out
 
     def put_tree(self, tree):
         return jax.tree.map(self.put, tree)
@@ -292,6 +372,14 @@ class MeshExec:
         checks themselves (their transfers are tiny, ride a completed
         program, and must not read as mid-pipeline syncs in the
         dispatch-budget accounting)."""
+        rec = self.loop_recorder
+        if rec is not None:
+            # a capture is watching: host plan logic reading a value a
+            # recorded dispatch produced may bake loop-VARIANT plan
+            # data (exchange send matrices) into the tape — the
+            # recorder checks the producer's carry-dependence and
+            # rejects such captures (api/loop.py)
+            rec.on_fetch(arr)
         if getattr(arr, "is_fully_addressable", True):
             return np.asarray(arr)
         from jax.experimental import multihost_utils
@@ -315,8 +403,19 @@ class MeshExec:
                        out_specs=out_specs, check_vma=check_vma)
         # full attribute delegation (not a copied .lower): AOT and
         # introspection callers (.trace, .clone, cost analysis) see
-        # the real jit object through the counting proxy
-        return _CountedJit(self, jax.jit(sm))
+        # the real jit object through the counting proxy; the raw
+        # shard_map program rides along for loop-replay donation twins
+        # and whole-loop fori lowering (api/loop.py)
+        return _CountedJit(self, jax.jit(sm), raw=sm)
+
+    def jit_cached(self, key: Tuple, fn: Callable) -> Callable:
+        """A cached plain-``jax.jit`` program behind the counting
+        proxy: replicated (non-shard_map) device math — an iterative
+        driver's small update step — becomes a RECORDABLE dispatch the
+        loop layer (api/loop.py) can tape and replay, instead of eager
+        ops the capture must reject."""
+        return self.cached(key, lambda: _CountedJit(self, jax.jit(fn),
+                                                    raw=fn))
 
     def cached(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
         """Memoize a compiled program per (mesh, key).
@@ -335,5 +434,8 @@ class MeshExec:
         fn = self._cache.get(key)
         if fn is None:
             fn = builder()
+            target = fn[0] if isinstance(fn, tuple) else fn
+            if isinstance(target, _CountedJit):
+                target.cache_key = key
             self._cache[key] = fn
         return fn

@@ -98,6 +98,7 @@ def render_html(events: List[dict]) -> str:
     profiles = []
     exchanges = []
     fused = []         # fused_dispatch (api/fusion.py program stitching)
+    loops = []         # iteration / loop_* (api/loop.py LoopPlan replay)
     ckpt = []          # checkpoint / ckpt_restore / resume (durability)
     overall = []       # overall_stats summary lines
     device_xchg: dict = {}   # host -> ordered device-plane exchanges
@@ -135,6 +136,11 @@ def render_html(events: List[dict]) -> str:
             faults.append((t, e))
         elif e.get("event") == "fused_dispatch":
             fused.append(e)
+        elif e.get("event") in ("iteration", "loop_replay", "loop_plan",
+                                "loop_capture_miss",
+                                "loop_replay_fallback", "loop_done",
+                                "loop_fori_unavailable"):
+            loops.append((t, e))
         elif e.get("event") in ("checkpoint", "ckpt_restore", "resume"):
             ckpt.append((t, e))
         elif e.get("event") == "overall_stats":
@@ -196,6 +202,7 @@ td.hm {{ min-width: 3em; }}
 {_render_worker_lanes(exchanges, total)}
 {_render_memory_events(memory, total)}
 {_render_fused_dispatches(fused, overall)}
+{_render_loop_iterations(loops, overall)}
 {_render_checkpoint_events(ckpt, overall)}
 {_render_fault_events(faults)}
 {_render_host_overlay(profiles, total)}
@@ -243,6 +250,74 @@ def _render_fused_dispatches(fused, overall) -> str:
 {summary}
 <table><tr><th class=l>stage composition</th><th>ops</th>
 <th>dispatches</th><th>saved</th></tr>{''.join(rows)}</table>"""
+
+
+def _render_loop_iterations(loops, overall) -> str:
+    """Iteration timeline (api/loop.py): one row per loop iteration —
+    capture/plain/replay/fori mode, dispatches issued, wall seconds —
+    plus plan-build/capture-miss/fallback markers and the loop_done
+    summaries, so replay hit rate and donated HBM are visible next to
+    the dispatch budget they bought."""
+    if not loops:
+        return ""
+    trs = []
+    for t, e in loops:
+        kind = e.get("event")
+        loop = e.get("loop") or e.get("name") or ""
+        if kind == "iteration":
+            row = (e.get("mode", "plain"), e.get("iter"),
+                   e.get("dispatches"), e.get("seconds"))
+        elif kind == "loop_replay":
+            mode = "fori" if e.get("fori") else "replay"
+            it = e.get("iter")
+            if e.get("iters"):
+                it = f"{it}..{it + e['iters'] - 1}"
+            row = (mode, it, e.get("dispatches", 1), e.get("seconds"))
+        elif kind == "loop_done":
+            hit = ((e.get("replays", 0) + e.get("fori_iters", 0))
+                   / max(e.get("iters", 1), 1))
+            row = (f"done: {e.get('iters')} iters, "
+                   f"{e.get('captures')} captures, "
+                   f"replay hit {hit:.0%}, "
+                   f"{e.get('fallbacks')} fallbacks, "
+                   f"{e.get('donated_bytes', 0)} B donated",
+                   "", "", round(e.get("capture_s", 0)
+                                 + e.get("replay_s", 0), 4))
+        elif kind == "loop_plan":
+            row = (f"plan: {e.get('calls')} calls, "
+                   f"{e.get('pruned_invariant')} invariant + "
+                   f"{e.get('pruned_dead')} dead pruned, "
+                   f"{e.get('donatable')} donatable"
+                   f"{', fori' if e.get('fori') else ''}", "", "", "")
+        else:
+            row = (f"{kind}: "
+                   f"{e.get('reason') or e.get('error') or ''}",
+                   e.get("iter", ""), "", "")
+        mode, it, disp, secs = row
+        trs.append(f"<tr><td class=l>{t:8.3f}s</td>"
+                   f"<td class=l>{html.escape(str(loop))}</td>"
+                   f"<td class=l>{html.escape(str(mode))}</td>"
+                   f"<td>{it}</td><td>{disp if disp is not None else ''}"
+                   f"</td><td>{secs if secs is not None else ''}</td>"
+                   f"</tr>")
+    summary = ""
+    if overall:
+        o = overall[-1]
+        if o.get("loop_plan_builds") is not None:
+            summary = (f"<p>loop plans built: "
+                       f"<b>{o.get('loop_plan_builds')}</b>, "
+                       f"replayed iterations: {o.get('loop_replays')}"
+                       f" + {o.get('loop_fori_iters')} in whole-loop "
+                       f"fori dispatches, "
+                       f"{o.get('loop_replay_fallbacks')} fallbacks, "
+                       f"{o.get('loop_donated_bytes')} bytes of "
+                       f"loop-carry HBM donated</p>")
+    return f"""
+<h2>iteration timeline (loop replay)</h2>
+{summary}
+<table><tr><th class=l>t</th><th class=l>loop</th><th class=l>mode</th>
+<th>iter</th><th>dispatches</th><th>seconds</th></tr>{''.join(trs)}
+</table>"""
 
 
 def _render_checkpoint_events(ckpt, overall) -> str:

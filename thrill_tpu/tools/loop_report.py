@@ -1,0 +1,114 @@
+"""Loop-replay report over the iterative example pipelines.
+
+Runs PageRank and k-means with the iteration execution layer on
+(default) and with THRILL_TPU_LOOP_REPLAY=0, checks exact result
+parity, and prints per-loop replay hit rate, plan builds, whole-loop
+fori iterations, donated loop-carry bytes, and the wall-clock split
+between the capture iteration (graph build + planning + dispatch) and
+the replayed iterations (pure dispatch). The mirror of
+``fusion_report`` one layer up: where that report counts dispatches a
+stitched program saves, this one counts the PLANNING work a replayed
+loop never does.
+
+Usage::
+
+    python -m thrill_tpu.tools.loop_report [--pages N] [--edges M]
+        [--iters K] [--points N] [--clusters K]
+
+(or ``run-scripts/loop_report.sh``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _examples_path() -> None:
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "..", "..", "examples")
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def _measure(name, job, mex):
+    """job() under replay on/off (one warm run each); returns the
+    row + the loop report captured from the replayed run."""
+    import numpy as np
+    results, wall = {}, {}
+    report = None
+    for replay in ("1", "0"):
+        os.environ["THRILL_TPU_LOOP_REPLAY"] = replay
+        job()                                    # warm: compile+cache
+        n0 = len(mex.loop_reports)
+        t0 = time.perf_counter()
+        results[replay] = np.asarray(job(), dtype=np.float64)
+        wall[replay] = time.perf_counter() - t0
+        if replay == "1":
+            reps = [r for r in mex.loop_reports[n0:]
+                    if r["name"] == name]
+            report = reps[-1] if reps else None
+    assert np.array_equal(results["1"], results["0"]), \
+        f"{name}: replayed and per-iteration results diverge"
+    return (name, report, wall["1"], wall["0"])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pages", type=int, default=1024)
+    ap.add_argument("--edges", type=int, default=8192)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--points", type=int, default=8192)
+    ap.add_argument("--clusters", type=int, default=16)
+    args = ap.parse_args()
+
+    import numpy as np
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    mex = MeshExec()
+    ctx = Context(mex)
+    _examples_path()
+    import k_means as km
+    import page_rank as pr
+
+    edges = pr.zipf_graph(args.pages, args.edges)
+    rng = np.random.default_rng(7)
+    points = rng.normal(size=(args.points, 8))
+
+    rows = [
+        _measure("page_rank",
+                 lambda: pr.page_rank(ctx, edges, args.pages,
+                                      iterations=args.iters), mex),
+        _measure("k_means",
+                 lambda: km.k_means(ctx, points, args.clusters,
+                                    iterations=args.iters), mex),
+    ]
+    os.environ.pop("THRILL_TPU_LOOP_REPLAY", None)
+
+    print(f"{'loop':<10} {'iters':>5} {'hit':>5} {'plans':>5} "
+          f"{'fori':>5} {'donatedB':>9} {'capture_s':>10} "
+          f"{'replay_s':>9} {'wall':>7} {'noreplay':>9}")
+    for name, r, w1, w0 in rows:
+        if r is None:
+            print(f"{name:<10} (no LoopPlan captured — see "
+                  f"event=loop_capture_miss)")
+            continue
+        hit = (r["replays"] + r["fori_iters"]) / max(r["iters"], 1)
+        print(f"{name:<10} {r['iters']:>5} {hit:>5.0%} "
+              f"{r['captures']:>5} {r['fori_iters']:>5} "
+              f"{r['donated_bytes']:>9} {r['capture_s']:>10.4f} "
+              f"{r['replay_s']:>9.4f} {w1:>7.3f} {w0:>9.3f}")
+    stats = ctx.overall_stats()
+    print(f"\nprocess totals: {stats['loop_plan_builds']} plan builds, "
+          f"{stats['loop_replays']} replays + "
+          f"{stats['loop_fori_iters']} fori iters, "
+          f"{stats['loop_replay_fallbacks']} fallbacks, "
+          f"{stats['loop_donated_bytes']} B donated")
+    ctx.close()
+
+
+if __name__ == "__main__":
+    main()
