@@ -332,6 +332,16 @@ def _run_bench() -> None:
     ck = (_ckpt_metric(n)
           if os.environ.get("THRILL_TPU_BENCH_CKPT") == "1" else {})
 
+    # memory-pressure observability (mem/pressure.py): the HBM peak the
+    # governor accounted, the cost model's high watermark, and how
+    # often the OOM ladder engaged — a nonzero oom_retries on a clean
+    # bench run means the working set is brushing the HBM budget
+    press = ctx.overall_stats()
+    _set(hbm_peak=int(press.get("hbm_peak", 0)),
+         hbm_high_watermark=int(press.get("hbm_high_watermark", 0)),
+         oom_retries=int(press.get("oom_retries", 0)),
+         segment_splits=int(press.get("segment_splits", 0)))
+
     _emit(value=round(mrec_s, 3),
           vs_baseline=round(mrec_s / host_mrec_s, 3),
           **wc, **prm, **kmm, **sfm, **em, **ck)

@@ -566,3 +566,85 @@ def test_loop_checkpoint_resume_with_replay(tmp_path, monkeypatch):
     want = Run(_ckpt_job, cfg)
     got = Run(_ckpt_job, cfg, resume=True)
     assert got == want
+
+
+# ----------------------------------------------------------------------
+# per-output-LEAF taint refinement (jaxpr input->output reachability)
+# ----------------------------------------------------------------------
+
+def test_invariant_output_of_carry_dependent_call_captures():
+    """A dispatch producing BOTH a carry-dependent output and an
+    invariant one (derived only from a constant input): host plan
+    logic fetching the INVARIANT output must no longer poison the
+    tape — per-CALL taint rejected this, per-LEAF taint captures."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    step = mex.jit_cached(("leaf_taint_step",),
+                          lambda x, k: (x + 1.0, k * 2))
+    scale = mex.jit_cached(("leaf_taint_scale",), lambda x, s: x * s)
+    keys = mex.put(np.arange(8, dtype=np.int64).reshape(1, 8) % 4)
+
+    def body(x):
+        y, kk = step(x, keys)
+        plan_val = mex.fetch(kk)          # invariant output -> host plan
+        s = mex.put_small(np.asarray(plan_val[:, :1] * 0 + 2.0))
+        return scale(y, s)
+
+    out = Iterate(ctx, body, jnp.zeros((1, 1), dtype=jnp.float64), 4,
+                  name="leaftaint")
+    stats = ctx.overall_stats()
+    want = 0.0
+    for _ in range(4):
+        want = (want + 1.0) * 2.0
+    assert np.allclose(np.asarray(out), want)
+    assert stats["loop_plan_builds"] == 1
+    assert stats["loop_replays"] + stats["loop_fori_iters"] >= 3
+    ctx.close()
+
+
+def test_carry_dependent_fetch_still_rejects():
+    """The refinement must only ACCEPT what dataflow proves: fetching
+    an output that genuinely derives from the carry keeps rejecting
+    the capture (plain loop, exact results)."""
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    step = mex.jit_cached(("leaf_taint_dep_step",),
+                          lambda x: (x + 1.0, x * 3.0))
+    scale = mex.jit_cached(("leaf_taint_dep_scale",),
+                           lambda x, s: x * s)
+
+    def body(x):
+        y, z = step(x)
+        v = mex.fetch(z)                  # carry-dependent output
+        s = mex.put_small(np.asarray(v * 0 + 2.0))
+        return scale(y, s)
+
+    out = Iterate(ctx, body, jnp.zeros((1, 1), dtype=jnp.float64), 4,
+                  name="leaftaint_dep")
+    stats = ctx.overall_stats()
+    want = 0.0
+    for _ in range(4):
+        want = (want + 1.0) * 2.0
+    assert np.allclose(np.asarray(out), want)
+    assert stats["loop_plan_builds"] == 0
+    assert stats["loop_replays"] == 0
+    ctx.close()
+
+
+def test_pagerank_captures_at_w_gt_1():
+    """The ROADMAP item this refinement closes: the constant-topology
+    W>1 PageRank body (dense-gather join + scatter ReduceToIndex,
+    where plan fetches ride invariant key columns) captures and
+    replays at every worker count, bit-identical across W."""
+    edges = _edges(pages=128, m=1024)
+    res = {}
+    for W in (1, 2):        # W=2 proves the W>1 path; keep tier-1 lean
+        mex = MeshExec(num_workers=W)
+        ctx = Context(mex)
+        res[W] = _pagerank(ctx, edges, pages=128, iters=4)
+        stats = ctx.overall_stats()
+        assert stats["loop_plan_builds"] == 1, (W, stats)
+        assert stats["loop_replays"] + stats["loop_fori_iters"] >= 3, \
+            (W, stats)
+        ctx.close()
+    assert np.allclose(res[1], res[2])

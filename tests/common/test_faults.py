@@ -308,6 +308,79 @@ def _ex_hbm_spill_and_restore():
     assert faults.REGISTRY.stats()["retries"] >= 1
 
 
+def _ex_mem_oom():
+    """mem.oom (memory-pressure ladder, mem/pressure.py): an injected
+    device RESOURCE_EXHAUSTED at the dispatch choke point recovers
+    through spill-and-retry with results exact; kind='oom' keeps the
+    generic transient dispatch retry from absorbing it. Deeper
+    coverage (split/host rungs, parity): tests/mem/test_pressure.py."""
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+    with faults.inject("mem.oom", n=1, seed=11):
+        mex = MeshExec(num_workers=2)
+        ctx = Context(mex)
+        got = sorted(int(x) for x in ctx.Distribute(
+            np.arange(24, dtype=np.int64)).Map(
+                lambda x: x * 7).AllGather())
+        stats = ctx.overall_stats()
+        ctx.close()
+    assert got == [x * 7 for x in range(24)]
+    assert stats["oom_retries"] >= 1
+    assert faults.REGISTRY.injected >= 1
+    assert any(e.get("event") == "oom_retry"
+               for e in faults.REGISTRY.events)
+
+
+def _pressured_ctx_run(extra_env):
+    """One pipeline under an armed admission budget (THRILL_TPU_HBM_
+    LIMIT) with a cold cached node to spill; returns its results."""
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+    prev = os.environ.get("THRILL_TPU_HBM_LIMIT")
+    os.environ["THRILL_TPU_HBM_LIMIT"] = "64Ki"
+    try:
+        with faults.inject(*extra_env):
+            mex = MeshExec(num_workers=2)
+            ctx = Context(mex)
+            a = ctx.Distribute(np.arange(4096, dtype=np.int64))
+            a.Keep(2)
+            assert a.Size() == 4096
+            got = sorted(int(x) for x in ctx.Distribute(
+                np.arange(8192, dtype=np.int64)).Map(
+                    lambda x: x + 1).AllGather())
+            kept = [int(x) for x in a.AllGather()]
+            ctx.close()
+        return got, kept
+    finally:
+        if prev is None:
+            os.environ.pop("THRILL_TPU_HBM_LIMIT", None)
+        else:
+            os.environ["THRILL_TPU_HBM_LIMIT"] = prev
+
+
+def _ex_mem_pressure_spill():
+    """mem.spill: a pressure-triggered admission spill fails — the
+    ladder degrades to dispatch-anyway (over budget beats data loss),
+    results exact, recovery noted."""
+    got, kept = _pressured_ctx_run(("mem.spill",))
+    assert got == [x + 1 for x in range(8192)]
+    assert kept == list(range(4096))
+    assert faults.REGISTRY.injected >= 1
+    assert any(e.get("what") == "mem.pressure_spill_skipped"
+               for e in faults.REGISTRY.events)
+
+
+def _ex_mem_estimate():
+    """mem.estimate: the cost model fails — admission is skipped for
+    that dispatch (estimation is advisory), results exact."""
+    got, kept = _pressured_ctx_run(("mem.estimate",))
+    assert got == [x + 1 for x in range(8192)]
+    assert kept == list(range(4096))
+    assert faults.REGISTRY.injected >= 1
+    assert any(e.get("what") == "mem.estimate_skipped"
+               for e in faults.REGISTRY.events)
+
+
 def _ex_vfs_read_reopen(tmp_path=None):
     """vfs.open_read / vfs.read: a mid-stream transient fault reopens
     at the tracked offset — the bytes come back complete and in
@@ -469,6 +542,9 @@ _MATRIX = {
     "data.blockstore.get": _ex_blockstore,
     "mem.hbm.spill": _ex_hbm_spill_and_restore,
     "mem.hbm.restore": _ex_hbm_spill_and_restore,
+    "mem.oom": _ex_mem_oom,
+    "mem.spill": _ex_mem_pressure_spill,
+    "mem.estimate": _ex_mem_estimate,
     "vfs.open_read": _ex_vfs_read_reopen,
     "vfs.read": _ex_vfs_read_reopen,
     "vfs.s3.read": _ex_vfs_scheme_sites,

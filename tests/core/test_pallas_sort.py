@@ -69,9 +69,14 @@ def test_radix_argsort_stability():
     assert np.array_equal(perm, np.argsort(wd, kind="stable"))
 
 
+@pytest.mark.slow
 def test_sort_pipeline_with_radix_engine(monkeypatch):
     """End-to-end DIA Sort with THRILL_TPU_SORT_IMPL=radix (the jit
-    engines run, host radix off) matches the default engine output."""
+    engines run, host radix off) matches the default engine output.
+    Marked slow (17s of tier-1 budget): the radix engine itself stays
+    covered in-tier by test_radix_argsort_matches_lexsort and
+    test_radix_argsort_stability; this is the pipeline-x-engine
+    integration sweep."""
     monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "0")
     monkeypatch.setenv("THRILL_TPU_SORT_IMPL", "radix")
     from thrill_tpu.api import Context

@@ -204,3 +204,76 @@ def test_file_scatter_ranges():
     for p in parts:
         p.close()
     f.close()
+
+
+# ----------------------------------------------------------------------
+# pure-python fallback store: same spill ladder, no compiler needed
+# ----------------------------------------------------------------------
+
+@pytest.fixture
+def _forced_fallback(monkeypatch):
+    """Force the compiler-less path regardless of the image's g++."""
+    from thrill_tpu.data import block_pool as bp
+    monkeypatch.setattr(bp, "_LIB", None)
+    monkeypatch.setattr(bp, "_LIB_FAILED", True)
+    yield
+
+
+def test_python_fallback_honors_soft_limit(_forced_fallback):
+    """The fallback store must SPILL past its soft limit (pid-tagged
+    files in spill_dir), not grow unbounded, and reads must come back
+    exact from RAM and disk alike."""
+    with tempfile.TemporaryDirectory() as d:
+        pool = BlockPool(spill_dir=d, soft_limit=10_000)
+        assert not pool.native
+        payloads = [bytes([i]) * 4000 for i in range(10)]  # 40 KB
+        ids = [pool.put(p) for p in payloads]
+        assert pool.mem_usage <= 10_000
+        spills = [f for f in os.listdir(d) if f.endswith(".spill")]
+        assert spills, "expected fallback spill files"
+        # native naming contract: ttpu-blk-<pid>-<store>-<id>-<host>
+        parts = spills[0][:-len(".spill")].split("-")
+        assert parts[:2] == ["ttpu", "blk"]
+        assert int(parts[2]) == os.getpid()
+        assert pool.num_blocks == 10
+        for i, bid in enumerate(ids):
+            assert pool.get(bid) == payloads[i]
+        # drop removes the disk copy too; close sweeps the rest
+        for bid in ids:
+            pool.drop(bid)
+        assert pool.num_blocks == 0
+        pool.close()
+        assert not [f for f in os.listdir(d) if f.endswith(".spill")]
+
+
+def test_python_fallback_pin_blocks_eviction(_forced_fallback):
+    with tempfile.TemporaryDirectory() as d:
+        pool = BlockPool(spill_dir=d, soft_limit=5_000)
+        first = pool.put(b"a" * 4000)
+        pool.pin(first)
+        pool.put(b"b" * 4000)            # over limit; first is pinned
+        assert first not in getattr(pool, "_spilled")
+        pool.unpin(first)
+        pool.put(b"c" * 4000)            # now first may spill
+        assert pool.mem_usage <= 5_000
+        assert pool.get(first) == b"a" * 4000
+        pool.close()
+
+
+def test_python_fallback_stale_spills_are_purged(_forced_fallback):
+    """A dead process's fallback spill files are reclaimed by the same
+    purge that sweeps native files (identical naming)."""
+    from thrill_tpu.data.block_pool import purge_stale_spills
+    with tempfile.TemporaryDirectory() as d:
+        pool = BlockPool(spill_dir=d, soft_limit=1)
+        pool.put(b"x" * 100)
+        pool.put(b"y" * 100)
+        spills = [f for f in os.listdir(d) if f.endswith(".spill")]
+        assert spills
+        fake = os.path.join(
+            d, spills[0].replace(f"-{os.getpid()}-", "-999999999-"))
+        with open(fake, "wb") as f:
+            f.write(b"stale")
+        assert purge_stale_spills(d) == 1
+        assert not os.path.exists(fake)
+        pool.close()

@@ -115,9 +115,13 @@ def test_suffix_array():
     RunLocalMock(job, 4)
 
 
+@pytest.mark.slow
 def test_dc3_suffix_array():
     """DC3 golden test on the virtual mesh (reference: dc3.cpp) —
-    recursion-forcing inputs (heavy repeats) included."""
+    recursion-forcing inputs (heavy repeats) included. Marked slow
+    (20s, the tier-1 budget's single biggest example): the DC family
+    stays covered in-tier by test_dc7_suffix_array (the more
+    stressing variant) and test_suffix_array."""
     rng = np.random.default_rng(11)
 
     def job(ctx):

@@ -90,6 +90,14 @@ class Context:
         self.mem = MemoryManager(name="context")
         from ..mem.hbm import HbmGovernor
         self.hbm = HbmGovernor(self, limit=self.config.hbm_limit)
+        # memory-pressure resilience (mem/pressure.py): HBM admission
+        # control + the OOM escalation ladder. Enabled only when a
+        # budget is known (device memory_stats or THRILL_TPU_HBM_LIMIT)
+        # — otherwise every dispatch pays one attribute read.
+        from ..mem.pressure import PressureMonitor
+        self.pressure = PressureMonitor(self.mesh_exec,
+                                        governor=self.hbm)
+        self.mesh_exec.pressure = self.pressure
         # stage memory negotiation state: bytes currently reserved by
         # active grants (reference: per-stage RAM distribution among
         # max-RAM requesters, api/dia_base.cpp:121-270)
@@ -316,6 +324,10 @@ class Context:
             "hbm_peak": self.hbm.mem.peak,
             "hbm_spills": self.hbm.spill_count,
             "hbm_restores": self.hbm.restore_count,
+            # memory-pressure ladder (mem/pressure.py): the admission
+            # cost model's high watermark, OOM-retry dispatches,
+            # segment splits and bytes spilled under pressure
+            **self.pressure.stats(),
             # robustness layer: lineage retries of hinted joins plus
             # the process-wide fault/retry/abort counters
             # (common/faults.py)
@@ -336,9 +348,12 @@ class Context:
             # logical graph) — take host 0's copy, don't sum. Only the
             # host-process-local peaks (and the per-process fault/
             # retry/abort counters) genuinely differ across hosts.
-            local_peaks = {"host_mem_peak", "recovery_time_s"}
+            local_peaks = {"host_mem_peak", "recovery_time_s",
+                           "hbm_high_watermark"}
             local_sums = {"faults_injected", "retries", "recoveries",
-                          "aborts", "ckpt_bytes_written"}
+                          "aborts", "ckpt_bytes_written", "oom_retries",
+                          "segment_splits", "host_fallbacks",
+                          "admission_spills", "pressure_spilled_bytes"}
             stats = {
                 k: (max(h[k] for h in per_host) if k in local_peaks
                     else sum(h.get(k, 0) for h in per_host)

@@ -59,6 +59,16 @@ def apply_stack_device(shards: DeviceShards, stack: Stack) -> DeviceShards:
                         in_specs=in_specs), holder
 
     fn, h = mex.cached(key, build)
+    pres = mex.pressure
+    if pres is not None and pres.enabled \
+            and not any(op.kind == "flat_map" for op in stack):
+        # admission cost model (mem/pressure.py): a non-expanding LOp
+        # stack's output shares the input capacity, so the input leaf
+        # bytes bound the program's output — hand the hint to the
+        # dispatch choke point (flat_map stacks may emit more rows
+        # than they consume; they use the learned/factor estimate)
+        pres.hint_output_bytes(sum(int(getattr(l, "nbytes", 0) or 0)
+                                   for l in leaves))
     out = fn(shards.counts_device(), *leaves, *b_leaves)
     tree = jax.tree.unflatten(h["treedef"], list(out[1:]))
     # counts stay on device: no host sync between chained programs.

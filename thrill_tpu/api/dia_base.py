@@ -173,6 +173,13 @@ class DIABase:
             if restored is not None:
                 self._shards = restored
             else:
+                # stage-level HBM admission (mem/pressure.py): before
+                # a new stage computes, bring the cached-results
+                # ledger back under the watermark — the pull-model
+                # analog of the reference's per-stage RAM distribution
+                pres = getattr(self.context, "pressure", None)
+                if pres is not None and pres.enabled:
+                    pres.admit_stage(self)
                 # stage memory negotiation: EM operators get a host-RAM
                 # grant split among concurrently computing
                 # max-requesters (nested pulls, e.g. recursive DC3

@@ -134,6 +134,18 @@ class Segment:
     # checks (hinted-join overflow) to the fused boundary
     finalize: Optional[Callable[["FusionPlan", DeviceShards], None]] = None
     dia_id: Optional[int] = None
+    # every output row derives from exactly one input row (LOp stacks:
+    # map/filter/flatmap — no collectives, no cross-row state), so the
+    # memory-pressure ladder may re-plan the chain as row-range
+    # sub-dispatches (mem/pressure.py rung 3) without changing results
+    row_local: bool = False
+    # may emit MORE rows than it consumes (flat_map): the admission
+    # cost model must not bound this chain's output by its input bytes
+    expands: bool = False
+    # host-engine form of this segment (items list -> items list); the
+    # ladder's LAST rung runs the chain through these when even split
+    # chunks exhaust HBM
+    host_apply: Optional[Callable] = None
 
 
 def _src_sig(shards: DeviceShards, flat) -> Tuple:
@@ -173,6 +185,7 @@ class FusionPlan:
         self.known_counts = known_counts
         self.aux: dict = {}          # last execute()'s aux outputs
         self._no_finalize = False    # recovery re-runs skip finalizers
+        self._no_split = False       # split-rung chunks must not re-split
 
     # -- building -------------------------------------------------------
     def append(self, seg: Segment) -> None:
@@ -294,7 +307,31 @@ class FusionPlan:
                                  dia_id=seg.dia_id, fused_ops=len(segs))
 
             default_policy().run(site_checks, what="fuse.dispatch")
-        out = fn(*args)
+        pres = mex.pressure
+        if pres is not None and pres.enabled \
+                and not any(s.expands for s in segs):
+            # cost-model hint from the plan's shapes: a non-expanding
+            # chain produces at most its sources' rows, so the sources'
+            # leaf bytes bound the stitched program's output. Expanding
+            # chains (flat_map) skip the hint — the learned per-program
+            # size / factor guess handles them instead of a systematic
+            # underestimate on exactly the chains most likely to OOM
+            pres.hint_output_bytes(sum(
+                int(getattr(l, "nbytes", 0) or 0)
+                for s in srcs for l in jax.tree.leaves(s.tree)))
+        try:
+            out = fn(*args)
+        except Exception as e:
+            # rungs 3-4 of the memory-pressure ladder (mem/pressure.py):
+            # the dispatch choke point already spilled and retried —
+            # an OOM surfacing here means the segment chain itself does
+            # not fit, so re-plan it as row-range sub-dispatches (or,
+            # last, run the chain's host-engine form)
+            from ..mem import pressure as _pressure
+            if self._no_split or not (_pressure.retry_enabled()
+                                      and _pressure.is_oom_error(e)):
+                raise
+            return self._execute_degraded(e)
         mex.stats_fused_dispatches += 1
         mex.stats_fused_ops += len(segs)
         ops = tuple(s.label for s in segs)
@@ -330,6 +367,93 @@ class FusionPlan:
         plan._no_finalize = True
         return plan.execute()
 
+    # -- memory-pressure degradation (mem/pressure.py rungs 3-4) --------
+    def _execute_degraded(self, exc: BaseException):
+        """The stitched dispatch exhausted the OOM-retry budget:
+        escalate. Rung 3 re-plans a row-local single-source chain as K
+        row-range sub-dispatches (``event=segment_split`` — lineage-
+        level like the hinted-join overflow re-run, never wrong data);
+        rung 4 runs the chain's host-engine form. Multi-controller
+        meshes re-raise: degradation is a per-process decision, and an
+        asymmetric re-plan would desynchronize the collective
+        schedule across controllers (same reasoning as the governor's
+        multi-process spill guard)."""
+        from ..mem import pressure as _pressure
+        mex = self.mex
+        segs = self.all_segments
+        labels = [s.label for s in segs]
+        if getattr(mex, "num_processes", 1) > 1 or self.head is not None \
+                or len(self.sources) != 1:
+            raise exc
+        pres = _pressure._monitor_for(mex)
+        src = self.sources[0]
+        if all(s.row_local and s.finalize is None for s in segs):
+            try:
+                k = int(os.environ.get("THRILL_TPU_SPLIT_K", "4") or 4)
+            except ValueError:
+                k = 4
+            k = max(2, min(k, src.cap))
+            if src.cap > 1:
+                try:
+                    out = self._execute_split(src, k)
+                except Exception as e2:
+                    if not _pressure.is_oom_error(e2):
+                        raise
+                    faults.note("recovery", what="mem.split_oom",
+                                ops=labels, error=repr(e2)[:200])
+                else:
+                    pres.segment_splits += 1
+                    faults.note("segment_split", k=k, ops=labels,
+                                cap=src.cap)
+                    faults.note("recovery", what="mem.segment_split",
+                                _quiet=True)
+                    return out
+        if all(s.host_apply is not None for s in segs):
+            # last rung: the host engine (the reference's EM
+            # degradation — slower, unbounded by HBM, bit-identical)
+            pres.host_fallbacks += 1
+            faults.note("recovery", what="mem.host_fallback",
+                        ops=labels)
+            shards = src.to_host_shards(reason="memory_pressure")
+            lists = shards.lists
+            for seg in segs:
+                lists = [seg.host_apply(items) for items in lists]
+            return HostShards(shards.num_workers, lists)
+        raise exc
+
+    def _execute_split(self, src: DeviceShards, k: int) -> DeviceShards:
+        """Run the (row-local) segment chain as ``k`` row-range
+        sub-dispatches over ``common/partition.py`` bounds and
+        reassemble per-worker results in chunk order — identical to
+        the unsplit program because every output row derives from
+        exactly one input row and chunk-then-compact preserves input
+        order."""
+        from ..common.partition import dense_range_bounds
+        mex = self.mex
+        bounds = dense_range_bounds(src.cap, k)
+        counts = src.counts                 # host sync: degraded path
+        parts: List[List[Any]] = [[] for _ in range(mex.num_workers)]
+        for i in range(k):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            if hi <= lo:
+                continue
+            chunk_tree = jax.tree.map(lambda l: l[:, lo:hi], src.tree)
+            chunk = DeviceShards(
+                mex, chunk_tree,
+                np.clip(counts - lo, 0, hi - lo).astype(np.int64))
+            sub = FusionPlan(mex, [chunk])
+            sub.segments = list(self.segments)
+            sub.known_counts = None
+            sub._no_finalize = True
+            sub._no_split = True
+            out_k = sub.execute()
+            for w, t in enumerate(out_k.to_worker_arrays()):
+                parts[w].append(t)
+        per_worker = [jax.tree.map(
+            lambda *ls: np.concatenate([np.asarray(l) for l in ls],
+                                       axis=0), *p) for p in parts]
+        return DeviceShards.from_worker_arrays(mex, per_worker)
+
 
 def wrap(shards) -> FusionPlan:
     """Plan-shaped wrapper around computed shards (host or device)."""
@@ -352,7 +476,10 @@ def stack_segment(stack: Stack, dia_id: Optional[int] = None) -> Segment:
                    token=("stack", stack_cache_token(stack)),
                    trace=trace, bound=bound,
                    preserves_counts=all(op.kind == "map" for op in stack),
-                   dia_id=dia_id)
+                   dia_id=dia_id, row_local=True,
+                   expands=any(op.kind == "flat_map" for op in stack),
+                   host_apply=lambda items, _s=stack:
+                       apply_stack_host_list(items, _s))
 
 
 def pull_plan(link, consume: bool = True) -> FusionPlan:

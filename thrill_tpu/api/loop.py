@@ -38,8 +38,15 @@ How the tape stays correct:
   ZipWithIndex offsets, join capacities) are ITERATION-INVARIANT —
   true for the fixed-shape loops this layer targets (PageRank,
   k-means, SGD) where every such value derives from counts that do not
-  change across iterations. ``THRILL_TPU_LOOP_REPLAY=0`` restores the
-  exact per-iteration planning behavior.
+  change across iterations. Invariance of a fetched plan value is
+  verified per output LEAF: when host plan logic reads an output of a
+  carry-dependent dispatch, the call's jaxpr input→output reachability
+  (:class:`_LeafTaint`) decides whether THAT output depends on the
+  carry — a constant-topology W>1 shuffle's send matrix (fixed key
+  column riding next to the changing ranks) captures, a genuinely
+  data-dependent plan still rejects, and every analysis gap falls back
+  to the conservative per-call verdict. ``THRILL_TPU_LOOP_REPLAY=0``
+  restores the exact per-iteration planning behavior.
 * KNOWN BLIND SPOT — carry-dependent Python control flow: a body that
   branches on a scalar it computes with EAGER jnp math and converts
   directly (``if float(jnp.sum(x)) < eps``, ``bool()``, ``.item()``,
@@ -135,11 +142,18 @@ class _Call:
     [leaf refs]) for pytree arguments that MIX loop-owned leaves with
     constants (a jit_cached body called on the carry dict).  Filled
     during analysis: ``donate_pos`` — argument positions whose buffers
-    are loop-owned and dead after this call."""
+    are loop-owned and dead after this call.  ``leaf_kinds`` (flatten
+    order across all arguments = jaxpr invar order) and ``avals``
+    support the per-output-LEAF taint refinement: a fetched output
+    that provably depends only on constant/invariant input leaves
+    does not poison the tape even when ANOTHER output of the same
+    call is carry-dependent."""
     fn: Any
     arg_refs: List[Tuple]
     out_buffers: List[Any]
     donate_pos: Tuple[int, ...] = ()
+    leaf_kinds: Optional[List[Tuple]] = None
+    avals: Optional[Tuple] = None
 
 
 def _leaf_refs(refs):
@@ -162,7 +176,7 @@ class _Recorder:
         self.carry_ids = carry_ids
         self.calls: List[_Call] = []
         self.produced: Dict[int, Tuple[int, int]] = {}
-        self.plan_reads: set = set()     # call idxs fetched to host
+        self.plan_reads: set = set()   # (call, out) leaves fetched to host
         self.dispatch_s = 0.0            # issue time inside dispatches
         self.dirty: Optional[str] = None
         # constant provenance: device arrays live BEFORE the capture
@@ -195,19 +209,20 @@ class _Recorder:
     def on_fetch(self, arr) -> None:
         """Host plan logic fetched ``arr`` during the capture run. If a
         recorded dispatch produced it, the body's between-dispatch
-        host code READ loop data — remember the producer so analysis
-        can reject the tape when that producer is carry-dependent
-        (its fetched value would vary per iteration: a data-dependent
-        exchange send matrix, a join size agreement). A fetched CARRY
-        leaf is carry-dependent by definition (e.g. the carry's device
-        counts sizing an exchange) — reject outright."""
+        host code READ loop data — remember the producing (call, out)
+        LEAF so analysis can reject the tape when that specific output
+        is carry-dependent (its fetched value would vary per
+        iteration: a data-dependent exchange send matrix, a join size
+        agreement). A fetched CARRY leaf is carry-dependent by
+        definition (e.g. the carry's device counts sizing an exchange)
+        — reject outright."""
         if id(arr) in self.carry_ids:
             self.dirty = ("host plan logic fetched a carry leaf "
                           "during capture (carry-dependent plan)")
             return
         src = self.produced.get(id(arr))
         if src is not None:
-            self.plan_reads.add(src[0])
+            self.plan_reads.add(src)
 
     def _leaf_ref(self, a) -> Optional[Tuple]:
         slot = self.carry_ids.get(id(a))
@@ -240,6 +255,7 @@ class _Recorder:
             self.dirty = "dispatch with keyword arguments"
             return
         refs: List[Tuple] = []
+        leaf_kinds: List[Tuple] = []     # flatten order = jaxpr invars
         for a in args:
             leaves, td = jax.tree.flatten(a)
             if len(leaves) == 1 and leaves[0] is a:
@@ -247,6 +263,7 @@ class _Recorder:
                 if ref is None:
                     return
                 refs.append(ref)
+                leaf_kinds.append(ref)
                 continue
             subs = []
             for l in leaves:
@@ -254,15 +271,139 @@ class _Recorder:
                 if s is None:
                     return
                 subs.append(s)
+            leaf_kinds.extend(subs)
             if all(s[0] == "const" for s in subs):
                 refs.append(("const", a))     # wholly-constant pytree
             else:
                 refs.append(("tree", td, subs))
+        try:
+            # abstract argument shapes for the per-output-leaf taint
+            # refinement (re-tracing with ShapeDtypeStructs is cheap
+            # and happens only for fetched, carry-dependent calls)
+            avals = tuple(
+                jax.tree.map(lambda l: jax.ShapeDtypeStruct(
+                    jnp.shape(l), jnp.result_type(l)), a)
+                for a in args)
+        except Exception:
+            avals = None                  # conservative: no refinement
         out_leaves = jax.tree.leaves(out)
         idx = len(self.calls)
         for j, o in enumerate(out_leaves):
             self.produced[id(o)] = (idx, j)
-        self.calls.append(_Call(fn, refs, out_leaves))
+        self.calls.append(_Call(fn, refs, out_leaves,
+                                leaf_kinds=leaf_kinds, avals=avals))
+
+
+# ----------------------------------------------------------------------
+# per-output-leaf taint refinement (jaxpr input->output reachability)
+# ----------------------------------------------------------------------
+
+# call-like primitives whose sub-jaxpr maps eqn invars to outvars
+# one-to-one, so reachability may recurse instead of union-ing all
+# inputs into all outputs. Loops/conds (scan, while, cond) are NOT
+# here on purpose: their iteration semantics mix operands across
+# rounds, so they keep the conservative union.
+_CALL_PRIMS = frozenset({"pjit", "closed_call", "core_call", "xla_call",
+                         "custom_jvp_call", "custom_vjp_call",
+                         "remat", "checkpoint", "shard_map"})
+
+
+def _jaxpr_output_deps(jaxpr) -> List[frozenset]:
+    """For each jaxpr output, the set of INVAR indices it may depend
+    on — a conservative over-approximation (per-equation union, with
+    recursion into call-like sub-jaxprs so a ``pjit``/``shard_map``
+    wrapper does not collapse the whole program into one equation)."""
+    deps = {v: frozenset([i]) for i, v in enumerate(jaxpr.invars)}
+
+    def get(atom):
+        if hasattr(atom, "val"):           # Literal
+            return frozenset()
+        return deps.get(atom, frozenset())  # constvars -> empty
+
+    for eqn in jaxpr.eqns:
+        sub = None
+        if eqn.primitive.name in _CALL_PRIMS:
+            p = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+            if p is not None:
+                inner = getattr(p, "jaxpr", p)   # ClosedJaxpr -> Jaxpr
+                if len(inner.invars) == len(eqn.invars):
+                    sub = inner
+        if sub is not None:
+            inner_out = _jaxpr_output_deps(sub)
+            in_sets = [get(a) for a in eqn.invars]
+            for ov, od in zip(eqn.outvars, inner_out):
+                s = frozenset()
+                for k in od:
+                    s |= in_sets[k]
+                deps[ov] = s
+            continue
+        u = frozenset()
+        for a in eqn.invars:
+            u |= get(a)
+        for ov in eqn.outvars:
+            deps[ov] = u
+    return [get(o) for o in jaxpr.outvars]
+
+
+def _call_output_deps(c: "_Call") -> Optional[List[frozenset]]:
+    """Per-output-leaf invar dependence of one recorded call, from a
+    fresh abstract trace of its program; None (refinement unavailable)
+    on any failure — the caller then falls back to call-level taint."""
+    if c.leaf_kinds is None or c.avals is None:
+        return None
+    target = getattr(c.fn, "raw", None) or getattr(c.fn, "_jitted",
+                                                   None)
+    if target is None:
+        return None
+    try:
+        closed = jax.make_jaxpr(target)(*c.avals)
+        return _jaxpr_output_deps(closed.jaxpr)
+    except Exception:
+        return None
+
+
+class _LeafTaint:
+    """Transitive per-output-LEAF carry dependence over a recorded
+    tape: output (i, j) is carry-dependent iff the jaxpr-level
+    reachability of call ``i`` connects it to a carry input leaf or to
+    a carry-dependent output of an earlier call (judged recursively at
+    leaf level). Conservative at every gap: a call whose program
+    cannot be re-traced falls back to its call-level verdict. Traces
+    are computed lazily and memoized — only calls actually reachable
+    from a fetched output pay one abstract trace."""
+
+    def __init__(self, calls: List["_Call"], dep: List[bool]) -> None:
+        self.calls = calls
+        self.dep = dep
+        self._out_deps: Dict[int, Optional[List[frozenset]]] = {}
+        self._pair: Dict[Tuple[int, int], bool] = {}
+
+    def pair_dep(self, i: int, j: int) -> bool:
+        key = (i, j)
+        hit = self._pair.get(key)
+        if hit is not None:
+            return hit
+        if not self.dep[i]:
+            self._pair[key] = False
+            return False
+        od = self._out_deps.get(i, ...)
+        if od is ...:
+            od = self._out_deps[i] = _call_output_deps(self.calls[i])
+        kinds = self.calls[i].leaf_kinds
+        r = True                        # conservative default
+        if od is not None and kinds is not None and j < len(od):
+            r = False
+            for k in od[j]:
+                if k >= len(kinds):
+                    r = True
+                    break
+                ref = kinds[k]
+                if ref[0] == "carry" or (
+                        ref[0] == "val" and self.pair_dep(*ref[1])):
+                    r = True
+                    break
+        self._pair[key] = r
+        return r
 
 
 # ----------------------------------------------------------------------
@@ -313,11 +454,17 @@ class LoopPlan:
         # host plan logic that read a CARRY-DEPENDENT value during
         # capture (data-dependent exchange send matrix, a size
         # agreement) would be frozen by the tape at iteration-1 values
-        # — reject instead; iteration-invariant reads (index-range
-        # exchange sizing over a fixed key column) are unverifiable by
-        # dataflow alone, so dependence is judged conservatively
-        for i in self.plan_reads:
-            if dep[i]:
+        # — reject. Dependence is judged per output LEAF: when the
+        # producing call is carry-dependent overall, its jaxpr's
+        # input->output reachability decides whether THIS output
+        # depends on a carry leaf or only on constants/invariant
+        # values (a constant-topology shuffle's send matrix derives
+        # from a fixed key column riding next to the changing ranks —
+        # per-CALL taint would reject it, per-leaf taint captures it).
+        # Refinement failures fall back to the per-call verdict.
+        taint = _LeafTaint(calls, dep)
+        for i, j in self.plan_reads:
+            if dep[i] and taint.pair_dep(i, j):
                 self.invalid = ("host plan logic read a "
                                 "carry-dependent value during capture "
                                 "(data-dependent exchange plan?)")

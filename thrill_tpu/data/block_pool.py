@@ -4,7 +4,11 @@ Equivalent of the reference's BlockPool/ByteBlock layer
 (reference: thrill/data/block_pool.hpp:42 — soft/hard limits, pin/unpin,
 LRU eviction to disk): bytes live in the C++ store (native/
 blockstore.cpp, built on first use with g++), Python handles only ids.
-Falls back to a pure-Python dict store when no compiler is available.
+Falls back to a pure-Python store when no compiler is available — with
+the SAME soft-limit spill-to-disk ladder (synchronous writes, same
+pid/store/host file naming so ``purge_stale_spills`` reclaims its
+files too), so a compiler-less host degrades instead of growing
+unbounded.
 """
 
 from __future__ import annotations
@@ -29,6 +33,17 @@ _F_GET = faults.declare("data.blockstore.get")
 _LIB_LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_FAILED = False
+
+
+def _sanitized_host() -> str:
+    """This host's tag as it appears in spill file names. ASCII-only
+    sanitization matching the C-locale std::isalnum the native writer
+    uses — the fallback writer and the purge sweeper must map a
+    hostname IDENTICALLY to the native store (and to each other) or
+    the host tag never matches and stale spills leak."""
+    import socket
+    return "".join(c if (c.isascii() and c.isalnum()) else "_"
+                   for c in socket.gethostname()) or "unknown"
 
 
 def _load_native() -> Optional[ctypes.CDLL]:
@@ -106,10 +121,61 @@ class BlockPool:
         if self.native:
             self._h = self._lib.bs_create(spill_dir.encode(), soft_limit,
                                           1 if async_io else 0)
-        else:  # pure-python fallback: no spill, just a dict
-            self._blocks: Dict[int, bytes] = {}
+        else:
+            # pure-python fallback: resident dict + synchronous spill
+            # to disk past the soft limit, the same degradation ladder
+            # as the native store (a host without a compiler must not
+            # grow unbounded — it gets slower, not bigger). Spill files
+            # carry the native pid/store/host naming so
+            # purge_stale_spills reclaims them after a kill -9.
+            self._blocks: Dict[int, bytes] = {}   # resident (insertion=LRU)
+            self._spilled: Dict[int, str] = {}    # block id -> file path
+            self._pins: Dict[int, int] = {}
             self._next = 1
             self._soft = soft_limit
+            self._mem = 0
+            self._spill_dir = spill_dir
+            self._host_tag = _sanitized_host()
+
+    # -- pure-python spill ladder ---------------------------------------
+    def _spill_path(self, block_id: int) -> str:
+        return os.path.join(
+            self._spill_dir,
+            f"ttpu-blk-{os.getpid()}-{hex(id(self))}-{block_id}-"
+            f"{self._host_tag}.spill")
+
+    def _maybe_spill_py(self) -> None:
+        """Evict coldest unpinned resident blocks to disk until the
+        resident bytes fit the soft limit. A failed write keeps the
+        block resident (over budget beats data loss), mirroring the
+        native store's failed-spill handling."""
+        if self._soft <= 0 or self._mem <= self._soft:
+            return
+        for bid in list(self._blocks.keys()):
+            if self._mem <= self._soft:
+                break
+            if self._pins.get(bid, 0) > 0:
+                continue
+            data = self._blocks[bid]
+            path = self._spill_path(bid)
+            try:
+                with open(path, "wb") as f:
+                    f.write(data)
+            except OSError as e:
+                # a mid-write failure (ENOSPC) leaves a truncated file
+                # close() would never sweep (it is not in _spilled) —
+                # unlink it; it is consuming exactly the disk whose
+                # shortage failed the spill
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                faults.note("recovery", what="blockpool.spill_skipped",
+                            block=bid, error=repr(e)[:200])
+                continue
+            self._spilled[bid] = path
+            del self._blocks[bid]
+            self._mem -= len(data)
 
     def put(self, data: bytes) -> int:
         return self._policy.run(lambda: self._put_once(data),
@@ -122,6 +188,8 @@ class BlockPool:
         bid = self._next
         self._next += 1
         self._blocks[bid] = bytes(data)
+        self._mem += len(data)
+        self._maybe_spill_py()
         return bid
 
     def get(self, block_id: int) -> bytes:
@@ -139,21 +207,44 @@ class BlockPool:
             if rc != 0:
                 raise IOError(f"block {block_id} fetch failed rc={rc}")
             return buf.raw[:size]
-        return self._blocks[block_id]
+        if block_id in self._blocks:
+            return self._blocks[block_id]
+        path = self._spilled.get(block_id)
+        if path is None:
+            raise KeyError(f"unknown block {block_id}")
+        with open(path, "rb") as f:
+            return f.read()
 
     def pin(self, block_id: int) -> None:
         if self.native:
             self._lib.bs_pin(self._h, block_id)
+        else:
+            self._pins[block_id] = self._pins.get(block_id, 0) + 1
 
     def unpin(self, block_id: int) -> None:
         if self.native:
             self._lib.bs_unpin(self._h, block_id)
+        else:
+            n = self._pins.get(block_id, 0) - 1
+            if n > 0:
+                self._pins[block_id] = n
+            else:
+                self._pins.pop(block_id, None)
 
     def drop(self, block_id: int) -> None:
         if self.native:
             self._lib.bs_drop(self._h, block_id)
         else:
-            self._blocks.pop(block_id, None)
+            data = self._blocks.pop(block_id, None)
+            if data is not None:
+                self._mem -= len(data)
+            self._pins.pop(block_id, None)
+            path = self._spilled.pop(block_id, None)
+            if path is not None:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     # -- sharing (reference: ByteBlock reference counting,
     # thrill/data/byte_block.hpp:51 — Blocks are slices of shared
@@ -186,18 +277,28 @@ class BlockPool:
     def mem_usage(self) -> int:
         if self.native:
             return self._lib.bs_mem_usage(self._h)
-        return sum(len(b) for b in self._blocks.values())
+        return self._mem
 
     @property
     def num_blocks(self) -> int:
         if self.native:
             return self._lib.bs_num_blocks(self._h)
-        return len(self._blocks)
+        return len(self._blocks) + len(self._spilled)
 
     def close(self) -> None:
-        if self.native and self._h:
-            self._lib.bs_destroy(self._h)
-            self._h = None
+        if self.native:
+            if self._h:
+                self._lib.bs_destroy(self._h)
+                self._h = None
+        else:
+            for path in self._spilled.values():
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            self._spilled.clear()
+            self._blocks.clear()
+            self._mem = 0
 
     def __del__(self):  # pragma: no cover
         try:
@@ -209,7 +310,8 @@ class BlockPool:
 def purge_stale_spills(spill_dir: str) -> int:
     """Remove spill files abandoned by DEAD processes.
 
-    The native store names its files ``ttpu-blk-<pid>-<store>-<id>-
+    The store (native and the pure-python fallback alike) names its
+    files ``ttpu-blk-<pid>-<store>-<id>-
     <host>.spill`` and unlinks them in its destructor — but a kill
     -9'd or aborted worker never runs destructors, leaking its spills
     into the shared spill dir. Context.close() calls this after an
@@ -219,12 +321,7 @@ def purge_stale_spills(spill_dir: str) -> int:
     judged — a local pid probe says nothing about a remote process.
     Returns the number removed."""
     import glob as _glob
-    import socket as _socket
-    # ASCII-only sanitization, matching the C-locale std::isalnum the
-    # native writer uses — a non-ASCII hostname must map identically
-    # on both sides or the host tag never matches
-    my_host = "".join(c if (c.isascii() and c.isalnum()) else "_"
-                      for c in _socket.gethostname()) or "unknown"
+    my_host = _sanitized_host()
     removed = 0
     for path in _glob.glob(os.path.join(spill_dir, "ttpu-blk-*.spill")):
         parts = os.path.basename(path)[:-len(".spill")].split("-")

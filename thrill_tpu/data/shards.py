@@ -321,6 +321,12 @@ class HostShards:
     def total(self) -> int:
         return sum(len(l) for l in self.lists)
 
+    def validate_pending(self) -> None:
+        """Host storage carries no deferred device validations; the
+        no-op keeps the fused-boundary contract uniform (a plan's
+        memory-pressure host fallback returns HostShards through
+        ``FusionPlan.finish``, which validates unconditionally)."""
+
     def to_device(self, mesh_exec: MeshExec) -> DeviceShards:
         """Columnarize (requires items be fixed-shape pytrees of numbers)."""
         if getattr(mesh_exec, "num_processes", 1) > 1:

@@ -1,1 +1,3 @@
 from .manager import MemoryManager, MemoryConfig  # noqa: F401
+from .pressure import (PressureMonitor, SimulatedOom,  # noqa: F401
+                       is_oom_error)

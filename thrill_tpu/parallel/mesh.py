@@ -26,6 +26,7 @@ import numpy as np
 
 from ..common import faults
 from ..common.retry import default_policy
+from ..mem import pressure as _pressure
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:                                    # jax >= 0.6: top-level export,
@@ -75,22 +76,51 @@ class _CountedJit:
         # on it so equal tapes share ONE compiled fori_loop
         self.cache_key: Optional[Tuple] = None
         self._donating: Dict[Tuple[int, ...], Callable] = {}
+        # memory-pressure cost model (mem/pressure.py): the program's
+        # measured output bytes, learned on the first successful call;
+        # the donating-twin back-pointer lets the OOM ladder re-dispatch
+        # with donation disarmed
+        self._out_bytes: Optional[int] = None
+        self._donate_base: Optional["_CountedJit"] = None
         functools.update_wrapper(self, jitted, updated=())
 
     def __call__(self, *args, **kwargs):
-        self._mex.stats_dispatches += 1
-        if not faults.REGISTRY.active():
-            # disarmed hot path: dispatch-per-iteration is the budgeted
-            # cost in this codebase — no policy construction, no env
-            # reads beyond active()'s one
-            out = self._jitted(*args, **kwargs)
-        else:
-            def dispatch():
-                faults.check(_F_DISPATCH)
-                return self._jitted(*args, **kwargs)
+        mex = self._mex
+        mex.stats_dispatches += 1
+        pres = mex.pressure
+        if pres is not None and pres.enabled:
+            # rung 1, admission control: estimate this dispatch's
+            # output+workspace bytes and pre-spill cold cached shards
+            # when the governor ledger says HBM is near the watermark
+            pres.admit(self, args)
+        try:
+            if not faults.REGISTRY.active():
+                # disarmed hot path: dispatch-per-iteration is the
+                # budgeted cost in this codebase — no policy
+                # construction, no env reads beyond active()'s one
+                out = self._jitted(*args, **kwargs)
+            else:
+                def dispatch():
+                    faults.check(_F_DISPATCH)
+                    faults.check(_pressure._F_OOM)
+                    return self._jitted(*args, **kwargs)
 
-            out = default_policy().run(dispatch, what="mesh.dispatch")
-        rec = self._mex.loop_recorder
+                out = default_policy().run(dispatch,
+                                           what="mesh.dispatch")
+        except Exception as e:
+            # rung 2, OOM-retry: device RESOURCE_EXHAUSTED spills the
+            # LRU cache and re-dispatches (donation disarmed) under
+            # the shared backoff budget; anything else — and every
+            # error with the ladder disabled — re-raises unchanged
+            if not (_pressure.retry_enabled()
+                    and _pressure.is_oom_error(e)):
+                raise
+            out = _pressure.recover_dispatch(self, args, kwargs, e)
+        if pres is not None and pres.enabled and self._out_bytes is None:
+            self._out_bytes = sum(
+                int(getattr(l, "nbytes", 0) or 0)
+                for l in jax.tree.leaves(out))
+        rec = mex.loop_recorder
         if rec is not None:
             rec.on_call(self, args, kwargs, out)
         return out
@@ -107,6 +137,10 @@ class _CountedJit:
             fn = _CountedJit(self._mex,
                              jax.jit(self.raw,
                                      donate_argnums=donate_argnums))
+            # the OOM ladder (mem/pressure.py) retries a failed
+            # donating dispatch through THIS base so the retry never
+            # re-donates buffers the failed attempt may have consumed
+            fn._donate_base = self
             self._donating[donate_argnums] = fn
         return fn
 
@@ -167,6 +201,10 @@ class MeshExec:
         # active tape recorder (None = zero-overhead fast path); set by
         # api/loop.py around a capture iteration's body run
         self.loop_recorder = None
+        # memory-pressure monitor (mem/pressure.py), attached by the
+        # Context once the HbmGovernor exists; None = the dispatch
+        # choke point pays one attribute read and no admission runs
+        self.pressure = None
         # per-Iterate reports (phase timings, replay hit rate) for
         # bench.py / tools/loop_report.py
         self.loop_reports: list = []

@@ -129,7 +129,8 @@ def render_html(events: List[dict]) -> str:
             exchanges.append((t, e))
         elif e.get("event") in ("hbm_spill", "hbm_restore",
                                 "mem_negotiate", "device_to_host",
-                                "host_replicate"):
+                                "host_replicate", "mem_spill",
+                                "oom_retry", "segment_split"):
             memory.append((t, e))
         elif e.get("event") in ("fault_injected", "retry", "recovery",
                                 "abort"):
@@ -506,12 +507,15 @@ def _render_host_overlay(profiles, total: float) -> str:
 
 def _render_memory_events(memory, total: float) -> str:
     """Memory-pressure timeline: HBM spills/restores, device->host
-    demotions and negotiation grants as ticks on one lane each
-    (reference: BlockPool occupancy in the profile report)."""
+    demotions, negotiation grants, and the escalation-ladder events
+    (admission spills, OOM retries, segment splits — mem/pressure.py)
+    as ticks on one lane each (reference: BlockPool occupancy in the
+    profile report)."""
     if not memory:
         return ""
     kinds = ["hbm_spill", "hbm_restore", "device_to_host",
-             "mem_negotiate"]
+             "mem_negotiate", "mem_spill", "oom_retry",
+             "segment_split"]
     lanes = []
     for kind in kinds:
         evs = [(t, e) for t, e in memory if e.get("event") == kind]
