@@ -300,8 +300,9 @@ def _run_bench() -> None:
 
     run_once()                      # warmup + compile
     run_once()                      # second warmup: steady-state HBM/GC
+    xs = _xchg_snapshot(mex)
     dt, disp = _best_of(run_once, iters=3)
-    _set(terasort_disp=disp)
+    _set(terasort_disp=disp, **_xchg_fields(mex, xs, "terasort"))
     _note_dispersion(disp)
 
     # host proxy baseline on identical data (best-of-2: one spike in
@@ -341,6 +342,18 @@ def _run_bench() -> None:
          hbm_high_watermark=int(press.get("hbm_high_watermark", 0)),
          oom_retries=int(press.get("oom_retries", 0)),
          segment_splits=int(press.get("segment_splits", 0)))
+    # overlapped-exchange data plane (data/exchange.py): run-wide
+    # overlap fraction, capacity-plan cache hit rate, and the
+    # bytes-on-wire baseline for the shrink-the-wire ROADMAP item
+    n_ex = int(press.get("exchanges", 0))
+    hits = int(press.get("cap_cache_hits", 0))
+    misses = int(press.get("cap_cache_misses", 0))
+    _set(exchange_overlap_frac=round(
+             press.get("exchanges_overlapped", 0) / n_ex, 3)
+         if n_ex else 0.0,
+         cap_cache_hit=round(hits / (hits + misses), 3)
+         if hits + misses else 0.0,
+         bytes_on_wire=int(press.get("bytes_on_wire", 0)))
 
     _emit(value=round(mrec_s, 3),
           vs_baseline=round(mrec_s / host_mrec_s, 3),
@@ -429,6 +442,28 @@ def _loop_phase_fields(ctx, name: str, prefix: str) -> dict:
             f"{prefix}_capture_s": round(r["capture_s"], 4)}
 
 
+def _xchg_snapshot(mex) -> tuple:
+    """(exchanges, overlapped, cap hits, cap misses) counter snapshot
+    for per-workload exchange-overlap attribution."""
+    return (mex.stats_exchanges, mex.stats_exchanges_overlapped,
+            mex.stats_cap_cache_hits, mex.stats_cap_cache_misses)
+
+
+def _xchg_fields(mex, snap, prefix: str) -> dict:
+    """Per-workload overlap fields since ``snap``: what fraction of the
+    workload's exchanges dispatched with NO mid-shuffle host sync
+    (``*_exchange_overlap_frac`` — the ROADMAP success metric: near 1.0
+    in steady state at W>1, exactly 0 where the workload has no
+    exchanges, e.g. dense-gather PageRank) and the capacity-plan cache
+    hit rate over its lookups."""
+    ex, ov, h, m = (b - a for a, b in zip(snap, _xchg_snapshot(mex)))
+    out = {f"{prefix}_exchange_overlap_frac":
+           round(ov / ex, 3) if ex else 0.0}
+    if h + m:
+        out[f"{prefix}_cap_cache_hit"] = round(h / (h + m), 3)
+    return out
+
+
 def _pagerank_metric(ctx) -> dict:
     """PageRank end-to-end: per-iteration edge throughput of the full
     DIA pipeline (dense-gather InnerJoin + scatter ReduceToIndex,
@@ -451,7 +486,9 @@ def _pagerank_metric(ctx) -> dict:
                                            iterations=iters)
 
         once()                                   # warmup + compile
+        xs = _xchg_snapshot(ctx.mesh_exec)
         dt, disp = _best_of(once, iters=2)
+        xf = _xchg_fields(ctx.mesh_exec, xs, "pagerank")
         _note_dispersion(disp)
         hh = {}
 
@@ -465,7 +502,7 @@ def _pagerank_metric(ctx) -> dict:
             return {"pagerank_error": "parity mismatch vs numpy"}
         return {"pagerank_medges_s": round(m * iters / dt / 1e6, 3),
                 "pagerank_vs_numpy": round(host_dt / dt, 3),
-                "pagerank_disp": disp,
+                "pagerank_disp": disp, **xf,
                 **_loop_phase_fields(ctx, "page_rank", "pagerank")}
     except Exception as e:  # secondary metric never kills the line
         return {"pagerank_error": repr(e)[:200]}

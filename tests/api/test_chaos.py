@@ -32,7 +32,13 @@ from test_fuzz_pipelines import _apply_ref, _gen_ops, apply_ops
 _CHAOS_SITES = ("api.mesh.dispatch", "data.blockstore.put",
                 "data.blockstore.get", "mem.hbm.spill",
                 "mem.hbm.restore", "mem.oom", "mem.spill",
-                "mem.estimate", "vfs.open_read", "vfs.read")
+                "mem.estimate", "vfs.open_read", "vfs.read",
+                # overlapped exchange (ISSUE 6): the per-chunk phase-B
+                # dispatch site — reachable whenever a W=2 pipeline
+                # shuffles (reduce/groupby/join ops in the generator);
+                # net.multiplexer.async_send needs multi-controller
+                # groups and gets its chaos from the fault matrix
+                "data.exchange.chunk")
 
 import os
 

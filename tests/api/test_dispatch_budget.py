@@ -285,6 +285,80 @@ def test_pagerank_pipeline_fusion_budget(monkeypatch):
     assert stats["loop_fori_iters"] == 6         # iterations 2..4, x2
 
 
+def _xk(t):
+    return t["k"]
+
+
+def test_exchange_overlap_budget():
+    """Exchange-overlap lane: a steady-state repeated query at W=2
+    (hash ReduceByKey — a real shuffle per run) pays the mid-shuffle
+    send-matrix sync exactly ONCE. Runs 2..N dispatch phase B on the
+    cached capacity plan: the capacity-cache hit rate is >= (N-1)/N
+    and the per-run tracked-fetch budget drops to the egress fetches
+    alone (zero mid-shuffle host syncs — the ISSUE 6 acceptance
+    metric; an Iterate replay tape composes on top by skipping the
+    planning step entirely, pinned in tests/api/test_loop.py)."""
+    from thrill_tpu.api import FieldReduce
+    mex = MeshExec(num_workers=2)
+    ctx = Context(mex)
+    rng = np.random.default_rng(11)
+    vals = rng.integers(0, 64, 4096).astype(np.int64)
+    red = FieldReduce({"k": "first", "c": "sum"})
+
+    def run():
+        out = ctx.Distribute(
+            {"k": vals, "c": np.ones_like(vals)}).ReduceByKey(_xk, red)
+        sh = out.node.materialize()
+        jax.block_until_ready(jax.tree.leaves(sh.tree))
+
+    run()                       # warm: compile + the one synced plan
+    assert mex.stats_cap_cache_misses == 0   # first run syncs, no miss
+    h0, f0, ov0 = (mex.stats_cap_cache_hits, mex.stats_fetches,
+                   mex.stats_exchanges_overlapped)
+    N = 4
+    for _ in range(N):
+        run()
+    assert mex.stats_exchanges_overlapped - ov0 == N
+    assert mex.stats_cap_cache_hits - h0 >= N
+    assert mex.stats_cap_cache_misses == 0
+    # zero tracked fetches for N whole runs: no mid-shuffle sync, and
+    # the post-phase counts stay device-resident to the barrier
+    assert mex.stats_fetches - f0 == 0, mex.stats_fetches - f0
+    ctx.close()
+
+
+def test_bytes_on_wire_pinned():
+    """bytes_on_wire budgets, pinned like dispatch counts: the W=1
+    PageRank pipeline ships NOTHING (the dense-gather join needs no
+    exchange — that zero IS the claim), a W=2 WordCount-shaped reduce
+    ships its padded phase-B blocks, and the stat matches the dense
+    plan's fabric formula exactly."""
+    sys.path.insert(0, _EXAMPLES)
+    import page_rank as pr
+    mex = MeshExec(num_workers=1)
+    ctx = Context(mex)
+    edges = pr.zipf_graph(256, 2048)
+    pr.page_rank(ctx, edges, 256, iterations=3)
+    assert ctx.overall_stats()["bytes_on_wire"] == 0
+    ctx.close()
+
+    from thrill_tpu.api import FieldReduce
+    mex2 = MeshExec(num_workers=2)
+    ctx2 = Context(mex2)
+    vals = np.arange(2048, dtype=np.int64)
+    red = FieldReduce({"k": "first", "c": "sum"})
+    out = ctx2.Distribute(
+        {"k": vals, "c": np.ones_like(vals)}).ReduceByKey(_xk, red)
+    out.node.materialize()
+    stats = ctx2.overall_stats()
+    assert stats["bytes_on_wire"] > 0
+    assert stats["bytes_on_wire"] == stats["bytes_wire_device"]
+    # dense plan fabric volume: W*(W-1)*M_pad rows x item bytes per
+    # exchange — the stat is the padded-wire truth, not payload bytes
+    assert stats["bytes_wire_device"] % (2 * (2 - 1)) == 0
+    ctx2.close()
+
+
 def test_put_small_content_cache():
     mex = MeshExec(num_workers=2)
     u0 = mex.stats_uploads

@@ -146,6 +146,62 @@ def test_pytree_carry_fori(monkeypatch):
     ctx.close()
 
 
+def test_fori_dispatch_rides_counted_jit_choke_point(monkeypatch):
+    """The whole-loop jit(fori_loop) program dispatches through
+    _CountedJit like every other device entry (first half of ROADMAP's
+    choke-point item): HBM admission sees its argument bytes, and an
+    injected device OOM at the fori dispatch degrades LOUDLY through
+    the ladder + Iterate's re-plan fallback instead of bypassing rung
+    1/2 entirely — with exact results either way."""
+    from thrill_tpu.common import faults
+    from thrill_tpu.common.config import Config
+
+    def run(hbm_env=None, arm_oom=False):
+        monkeypatch.setenv("THRILL_TPU_HOST_RADIX", "0")
+        if hbm_env:
+            # arms admission on CPU (mem/pressure.py detect_hbm_budget)
+            monkeypatch.setenv("THRILL_TPU_HBM_LIMIT", hbm_env)
+        else:
+            monkeypatch.delenv("THRILL_TPU_HBM_LIMIT", raising=False)
+        mex = MeshExec(num_workers=1)
+        ctx = Context(mex, Config())
+        step = mex.jit_cached(("fori_choke_step",),
+                              lambda t: {"x": t["x"] * 0.5 + 1.0})
+
+        def body(t):
+            return step(t)
+
+        carry = {"x": jnp.arange(8, dtype=jnp.float64)}
+        if arm_oom:
+            # fires at the NEXT dispatch after arming — the fori
+            # program (capture iteration already ran); the ladder's
+            # rung-2 retry (spill + re-dispatch) absorbs it
+            with faults.inject("mem.oom", n=1, seed=5):
+                out = Iterate(ctx, body, carry, 6, name="fori_choke")
+        else:
+            out = Iterate(ctx, body, carry, 6, name="fori_choke")
+        stats = ctx.overall_stats()
+        ctx.close()
+        return np.asarray(out["x"]), stats
+
+    want = np.arange(8, dtype=np.float64)
+    for _ in range(6):
+        want = want * 0.5 + 1.0
+    # admission: with a budget armed, the cost model's high watermark
+    # moves on the fori dispatch (it was invisible to the governor
+    # when the program bypassed the proxy)
+    got, stats = run(hbm_env="1Gi")
+    assert np.allclose(got, want)
+    assert stats["loop_fori_iters"] == 5
+    assert stats["hbm_high_watermark"] > 0
+    # OOM ladder: an injected RESOURCE_EXHAUSTED at the fori dispatch
+    # recovers (rung 2 or the Iterate re-plan fallback), exact results
+    got2, stats2 = run(arm_oom=True)
+    assert np.allclose(got2, want)
+    assert stats2["oom_retries"] >= 1 or \
+        stats2["loop_replay_fallbacks"] >= 1
+
+
 def test_invariant_producer_carry_leaf_folds_to_const(monkeypatch):
     """A carry leaf recomputed each iteration from CONSTANTS only (no
     carry dependence) is folded by the dataflow analysis — the tape

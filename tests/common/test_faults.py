@@ -228,6 +228,105 @@ def _ex_fused_per_op_sites():
     assert faults.REGISTRY.stats()["retries"] >= 1
 
 
+def _ex_exchange_chunk_site():
+    """data.exchange.chunk (overlapped exchange, data/exchange.py):
+    the per-chunk site in the chunked phase-B dispatch loop fires
+    before a chunk program launches — a transient fire retries under
+    the shared policy and the shuffle stays exact."""
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+    prev = os.environ.get("THRILL_TPU_XCHG_CHUNKS")
+    os.environ["THRILL_TPU_XCHG_CHUNKS"] = "2"   # real multi-chunk path
+    try:
+        with faults.inject("data.exchange.chunk", n=1, seed=4):
+            mex = MeshExec(num_workers=2)
+            ctx = Context(mex)
+            out = ctx.Distribute(
+                np.arange(64, dtype=np.int64)).Map(
+                    lambda x: (x % 5, x)).ReducePair(lambda a, b: a + b)
+            got = sorted((int(k), int(v)) for k, v in out.AllGather())
+            ctx.close()
+    finally:
+        if prev is None:
+            os.environ.pop("THRILL_TPU_XCHG_CHUNKS", None)
+        else:
+            os.environ["THRILL_TPU_XCHG_CHUNKS"] = prev
+    want = {k: sum(x for x in range(64) if x % 5 == k)
+            for k in range(5)}
+    assert got == sorted(want.items())
+    assert faults.REGISTRY.injected >= 1
+    assert faults.REGISTRY.stats()["retries"] >= 1
+
+
+def _ex_async_send_site():
+    """net.multiplexer.async_send (MixStream-analog host sender): the
+    background sender thread's injection point retries inside the
+    thread; delivery and CatStream order stay exact across 2 simulated
+    controllers."""
+    import threading
+
+    from thrill_tpu.data.multiplexer import host_exchange
+    from thrill_tpu.data.shards import HostShards
+    from thrill_tpu.net import FlowControlChannel
+    from thrill_tpu.net.mock import MockNetwork
+
+    W, P = 4, 2
+
+    class _Stub:
+        def __init__(self, pidx, group):
+            self.num_workers = W
+            self.num_processes = P
+            self.process_index = pidx
+            self.worker_process = np.repeat(np.arange(P), W // P)
+            self.host_net = FlowControlChannel(group)
+            self.stats_exchanges = 0
+            self.stats_items_moved = 0
+            self.logger = None
+
+        @property
+        def local_workers(self):
+            return [w for w in range(W)
+                    if self.worker_process[w] == self.process_index]
+
+    groups = MockNetwork.construct(P)
+    results = [None] * P
+    errors = [None] * P
+
+    def job(p):
+        try:
+            mex = _Stub(p, groups[p])
+            local = set(mex.local_workers)
+            shards = HostShards(W, [[(w, i) for i in range(3)]
+                                    if w in local else []
+                                    for w in range(W)])
+            out = host_exchange(mex, shards, lambda it: it[1] % W)
+            results[p] = out.lists
+        except BaseException as e:  # pragma: no cover
+            errors[p] = e
+
+    with faults.inject("net.multiplexer.async_send", n=1, seed=6):
+        threads = [threading.Thread(target=job, args=(p,), daemon=True)
+                   for p in range(P)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    for e in errors:
+        if e is not None:
+            raise e
+    assert all(not t.is_alive() for t in threads)
+    # every item delivered exactly once, to the right worker, on its
+    # owning process, in source-rank (CatStream) order
+    wp = np.repeat(np.arange(P), W // P)
+    for w in range(W):
+        owner = int(wp[w])
+        got = results[owner][w]
+        assert got == [(sw, i) for sw in range(W) for i in range(3)
+                       if i % W == w]
+    assert faults.REGISTRY.injected >= 1
+    assert faults.REGISTRY.stats()["retries"] >= 1
+
+
 def _ex_mesh_dispatch_exhausted():
     """api.mesh.dispatch surviving the budget: clean root-cause error,
     not a hang and not a wrong answer."""
@@ -540,6 +639,10 @@ _MATRIX = {
     "ckpt.read": _ex_ckpt_read,
     "data.blockstore.put": _ex_blockstore,
     "data.blockstore.get": _ex_blockstore,
+    # overlapped exchange data plane (ISSUE 6): per-chunk device
+    # dispatch site + the async host-frame sender thread
+    "data.exchange.chunk": _ex_exchange_chunk_site,
+    "net.multiplexer.async_send": _ex_async_send_site,
     "mem.hbm.spill": _ex_hbm_spill_and_restore,
     "mem.hbm.restore": _ex_hbm_spill_and_restore,
     "mem.oom": _ex_mem_oom,

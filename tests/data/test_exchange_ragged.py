@@ -7,21 +7,27 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from thrill_tpu.common.platform import has_ragged_all_to_all
+
 # this container's jax/jaxlib predates lax.ragged_all_to_all entirely
 # (added in jax 0.5); the trace/lowering contract can only be checked
 # where the op exists — on platforms without it these cases are a
-# known environment limit, not a regression
+# known environment limit, not a regression. The capability probe is
+# the shared common/platform helper, not a per-file hasattr copy.
 _NEEDS_RAGGED_OP = pytest.mark.skipif(
-    not hasattr(jax.lax, "ragged_all_to_all"),
+    not has_ragged_all_to_all(),
     reason="jax.lax.ragged_all_to_all not available in this jax "
            "version (XLA:CPU container); execution is TPU-only anyway")
 
 
 @_NEEDS_RAGGED_OP
-def test_ragged_path_traces_and_lowers():
+def test_ragged_path_traces_and_lowers(monkeypatch):
     from thrill_tpu.parallel.mesh import MeshExec
     from thrill_tpu.data import exchange
 
+    # the env override is captured at mesh construction (resolve_mode
+    # no longer reads os.environ per call) — set it FIRST
+    monkeypatch.setenv("THRILL_TPU_EXCHANGE", "ragged")
     cpus = jax.devices("cpu")[:4]
     mex = MeshExec(devices=cpus)
     W, cap = 4, 8
@@ -30,17 +36,12 @@ def test_ragged_path_traces_and_lowers():
     leaves = [jnp.zeros((W, cap), jnp.int64)]
     treedef = jax.tree.structure(0)
 
-    import os
-    os.environ["THRILL_TPU_EXCHANGE"] = "ragged"
-    try:
-        # tracing + abstract shapes must succeed; only backend compile
-        # of the ragged op is TPU-only
-        with pytest.raises(Exception) as ei:
-            exchange._exchange_planned(mex, treedef, None, leaves, S)
-        assert "ragged-all-to-all" in str(ei.value) or \
-            "UNIMPLEMENTED" in str(ei.value), str(ei.value)[:200]
-    finally:
-        os.environ.pop("THRILL_TPU_EXCHANGE", None)
+    # tracing + abstract shapes must succeed; only backend compile
+    # of the ragged op is TPU-only
+    with pytest.raises(Exception) as ei:
+        exchange._exchange_planned(mex, treedef, None, leaves, S)
+    assert "ragged-all-to-all" in str(ei.value) or \
+        "UNIMPLEMENTED" in str(ei.value), str(ei.value)[:200]
 
 
 @_NEEDS_RAGGED_OP
@@ -60,25 +61,28 @@ def test_lower_ragged_exchange_plan():
     assert "ragged" in hlo.lower()
 
 
-def test_ragged_off_tpu_warns_loudly(capsys):
+def test_ragged_off_tpu_warns_loudly(capsys, monkeypatch):
     """Forcing ragged on a CPU backend prints the untested-path gate
     before the compile error surfaces."""
     from thrill_tpu.parallel.mesh import MeshExec
     from thrill_tpu.data import exchange
 
+    monkeypatch.setenv("THRILL_TPU_EXCHANGE", "ragged")
     mex = MeshExec(devices=jax.devices("cpu")[:2])
     S = np.array([[1, 1], [1, 1]], dtype=np.int64)
     leaves = [jnp.zeros((2, 4), jnp.int64)]
     treedef = jax.tree.structure(0)
-    import os
-    os.environ["THRILL_TPU_EXCHANGE"] = "ragged"
-    try:
-        with pytest.raises(Exception):
-            exchange._exchange_planned(mex, treedef, None, leaves, S)
-    finally:
-        os.environ.pop("THRILL_TPU_EXCHANGE", None)
+    with pytest.raises(Exception):
+        exchange._exchange_planned(mex, treedef, None, leaves, S)
     err = capsys.readouterr().err
     assert "UNIMPLEMENTED" in err and "ragged" in err
+
+
+def test_probe_single_sourced():
+    """The capability probe is one common helper; the exchange planner
+    and every skipif gate share it (no hasattr copies to drift)."""
+    assert has_ragged_all_to_all() == hasattr(jax.lax,
+                                              "ragged_all_to_all")
 
 
 def test_landing_offsets_math():

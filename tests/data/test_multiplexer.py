@@ -144,3 +144,65 @@ def test_net_fold():
                         lambda a, b: a + b, empty=mex.process_index == 1)
 
     assert run_procs(4, 2, job_empty_one) == [5, 5]
+
+
+def _xchg_job(W, rank_order=True):
+    def items_of(w):
+        return [(w, i) for i in range(4 + w)]
+
+    def job(mex):
+        shards = local_input(mex, W, items_of)
+        out = host_exchange(mex, shards, lambda it: it[1] % W,
+                            rank_order=rank_order)
+        return out.lists
+
+    return items_of, job
+
+
+@pytest.mark.parametrize("P", [2, 3])
+def test_async_sender_matches_serial(P, monkeypatch):
+    """The background-sender (MixStream-analog) data plane delivers
+    the identical CatStream result as the serial per-peer sender, and
+    accounts the serialized frame bytes it put on the wire."""
+    W = 6
+    items_of, job = _xchg_job(W)
+    monkeypatch.setenv("THRILL_TPU_ASYNC_SEND", "0")
+    serial = run_procs(W, P, job)
+    monkeypatch.setenv("THRILL_TPU_ASYNC_SEND", "1")
+    wire = {}
+
+    def job_async(mex):
+        out = job(mex)
+        wire[mex.process_index] = getattr(mex, "stats_bytes_wire_host",
+                                          0)
+        return out
+
+    assert run_procs(W, P, job_async) == serial
+    assert all(b > 0 for b in wire.values())   # frames were accounted
+
+
+def test_mix_delivery_multiset_and_within_source_order(monkeypatch):
+    """THRILL_TPU_HOST_MIX=1 + a rank_order=False site: each worker
+    receives the same item MULTISET as CatStream, and every source's
+    batch stays internally ordered (the MixStream contract — only
+    batch interleaving is schedule-dependent)."""
+    W, P = 4, 2
+    items_of, _ = _xchg_job(W)
+    _, job_mix = _xchg_job(W, rank_order=False)
+    monkeypatch.setenv("THRILL_TPU_HOST_MIX", "1")
+    results = run_procs(W, P, job_mix)
+    wp = np.repeat(np.arange(P), W // P)[:W]
+    want = [sorted(it for w in range(W) for it in items_of(w)
+                   if it[1] % W == dw) for dw in range(W)]
+    for w in range(W):
+        got = results[int(wp[w])][w]
+        assert sorted(got) == want[w]          # nothing lost/duplicated
+        for src in range(W):                   # within-source order kept
+            mine = [it for it in got if it[0] == src]
+            assert mine == sorted(mine)
+    # rank_order=True sites keep CatStream order even under HOST_MIX=1
+    _, job_cat = _xchg_job(W, rank_order=True)
+    monkeypatch.delenv("THRILL_TPU_HOST_MIX", raising=False)
+    golden = run_procs(W, P, job_cat)
+    monkeypatch.setenv("THRILL_TPU_HOST_MIX", "1")
+    assert run_procs(W, P, job_cat) == golden

@@ -297,6 +297,19 @@ class Context:
             "exchanges": mex.stats_exchanges,
             "items_moved": mex.stats_items_moved,
             "bytes_moved": mex.stats_bytes_moved,
+            # overlapped exchange data plane (data/exchange.py):
+            # exchanges dispatched with NO mid-shuffle host sync, the
+            # capacity-plan cache's hit/miss record, and the bytes that
+            # actually cross the fabric (padded device rows) / the TCP
+            # wire (serialized host frames) — bytes_on_wire is the
+            # pinned baseline for ROADMAP's shrink-the-wire item
+            "exchanges_overlapped": mex.stats_exchanges_overlapped,
+            "cap_cache_hits": mex.stats_cap_cache_hits,
+            "cap_cache_misses": mex.stats_cap_cache_misses,
+            "bytes_wire_device": mex.stats_bytes_wire_device,
+            "bytes_wire_host": mex.stats_bytes_wire_host,
+            "bytes_on_wire": (mex.stats_bytes_wire_device
+                              + mex.stats_bytes_wire_host),
             # on a tunneled chip each dispatch/upload costs one link
             # RTT (140.7 ms measured, BASELINE.md r5) — the governing
             # pipeline cost; see tests/api/test_dispatch_budget.py
@@ -353,12 +366,18 @@ class Context:
             local_sums = {"faults_injected", "retries", "recoveries",
                           "aborts", "ckpt_bytes_written", "oom_retries",
                           "segment_splits", "host_fallbacks",
-                          "admission_spills", "pressure_spilled_bytes"}
+                          "admission_spills", "pressure_spilled_bytes",
+                          # host frames are per-process partials; the
+                          # device wire bytes derive from the
+                          # replicated send matrix (host 0's copy)
+                          "bytes_wire_host"}
             stats = {
                 k: (max(h[k] for h in per_host) if k in local_peaks
                     else sum(h.get(k, 0) for h in per_host)
                     if k in local_sums else per_host[0][k])
                 for k in stats}
+            stats["bytes_on_wire"] = (stats["bytes_wire_device"]
+                                      + stats["bytes_wire_host"])
             stats["hosts"] = len(per_host)
         return stats
 

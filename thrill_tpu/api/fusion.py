@@ -226,6 +226,16 @@ class FusionPlan:
         segs = self.all_segments
         if not segs:
             return srcs[0]
+        # exchange-boundary scheduling: a source produced by an
+        # OPTIMISTIC exchange (data/exchange.py capacity-plan cache)
+        # still owes its deferred capacity check — run it before this
+        # program bakes the source columns. The check blocks only until
+        # the exchange's FIRST chunk lands (the overflow flag rides
+        # chunk 0), so the stitched program here is enqueued while the
+        # remaining chunks' collectives are still in flight — that is
+        # the chunk-pipeline overlap, with none of the wrong-data risk
+        for s in srcs:
+            s.validate_pending()
         src_flat = [jax.tree.flatten(s.tree) for s in srcs]
         sigs = tuple(_src_sig(s, f) for s, f in zip(srcs, src_flat))
         bound_flat = []

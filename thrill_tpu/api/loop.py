@@ -741,7 +741,14 @@ class LoopPlan:
 
                     return lax.fori_loop(0, k, body, tuple(carry_t))
 
-                return jax.jit(loop_fn)
+                # the whole-loop program dispatches through the
+                # _CountedJit choke point like every other device
+                # entry: HBM admission control, the OOM-retry ladder
+                # and the dispatch counters cover it (an OOM here used
+                # to bypass rung 1/2 entirely and only degrade via
+                # Iterate's re-plan fallback)
+                from ..parallel.mesh import _CountedJit
+                return _CountedJit(self.mex, jax.jit(loop_fn))
 
             try:
                 fn = self.mex.cached(key, build)
@@ -756,7 +763,7 @@ class LoopPlan:
                 return None
             self._fori = (fn, k)
         fn = self._fori[0]
-        self.mex.stats_dispatches += 1
+        # the dispatch counter ticks inside _CountedJit.__call__ now
         out = fn(tuple(carry), self._fori_consts())
         return list(out)
 

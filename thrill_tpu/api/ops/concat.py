@@ -70,6 +70,7 @@ def rebalance_to_even(mex, parts: List[DeviceShards], token) -> DeviceShards:
         return jnp.searchsorted(bdev, g, side="right").astype(jnp.int32)
 
     merged = exchange.exchange(merged, dest, ("concat_dest", token, W))
+    merged.validate_pending()       # optimistic-exchange heal point
 
     # restore order by global index, then drop the index column
     cap = merged.cap

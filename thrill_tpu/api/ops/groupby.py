@@ -84,9 +84,14 @@ class GroupByKeyNode(DIABase):
                 lst.clear()
         pre = HostShards(W, pre_lists)
         del pre_lists
+        # hash-partition target (MixStream-eligible): under
+        # THRILL_TPU_HOST_MIX=1 a group's items arrive in frame order,
+        # so group_fn must be iteration-order-insensitive — the
+        # documented contract for opting in (CatStream default keeps
+        # source-rank order exactly as before)
         shards = multiplexer.host_exchange(
             self.context.mesh_exec, pre, lambda t: t[0],
-            reason="groupby")
+            reason="groupby", rank_order=False)
         # grouping phase is memory-bounded: over the negotiated grant,
         # the buffer spills (hash, seq)-sorted runs and the emit merges
         # them so each group streams through RAM (reference:
@@ -137,6 +142,8 @@ class GroupByKeyNode(DIABase):
     def _group_device(self, shards: DeviceShards) -> DeviceShards:
         """Fully-device grouping: sort by key words, segment ids, then
         the user's vectorized fold (jax.ops.segment_* family).
+        The hash-exchange input may be an optimistic (capacity-cached)
+        shuffle still owing its overflow check — validated on entry.
 
         ``device_fn(sorted_tree, segment_ids, num_segments)`` must
         return a pytree of arrays with leading dim ``num_segments``
@@ -148,6 +155,7 @@ class GroupByKeyNode(DIABase):
         import jax.numpy as jnp
 
         mex = shards.mesh_exec
+        shards.validate_pending()
         cap = shards.cap
         key_fn, device_fn = self.key_fn, self.device_fn
         leaves, treedef = jax.tree.flatten(shards.tree)
@@ -403,6 +411,7 @@ class GroupToIndexNode(DIABase):
             # on device_fn, so different folds share one executable
             shards = exchange.exchange(shards, dest,
                                        ("g2i_dest", index_fn, n, W))
+            shards.validate_pending()  # optimistic-exchange heal point
 
         cap = shards.cap
         leaves, treedef = jax.tree.flatten(shards.tree)

@@ -167,9 +167,13 @@ class InnerJoinNode(DIABase):
             # once at line 62, survives pruning in lock-step)
             tagged = HostShards(W, [[(h, it) for it, h in zip(items, hl)]
                                     for items, hl in zip(shards.lists, hs)])
+            # hash-partition target (MixStream-eligible): the join
+            # matches by key, so batch arrival order only permutes the
+            # output row order under THRILL_TPU_HOST_MIX=1
             ex = multiplexer.host_exchange(mex, tagged,
                                            lambda p: p[0] % W,
-                                           reason="join")
+                                           reason="join",
+                                           rank_order=False)
             return HostShards(W, [[it for _, it in l] for l in ex.lists])
 
         lx = shuffle(left, lh)
@@ -211,6 +215,10 @@ class InnerJoinNode(DIABase):
                                      ("join_l", token, W))
             right = exchange.exchange(right, mk_dest(rkey),
                                       ("join_r", token, W))
+            # optimistic (capacity-cached) exchanges owe a deferred
+            # overflow check; the join phases read the columns directly
+            left.validate_pending()
+            right.validate_pending()
         return left, right
 
     def compute_plan(self):

@@ -55,6 +55,9 @@ def _local_reduce_device(shards: DeviceShards, key_fn: Callable,
                          token) -> DeviceShards:
     """One jitted program: encode keys, sort, segmented-reduce, compact."""
     mex = shards.mesh_exec
+    # an optimistic post-exchange input may owe its capacity check —
+    # heal before reading the columns (data/exchange.py)
+    shards.validate_pending()
     out = _host_reduce_shards(shards, key_fn, reduce_fn)
     if out is not None:
         return out
@@ -587,8 +590,14 @@ class ReduceNode(DIABase):
             entries.clear()
             pre_lists.append(lst)
         del pre_entries, pre_hashes
+        # hash-partition target: the post-phase reduce table is keyed,
+        # so batch ARRIVAL order is semantically free — under
+        # THRILL_TPU_HOST_MIX=1 delivery is MixStream (arrival order;
+        # note a non-commutative float reduce_fn then folds in that
+        # order — the documented contract for opting in)
         ex = multiplexer.host_exchange(mex, HostShards(W, pre_lists),
-                                       dest, reason="reduce")
+                                       dest, reason="reduce",
+                                       rank_order=False)
         # post-phase: EM reduce tables sized by the grant — spilled
         # partitions re-reduce recursively, so distinct keys beyond the
         # grant stream through bounded RAM (reference:
@@ -1023,6 +1032,7 @@ class ReduceToIndexNode(DIABase):
 
         if W > 1:
             shards = self._exchange_by_index(shards, bounds, token)
+            shards.validate_pending()    # optimistic-exchange heal point
 
         cap = shards.cap
         leaves, treedef = jax.tree.flatten(shards.tree)

@@ -47,8 +47,13 @@ def _realign_device(shards: DeviceShards, target_bounds: np.ndarray,
         return d
 
     # dest == W marks dropped items; exchange clips dest, so pre-mask:
-    return exchange.exchange(_mask_tail(shards, n_out), dest,
-                             ("realign", token, W), min_cap=min_cap)
+    out = exchange.exchange(_mask_tail(shards, n_out), dest,
+                            ("realign", token, W), min_cap=min_cap)
+    # heal an optimistic capacity miss HERE: the zip path re-wraps the
+    # tree into fresh DeviceShards (pad counts), which would drop the
+    # deferred check
+    out.validate_pending()
+    return out
 
 
 def _mask_tail(shards: DeviceShards, n_out: int) -> DeviceShards:

@@ -24,6 +24,30 @@ def _env_str(name: str, default: Optional[str]) -> Optional[str]:
     return v if v not in (None, "") else default
 
 
+def _env_flag(name: str, default: bool = True) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v not in ("0", "off", "false")
+
+
+def overlap_enabled() -> bool:
+    """THRILL_TPU_OVERLAP=0 restores the bulk-synchronous data plane
+    exactly: single-dispatch phase-B exchanges, a host sync on every
+    send-count matrix, and the serial per-peer host-frame sender.
+    Master switch over the per-feature knobs (XCHG_CHUNKS,
+    XCHG_CAP_CACHE, ASYNC_SEND)."""
+    return _env_flag("THRILL_TPU_OVERLAP", True)
+
+
+def cap_cache_enabled() -> bool:
+    """THRILL_TPU_XCHG_CAP_CACHE=0 disables optimistic capacity-plan
+    reuse: every exchange then syncs its [W, W] send-count matrix to
+    the host before phase B, as before this knob existed."""
+    return overlap_enabled() and _env_flag("THRILL_TPU_XCHG_CAP_CACHE",
+                                           True)
+
+
 def parse_si_iec_units(s: str) -> int:
     """Parse '100', '64K', '1Gi', '2GB' style size strings to bytes.
 

@@ -27,6 +27,18 @@ def force_cpu_platform() -> None:
               file=sys.stderr)
 
 
+def has_ragged_all_to_all() -> bool:
+    """Does this jax build export ``lax.ragged_all_to_all``?
+
+    The single source of truth for the capability probe: the exchange
+    planner's ragged path, the driver dryrun and every test skipif gate
+    on THIS instead of hand-rolled ``hasattr`` copies (this container's
+    jax/jaxlib predates the op entirely; execution is TPU-only even
+    where the symbol exists)."""
+    import jax
+    return hasattr(jax.lax, "ragged_all_to_all")
+
+
 def maybe_force_cpu_from_env() -> bool:
     """Apply force_cpu_platform iff the user explicitly asked for CPU.
     Returns whether it applied."""

@@ -25,20 +25,53 @@ Destination builders cover every DOp shuffle pattern:
   hash partition (ReduceByKey/GroupBy/Join), range partition by splitter
   search (Sort/Merge), index ranges (ReduceToIndex/Zip/Concat/Rebalance)
   and explicit per-item targets.
+
+Overlapped data plane (the MixStream-analog dispatch discipline):
+
+* Phase B is CHUNKED — the per-destination slot space [0, M_pad) splits
+  into K row ranges (``common/partition.py`` bounds) and each range is
+  its own jitted dispatch scattering into a shared output accumulator.
+  Every output row is written by exactly one chunk at the exact position
+  the bulk program would use, so results are bit-identical for any K;
+  jax's async dispatch keeps chunk i's ``all_to_all`` + compaction in
+  flight while chunk i+1 is scattered, and the consumer's next program
+  can be enqueued before the last chunks land. ``THRILL_TPU_XCHG_CHUNKS``
+  forces K; the auto policy chunks only volumes worth pipelining.
+* The mid-shuffle host sync on the [W, W] send matrix is ELIDED in
+  steady state: per-(plan-key, site) padded capacities learned by
+  ``_sticky_caps`` double as a capacity-plan cache, phase B dispatches
+  optimistically on the cached plan straight off the DEVICE send matrix
+  (counts come back as a device output), and a device-computed overflow
+  flag rides a deferred check (the hinted-join pattern): on a miss the
+  exchange transparently re-runs from the retained phase-A output under
+  the synced plan. ``THRILL_TPU_XCHG_CAP_CACHE=0`` disables the
+  optimistic path; ``THRILL_TPU_OVERLAP=0`` restores the bulk-
+  synchronous exchange (single dispatch + host sync) bit-identically.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Tuple
+import os
+import weakref
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..common.config import round_up_pow2
+from ..common import faults
+from ..common.config import (cap_cache_enabled, overlap_enabled,
+                             round_up_pow2)
+from ..common.partition import dense_range_bounds
+from ..common.retry import default_policy
 from ..parallel.mesh import AXIS, MeshExec
 from .shards import DeviceShards
+
+# per-chunk injection at the chunked phase-B dispatch loop: fires
+# BEFORE the chunk program launches (nothing dispatched yet), so a
+# transient retry is safe — mirrors the fused per-op site discipline
+_F_CHUNK = faults.declare("data.exchange.chunk")
 
 
 def _ex_cumsum(x):
@@ -49,10 +82,18 @@ def resolve_mode(mex: MeshExec) -> str:
     """Exchange mode precedence: env THRILL_TPU_EXCHANGE, then the
     mesh's configured mode, then dense. Single source of truth for
     every caller that gates on the exchange plan (the Sort fused path
-    must agree with the plan the generic exchange would pick)."""
-    import os
-    return os.environ.get("THRILL_TPU_EXCHANGE") or \
-        getattr(mex, "exchange_mode", "dense")
+    must agree with the plan the generic exchange would pick).
+
+    The env override is captured ONCE at mesh construction
+    (``MeshExec._env_exchange``) — this used to be an ``os.environ``
+    read on every plan step of every exchange. Set the variable before
+    building the mesh; mid-process toggles still work through
+    ``mex.exchange_mode`` (which Context sets from Config)."""
+    if hasattr(mex, "_env_exchange"):
+        env = mex._env_exchange
+    else:                                  # bare stubs in tests
+        env = os.environ.get("THRILL_TPU_EXCHANGE")
+    return env or getattr(mex, "exchange_mode", "dense")
 
 
 def send_slot_index(dest, S_row, W: int, M_pad: int, cap: int):
@@ -108,8 +149,15 @@ def exchange_presorted(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
 def _phase_a(shards: DeviceShards, dest_builder: Callable,
              cache_key: Tuple):
     """Phase A: destination, local dest-sort, send counts. Returns
-    (treedef, sorted_dest, sorted_leaves, S)."""
+    (treedef, sorted_dest, sorted_leaves, send_mat) with the [W, W]
+    send matrix REPLICATED ON DEVICE — whether the planner syncs it to
+    the host (classic path) or dispatches phase B straight off it
+    (optimistic capacity-cache path) is the caller's decision."""
     mex = shards.mesh_exec
+    # an upstream optimistic exchange may still owe its overflow check:
+    # heal it before this program bakes the (possibly truncated)
+    # columns into a new shuffle
+    shards.validate_pending()
     W = mex.num_workers
     cap = shards.cap
     leaves, treedef = jax.tree.flatten(shards.tree)
@@ -144,8 +192,7 @@ def _phase_a(shards: DeviceShards, dest_builder: Callable,
     out_a = fa(shards.counts_device(), *leaves)
     sorted_dest, send_mat = out_a[0], out_a[1]
     sorted_leaves = list(out_a[2:])
-    S = mex.fetch(send_mat)                       # [W, W] S[w, d]
-    return treedef, sorted_dest, sorted_leaves, S
+    return treedef, sorted_dest, sorted_leaves, send_mat
 
 
 def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
@@ -155,12 +202,30 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
     ``dest_builder(tree, valid_mask, worker_index) -> int32 [cap]`` is
     traced inside the phase-A program; ``cache_key`` must identify it
     (plus its static parameters) for executable caching.
+
+    Steady state pays NO mid-shuffle host sync: once this call site's
+    padded capacities are cached (the first, synced run), phase B
+    dispatches optimistically on the cached plan with the send matrix
+    staying device-resident; a capacity miss is detected by a deferred
+    device flag and healed by re-running the synced plan from the
+    retained phase-A output (lineage-level, never wrong data).
     """
     mex = shards.mesh_exec
-    treedef, sorted_dest, sorted_leaves, S = _phase_a(
+    treedef, sorted_dest, sorted_leaves, send_mat = _phase_a(
         shards, dest_builder, cache_key)
+    if mex.num_workers > 1:
+        cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
+        cap_ident = _dense_cap_ident(cache_key, cap, treedef,
+                                     sorted_leaves)
+        caps = _optimistic_ok(mex, cap_ident, min_cap)
+        if caps is not None:
+            return _exchange_optimistic(
+                mex, treedef, sorted_dest, sorted_leaves, send_mat,
+                caps, ident=cache_key, min_cap=min_cap)
+    S = mex.fetch(send_mat)                       # [W, W] S[w, d]
     return _exchange_planned(mex, treedef, sorted_dest, sorted_leaves, S,
-                             min_cap=min_cap, ident=cache_key)
+                             min_cap=min_cap, ident=cache_key,
+                             smat_dev=send_mat)
 
 
 def exchange_stream(shards: DeviceShards, dest_builder: Callable,
@@ -179,8 +244,9 @@ def exchange_stream(shards: DeviceShards, dest_builder: Callable,
     """
     mex = shards.mesh_exec
     W = mex.num_workers
-    treedef, sorted_dest, sorted_leaves, S = _phase_a(
+    treedef, sorted_dest, sorted_leaves, send_mat = _phase_a(
         shards, dest_builder, cache_key)
+    S = mex.fetch(send_mat)   # per-round caps genuinely need the host S
     account_traffic(mex, S, leaf_item_bytes(sorted_leaves))
     cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
 
@@ -196,6 +262,9 @@ def exchange_stream(shards: DeviceShards, dest_builder: Callable,
         max(int(S[np.arange(W), to].max()), 1) for to in rounds)
     caps = _sticky_caps(mex, cap_ident, needed)
     mex.stats_padded_rows += sum(caps)
+    # identity round is a local scatter; rounds 1.. cross the fabric
+    mex.stats_bytes_wire_device += (
+        W * sum(caps[1:]) * leaf_item_bytes(sorted_leaves))
 
     srow = mex.put_small(S.astype(np.int32))
 
@@ -277,12 +346,15 @@ def dense_all_to_all_applies(mex: MeshExec, S: np.ndarray,
                                                         mex)
 
 
-def account_traffic(mex: MeshExec, S: np.ndarray, item_bytes: int) -> None:
+def account_traffic(mex: MeshExec, S: np.ndarray, item_bytes: int,
+                    **log_extra: Any) -> None:
     """Traffic accounting shared by every exchange plan (reference:
     net::Manager tx/rx counters feeding the end-of-job OverallStats
     AllReduce, api/context.cpp:1275-1341). On multi-slice meshes the
     bytes are split by tier: same-slice pairs ride ICI, cross-slice
-    pairs DCN."""
+    pairs DCN. Called exactly once per LOGICAL exchange — the
+    optimistic path calls it at deferred-check time (hit), or lets the
+    healed synced re-run account instead (miss)."""
     moved = int(S.sum()) - int(np.trace(S))       # off-diagonal items
     mex.stats_exchanges += 1
     mex.stats_items_moved += moved
@@ -304,7 +376,7 @@ def account_traffic(mex: MeshExec, S: np.ndarray, item_bytes: int) -> None:
                  bytes=moved * item_bytes,
                  bytes_dcn=dcn_items * item_bytes,
                  per_worker_sent=sent.tolist(),
-                 per_worker_recv=recv.tolist())
+                 per_worker_recv=recv.tolist(), **log_extra)
 
 
 def one_factor_rounds(mex: MeshExec) -> List[np.ndarray]:
@@ -401,10 +473,285 @@ def _skewed(S: np.ndarray, row_bytes: int, mex: MeshExec) -> bool:
     return saved > len(rounds) * _bytes_eq(mex)
 
 
+def _dense_cap_ident(ident: Tuple, cap: int, treedef, sorted_leaves
+                     ) -> Tuple:
+    """Sticky-capacity / capacity-plan-cache key for the dense plan:
+    per CALL SITE (ident), not per shape — two unrelated same-shaped
+    exchanges must not ratchet each other's capacities."""
+    return ("xchg_caps", ident, cap, treedef,
+            tuple((l.dtype, l.shape[2:]) for l in sorted_leaves))
+
+
+def _chunk_count(mex: MeshExec, W: int, M_pad: int,
+                 item_bytes: int) -> int:
+    """How many row-range chunks phase B splits into.
+
+    ``THRILL_TPU_OVERLAP=0`` forces the single bulk dispatch;
+    ``THRILL_TPU_XCHG_CHUNKS=K`` pins K; the auto policy chunks only
+    exchanges whose padded volume is worth pipelining (chunking a
+    kilobyte shuffle pays K-1 extra dispatches for nothing — and every
+    chunk shape is its own compiled program)."""
+    if not overlap_enabled():
+        return 1
+    env = os.environ.get("THRILL_TPU_XCHG_CHUNKS")
+    if env:
+        try:
+            return max(1, min(int(env), M_pad))
+        except ValueError:
+            pass
+    if W * M_pad * item_bytes < _CHUNK_MIN_BYTES:
+        return 1
+    return max(1, min(_CHUNK_DEFAULT, M_pad))
+
+
+_CHUNK_DEFAULT = 4
+_CHUNK_MIN_BYTES = 1 << 20
+# every Nth use of a cached capacity plan takes the synced path anyway,
+# so a site whose data turned skewed after warmup regains the 1-factor
+# plan within N exchanges instead of never (perf-only: the overflow
+# flag already guards correctness)
+_CAP_RESYNC_EVERY = 32
+
+
+def _optimistic_ok(mex: MeshExec, cap_ident: Tuple,
+                   min_cap: int) -> Optional[Tuple[int, int]]:
+    """Cached (M_pad, out_cap) when this site may dispatch phase B
+    WITHOUT the host sync, else None.
+
+    Requirements: the overlap/cap-cache knobs are on, the site's last
+    synced plan was the dense one (a skew-flipped or ragged site needs
+    the host S every time), a capacity plan is cached, no loop capture
+    is recording (captures keep today's synced semantics so tapes bake
+    the same plan they always did), and single-controller (a deferred
+    per-process heal would desynchronize the collective schedule —
+    same reasoning as the memory ladder's multi-process guard)."""
+    if not cap_cache_enabled():
+        return None
+    if mex.loop_recorder is not None:
+        return None
+    if getattr(mex, "num_processes", 1) > 1:
+        return None
+    if resolve_mode(mex) != "dense":
+        return None
+    if getattr(mex, "_xchg_plan", {}).get(cap_ident) != "dense":
+        return None
+    caps = getattr(mex, "_sticky_caps", {}).get(cap_ident)
+    if not caps or len(caps) != 2 or caps[1] < min_cap:
+        return None
+    # periodic re-plan: the dense-vs-1-factor skew decision needs the
+    # host S, which steady-state hits elide — without this, skew that
+    # develops AFTER warmup (and stays inside the monotone caps) would
+    # keep the padded dense plan forever. Every Nth use of a site runs
+    # the synced path, re-evaluating skew and re-recording the plan.
+    hits = getattr(mex, "_xchg_plan_uses", None)
+    if hits is None:
+        hits = mex._xchg_plan_uses = {}
+    n = hits.get(cap_ident, 0) + 1
+    hits[cap_ident] = n
+    if n % _CAP_RESYNC_EVERY == 0:
+        return None
+    return caps
+
+
+def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
+                      smat, M_pad: int, out_cap: int):
+    """The dense phase-B program(s): K row-range chunk dispatches over
+    a shared output accumulator, all plan values derived IN-TRACE from
+    the replicated [W, W] send matrix ``smat``.
+
+    Chunk j ships destination-slot range [lo_j, hi_j) of every (src,
+    dst) pair: the all_to_all blocks are [W, hi_j-lo_j] and the receive
+    scatter lands rows at ``roff[src] + slot`` — exactly the bulk
+    program's positions, so any K (including 1, the bulk form) is
+    bit-identical. Each chunk is its own ``_CountedJit`` dispatch, so
+    admission control, the OOM-retry ladder and dispatch stats cover
+    every chunk, and jax async dispatch pipelines chunk i's collective
+    with chunk i+1's scatter. The FIRST chunk additionally returns the
+    device-resident output counts and the capacity-overflow flag (both
+    functions of ``smat`` alone), so the optimistic path's deferred
+    check blocks only until chunk 0 lands — chunks 1..K-1 and the
+    consumer's next program keep overlapping.
+
+    Returns (out_leaves, counts_dev [W, 1] int32, flag [1] int32).
+    """
+    W = mex.num_workers
+    cap = sorted_leaves[0].shape[1] if sorted_leaves else \
+        sorted_dest.shape[1]
+    leafsig = tuple((l.dtype, l.shape[2:]) for l in sorted_leaves)
+    n_leaves = len(sorted_leaves)
+    item_bytes = leaf_item_bytes(sorted_leaves)
+    K = _chunk_count(mex, W, M_pad, item_bytes)
+    bounds = dense_range_bounds(M_pad, K)
+    ranges = [(int(bounds[j]), int(bounds[j + 1])) for j in range(K)
+              if bounds[j + 1] > bounds[j]]
+    from jax.sharding import PartitionSpec as P
+
+    def chunk_program(lo: int, hi: int, first: bool, last: bool):
+        M_j = hi - lo
+        key = ("xchg_chunk", cap, M_pad, out_cap, lo, hi, first, last,
+               W, treedef, leafsig)
+
+        def build():
+            def f(sdest, smat_a, *ls):
+                from ..core import rowmove
+                widx = lax.axis_index(AXIS)
+                S_row = jnp.take(smat_a, widx, axis=0).astype(jnp.int32)
+                S_col = jnp.take(smat_a, widx, axis=1).astype(jnp.int32)
+                off = _ex_cumsum(S_row)
+                roff = _ex_cumsum(S_col)
+                d = sdest[0]
+                i = jnp.arange(cap)
+                dc = jnp.clip(d, 0, W - 1)
+                slot = i - jnp.take(off, dc)
+                sel = (d < W) & (slot >= lo) & (slot < hi)
+                send_idx = jnp.where(sel, dc * M_j + (slot - lo),
+                                     W * M_j)
+                jj = jnp.arange(M_j)
+                n_from = jnp.clip(S_col - lo, 0, M_j)
+                pos = jnp.where(jj[None, :] < n_from[:, None],
+                                roff[:, None] + lo + jj[None, :],
+                                out_cap)
+                # clamp: under a capacity overflow positions can pass
+                # the dump row — those rows are garbage either way and
+                # the flag below routes the whole exchange to the
+                # synced re-run
+                pos = jnp.minimum(pos.reshape(-1), out_cap)
+                pack = rowmove.enabled()
+                srcs, accs = ls[:n_leaves], ls[n_leaves:]
+                outs = []
+                for li, l in enumerate(srcs):
+                    x, m = rowmove.pack_rows(l[0]) if pack \
+                        else (l[0], None)
+                    recv = ship_blocks(x, send_idx, W, M_j)
+                    if first:
+                        acc = jnp.zeros((out_cap + 1,) + x.shape[1:],
+                                        x.dtype)
+                    else:
+                        acc = accs[li][0]
+                    acc = acc.at[pos].set(recv)
+                    if last:
+                        outs.append(rowmove.unpack_rows(
+                            acc[:out_cap], m)[None])
+                    else:
+                        outs.append(acc[None])
+                if not first:
+                    return tuple(outs)
+                cnt = jnp.sum(S_col).astype(jnp.int32)[None, None]
+                ovf = jnp.logical_or(
+                    smat_a.max() > M_pad,
+                    smat_a.sum(axis=0).max() > out_cap)
+                return (cnt, ovf.astype(jnp.int32).reshape(1), *outs)
+
+            na = 2 + n_leaves + (0 if first else n_leaves)
+            in_specs = (P(AXIS), P()) + (P(AXIS),) * (na - 2)
+            out_specs = ((P(AXIS), P()) if first else ()) \
+                + (P(AXIS),) * n_leaves
+            return mex.smap(f, na, out_specs=out_specs,
+                            in_specs=in_specs)
+
+        return mex.cached(key, build)
+
+    armed = faults.REGISTRY.active()
+    # chunk i's accumulator is consumed exactly once by chunk i+1:
+    # donate it so XLA aliases instead of copying the [W, out_cap]
+    # buffers K-1 times. CPU has no input-output aliasing (and the OOM
+    # ladder's donation-disarm story stays simplest un-donated under
+    # armed faults / capture), so the twin is TPU/GPU-only.
+    donate = (bool(mex.devices)
+              and mex.devices[0].platform not in ("cpu",)
+              and mex.loop_recorder is None and not armed)
+    acc_pos = tuple(range(2 + n_leaves, 2 + 2 * n_leaves))
+    counts_dev = flag = None
+    accs: List[Any] = []
+    for j, (lo, hi) in enumerate(ranges):
+        first, last = j == 0, j == len(ranges) - 1
+        fn = chunk_program(lo, hi, first, last)
+        if armed:
+            default_policy().run(
+                lambda j=j: faults.check(_F_CHUNK, chunk=j,
+                                         chunks=len(ranges)),
+                what="xchg.chunk")
+        if first:
+            out = fn(sorted_dest, smat, *sorted_leaves)
+            counts_dev, flag = out[0], out[1]
+            accs = list(out[2:])
+        else:
+            call = fn.donating(acc_pos) if donate and acc_pos else fn
+            accs = list(call(sorted_dest, smat, *sorted_leaves, *accs))
+    mex.stats_padded_rows += W * M_pad
+    mex.stats_bytes_wire_device += W * (W - 1) * M_pad * item_bytes
+    return accs, counts_dev, flag
+
+
+def _exchange_optimistic(mex: MeshExec, treedef, sorted_dest,
+                         sorted_leaves, send_mat, caps: Tuple[int, int],
+                         ident: Tuple, min_cap: int = 1) -> DeviceShards:
+    """Phase B on the CACHED capacity plan: no host sync, counts come
+    back device-resident, and a deferred check (drained at the next
+    consumer boundary / host realization, like the hinted-join
+    overflow) verifies the cached capacities actually held — on a miss
+    the exchange re-runs from the retained phase-A output under the
+    freshly synced plan and heals the shards in place."""
+    M_pad, out_cap = caps
+    W = mex.num_workers
+    item_bytes = leaf_item_bytes(sorted_leaves)
+    out_leaves, counts_dev, flag = _dispatch_chunked(
+        mex, treedef, sorted_dest, sorted_leaves, send_mat, M_pad,
+        out_cap)
+    tree = jax.tree.unflatten(treedef, out_leaves)
+    shards = DeviceShards(mex, tree, counts_dev)
+
+    def check(counts: np.ndarray):
+        overflowed = bool(mex._fetch_raw(flag).reshape(-1)[0])
+        S = mex._fetch_raw(send_mat).astype(np.int64)
+        if not overflowed:
+            # the exchange is accounted HERE, not at dispatch: a miss
+            # must count one (synced) exchange, not an optimistic one
+            # plus its healed re-run
+            mex.stats_cap_cache_hits += 1
+            mex.stats_exchanges_overlapped += 1
+            account_traffic(mex, S, item_bytes, overlapped=True,
+                            cap_hit=True)
+            return None
+        # capacity miss: the cached plan truncated — re-run phases
+        # host+B from the retained phase-A output (the synced plan
+        # grows the sticky caps, so the NEXT run hits again)
+        mex.stats_cap_cache_misses += 1
+        faults.note("recovery", what="xchg.capacity_miss",
+                    cached=(M_pad, out_cap))
+        healed = _exchange_planned(mex, treedef, sorted_dest,
+                                   sorted_leaves, S, min_cap=min_cap,
+                                   ident=ident, smat_dev=send_mat)
+        shards.tree = healed.tree
+        return healed.counts
+
+    shards._counts_check = check
+    # backstop drain point: any tracked fetch / action egress heals an
+    # exchange whose output a pipeline abandoned before consuming.
+    # WEAK ref only — the hinted-join precedent (join.py): a lingering
+    # queue entry must pin no device buffers, or a fetch-free steady-
+    # state loop would grow one [W, out_cap] output per query and an
+    # HbmGovernor spill could never actually free the HBM
+    ref = weakref.ref(shards)
+
+    def _backstop():
+        s = ref()
+        if s is not None:
+            s.validate_pending()
+
+    mex._pending_checks.append(_backstop)
+    return shards
+
+
 def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                       S: np.ndarray, min_cap: int = 1,
-                      ident: Tuple = ()) -> DeviceShards:
-    """Phases host+B given phase-A output (also used by scatter paths)."""
+                      ident: Tuple = (),
+                      smat_dev: Optional[Any] = None) -> DeviceShards:
+    """Phases host+B given phase-A output (also used by scatter paths).
+
+    ``smat_dev`` is the replicated device copy of ``S`` when phase A
+    produced one (saves the plan upload); callers with a host-only
+    plan (Sort's presorted entry) leave it None."""
     W = mex.num_workers
     cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
     R = S.sum(axis=0)                             # recv totals per worker
@@ -417,57 +764,28 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
         tree = jax.tree.unflatten(treedef, sorted_leaves)
         return DeviceShards(mex, tree, new_counts)
 
+    cap_ident = _dense_cap_ident(ident, cap, treedef, sorted_leaves)
     mode = resolve_mode(mex)
     if mode == "ragged":
+        mex._xchg_plan[cap_ident] = "sync"
         return _exchange_ragged(mex, treedef, sorted_leaves, S, min_cap)
     if mode == "onefactor" or (
             mode == "dense"
             and _skewed(S, leaf_item_bytes(sorted_leaves), mex)):
+        # a skew-flipped site stays synced: the dense-vs-1-factor
+        # decision needs the host S, which the optimistic path elides
+        mex._xchg_plan[cap_ident] = "sync"
         return _exchange_onefactor(mex, treedef, sorted_dest,
                                    sorted_leaves, S, min_cap, ident=ident)
 
-    # sticky per CALL SITE (ident), not per shape: two unrelated
-    # same-shaped exchanges must not ratchet each other's capacities
-    cap_ident = ("xchg_caps", ident, cap, treedef,
-                 tuple((l.dtype, l.shape[2:]) for l in sorted_leaves))
     M_pad, out_cap = _sticky_caps(
         mex, cap_ident,
         (max(int(S.max()), 1), max(int(R.max()), min_cap, 1)))
-    mex.stats_padded_rows += W * M_pad
-
-    key_b = ("xchg_b", cap, M_pad, out_cap, treedef,
-             tuple((l.dtype, l.shape[2:]) for l in sorted_leaves))
-
-    def build_b():
-        def fb(sdest, srow, scol, *ls):
-            from ..core import rowmove
-            d = sdest[0]                          # [cap] dest-sorted
-            S_row = srow[0]                       # my send counts [W]
-            S_col = scol[0]                       # my recv counts by src [W]
-            send_idx = send_slot_index(d, S_row, W, M_pad, cap)
-            roff = _ex_cumsum(S_col)
-            j = jnp.arange(M_pad)[None, :]
-            rc_valid = j < S_col[:, None]
-            out_idx = jnp.where(rc_valid, roff[:, None] + j, out_cap)
-
-            pack = rowmove.enabled()
-            outs = []
-            for l in ls:
-                # scatter + all_to_all + compaction all run on the
-                # packed u32 view of sub-word payload columns
-                x, m = rowmove.pack_rows(l[0]) if pack else (l[0], None)
-                recv = ship_blocks(x, send_idx, W, M_pad)
-                out = jnp.zeros((out_cap + 1,) + x.shape[1:], x.dtype)
-                out = out.at[out_idx.reshape(-1)].set(recv)
-                outs.append(rowmove.unpack_rows(out[:out_cap], m)[None])
-            return tuple(outs)
-
-        return mex.smap(fb, 3 + len(sorted_leaves))
-
-    fb = mex.cached(key_b, build_b)
-    srow = mex.put_small(S.astype(np.int32))            # row w on worker w
-    scol = mex.put_small(S.T.copy().astype(np.int32))   # col w on worker w
-    out_leaves = list(fb(sorted_dest, srow, scol, *sorted_leaves))
+    mex._xchg_plan[cap_ident] = "dense"
+    smat = smat_dev if smat_dev is not None else \
+        mex.put_small(S.astype(np.int32), replicated=True)
+    out_leaves, _counts_dev, _flag = _dispatch_chunked(
+        mex, treedef, sorted_dest, sorted_leaves, smat, M_pad, out_cap)
     tree = jax.tree.unflatten(treedef, out_leaves)
     return DeviceShards(mex, tree, new_counts)
 
@@ -498,6 +816,8 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     caps = _sticky_caps(mex, cap_ident, needed)
     M_rounds, out_cap = caps[:-1], caps[-1]
     mex.stats_padded_rows += sum(M_rounds)
+    mex.stats_bytes_wire_device += (
+        W * sum(M_rounds) * leaf_item_bytes(sorted_leaves))
 
     key_b = ("xchg_of", cap, M_rounds, out_cap, mex.num_slices, treedef,
              tuple((l.dtype, l.shape[2:]) for l in sorted_leaves))
@@ -614,6 +934,10 @@ def _exchange_ragged(mex: MeshExec, treedef, sorted_leaves, S: np.ndarray,
     _warn_ragged_untested(mex)
     R = S.sum(axis=0)
     new_counts = R.astype(np.int64)
+    # ragged ships exactly the off-diagonal items — no padding tax
+    mex.stats_bytes_wire_device += (
+        (int(S.sum()) - int(np.trace(S)))
+        * leaf_item_bytes(sorted_leaves))
     out_cap = round_up_pow2(max(int(R.max()), min_cap, 1))
     key = ("xchg_ragged", out_cap, treedef,
            tuple((l.dtype, l.shape[1:]) for l in sorted_leaves))

@@ -29,12 +29,15 @@ paths — identical behavior to the pre-multiplexer code.
 
 from __future__ import annotations
 
+import os
+import queue
+import threading
 from typing import Any, Callable, List, Optional
 
 import numpy as np
 
 from ..common import faults
-from ..common.config import round_up_pow2
+from ..common.config import _env_flag, overlap_enabled, round_up_pow2
 from ..common.retry import default_policy
 from ..net.group import poison_on_error
 from .shards import DeviceShards, HostShards
@@ -48,7 +51,56 @@ _F_SEND = faults.declare("net.multiplexer.frame_send",
                          exc=faults.InjectedConnectionError)
 _F_RECV = faults.declare("net.multiplexer.frame_recv",
                          exc=faults.InjectedConnectionError)
+# fires in the BACKGROUND sender thread before a frame is posted to
+# the transport (nothing sent yet -> retry-safe, same contract as
+# frame_send); the error is re-raised on the exchange's main thread
+_F_ASYNC = faults.declare("net.multiplexer.async_send",
+                          exc=faults.InjectedConnectionError)
 _FRAME_RETRY = dict(transient=(faults.InjectedConnectionError,))
+
+
+def _async_send_enabled() -> bool:
+    """MixStream-analog sender: frames ride a background thread with a
+    bounded queue so the send side overlaps the receive side instead
+    of strictly alternating per peer. THRILL_TPU_ASYNC_SEND=0 (or the
+    THRILL_TPU_OVERLAP=0 master switch) restores the serial sender."""
+    return overlap_enabled() and _env_flag("THRILL_TPU_ASYNC_SEND",
+                                           True)
+
+
+def _mix_delivery(rank_order: bool) -> bool:
+    """Arrival-order (MixStream) delivery: only for call sites that
+    DECLARED tolerance (``rank_order=False`` — hash-partition targets)
+    and only when explicitly opted in: the default stays CatStream
+    source-rank order everywhere so results are bit-identical to the
+    serial plane (float folds are order-sensitive)."""
+    return (not rank_order) and _env_flag("THRILL_TPU_HOST_MIX", False)
+
+
+def _send_queue_depth() -> int:
+    try:
+        return max(1, int(os.environ.get("THRILL_TPU_SEND_QUEUE",
+                                         "4") or 4))
+    except ValueError:
+        return 4
+
+
+def _frame_bytes(msg: Any) -> int:
+    """Serialized size of one frame — what the TCP plane actually puts
+    on the wire (net/wire.py is the transport's framing codec). The
+    ``bytes_on_wire`` baseline for ROADMAP's shrink-the-wire item.
+
+    Cost note: this re-serializes the frame purely to measure it (the
+    transport serializes again inside ``send_to``). The async sender
+    pays it on the background thread, off the send critical path; the
+    serial (opt-out) plane pays it inline. Folding the accounting into
+    the transport, where the serialized parts already exist, is part
+    of the shrink-the-wire ROADMAP item."""
+    try:
+        from ..net import wire
+        return len(wire.dumps(msg, allow_pickle=True))
+    except Exception:
+        return 0
 
 
 def _send_frame(group, peer: int, msg: Any, what: str) -> None:
@@ -105,16 +157,36 @@ def local_worker_set(mex) -> set:
 
 
 def host_exchange(mex, shards: HostShards, dest_fn: Callable[[Any], int],
-                  reason: str = "host-exchange") -> HostShards:
+                  reason: str = "host-exchange",
+                  rank_order: bool = True) -> HostShards:
     """Move every item to the worker ``dest_fn(item) % W`` computes.
 
     Single-controller: in-process bucketing (the old fast path).
     Multi-controller: this process buckets its local workers' items,
     ships each remote process one framed message of
     ``{dest_worker: {src_worker: [items...]}}`` over the TCP group
-    (large frames ride the async dispatcher), and assembles its own
-    workers' receives in source-worker rank order — the CatStream
-    delivery guarantee (reference: thrill/data/cat_stream.hpp:155).
+    (large frames ride the async dispatcher). By default frames are
+    POSTED to a background sender thread with a bounded queue — the
+    MixStream-analog data plane (reference: the multiplexer's async
+    dispatch thread, thrill/data/multiplexer.cpp:282) — so sends
+    overlap receives instead of alternating serially per peer
+    (``THRILL_TPU_ASYNC_SEND=0`` / ``THRILL_TPU_OVERLAP=0`` restore
+    the serial sender).
+
+    Delivery order: each receiving worker sees batches in source-worker
+    rank order — the CatStream guarantee (reference:
+    thrill/data/cat_stream.hpp:155) — regardless of the sender mode.
+    Call sites whose consumer does not need rank order (hash-partition
+    targets: ReduceByKey, GroupByKey, hash InnerJoin) declare it with
+    ``rank_order=False``; with ``THRILL_TPU_HOST_MIX=1`` those merge
+    frames in RECEIVE-SEQUENCE order instead (per-source batches stay
+    internally ordered, batch interleaving does not). Scope honestly
+    stated: receives still drain on the fixed per-peer schedule, so
+    this relaxes the ordering CONTRACT (batch interleaving may differ
+    from source-rank order) — the wall-clock overlap comes from the
+    async sender; true consume-whichever-peer-arrives-first needs an
+    any-source receive in the transports (ROADMAP, exchange item).
+    Sort/Merge/index-partition sites never pass ``rank_order=False``.
     """
     W = shards.num_workers
     if not multiprocess(mex):
@@ -140,30 +212,137 @@ def host_exchange(mex, shards: HostShards, dest_fn: Callable[[Any], int],
 
     received = [outgoing[me]]
     sent_items = 0
+    wire_bytes = 0
     group = net.group
+    use_async = _async_send_enabled() and P > 1
     with poison_on_error(group, "host_exchange"):
-        for r in range(1, P):
-            to, frm = (me + r) % P, (me - r) % P
-            sent_items += sum(len(b) for dws in outgoing[to].values()
-                              for b in dws.values())
-            _send_frame(group, to, outgoing[to], "host_exchange")
-            received.append(_recv_frame(group, frm, "host_exchange"))
+        if use_async:
+            sent_items, wire_bytes = _exchange_frames_async(
+                mex, group, outgoing, received, me, P)
+        else:
+            for r in range(1, P):
+                to, frm = (me + r) % P, (me - r) % P
+                sent_items += sum(len(b)
+                                  for dws in outgoing[to].values()
+                                  for b in dws.values())
+                wire_bytes += _frame_bytes(outgoing[to])
+                _send_frame(group, to, outgoing[to], "host_exchange")
+                received.append(_recv_frame(group, frm,
+                                            "host_exchange"))
 
     lists: List[List[Any]] = [[] for _ in range(W)]
+    mix = _mix_delivery(rank_order)
     for w in mex.local_workers:
-        per_src: dict = {}
-        for msg in received:
-            per_src.update(msg.get(w, {}))
-        for sw in sorted(per_src):
-            lists[w].extend(per_src[sw])
+        if mix:
+            # MixStream: frames in arrival order, each frame's batches
+            # in source order (deterministic WITHIN a frame only)
+            for msg in received:
+                for sw in sorted(msg.get(w, {})):
+                    lists[w].extend(msg[w][sw])
+        else:
+            per_src: dict = {}
+            for msg in received:
+                per_src.update(msg.get(w, {}))
+            for sw in sorted(per_src):
+                lists[w].extend(per_src[sw])
 
     mex.stats_exchanges += 1
     mex.stats_items_moved += sent_items
+    mex.stats_bytes_wire_host = getattr(mex, "stats_bytes_wire_host",
+                                        0) + wire_bytes
     log = getattr(mex, "logger", None)
     if log is not None and log.enabled:
         log.line(event="host_exchange", reason=reason,
-                 items_sent=sent_items, processes=P)
+                 items_sent=sent_items, processes=P,
+                 bytes=wire_bytes, mode="mix" if mix else "cat",
+                 async_send=use_async)
     return HostShards(W, lists)
+
+
+def _exchange_frames_async(mex, group, outgoing: List[dict],
+                           received: List[dict], me: int, P: int):
+    """Ship the P-1 outgoing frames from a background sender thread
+    (bounded queue) while the main thread drains the P-1 receives.
+
+    A sender-thread failure is re-raised here on the main thread —
+    inside the caller's ``poison_on_error`` scope, so the peers still
+    convert to fast attributable aborts. The queue bound applies
+    backpressure instead of buffering every frame at once; posting
+    never deadlocks on a dead sender (the post loop watches the error
+    slot)."""
+    q: "queue.Queue" = queue.Queue(maxsize=_send_queue_depth())
+    err: List[BaseException] = []
+    wire_holder = [0]
+
+    def _sender():
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                peer, msg = item
+                # byte accounting rides the sender thread so its
+                # serialization cost overlaps the main thread's
+                # receive processing instead of the send critical path
+                wire_holder[0] += _frame_bytes(msg)
+                if faults.REGISTRY.active():
+                    def op(peer=peer):
+                        faults.check(_F_ASYNC, peer=peer)
+                    default_policy(**_FRAME_RETRY).run(
+                        op, what="host_exchange:async_send")
+                _send_frame(group, peer, msg, "host_exchange")
+        except BaseException as e:  # surfaced on the main thread
+            err.append(e)
+
+    t = threading.Thread(target=_sender, daemon=True,
+                         name="thrill-tpu-mux-send")
+    t.start()
+    sent_items = 0
+    try:
+        for r in range(1, P):
+            to = (me + r) % P
+            sent_items += sum(len(b) for dws in outgoing[to].values()
+                              for b in dws.values())
+            while True:
+                if err:
+                    raise err[0]
+                try:
+                    q.put((to, outgoing[to]), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+        q.put(None)
+        for r in range(1, P):
+            frm = (me - r) % P
+            received.append(_recv_frame(group, frm, "host_exchange"))
+    finally:
+        if err:
+            # unblock join below; frames already queued are moot
+            while not q.empty():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+    # sender drain deadline: the collective-watchdog knob
+    # (THRILL_TPU_HANG_TIMEOUT_S) — the same deadline every blocking
+    # collective honors. Watchdog off (None) = wait for the send like
+    # the serial plane would; a legitimately slow large frame is not a
+    # fault.
+    from ..net.group import hang_timeout_s
+    t.join(timeout=hang_timeout_s())
+    if err:
+        raise err[0]
+    if t.is_alive():
+        # our receives never depend on our OWN sends, so the recv loop
+        # can complete while a send is still wedged — returning success
+        # would strand the peer waiting for this frame with nothing
+        # attributing the cause. Raise inside the caller's
+        # poison_on_error scope instead.
+        raise RuntimeError(
+            "host_exchange async sender exceeded the hang deadline "
+            "with a frame still in flight (wedged send to a peer); "
+            "aborting the exchange")
+    return sent_items, wire_holder[0]
 
 
 def ensure_replicated(mex, shards: HostShards,

@@ -171,6 +171,21 @@ class MeshExec:
         self.stats_bytes_moved = 0
         # padded rows allocated by exchange plans (skew diagnostics)
         self.stats_padded_rows = 0
+        # overlapped-exchange data plane (data/exchange.py): exchanges
+        # dispatched optimistically on a cached capacity plan (no
+        # mid-shuffle host sync), capacity-plan cache hits/misses, and
+        # the bytes that actually cross the fabric/wire — padded rows
+        # on the device plane, serialized frames on the host plane
+        # (the baseline for ROADMAP's shrink-the-wire item)
+        self.stats_exchanges_overlapped = 0
+        self.stats_cap_cache_hits = 0
+        self.stats_cap_cache_misses = 0
+        self.stats_bytes_wire_device = 0
+        self.stats_bytes_wire_host = 0
+        # per-exchange-site plan kind ('dense' = optimistic-eligible,
+        # 'sync' = the site needs the host plan step every time); the
+        # capacity values themselves live in _sticky_caps
+        self._xchg_plan: Dict[Any, str] = {}
         # device-program dispatch / host<->device transfer counters.
         # On a tunneled chip every dispatch pays the link round trip
         # (measured 140.7 ms on the axon tunnel, BASELINE.md round 5),
@@ -223,8 +238,13 @@ class MeshExec:
         self.stats_bytes_dcn = 0
         # exchange implementation ('dense' | 'onefactor' | 'ragged');
         # Context sets it from Config.exchange, THRILL_TPU_EXCHANGE
-        # env overrides ('dense' auto-switches to 1-factor under skew)
+        # env overrides ('dense' auto-switches to 1-factor under skew).
+        # The env override is read ONCE here: resolve_mode() used to
+        # pay an os.environ lookup on every exchange plan step — set
+        # the variable before constructing the mesh
         self.exchange_mode = "dense"
+        import os as _os
+        self._env_exchange = _os.environ.get("THRILL_TPU_EXCHANGE")
         # slice topology: collectives between same-slice workers ride
         # ICI, cross-slice DCN. Detected from the device objects'
         # slice_index (real multi-slice pods); THRILL_TPU_SLICES=k
@@ -353,26 +373,40 @@ class MeshExec:
     def put_tree(self, tree):
         return jax.tree.map(self.put, tree)
 
-    def put_small(self, arr) -> jax.Array:
+    def put_small(self, arr, replicated: bool = False) -> jax.Array:
         """Content-cached ``put`` for small recurring plan arrays
         (shard counts, zip offsets, range bounds). Iterative pipelines
         re-upload identical tiny arrays every iteration — on a tunneled
         chip each is a link round trip (BASELINE.md r5) — and device
         buffers are immutable, so sharing one upload per distinct value
-        is safe. Falls through to plain put() above 4 KiB."""
+        is safe. Falls through to plain put() above 4 KiB.
+
+        ``replicated=True`` places the whole array on every worker
+        (P() operand — the exchange plans' [W, W] send matrix form)
+        instead of splitting axis 0."""
         arr = np.asarray(arr)
         if arr.nbytes > 4096:
-            return self.put(arr)
-        key = (arr.shape, arr.dtype.str, arr.tobytes())
+            return self._put_replicated(arr) if replicated \
+                else self.put(arr)
+        key = (arr.shape, arr.dtype.str, arr.tobytes(), replicated)
         buf = self._put_small_cache.get(key)
         if buf is None:
             if len(self._put_small_cache) >= 4096:   # unbounded-growth cap
                 self._put_small_cache.clear()
-            buf = self.put(arr)
+            buf = self._put_replicated(arr) if replicated \
+                else self.put(arr)
             self._put_small_cache[key] = buf
         else:
             self.stats_upload_cache_hits += 1
         return buf
+
+    def _put_replicated(self, arr) -> jax.Array:
+        """Upload one identical copy per device (values must already
+        agree across processes — exchange plan arrays derive from the
+        replicated send matrix, so they do)."""
+        self.stats_uploads += 1
+        return self._bless(jax.device_put(np.asarray(arr),
+                                          self.replicated))
 
     def fetch(self, arr) -> np.ndarray:
         """Device -> host fetch that is multi-controller safe.

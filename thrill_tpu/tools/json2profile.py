@@ -200,6 +200,7 @@ td.hm {{ min-width: 3em; }}
 {_render_stage_table(rows, exchanges, nodes)}
 {_render_stage_worker_matrix(nodes)}
 {_render_exchange_volume(exchanges, total)}
+{_render_overlap_lane(exchanges, overall, total)}
 {_render_worker_lanes(exchanges, total)}
 {_render_memory_events(memory, total)}
 {_render_fused_dispatches(fused, overall)}
@@ -560,6 +561,44 @@ def _render_exchange_volume(exchanges, total: float) -> str:
             f'{f", DCN {cum_dcn / 1e6:.1f} MB" if cum_dcn else ""})</h2>'
             f'<svg viewBox="0 0 100 120" class="vol" '
             f'preserveAspectRatio="none">{line(pts, "#07c")}{dcn}</svg>')
+
+
+def _render_overlap_lane(exchanges, overall, total: float) -> str:
+    """Exchange-overlap lane (data/exchange.py overlapped data plane):
+    one tick per device-plane exchange — overlapped dispatches
+    (capacity-cache hit, no mid-shuffle host sync) vs synced plans —
+    rendered next to the exchange-volume lanes, with the run's overlap
+    fraction and capacity-plan cache hit rate from overall_stats."""
+    dev = [(t, e) for t, e in exchanges if e.get("event") == "exchange"]
+    if not dev:
+        return ""
+    lanes = []
+    for kind, pred in (("overlapped", lambda e: e.get("overlapped")),
+                       ("synced plan", lambda e: not e.get("overlapped"))):
+        evs = [(t, e) for t, e in dev if pred(e)]
+        marks = "".join(
+            f'<div class="mark" style="left:{100 * t / total:.2f}%;'
+            f'width:0.4%;height:100%"></div>' for t, _ in evs)
+        lanes.append(
+            f'<div class="row"><span class="lbl">{kind}</span>'
+            f'<div class="track">{marks}</div>'
+            f'<span class="dur">{len(evs)} exchanges</span></div>')
+    summary = ""
+    if overall:
+        o = overall[-1]
+        ex = o.get("exchanges") or 0
+        ov = o.get("exchanges_overlapped", 0)
+        h, m = o.get("cap_cache_hits", 0), o.get("cap_cache_misses", 0)
+        wire = o.get("bytes_on_wire", 0)
+        summary = (
+            f"<p>overlap fraction <b>{(ov / ex if ex else 0):.0%}</b>"
+            f" ({ov}/{ex} exchanges dispatched with no mid-shuffle "
+            f"host sync), capacity-plan cache "
+            f"{(h / (h + m) if h + m else 0):.0%} hit "
+            f"({h} hits / {m} misses), "
+            f"{wire / 1e6:.2f} MB on the wire</p>")
+    return ("<h2>exchange overlap (capacity-plan cache)</h2>"
+            + summary + "".join(lanes))
 
 
 def _render_worker_lanes(exchanges, total: float) -> str:
