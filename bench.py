@@ -353,7 +353,10 @@ def _run_bench() -> None:
          if n_ex else 0.0,
          cap_cache_hit=round(hits / (hits + misses), 3)
          if hits + misses else 0.0,
-         bytes_on_wire=int(press.get("bytes_on_wire", 0)))
+         bytes_on_wire=int(press.get("bytes_on_wire", 0)),
+         bytes_on_wire_raw=int(press.get("bytes_on_wire_raw", 0)),
+         wire_compress_ratio=float(
+             press.get("wire_compress_ratio", 1.0)))
 
     _emit(value=round(mrec_s, 3),
           vs_baseline=round(mrec_s / host_mrec_s, 3),
@@ -443,22 +446,32 @@ def _loop_phase_fields(ctx, name: str, prefix: str) -> dict:
 
 
 def _xchg_snapshot(mex) -> tuple:
-    """(exchanges, overlapped, cap hits, cap misses) counter snapshot
-    for per-workload exchange-overlap attribution."""
+    """(exchanges, overlapped, cap hits, cap misses, wire, wire raw)
+    counter snapshot for per-workload exchange attribution."""
     return (mex.stats_exchanges, mex.stats_exchanges_overlapped,
-            mex.stats_cap_cache_hits, mex.stats_cap_cache_misses)
+            mex.stats_cap_cache_hits, mex.stats_cap_cache_misses,
+            mex.stats_bytes_wire_device + mex.stats_bytes_wire_host,
+            mex.stats_bytes_wire_device_raw + mex.stats_bytes_wire_host
+            + mex.stats_bytes_wire_host_saved)
 
 
 def _xchg_fields(mex, snap, prefix: str) -> dict:
-    """Per-workload overlap fields since ``snap``: what fraction of the
-    workload's exchanges dispatched with NO mid-shuffle host sync
+    """Per-workload overlap + wire fields since ``snap``: what fraction
+    of the workload's exchanges dispatched with NO mid-shuffle host sync
     (``*_exchange_overlap_frac`` — the ROADMAP success metric: near 1.0
     in steady state at W>1, exactly 0 where the workload has no
-    exchanges, e.g. dense-gather PageRank) and the capacity-plan cache
-    hit rate over its lookups."""
-    ex, ov, h, m = (b - a for a, b in zip(snap, _xchg_snapshot(mex)))
+    exchanges, e.g. dense-gather PageRank), the capacity-plan cache
+    hit rate over its lookups, and the workload's bytes-on-wire with
+    its compression ratio (ISSUE 7: wire regressions loud per workload,
+    the way dispatch budgets are)."""
+    ex, ov, h, m, wire, raw = (b - a
+                               for a, b in zip(snap,
+                                               _xchg_snapshot(mex)))
     out = {f"{prefix}_exchange_overlap_frac":
-           round(ov / ex, 3) if ex else 0.0}
+           round(ov / ex, 3) if ex else 0.0,
+           f"{prefix}_bytes_on_wire": int(wire),
+           f"{prefix}_wire_compress_ratio":
+           round(raw / wire, 3) if wire else 1.0}
     if h + m:
         out[f"{prefix}_cap_cache_hit"] = round(h / (h + m), 3)
     return out

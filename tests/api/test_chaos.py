@@ -38,7 +38,12 @@ _CHAOS_SITES = ("api.mesh.dispatch", "data.blockstore.put",
                 # shuffles (reduce/groupby/join ops in the generator);
                 # net.multiplexer.async_send needs multi-controller
                 # groups and gets its chaos from the fault matrix
-                "data.exchange.chunk")
+                "data.exchange.chunk",
+                # shrink-the-wire (ISSUE 7): row-narrowing degrade at
+                # the same shuffle sites (full-width fallback, always
+                # correct); net.wire.compress needs host frames and
+                # gets its chaos from the fault matrix
+                "data.exchange.pack")
 
 import os
 

@@ -535,3 +535,95 @@ def test_join_overflow_recovery_heals_downstream_pipeline():
     disp, up, fetch = (_snap(mex) - s0).tolist()
     assert fetch <= 1, fetch                   # egress only; no sync
     ctx.close()
+
+
+# ----------------------------------------------------------------------
+# shrink-the-wire budgets (ISSUE 7): >=2x bytes_on_wire vs the PR 6
+# baseline, pinned like dispatch counts
+# ----------------------------------------------------------------------
+
+def _jk(t):
+    return t["k"]
+
+
+def _join_sum(a, b):
+    return {"k": a["k"], "s": a["v"] + b["v"]}
+
+
+def test_wire_shrink_innerjoin_budget(monkeypatch):
+    """W=2 InnerJoin pipeline: row narrowing (i64 keys/payloads in
+    narrow ranges) shrinks bytes_on_wire >= 2x vs the PR 6 baseline
+    (THRILL_TPU_WIRE_COMPRESS=0), results bit-identical with
+    compression and pruning individually disabled; the location filter
+    composes (pruned rows shrink the wire further, never change the
+    result)."""
+    n = 4096
+
+    def run(compress, prune):
+        monkeypatch.setenv("THRILL_TPU_WIRE_COMPRESS", compress)
+        monkeypatch.setenv("THRILL_TPU_LOCATION_DETECT", prune)
+        mex = MeshExec(num_workers=2)
+        ctx = Context(mex)
+        lk = np.arange(n, dtype=np.int64)
+        l = ctx.Distribute({"k": lk, "v": (lk * 3) % 1000})
+        rk = np.arange(0, n, 4, dtype=np.int64)     # quarter keyspace
+        r = ctx.Distribute({"k": rk, "v": rk % 97})
+        j = InnerJoin(l, r, _jk, _jk, _join_sum)
+        cols = jax.tree.map(np.asarray, j.AllGatherArrays())
+        order = np.lexsort((cols["s"], cols["k"]))
+        out = {kk: np.asarray(vv)[order] for kk, vv in cols.items()}
+        wire = ctx.overall_stats()["bytes_on_wire"]
+        ctx.close()
+        return out, wire
+
+    base, wire_base = run("0", "0")    # the PR 6 baseline plane
+    comp, wire_comp = run("1", "0")    # compression alone
+    full, wire_full = run("1", "1")    # compression + pruning
+    for k in base:
+        assert np.array_equal(base[k], comp[k]), k
+        assert np.array_equal(base[k], full[k]), k
+    assert wire_base > 0
+    assert wire_base >= 2 * wire_comp, (wire_base, wire_comp)
+    assert wire_full <= wire_comp, (wire_full, wire_comp)
+
+
+def _pr_idx(t):
+    return t["i"]
+
+
+def test_wire_shrink_pagerank_budget(monkeypatch):
+    """W=2 multi-iteration PageRank-shaped traffic (per iteration an
+    index-partitioned scatter of (page index, f32 contribution) — the
+    ReduceToIndex exchange PageRank pays at W>1): narrowing the index
+    column shrinks bytes_on_wire >= 2x vs the PR 6 baseline, ranks
+    bit-identical."""
+    from thrill_tpu.api import FieldReduce
+    npages, nedges, iters = 200, 4096, 3
+    rng = np.random.default_rng(3)
+    src = rng.integers(0, npages, nedges).astype(np.int64)
+    dst = rng.integers(0, npages, nedges).astype(np.int64)
+    deg = np.maximum(np.bincount(src, minlength=npages), 1)
+
+    def run(compress):
+        monkeypatch.setenv("THRILL_TPU_WIRE_COMPRESS", compress)
+        mex = MeshExec(num_workers=2)
+        ctx = Context(mex)
+        red = FieldReduce({"i": "first", "r": "sum"})
+        ranks = np.full(npages, 1.0 / npages, np.float32)
+        for _ in range(iters):
+            contrib = (ranks[src] / deg[src]).astype(np.float32)
+            d = ctx.Distribute({"i": dst, "r": contrib})
+            out = d.ReduceToIndex(_pr_idx, red, size=npages,
+                                  neutral={"i": 0, "r": np.float32(0)})
+            cols = jax.tree.map(np.asarray, out.AllGatherArrays())
+            ranks = (0.15 / npages
+                     + 0.85 * np.asarray(cols["r"])).astype(np.float32)
+        wire = ctx.overall_stats()["bytes_on_wire"]
+        ctx.close()
+        return ranks, wire
+
+    ranks_base, wire_base = run("0")
+    ranks_comp, wire_comp = run("1")
+    assert np.array_equal(ranks_base, ranks_comp)
+    assert wire_base > 0
+    assert wire_base >= 2 * wire_comp, (wire_base, wire_comp)

@@ -258,6 +258,49 @@ def _ex_exchange_chunk_site():
     assert faults.REGISTRY.stats()["retries"] >= 1
 
 
+def _ex_wire_compress_site():
+    """net.wire.compress (shrink-the-wire host codec, net/wire.py):
+    an armed fire DEGRADES that column to the raw tags — the frame
+    still round-trips exactly, never fails; the degrade is counted as
+    a recovery."""
+    from thrill_tpu.net import wire
+    a = np.arange(4096, dtype=np.int64) % 100      # compressible
+    with faults.inject("net.wire.compress", n=1, seed=3):
+        enc_degraded = wire.dumps(a, compress=True)
+        enc_normal = wire.dumps(a, compress=True)
+    assert np.array_equal(wire.loads(enc_degraded), a)
+    assert np.array_equal(wire.loads(enc_normal), a)
+    # the degraded frame shipped raw (bigger), the next one compressed
+    assert len(enc_degraded) > len(enc_normal)
+    assert faults.REGISTRY.injected >= 1
+    assert faults.REGISTRY.stats()["recoveries"] >= 1
+
+
+def _ex_exchange_pack_site():
+    """data.exchange.pack (phase-B row narrowing, data/exchange.py):
+    an armed fire drops the narrow spec for that exchange — rows ship
+    full-width (always correct), results exact, degrade counted. The
+    keyspace keeps the pre-reduced shuffle above the narrowing
+    volume gate (_NARROW_MIN_BYTES), or the site is unreachable."""
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+    n, keys = 16384, 2048
+    with faults.inject("data.exchange.pack", n=1, seed=5):
+        mex = MeshExec(num_workers=2)
+        ctx = Context(mex)
+        out = ctx.Distribute(
+            np.arange(n, dtype=np.int64)).Map(
+                lambda x: (x % keys, x)).ReducePair(lambda a, b: a + b)
+        got = sorted((int(k), int(v)) for k, v in out.AllGather())
+        ctx.close()
+    want: dict = {}
+    for x in range(n):
+        want[x % keys] = want.get(x % keys, 0) + x
+    assert got == sorted(want.items())
+    assert faults.REGISTRY.injected >= 1
+    assert faults.REGISTRY.stats()["recoveries"] >= 1
+
+
 def _ex_async_send_site():
     """net.multiplexer.async_send (MixStream-analog host sender): the
     background sender thread's injection point retries inside the
@@ -642,6 +685,10 @@ _MATRIX = {
     # overlapped exchange data plane (ISSUE 6): per-chunk device
     # dispatch site + the async host-frame sender thread
     "data.exchange.chunk": _ex_exchange_chunk_site,
+    # shrink-the-wire (ISSUE 7): host-frame column codec + device-row
+    # narrowing — both DEGRADE to the uncompressed form, never wrong
+    "net.wire.compress": _ex_wire_compress_site,
+    "data.exchange.pack": _ex_exchange_pack_site,
     "net.multiplexer.async_send": _ex_async_send_site,
     "mem.hbm.spill": _ex_hbm_spill_and_restore,
     "mem.hbm.restore": _ex_hbm_spill_and_restore,

@@ -194,3 +194,46 @@ def test_stage_table_single_attribution():
     assert "<td>1.00</td>" in html_out
     assert "<td>2.00</td>" in html_out
     assert "<td>3.00</td>" not in html_out
+
+
+def test_jit_construction_single_choke_point():
+    """Source audit (ROADMAP choke-point item): every ``jax.jit`` in
+    the package is constructed inside parallel/mesh.py, behind the
+    _CountedJit proxy — the single dispatch entry that admission
+    control, the OOM-retry ladder and the dispatch/budget counters
+    cover. A stray jit anywhere else would dispatch device programs
+    those layers cannot see."""
+    import tokenize
+
+    import thrill_tpu
+
+    pkg_root = os.path.dirname(os.path.abspath(thrill_tpu.__file__))
+    offenders = []
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, pkg_root)
+            if rel == os.path.join("parallel", "mesh.py"):
+                continue
+            with open(path, "rb") as f:
+                toks = [t for t in tokenize.tokenize(f.readline)
+                        if t.type in (tokenize.NAME, tokenize.OP)]
+            for i in range(len(toks) - 2):
+                a, b, c = toks[i], toks[i + 1], toks[i + 2]
+                # CODE tokens only — docstrings/comments never match
+                if (a.type == tokenize.NAME and a.string == "jax"
+                        and b.string == "." and c.string == "jit"):
+                    offenders.append(f"{rel}:{a.start[0]}")
+                if (a.string == "import" and b.string == "jit"
+                        and i >= 2 and toks[i - 2].string == "from"
+                        and toks[i - 1].string == "jax"):
+                    offenders.append(f"{rel}:{a.start[0]}")
+    assert not offenders, (
+        f"jax.jit constructed outside parallel/mesh.py: {offenders} — "
+        f"route it through MeshExec.smap/jit_cached/counted_jit so the "
+        f"_CountedJit choke point (admission control, OOM ladder, "
+        f"dispatch budgets) covers it")

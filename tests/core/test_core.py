@@ -156,3 +156,55 @@ def test_multiway_merge_degree_cap():
                                        max_merge_degree=8))
     assert merged == sorted(all_vals)
     pool.close()
+
+
+def test_preshuffle_cost_model():
+    """Plan-time pre-shuffle decisions (core/preshuffle.py): register
+    width clamps, the pays-for-itself threshold, env forcing, sticky
+    per-site verdicts and prune-fraction learning."""
+    import os
+
+    from thrill_tpu.core import preshuffle as ps
+
+    class Mex:
+        num_workers = 4
+        num_processes = 1
+
+    assert ps.register_width(1) == ps._REG_MIN
+    assert ps.register_width(10**9) == ps._REG_MAX
+    assert ps.register_width(4096) == 1 << 15            # 8x rows
+
+    # tiny join: registers cost more than the rows they could prune
+    assert not ps.auto_location_detect(Mex(), 1000, 16, "t1")
+    # big join: pruning pays comfortably
+    assert ps.auto_location_detect(Mex(), 1_000_000, 16, "t2")
+    # sticky: the verdict is remembered per (mesh, site)
+    m = Mex()
+    assert ps.auto_location_detect(m, 1_000_000, 16, "t3")
+    assert ps.auto_location_detect(m, 1, 1, "t3")        # sticky True
+
+    # learned prune fraction moves the threshold
+    m2 = Mex()
+    ps.record_prune(m2, "t4", pre_rows=1000, post_rows=1000)  # 0 pruned
+    assert ps.prune_fraction(m2, "t4") < 0.3
+    assert not ps.auto_location_detect(m2, 300_000, 16, "t4")
+
+    # env forcing beats the model both ways
+    os.environ["THRILL_TPU_LOCATION_DETECT"] = "1"
+    try:
+        assert ps.auto_location_detect(Mex(), 1, 1, "t5")
+    finally:
+        os.environ["THRILL_TPU_LOCATION_DETECT"] = "0"
+    try:
+        assert not ps.auto_location_detect(Mex(), 10**9, 64, "t6")
+    finally:
+        del os.environ["THRILL_TPU_LOCATION_DETECT"]
+
+    # multi-controller: auto resolves OFF (decision inputs must be
+    # globally agreed; see module docstring)
+    class MexMP(Mex):
+        num_processes = 2
+
+    assert not ps.auto_location_detect(MexMP(), 10**9, 64, "t7")
+    assert not ps.auto_dup_detect(MexMP(), 10**9, 64, "t7")
+    assert ps.auto_dup_detect(Mex(), 2_000_000, 16, "t8")

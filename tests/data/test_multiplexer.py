@@ -206,3 +206,36 @@ def test_mix_delivery_multiset_and_within_source_order(monkeypatch):
     golden = run_procs(W, P, job_cat)
     monkeypatch.setenv("THRILL_TPU_HOST_MIX", "1")
     assert run_procs(W, P, job_cat) == golden
+
+
+def test_mix_any_source_receive(monkeypatch):
+    """THRILL_TPU_HOST_MIX=1 at P=3: receives drain whichever peer's
+    frame lands first (Group.recv_any over the mock readiness probe)
+    instead of the fixed per-peer schedule — delivery stays exactly
+    the CatStream multiset with per-source internal order (the
+    MixStream contract)."""
+    W, P = 6, 3
+    items_of, _ = _xchg_job(W)
+    _, job_mix = _xchg_job(W, rank_order=False)
+    monkeypatch.setenv("THRILL_TPU_HOST_MIX", "1")
+    results = run_procs(W, P, job_mix)
+    wp = np.repeat(np.arange(P), W // P)[:W]
+    want = [sorted(it for w in range(W) for it in items_of(w)
+                   if it[1] % W == dw) for dw in range(W)]
+    for w in range(W):
+        got = results[int(wp[w])][w]
+        assert sorted(got) == want[w]
+        for src in range(W):
+            mine = [it for it in got if it[0] == src]
+            assert mine == sorted(mine)
+
+
+def test_recv_any_picks_ready_peer():
+    """The mock transport's readiness probe returns the peer whose
+    frame is already queued, not just the first candidate."""
+    from thrill_tpu.net.mock import MockNetwork
+    groups = MockNetwork.construct(3)
+    assert groups[0].supports_recv_any
+    groups[2].send_to(0, {"from": 2})      # only peer 2 has a frame
+    peer, msg = groups[0].recv_any([1, 2])
+    assert (peer, msg) == (2, {"from": 2})

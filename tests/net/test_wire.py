@@ -205,3 +205,118 @@ def test_tcp_group_secret_large_frames():
     assert all(e is None for e in errors), errors
     assert all(not t.is_alive() for t in threads)
     assert results == [[0, 1], [0, 1]]
+
+
+# ----------------------------------------------------------------------
+# frame compression (shrink-the-wire host plane, ISSUE 7)
+# ----------------------------------------------------------------------
+
+def _compress_cases():
+    rng = np.random.default_rng(0)
+    return {
+        "narrow_i64": rng.integers(0, 1000, 2048).astype(np.int64),
+        "narrow_negative": rng.integers(-100, 100, 2048).astype(np.int64),
+        "sorted_unique": np.unique(
+            rng.integers(0, 1 << 32, 4096).astype(np.int64)),
+        "monotone_dups": np.sort(
+            rng.integers(0, 1 << 40, 2048).astype(np.int64)),
+        "constant": np.full(2048, 7, np.int64),
+        "already_narrow_u8": rng.integers(0, 255, 2048).astype(np.uint8),
+        "unsorted_wide": rng.integers(
+            -(1 << 62), 1 << 62, 2048).astype(np.int64),
+        "nan_floats": np.where(rng.random(2048) < 0.3, np.nan,
+                               rng.random(2048)),
+        "neg_zero_floats": np.array([0.0, -0.0, 1.5] * 100),
+        "u16": rng.integers(0, 200, 2048).astype(np.uint16),
+        "u64_full_range": rng.integers(0, 1 << 63, 512).astype(np.uint64)
+        * np.uint64(2),
+        "u64_sorted_past_i64": np.sort(
+            rng.integers(0, 1 << 63, 512).astype(np.uint64)
+            * np.uint64(2)),
+        "twod_narrow": rng.integers(0, 50, (256, 16)).astype(np.int64),
+        "empty": np.zeros(0, np.int64),
+        "bools": rng.random(512) < 0.5,
+    }
+
+
+def test_compress_roundtrip_parity_sweep():
+    """Every codec x pathological column: the compressed frame decodes
+    to the exact array (dtype, shape, bytes — NaN payloads included),
+    and the parts path concatenates to the same decodable stream."""
+    from thrill_tpu.net import wire
+    for name, a in _compress_cases().items():
+        nan_ok = a.dtype.kind == "f"
+        enc = wire.dumps(a, compress=True)
+        dec = wire.loads(enc)
+        assert isinstance(dec, np.ndarray) and dec.dtype == a.dtype \
+            and dec.shape == a.shape, name
+        if nan_ok:
+            # bit-level float parity (NaN payloads, signed zeros)
+            assert dec.tobytes() == a.tobytes(), name
+        else:
+            assert np.array_equal(dec, a), name
+        cat = b"".join(bytes(p)
+                       for p in wire.dumps_parts(a, compress=True))
+        dec2 = wire.loads(cat)
+        assert dec2.tobytes() == a.tobytes(), name
+        # decoded arrays must be writable (frombuffer views are not)
+        dec[...] = dec
+    # int sequences decode to their original container of python ints
+    vals = sorted(int(x) for x in
+                  np.unique(np.random.default_rng(1).integers(
+                      0, 1 << 32, 2000)))
+    assert wire.loads(wire.dumps(vals, compress=True)) == vals
+    tup = tuple(vals)
+    got = wire.loads(wire.dumps(tup, compress=True))
+    assert got == tup and type(got) is tuple
+    mixed = [1, "a", 3.5] * 50
+    assert wire.loads(wire.dumps(mixed, compress=True,
+                                 allow_pickle=False)) == mixed
+
+
+def test_compress_disabled_is_bit_identical_pre_codec():
+    """THRILL_TPU_WIRE_COMPRESS=0 restores the pre-codec frames
+    byte-identically: no compressed tag anywhere in the stream, and
+    the explicit compress=False twin matches the env-disabled form."""
+    import os
+
+    from thrill_tpu.net import wire
+    frame = {0: {1: list(range(100)), 2: _compress_cases()["narrow_i64"]}}
+    off_explicit = wire.dumps(frame, allow_pickle=True, compress=False)
+    prev = os.environ.get("THRILL_TPU_WIRE_COMPRESS")
+    os.environ["THRILL_TPU_WIRE_COMPRESS"] = "0"
+    try:
+        off_env = wire.dumps(frame, allow_pickle=True)
+    finally:
+        if prev is None:
+            del os.environ["THRILL_TPU_WIRE_COMPRESS"]
+        else:
+            os.environ["THRILL_TPU_WIRE_COMPRESS"] = prev
+    assert off_explicit == off_env
+    on = wire.dumps(frame, allow_pickle=True, compress=True)
+    assert len(on) < len(off_env)
+    # decoders accept BOTH forms regardless of the sender's flag
+    for enc in (on, off_env):
+        dec = wire.loads(enc, allow_pickle=True)
+        assert dec[0][1] == list(range(100))
+        assert np.array_equal(dec[0][2],
+                              _compress_cases()["narrow_i64"])
+
+
+def test_rice_fast_codec_matches_bitwise():
+    """The vectorized Rice encoder (core/golomb.py encode_sorted_np)
+    is bit-identical to the per-bit reference writer, and the
+    vectorized decoder inverts both."""
+    from thrill_tpu.core import golomb as g
+    rng = np.random.default_rng(7)
+    for n in (0, 1, 3, 257, 2000):
+        vals = np.unique(rng.integers(0, 1 << 24, n).astype(np.int64))
+        k = g.rice_parameter((1 << 24) / max(len(vals), 1))
+        slow = g.encode_sorted([int(v) for v in vals], k)
+        fast = g.encode_sorted_np(vals, k)
+        assert slow == fast
+        assert np.array_equal(g.decode_sorted_np(*fast, k), vals)
+        if len(vals):
+            dec = np.fromiter(g.decode_sorted(*slow, k),
+                              dtype=np.int64, count=len(vals))
+            assert np.array_equal(dec, vals)

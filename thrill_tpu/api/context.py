@@ -32,6 +32,11 @@ from ..net.flow import FlowControlChannel, LocalFlowControl
 from ..parallel.mesh import MeshExec
 
 
+def _wire_ratio(raw: int, actual: int) -> float:
+    """bytes_on_wire_raw / bytes_on_wire, 1.0 when nothing shipped."""
+    return round(raw / actual, 3) if actual else 1.0
+
+
 class Context:
     """Runtime handle passed to user jobs; owns the mesh and services."""
 
@@ -310,6 +315,21 @@ class Context:
             "bytes_wire_host": mex.stats_bytes_wire_host,
             "bytes_on_wire": (mex.stats_bytes_wire_device
                               + mex.stats_bytes_wire_host),
+            # shrink-the-wire layer (ISSUE 7): the raw-equivalent
+            # volume (full-width device rows + host frame bytes before
+            # the column codec) and the resulting compression ratio —
+            # >= 1.0, exactly 1.0 with THRILL_TPU_WIRE_COMPRESS=0
+            "bytes_wire_device_raw": mex.stats_bytes_wire_device_raw,
+            "bytes_wire_host_saved": mex.stats_bytes_wire_host_saved,
+            "bytes_on_wire_raw": (mex.stats_bytes_wire_device_raw
+                                  + mex.stats_bytes_wire_host
+                                  + mex.stats_bytes_wire_host_saved),
+            "wire_compress_ratio": _wire_ratio(
+                mex.stats_bytes_wire_device_raw
+                + mex.stats_bytes_wire_host
+                + mex.stats_bytes_wire_host_saved,
+                mex.stats_bytes_wire_device
+                + mex.stats_bytes_wire_host),
             # on a tunneled chip each dispatch/upload costs one link
             # RTT (140.7 ms measured, BASELINE.md r5) — the governing
             # pipeline cost; see tests/api/test_dispatch_budget.py
@@ -367,10 +387,11 @@ class Context:
                           "aborts", "ckpt_bytes_written", "oom_retries",
                           "segment_splits", "host_fallbacks",
                           "admission_spills", "pressure_spilled_bytes",
-                          # host frames are per-process partials; the
-                          # device wire bytes derive from the
+                          # host frames (and their codec savings) are
+                          # per-process partials; the device wire
+                          # bytes — actual and raw — derive from the
                           # replicated send matrix (host 0's copy)
-                          "bytes_wire_host"}
+                          "bytes_wire_host", "bytes_wire_host_saved"}
             stats = {
                 k: (max(h[k] for h in per_host) if k in local_peaks
                     else sum(h.get(k, 0) for h in per_host)
@@ -378,6 +399,12 @@ class Context:
                 for k in stats}
             stats["bytes_on_wire"] = (stats["bytes_wire_device"]
                                       + stats["bytes_wire_host"])
+            stats["bytes_on_wire_raw"] = (
+                stats["bytes_wire_device_raw"]
+                + stats["bytes_wire_host"]
+                + stats["bytes_wire_host_saved"])
+            stats["wire_compress_ratio"] = _wire_ratio(
+                stats["bytes_on_wire_raw"], stats["bytes_on_wire"])
             stats["hosts"] = len(per_host)
         return stats
 

@@ -56,17 +56,22 @@ class DIA:
     # distributed ops
     # ------------------------------------------------------------------
     def ReduceByKey(self, key_fn: Callable, reduce_fn: Callable,
-                    dup_detection: bool = False) -> "DIA":
+                    dup_detection=None) -> "DIA":
         """``dup_detection`` (reference: DuplicateDetectionTag) skips
-        shuffling globally-unique keys — host-storage path only; the
-        device path ignores it (its pre-reduce already bounds shuffle
-        volume at one item per local distinct key).
+        shuffling globally-unique keys: the device path folds a
+        presence-register psum into the destination program, the host
+        path exchanges Golomb fingerprints. None — the default —
+        defers to the plan-time cost model (core/preshuffle.py,
+        forced either way with THRILL_TPU_DUP_DETECT=0/1); True/False
+        force it per call.
 
         Output order is UNSPECIFIED (as in the reference's
         hash-partitioned tables): the device engine emits key-sorted
         order, the CPU-backend native hash-group emits
         first-appearance order — sort before comparing across
-        backends."""
+        backends. Dup detection additionally changes which worker
+        holds a unique key's result (it stays local instead of
+        travelling to its hash home) — the result SET is identical."""
         from .ops import reduce as _r
         return _r.ReduceByKey(self, key_fn, reduce_fn, dup_detection)
 
@@ -320,11 +325,14 @@ def Union(*dias: DIA) -> DIA:
 
 def InnerJoin(left: DIA, right: DIA, left_key_fn: Callable,
               right_key_fn: Callable, join_fn: Callable,
-              location_detection: bool = False,
+              location_detection=None,
               out_size_hint=None, dense_right_index=None) -> DIA:
     """``location_detection`` (reference: LocationDetectionTag) prunes
-    items whose key exists on only one side before the shuffle —
-    host-storage path only; the device path ignores the flag.
+    items whose key exists on only one side before the shuffle, on
+    both the device path (presence-register filter) and the host path
+    (Golomb fingerprint exchange). None — the default — defers to the
+    plan-time cost model (core/preshuffle.py, forced either way with
+    THRILL_TPU_LOCATION_DETECT=0/1); True/False force it per call.
     ``out_size_hint``: optional per-worker match-count upper bound —
     the device path then skips its blocking size sync (overflow raises
     at the next host fetch, never silently truncates).

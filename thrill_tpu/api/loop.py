@@ -746,9 +746,10 @@ class LoopPlan:
                 # entry: HBM admission control, the OOM-retry ladder
                 # and the dispatch counters cover it (an OOM here used
                 # to bypass rung 1/2 entirely and only degrade via
-                # Iterate's re-plan fallback)
-                from ..parallel.mesh import _CountedJit
-                return _CountedJit(self.mex, jax.jit(loop_fn))
+                # Iterate's re-plan fallback). counted_jit keeps
+                # parallel/mesh.py the single module constructing jits
+                # (the choke-point source audit in test_tracing.py)
+                return self.mex.counted_jit(loop_fn)
 
             try:
                 fn = self.mex.cached(key, build)

@@ -35,7 +35,7 @@ from ..dia_base import DIABase
 
 class InnerJoinNode(DIABase):
     def __init__(self, ctx, llink, rlink, lkey, rkey, join_fn,
-                 location_detection: bool = False,
+                 location_detection=None,
                  out_size_hint=None, dense_right_index=None) -> None:
         super().__init__(ctx, "InnerJoin", [llink, rlink])
         if dense_right_index is not None and rkey is not None:
@@ -65,7 +65,11 @@ class InnerJoinNode(DIABase):
                                   else int(dense_right_index))
         # reference: LocationDetectionTag, api/inner_join.hpp:161-190 —
         # prune items whose key hash exists on only one side before the
-        # shuffle (host path)
+        # shuffle. None (the default) = decided by the plan-time cost
+        # model (core/preshuffle.py: estimated fingerprint bytes vs
+        # estimated pruned row bytes, fed by the learned per-site
+        # exchange capacities); True/False force it like the
+        # reference's explicit tag
         self.location_detection = location_detection
         # PER-WORKER output capacity hint: when the caller knows an
         # upper bound on each worker's match count (index joins with
@@ -123,7 +127,17 @@ class InnerJoinNode(DIABase):
               for l in left.lists]
         rh = [[hashing.stable_host_hash(_h(rkey(it))) for it in l]
               for l in right.lists]
-        if self.location_detection and W > 1:
+        ld = self.location_detection
+        if ld is None:
+            # host path: exact local row counts feed the cost model
+            # (auto resolves OFF in multi-controller runs — local
+            # counts are not globally agreed, see core/preshuffle.py)
+            from ...core import preshuffle
+            rows = (sum(len(l) for l in left.lists)
+                    + sum(len(l) for l in right.lists))
+            ld = preshuffle.auto_location_detect(
+                mex, rows, 32, ("join_host", self.lkey, self.rkey))
+        if ld and W > 1:
             from ...core.location_detection import (LocationDetection,
                                                     _MASK)
             lh_all, rh_all = lh, rh
@@ -199,9 +213,24 @@ class InnerJoinNode(DIABase):
         W = mex.num_workers
         lkey, rkey = self.lkey, self.rkey
 
-        if self.location_detection and W > 1:
+        ld = self.location_detection
+        if ld is None and W > 1:
+            # plan-time cost model: fingerprint register bytes vs the
+            # rows pruning is expected to save, fed by exact counts
+            # where host-known and the learned per-site exchange
+            # capacities otherwise (core/preshuffle.py)
+            from ...core import preshuffle
+            rows, item_bytes = preshuffle.join_rows_estimate(
+                mex, left, right, ("join_l", token, W),
+                ("join_r", token, W))
+            ld = preshuffle.auto_location_detect(mex, rows, item_bytes,
+                                                 ("join_dev", token))
+        if ld and W > 1:
+            pre_rows = _host_rows(left), _host_rows(right)
             left, right = _location_filter(left, right, lkey, rkey,
                                            token)
+        else:
+            pre_rows = None
 
         if W > 1:
             def mk_dest(key_fn):
@@ -219,6 +248,15 @@ class InnerJoinNode(DIABase):
             # overflow check; the join phases read the columns directly
             left.validate_pending()
             right.validate_pending()
+            if pre_rows is not None:
+                # teach the site its prune fraction where both counts
+                # happen to be host-known already (never adds a sync)
+                post = _host_rows(left), _host_rows(right)
+                if None not in pre_rows and None not in post:
+                    from ...core import preshuffle
+                    preshuffle.record_prune(
+                        mex, ("join_dev", token),
+                        pre_rows[0] + pre_rows[1], post[0] + post[1])
         return left, right
 
     def compute_plan(self):
@@ -702,9 +740,10 @@ class InnerJoinNode(DIABase):
         return out
 
 
-# presence-register width for device LocationDetection (false positives
-# only cost shuffle traffic, never correctness)
-_LD_REGISTERS = 1 << 17
+def _host_rows(shards) -> "int | None":
+    """Global row count when already host-known (no sync), else None."""
+    counts = getattr(shards, "_counts_host", None)
+    return None if counts is None else int(np.asarray(counts).sum())
 
 
 def _location_filter(left: DeviceShards, right: DeviceShards,
@@ -714,16 +753,19 @@ def _location_filter(left: DeviceShards, right: DeviceShards,
     for the exchange (reference: LocationDetectionTag,
     api/inner_join.hpp:161-190, core/location_detection.hpp:70 — the
     Golomb-coded per-key location exchange becomes one pmax over
-    presence registers)."""
+    presence registers). Registers are u8 presence bits sized to the
+    padded row bound (core/preshuffle.py register_width) — false
+    positives only cost shuffle traffic, never correctness."""
     import jax
     from jax import lax
 
+    from ...core import preshuffle
     from ...data.shards import compact_valid
     from ...parallel.mesh import AXIS
 
     mex = left.mesh_exec
-    M = _LD_REGISTERS
     lcap, rcap = left.cap, right.cap
+    M = preshuffle.register_width((lcap + rcap) * mex.num_workers)
     lleaves, ltd = jax.tree.flatten(left.tree)
     rleaves, rtd = jax.tree.flatten(right.tree)
     nl = len(lleaves)
@@ -743,10 +785,12 @@ def _location_filter(left: DeviceShards, right: DeviceShards,
             hr = (hashing.hash_key_words(
                 keymod.encode_key_words(rkey(rtree)))
                 % jnp.uint64(M)).astype(jnp.int32)
-            pres_l = jnp.zeros(M, jnp.int32).at[hl].max(
-                lvalid.astype(jnp.int32))
-            pres_r = jnp.zeros(M, jnp.int32).at[hr].max(
-                rvalid.astype(jnp.int32))
+            # u8 presence registers: a quarter of the i32 form's
+            # fabric bytes, same verdict
+            pres_l = jnp.zeros(M, jnp.uint8).at[hl].max(
+                lvalid.astype(jnp.uint8))
+            pres_r = jnp.zeros(M, jnp.uint8).at[hr].max(
+                rvalid.astype(jnp.uint8))
             pres_l = lax.pmax(pres_l, AXIS)
             pres_r = lax.pmax(pres_r, AXIS)
             keep_l = lvalid & (jnp.take(pres_r, hl) > 0)
@@ -835,9 +879,15 @@ def _enum_key(t):
 
 
 def InnerJoin(left: DIA, right: DIA, left_key_fn, right_key_fn,
-              join_fn, location_detection: bool = False,
+              join_fn, location_detection=None,
               out_size_hint=None, dense_right_index=None) -> DIA:
-    """``out_size_hint``: optional per-worker upper bound on match
+    """``location_detection``: None (default) lets the plan-time cost
+    model decide whether to pre-filter both sides by cross-side key
+    presence before the shuffle (core/preshuffle.py; forced by
+    THRILL_TPU_LOCATION_DETECT=0/1); True/False force it per call like
+    the reference's LocationDetectionTag.
+
+    ``out_size_hint``: optional per-worker upper bound on match
     count; lets the device path skip its blocking size sync. A wrong
     hint is SAFE: overflow is detected before any consumer reads the
     columns and the join phase transparently re-runs without the hint

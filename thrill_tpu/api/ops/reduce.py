@@ -32,9 +32,9 @@ from ..dia_base import DIABase
 from ...parallel.mesh import AXIS
 
 
-# register array size for device DuplicateDetection (collisions only
-# cause unnecessary shuffling, never wrong results)
-_DUP_REGISTERS = 1 << 17
+# device DuplicateDetection registers are sized per site by
+# core/preshuffle.register_width (collisions only cause unnecessary
+# shuffling, never wrong results)
 
 
 def _device_fold_specs(reduce_fn, treedef, leaves):
@@ -341,7 +341,7 @@ class ReduceNode(DIABase):
 
     def __init__(self, ctx, link, key_fn: Callable, reduce_fn: Callable,
                  label: str = "ReduceByKey",
-                 dup_detection: bool = False, token=None) -> None:
+                 dup_detection=None, token=None) -> None:
         super().__init__(ctx, label, [link])
         self.key_fn = key_fn
         self.reduce_fn = reduce_fn
@@ -351,7 +351,8 @@ class ReduceNode(DIABase):
         # iteration.
         self.token = token if token is not None else (key_fn, reduce_fn)
         # reference: DuplicateDetectionTag, api/reduce_by_key.hpp — skip
-        # shuffling keys whose hash is globally unique (host path)
+        # shuffling keys whose hash is globally unique. None = decided
+        # by the plan-time cost model (core/preshuffle.py)
         self.dup_detection = dup_detection
 
     def _fuse_segment(self, phase: str):
@@ -426,7 +427,20 @@ class ReduceNode(DIABase):
         key_fn, reduce_fn = self.key_fn, self.reduce_fn
         token = self.token
         W = self.context.num_workers
+        mex = self.context.mesh_exec
         dup = self.dup_detection
+        if dup is None and W > 1:
+            # plan-time cost model (core/preshuffle.py): presence-
+            # register psum bytes vs the pre-reduced rows expected to
+            # stay local. The pre-phase cap is globally agreed, so the
+            # verdict is deterministic across controllers.
+            from ...core import preshuffle
+            import jax as _jax
+            item_bytes = exchange.leaf_item_bytes(
+                _jax.tree.leaves(pre.tree))
+            dup = preshuffle.auto_dup_detect(
+                mex, pre.cap * W, item_bytes, ("reduce_dup", token))
+        dup = bool(dup)
         # shuffle by key hash (reference: Mix/CatStream exchange).
         # With DuplicateDetection, globally-unique key hashes skip the
         # shuffle: a register psum inside the destination program finds
@@ -434,7 +448,11 @@ class ReduceNode(DIABase):
         # (reference: core/duplicate_detection.hpp:46 — the Golomb-coded
         # register exchange becomes one psum over a [M] register array).
         if W > 1:
-            M = _DUP_REGISTERS if dup else 0
+            if dup:
+                from ...core import preshuffle
+                M = preshuffle.register_width(pre.cap * W)
+            else:
+                M = 0
 
             def dest(tree, mask, widx):
                 words = keymod.encode_key_words(key_fn(tree))
@@ -443,12 +461,20 @@ class ReduceNode(DIABase):
                 if not dup:
                     return hash_dest
                 reg = (h % jnp.uint64(M)).astype(jnp.int32)
-                local = jnp.zeros(M, jnp.int32).at[reg].add(
-                    mask.astype(jnp.int32))
-                glob = lax.psum(local, AXIS)
-                # register count == my count -> no other worker holds
-                # this hash: the post-phase combine is a local no-op
-                mine_only = jnp.take(glob, reg) == jnp.take(local, reg)
+                # presence (not item counts): a worker contributes 0/1
+                # per register, so the psum'd holder count fits u8 for
+                # W < 256 — a quarter of the i32 registers' fabric
+                # bytes, same verdict ("exactly one worker holds this
+                # hash, and it is me"). Wider meshes keep i32: a u8
+                # psum would WRAP (257 holders reads as 1) and silently
+                # keep colliding keys local — wrong results, not just
+                # extra traffic.
+                reg_dt = jnp.uint8 if W < 256 else jnp.int32
+                local = jnp.zeros(M, reg_dt).at[reg].max(
+                    mask.astype(reg_dt))
+                holders = lax.psum(local, AXIS)
+                mine_only = (jnp.take(holders, reg) == 1) & \
+                    (jnp.take(local, reg) == 1)
                 return jnp.where(mine_only, widx.astype(jnp.int32),
                                  hash_dest)
 
@@ -460,7 +486,7 @@ class ReduceNode(DIABase):
                 # overlap, api/reduce_by_key.hpp:142-168, over
                 # MixStream's arbitrary-order delivery)
                 return fusion.wrap(
-                    self._compute_device_stream(pre, dest, token))
+                    self._compute_device_stream(pre, dest, token, dup))
             pre = exchange.exchange(pre, dest,
                                     ("reduce_dest", token, W, dup))
         # post-phase: final combine (reference: ReduceByHashPostPhase);
@@ -474,7 +500,8 @@ class ReduceNode(DIABase):
         return fusion.wrap(
             _local_reduce_device(pre, key_fn, reduce_fn, "post", token))
 
-    def _compute_device_stream(self, pre: DeviceShards, dest, token):
+    def _compute_device_stream(self, pre: DeviceShards, dest, token,
+                               dup: bool = False):
         """Streamed post-phase: per-round receive + incremental fold.
 
         Every yielded round block is folded by ONE jitted program
@@ -496,7 +523,7 @@ class ReduceNode(DIABase):
         W = self.context.num_workers
         levels: List[Optional[DeviceShards]] = []
         for block in exchange.exchange_stream(
-                pre, dest, ("reduce_dest", token, W, self.dup_detection)):
+                pre, dest, ("reduce_dest", token, W, dup)):
             # round blocks carry pre-reduced (unique-key) rows, so any
             # block IS a valid partial accumulator
             cur = block
@@ -551,7 +578,16 @@ class ReduceNode(DIABase):
         pre_hashes = [[hashing.stable_host_hash(k) for k, _ in entries]
                       for entries in pre_entries]
         non_unique = None
-        if self.dup_detection and W > 1:
+        dup = self.dup_detection
+        if dup is None:
+            # host path: exact local entry counts feed the cost model
+            # (auto resolves OFF multi-controller — local counts are
+            # not globally agreed, core/preshuffle.py)
+            from ...core import preshuffle
+            rows = sum(len(h) for h in pre_hashes)
+            dup = preshuffle.auto_dup_detect(
+                mex, rows, 32, ("reduce_dup_host", self.token))
+        if dup and W > 1:
             from ...core import duplicate_detection as dd
             hash_lists = pre_hashes
             if multiplexer.multiprocess(mex):
@@ -627,7 +663,7 @@ class ReduceNode(DIABase):
 
 
 def ReduceByKey(dia: DIA, key_fn: Callable, reduce_fn: Callable,
-                dup_detection: bool = False) -> DIA:
+                dup_detection=None) -> DIA:
     return DIA(ReduceNode(dia.context, dia._link(), key_fn, reduce_fn,
                           dup_detection=dup_detection))
 

@@ -48,6 +48,24 @@ def cap_cache_enabled() -> bool:
                                            True)
 
 
+def wire_compress_enabled() -> bool:
+    """THRILL_TPU_WIRE_COMPRESS=0 restores the uncompressed wire on
+    BOTH planes bit-identically: host frames ship the raw column codec
+    (net/wire.py emits no compressed tags) and device exchanges ship
+    rows at their declared dtypes (no phase-B narrowing). Master
+    switch of the shrink-the-wire layer."""
+    return _env_flag("THRILL_TPU_WIRE_COMPRESS", True)
+
+
+def xchg_narrow_enabled() -> bool:
+    """THRILL_TPU_XCHG_NARROW=0 disables just the device plane's
+    phase-B row narrowing (data/exchange.py) while the host-frame
+    codec stays on; results are bit-identical either way — narrowing
+    is an exact integer cast chosen from observed ranges."""
+    return wire_compress_enabled() and _env_flag(
+        "THRILL_TPU_XCHG_NARROW", True)
+
+
 def parse_si_iec_units(s: str) -> int:
     """Parse '100', '64K', '1Gi', '2GB' style size strings to bytes.
 

@@ -1,0 +1,230 @@
+"""Plan-time pre-shuffle reduction decisions.
+
+The reference enables LocationDetection / DuplicateDetection by opt-in
+template tags (reference: api/inner_join.hpp:161-190 LocationDetectionTag,
+api/reduce_by_key.hpp DuplicateDetectionTag) — the caller must know the
+workload. Here both become COST-MODEL decisions made at plan time, on by
+default whenever the model says the fingerprint traffic is cheaper than
+the rows it is expected to prune:
+
+    est_pruned_row_bytes  >  margin * est_fingerprint_bytes
+
+* est_pruned_row_bytes: global row estimate x item bytes x the expected
+  prune fraction x the off-diagonal share (W-1)/W. The row estimate
+  prefers exact counts (host-known), then the LEARNED per-site padded
+  capacities the capacity-plan cache recorded for this site's exchanges
+  (data/exchange.py _sticky_caps — the PR 6 machinery), then the padded
+  capacity upper bound. The prune fraction starts at a neutral default
+  and is refined per site from observed pre/post counts when a pipeline
+  happens to expose them (no syncs are ever added to learn it).
+* est_fingerprint_bytes: the presence registers crossing the fabric —
+  sides x M bytes (u8 registers; core register width adapts to the row
+  estimate, clamped so small joins pay kilobytes and large joins stop
+  growing at the point false positives are already rare).
+
+Decisions are STICKY per (mesh, site): flipping mid-run would recompile
+the destination programs for nothing. Env overrides force either way:
+THRILL_TPU_LOCATION_DETECT=0/1 and THRILL_TPU_DUP_DETECT=0/1 (unset =
+auto). Multi-controller runs resolve auto to OFF unless the inputs of
+the decision are globally agreed (the device path's padded caps are;
+host-path local counts are not) — a per-process flip would desync the
+collective schedule.
+
+Register fingerprints are PLAN traffic, like the send-count all_gather:
+they are deliberately not counted in ``bytes_on_wire`` (which measures
+the exchange data plane), but the cost model weighs them all the same.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from ..common.config import round_up_pow2
+
+# register-width clamps: below the floor the pmax/psum launch overhead
+# dominates anyway; above the ceiling false positives are already rare
+# (M >= 8x rows -> <~12% spurious keeps) and the register cost would
+# keep growing linearly for no pruning gain
+_REG_MIN = 1 << 12
+_REG_MAX = 1 << 17
+
+# expected prune fraction before a site has taught us anything: half
+# the rows neither match (join) nor collide remotely (reduce) — the
+# neutral prior between WordCount-like (mostly unique) and dense-join
+# workloads
+_DEFAULT_PRUNE_FRAC = 0.5
+
+# enable only when the expected pruned bytes clear the fingerprint
+# cost by this factor (the filter also costs a dispatch; narrow wins
+# are not worth the program-cache entry)
+_MARGIN = 2.0
+
+
+def _env_mode(name: str) -> Optional[bool]:
+    v = os.environ.get(name)
+    if v in (None, "", "auto"):
+        return None
+    return v not in ("0", "off", "false")
+
+
+def location_mode() -> Optional[bool]:
+    """THRILL_TPU_LOCATION_DETECT: 1 forces the join location filter
+    on, 0 off, unset/auto defers to the cost model."""
+    return _env_mode("THRILL_TPU_LOCATION_DETECT")
+
+
+def dup_mode() -> Optional[bool]:
+    """THRILL_TPU_DUP_DETECT: 1 forces ReduceByKey duplicate detection
+    on, 0 off, unset/auto defers to the cost model."""
+    return _env_mode("THRILL_TPU_DUP_DETECT")
+
+
+def register_width(est_rows: int) -> int:
+    """Presence-register count adapted to the global row estimate."""
+    return max(_REG_MIN, min(_REG_MAX,
+                             round_up_pow2(8 * max(int(est_rows), 1))))
+
+
+def record_prune(mex, token, pre_rows: int, post_rows: int) -> None:
+    """Teach the site its observed prune fraction (called only where
+    both counts are already host-known — learning never adds a sync)."""
+    if pre_rows <= 0:
+        return
+    hist = getattr(mex, "_prune_history", None)
+    if hist is None:
+        hist = mex._prune_history = {}
+    frac = max(0.0, min(1.0, 1.0 - post_rows / pre_rows))
+    prev = hist.get(token)
+    hist[token] = frac if prev is None else 0.5 * (prev + frac)
+
+
+def prune_fraction(mex, token) -> float:
+    hist = getattr(mex, "_prune_history", None)
+    if hist is None:
+        return _DEFAULT_PRUNE_FRAC
+    return hist.get(token, _DEFAULT_PRUNE_FRAC)
+
+
+def learned_site_rows(mex, xchg_ident) -> Optional[int]:
+    """Best learned output capacity of the exchange site ``xchg_ident``
+    (the capacity-plan cache's sticky caps, data/exchange.py): what PR 6
+    already knows about this site's steady-state row volume."""
+    caps = getattr(mex, "_sticky_caps", None)
+    if not caps:
+        return None
+    best = None
+    for key, v in caps.items():
+        if (isinstance(key, tuple) and len(key) >= 2
+                and key[0] == "xchg_caps" and key[1] == xchg_ident
+                and len(v) == 2):
+            best = max(best or 0, int(v[1]))
+    return best
+
+
+# every Nth use of a site's remembered verdict re-runs the cost model,
+# so the prune fraction LEARNED after the first decision (record_prune)
+# actually gets a vote — the same periodic-resync pattern the exchange
+# capacity cache uses. A flip costs one extra program compile, bounded
+# by the re-evaluation period.
+_DECIDE_RESYNC_EVERY = 16
+
+
+def _decay_fraction(mex, token) -> None:
+    """Pull a site's learned prune fraction halfway back toward the
+    neutral prior. Observations only arrive while the filter RUNS
+    (record_prune reads counts the filter path exposes) — without
+    decay, a site whose verdict flipped OFF would re-evaluate forever
+    on its frozen last fraction and never probe pruning again even if
+    the workload turned prunable."""
+    hist = getattr(mex, "_prune_history", None)
+    if hist and token in hist:
+        hist[token] = 0.5 * (hist[token] + _DEFAULT_PRUNE_FRAC)
+
+
+def _sticky_decision(mex, kind: str, token, compute) -> bool:
+    store = getattr(mex, "_prune_decisions", None)
+    if store is None:
+        store = mex._prune_decisions = {}
+    key = (kind, token)
+    entry = store.get(key)
+    if entry is None:
+        entry = (bool(compute()), 1)
+    else:
+        verdict, uses = entry
+        if uses % _DECIDE_RESYNC_EVERY == 0:
+            _decay_fraction(mex, token)
+            verdict = bool(compute())
+        entry = (verdict, uses + 1)
+    store[key] = entry
+    return entry[0]
+
+
+def _pays(rows: int, item_bytes: int, W: int, sides: int, M: int,
+          frac: float) -> bool:
+    if W <= 1 or rows <= 0:
+        return False
+    pruned = rows * item_bytes * frac * (W - 1) / W
+    fingerprint = sides * M                     # u8 registers
+    return pruned > _MARGIN * fingerprint
+
+
+def auto_location_detect(mex, rows_global: int, item_bytes: int,
+                         token) -> bool:
+    """Cost-model verdict for the join location filter (device path).
+    ``rows_global`` is the caller's best row estimate (exact counts >
+    learned site caps > padded upper bound)."""
+    forced = location_mode()
+    if forced is not None:
+        return forced
+    if getattr(mex, "num_processes", 1) > 1:
+        return False                            # see module docstring
+
+    def compute():
+        W = mex.num_workers
+        M = register_width(rows_global)
+        return _pays(rows_global, item_bytes, W, sides=2, M=M,
+                     frac=prune_fraction(mex, token))
+    return _sticky_decision(mex, "ld", token, compute)
+
+
+def auto_dup_detect(mex, rows_global: int, item_bytes: int,
+                    token) -> bool:
+    """Cost-model verdict for ReduceByKey duplicate detection: keep
+    globally-unique keys local instead of shuffling them."""
+    forced = dup_mode()
+    if forced is not None:
+        return forced
+    if getattr(mex, "num_processes", 1) > 1:
+        return False
+
+    def compute():
+        W = mex.num_workers
+        M = register_width(rows_global)
+        return _pays(rows_global, item_bytes, W, sides=1, M=M,
+                     frac=prune_fraction(mex, token))
+    return _sticky_decision(mex, "dup", token, compute)
+
+
+def join_rows_estimate(mex, left, right, token_l, token_r) -> Tuple[int,
+                                                                    int]:
+    """(rows_global, item_bytes) for a device join's decision: exact
+    host-known counts when present, else the learned exchange-site
+    capacities, else the padded capacity bound."""
+    import numpy as np
+
+    def side_rows(shards, ident):
+        counts = getattr(shards, "_counts_host", None)
+        if counts is not None:
+            return int(np.asarray(counts).sum())
+        learned = learned_site_rows(mex, ident)
+        if learned is not None:
+            return learned * mex.num_workers
+        return shards.cap * mex.num_workers
+
+    rows = side_rows(left, token_l) + side_rows(right, token_r)
+    from ..data.exchange import leaf_item_bytes
+    import jax
+    bytes_l = leaf_item_bytes(jax.tree.leaves(left.tree))
+    bytes_r = leaf_item_bytes(jax.tree.leaves(right.tree))
+    return rows, max((bytes_l + bytes_r) // 2, 1)

@@ -62,7 +62,7 @@ from jax import lax
 
 from ..common import faults
 from ..common.config import (cap_cache_enabled, overlap_enabled,
-                             round_up_pow2)
+                             round_up_pow2, xchg_narrow_enabled)
 from ..common.partition import dense_range_bounds
 from ..common.retry import default_policy
 from ..parallel.mesh import AXIS, MeshExec
@@ -72,6 +72,129 @@ from .shards import DeviceShards
 # BEFORE the chunk program launches (nothing dispatched yet), so a
 # transient retry is safe — mirrors the fused per-op site discipline
 _F_CHUNK = faults.declare("data.exchange.chunk")
+# row-narrowing injection: fires before a learned narrow spec is
+# applied to a phase-B dispatch; an armed fire DEGRADES that exchange
+# to full-width rows (narrowing is a pure byte optimization — shipping
+# wide is always correct), never a wrong result
+_F_PACK = faults.declare("data.exchange.pack")
+
+
+# ----------------------------------------------------------------------
+# phase-B row narrowing (dtype/range analysis)
+# ----------------------------------------------------------------------
+# Integer leaves whose observed [min, max] fits a narrower dtype cross
+# the fabric as that dtype: phase A all-reduces per-leaf ranges on
+# device (no extra sync — the synced plan step reads them alongside the
+# send matrix, and the optimistic path trusts the spec LEARNED from
+# past synced runs, guarded by an in-trace range check riding the
+# existing deferred overflow flag). Narrow specs, like capacities, only
+# ever WIDEN for a site, so steady-state executables are reused.
+
+
+def _narrowable_leaves(leaves) -> Tuple[int, ...]:
+    """Leaf indices eligible for range analysis: integer dtypes wider
+    than one byte (floats never narrow — NaN/rounding would break bit
+    parity; sub-byte ints have nothing to gain)."""
+    return tuple(i for i, l in enumerate(leaves)
+                 if np.dtype(l.dtype).kind in "iu"
+                 and np.dtype(l.dtype).itemsize >= 2)
+
+
+def _spec_from_ranges(mex: MeshExec, cap_ident: Tuple, leaves,
+                      nidx: Tuple[int, ...],
+                      ranges: Optional[np.ndarray]):
+    """Sticky (widen-only) narrow spec for this site: merge the fetched
+    per-leaf ranges into the remembered union and derive the narrow
+    dtype per leaf. Returns a tuple of dtype-str-or-None per LEAF (not
+    per narrowable leaf), or None when nothing narrows."""
+    if ranges is None or not nidx:
+        return None
+    store = getattr(mex, "_sticky_ranges", None)
+    if store is None:
+        store = mex._sticky_ranges = {}
+    prev = store.get(cap_ident)
+    merged = []
+    for j, li in enumerate(nidx):
+        lo, hi = int(ranges[j, 0]), int(ranges[j, 1])
+        dt = np.dtype(leaves[li].dtype)
+        if dt.kind == "u" and (lo < 0 or hi < 0):
+            # u64 value past int64.max wrapped negative in the range
+            # output: unrepresentable — poison the leaf's range so it
+            # never narrows
+            lo, hi = np.iinfo(np.int64).min, np.iinfo(np.int64).max
+        if lo > hi:                       # empty shard: no information
+            if prev is not None and prev[j] is not None:
+                lo, hi = prev[j]
+            else:
+                merged.append(None)
+                continue
+        elif prev is not None and prev[j] is not None:
+            lo, hi = min(lo, prev[j][0]), max(hi, prev[j][1])
+        merged.append((lo, hi))
+    store[cap_ident] = tuple(merged)
+    from ..net.wire import narrow_dtype
+    spec: list = [None] * len(leaves)
+    any_narrow = False
+    for j, li in enumerate(nidx):
+        if merged[j] is None:
+            continue
+        nd = narrow_dtype(merged[j][0], merged[j][1],
+                          np.dtype(leaves[li].dtype).itemsize)
+        if nd is not None:
+            spec[li] = nd.str
+            any_narrow = True
+    return tuple(spec) if any_narrow else None
+
+
+def _sticky_spec(mex: MeshExec, cap_ident: Tuple, leaves):
+    """Narrow spec for an OPTIMISTIC dispatch: derived purely from the
+    site's remembered range union (no fetch). The in-trace guard in
+    chunk 0 catches data that outgrew the learned ranges and routes
+    the exchange to the synced heal, which re-learns them."""
+    store = getattr(mex, "_sticky_ranges", None)
+    if store is None:
+        return None
+    prev = store.get(cap_ident)
+    if prev is None:
+        return None
+    nidx = _narrowable_leaves(leaves)
+    from ..net.wire import narrow_dtype
+    spec: list = [None] * len(leaves)
+    any_narrow = False
+    for j, li in enumerate(nidx):
+        if j >= len(prev) or prev[j] is None:
+            continue
+        nd = narrow_dtype(prev[j][0], prev[j][1],
+                          np.dtype(leaves[li].dtype).itemsize)
+        if nd is not None:
+            spec[li] = nd.str
+            any_narrow = True
+    return tuple(spec) if any_narrow else None
+
+
+def _pack_degraded(spec):
+    """data.exchange.pack injection gate: an armed fire drops the
+    narrow spec for THIS exchange (full-width rows — always correct),
+    mirroring the degrade-never-wrong discipline of mem.estimate."""
+    if spec is None or not faults.REGISTRY.active():
+        return spec
+    try:
+        faults.check(_F_PACK)
+    except faults.InjectedFault:
+        faults.note("recovery", what="xchg.pack_degrade")
+        return None
+    return spec
+
+
+def _narrow_item_bytes(leaves, spec) -> int:
+    """Per-item fabric bytes under a narrow spec (None = full width)."""
+    total = 0
+    for i, l in enumerate(leaves):
+        isz = (np.dtype(spec[i]).itemsize
+               if spec is not None and spec[i] is not None
+               else np.dtype(l.dtype).itemsize)
+        total += isz * int(np.prod(l.shape[2:], dtype=np.int64))
+    return total
 
 
 def _ex_cumsum(x):
@@ -147,12 +270,22 @@ def exchange_presorted(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
 
 
 def _phase_a(shards: DeviceShards, dest_builder: Callable,
-             cache_key: Tuple):
+             cache_key: Tuple, want_ranges: bool = True):
     """Phase A: destination, local dest-sort, send counts. Returns
-    (treedef, sorted_dest, sorted_leaves, send_mat) with the [W, W]
-    send matrix REPLICATED ON DEVICE — whether the planner syncs it to
-    the host (classic path) or dispatches phase B straight off it
-    (optimistic capacity-cache path) is the caller's decision."""
+    (treedef, sorted_dest, sorted_leaves, send_mat, range_mat) with the
+    [W, W] send matrix REPLICATED ON DEVICE — whether the planner syncs
+    it to the host (classic path) or dispatches phase B straight off it
+    (optimistic capacity-cache path) is the caller's decision.
+
+    ``range_mat`` ([L, 2] int64, replicated; None when no leaf is
+    narrowable or narrowing is off) carries the all-reduced [min, max]
+    of every integer leaf's valid items — the dtype/range analysis the
+    phase-B row narrowing feeds on. Computing it here costs two
+    reductions per leaf inside a program that already sorts the shard;
+    whether anything READS it (the synced plan step, or an optimistic
+    miss heal) is again the caller's decision. Callers whose phase B
+    never narrows (the streamed rounds) pass ``want_ranges=False`` and
+    skip the analysis entirely."""
     mex = shards.mesh_exec
     # an upstream optimistic exchange may still owe its overflow check:
     # heal it before this program bakes the (possibly truncated)
@@ -161,7 +294,16 @@ def _phase_a(shards: DeviceShards, dest_builder: Callable,
     W = mex.num_workers
     cap = shards.cap
     leaves, treedef = jax.tree.flatten(shards.tree)
-    key_a = ("xchg_a", cache_key, cap, treedef,
+    # Narrowing pays on VOLUME: W=1 exchanges move nothing, and a
+    # kilobyte shuffle saves less than the range analysis adds to its
+    # phase-A compile — the same worth-it policy as phase-B chunking.
+    # The gate is deterministic across processes (cap/W/dtypes are
+    # globally agreed shapes).
+    narrow_worth = (want_ranges and W > 1 and xchg_narrow_enabled()
+                    and W * cap * leaf_item_bytes(leaves)
+                    >= _NARROW_MIN_BYTES)
+    nidx = _narrowable_leaves(leaves) if narrow_worth else ()
+    key_a = ("xchg_a", cache_key, cap, treedef, nidx,
              tuple((l.dtype, l.shape[2:]) for l in leaves))
 
     def build_a():
@@ -180,19 +322,51 @@ def _phase_a(shards: DeviceShards, dest_builder: Callable,
             # replicate the [W, W] send-count matrix: every process can
             # then fetch it locally (multi-controller safe host step)
             all_send = send_counts(sorted_dest, W)
-            return (sorted_dest[None], all_send,
+            outs = (sorted_dest[None], all_send,
                     *[sl[None] for sl in sorted_ls])
+            if nidx:
+                i64max = np.iinfo(np.int64).max
+                rows = []
+                for li in nidx:
+                    x = ls[li][0]
+                    info = jnp.iinfo(x.dtype)
+                    smax = info.max
+                    if x.dtype == jnp.uint64:
+                        # clamp values AND the empty-shard sentinel
+                        # BEFORE the int64 cast: u64 quantities past
+                        # int64.max would wrap negative and corrupt
+                        # the pmin — clamped they saturate at
+                        # int64.max, which correctly reads as "cannot
+                        # narrow" without poisoning the leaf's sticky
+                        # range when a shard merely happened to be
+                        # empty
+                        x = jnp.minimum(x, jnp.uint64(i64max))
+                        smax = i64max
+                    m = mask.reshape((-1,) + (1,) * (x.ndim - 1))
+                    lo = lax.pmin(jnp.min(jnp.where(m, x, smax))
+                                  .astype(jnp.int64), AXIS)
+                    hi = lax.pmax(jnp.max(jnp.where(m, x, info.min))
+                                  .astype(jnp.int64), AXIS)
+                    rows.append(jnp.stack([lo, hi]))
+                outs = outs + (jnp.stack(rows),)
+            return outs
 
         from jax.sharding import PartitionSpec as P
-        return mex.smap(fa, 1 + len(leaves),
-                        out_specs=(P(AXIS), P()) +
-                        (P(AXIS),) * len(leaves))
+        out_specs = (P(AXIS), P()) + (P(AXIS),) * len(leaves)
+        if nidx:
+            out_specs = out_specs + (P(),)
+        return mex.smap(fa, 1 + len(leaves), out_specs=out_specs)
 
     fa = mex.cached(key_a, build_a)
     out_a = fa(shards.counts_device(), *leaves)
     sorted_dest, send_mat = out_a[0], out_a[1]
-    sorted_leaves = list(out_a[2:])
-    return treedef, sorted_dest, sorted_leaves, send_mat
+    if nidx:
+        sorted_leaves = list(out_a[2:-1])
+        range_mat = out_a[-1]
+    else:
+        sorted_leaves = list(out_a[2:])
+        range_mat = None
+    return treedef, sorted_dest, sorted_leaves, send_mat, range_mat
 
 
 def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
@@ -211,8 +385,14 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
     retained phase-A output (lineage-level, never wrong data).
     """
     mex = shards.mesh_exec
-    treedef, sorted_dest, sorted_leaves, send_mat = _phase_a(
-        shards, dest_builder, cache_key)
+    # a loop capture is recording: leaf ranges are VALUES of loop data
+    # (carry-dependent), so reading them would taint the tape —
+    # captured exchanges ship full-width rows, and the capture-time
+    # phase A skips the analysis entirely so the replayed tape carries
+    # no dead per-iteration range reductions
+    treedef, sorted_dest, sorted_leaves, send_mat, range_mat = _phase_a(
+        shards, dest_builder, cache_key,
+        want_ranges=mex.loop_recorder is None)
     if mex.num_workers > 1:
         cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
         cap_ident = _dense_cap_ident(cache_key, cap, treedef,
@@ -221,11 +401,16 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
         if caps is not None:
             return _exchange_optimistic(
                 mex, treedef, sorted_dest, sorted_leaves, send_mat,
-                caps, ident=cache_key, min_cap=min_cap)
+                caps, ident=cache_key, min_cap=min_cap,
+                range_mat=range_mat)
     S = mex.fetch(send_mat)                       # [W, W] S[w, d]
+    # the tiny [L, 2] range matrix rides the SAME host-sync window as
+    # the send matrix (raw transfer: one logical plan sync, not a
+    # second counted mid-pipeline fetch)
+    ranges = None if range_mat is None else mex._fetch_raw(range_mat)
     return _exchange_planned(mex, treedef, sorted_dest, sorted_leaves, S,
                              min_cap=min_cap, ident=cache_key,
-                             smat_dev=send_mat)
+                             smat_dev=send_mat, ranges=ranges)
 
 
 def exchange_stream(shards: DeviceShards, dest_builder: Callable,
@@ -244,8 +429,9 @@ def exchange_stream(shards: DeviceShards, dest_builder: Callable,
     """
     mex = shards.mesh_exec
     W = mex.num_workers
-    treedef, sorted_dest, sorted_leaves, send_mat = _phase_a(
-        shards, dest_builder, cache_key)
+    # streamed rounds ship full-width by design — skip range analysis
+    treedef, sorted_dest, sorted_leaves, send_mat, _ = _phase_a(
+        shards, dest_builder, cache_key, want_ranges=False)
     S = mex.fetch(send_mat)   # per-round caps genuinely need the host S
     account_traffic(mex, S, leaf_item_bytes(sorted_leaves))
     cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
@@ -263,8 +449,10 @@ def exchange_stream(shards: DeviceShards, dest_builder: Callable,
     caps = _sticky_caps(mex, cap_ident, needed)
     mex.stats_padded_rows += sum(caps)
     # identity round is a local scatter; rounds 1.. cross the fabric
-    mex.stats_bytes_wire_device += (
-        W * sum(caps[1:]) * leaf_item_bytes(sorted_leaves))
+    # (streamed rounds ship full-width: no narrowing on this path)
+    stream_bytes = W * sum(caps[1:]) * leaf_item_bytes(sorted_leaves)
+    mex.stats_bytes_wire_device += stream_bytes
+    mex.stats_bytes_wire_device_raw += stream_bytes
 
     srow = mex.put_small(S.astype(np.int32))
 
@@ -506,6 +694,10 @@ def _chunk_count(mex: MeshExec, W: int, M_pad: int,
 
 _CHUNK_DEFAULT = 4
 _CHUNK_MIN_BYTES = 1 << 20
+# minimum padded exchange volume (W * cap * item bytes) for phase-A
+# range analysis + phase-B narrowing: below this the compile-time cost
+# of the analysis exceeds what thinner rows could ever save
+_NARROW_MIN_BYTES = 1 << 15
 # every Nth use of a cached capacity plan takes the synced path anyway,
 # so a site whose data turned skewed after warmup regains the 1-factor
 # plan within N exchanges instead of never (perf-only: the overflow
@@ -554,7 +746,7 @@ def _optimistic_ok(mex: MeshExec, cap_ident: Tuple,
 
 
 def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
-                      smat, M_pad: int, out_cap: int):
+                      smat, M_pad: int, out_cap: int, narrow=None):
     """The dense phase-B program(s): K row-range chunk dispatches over
     a shared output accumulator, all plan values derived IN-TRACE from
     the replicated [W, W] send matrix ``smat``.
@@ -571,6 +763,18 @@ def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     functions of ``smat`` alone), so the optimistic path's deferred
     check blocks only until chunk 0 lands — chunks 1..K-1 and the
     consumer's next program keep overlapping.
+
+    ``narrow`` (per-leaf dtype-str or None) ships eligible integer
+    leaves across the fabric as their narrowed dtype — the cast is
+    exact for in-range values, so results stay bit-identical; the
+    scatter accumulator holds the narrow form and widens once, at the
+    last chunk. Chunk 0's overflow flag then ALSO checks in-trace that
+    every valid value fits its narrow dtype: synced plans derive the
+    spec from the current data (the check can only pass), optimistic
+    dispatches run on the LEARNED spec and data that outgrew it routes
+    to the synced heal instead of truncating. One program serves both
+    paths — a separate guarded twin would double every site's phase-B
+    compiles for a check that costs two reductions.
 
     Returns (out_leaves, counts_dev [W, 1] int32, flag [1] int32).
     """
@@ -589,7 +793,7 @@ def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     def chunk_program(lo: int, hi: int, first: bool, last: bool):
         M_j = hi - lo
         key = ("xchg_chunk", cap, M_pad, out_cap, lo, hi, first, last,
-               W, treedef, leafsig)
+               W, treedef, leafsig, narrow)
 
         def build():
             def f(sdest, smat_a, *ls):
@@ -619,9 +823,24 @@ def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                 pack = rowmove.enabled()
                 srcs, accs = ls[:n_leaves], ls[n_leaves:]
                 outs = []
+                range_bad = jnp.zeros((), jnp.int32)
                 for li, l in enumerate(srcs):
-                    x, m = rowmove.pack_rows(l[0]) if pack \
-                        else (l[0], None)
+                    xw = l[0]
+                    nd = narrow[li] if narrow is not None else None
+                    if nd is not None:
+                        if first:
+                            info = np.iinfo(np.dtype(nd))
+                            v = d < W
+                            vm = v.reshape((-1,) + (1,)
+                                           * (xw.ndim - 1))
+                            oob = vm & ((xw < info.min)
+                                        | (xw > info.max))
+                            range_bad = jnp.maximum(
+                                range_bad,
+                                jnp.max(oob.astype(jnp.int32)))
+                        xw = xw.astype(np.dtype(nd))
+                    x, m = rowmove.pack_rows(xw) if pack \
+                        else (xw, None)
                     recv = ship_blocks(x, send_idx, W, M_j)
                     if first:
                         acc = jnp.zeros((out_cap + 1,) + x.shape[1:],
@@ -630,8 +849,10 @@ def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                         acc = accs[li][0]
                     acc = acc.at[pos].set(recv)
                     if last:
-                        outs.append(rowmove.unpack_rows(
-                            acc[:out_cap], m)[None])
+                        wide = rowmove.unpack_rows(acc[:out_cap], m)
+                        if nd is not None:
+                            wide = wide.astype(l.dtype)
+                        outs.append(wide[None])
                     else:
                         outs.append(acc[None])
                 if not first:
@@ -640,7 +861,14 @@ def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                 ovf = jnp.logical_or(
                     smat_a.max() > M_pad,
                     smat_a.sum(axis=0).max() > out_cap)
-                return (cnt, ovf.astype(jnp.int32).reshape(1), *outs)
+                ovf = ovf.astype(jnp.int32)
+                if narrow is not None:
+                    # values past the narrow ranges spoil the cast on
+                    # SOME worker: replicate the verdict so the
+                    # deferred check sees it wherever it drains
+                    ovf = jnp.maximum(ovf,
+                                      lax.pmax(range_bad, AXIS))
+                return (cnt, ovf.reshape(1), *outs)
 
             na = 2 + n_leaves + (0 if first else n_leaves)
             in_specs = (P(AXIS), P()) + (P(AXIS),) * (na - 2)
@@ -679,25 +907,44 @@ def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
             call = fn.donating(acc_pos) if donate and acc_pos else fn
             accs = list(call(sorted_dest, smat, *sorted_leaves, *accs))
     mex.stats_padded_rows += W * M_pad
-    mex.stats_bytes_wire_device += W * (W - 1) * M_pad * item_bytes
+    # wire truth vs raw equivalent: narrowed rows cross the fabric at
+    # their cast width; the raw counter records what full-width rows
+    # would have shipped (wire_compress_ratio's denominator)
+    wire_rows = W * (W - 1) * M_pad
+    mex.stats_bytes_wire_device += wire_rows * _narrow_item_bytes(
+        sorted_leaves, narrow)
+    mex.stats_bytes_wire_device_raw += wire_rows * item_bytes
     return accs, counts_dev, flag
 
 
 def _exchange_optimistic(mex: MeshExec, treedef, sorted_dest,
                          sorted_leaves, send_mat, caps: Tuple[int, int],
-                         ident: Tuple, min_cap: int = 1) -> DeviceShards:
+                         ident: Tuple, min_cap: int = 1,
+                         range_mat=None) -> DeviceShards:
     """Phase B on the CACHED capacity plan: no host sync, counts come
     back device-resident, and a deferred check (drained at the next
     consumer boundary / host realization, like the hinted-join
     overflow) verifies the cached capacities actually held — on a miss
     the exchange re-runs from the retained phase-A output under the
-    freshly synced plan and heals the shards in place."""
+    freshly synced plan and heals the shards in place.
+
+    Row narrowing rides the same optimism: the spec LEARNED from past
+    synced runs narrows this dispatch, and chunk 0's flag verifies
+    every value still fits it — data that outgrew the learned ranges
+    is a miss like any other, healed by the synced re-run (which
+    re-reads the device ranges and widens the sticky spec)."""
     M_pad, out_cap = caps
     W = mex.num_workers
     item_bytes = leaf_item_bytes(sorted_leaves)
+    cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
+    cap_ident = _dense_cap_ident(ident, cap, treedef, sorted_leaves)
+    narrow = None
+    if range_mat is not None:
+        narrow = _pack_degraded(
+            _sticky_spec(mex, cap_ident, sorted_leaves))
     out_leaves, counts_dev, flag = _dispatch_chunked(
         mex, treedef, sorted_dest, sorted_leaves, send_mat, M_pad,
-        out_cap)
+        out_cap, narrow=narrow)
     tree = jax.tree.unflatten(treedef, out_leaves)
     shards = DeviceShards(mex, tree, counts_dev)
 
@@ -713,15 +960,19 @@ def _exchange_optimistic(mex: MeshExec, treedef, sorted_dest,
             account_traffic(mex, S, item_bytes, overlapped=True,
                             cap_hit=True)
             return None
-        # capacity miss: the cached plan truncated — re-run phases
-        # host+B from the retained phase-A output (the synced plan
-        # grows the sticky caps, so the NEXT run hits again)
+        # capacity (or narrow-range) miss: the cached plan truncated —
+        # re-run phases host+B from the retained phase-A output (the
+        # synced plan grows the sticky caps and re-learns the ranges,
+        # so the NEXT run hits again)
         mex.stats_cap_cache_misses += 1
         faults.note("recovery", what="xchg.capacity_miss",
                     cached=(M_pad, out_cap))
+        ranges = (None if range_mat is None
+                  else mex._fetch_raw(range_mat))
         healed = _exchange_planned(mex, treedef, sorted_dest,
                                    sorted_leaves, S, min_cap=min_cap,
-                                   ident=ident, smat_dev=send_mat)
+                                   ident=ident, smat_dev=send_mat,
+                                   ranges=ranges)
         shards.tree = healed.tree
         return healed.counts
 
@@ -746,12 +997,18 @@ def _exchange_optimistic(mex: MeshExec, treedef, sorted_dest,
 def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                       S: np.ndarray, min_cap: int = 1,
                       ident: Tuple = (),
-                      smat_dev: Optional[Any] = None) -> DeviceShards:
+                      smat_dev: Optional[Any] = None,
+                      ranges: Optional[np.ndarray] = None
+                      ) -> DeviceShards:
     """Phases host+B given phase-A output (also used by scatter paths).
 
     ``smat_dev`` is the replicated device copy of ``S`` when phase A
     produced one (saves the plan upload); callers with a host-only
-    plan (Sort's presorted entry) leave it None."""
+    plan (Sort's presorted entry) leave it None. ``ranges`` is the
+    fetched [L, 2] per-leaf min/max when phase A computed it — the
+    narrow spec derived from it (union'd with the site's remembered
+    ranges, so it covers the current data by construction) ships the
+    padded rows at their narrowed widths."""
     W = mex.num_workers
     cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
     R = S.sum(axis=0)                             # recv totals per worker
@@ -782,10 +1039,14 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
         mex, cap_ident,
         (max(int(S.max()), 1), max(int(R.max()), min_cap, 1)))
     mex._xchg_plan[cap_ident] = "dense"
+    narrow = _pack_degraded(_spec_from_ranges(
+        mex, cap_ident, sorted_leaves,
+        _narrowable_leaves(sorted_leaves), ranges))
     smat = smat_dev if smat_dev is not None else \
         mex.put_small(S.astype(np.int32), replicated=True)
     out_leaves, _counts_dev, _flag = _dispatch_chunked(
-        mex, treedef, sorted_dest, sorted_leaves, smat, M_pad, out_cap)
+        mex, treedef, sorted_dest, sorted_leaves, smat, M_pad, out_cap,
+        narrow=narrow)
     tree = jax.tree.unflatten(treedef, out_leaves)
     return DeviceShards(mex, tree, new_counts)
 
@@ -816,8 +1077,9 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     caps = _sticky_caps(mex, cap_ident, needed)
     M_rounds, out_cap = caps[:-1], caps[-1]
     mex.stats_padded_rows += sum(M_rounds)
-    mex.stats_bytes_wire_device += (
-        W * sum(M_rounds) * leaf_item_bytes(sorted_leaves))
+    of_bytes = W * sum(M_rounds) * leaf_item_bytes(sorted_leaves)
+    mex.stats_bytes_wire_device += of_bytes
+    mex.stats_bytes_wire_device_raw += of_bytes
 
     key_b = ("xchg_of", cap, M_rounds, out_cap, mex.num_slices, treedef,
              tuple((l.dtype, l.shape[2:]) for l in sorted_leaves))
@@ -935,9 +1197,10 @@ def _exchange_ragged(mex: MeshExec, treedef, sorted_leaves, S: np.ndarray,
     R = S.sum(axis=0)
     new_counts = R.astype(np.int64)
     # ragged ships exactly the off-diagonal items — no padding tax
-    mex.stats_bytes_wire_device += (
-        (int(S.sum()) - int(np.trace(S)))
-        * leaf_item_bytes(sorted_leaves))
+    ragged_bytes = ((int(S.sum()) - int(np.trace(S)))
+                    * leaf_item_bytes(sorted_leaves))
+    mex.stats_bytes_wire_device += ragged_bytes
+    mex.stats_bytes_wire_device_raw += ragged_bytes
     out_cap = round_up_pow2(max(int(R.max()), min_cap, 1))
     key = ("xchg_ragged", out_cap, treedef,
            tuple((l.dtype, l.shape[1:]) for l in sorted_leaves))

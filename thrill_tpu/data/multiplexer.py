@@ -86,16 +86,13 @@ def _send_queue_depth() -> int:
 
 
 def _frame_bytes(msg: Any) -> int:
-    """Serialized size of one frame — what the TCP plane actually puts
-    on the wire (net/wire.py is the transport's framing codec). The
-    ``bytes_on_wire`` baseline for ROADMAP's shrink-the-wire item.
-
-    Cost note: this re-serializes the frame purely to measure it (the
-    transport serializes again inside ``send_to``). The async sender
-    pays it on the background thread, off the send critical path; the
-    serial (opt-out) plane pays it inline. Folding the accounting into
-    the transport, where the serialized parts already exist, is part
-    of the shrink-the-wire ROADMAP item."""
+    """Serialized size of one frame — what the TCP plane would put on
+    the wire (net/wire.py is the transport's framing codec, column
+    compression included). FALLBACK only: the TCP transport reports
+    its serialized byte count from ``send`` itself (counted once,
+    where the frame is encoded); this measurement serialization is
+    paid only on transports that pass objects by reference (the mock
+    test plane) and report None."""
     try:
         from ..net import wire
         return len(wire.dumps(msg, allow_pickle=True))
@@ -103,14 +100,17 @@ def _frame_bytes(msg: Any) -> int:
         return 0
 
 
-def _send_frame(group, peer: int, msg: Any, what: str) -> None:
+def _send_frame(group, peer: int, msg: Any, what: str) -> int:
+    """Send one frame; returns its wire byte count (transport-reported
+    where the transport serializes, else measured here once)."""
     if not faults.REGISTRY.active():     # disarmed hot path: direct
-        return group.send_to(peer, msg)
-
-    def op():
-        faults.check(_F_SEND, peer=peer, what=what)
-        group.send_to(peer, msg)
-    default_policy(**_FRAME_RETRY).run(op, what=f"{what}:send")
+        nb = group.send_to(peer, msg)
+    else:
+        def op():
+            faults.check(_F_SEND, peer=peer, what=what)
+            return group.send_to(peer, msg)
+        nb = default_policy(**_FRAME_RETRY).run(op, what=f"{what}:send")
+    return nb if nb is not None else _frame_bytes(msg)
 
 
 def _recv_frame(group, peer: int, what: str) -> Any:
@@ -120,6 +120,20 @@ def _recv_frame(group, peer: int, what: str) -> Any:
     def op():
         faults.check(_F_RECV, peer=peer, what=what)
         return group.recv_from(peer)
+    return default_policy(**_FRAME_RETRY).run(op, what=f"{what}:recv")
+
+
+def _recv_frame_any(group, peers, what: str):
+    """Any-source receive: drain whichever peer's frame lands first
+    (ROADMAP exchange item (d)); returns (peer, msg). The injection
+    site fires BEFORE the receive (nothing consumed), so a transient
+    retry is safe exactly like the per-peer site."""
+    if not faults.REGISTRY.active():
+        return group.recv_any(peers)
+
+    def op():
+        faults.check(_F_RECV, peer=-1, what=what)
+        return group.recv_any(peers)
     return default_policy(**_FRAME_RETRY).run(op, what=f"{what}:recv")
 
 
@@ -215,23 +229,37 @@ def host_exchange(mex, shards: HostShards, dest_fn: Callable[[Any], int],
     wire_bytes = 0
     group = net.group
     use_async = _async_send_enabled() and P > 1
+    mix = _mix_delivery(rank_order)
+    from ..net import wire as _wire
+    csnap = _wire.compress_stats()
     with poison_on_error(group, "host_exchange"):
         if use_async:
             sent_items, wire_bytes = _exchange_frames_async(
-                mex, group, outgoing, received, me, P)
+                mex, group, outgoing, received, me, P, mix)
         else:
             for r in range(1, P):
                 to, frm = (me + r) % P, (me - r) % P
                 sent_items += sum(len(b)
                                   for dws in outgoing[to].values()
                                   for b in dws.values())
-                wire_bytes += _frame_bytes(outgoing[to])
-                _send_frame(group, to, outgoing[to], "host_exchange")
+                # byte accounting rides the transport's own send-path
+                # serialization (ROADMAP exchange item (e): counted
+                # once, where the frame is encoded)
+                wire_bytes += _send_frame(group, to, outgoing[to],
+                                          "host_exchange")
                 received.append(_recv_frame(group, frm,
                                             "host_exchange"))
+    # column-codec savings attributed to this exchange window: raw
+    # bytes the compressed columns held minus what actually shipped.
+    # The counters are process-global, so when several simulated
+    # controllers share one process (the mock test plane) concurrent
+    # windows can cross-attribute each other's savings — a stats-only
+    # imprecision; real deployments run one controller per process
+    _, raw0, out0 = csnap
+    _, raw1, out1 = _wire.compress_stats()
+    saved = max((raw1 - raw0) - (out1 - out0), 0)
 
     lists: List[List[Any]] = [[] for _ in range(W)]
-    mix = _mix_delivery(rank_order)
     for w in mex.local_workers:
         if mix:
             # MixStream: frames in arrival order, each frame's batches
@@ -250,17 +278,21 @@ def host_exchange(mex, shards: HostShards, dest_fn: Callable[[Any], int],
     mex.stats_items_moved += sent_items
     mex.stats_bytes_wire_host = getattr(mex, "stats_bytes_wire_host",
                                         0) + wire_bytes
+    mex.stats_bytes_wire_host_saved = getattr(
+        mex, "stats_bytes_wire_host_saved", 0) + saved
     log = getattr(mex, "logger", None)
     if log is not None and log.enabled:
         log.line(event="host_exchange", reason=reason,
                  items_sent=sent_items, processes=P,
-                 bytes=wire_bytes, mode="mix" if mix else "cat",
+                 bytes=wire_bytes, bytes_saved=saved,
+                 mode="mix" if mix else "cat",
                  async_send=use_async)
     return HostShards(W, lists)
 
 
 def _exchange_frames_async(mex, group, outgoing: List[dict],
-                           received: List[dict], me: int, P: int):
+                           received: List[dict], me: int, P: int,
+                           mix: bool = False):
     """Ship the P-1 outgoing frames from a background sender thread
     (bounded queue) while the main thread drains the P-1 receives.
 
@@ -269,7 +301,15 @@ def _exchange_frames_async(mex, group, outgoing: List[dict],
     convert to fast attributable aborts. The queue bound applies
     backpressure instead of buffering every frame at once; posting
     never deadlocks on a dead sender (the post loop watches the error
-    slot)."""
+    slot).
+
+    With ``mix`` (a rank-order-tolerant site under THRILL_TPU_HOST_MIX)
+    and a transport that can probe readiness, receives drain ANY-SOURCE
+    — whichever peer's frame lands first is consumed first (ROADMAP
+    exchange item (d); the true MixStream receive discipline,
+    reference: mix_stream.hpp:126). CatStream sites keep the fixed
+    per-peer schedule: their merge is per-source anyway, and identical
+    scheduling keeps the serial and async planes easiest to compare."""
     q: "queue.Queue" = queue.Queue(maxsize=_send_queue_depth())
     err: List[BaseException] = []
     wire_holder = [0]
@@ -281,16 +321,16 @@ def _exchange_frames_async(mex, group, outgoing: List[dict],
                 if item is None:
                     return
                 peer, msg = item
-                # byte accounting rides the sender thread so its
-                # serialization cost overlaps the main thread's
-                # receive processing instead of the send critical path
-                wire_holder[0] += _frame_bytes(msg)
                 if faults.REGISTRY.active():
                     def op(peer=peer):
                         faults.check(_F_ASYNC, peer=peer)
                     default_policy(**_FRAME_RETRY).run(
                         op, what="host_exchange:async_send")
-                _send_frame(group, peer, msg, "host_exchange")
+                # byte accounting rides the sender thread (and, on
+                # serializing transports, the transport's own encode),
+                # off the send critical path
+                wire_holder[0] += _send_frame(group, peer, msg,
+                                              "host_exchange")
         except BaseException as e:  # surfaced on the main thread
             err.append(e)
 
@@ -312,9 +352,18 @@ def _exchange_frames_async(mex, group, outgoing: List[dict],
                 except queue.Full:
                     continue
         q.put(None)
-        for r in range(1, P):
-            frm = (me - r) % P
-            received.append(_recv_frame(group, frm, "host_exchange"))
+        if mix and getattr(group, "supports_recv_any", False):
+            pending = [(me - r) % P for r in range(1, P)]
+            while pending:
+                frm, msg = _recv_frame_any(group, pending,
+                                           "host_exchange")
+                pending.remove(frm)
+                received.append(msg)
+        else:
+            for r in range(1, P):
+                frm = (me - r) % P
+                received.append(_recv_frame(group, frm,
+                                            "host_exchange"))
     finally:
         if err:
             # unblock join below; frames already queued are moot

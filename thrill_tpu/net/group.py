@@ -83,7 +83,12 @@ class Connection(abc.ABC):
     """Reliable ordered duplex message channel to one peer."""
 
     @abc.abstractmethod
-    def send(self, obj: Any) -> None: ...
+    def send(self, obj: Any) -> Optional[int]:
+        """Send one message. Transports that serialize the payload
+        return the serialized byte count (the wire truth, measured
+        ONCE where the frame is encoded — data/multiplexer.py's
+        byte accounting reads it instead of re-serializing); queue
+        transports that pass objects by reference return None."""
 
     @abc.abstractmethod
     def recv(self) -> Any: ...
@@ -130,9 +135,9 @@ class Group(abc.ABC):
     @abc.abstractmethod
     def connection(self, peer: int) -> Connection: ...
 
-    def send_to(self, peer: int, obj: Any) -> None:
+    def send_to(self, peer: int, obj: Any) -> Optional[int]:
         self._check_pending_abort()
-        self.connection(peer).send(obj)
+        return self.connection(peer).send(obj)
 
     @contextlib.contextmanager
     def _at(self, site: str):
@@ -232,6 +237,37 @@ class Group(abc.ABC):
                     pass
             raise ClusterAbort(origin, cause)
         return obj
+
+    # ------------------------------------------------------------------
+    # any-source receive (MixStream consume-first-arrival)
+    # ------------------------------------------------------------------
+
+    @property
+    def supports_recv_any(self) -> bool:
+        """Whether :meth:`recv_any` can genuinely pick whichever peer's
+        frame lands first. Transports without a readiness probe fall
+        back to the fixed per-peer schedule (the pre-any-source
+        behavior) — callers need no special-casing either way."""
+        return False
+
+    def _pick_ready_peer(self, peers: List[int]) -> int:
+        """Transport hook: block until SOME peer in ``peers`` has a
+        frame pending and return its rank. The default (no readiness
+        probe) returns the first peer — recv_any then degrades to the
+        fixed schedule. Implementations should bound their wait by
+        :func:`hang_timeout_s` and return any peer on expiry so
+        ``recv_from``'s own watchdog produces the attributable abort."""
+        return peers[0]
+
+    def recv_any(self, peers: List[int]) -> tuple:
+        """Receive one message from whichever of ``peers`` delivers
+        first; returns ``(peer, obj)``. Poison frames, heartbeat
+        filtering and the collective watchdog behave exactly as in
+        :meth:`recv_from` (the pick only chooses WHO to drain; the
+        actual receive goes through the same guarded path)."""
+        self._check_pending_abort()
+        peer = self._pick_ready_peer(list(peers))
+        return peer, self.recv_from(peer)
 
     # ------------------------------------------------------------------
     # coordinated abort (poison control frames)

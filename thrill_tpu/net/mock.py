@@ -11,9 +11,11 @@ socketpairs.
 from __future__ import annotations
 
 import queue
-from typing import Any, List
+import time
+from typing import Any, List, Optional
 
-from .group import CollectiveHangTimeout, Connection, Group
+from .group import (CollectiveHangTimeout, Connection, Group,
+                    hang_timeout_s)
 
 
 class _MockConnection(Connection):
@@ -21,8 +23,12 @@ class _MockConnection(Connection):
         self._out = out_q
         self._in = in_q
 
-    def send(self, obj: Any) -> None:
+    def send(self, obj: Any) -> Optional[int]:
+        # objects pass by reference — nothing is serialized, so there
+        # is no wire byte count to report (callers measuring frame
+        # bytes fall back to an explicit wire.dumps)
         self._out.put(obj)
+        return None
 
     def recv(self) -> Any:
         return self._in.get()
@@ -52,6 +58,28 @@ class MockGroup(Group):
         if peer == self.my_rank:
             raise ValueError("no connection to self")
         return self._conns[peer]
+
+    @property
+    def supports_recv_any(self) -> bool:
+        return True
+
+    def _pick_ready_peer(self, peers: List[int]) -> int:
+        """Poll the incoming queues (non-destructively) and return the
+        first peer with a frame pending — the mock transport's
+        any-source readiness probe. Bounded by the collective-watchdog
+        deadline; on expiry returns the first peer so recv_from's own
+        watchdog raises the attributable abort."""
+        deadline = hang_timeout_s()
+        deadline_at = (None if deadline is None
+                       else time.monotonic() + deadline)
+        while True:
+            for p in peers:
+                if not self._conns[p]._in.empty():
+                    return p
+            if (deadline_at is not None
+                    and time.monotonic() >= deadline_at):
+                return peers[0]
+            time.sleep(0.0005)
 
 
 class MockNetwork:

@@ -201,10 +201,13 @@ class MpiConnection(Connection):
         self.tag = tag
         self.engine = engine
 
-    def send(self, obj: Any) -> None:
-        """Queue the framed payload as an Isend and RETURN — completion
-        is lazy (engine reaps in recv polls / flush). See module
-        docstring for why waiting here deadlocks rendezvous MPI."""
+    def send(self, obj: Any) -> int:
+        """Queue the framed payload as an Isend and RETURN the
+        serialized byte count (the wire truth, measured where the
+        frame is encoded — the multiplexer's accounting reads it
+        instead of paying a second serialization) — completion is lazy
+        (engine reaps in recv polls / flush). See module docstring for
+        why waiting here deadlocks rendezvous MPI."""
         payload = wire.dumps(obj, allow_pickle=True)
         with _MPI_LOCK:
             req = self.comm.Isend([payload, self.mpi.BYTE],
@@ -212,6 +215,7 @@ class MpiConnection(Connection):
             self.engine.note_send_locked(req, payload)
             self.engine.reap_locked()
         self.engine.enforce_cap()
+        return len(payload)
 
     def recv(self) -> Any:
         """Iprobe poll -> sized Irecv -> Test poll; every poll iteration

@@ -37,7 +37,7 @@ from ..common import faults
 from ..common.retry import default_policy
 from . import wire
 from .group import (HEARTBEAT_KEY, CollectiveHangTimeout, Connection,
-                    Group)
+                    Group, hang_timeout_s)
 
 # Injection sites fire BEFORE any bytes hit the wire, so the internal
 # retry (shared backoff policy) is safe: nothing was transmitted. Real
@@ -203,13 +203,16 @@ class TcpConnection(Connection):
                 self._disp.wait(q[0][0])
                 self._retire_head()
 
-    def send(self, obj: Any) -> None:
-        """Send one message. Large bytes/ndarray payloads are BORROWED
-        (zero-copy scatter-gather): on a dispatcher-attached connection
-        the buffer must not be mutated until the send completes —
-        ``flush()`` is the synchronization point. Collectives in
-        net/group.py never mutate sent values; callers reusing staging
-        arrays across rounds must flush between them."""
+    def send(self, obj: Any) -> int:
+        """Send one message; returns the serialized payload byte count
+        (the wire truth, measured here where the frame is encoded —
+        the multiplexer's byte accounting reads it instead of paying a
+        second serialization). Large bytes/ndarray payloads are
+        BORROWED (zero-copy scatter-gather): on a dispatcher-attached
+        connection the buffer must not be mutated until the send
+        completes — ``flush()`` is the synchronization point.
+        Collectives in net/group.py never mutate sent values; callers
+        reusing staging arrays across rounds must flush between them."""
         _frame_site_check(_F_SEND)
         parts = wire.dumps_parts(obj, allow_pickle=self.authenticated)
         total = sum(len(p) for p in parts)
@@ -237,6 +240,7 @@ class TcpConnection(Connection):
                                        len(b), _borrow_check(b))
             else:
                 self._sendall_parts(bufs)
+        return total
 
     def send_bounded(self, obj: Any, deadline_s: float) -> None:
         """Send one message with a hard bound on blocking time
@@ -528,6 +532,51 @@ class TcpGroup(Group):
         if peer == self.my_rank:
             raise ValueError("no connection to self")
         return self._conns[peer]
+
+    @property
+    def supports_recv_any(self) -> bool:
+        return True
+
+    def _pick_ready_peer(self, peers) -> int:
+        """Any-source readiness probe: poll the peer sockets and return
+        the first with bytes pending (the connection reads straight
+        from the socket, so fd readability == a frame is landing).
+        Falls back to the fixed schedule when any candidate's fd is
+        owned by the async engine (the engine completes reads on its
+        own thread — polling the fd here would race it). Bounded by
+        the collective-watchdog deadline; on expiry returns the first
+        peer so recv_from's watchdog raises the attributable abort."""
+        import select as _select
+        conns = [self._conns[p] for p in peers]
+        if any(c._disp is not None for c in conns):
+            return peers[0]
+        deadline = hang_timeout_s()
+        deadline_at = (None if deadline is None
+                       else time.monotonic() + deadline)
+        p = _select.poll()
+        by_fd = {}
+        try:
+            for peer, c in zip(peers, conns):
+                fd = c.sock.fileno()
+                p.register(fd, _select.POLLIN)
+                by_fd[fd] = peer
+            while True:
+                if deadline_at is not None:
+                    remaining = deadline_at - time.monotonic()
+                    if remaining <= 0:
+                        return peers[0]
+                    timeout_ms = min(remaining, 0.5) * 1000.0
+                else:
+                    timeout_ms = 500.0
+                events = p.poll(timeout_ms)
+                if events:
+                    return by_fd[events[0][0]]
+        finally:
+            for fd in by_fd:
+                try:
+                    p.unregister(fd)
+                except (KeyError, OSError):
+                    pass
 
     def _shared_dispatcher(self):
         """One async engine per group, created on first bulk frame (a

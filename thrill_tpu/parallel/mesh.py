@@ -182,6 +182,12 @@ class MeshExec:
         self.stats_cap_cache_misses = 0
         self.stats_bytes_wire_device = 0
         self.stats_bytes_wire_host = 0
+        # shrink-the-wire layer: what full-width device rows would have
+        # shipped (actual is bytes_wire_device, narrowed), and host
+        # frame bytes saved by the column codec (net/wire.py) — the
+        # two halves of wire_compress_ratio in overall_stats
+        self.stats_bytes_wire_device_raw = 0
+        self.stats_bytes_wire_host_saved = 0
         # per-exchange-site plan kind ('dense' = optimistic-eligible,
         # 'sync' = the site needs the host plan step every time); the
         # capacity values themselves live in _sticky_caps
@@ -488,6 +494,16 @@ class MeshExec:
         ops the capture must reject."""
         return self.cached(key, lambda: _CountedJit(self, jax.jit(fn),
                                                     raw=fn))
+
+    def counted_jit(self, fn: Callable) -> "_CountedJit":
+        """``jax.jit`` behind the counting proxy, uncached — for
+        callers managing their own cache entry (the whole-loop
+        fori_loop program, api/loop.py). This and the two methods
+        above are the ONLY places the codebase constructs a jit:
+        admission control, the OOM ladder and the dispatch counters
+        depend on every device entry passing through _CountedJit
+        (pinned by tests/common/test_tracing.py's source audit)."""
+        return _CountedJit(self, jax.jit(fn), raw=fn)
 
     def cached(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
         """Memoize a compiled program per (mesh, key).

@@ -201,6 +201,7 @@ td.hm {{ min-width: 3em; }}
 {_render_stage_worker_matrix(nodes)}
 {_render_exchange_volume(exchanges, total)}
 {_render_overlap_lane(exchanges, overall, total)}
+{_render_wire_lane(overall)}
 {_render_worker_lanes(exchanges, total)}
 {_render_memory_events(memory, total)}
 {_render_fused_dispatches(fused, overall)}
@@ -599,6 +600,51 @@ def _render_overlap_lane(exchanges, overall, total: float) -> str:
             f"{wire / 1e6:.2f} MB on the wire</p>")
     return ("<h2>exchange overlap (capacity-plan cache)</h2>"
             + summary + "".join(lanes))
+
+
+def _render_wire_lane(overall) -> str:
+    """Bytes-on-wire lane (ISSUE 7 shrink-the-wire): actual vs
+    raw-equivalent wire volume per plane with the run's compression
+    ratio — a wire regression (ratio sliding toward 1.0 on a workload
+    that used to compress, or absolute bytes growing) is as loud here
+    as a dispatch-budget slip."""
+    if not overall:
+        return ""
+    o = overall[-1]
+    wire = o.get("bytes_on_wire", 0)
+    raw = o.get("bytes_on_wire_raw", wire)
+    if not raw:
+        return ""
+    ratio = o.get("wire_compress_ratio",
+                  round(raw / wire, 3) if wire else 1.0)
+    dev = o.get("bytes_wire_device", 0)
+    dev_raw = o.get("bytes_wire_device_raw", dev)
+    host = o.get("bytes_wire_host", 0)
+    host_saved = o.get("bytes_wire_host_saved", 0)
+    width = max(wire, raw, 1)
+    rows = []
+    for label, actual, raw_eq in (
+            ("device rows", dev, dev_raw),
+            ("host frames", host, host + host_saved)):
+        if not raw_eq:
+            continue
+        pct = 100.0 * actual / width
+        pct_raw = 100.0 * raw_eq / width
+        rows.append(
+            f'<div class="row"><span class="lbl">{label}</span>'
+            f'<div class="track">'
+            f'<div class="mark" style="left:0;width:{pct_raw:.1f}%;'
+            f'height:35%;top:0;background:#ccc"></div>'
+            f'<div class="mark" style="left:0;width:{pct:.1f}%;'
+            f'height:35%;top:55%"></div></div>'
+            f'<span class="dur">{actual / 1e6:.2f} of '
+            f'{raw_eq / 1e6:.2f} MB</span></div>')
+    return (
+        f"<h2>bytes on wire (shrink-the-wire)</h2>"
+        f"<p><b>{wire / 1e6:.2f} MB</b> shipped of "
+        f"{raw / 1e6:.2f} MB raw-equivalent — compression ratio "
+        f"<b>{ratio}x</b> (grey = raw, colored = shipped)</p>"
+        + "".join(rows))
 
 
 def _render_worker_lanes(exchanges, total: float) -> str:
