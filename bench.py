@@ -342,6 +342,13 @@ def _run_bench() -> None:
          hbm_high_watermark=int(press.get("hbm_high_watermark", 0)),
          oom_retries=int(press.get("oom_retries", 0)),
          segment_splits=int(press.get("segment_splits", 0)))
+    # scoped failure domains (api/context.py pipeline()/heal): the
+    # seed metrics for the sustained-traffic harness — a clean bench
+    # run reports 0 aborts / 0 reconnects / 0.0 heal seconds, and any
+    # nonzero value means the run survived real faults
+    _set(pipeline_aborts=int(press.get("pipeline_aborts", 0)),
+         conn_reconnects=int(press.get("conn_reconnects", 0)),
+         heal_time_s=float(press.get("heal_time_s", 0.0)))
     # overlapped-exchange data plane (data/exchange.py): run-wide
     # overlap fraction, capacity-plan cache hit rate, and the
     # bytes-on-wire baseline for the shrink-the-wire ROADMAP item

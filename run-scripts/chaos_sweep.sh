@@ -20,6 +20,13 @@
 # supervised relaunch must resume to bit-identical results. N_SEEDS
 # scales both sweeps.
 #
+# Survive mode (CHAOS_SURVIVE=1): additionally sweeps the scoped
+# failure-domain cases (tests/api/test_survive.py, chaos-marked): one
+# Context must outlive N_SEEDS seeded pipeline failures per fault
+# class — each surfacing as a catchable PipelineError, each healed,
+# final results bit-exact. The generation/reconnect socket cases in
+# tests/net/test_generation.py ride along.
+#
 # Tuning knobs (exported through to the harness):
 #   THRILL_TPU_RETRY_ATTEMPTS / _BASE_S / _MAX_S  retry policy
 #   THRILL_TPU_RETRY=0   disable retries (detection-only sweep: every
@@ -35,8 +42,15 @@ TARGETS=(tests/api/test_chaos.py tests/net/test_fault_injection.py
 if [[ "${CHAOS_KILL:-0}" == "1" ]]; then
   TARGETS+=(tests/api/test_checkpoint.py)
 fi
+if [[ "${CHAOS_SURVIVE:-0}" == "1" ]]; then
+  # the survive sweep's slow-marked seed tail still carries the chaos
+  # mark, so -m chaos runs the WHOLE grid here while tier-1's
+  # -m 'not slow' keeps only one representative seed per fault class
+  TARGETS+=(tests/api/test_survive.py tests/net/test_generation.py)
+fi
 
 exec env JAX_PLATFORMS=cpu THRILL_TPU_CHAOS_SEEDS="$N_SEEDS" \
     THRILL_TPU_CHAOS_KILL_SEEDS="$N_SEEDS" \
+    THRILL_TPU_SURVIVE_SEEDS="$N_SEEDS" \
     python -m pytest -m chaos -q -p no:cacheprovider \
     "${TARGETS[@]}" "$@"

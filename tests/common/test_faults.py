@@ -669,6 +669,11 @@ _NET_SITES = {
     # failure detector (PR 3): injected collective wedge + heartbeat
     # probe faults — exercised against real socketpair groups
     "net.group.recv_hang", "net.heartbeat",
+    # scoped failure domains (ISSUE 8): a real mid-exchange socket
+    # drop (heals via reconnect, tests/net/test_generation.py) and a
+    # replayed prior-generation frame (dropped by the generation
+    # filter) — both exercised against socketpair/bootstrapped groups
+    "net.tcp.disconnect", "net.group.stale_frame",
 }
 
 _MATRIX = {

@@ -537,6 +537,62 @@ def test_hung_collective_aborts_within_deadline(monkeypatch):
         b.close()
 
 
+def test_injected_tcp_disconnect_drops_the_socket(monkeypatch):
+    """net.tcp.disconnect: an armed fire REALLY closes the socket
+    mid-exchange — the sender surfaces a clean ConnectionError that no
+    frame retry absorbs, the link is marked broken (fast-fail for
+    every later frame), and the peer sees EOF, not a torn frame."""
+    g0, g1, a, b = _socketpair_group_pair()
+    try:
+        with faults.inject("net.tcp.disconnect", n=1, seed=31):
+            with pytest.raises(ConnectionError, match="injected link"):
+                g0.send_to(1, {"bulk": list(range(64))})
+        assert faults.REGISTRY.injected >= 1
+        conn = g0.connection(1)
+        assert conn.broken
+        # fast-fail, not EBADF surprises, on the next frame
+        with pytest.raises(ConnectionError, match="link is down"):
+            g0.send_to(1, "more")
+        # the peer's next read sees a clean end-of-stream verdict
+        with pytest.raises(ConnectionError):
+            g1.recv_from(0)
+        assert g1.connection(0).broken
+        # no reconnect possible on a socketpair group (no hostlist):
+        # the heal refuses rather than pretending
+        with pytest.raises((ConnectionError, OSError)):
+            g0.begin_generation(1)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_injected_stale_frame_is_filtered(monkeypatch):
+    """net.group.stale_frame: an armed fire replays a PRIOR-generation
+    poison frame into the next recv — the generation filter drops it,
+    the collective still completes exactly, and the drop is counted."""
+    g0, g1, a, b = _socketpair_group_pair()
+    g0.generation = g1.generation = 2
+    try:
+        with faults.inject("net.group.stale_frame", n=1, seed=37):
+            done = []
+
+            def peer():
+                done.append(g1.all_reduce(5))
+
+            t = threading.Thread(target=peer, daemon=True)
+            t.start()
+            got = g0.all_reduce(2)
+            t.join(timeout=10)
+        assert not t.is_alive()
+        assert got == 7 and done == [7]
+        assert faults.REGISTRY.injected >= 1
+        assert g0.stats_stale_dropped + g1.stats_stale_dropped >= 1
+        assert faults.REGISTRY.stats()["recoveries"] >= 1
+    finally:
+        a.close()
+        b.close()
+
+
 def test_injected_recv_hang_site(monkeypatch):
     """net.group.recv_hang: an armed fire makes the next collective
     recv behave as a deadline expiry — the full hang-abort path runs

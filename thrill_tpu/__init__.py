@@ -25,8 +25,8 @@ __version__ = "0.1.0"
 #: top-level convenience surface (the reference exposes thrill::Run /
 #: thrill::DIA the same way); resolved lazily so importing thrill_tpu
 #: stays light
-_API_NAMES = ("Bind", "Context", "DIA", "FieldReduce", "Run",
-              "RunDistributed", "RunLocalMock", "RunLocalTests",
+_API_NAMES = ("Bind", "Context", "DIA", "FieldReduce", "PipelineError",
+              "Run", "RunDistributed", "RunLocalMock", "RunLocalTests",
               "RunSupervised",
               "Concat", "InnerJoin", "Iterate", "Merge", "Union", "Zip",
               "ZipWindow")

@@ -1,5 +1,6 @@
-from .context import (Context, Run, RunDistributed, RunLocalMock,  # noqa: F401
-                      RunLocalTests, RunSupervised)
+from .context import (Context, PipelineError, Run,  # noqa: F401
+                      RunDistributed, RunLocalMock, RunLocalTests,
+                      RunSupervised)
 from .dia import DIA, Concat, InnerJoin, Merge, Union, Zip, ZipWindow  # noqa: F401
 from .functors import FieldReduce  # noqa: F401
 from .loop import Iterate  # noqa: F401

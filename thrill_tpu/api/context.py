@@ -17,8 +17,10 @@ same single-controller program (standard JAX multi-controller SPMD).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
@@ -35,6 +37,28 @@ from ..parallel.mesh import MeshExec
 def _wire_ratio(raw: int, actual: int) -> float:
     """bytes_on_wire_raw / bytes_on_wire, 1.0 when nothing shipped."""
     return round(raw / actual, 3) if actual else 1.0
+
+
+class PipelineError(RuntimeError):
+    """One pipeline run on a Context failed — and ONLY that pipeline:
+    the Context healed (generation-scoped failure domain) and stays
+    usable for the next run. Carries the ROOT CAUSE of the abort
+    (``origin`` rank, ``cause`` text, ``generation`` of the failed
+    run, ``root`` original exception). Deliberately NOT a
+    ConnectionError/ClusterAbort subclass: retry policies classify it
+    permanent, RunSupervised does not relaunch for it (the caller
+    opted into handling scoped failures by using ``ctx.pipeline()``),
+    and ``Context.close()`` runs the healthy collective shutdown."""
+
+    def __init__(self, origin: int, cause: str, generation: int,
+                 root: Optional[BaseException] = None) -> None:
+        super().__init__(
+            f"pipeline generation {generation} aborted "
+            f"(origin rank {origin}): {cause}")
+        self.origin = origin
+        self.cause = cause
+        self.generation = generation
+        self.root = root
 
 
 class Context:
@@ -114,6 +138,19 @@ class Context:
         # an abort-class exception is in flight) so cleanup never runs
         # collectives against dead peers and leaked run files get swept
         self._aborted = False
+        # generation-scoped failure domains: every pipeline run carries
+        # the CURRENT generation id; an abort tears down only that
+        # generation (ctx.pipeline() heals and bumps it) instead of
+        # poisoning the whole Context. The net group shares the id so
+        # poison frames / barriers are tagged consistently. The
+        # counter is MONOTONIC and never reused (nested/sequential
+        # blocks each get a fresh id; clean exits restore the parent
+        # domain without ever re-issuing an id a node is stamped with).
+        self.generation = 1
+        self._gen_counter = 1
+        self.net.group.generation = self.generation
+        self.stats_pipeline_aborts = 0
+        self.stats_heal_time_s = 0.0
         # checkpoint/resume subsystem (api/checkpoint.py): fully off —
         # ctx.checkpoint stays None, the stage driver pays one
         # attribute read — unless THRILL_TPU_CKPT_DIR is set
@@ -179,6 +216,10 @@ class Context:
         return self.mesh_exec.num_workers
 
     def _register_node(self, node) -> int:
+        # stamp the failure domain: a heal disposes exactly the nodes
+        # of the aborted generation (their shards may be partial) and
+        # leaves earlier generations' cached results untouched
+        node._generation = self.generation
         self._nodes.append(node)
         return len(self._nodes) - 1
 
@@ -365,6 +406,18 @@ class Context:
             # the process-wide fault/retry/abort counters
             # (common/faults.py)
             "join_overflow_retries": mex.stats_join_overflow_retries,
+            # generation-scoped failure domains: pipelines aborted on
+            # this Context (each healed, not fatal), time spent
+            # healing, links repaired by the tcp reconnect, and stale
+            # prior-generation frames the filter dropped — the seed
+            # metrics for the sustained-traffic harness
+            "generation": self.generation,
+            "pipeline_aborts": self.stats_pipeline_aborts,
+            "heal_time_s": round(self.stats_heal_time_s, 4),
+            "conn_reconnects": getattr(self.net.group,
+                                       "stats_reconnects", 0),
+            "stale_frames_dropped": getattr(self.net.group,
+                                            "stats_stale_dropped", 0),
         }
         # durability layer (api/checkpoint.py): epochs committed, bytes
         # sealed, ops skipped by resume, time spent restoring
@@ -382,11 +435,16 @@ class Context:
             # host-process-local peaks (and the per-process fault/
             # retry/abort counters) genuinely differ across hosts.
             local_peaks = {"host_mem_peak", "recovery_time_s",
-                           "hbm_high_watermark"}
+                           "hbm_high_watermark", "heal_time_s"}
             local_sums = {"faults_injected", "retries", "recoveries",
                           "aborts", "ckpt_bytes_written", "oom_retries",
                           "segment_splits", "host_fallbacks",
                           "admission_spills", "pressure_spilled_bytes",
+                          # link repairs and stale-frame drops are
+                          # per-process transport events; the abort/
+                          # generation counters are coordinated (host
+                          # 0's copy, the default, is the global view)
+                          "conn_reconnects", "stale_frames_dropped",
                           # host frames (and their codec savings) are
                           # per-process partials; the device wire
                           # bytes — actual and raw — derive from the
@@ -408,19 +466,203 @@ class Context:
             stats["hosts"] = len(per_host)
         return stats
 
+    # -- generation-scoped failure domains ------------------------------
+
+    @contextlib.contextmanager
+    def pipeline(self, name: str = ""):
+        """Scoped failure domain for one pipeline run.
+
+        Any error escaping the block aborts ONLY this pipeline: the
+        Context heals (stale in-flight frames drained by generation
+        tag, the failed run's HBM reservations and cached-shard pins
+        released, deferred checks cancelled, dropped TCP links
+        reconnected, watchdog + heartbeat re-armed) and surfaces a
+        catchable :class:`PipelineError` carrying the root cause and
+        generation — the next pipeline on this same Context runs
+        bit-identical to a fresh-Context run.
+
+        Unrecoverable verdicts (heartbeat-confirmed dead peer, or a
+        heal that itself fails) re-raise the ORIGINAL abort so the
+        supervised relaunch + resume path still engages. Yields the
+        generation id of this run.
+
+        Entering the block starts a FRESH generation (a never-reused
+        id off a monotonic counter), so nodes cached by earlier
+        successful pipelines (or created between blocks) belong to
+        other generations and survive this block's abort — only THIS
+        run's nodes are disposed by the heal. A nested block's clean
+        exit restores the ENCLOSING failure domain, so an outer abort
+        heals the outer run's nodes, not the nested survivor's. In
+        multi-controller runs every controller must enter/exit
+        pipeline() at the same program points (the same lockstep
+        contract every collective already has)."""
+        parent = self.generation
+        self._gen_counter += 1
+        self.generation = self._gen_counter
+        self.net.group.generation = self.generation
+        gen = self.generation
+        try:
+            yield gen
+            # a deferred check crossing the boundary belongs to THIS
+            # pipeline: surface it here, inside the failure domain
+            self.mesh_exec.drain_checks()
+        except PipelineError:
+            # a nested pipeline() already aborted, healed and wrapped
+            # this failure — pass it through, never double-heal (a
+            # second barrier would waste a collective round and the
+            # re-wrap would misreport the failed generation). Node
+            # stamping resumes in the enclosing domain.
+            self.generation = parent
+            raise
+        except Exception as e:
+            replacement = self._pipeline_failed(e, name)
+            if replacement is e:
+                raise
+            # healed: execution resumes in the ENCLOSING domain — a
+            # caller catching this PipelineError continues the outer
+            # block with its own generation, so the outer run's nodes
+            # (stamped before AND after this failed block) share one
+            # id and a later outer abort heals all of them. The WIRE
+            # epoch (group.generation) stays at the heal's advanced
+            # value so the failed generation's frames read as stale.
+            self.generation = parent
+            raise replacement from e
+        else:
+            # clean exit: pop back to the enclosing failure domain
+            # (frames tagged with this block's id stay >= the restored
+            # group generation, so nothing of a LIVE outer run ever
+            # reads as stale)
+            self.generation = parent
+            self.net.group.generation = parent
+
+    def _pipeline_failed(self, exc: BaseException,
+                         name: str = "") -> BaseException:
+        """Abort bookkeeping + heal; returns the exception the caller
+        should raise (a PipelineError after a successful heal, the
+        original otherwise)."""
+        from ..common import faults
+        from ..net.group import ClusterAbort
+        failed_gen = self.generation
+        unrecoverable = (isinstance(exc, ClusterAbort)
+                         and not getattr(exc, "recoverable", True))
+        origin = int(getattr(exc, "origin", self.host_rank))
+        cause = str(getattr(exc, "cause", "") or
+                    f"{type(exc).__name__}: {exc}")
+        self.stats_pipeline_aborts += 1
+        if self.logger.enabled:
+            self.logger.line(event="pipeline_abort", origin=origin,
+                             generation=failed_gen,
+                             pipeline=name or None,
+                             recoverable=not unrecoverable,
+                             cause=cause[:300])
+        if (self.net.num_workers > 1
+                and not isinstance(exc, ClusterAbort)):
+            # a RANK-LOCAL failure (user logic, per-rank I/O): the
+            # peers never saw it and would not enter their own heal —
+            # the generation barrier would then wait on ranks that
+            # never aborted. Poison them first so every controller
+            # aborts this generation and meets us at the barrier.
+            try:
+                self.net.group.poison_peers(cause)
+            except Exception:
+                pass
+        if not unrecoverable:
+            try:
+                self._heal(failed_gen)
+            except Exception as he:
+                unrecoverable = True
+                faults.note("recovery", what="heal_failed",
+                            gen=failed_gen, error=repr(he))
+        if unrecoverable:
+            self._aborted = True
+            return exc
+        return PipelineError(origin, cause, failed_gen, root=exc)
+
+    def _heal(self, failed_gen: int) -> None:
+        """Tear down generation ``failed_gen`` and make the Context as
+        good as fresh: dispose the failed run's nodes (releasing the
+        HbmGovernor ledger entries, cached-shard pins, spilled blocks
+        and host-RAM grants), cancel its deferred checks and any live
+        loop capture, then run the fresh-generation barrier over the
+        host group (reconnecting dropped TCP links, draining stale
+        in-flight frames by generation tag) and re-arm the heartbeat
+        monitor. Raises when the mesh cannot be healed (dead peer,
+        reconnect failure, barrier timeout)."""
+        from .dia_base import DISPOSED
+        t0 = time.monotonic()
+        mex = self.mesh_exec
+        # the healed domain gets a FRESH never-reused id: past
+        # failed_gen (stale-frame ordering) AND past every id nested
+        # blocks already consumed (never collide with a surviving
+        # node's stamp)
+        self._gen_counter = max(self._gen_counter, failed_gen) + 1
+        self.generation = self._gen_counter
+        checks_dropped = mex.reset_run_state()
+        released = 0
+        for node in self._nodes:
+            if getattr(node, "_generation", 0) != failed_gen:
+                continue
+            self.release_mem(node)
+            if node.state == DISPOSED:
+                continue
+            try:
+                node.dispose()
+                released += 1
+            except Exception:
+                pass           # best effort: the ledger entry is gone
+        # the transport heal + barrier is the COLLECTIVE part: every
+        # controller that aborted this generation enters it. A rank
+        # that MISSED the cluster's abort adopts the newer generation
+        # its peers' barrier markers announced — re-sync local ids to
+        # whatever the barrier settled on.
+        stale = self.net.group.begin_generation(self.generation)
+        self.generation = max(self.generation,
+                              self.net.group.generation)
+        self._gen_counter = max(self._gen_counter, self.generation)
+        # re-arm liveness probing if the monitor thread has exited
+        # (it stops itself only on a dead-peer verdict, which is
+        # unrecoverable — this covers monitors stopped by tests or a
+        # future recoverable-stop path)
+        hb = getattr(self.net.group, "_heartbeat", None)
+        if hb is not None and (hb._thread is None
+                               or not hb._thread.is_alive()):
+            from ..net import heartbeat
+            self.net.group._heartbeat = heartbeat.maybe_start(
+                self.net.group)
+        self._aborted = False
+        dt = time.monotonic() - t0
+        self.stats_heal_time_s += dt
+        if self.logger.enabled:
+            self.logger.line(event="heal", generation=self.generation,
+                             heal_time_s=round(dt, 4),
+                             nodes_released=released,
+                             checks_dropped=checks_dropped,
+                             stale_frames=stale)
+
     def abort(self, cause: Any) -> None:
         """Coordinated abort: broadcast ``cause`` as a poison control
         frame to every controller (each peer surfaces it as a
         ClusterAbort carrying this ROOT CAUSE within its own recv
         deadline — no cascade of secondary timeouts), then raise it
-        locally."""
+        locally. The ``event=abort`` line is emitted BEFORE the raise
+        (with origin + generation), so single-rank aborts — where no
+        poison frame is ever sent — are visible in json2profile
+        exactly like poisoned ones."""
         from ..net.group import ClusterAbort
         self._aborted = True
+        if self.logger.enabled:
+            cause_s = (f"{type(cause).__name__}: {cause}"
+                       if isinstance(cause, BaseException)
+                       else str(cause))
+            self.logger.line(event="abort", origin=self.host_rank,
+                             generation=self.generation,
+                             cause=cause_s[:300])
         if self.net.num_workers > 1:
             self.net.group.poison_peers(cause)
         if isinstance(cause, BaseException):
             raise cause
-        raise ClusterAbort(self.host_rank, str(cause))
+        raise ClusterAbort(self.host_rank, str(cause),
+                           generation=self.generation)
 
     def collective_mean_stdev(self, value: float):
         """(mean, stdev) of a per-controller scalar across the cluster

@@ -333,11 +333,22 @@ def _exchange_frames_async(mex, group, outgoing: List[dict],
                                               "host_exchange")
         except BaseException as e:  # surfaced on the main thread
             err.append(e)
+            # the main thread may be BLOCKED in a peer recv that can
+            # now never complete (our frame will not arrive, and with
+            # the watchdog off a recv has no deadline) — and the PEER
+            # may be symmetrically blocked on us. Poison the scope:
+            # peers abort fast with the root cause, and their relay
+            # frees OUR blocked recv too, instead of a mutual hang.
+            try:
+                group.poison_peers(e)
+            except Exception:
+                pass
 
     t = threading.Thread(target=_sender, daemon=True,
                          name="thrill-tpu-mux-send")
     t.start()
     sent_items = 0
+    posted_sentinel = False
     try:
         for r in range(1, P):
             to = (me + r) % P
@@ -351,7 +362,18 @@ def _exchange_frames_async(mex, group, outgoing: List[dict],
                     break
                 except queue.Full:
                     continue
-        q.put(None)
+        while True:
+            # sentinel rides the same err-watching bounded post as the
+            # frames: a sender that died with the queue FULL must not
+            # park this thread in a blocking put forever
+            if err:
+                raise err[0]
+            try:
+                q.put(None, timeout=0.1)
+                break
+            except queue.Full:
+                continue
+        posted_sentinel = True
         if mix and getattr(group, "supports_recv_any", False):
             pending = [(me - r) % P for r in range(1, P)]
             while pending:
@@ -365,13 +387,22 @@ def _exchange_frames_async(mex, group, outgoing: List[dict],
                 received.append(_recv_frame(group, frm,
                                             "host_exchange"))
     finally:
-        if err:
-            # unblock join below; frames already queued are moot
+        if err or not posted_sentinel:
+            # STOP the sender cleanly on any failure path — the
+            # sender's own error, or a receive-side abort before the
+            # sentinel was posted (without this, a receive failure
+            # stranded the sender blocked on q.get() forever: a thread
+            # leaked per aborted exchange). Frames still queued are
+            # moot; drain them so the sentinel fits the bounded queue.
             while not q.empty():
                 try:
                     q.get_nowait()
                 except queue.Empty:
                     break
+            try:
+                q.put_nowait(None)
+            except queue.Full:
+                pass            # sender mid-get will drain to it
     # sender drain deadline: the collective-watchdog knob
     # (THRILL_TPU_HANG_TIMEOUT_S) — the same deadline every blocking
     # collective honors. Watchdog off (None) = wait for the send like

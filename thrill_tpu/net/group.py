@@ -36,10 +36,23 @@ POISON_KEY = "__thrill_tpu_poison__"
 #: it can reach a collective's payload stream
 HEARTBEAT_KEY = "__thrill_tpu_hb__"
 
+#: magic key of a generation-barrier control frame: the marker a
+#: healing rank sends each peer when it enters a new failure domain
+#: (Context generation). Everything queued BEFORE the marker on the
+#: ordered channel belongs to the aborted generation and is drained;
+#: the marker itself is the "fresh-generation barrier"
+GENERATION_KEY = "__thrill_tpu_gen__"
+
 #: injectable hang: an armed fire at this site makes the next blocking
 #: collective recv behave as if its deadline expired with no frame —
 #: the watchdog's abort path runs for real, no actual wedged peer needed
 _F_HANG = faults.declare("net.group.recv_hang")
+
+#: injectable generation replay: an armed fire makes the next recv see
+#: a PRIOR-generation poison frame first (as if a stale frame from an
+#: aborted pipeline were still in flight) — the generation filter must
+#: drop it and the collective must still complete
+_F_STALE = faults.declare("net.group.stale_frame")
 
 #: heartbeat-probe site (checked per heartbeat send, net/heartbeat.py)
 F_HEARTBEAT = faults.declare("net.heartbeat",
@@ -65,18 +78,55 @@ def hang_timeout_s() -> Optional[float]:
     return t if t > 0 else None
 
 
+def heal_timeout_s() -> float:
+    """Budget for one generation heal (barrier drain + reconnects):
+    THRILL_TPU_HEAL_TIMEOUT_S, default 30s. Past it the heal itself
+    fails and the abort escalates to the unrecoverable path. Unlike
+    the watchdog knob, the heal MUST be bounded (an unbounded barrier
+    against a dead peer is a hang) — a non-positive value is refused
+    loudly and the default applies."""
+    v = os.environ.get("THRILL_TPU_HEAL_TIMEOUT_S", "")
+    try:
+        t = float(v)
+    except ValueError:
+        return 30.0
+    if t <= 0:
+        global _WARNED_HEAL_TIMEOUT
+        if not _WARNED_HEAL_TIMEOUT:
+            _WARNED_HEAL_TIMEOUT = True
+            import sys
+            print("thrill_tpu.net: THRILL_TPU_HEAL_TIMEOUT_S must be "
+                  "> 0 (the heal cannot be unbounded); using the "
+                  "default 30s", file=sys.stderr)
+        return 30.0
+    return t
+
+
+_WARNED_HEAL_TIMEOUT = False
+
+
 class ClusterAbort(ConnectionError):
     """A peer broadcast a poison frame: its ROOT CAUSE, not a local
     secondary symptom. ConnectionError subclass so existing dead-peer
     handling (tests, cleanup paths) treats an abort as fatal transport
     loss — but the retry policy classifies it permanent (never retry
-    a coordinated shutdown)."""
+    a coordinated shutdown).
 
-    def __init__(self, origin: int, cause: str) -> None:
+    ``generation`` scopes the abort to one pipeline run (Context
+    failure domain); ``recoverable`` distinguishes pipeline-scoped
+    verdicts (poison, hung collective, dropped link — the Context can
+    heal and serve the next pipeline) from process-death verdicts
+    (heartbeat-confirmed dead peer — only a supervised relaunch +
+    resume recovers those)."""
+
+    def __init__(self, origin: int, cause: str, generation: int = -1,
+                 recoverable: bool = True) -> None:
         super().__init__(
             f"cluster abort from rank {origin}: {cause}")
         self.origin = origin
         self.cause = cause
+        self.generation = generation
+        self.recoverable = recoverable
 
 
 class Connection(abc.ABC):
@@ -127,6 +177,19 @@ class Group(abc.ABC):
         self._collective_site: str = ""
         self._hb_last: dict = {}
         self._pending_abort: Optional[ClusterAbort] = None
+        # failure-domain scope (Context generation): poison frames and
+        # generation barriers carry it; frames tagged with an OLDER
+        # generation are stale leftovers of an aborted pipeline and are
+        # dropped instead of poisoning the healed group
+        self.generation = 0
+        self.stats_stale_dropped = 0
+        # link repairs performed by _repair_connection (tcp reconnect)
+        self.stats_reconnects = 0
+        # newest generation-barrier marker seen per peer OUTSIDE a
+        # barrier drain (a payload recv may consume one when this rank
+        # missed the cluster's abort): the local barrier reads the
+        # stash instead of waiting for a frame already consumed
+        self._gen_markers: dict = {}
 
     @property
     def num_hosts(self) -> int:
@@ -159,12 +222,19 @@ class Group(abc.ABC):
         """Failure-detector verdict (net/heartbeat.py): ``peer`` is
         unreachable. Latch an abort for the main thread, poison the
         surviving peers so the whole group converts to fast attributable
-        aborts instead of a cascade of timeouts."""
-        ab = ClusterAbort(self.my_rank, cause)
-        if self._pending_abort is None:
+        aborts instead of a cascade of timeouts.
+
+        The verdict is UNRECOVERABLE: a heartbeat-confirmed dead
+        process cannot be healed by a new generation — only the
+        supervised relaunch + checkpoint resume path recovers it
+        (run-scripts/supervise.sh, api.RunSupervised)."""
+        ab = ClusterAbort(self.my_rank, cause,
+                          generation=self.generation, recoverable=False)
+        if self._pending_abort is None or getattr(
+                self._pending_abort, "recoverable", True):
             self._pending_abort = ab
         try:
-            self.poison_peers(cause)
+            self.poison_peers(cause, unrecoverable=True)
         except Exception:
             pass
 
@@ -188,22 +258,39 @@ class Group(abc.ABC):
         deadline_at = (None if deadline is None
                        else time.monotonic() + deadline)
         site = self._collective_site or "recv"
+        injected_stale = False
         while True:
             try:
+                obj = None
                 if faults.REGISTRY.active():
                     try:
                         faults.check(_F_HANG, peer=peer, site=site)
                     except faults.InjectedFault:
                         raise CollectiveHangTimeout(
                             "injected wedge") from None
-                conn = self.connection(peer)
-                if deadline_at is None:
-                    obj = conn.recv()
-                else:
-                    remaining = deadline_at - time.monotonic()
-                    if remaining <= 0:
-                        raise CollectiveHangTimeout("deadline spent")
-                    obj = conn.recv_deadline(remaining)
+                    if not injected_stale:
+                        try:
+                            faults.check(_F_STALE, peer=peer, site=site)
+                        except faults.InjectedFault:
+                            # replay a prior-generation poison frame as
+                            # if it were still in flight from an aborted
+                            # pipeline: the filter below must drop it
+                            # and the REAL frame arrives on the next
+                            # loop pass
+                            injected_stale = True
+                            obj = {POISON_KEY: {
+                                "origin": peer,
+                                "cause": "injected stale replay",
+                                "gen": self.generation - 1}}
+                if obj is None:
+                    conn = self.connection(peer)
+                    if deadline_at is None:
+                        obj = conn.recv()
+                    else:
+                        remaining = deadline_at - time.monotonic()
+                        if remaining <= 0:
+                            raise CollectiveHangTimeout("deadline spent")
+                        obj = conn.recv_deadline(remaining)
             except CollectiveHangTimeout:
                 cause = (f"hang at {site}: rank {self.my_rank} "
                          f"received no frame from rank {peer} within "
@@ -213,30 +300,75 @@ class Group(abc.ABC):
                     self.poison_peers(cause)
                 except Exception:
                     pass
-                raise ClusterAbort(self.my_rank, cause) from None
+                raise ClusterAbort(self.my_rank, cause,
+                                   generation=self.generation) from None
             if isinstance(obj, dict) and HEARTBEAT_KEY in obj:
                 # liveness chatter from a transport without its own
                 # filter (mock queues): note it, keep waiting for the
                 # payload on the SAME deadline budget
                 self._hb_last[peer] = time.monotonic()
                 continue
-            break
-        if isinstance(obj, dict) and POISON_KEY in obj:
-            info = obj[POISON_KEY]
-            origin = int(info.get("origin", peer))
-            cause = str(info.get("cause", "unknown"))
-            if (origin, cause) not in self._poison_relayed:
-                # RELAY once before aborting: in tree/hypercube
-                # collectives most ranks never recv from the origin
-                # directly — without the relay they would block on a
-                # healthy partner that already aborted and surface a
-                # secondary 'peer closed' instead of the root cause
-                try:
-                    self.poison_peers(cause, origin=origin)
-                except Exception:
-                    pass
-            raise ClusterAbort(origin, cause)
-        return obj
+            if isinstance(obj, dict) and GENERATION_KEY in obj:
+                info = obj[GENERATION_KEY]
+                g = int(info.get("gen", 0))
+                if g > self.generation:
+                    # the peer healed into a NEWER failure domain:
+                    # this rank MISSED the cluster's abort (its poison
+                    # frame was lost and the watchdog is off). Stash
+                    # the marker — our own barrier must not wait for a
+                    # frame we just consumed — and abort the current
+                    # collective so the pipeline handler heals and
+                    # meets the peer at the barrier.
+                    self._gen_markers[peer] = max(
+                        self._gen_markers.get(peer, 0), g)
+                    origin = int(info.get("rank", peer))
+                    raise ClusterAbort(
+                        origin,
+                        f"peer rank {origin} healed to generation "
+                        f"{g} while this rank was still in generation "
+                        f"{self.generation} — the cluster aborted "
+                        f"without local notice",
+                        generation=self.generation)
+                # a LATE marker from a heal this rank already
+                # completed: control chatter, never payload — drop it
+                self._drop_stale(peer, obj)
+                continue
+            if isinstance(obj, dict) and POISON_KEY in obj:
+                info = obj[POISON_KEY]
+                gen = int(info.get("gen", self.generation))
+                if gen < self.generation:
+                    # stale poison of an ALREADY-HEALED generation (a
+                    # slow peer's abort frame, or a replayed frame):
+                    # the failure domain it belongs to is gone — drop
+                    # it instead of killing the healed group
+                    self._drop_stale(peer, obj)
+                    continue
+                origin = int(info.get("origin", peer))
+                cause = str(info.get("cause", "unknown"))
+                recoverable = not bool(info.get("unrecoverable", False))
+                if (origin, cause) not in self._poison_relayed:
+                    # RELAY once before aborting: in tree/hypercube
+                    # collectives most ranks never recv from the origin
+                    # directly — without the relay they would block on a
+                    # healthy partner that already aborted and surface a
+                    # secondary 'peer closed' instead of the root cause
+                    try:
+                        self.poison_peers(cause, origin=origin,
+                                          unrecoverable=not recoverable)
+                    except Exception:
+                        pass
+                raise ClusterAbort(origin, cause, generation=gen,
+                                   recoverable=recoverable)
+            return obj
+
+    def _drop_stale(self, peer: int, obj: Any) -> None:
+        """Count + log one dropped prior-generation frame."""
+        self.stats_stale_dropped += 1
+        info = next(iter(obj.values())) if obj else {}
+        faults.note("recovery", what="net.stale_frame_dropped",
+                    _quiet=self.stats_stale_dropped > 8,
+                    peer=peer, gen=self.generation,
+                    frame_gen=(info or {}).get("gen"))
 
     # ------------------------------------------------------------------
     # any-source receive (MixStream consume-first-arrival)
@@ -273,7 +405,8 @@ class Group(abc.ABC):
     # coordinated abort (poison control frames)
     # ------------------------------------------------------------------
 
-    def poison_peers(self, cause: Any, origin: Optional[int] = None) -> int:
+    def poison_peers(self, cause: Any, origin: Optional[int] = None,
+                     unrecoverable: bool = False) -> int:
         """Best-effort broadcast of a poison frame to every peer.
 
         A worker hitting an unrecoverable error calls this before
@@ -285,11 +418,19 @@ class Group(abc.ABC):
         cause may be the transport itself) are swallowed — the
         caller's re-raise is the authoritative error. ``origin`` is
         set by relays to preserve the ORIGINATING rank.
+
+        The frame is tagged with the CURRENT generation so a healed
+        group drops it if it arrives after the failure domain it
+        belongs to was torn down; ``unrecoverable`` marks process-death
+        verdicts (mark_dead) that no heal may clear.
         """
         org = self.my_rank if origin is None else origin
         self._poison_relayed.add((org, _cause_str(cause)))
         frame = {POISON_KEY: {"origin": org,
-                              "cause": _cause_str(cause)}}
+                              "cause": _cause_str(cause),
+                              "gen": self.generation,
+                              **({"unrecoverable": True}
+                                 if unrecoverable else {})}}
         # bounded send deadline (common/timeouts.py load scaling): a
         # peer that stopped draining its socket (wedged, descheduled,
         # dying) can have a FULL kernel buffer — a blocking send of the
@@ -315,6 +456,159 @@ class Group(abc.ABC):
         faults.note("abort", origin=self.my_rank, notified=notified,
                     cause=_cause_str(cause))
         return notified
+
+    # ------------------------------------------------------------------
+    # generation-scoped failure domains (heal after a pipeline abort)
+    # ------------------------------------------------------------------
+
+    def _heal_transport(self, deadline_at: float) -> None:
+        """Proactively repair links already KNOWN broken before the
+        generation barrier runs (tcp overrides: reconnect + session
+        handshake). Base transports have nothing to repair."""
+
+    def _repair_connection(self, peer: int, deadline_at: float,
+                           cause: Optional[BaseException] = None) -> bool:
+        """Transport hook: try to re-establish the link to ``peer``
+        after a transport error surfaced mid-barrier. Returns True when
+        the link is usable again (the barrier retries), False when this
+        transport cannot reconnect (the heal fails and the abort
+        escalates to the unrecoverable path)."""
+        return False
+
+    def link_repairable(self, peer: int) -> bool:
+        """Is the link to ``peer`` in a DOWN-BUT-REPAIRABLE state (a
+        dropped stream a generation heal could reconnect)? The
+        heartbeat monitor consults this before ruling a peer dead: a
+        repairable link drop is a PIPELINE-scoped event owned by the
+        heal (whose dial budget still produces the dead-process verdict
+        when nobody answers) — declaring it a dead process here would
+        defeat the heal. Base transports have no repair path."""
+        return False
+
+    def begin_generation(self, gen: int) -> int:
+        """Enter failure domain ``gen`` after a pipeline abort: clear
+        the pipeline-scoped abort latch, repair dropped links (tcp),
+        send every peer a generation-barrier marker and DRAIN each
+        inbound channel up to the peer's marker — everything queued
+        before it (bulk frames of the aborted exchange, late poison,
+        stray collective payloads) belongs to the dead generation and
+        is discarded. On return the group is exactly as quiet as a
+        freshly bootstrapped one.
+
+        Raises the latched abort when it is unrecoverable (heartbeat
+        dead-peer verdict), :class:`CollectiveHangTimeout` when a peer
+        never delivers its marker within THRILL_TPU_HEAL_TIMEOUT_S,
+        and :class:`ClusterAbort` when a CURRENT-generation poison
+        arrives mid-drain (a new failure during the heal itself).
+        Returns the number of stale frames dropped."""
+        gen = int(gen)
+        if self._gen_markers:
+            # ADOPT a newer generation announced by peers whose heal
+            # this rank missed: the barrier only completes when every
+            # rank targets the same id
+            gen = max(gen, max(self._gen_markers.values()))
+        ab = self._pending_abort
+        if ab is not None:
+            if (getattr(ab, "recoverable", True)
+                    and getattr(ab, "generation", -1) < gen):
+                # pipeline-scoped verdict of the aborted generation:
+                # the new domain starts clean
+                self._pending_abort = None
+            else:
+                raise ab
+        self._poison_relayed.clear()
+        self.generation = gen
+        dropped = 0
+        if self.num_hosts > 1:
+            deadline_at = time.monotonic() + heal_timeout_s()
+            self._heal_transport(deadline_at)
+            frame = {GENERATION_KEY: {"gen": self.generation,
+                                      "rank": self.my_rank}}
+            for peer in range(self.num_hosts):
+                if peer == self.my_rank:
+                    continue
+                while True:
+                    try:
+                        dropped += self._gen_barrier_peer(
+                            peer, frame, deadline_at)
+                        break
+                    except (ClusterAbort, CollectiveHangTimeout):
+                        raise
+                    except (ConnectionError, OSError) as e:
+                        if (isinstance(e, TimeoutError)
+                                and not isinstance(e, ConnectionError)):
+                            # bounded-send expiry with nothing written:
+                            # the stream is INTACT (the peer is just
+                            # slow to drain) — retry the barrier within
+                            # the heal deadline instead of dropping a
+                            # healthy authenticated link (duplicate
+                            # markers are filtered on receipt)
+                            if time.monotonic() >= deadline_at:
+                                raise
+                            continue
+                        # the link itself died (or was already dead on
+                        # this side): give the transport one repair
+                        # attempt per error, bounded by the heal
+                        # deadline
+                        if (time.monotonic() >= deadline_at
+                                or not self._repair_connection(
+                                    peer, deadline_at, e)):
+                            raise
+        # markers at or below the settled generation are used up; only
+        # evidence of an even NEWER domain (a concurrent further heal)
+        # survives for the next barrier
+        self._gen_markers = {p: g for p, g in self._gen_markers.items()
+                             if g > self.generation}
+        self.stats_stale_dropped += dropped
+        if dropped:
+            faults.note("recovery", what="net.generation_drain",
+                        gen=self.generation, dropped=dropped)
+        return dropped
+
+    def _gen_barrier_peer(self, peer: int, frame: dict,
+                          deadline_at: float) -> int:
+        """Send ``peer`` the generation marker, then drain its channel
+        up to the peer's own marker. Returns stale frames dropped."""
+        conn = self.connection(peer)
+        conn.send_bounded(frame,
+                          min(max(deadline_at - time.monotonic(), 0.1),
+                              5.0))
+        if self._gen_markers.get(peer, 0) >= self.generation:
+            # the peer's marker was already consumed by a payload recv
+            # (the missed-abort path): the barrier is satisfied
+            return 0
+        dropped = 0
+        while True:
+            remaining = deadline_at - time.monotonic()
+            if remaining <= 0:
+                raise CollectiveHangTimeout(
+                    f"generation barrier: no gen-{self.generation} "
+                    f"marker from rank {peer} within "
+                    f"{heal_timeout_s()}s (THRILL_TPU_HEAL_TIMEOUT_S)")
+            obj = conn.recv_deadline(remaining)
+            if isinstance(obj, dict) and HEARTBEAT_KEY in obj:
+                self._hb_last[peer] = time.monotonic()
+                continue
+            if isinstance(obj, dict) and GENERATION_KEY in obj:
+                g = int(obj[GENERATION_KEY].get("gen", 0))
+                if g >= self.generation:
+                    return dropped          # barrier reached
+                dropped += 1                # stale marker of an older heal
+                continue
+            if isinstance(obj, dict) and POISON_KEY in obj:
+                info = obj[POISON_KEY]
+                g = int(info.get("gen", self.generation))
+                if g >= self.generation:
+                    # a NEW failure arrived during the heal itself
+                    raise ClusterAbort(
+                        int(info.get("origin", peer)),
+                        str(info.get("cause", "unknown")),
+                        generation=g,
+                        recoverable=not bool(info.get("unrecoverable",
+                                                      False)))
+                dropped += 1
+                continue
+            dropped += 1                    # pre-abort payload frame
 
     # ------------------------------------------------------------------
     # collectives (generic over connections; reference net/collective.hpp)

@@ -34,7 +34,7 @@ from typing import Optional
 
 from ..common import faults
 from ..common.retry import default_policy
-from .group import F_HEARTBEAT, HEARTBEAT_KEY, Group
+from .group import F_HEARTBEAT, HEARTBEAT_KEY, Group, heal_timeout_s
 
 
 def heartbeat_interval_s() -> Optional[float]:
@@ -58,6 +58,11 @@ class HeartbeatMonitor:
         # one bounded-backoff policy for all probes: a single EAGAIN
         # blip must not declare a peer dead
         self._policy = default_policy()
+        # first time each peer's link was seen down-but-repairable:
+        # the monitor defers to the generation heal for a bounded
+        # window only — a link that stays broken far past any heal
+        # deadline with no repair is a dead peer after all
+        self._broken_since: dict = {}
 
     def start(self) -> "HeartbeatMonitor":
         if self.group.num_hosts <= 1 or self._thread is not None:
@@ -83,6 +88,22 @@ class HeartbeatMonitor:
             for peer in range(g.num_hosts):
                 if peer == g.my_rank or self._stop.is_set():
                     continue
+                if g.link_repairable(peer):
+                    if self._defer_to_heal(peer):
+                        # the link is down but a generation heal can
+                        # reconnect it: that is a PIPELINE-scoped event
+                        # the heal owns — probing now would fast-fail
+                        # on the broken mark and misrule a dropped LINK
+                        # as a dead PROCESS (if nobody answers the
+                        # reconnect, the heal's dial budget produces
+                        # that verdict instead)
+                        continue
+                    # deferral window spent with no heal: probe (and
+                    # fast-fail into the dead verdict) after all
+                else:
+                    # link healthy or repaired: a LATER drop is a new
+                    # incident with its own full deferral window
+                    self._broken_since.pop(peer, None)
                 try:
                     self._probe(peer, frame)
                 except TimeoutError:
@@ -90,6 +111,14 @@ class HeartbeatMonitor:
                     # dead — the collective watchdog owns that verdict
                     continue
                 except Exception as e:
+                    if (g.link_repairable(peer)
+                            and self._defer_to_heal(peer)):
+                        # the probe itself was first to discover the
+                        # drop (send failed, link now marked broken):
+                        # re-check repairability AFTER the failure too,
+                        # or the first-to-hit probe would misrule a
+                        # reconnectable drop as a dead process
+                        continue
                     cause = (f"heartbeat: rank {peer} is unreachable "
                              f"({type(e).__name__}: {e}"
                              f"{self._staleness(peer)}) — worker "
@@ -99,6 +128,28 @@ class HeartbeatMonitor:
                     g.mark_dead(peer, cause)
                     self._stop.set()
                     return
+
+    def _defer_to_heal(self, peer: int) -> bool:
+        """Should a down-but-repairable link still be left to the
+        generation heal? Only within a bounded window (2x the heal
+        deadline) of the CURRENT incident: an application that never
+        heals (no ctx.pipeline() in use) must still get the dead-peer
+        verdict eventually, or silent worker loss goes unreported.
+        The window is keyed to the group's repair counter, not to the
+        monitor observing a healthy instant — under sustained drops
+        (one per pipeline, each healed) every probe pass may sample
+        the link mid-incident, and an accumulated window would issue a
+        false dead-process verdict for a peer whose every heal
+        succeeded."""
+        now = time.monotonic()
+        repairs = getattr(self.group, "stats_reconnects", 0)
+        first, seen = self._broken_since.get(peer, (now, repairs))
+        if repairs != seen:
+            # a repair landed since this incident began: whatever is
+            # broken NOW is a new incident with a fresh window
+            first, seen = now, repairs
+        self._broken_since[peer] = (first, seen)
+        return now - first < 2.0 * heal_timeout_s()
 
     def _staleness(self, peer: int) -> str:
         """Last inbound heartbeat seen from ``peer``, for the verdict
@@ -115,12 +166,14 @@ class HeartbeatMonitor:
                 f"{time.monotonic() - last:.1f}s ago")
 
     def _probe(self, peer: int, frame: dict) -> None:
-        conn = self.group.connection(peer)
         bound = max(self.interval_s, 0.25)
 
         def once():
             faults.check(F_HEARTBEAT, peer=peer)
-            conn.send_bounded(frame, bound)
+            # re-fetch per attempt: a concurrent generation heal may
+            # swap in a freshly reconnected connection mid-retry — the
+            # probe must judge the CURRENT link, not the dropped one
+            self.group.connection(peer).send_bounded(frame, bound)
 
         self._policy.run(once, what="net.heartbeat", seed=peer)
 

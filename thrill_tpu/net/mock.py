@@ -22,21 +22,33 @@ class _MockConnection(Connection):
     def __init__(self, out_q: "queue.Queue[Any]", in_q: "queue.Queue[Any]") -> None:
         self._out = out_q
         self._in = in_q
+        # simulated link drop (MockGroup.drop_link): a broken mock
+        # connection refuses traffic like a closed socket, so the
+        # Context heal / generation-barrier repair path is testable
+        # without real sockets
+        self.broken = False
+
+    def _check_link(self) -> None:
+        if self.broken:
+            raise ConnectionError("mock link dropped")
 
     def send(self, obj: Any) -> Optional[int]:
         # objects pass by reference — nothing is serialized, so there
         # is no wire byte count to report (callers measuring frame
         # bytes fall back to an explicit wire.dumps)
+        self._check_link()
         self._out.put(obj)
         return None
 
     def recv(self) -> Any:
+        self._check_link()
         return self._in.get()
 
     def recv_deadline(self, deadline_s: float) -> Any:
         """Timed receive for the collective watchdog (net/group.py) —
         the mock transport honors THRILL_TPU_HANG_TIMEOUT_S too, so
         the hang-abort protocol is testable without sockets."""
+        self._check_link()
         try:
             return self._in.get(timeout=deadline_s)
         except queue.Empty:
@@ -58,6 +70,34 @@ class MockGroup(Group):
         if peer == self.my_rank:
             raise ValueError("no connection to self")
         return self._conns[peer]
+
+    def drop_link(self, peer: int) -> None:
+        """Simulate a dropped link to ``peer`` (tests): traffic raises
+        ConnectionError until a generation heal repairs it."""
+        self._conns[peer].broken = True
+
+    def _repair_connection(self, peer, deadline_at, cause=None) -> bool:
+        """Mock links 'reconnect' by clearing the broken flag — the
+        queues never actually died. In-flight frames queued before the
+        drop survive (like kernel-buffered bytes on a real socket) and
+        are discarded by the generation-barrier drain."""
+        conn = self._conns[peer]
+        if not conn.broken:
+            return False
+        conn.broken = False
+        self.stats_reconnects += 1
+        from ..common import faults
+        faults.note("recovery", what="net.reconnect", peer=peer,
+                    gen=self.generation, transport="mock")
+        return True
+
+    def _heal_transport(self, deadline_at: float) -> None:
+        for peer in range(self.num_hosts):
+            if peer != self.my_rank and self._conns[peer].broken:
+                self._repair_connection(peer, deadline_at)
+
+    def link_repairable(self, peer: int) -> bool:
+        return self._conns[peer].broken
 
     @property
     def supports_recv_any(self) -> bool:

@@ -52,6 +52,34 @@ _F_FLUSH = faults.declare("net.tcp.flush",
                           exc=faults.InjectedConnectionError)
 _FRAME_TRANSIENT = (faults.InjectedConnectionError,)
 
+# link-drop injection: an armed fire REALLY closes the socket
+# mid-exchange (kind="permanent" at the frame layer — nothing can
+# resynchronize a torn stream), surfacing as a plain ConnectionError so
+# no per-frame retry absorbs it. The current pipeline aborts; the
+# generation heal (Group.begin_generation -> _repair_connection)
+# reconnects the link for the next one.
+_F_DISCONNECT = faults.declare("net.tcp.disconnect", kind="permanent")
+
+
+def _reconnect_enabled() -> bool:
+    """THRILL_TPU_RECONNECT=0 disables link repair: a dropped socket
+    then stays fatal for the Context (pre-reconnect behavior)."""
+    return os.environ.get("THRILL_TPU_RECONNECT", "1") != "0"
+
+
+def _reconnect_tries() -> int:
+    """UNANSWERED dial attempts per link repair
+    (THRILL_TPU_RECONNECT_TRIES, default 25; backoff rides the shared
+    full-jitter policy). Generous by design: during a multi-link heal
+    a live peer repairs its links sequentially, so early dials land on
+    a port nobody is listening on yet — the budget must outlast that
+    window, and the heal deadline stays the hard bound."""
+    try:
+        return max(1, int(os.environ.get("THRILL_TPU_RECONNECT_TRIES",
+                                         "25")))
+    except ValueError:
+        return 25
+
 
 def _frame_site_check(site: str) -> None:
     """Per-frame injection gate. Only injected faults are retryable at
@@ -114,6 +142,48 @@ class TcpConnection(Connection):
         # monotonic timestamp of the last heartbeat frame seen on this
         # connection (net/heartbeat.py liveness chatter)
         self.last_heartbeat = 0.0
+        # link verdict: set when the stream died (peer closed, torn
+        # frame, injected disconnect). A broken connection refuses
+        # traffic fast; the generation heal replaces it via reconnect
+        self.broken = False
+
+    def _drop_link(self) -> None:
+        """Tear this link down for real: detach from the async engine,
+        close the fd, mark broken. The peer sees EOF on its next read."""
+        self.broken = True
+        if self._disp is not None:
+            try:
+                self._disp.unregister(self.sock)
+            except Exception:
+                pass
+            self._disp = None
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _check_link(self) -> None:
+        """Fail fast on a known-dead link; fire the injected
+        mid-exchange socket drop when armed."""
+        if self.broken:
+            raise ConnectionError(
+                "tcp link is down (awaiting generation heal/reconnect)")
+        if faults.REGISTRY.active():
+            try:
+                faults.check(_F_DISCONNECT)
+            except faults.InjectedFault as e:
+                self._drop_link()
+                raise ConnectionError(
+                    "injected link drop (net.tcp.disconnect)") from e
+
+    def _mark_broken(self, exc: BaseException) -> None:
+        """A real transport error tore the stream: remember the verdict.
+        Injected RETRYABLE faults fire before any byte hits the wire,
+        and timeouts (TimeoutError is an OSError subclass — the
+        watchdog's CollectiveHangTimeout, send_bounded's nothing-sent
+        expiry) leave the stream intact: neither condemns the link."""
+        if not isinstance(exc, (faults.InjectedFault, TimeoutError)):
+            self.broken = True
 
     def set_dispatcher_supplier(self, supplier) -> None:
         """Enable lazy attach: ``supplier()`` returns the shared engine
@@ -214,32 +284,37 @@ class TcpConnection(Connection):
         Collectives in net/group.py never mutate sent values; callers
         reusing staging arrays across rounds must flush between them."""
         _frame_site_check(_F_SEND)
+        self._check_link()
         parts = wire.dumps_parts(obj, allow_pickle=self.authenticated)
         total = sum(len(p) for p in parts)
         bufs = [struct.pack("<I", total), *parts]
-        with self._send_lock:
-            if self._send_error is not None:
-                e, self._send_error = self._send_error, None
-                raise e
-            if self._session_key is not None:
-                # per-frame MAC: the handshake alone does not protect
-                # the stream from on-path frame injection
-                bufs.append(wire.frame_mac_parts(
-                    self._session_key, self._send_dir, self._send_seq,
-                    parts))
-                self._send_seq += 1
-            if (self._disp is None and self._disp_supplier is not None
-                    and total >= self._async_threshold):
-                # first bulk frame: hand the fd to the async engine (no
-                # recv-lock handshake needed — see attach_dispatcher)
-                self._attach_locked(self._disp_supplier())
-            if self._disp is not None:
-                self._reap_sends(block=True)
-                for b in bufs:
-                    self._enqueue_send(self._disp.async_write(self.sock, b),
-                                       len(b), _borrow_check(b))
-            else:
-                self._sendall_parts(bufs)
+        try:
+            with self._send_lock:
+                if self._send_error is not None:
+                    e, self._send_error = self._send_error, None
+                    raise e
+                if self._session_key is not None:
+                    # per-frame MAC: the handshake alone does not protect
+                    # the stream from on-path frame injection
+                    bufs.append(wire.frame_mac_parts(
+                        self._session_key, self._send_dir, self._send_seq,
+                        parts))
+                    self._send_seq += 1
+                if (self._disp is None and self._disp_supplier is not None
+                        and total >= self._async_threshold):
+                    # first bulk frame: hand the fd to the async engine (no
+                    # recv-lock handshake needed — see attach_dispatcher)
+                    self._attach_locked(self._disp_supplier())
+                if self._disp is not None:
+                    self._reap_sends(block=True)
+                    for b in bufs:
+                        self._enqueue_send(self._disp.async_write(self.sock, b),
+                                           len(b), _borrow_check(b))
+                else:
+                    self._sendall_parts(bufs)
+        except (ConnectionError, OSError) as e:
+            self._mark_broken(e)
+            raise
         return total
 
     def send_bounded(self, obj: Any, deadline_s: float) -> None:
@@ -256,6 +331,9 @@ class TcpConnection(Connection):
         here like in send(), not silently dropped. A wedged sender
         already holding the send lock also counts against the
         deadline."""
+        if self.broken:
+            raise ConnectionError(
+                "tcp link is down (awaiting generation heal/reconnect)")
         deadline_at = time.monotonic() + float(deadline_s)
         if not self._send_lock.acquire(timeout=deadline_s):
             raise TimeoutError("send_bounded: send lock busy past the "
@@ -330,6 +408,9 @@ class TcpConnection(Connection):
                         self.sock.setblocking(True)
                     except OSError:
                         pass
+        except (ConnectionError, OSError) as e:
+            self._mark_broken(e)
+            raise
         finally:
             self._send_lock.release()
 
@@ -402,20 +483,40 @@ class TcpConnection(Connection):
         return self._recv_msg(time.monotonic() + float(deadline_s))
 
     def _recv_msg(self, deadline_at: Optional[float]) -> Any:
+        self._check_link()
+        try:
+            return self._recv_msg_inner(deadline_at)
+        except (ConnectionError, OSError) as e:
+            self._mark_broken(e)
+            raise
+
+    def _recv_msg_inner(self, deadline_at: Optional[float]) -> Any:
         while True:   # heartbeat frames are liveness chatter, not data
             with self._recv_lock:
                 header = self._recv_exact(4, deadline_at)
-                (size,) = struct.unpack("<I", header)
-                payload = self._recv_exact(size, deadline_at)
-                if self._session_key is not None:
-                    mac = self._recv_exact(wire._MAC_LEN, deadline_at)
-                    want = wire.frame_mac(self._session_key,
-                                          self._recv_dir,
-                                          self._recv_seq, payload)
-                    import hmac as _hmac
-                    if not _hmac.compare_digest(mac, want):
-                        raise wire.AuthError("wire: frame MAC mismatch")
-                    self._recv_seq += 1
+                try:
+                    (size,) = struct.unpack("<I", header)
+                    payload = self._recv_exact(size, deadline_at)
+                    if self._session_key is not None:
+                        mac = self._recv_exact(wire._MAC_LEN,
+                                               deadline_at)
+                        want = wire.frame_mac(self._session_key,
+                                              self._recv_dir,
+                                              self._recv_seq, payload)
+                        import hmac as _hmac
+                        if not _hmac.compare_digest(mac, want):
+                            raise wire.AuthError(
+                                "wire: frame MAC mismatch")
+                        self._recv_seq += 1
+                except CollectiveHangTimeout:
+                    # the deadline fired MID-FRAME: the header (and
+                    # possibly part of the payload) is already
+                    # consumed, so the stream is desynchronized — a
+                    # later read would parse payload bytes as a frame
+                    # length. Condemn the link; the generation heal
+                    # reconnects it instead of reusing garbage.
+                    self.broken = True
+                    raise
                 obj = wire.loads(payload,
                                  allow_pickle=self.authenticated)
             # opportunistic: drop pins of completed async sends (send/
@@ -463,6 +564,11 @@ class TcpConnection(Connection):
                 remaining = deadline_at - time.monotonic()
                 if remaining <= 0 or self._disp.wait(
                         rid, remaining) == 0:
+                    # the orphaned async read stays queued on the
+                    # engine and will consume the next arriving bytes
+                    # into a fetch nobody reads: the stream cannot be
+                    # resynchronized — condemn the link for the heal
+                    self.broken = True
                     raise CollectiveHangTimeout(
                         f"no frame within the recv deadline "
                         f"({n} bytes outstanding)")
@@ -472,6 +578,10 @@ class TcpConnection(Connection):
             if deadline_at is not None:
                 remaining = deadline_at - time.monotonic()
                 if remaining <= 0:
+                    if chunks:
+                        # partial read: later reads would misparse the
+                        # remaining bytes — the stream is torn
+                        self.broken = True
                     raise CollectiveHangTimeout(
                         f"no frame within the recv deadline "
                         f"({n} bytes outstanding)")
@@ -527,6 +637,12 @@ class TcpGroup(Group):
         # liveness prober (net/heartbeat.py); None unless
         # THRILL_TPU_HEARTBEAT_S is set
         self._heartbeat = None
+        # reconnect endpoints: construct_tcp_group stores the hostlist
+        # + shared secret so a generation heal can re-dial a dropped
+        # link with the same session-handshake guarantees as bootstrap.
+        # None (socketpair-built test groups) = reconnect unavailable.
+        self._hosts: Optional[List[Tuple[str, int]]] = None
+        self._secret: Optional[bytes] = None
 
     def connection(self, peer: int) -> TcpConnection:
         if peer == self.my_rank:
@@ -577,6 +693,198 @@ class TcpGroup(Group):
                     p.unregister(fd)
                 except (KeyError, OSError):
                     pass
+
+    # ------------------------------------------------------------------
+    # reconnect-with-backoff (generation heal, net/group.py)
+    # ------------------------------------------------------------------
+
+    def _heal_transport(self, deadline_at: float) -> None:
+        """Repair every link already KNOWN broken before the generation
+        barrier runs; a link that cannot be repaired fails the heal
+        (the Context then escalates to the unrecoverable path)."""
+        # ASCENDING peer order on every rank: with lower-listens /
+        # higher-dials roles this is ordered resource acquisition —
+        # concurrent multi-link heals cannot form a cyclic
+        # accept/dial wait (dict insertion order is bootstrap accept
+        # completion order, which CAN cycle)
+        for peer in sorted(self._conns):
+            conn = self._conns[peer]
+            if getattr(conn, "broken", False):
+                if not self._repair_connection(peer, deadline_at):
+                    raise ConnectionError(
+                        f"rank {self.my_rank}: link to rank {peer} is "
+                        f"down and could not be reconnected "
+                        f"(THRILL_TPU_RECONNECT/"
+                        f"THRILL_TPU_RECONNECT_TRIES)")
+
+    def _repair_connection(self, peer: int, deadline_at: float,
+                           cause: Optional[BaseException] = None) -> bool:
+        """Re-establish the link to ``peer``: same roles as bootstrap
+        (lower rank listens, higher rank dials), mutual auth when a
+        secret is configured, then a session handshake exchanging
+        (rank, generation, frame seq) so both sides agree which failure
+        domain the fresh stream belongs to. Returns False when
+        reconnect is disabled/unavailable or the peer never answers
+        (a dead PROCESS, not a dropped link — that verdict escalates)."""
+        old = self._conns.get(peer)
+        if old is not None:
+            # idempotent: closes the fd even when an earlier recv
+            # error already marked the link broken (a peer-closed
+            # socket stays open on OUR side until dropped)
+            old._drop_link()
+        if self._hosts is None or not _reconnect_enabled():
+            return False
+        try:
+            if peer > self.my_rank:
+                conn = self._reconnect_accept(peer, deadline_at)
+            else:
+                conn = self._reconnect_dial(peer, deadline_at)
+        except wire.AuthError:
+            raise                   # definitive: never degrade auth
+        except (ConnectionError, OSError, TimeoutError) as e:
+            faults.note("recovery", what="net.reconnect_failed",
+                        peer=peer, gen=self.generation, error=repr(e))
+            return False
+        if old is not None and old._disp_supplier is not None:
+            conn.set_dispatcher_supplier(self._shared_dispatcher)
+        self._conns[peer] = conn
+        self.stats_reconnects += 1
+        faults.note("recovery", what="net.reconnect", peer=peer,
+                    gen=self.generation, transport="tcp")
+        return True
+
+    def link_repairable(self, peer: int) -> bool:
+        conn = self._conns.get(peer)
+        return (conn is not None and getattr(conn, "broken", False)
+                and self._hosts is not None and _reconnect_enabled())
+
+    def _handshake_frame(self) -> dict:
+        # a FRESH stream restarts the MAC sequence: seq announces (and
+        # the peer validates) where frame numbering resumes
+        return {"reconnect": self.my_rank, "gen": self.generation,
+                "seq": 0}
+
+    def _validate_handshake(self, obj: Any, want_rank: int) -> int:
+        if not (isinstance(obj, dict) and "reconnect" in obj):
+            raise ConnectionError(f"bad reconnect handshake {obj!r}")
+        if int(obj["reconnect"]) != want_rank:
+            raise ConnectionError(
+                f"reconnect handshake from unexpected rank "
+                f"{obj['reconnect']!r} (awaiting {want_rank})")
+        if int(obj.get("seq", 0)) != 0:
+            raise ConnectionError(
+                f"reconnect handshake with nonzero frame seq "
+                f"{obj.get('seq')!r} — peer expects a resumed stream, "
+                f"only fresh sessions are supported")
+        gen = int(obj.get("gen", self.generation))
+        if gen != self.generation:
+            # both sides must be healing the SAME failure domain; a
+            # cross-generation stream (one rank aborted again while
+            # the other was still dialing) is rejected LOUDLY here —
+            # the dialer retries and converges, instead of the
+            # mismatch surfacing as an opaque barrier timeout
+            raise ConnectionError(
+                f"reconnect handshake generation mismatch: peer is "
+                f"healing gen {gen}, this rank gen {self.generation}")
+        return gen
+
+    def _reconnect_dial(self, peer: int,
+                        deadline_at: float) -> TcpConnection:
+        import random
+        policy = default_policy(max_attempts=1 << 30,
+                                base_delay_s=0.05, max_delay_s=1.0)
+        rng = random.Random(f"reconnect:{self.my_rank}:{peer}")
+        tries = _reconnect_tries()
+        attempt = 0             # dead-process budget: UNANSWERED dials
+        rounds = 0              # backoff progression across all errors
+        while True:
+            connected = False
+            try:
+                s = socket.create_connection(self._hosts[peer],
+                                             timeout=2.0)
+                connected = True
+                s.settimeout(min(10.0, max(
+                    deadline_at - time.monotonic(), 1.0)))
+                conn = TcpConnection(s)
+                try:
+                    _exchange_auth_flag(conn, self._secret is not None)
+                    if self._secret is not None:
+                        conn.authenticate(self._secret, role="client")
+                    conn.send(self._handshake_frame())
+                    self._validate_handshake(conn.recv(), peer)
+                except Exception:
+                    conn.close()
+                    raise
+                s.settimeout(None)
+                return conn
+            except wire.AuthError:
+                raise
+            except OSError as e:
+                # only UNANSWERED dials spend the dead-process budget
+                # (THRILL_TPU_RECONNECT_TRIES): a rejection after the
+                # connect succeeded means the peer PROCESS is alive —
+                # e.g. its one-port acceptor is mid-repair of another
+                # link, or a cross-generation handshake — and must not
+                # burn the budget toward a false dead verdict. The
+                # heal deadline stays the overall bound.
+                rounds += 1
+                if not connected:
+                    attempt += 1
+                if (attempt >= tries
+                        or time.monotonic() >= deadline_at):
+                    raise ConnectionError(
+                        f"rank {self.my_rank}: reconnect to rank "
+                        f"{peer} failed after {attempt} unanswered "
+                        f"dials / {rounds} rounds") from e
+                d = policy.delay(min(rounds, 6), rng)
+                faults.note("retry", _quiet=rounds > 3,
+                            what="tcp.reconnect_dial", peer=peer,
+                            attempt=rounds, delay_s=round(d, 4),
+                            error=repr(e))
+                time.sleep(min(d, max(
+                    deadline_at - time.monotonic(), 0.0)))
+
+    def _reconnect_accept(self, peer: int,
+                          deadline_at: float) -> TcpConnection:
+        host, port = self._hosts[self.my_rank]
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            srv.bind((host if host != "localhost" else "127.0.0.1",
+                      port))
+            srv.listen(4)
+            srv.settimeout(0.5)
+            while time.monotonic() < deadline_at:
+                try:
+                    s, addr = srv.accept()
+                except socket.timeout:
+                    continue
+                s.settimeout(min(10.0, max(
+                    deadline_at - time.monotonic(), 1.0)))
+                conn = TcpConnection(s)
+                try:
+                    _exchange_auth_flag(conn, self._secret is not None)
+                    if self._secret is not None:
+                        conn.authenticate(self._secret, role="server")
+                    self._validate_handshake(conn.recv(), peer)
+                    conn.send(self._handshake_frame())
+                except Exception as e:
+                    # a different rank's dialer (several links healing
+                    # at once) or a rogue connection: reject, keep
+                    # listening — the rejected dialer retries
+                    conn.close()
+                    import sys
+                    print(f"thrill_tpu.net.tcp: rank {self.my_rank} "
+                          f"rejected reconnect from {addr}: {e}",
+                          file=sys.stderr)
+                    continue
+                s.settimeout(None)
+                return conn
+            raise ConnectionError(
+                f"rank {self.my_rank}: reconnect accept from rank "
+                f"{peer} timed out")
+        finally:
+            srv.close()
 
     def _shared_dispatcher(self):
         """One async engine per group, created on first bulk frame (a
@@ -849,6 +1157,10 @@ def construct_tcp_group(rank: int, hosts: List[Tuple[str, int]],
         raise errors[0]
     assert len(conns) == p - 1
     group = TcpGroup(rank, p, conns)
+    # remember the endpoints + secret: the generation heal re-dials a
+    # dropped link through the same authenticated handshake
+    group._hosts = list(hosts)
+    group._secret = secret
     # lazy async engine on by default: control frames stay blocking
     # (fast path), bulk frames ride the dispatcher
     # (THRILL_TPU_ASYNC_NET=0 disables; THRILL_TPU_ASYNC_THRESHOLD

@@ -445,6 +445,21 @@ class MeshExec:
             self._pending_checks.extend(checks)
             raise
 
+    def reset_run_state(self) -> int:
+        """Abandon the aborted pipeline's per-run execution state: the
+        deferred-check queue (their producer shards are being
+        disposed; a surviving older node's shards still re-validate at
+        their own pull — the queue is only the backstop) and any live
+        loop-capture recorder. Learned, value-independent state —
+        compiled programs, sticky exchange capacities, narrow specs,
+        plan kinds — survives: the next pipeline reuses it and stays
+        bit-identical to a fresh-Context run by construction. Returns
+        the number of checks dropped."""
+        dropped = len(self._pending_checks)
+        self._pending_checks.clear()
+        self.loop_recorder = None
+        return dropped
+
     def _fetch_raw(self, arr) -> np.ndarray:
         """fetch() without stats or check-draining — for the deferred
         checks themselves (their transfers are tiny, ride a completed

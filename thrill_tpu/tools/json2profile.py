@@ -133,7 +133,11 @@ def render_html(events: List[dict]) -> str:
                                 "oom_retry", "segment_split"):
             memory.append((t, e))
         elif e.get("event") in ("fault_injected", "retry", "recovery",
-                                "abort"):
+                                "abort", "pipeline_abort", "heal"):
+            # the abort/heal lane: scoped pipeline failures and their
+            # generation heals render chronologically alongside the
+            # faults that caused them (reconnects arrive as
+            # event=recovery what=net.reconnect)
             faults.append((t, e))
         elif e.get("event") == "fused_dispatch":
             fused.append(e)
