@@ -365,9 +365,15 @@ def _run_bench() -> None:
          wire_compress_ratio=float(
              press.get("wire_compress_ratio", 1.0)))
 
+    # sustained-traffic serve lane (service/scheduler.py): closed-loop
+    # client threads submitting a mixed WordCount/PageRank workload
+    # through ctx.submit — qps + latency percentiles make throughput
+    # regressions as loud as the dispatch budgets
+    sv = _serve_metric(ctx)
+
     _emit(value=round(mrec_s, 3),
           vs_baseline=round(mrec_s / host_mrec_s, 3),
-          **wc, **prm, **kmm, **sfm, **em, **ck)
+          **wc, **prm, **kmm, **sfm, **em, **ck, **sv)
     ctx.close()
 
 
@@ -704,6 +710,105 @@ def _em_sort_metric(ctx) -> dict:
         return out
     except Exception as e:  # tertiary metric never kills the line
         return {"em_sort_error": repr(e)[:200]}
+
+
+def _serve_kv(x):
+    return (x % 257, x)
+
+
+def _serve_add(a, b):
+    return a + b
+
+
+def _serve_metric(ctx) -> dict:
+    """Sustained-traffic serve lane (service/scheduler.py): closed-loop
+    client threads — each submits its next job only after the previous
+    one resolved — driving a mixed WordCount-shaped ReduceByKey /
+    PageRank workload through ``ctx.submit`` under two tenants.
+    Reports queries/s, p50/p99 submit-to-result latency, mean queue
+    wait, and the plan-store hit counter (nonzero when the operator
+    exported THRILL_TPU_PLAN_STORE and this process warm-started), so
+    a serving-throughput regression is as loud as a dispatch-budget
+    one. Sizes stay small: the lane measures the service plane's
+    overhead and fairness machinery, not raw operator throughput (the
+    dedicated lanes above own that)."""
+    try:
+        import threading
+
+        _examples_path()
+        import page_rank as pr
+        n_wc = 1 << 13
+        edges = pr.zipf_graph(512, 1 << 12, seed=5)
+        try:
+            clients = int(os.environ.get("THRILL_TPU_BENCH_SERVE_CLIENTS",
+                                         "") or 3)
+            per_client = int(os.environ.get("THRILL_TPU_BENCH_SERVE_JOBS",
+                                            "") or 4)
+        except ValueError:
+            clients, per_client = 3, 4
+        data = np.arange(n_wc, dtype=np.int64)
+
+        def wordcount_job(c):
+            c.Distribute(data).Map(_serve_kv).ReducePair(
+                _serve_add).Size()
+            return None
+
+        def pagerank_job(c):
+            return pr.page_rank(c, edges, 512, iterations=2)
+
+        # warmup through the scheduler so compiles stay out of the
+        # timed window (every other lane warms up the same way);
+        # bounded like the client loop — a wedged dispatcher must
+        # degrade to serve_error, never hang the whole bench line
+        ctx.submit(wordcount_job, tenant="t0").result(600)
+        ctx.submit(pagerank_job, tenant="t1").result(600)
+
+        lat: list = []
+        waits: list = []
+        errors: list = []
+        lock = threading.Lock()
+
+        def client(i: int):
+            for j in range(per_client):
+                fn = wordcount_job if (i + j) % 2 == 0 else pagerank_job
+                t0 = time.perf_counter()
+                try:
+                    fut = ctx.submit(fn, tenant=f"t{i % 2}",
+                                     name=f"c{i}-j{j}")
+                    fut.result(600)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(e)[:200])
+                    return
+                with lock:
+                    lat.append(time.perf_counter() - t0)
+                    waits.append(fut.queue_wait_s)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors or not lat:
+            return {"serve_error": (errors or ["no jobs completed"])[0]}
+        lat.sort()
+        stats = ctx.overall_stats()
+        return {
+            "serve_qps": round(len(lat) / wall, 3),
+            "serve_p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+            "serve_p99_ms": round(
+                lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1e3, 2),
+            "serve_jobs": len(lat),
+            "queue_wait_s": round(sum(waits) / len(waits), 4),
+            "queue_depth_peak": int(stats.get("queue_depth_peak", 0)),
+            "plan_store_hits": int(stats.get("plan_store_hits", 0)),
+            "plan_builds": int(stats.get("plan_builds", 0)),
+        }
+    except Exception as e:  # secondary metric never kills the line
+        return {"serve_error": repr(e)[:200]}
 
 
 def _ckpt_metric(n: int) -> dict:

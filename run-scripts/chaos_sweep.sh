@@ -48,9 +48,19 @@ if [[ "${CHAOS_SURVIVE:-0}" == "1" ]]; then
   # -m 'not slow' keeps only one representative seed per fault class
   TARGETS+=(tests/api/test_survive.py tests/net/test_generation.py)
 fi
+if [[ "${CHAOS_SERVE:-0}" == "1" ]]; then
+  # service-plane sweep (tests/service/, chaos-marked): seeded fault
+  # classes fired into a serving Context — every failed job must
+  # resolve its OWN future as a PipelineError while the queue drains
+  # the rest exactly, and a corrupt/version-skewed plan store must
+  # degrade loudly to recompile, never wrong results. N_SEEDS scales
+  # the sweep via THRILL_TPU_SERVE_SEEDS.
+  TARGETS+=(tests/service/test_service_chaos.py)
+fi
 
 exec env JAX_PLATFORMS=cpu THRILL_TPU_CHAOS_SEEDS="$N_SEEDS" \
     THRILL_TPU_CHAOS_KILL_SEEDS="$N_SEEDS" \
     THRILL_TPU_SURVIVE_SEEDS="$N_SEEDS" \
+    THRILL_TPU_SERVE_SEEDS="$N_SEEDS" \
     python -m pytest -m chaos -q -p no:cacheprovider \
     "${TARGETS[@]}" "$@"

@@ -228,6 +228,7 @@ def test_disjoint_window():
     sweep(job)
 
 
+@pytest.mark.slow  # tier-1 budget: concat/union composites ride the fuzz chains
 def test_concat_and_rebalance():
     def job(ctx):
         a = ctx.Generate(25)
@@ -557,6 +558,7 @@ def test_group_to_index_device_fn():
     sweep(job)
 
 
+@pytest.mark.slow  # tier-1 budget: test_merge_sorted keeps the merge family in-tier
 def test_merge_three_inputs_with_ties():
     """Merge exploits sortedness; ties order by input index (the
     reference's tie ordering), sizes may differ."""

@@ -660,6 +660,71 @@ def _ex_loop_replay():
     assert faults.REGISTRY.injected >= 1
 
 
+def _ex_service_submit():
+    """service.submit (service/scheduler.py): fires at job admission
+    INSIDE the job's pipeline() failure domain — exactly that job's
+    future resolves with a PipelineError (correct generation), the
+    Context heals, and a later job on the same Context runs exact."""
+    from thrill_tpu.api import Context, PipelineError
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    def job(c):
+        return sorted(int(x) for x in c.Distribute(
+            np.arange(24, dtype=np.int64)).Map(
+                lambda x: x + 1).AllGather())
+
+    with faults.inject("service.submit", n=1, seed=7):
+        ctx = Context(MeshExec(num_workers=2))
+        f1 = ctx.submit(job)
+        err = f1.exception(300)
+        assert isinstance(err, PipelineError), err
+        f2 = ctx.submit(job)
+        got = f2.result(300)
+        stats = ctx.overall_stats()
+        ctx.close()
+    assert got == list(range(1, 25))
+    assert stats["jobs_failed"] == 1
+    assert stats["pipeline_aborts"] == 1
+    assert faults.REGISTRY.injected >= 1
+
+
+def _ex_plan_store_corrupt():
+    """service.plan_store.corrupt (service/plan_store.py): an armed
+    fire makes a VALID store read as corrupt at load — the service
+    degrades LOUDLY to cold recompile (recovery event, zero seeds),
+    results exact; the close rewrites a valid store."""
+    import dataclasses
+    import shutil
+    import tempfile
+
+    from thrill_tpu.api import Context
+    from thrill_tpu.common.config import Config
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    def run(cfg):
+        ctx = Context(MeshExec(num_workers=2), cfg)
+        got = sorted(int(x) for x in ctx.Distribute(
+            np.arange(16, dtype=np.int64)).Map(
+                lambda x: x * 2).AllGather())
+        hits = ctx.mesh_exec.stats_plan_store_hits
+        ctx.close()
+        return got, hits
+
+    td = tempfile.mkdtemp(prefix="ttpu-pstore-")
+    try:
+        cfg = dataclasses.replace(Config.from_env(), plan_store=td)
+        want, _ = run(cfg)
+        base = faults.REGISTRY.stats()["recoveries"]
+        with faults.inject("service.plan_store.corrupt", n=1, seed=9):
+            got, hits = run(cfg)
+        assert got == want
+        assert hits == 0
+        assert faults.REGISTRY.stats()["recoveries"] > base
+        assert faults.REGISTRY.injected >= 1
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
 # sites whose exercisers live in tests/net/test_fault_injection.py
 # (they need real sockets / multi-rank groups)
 _NET_SITES = {
@@ -700,6 +765,10 @@ _MATRIX = {
     "mem.oom": _ex_mem_oom,
     "mem.spill": _ex_mem_pressure_spill,
     "mem.estimate": _ex_mem_estimate,
+    # service plane (ISSUE 9): job admission aborts into its own
+    # future; a corrupt plan store degrades to cold recompile
+    "service.submit": _ex_service_submit,
+    "service.plan_store.corrupt": _ex_plan_store_corrupt,
     "vfs.open_read": _ex_vfs_read_reopen,
     "vfs.read": _ex_vfs_read_reopen,
     "vfs.s3.read": _ex_vfs_scheme_sites,
@@ -733,6 +802,8 @@ def test_every_registered_site_is_covered():
     import thrill_tpu.net.dispatcher  # noqa: F401
     import thrill_tpu.net.tcp  # noqa: F401
     import thrill_tpu.parallel.mesh  # noqa: F401
+    import thrill_tpu.service.plan_store  # noqa: F401
+    import thrill_tpu.service.scheduler  # noqa: F401
     import thrill_tpu.vfs.file_io  # noqa: F401
     import thrill_tpu.vfs.hdfs_file  # noqa: F401
     import thrill_tpu.vfs.s3_file  # noqa: F401

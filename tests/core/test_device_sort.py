@@ -70,6 +70,7 @@ def test_chunked_matches_xla(monkeypatch, n, nwords):
     assert np.array_equal(perm_xla, perm_ch)
 
 
+@pytest.mark.slow  # tier-1 budget: chunked engine covered in-tier by test_chunked_matches_xla
 def test_chunked_all_ones_and_presorted():
     """Padding sentinel (max words) must not displace real max-valued
     keys, and already-sorted input must round-trip."""

@@ -138,6 +138,7 @@ def test_dc3_suffix_array():
     RunLocalMock(job, 4)
 
 
+@pytest.mark.slow  # tier-1 budget: sibling of the already-slow dc3; examples family stays in-tier
 def test_dc7_suffix_array():
     """DC7 golden test (reference: dc7.cpp). Periodic inputs whose
     length is a multiple of 7 stress the section-terminator logic (a
@@ -276,6 +277,7 @@ def test_percentiles():
     RunLocalMock(job, 4)
 
 
+@pytest.mark.slow  # tier-1 budget: iterative-driver family covered in-tier by k-means/PageRank
 def test_sgd():
     import sgd as sg
     rng = np.random.default_rng(29)

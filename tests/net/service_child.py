@@ -1,0 +1,81 @@
+"""Child process body for the multi-controller service-plane test.
+
+Each rank runs a long-lived Context and submits the SAME jobs in the
+same per-tenant order (the lockstep submission contract) from its main
+thread. Rank 0's dispatcher picks the cluster order under WFQ and
+broadcasts ordering frames; the follower runs exactly the announced
+job. A mid-stream failing job must resolve its OWN future with the
+PipelineError on every rank while the Context heals and later jobs
+complete normally. Prints one RESULT line for cross-rank comparison.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+from thrill_tpu.common.platform import force_cpu_platform
+
+force_cpu_platform()
+
+import numpy as np  # noqa: E402
+
+from thrill_tpu.api import RunDistributed  # noqa: E402
+from thrill_tpu.api.context import PipelineError  # noqa: E402
+from thrill_tpu.common.timeouts import scaled  # noqa: E402
+
+
+def _wordcount(mod):
+    def fn(ctx):
+        vals = np.arange(400, dtype=np.int64)
+        hist = ctx.Distribute(vals).Map(lambda x: (x % mod, 1)) \
+            .ReducePair(lambda a, b: a + b)
+        return sorted([int(k), int(v)] for k, v in hist.AllGather())
+    return fn
+
+
+def _boom(ctx):
+    # touch the mesh first so the abort happens mid-generation, not
+    # before the job's failure domain did any device work
+    ctx.Distribute(np.arange(8, dtype=np.int64)).Sum()
+    raise RuntimeError("boom: injected job failure")
+
+
+def job(ctx):
+    # one submitting thread per rank => per-tenant order is trivially
+    # rank-deterministic (the lockstep submission contract)
+    futs = {
+        "a1": ctx.submit(_wordcount(5), tenant="alpha", name="a1"),
+        "b1": ctx.submit(_wordcount(7), tenant="beta", name="b1"),
+        "bad": ctx.submit(_boom, tenant="alpha", name="bad"),
+        "a2": ctx.submit(_wordcount(3), tenant="alpha", name="a2"),
+    }
+    deadline = scaled(240.0)
+    out = {k: futs[k].result(timeout=deadline) for k in ("a1", "b1", "a2")}
+    try:
+        futs["bad"].result(timeout=deadline)
+        out["bad"] = "NO-ERROR"
+    except PipelineError as e:
+        out["bad"] = ["pipeline-error",
+                      type(e.root).__name__ if e.root is not None else "",
+                      "boom" in e.cause,
+                      futs["bad"].generation is not None]
+    svc = ctx.service.stats()
+    out["jobs_submitted"] = svc["jobs_submitted"]
+    out["jobs_failed"] = svc["jobs_failed"]
+    return out
+
+
+def main():
+    coordinator, rank = sys.argv[1], int(sys.argv[2])
+    nproc = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    from child_common import maybe_inject_fake_mpi
+    maybe_inject_fake_mpi(rank, nproc)
+    res = RunDistributed(job, coordinator_address=coordinator,
+                         num_processes=nproc, process_id=rank)
+    print("RESULT " + json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,290 @@
+"""Service plane: concurrent pipeline scheduling on one Context.
+
+Pinned acceptance for the scheduler (service/scheduler.py):
+
+* N client threads submitting concurrently on ONE Context at
+  W in {1, 2} produce results bit-identical to the same pipelines run
+  serially on a fresh Context;
+* a mid-stream job failure surfaces as a PipelineError in ITS OWN
+  JobFuture (correct root cause + generation) and heals only its
+  generation — later jobs complete normally, the queue never stalls;
+* weighted-fair queueing across tenants is deterministic and gives a
+  weight-2 tenant ~2x the slots of a weight-1 tenant under load.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from thrill_tpu.api import Context, PipelineError
+from thrill_tpu.common import faults
+from thrill_tpu.parallel.mesh import MeshExec
+from thrill_tpu.service.scheduler import JobFuture, WfqQueue
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    monkeypatch.delenv("THRILL_TPU_SERVE_WEIGHTS", raising=False)
+    monkeypatch.delenv("THRILL_TPU_SERVE_HBM_BUDGETS", raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+# module-level functors: stable identities keep the exchange-site
+# caches (and with them the dispatch/plan budgets) shared across runs
+def _kv7(x):
+    return (x % 7, x)
+
+
+def _kv5(x):
+    return (x % 5, x * 2)
+
+
+def _add(a, b):
+    return a + b
+
+
+def _mul17(x):
+    return x * 1.7
+
+
+def _reduce_job(ctx):
+    return sorted((int(k), int(v)) for k, v in ctx.Distribute(
+        np.arange(96, dtype=np.int64)).Map(_kv7).ReducePair(
+            _add).AllGather())
+
+
+def _reduce_job2(ctx):
+    return sorted((int(k), int(v)) for k, v in ctx.Distribute(
+        np.arange(64, dtype=np.int64)).Map(_kv5).ReducePair(
+            _add).AllGather())
+
+
+def _float_job(ctx):
+    # order-sensitive float math: the bit-identity probe
+    return float(ctx.Distribute(
+        np.linspace(0.0, 1.0, 41)).Map(_mul17).Sum())
+
+
+_JOBS = [_reduce_job, _reduce_job2, _float_job]
+
+
+def _midstream_boom(ctx):
+    ctx.Distribute(np.arange(16, dtype=np.int64)).Map(_kv7).Size()
+    raise RuntimeError("mid-stream failure")
+
+
+@pytest.mark.parametrize("W", [1, 2])
+def test_concurrent_submission_bit_identical_to_serial(W):
+    """The pinned acceptance scenario: N client threads on ONE
+    Context, one job failing MID-STREAM — the failure resolves its own
+    future as a PipelineError (healed generation) while every other
+    job's result is bitwise identical to serial execution on a fresh
+    Context."""
+    serial_ctx = Context(MeshExec(num_workers=W))
+    want = [fn(serial_ctx) for fn in _JOBS]
+    serial_ctx.close()
+
+    ctx = Context(MeshExec(num_workers=W))
+    futures: dict = {}
+    boom_holder: dict = {}
+
+    def client(i):
+        for j, fn in enumerate(_JOBS):
+            futures[(i, j)] = ctx.submit(fn, tenant=f"t{i}",
+                                         name=f"c{i}-{fn.__name__}")
+            if i == 1 and j == 1:
+                # one mid-stream failure, racing the healthy streams
+                boom_holder["f"] = ctx.submit(_midstream_boom,
+                                              tenant=f"t{i}",
+                                              name="boom")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    got = {k: f.result(300) for k, f in futures.items()}
+    with pytest.raises(PipelineError) as ei:
+        boom_holder["f"].result(300)
+    assert isinstance(ei.value.root, RuntimeError)
+    stats = ctx.overall_stats()
+    ctx.close()
+
+    # every healthy job's result equals its serial twin, whatever
+    # admission order the WFQ picked and wherever the failure landed
+    for (i, j), res in got.items():
+        assert res == want[j], (i, j)
+    assert stats["jobs_submitted"] == 10
+    assert stats["jobs_failed"] == 1
+    assert stats["pipeline_aborts"] == 1
+
+
+def _boom_job(ctx):
+    ctx.Distribute(np.arange(8, dtype=np.int64)).Map(_kv7).Size()
+    raise ValueError("boom: user logic failed mid-pipeline")
+
+
+@pytest.mark.parametrize("W", [1, 2])
+def test_mid_stream_failure_heals_only_its_job(W):
+    """Job 2 fails -> PipelineError in ITS future; jobs 1/3 exact."""
+    ctx = Context(MeshExec(num_workers=W))
+    f1 = ctx.submit(_reduce_job, tenant="a")
+    f2 = ctx.submit(_boom_job, tenant="a", name="boom")
+    f3 = ctx.submit(_float_job, tenant="b")
+    with pytest.raises(PipelineError) as ei:
+        f2.result(300)
+    assert "boom" in str(ei.value)
+    assert isinstance(ei.value.root, ValueError)
+    assert f2.generation == ei.value.generation
+    r1, r3 = f1.result(300), f3.result(300)
+    # the queue never stalled: a post-failure job still runs clean
+    f4 = ctx.submit(_reduce_job, tenant="a")
+    r4 = f4.result(300)
+    stats = ctx.overall_stats()
+    ctx.close()
+
+    fresh = Context(MeshExec(num_workers=W))
+    assert r1 == r4 == _reduce_job(fresh)
+    assert r3 == _float_job(fresh)
+    fresh.close()
+    assert stats["jobs_submitted"] == 4
+    assert stats["jobs_failed"] == 1
+    assert stats["pipeline_aborts"] == 1
+
+
+@pytest.mark.slow
+def test_injected_submit_fault_fails_one_job_only():
+    """service.submit fires at admission INSIDE the job's failure
+    domain: exactly that job's future carries the PipelineError.
+    Slow-marked: the fault matrix (_ex_service_submit) pins the same
+    site in-tier."""
+    ctx = Context(MeshExec(num_workers=2))
+    with faults.inject("service.submit", n=1, seed=3):
+        f1 = ctx.submit(_reduce_job, tenant="a")
+        with pytest.raises(PipelineError):
+            f1.result(300)
+        f2 = ctx.submit(_reduce_job, tenant="a")
+        got = f2.result(300)
+    stats = ctx.overall_stats()
+    ctx.close()
+    fresh = Context(MeshExec(num_workers=2))
+    assert got == _reduce_job(fresh)
+    fresh.close()
+    assert stats["jobs_failed"] == 1
+    assert stats["faults_injected"] >= 1
+
+
+def test_wfq_weighted_fairness_is_deterministic():
+    """Unit test of the admission order: weight 2 tenant gets ~2x the
+    slots, ties break by tenant name then FIFO — no wall-clock, no
+    threads, fully deterministic."""
+    q = WfqQueue({"a": 2.0, "b": 1.0})
+    for i in range(6):
+        q.push(None, "a", f"a{i}", JobFuture(i, "a", f"a{i}"))
+    for i in range(3):
+        q.push(None, "b", f"b{i}", JobFuture(10 + i, "b", f"b{i}"))
+    order = []
+    while True:
+        job = q.pop()
+        if job is None:
+            break
+        order.append(job.name)
+    assert order == ["a0", "b0", "a1", "a2", "b1", "a3", "a4", "b2",
+                     "a5"]
+    # per-tenant FIFO preserved
+    assert [n for n in order if n.startswith("a")] == [f"a{i}" for i
+                                                       in range(6)]
+    assert q.depth == 0 and q.depth_peak == 9
+
+
+def test_wfq_take_removes_specific_job():
+    """The multi-controller follower path: take() pulls exactly the
+    job rank 0's ordering frame names, whatever the local order."""
+    q = WfqQueue()
+    futs = [JobFuture(i, "a", f"a{i}") for i in range(3)]
+    jobs = [q.push(None, "a", f.name, f) for f in futs]
+    assert q.take("a", jobs[1].tenant_seq) is jobs[1]
+    assert q.take("a", jobs[1].tenant_seq) is None      # gone
+    assert q.take("nope", 1) is None
+    assert q.pop() is jobs[0] and q.pop() is jobs[2]
+
+
+def test_submit_after_close_resolves_failed():
+    ctx = Context(MeshExec(num_workers=1))
+    f1 = ctx.submit(_float_job)
+    assert f1.result(300) == pytest.approx(_expected_float(), abs=0)
+    ctx.service.close()
+    f2 = ctx.submit(_float_job)
+    assert isinstance(f2.exception(5), RuntimeError)
+    ctx.close()
+
+
+def _expected_float():
+    return float(np.sum(np.linspace(0.0, 1.0, 41) * 1.7))
+
+
+def test_first_submit_after_context_close_resolves_failed():
+    """A Context that NEVER served and then closed must not construct
+    a live scheduler over the torn-down mesh on a late submit — the
+    future resolves failed, like a submit on a closed scheduler."""
+    ctx = Context(MeshExec(num_workers=1))
+    ctx.close()
+    f = ctx.submit(_float_job)
+    assert isinstance(f.exception(5), RuntimeError)
+    assert ctx.service is None          # no dispatcher was created
+
+
+def _sustained(W, clients, per_client):
+    """Closed-loop sustained-traffic sweep body (the bench lane's
+    shape, asserted for exactness instead of throughput)."""
+    ctx = Context(MeshExec(num_workers=W))
+    want0 = None
+    errors = []
+    lock = threading.Lock()
+
+    def client(i):
+        for j in range(per_client):
+            fn = _JOBS[(i + j) % len(_JOBS)]
+            try:
+                got = ctx.submit(fn, tenant=f"t{i % 2}").result(600)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append((i, j, repr(e)))
+                return
+            with lock:
+                if fn is _reduce_job:
+                    if want0 is not None:
+                        assert got == want0
+    # pin one expected value outside the threads
+    fresh = Context(MeshExec(num_workers=W))
+    want0 = _reduce_job(fresh)
+    fresh.close()
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats = ctx.overall_stats()
+    ctx.close()
+    assert not errors, errors
+    assert stats["jobs_submitted"] == clients * per_client
+    assert stats["jobs_failed"] == 0
+
+
+def test_sustained_traffic_small():
+    """One representative sustained-traffic config in-tier."""
+    _sustained(W=2, clients=2, per_client=3)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("W,clients,per_client",
+                         [(1, 3, 4), (2, 4, 5)])
+def test_sustained_traffic_sweep(W, clients, per_client):
+    """The sweep tail (slow-marked: tier-1 runs one config above)."""
+    _sustained(W, clients, per_client)

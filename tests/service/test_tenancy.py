@@ -1,0 +1,134 @@
+"""Per-tenant HBM budgets: isolation through the HbmGovernor ledger.
+
+Pinned acceptance: an over-budget tenant spills ITS OWN cold shards
+(and pays its own restores) while another tenant's cached results stay
+device-resident — one tenant's pressure can never evict a neighbor.
+"""
+
+import numpy as np
+import pytest
+
+from thrill_tpu.api import Context
+from thrill_tpu.common import faults
+from thrill_tpu.data.shards import DeviceShards
+from thrill_tpu.mem.hbm import SpilledShards
+from thrill_tpu.parallel.mesh import MeshExec
+from thrill_tpu.service import tenancy
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    monkeypatch.delenv(tenancy.ENV_BUDGETS, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+def _cache_array(ctx, n):
+    """Materialize (and KEEP cached) one device-resident node of ~8n
+    bytes; returns its DIA node."""
+    d = ctx.Distribute(np.arange(n, dtype=np.int64))
+    d.Keep()
+    d.node.materialize(consume=False)
+    return d.node
+
+
+def test_over_budget_tenant_spills_only_itself():
+    ctx = Context(MeshExec(num_workers=1))
+    n = 1 << 12
+    with tenancy.activate(ctx, "b"):
+        nb1 = _cache_array(ctx, n)
+        nb2 = _cache_array(ctx, n + 1)
+    with tenancy.activate(ctx, "a"):
+        na1 = _cache_array(ctx, n + 2)
+    # budget = 1.5x one node's ACTUAL accounted bytes (capacities pad,
+    # so byte math must come from the ledger, not the item count)
+    node_bytes = na1._hbm_bytes
+    assert node_bytes > 0
+    tenancy.set_budget(ctx, "a", int(1.5 * node_bytes))
+    with tenancy.activate(ctx, "a"):
+        na2 = _cache_array(ctx, n + 3)      # pushes a over its budget
+    # tenant a's COLD node spilled; its newest stays resident
+    assert isinstance(na1._shards, SpilledShards)
+    assert isinstance(na2._shards, DeviceShards)
+    # tenant b (unbudgeted, same Context, MORE bytes cached) untouched
+    assert isinstance(nb1._shards, DeviceShards)
+    assert isinstance(nb2._shards, DeviceShards)
+    assert ctx.hbm.tenant_bytes["a"] <= ctx.hbm.tenant_budgets["a"]
+    stats = ctx.overall_stats()
+    assert stats["tenant_spills"] >= 1
+    assert stats["tenant_hbm_peaks"]["a"] > ctx.hbm.tenant_budgets["a"]
+    assert "b" in stats["tenant_hbm_peaks"]
+    # the spilled node restores transparently on its next pull — the
+    # over-budget tenant pays ITS OWN ladder, results exact
+    got = sorted(int(x) for x in
+                 __import__("jax").tree.leaves(
+                     na1._shards.restore().tree)[0].reshape(-1)[:8])
+    assert got == sorted(range(8))
+    ctx.close()
+
+
+def test_jobs_under_budget_are_isolated_end_to_end():
+    """The scheduler form: tenant budgets from the env, two tenants'
+    job streams on one Context; the budgeted tenant's pressure spills
+    its own shards, both tenants' results stay exact."""
+    import os
+    n = 1 << 12
+    # one node of n int64 items pads its capacity to a power of two:
+    # 8192 rows x 8 B = 64 KiB; 1.5 nodes keeps exactly one resident
+    os.environ[tenancy.ENV_BUDGETS] = f"small={int(1.5 * 65536)}"
+    try:
+        ctx = Context(MeshExec(num_workers=1))
+
+        def keeper(tag, size):
+            def job(c):
+                d = c.Distribute(np.arange(size, dtype=np.int64))
+                d.Keep()
+                d.node.materialize(consume=False)
+                return int(size)
+            job.__name__ = f"keeper_{tag}"
+            return job
+
+        futs = [ctx.submit(keeper("s0", n), tenant="small"),
+                ctx.submit(keeper("b0", n), tenant="big"),
+                ctx.submit(keeper("s1", n + 1), tenant="small"),
+                ctx.submit(keeper("b1", n + 1), tenant="big"),
+                ctx.submit(keeper("s2", n + 2), tenant="small")]
+        for f in futs:
+            f.result(300)
+        assert ctx.hbm.tenant_bytes["small"] <= \
+            ctx.hbm.tenant_budgets["small"]
+        # big (unbudgeted) kept everything device-resident
+        big_nodes = [nd for nd in ctx._nodes
+                     if getattr(nd, "_tenant", None) == "big"
+                     and nd._shards is not None]
+        assert big_nodes and all(isinstance(nd._shards, DeviceShards)
+                                 for nd in big_nodes)
+        stats = ctx.overall_stats()
+        assert stats["tenant_spills"] >= 1
+        ctx.close()
+    finally:
+        os.environ.pop(tenancy.ENV_BUDGETS, None)
+
+
+def test_budget_parsing_and_validation():
+    assert tenancy.parse_budgets("a=1Mi, b=2K ,bad, c=0") == {
+        "a": 1 << 20, "b": 2048}
+    ctx = Context(MeshExec(num_workers=1))
+    tenancy.set_budget(ctx, "t", "4Ki")
+    assert ctx.hbm.tenant_budgets["t"] == 4096
+    with pytest.raises(ValueError):
+        tenancy.set_budget(ctx, "t", 0)
+    ctx.close()
+
+
+def test_activate_restores_previous_tenant():
+    ctx = Context(MeshExec(num_workers=1))
+    assert ctx.current_tenant is None
+    with tenancy.activate(ctx, "outer"):
+        with tenancy.activate(ctx, "inner"):
+            assert ctx.current_tenant == "inner"
+        assert ctx.current_tenant == "outer"
+    assert ctx.current_tenant is None
+    ctx.close()

@@ -151,6 +151,43 @@ class Context:
         self.net.group.generation = self.generation
         self.stats_pipeline_aborts = 0
         self.stats_heal_time_s = 0.0
+        # service plane (thrill_tpu/service/): the scheduler is
+        # constructed lazily by the first submit(); current_tenant is
+        # the tenant nodes created right now are stamped with (the
+        # scheduler sets it around each job, service/tenancy.py's
+        # activate() is the direct-use form)
+        self.service = None
+        self._service_lock = threading.Lock()
+        self._closed = False
+        self.current_tenant: Optional[str] = None
+        # persistent plan store (service/plan_store.py): learned
+        # exchange capacities / narrow specs / plan kinds / pre-shuffle
+        # verdicts seed the fresh mesh, so a warm restart re-runs a
+        # known pipeline with zero data-driven plan builds. Off (zero
+        # overhead) unless THRILL_TPU_PLAN_STORE is set.
+        self.plan_store = None
+        if self.config.plan_store and self.mesh_exec.num_processes > 1:
+            # multi-controller meshes get NO plan store: seeds install
+            # per-rank with no cross-rank agreement, and an asymmetric
+            # read (one rank cold, one seeded; a corrupt file on one
+            # host) would make the ranks plan DIFFERENT exchange
+            # programs for the same collective slot. Cold planning is
+            # symmetric by construction. Rank-0 broadcast of loaded
+            # entries is the ROADMAP path to lifting this.
+            import sys
+            print("thrill_tpu.service: THRILL_TPU_PLAN_STORE ignored "
+                  "on a multi-process mesh (per-rank seeding would "
+                  "desynchronize SPMD plans); recompiling cold",
+                  file=sys.stderr)
+        elif self.config.plan_store:
+            from ..service.plan_store import PlanStore
+            self.plan_store = PlanStore(self.config.plan_store,
+                                        logger=self.logger)
+            seeded = self.plan_store.attach(self.mesh_exec)
+            if self.logger.enabled:
+                self.logger.line(event="plan_store_load",
+                                 path=self.config.plan_store,
+                                 entries=seeded)
         # checkpoint/resume subsystem (api/checkpoint.py): fully off —
         # ctx.checkpoint stays None, the stage driver pays one
         # attribute read — unless THRILL_TPU_CKPT_DIR is set
@@ -218,10 +255,50 @@ class Context:
     def _register_node(self, node) -> int:
         # stamp the failure domain: a heal disposes exactly the nodes
         # of the aborted generation (their shards may be partial) and
-        # leaves earlier generations' cached results untouched
+        # leaves earlier generations' cached results untouched. The
+        # tenant stamp routes the node's HBM bytes to the per-tenant
+        # ledger (mem/hbm.py, service/tenancy.py).
         node._generation = self.generation
+        node._tenant = self.current_tenant
         self._nodes.append(node)
         return len(self._nodes) - 1
+
+    # -- service plane (thrill_tpu/service/) ----------------------------
+    def submit(self, pipeline_fn: Callable[["Context"], Any],
+               tenant: str = "default", name: str = "",
+               weight: Optional[float] = None):
+        """Queue ``pipeline_fn(ctx) -> result`` for execution on this
+        Context and return a :class:`~thrill_tpu.service.JobFuture`.
+
+        Thread-safe: any number of client threads may submit; jobs
+        serialize onto the SPMD mesh in weighted-fair order across
+        tenants (service/scheduler.py). Each job runs in its own
+        ``ctx.pipeline()`` failure domain — a failing job raises its
+        :class:`PipelineError` from ``future.result()`` while the
+        Context heals and later jobs run normally. Once a Context
+        serves, run ALL its pipelines through submit(): the Context is
+        not re-entrant, and a main-thread pipeline racing the
+        dispatcher would interleave device programs."""
+        svc = self.service
+        if svc is None:
+            # first submit may race across client threads: exactly ONE
+            # scheduler (and dispatcher thread) may ever own the mesh
+            with self._service_lock:
+                if self._closed:
+                    # a first submit AFTER close() must not construct
+                    # a live scheduler over the torn-down mesh — it
+                    # resolves failed, like a submit on a closed
+                    # scheduler does
+                    from ..service.scheduler import JobFuture
+                    return JobFuture.failed(
+                        0, tenant, name or "job-0",
+                        RuntimeError("Context is closed"))
+                svc = self.service
+                if svc is None:
+                    from ..service.scheduler import Scheduler
+                    svc = self.service = Scheduler(self)
+        return svc.submit(pipeline_fn, tenant=tenant, name=name,
+                          weight=weight)
 
     # -- stage memory negotiation ---------------------------------------
     # Reference: the StageBuilder distributes worker RAM per stage —
@@ -418,6 +495,17 @@ class Context:
                                        "stats_reconnects", 0),
             "stale_frames_dropped": getattr(self.net.group,
                                             "stats_stale_dropped", 0),
+            # service plane (thrill_tpu/service/): admission counters
+            # from the scheduler, per-tenant HBM peaks from the
+            # governor ledger, and the plan-store counters — a warm
+            # restart of a known pipeline reports plan_builds == 0
+            **(self.service.stats() if self.service is not None else
+               {"jobs_submitted": 0, "jobs_failed": 0,
+                "queue_depth_peak": 0}),
+            "tenant_hbm_peaks": dict(self.hbm.tenant_peaks),
+            "tenant_spills": self.hbm.tenant_spill_count,
+            "plan_builds": mex.stats_plan_builds,
+            "plan_store_hits": mex.stats_plan_store_hits,
         }
         # durability layer (api/checkpoint.py): epochs committed, bytes
         # sealed, ops skipped by resume, time spent restoring
@@ -426,7 +514,18 @@ class Context:
         from ..common import faults
         stats.update({k: v - self._faults_base.get(k, 0)
                       for k, v in faults.REGISTRY.stats().items()})
-        if self.net.num_workers > 1 and not self._aborted:
+        if self.net.num_workers > 1 and not self._aborted \
+                and self.service is None:
+            # once a rank has EVER served, degrade to the local view
+            # permanently: while dispatchers live, the non-root ranks'
+            # park in a recv on this same untagged control plane
+            # waiting for ordering frames — an application-thread
+            # all_gather here would race them for frames — and the
+            # skip decision must be CROSS-RANK DETERMINISTIC, which
+            # `service.alive` is not (a one-rank poison kills one
+            # dispatcher while its peers' survive; scheduler
+            # CONSTRUCTION is lockstep under the submission contract,
+            # so gating on it keeps every rank on the same branch).
             per_host = self.net.all_gather(stats)
             # almost every counter is a per-controller view of one
             # global value (exchange stats derive from the replicated
@@ -449,7 +548,15 @@ class Context:
                           # per-process partials; the device wire
                           # bytes — actual and raw — derive from the
                           # replicated send matrix (host 0's copy)
-                          "bytes_wire_host", "bytes_wire_host_saved"}
+                          "bytes_wire_host", "bytes_wire_host_saved",
+                          # per-process tenant spills sum; the service
+                          # admission counters and plan-build/store
+                          # counters are coordinated (lockstep
+                          # submission / replicated plan decisions —
+                          # host 0's copy, the default). The
+                          # tenant_hbm_peaks DICT also stays host 0's
+                          # view: per-process governor ledgers.
+                          "tenant_spills"}
             stats = {
                 k: (max(h[k] for h in per_host) if k in local_peaks
                     else sum(h.get(k, 0) for h in per_host)
@@ -707,6 +814,31 @@ class Context:
         # supervisor would relaunch only the dead rank — stranding it
         # in bootstrap against a rank that never comes back
         discovered: Optional[BaseException] = None
+        # service plane first: drain queued jobs and stop the
+        # dispatcher BEFORE the stats collective (the dispatcher owns
+        # the mesh while serving), then persist the learned plan state
+        # (rank 0 writes; all ranks read — the state derives from
+        # replicated plan inputs, so one copy is the cluster's copy)
+        with self._service_lock:
+            self._closed = True
+        if self.service is not None:
+            try:
+                self.service.close()
+            except Exception as e:
+                from ..common import faults as _faults
+                _faults.note("recovery", what="service.close_failed",
+                             error=repr(e)[:200])
+        # plan_store is only ever constructed on single-process meshes
+        # (see __init__; multi-process needs the ROADMAP rank-0
+        # entry broadcast first), so no rank guard is needed here
+        if self.plan_store is not None:
+            try:
+                self.plan_store.save(self.mesh_exec)
+            except Exception as e:
+                # a failing store must never take down a clean close
+                from ..common import faults as _faults
+                _faults.note("recovery", what="plan_store.save_failed",
+                             error=repr(e)[:200])
         # a dead-peer verdict latched by the background heartbeat
         # monitor (net/heartbeat.py mark_dead) may arrive with NO
         # exception in flight (the job finished between collectives):
@@ -874,7 +1006,11 @@ def RunDistributed(job: Callable[[Context], Any],
         # take minutes of imports/compiles to reach it (see
         # common/timeouts.py)
         import inspect
+        from ..common.platform import enable_cpu_multiprocess_collectives
         from ..common.timeouts import scaled
+        # a CPU mesh spanning processes needs an explicit collectives
+        # backend (gloo) or every cross-process program fails at runtime
+        enable_cpu_multiprocess_collectives()
         kw = {}
         try:
             if "initialization_timeout" in inspect.signature(

@@ -319,13 +319,19 @@ class FusionPlan:
             default_policy().run(site_checks, what="fuse.dispatch")
         pres = mex.pressure
         if pres is not None and pres.enabled \
-                and not any(s.expands for s in segs):
+                and not any(s.expands for s in segs) \
+                and getattr(fn, "_out_bytes", None) is None:
             # cost-model hint from the plan's shapes: a non-expanding
             # chain produces at most its sources' rows, so the sources'
             # leaf bytes bound the stitched program's output. Expanding
             # chains (flat_map) skip the hint — the learned per-program
             # size / factor guess handles them instead of a systematic
-            # underestimate on exactly the chains most likely to OOM
+            # underestimate on exactly the chains most likely to OOM.
+            # Once the program LEARNED its measured output size (this
+            # process, or imported from the plan store on a warm
+            # restart), that exact number governs instead of this
+            # upper bound — a fused ReduceByKey's output is usually
+            # far smaller than its sources
             pres.hint_output_bytes(sum(
                 int(getattr(l, "nbytes", 0) or 0)
                 for s in srcs for l in jax.tree.leaves(s.tree)))

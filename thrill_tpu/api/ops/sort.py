@@ -748,7 +748,11 @@ def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
     R = S.sum(axis=0)
     new_counts = R.astype(np.int64)
 
-    # capacity agreement — sticky like the generic dense exchange
+    # capacity agreement — sticky like the generic dense exchange.
+    # Sort's fused path always plans from the synced host S (splitter
+    # agreement needs it anyway), so it is a plan build every time —
+    # the plan store cannot elide it, only ratchet its capacities
+    exchange.count_plan_build(mex)
     cap_ident = ("sort_fused_caps", token, cap, nwords, treedef,
                  tuple((l.dtype, l.shape[2:]) for l in sorted_payload))
     M_pad, out_cap = exchange._sticky_caps(

@@ -89,6 +89,27 @@ def parse_si_iec_units(s: str) -> int:
     return int(float(s.strip()) * mult)
 
 
+def parse_kv_spec(spec: str, parse_value, what: str) -> dict:
+    """Parse a "name=value,name=value" env spec, skipping malformed
+    entries LOUDLY (a typo must not silently drop a tenant's weight or
+    budget). ``parse_value`` converts and validates one value (raise
+    ValueError to reject); shared by the service plane's weight and
+    budget knobs (service/scheduler.py, service/tenancy.py)."""
+    out: dict = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, v = entry.partition("=")
+        try:
+            out[name.strip()] = parse_value(v)
+        except (ValueError, IndexError):
+            import sys
+            print(f"thrill_tpu: malformed {what} entry {entry!r} "
+                  f"ignored", file=sys.stderr)
+    return out
+
+
 DEFAULT_COMPILE_CACHE = "~/.cache/thrill_tpu_xla"
 
 
@@ -141,6 +162,12 @@ class Config:
     # Auto-checkpoint every materialized DOp stage barrier, not just
     # explicit dia.Checkpoint() calls (THRILL_TPU_CKPT_AUTO=1).
     ckpt_auto: bool = False
+    # Persistent plan store directory (service/plan_store.py): learned
+    # exchange capacities, narrow specs, plan kinds and pre-shuffle
+    # verdicts survive process restarts — a warm restart re-runs a
+    # known pipeline with zero data-driven plan builds. Any vfs scheme
+    # (file://, s3://, hdfs://). Empty = off (zero overhead).
+    plan_store: str = ""
 
     @staticmethod
     def from_env() -> "Config":
@@ -163,6 +190,7 @@ class Config:
             ckpt_dir=_env_str("THRILL_TPU_CKPT_DIR", "") or "",
             resume=bool(_env_int("THRILL_TPU_RESUME", 0)),
             ckpt_auto=bool(_env_int("THRILL_TPU_CKPT_AUTO", 0)),
+            plan_store=_env_str("THRILL_TPU_PLAN_STORE", "") or "",
         )
 
 

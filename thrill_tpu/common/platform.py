@@ -27,6 +27,33 @@ def force_cpu_platform() -> None:
               file=sys.stderr)
 
 
+def enable_cpu_multiprocess_collectives() -> bool:
+    """Select the gloo CPU collectives backend, if this jax has it.
+
+    Without an explicit CPU collectives implementation, a multi-process
+    CPU mesh fails every cross-process program with "Multiprocess
+    computations aren't implemented on the CPU backend" — jax does not
+    pick gloo by itself.  Must run BEFORE the backend initializes (the
+    multi-process entry point calls it ahead of
+    ``jax.distributed.initialize``); only applies when the platform is
+    (or is forced to) CPU, so TPU meshes are untouched.  Returns
+    whether the option took, so callers can decide to skip rather than
+    fail on jax builds that predate it."""
+    import os
+
+    import jax
+
+    platforms = getattr(jax.config, "jax_platforms", None) \
+        or os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" not in str(platforms):
+        return False
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:
+        return False        # option or gloo absent: caller degrades
+
+
 def has_ragged_all_to_all() -> bool:
     """Does this jax build export ``lax.ragged_all_to_all``?
 

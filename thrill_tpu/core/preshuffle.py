@@ -102,8 +102,19 @@ def record_prune(mex, token, pre_rows: int, post_rows: int) -> None:
 def prune_fraction(mex, token) -> float:
     hist = getattr(mex, "_prune_history", None)
     if hist is None:
-        return _DEFAULT_PRUNE_FRAC
-    return hist.get(token, _DEFAULT_PRUNE_FRAC)
+        hist = mex._prune_history = {}
+    frac = hist.get(token)
+    if frac is None:
+        # warm restart: the plan store remembers what fraction this
+        # site's filter pruned in past runs (service/plan_store.py)
+        from ..data.exchange import plan_seed
+        v = plan_seed(mex, "prune_history", token)
+        if v is not None:
+            try:
+                frac = hist[token] = max(0.0, min(1.0, float(v)))
+            except (TypeError, ValueError):
+                frac = None
+    return _DEFAULT_PRUNE_FRAC if frac is None else frac
 
 
 def learned_site_rows(mex, xchg_ident) -> Optional[int]:
@@ -143,21 +154,57 @@ def _decay_fraction(mex, token) -> None:
 
 
 def _sticky_decision(mex, kind: str, token, compute) -> bool:
+    from ..data.exchange import count_plan_build, plan_seed
     store = getattr(mex, "_prune_decisions", None)
     if store is None:
         store = mex._prune_decisions = {}
     key = (kind, token)
     entry = store.get(key)
     if entry is None:
-        entry = (bool(compute()), 1)
+        seeded = plan_seed(mex, "prune_decisions", key)
+        if seeded is not None:
+            # warm restart: the remembered verdict, no cost-model run.
+            # Correctness-neutral either way — pruning filters are
+            # exact; a stale verdict costs performance until the
+            # periodic resync below re-evaluates it.
+            entry = (bool(seeded), 1)
+        else:
+            count_plan_build(mex)
+            entry = (bool(compute()), 1)
     else:
         verdict, uses = entry
         if uses % _DECIDE_RESYNC_EVERY == 0:
             _decay_fraction(mex, token)
+            count_plan_build(mex)
             verdict = bool(compute())
         entry = (verdict, uses + 1)
     store[key] = entry
     return entry[0]
+
+
+# -- plan-state persistence (service/plan_store.py) --------------------
+
+def export_plan_state(mex) -> dict:
+    """Pre-shuffle verdicts and learned prune fractions as digest maps
+    (the plan store's on-disk form; keys digest like the exchange
+    plan state — data/exchange.py _ident_digest)."""
+    from ..data.exchange import _ident_digest, merge_unconsumed_seeds
+    return merge_unconsumed_seeds(mex, {
+        "prune_decisions": {
+            _ident_digest(k): bool(v[0])
+            for k, v in getattr(mex, "_prune_decisions", {}).items()},
+        "prune_history": {
+            _ident_digest(k): float(v)
+            for k, v in getattr(mex, "_prune_history", {}).items()},
+    })
+
+
+def import_plan_state(mex, state: dict) -> int:
+    """Install pre-shuffle seeds into the shared ``mex._plan_seed``
+    table (consumed lazily by the lookup helpers above)."""
+    from ..data.exchange import install_plan_seeds
+    return install_plan_seeds(
+        mex, state, ("prune_decisions", "prune_history"))
 
 
 def _pays(rows: int, item_bytes: int, W: int, sides: int, M: int,

@@ -80,6 +80,176 @@ _F_PACK = faults.declare("data.exchange.pack")
 
 
 # ----------------------------------------------------------------------
+# plan-state persistence (service/plan_store.py)
+# ----------------------------------------------------------------------
+# The learned per-site plan state — sticky capacities, plan kinds,
+# narrow ranges — is keyed by in-memory identity tuples (call-site
+# ident + cap + treedef + dtypes). For persistence the tuples digest to
+# stable strings: every component reprs deterministically for a fixed
+# program (ints, strings, dtypes, shape tuples, PyTreeDefs), so a warm
+# restart of the SAME pipeline recomputes the same digests, and a
+# changed pipeline simply misses and re-learns. Values are correctness-
+# neutral (a lying capacity/range is healed by the in-trace overflow
+# flag), which is what makes importing them safe at all.
+
+
+def _canon(x) -> str:
+    """Address-free canonical repr for digesting. Call-site idents
+    embed user FUNCTIONS (key extractors, reduce lambdas) whose repr
+    carries a memory address; canonicalize them to module.qualname
+    plus a bytecode hash — stable across processes for the same
+    source, distinct for distinct lambdas sharing a qualname. Other
+    objects whose default repr is address-bearing degrade to their
+    class identity: a collision can only MERGE plan state of
+    same-class sites, which is correctness-neutral (capacities
+    ratchet, ranges/kinds are healed by the in-trace guards)."""
+    if isinstance(x, tuple):
+        return "(" + ",".join(_canon(e) for e in x) + ")"
+    if callable(x) and not isinstance(x, type):
+        qn = getattr(x, "__qualname__", None)
+        if qn:
+            code = getattr(x, "__code__", None)
+            if code is not None:
+                import hashlib
+                # bytecode + constants: `lambda x: x % 7` and
+                # `lambda x: x % 11` share co_code (the constant lives
+                # in co_consts, referenced by index) — hashing both
+                # keeps "edit the constant -> warm restart misses and
+                # re-learns". Nested code objects in co_consts hash by
+                # their own bytecode (their repr carries an address).
+                consts = tuple(
+                    c.co_code.hex() if hasattr(c, "co_code")
+                    else repr(c) for c in code.co_consts)
+                # closure cells too: factory-made lambdas
+                # (make(7) vs make(1000)) share code AND consts — the
+                # captured value is what distinguishes them
+                try:
+                    cells = tuple(_canon(c.cell_contents)
+                                  for c in (x.__closure__ or ()))
+                except Exception:
+                    cells = ("<?>",)
+                h = hashlib.sha1(repr((consts, cells)).encode()
+                                 + b"|" + code.co_code).hexdigest()[:8]
+                return f"<fn {getattr(x, '__module__', '?')}.{qn}:{h}>"
+            return f"<fn {getattr(x, '__module__', '?')}.{qn}>"
+    r = repr(x)
+    if " at 0x" in r:
+        return f"<{type(x).__module__}.{type(x).__qualname__}>"
+    return r
+
+
+def _ident_digest(ident: Tuple) -> str:
+    import hashlib
+    return hashlib.sha1(_canon(ident).encode()).hexdigest()
+
+
+def plan_seed(mex: MeshExec, kind: str, ident: Tuple):
+    """Consume the imported plan-store seed for ``ident`` (None when
+    no store was attached or the key is unknown). Consumed ONCE: the
+    live per-mesh dicts take over from the first lookup, so the seed
+    table never shadows fresher in-process learning. Shared with
+    core/preshuffle.py for its verdict/fraction kinds."""
+    seeds = getattr(mex, "_plan_seed", None)
+    if not seeds:
+        return None
+    m = seeds.get(kind)
+    if not m:
+        return None
+    v = m.pop(_ident_digest(ident), None)
+    if v is not None:
+        mex.stats_plan_store_hits = getattr(
+            mex, "stats_plan_store_hits", 0) + 1
+    return v
+
+
+def count_plan_build(mex: MeshExec) -> None:
+    """One data-driven host plan construction (synced exchange plan /
+    pre-shuffle verdict evaluation) — the events a warm plan-store
+    restart runs ZERO of."""
+    mex.stats_plan_builds = getattr(mex, "stats_plan_builds", 0) + 1
+
+
+def merge_unconsumed_seeds(mex, out: dict) -> dict:
+    """Ride imported-but-unconsumed seeds along an export, so learned
+    state for pipelines NOT re-run this session survives the save
+    (forgetting this silently drops their plans). Shared by every
+    plan-state exporter (here and core/preshuffle.py)."""
+    seeds = getattr(mex, "_plan_seed", None) or {}
+    for kind in out:
+        for dg, v in (seeds.get(kind) or {}).items():
+            out[kind].setdefault(dg, v)
+    return out
+
+
+def install_plan_seeds(mex, state: dict, kinds) -> int:
+    """Merge digest maps for ``kinds`` into the shared lazy seed table
+    (``mex._plan_seed``); returns how many entries arrived. Shared by
+    every plan-state importer."""
+    seeds = getattr(mex, "_plan_seed", None)
+    if seeds is None:
+        seeds = mex._plan_seed = {}
+    n = 0
+    for kind in kinds:
+        m = state.get(kind)
+        if isinstance(m, dict) and m:
+            seeds.setdefault(kind, {}).update(m)
+            n += len(m)
+    return n
+
+
+def export_plan_state(mex: MeshExec) -> dict:
+    """This mesh's exchange plan state as JSON-serializable digest
+    maps (the plan store's on-disk form)."""
+    return merge_unconsumed_seeds(mex, {
+        "caps": {_ident_digest(k): [int(x) for x in v]
+                 for k, v in getattr(mex, "_sticky_caps", {}).items()},
+        "plan": {_ident_digest(k): str(v)
+                 for k, v in getattr(mex, "_xchg_plan", {}).items()},
+        "ranges": {_ident_digest(k):
+                   [list(map(int, r)) if r is not None else None
+                    for r in v]
+                   for k, v in getattr(mex, "_sticky_ranges",
+                                       {}).items()},
+    })
+
+
+def import_plan_state(mex: MeshExec, state: dict) -> int:
+    """Install exchange plan-state seeds (digest maps, as produced by
+    :func:`export_plan_state`); returns how many entries arrived."""
+    return install_plan_seeds(mex, state, ("caps", "plan", "ranges"))
+
+
+def _seeded_caps(mex: MeshExec, ident: Tuple) -> Optional[Tuple[int, ...]]:
+    v = plan_seed(mex, "caps", ident)
+    if not v:
+        return None
+    try:
+        return tuple(int(x) for x in v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _sticky_range_get(mex: MeshExec, cap_ident: Tuple):
+    """The remembered per-leaf range union for a site, seeding the
+    live store from an attached plan store on first miss."""
+    store = getattr(mex, "_sticky_ranges", None)
+    if store is None:
+        store = mex._sticky_ranges = {}
+    prev = store.get(cap_ident)
+    if prev is None:
+        v = plan_seed(mex, "ranges", cap_ident)
+        if v is not None:
+            try:
+                prev = tuple(tuple(int(x) for x in r)
+                             if r is not None else None for r in v)
+            except (TypeError, ValueError):
+                prev = None
+            if prev is not None:
+                store[cap_ident] = prev
+    return prev
+
+
+# ----------------------------------------------------------------------
 # phase-B row narrowing (dtype/range analysis)
 # ----------------------------------------------------------------------
 # Integer leaves whose observed [min, max] fits a narrower dtype cross
@@ -109,10 +279,8 @@ def _spec_from_ranges(mex: MeshExec, cap_ident: Tuple, leaves,
     per narrowable leaf), or None when nothing narrows."""
     if ranges is None or not nidx:
         return None
-    store = getattr(mex, "_sticky_ranges", None)
-    if store is None:
-        store = mex._sticky_ranges = {}
-    prev = store.get(cap_ident)
+    prev = _sticky_range_get(mex, cap_ident)
+    store = mex._sticky_ranges
     merged = []
     for j, li in enumerate(nidx):
         lo, hi = int(ranges[j, 0]), int(ranges[j, 1])
@@ -151,10 +319,7 @@ def _sticky_spec(mex: MeshExec, cap_ident: Tuple, leaves):
     site's remembered range union (no fetch). The in-trace guard in
     chunk 0 catches data that outgrew the learned ranges and routes
     the exchange to the synced heal, which re-learns them."""
-    store = getattr(mex, "_sticky_ranges", None)
-    if store is None:
-        return None
-    prev = store.get(cap_ident)
+    prev = _sticky_range_get(mex, cap_ident)
     if prev is None:
         return None
     nidx = _narrowable_leaves(leaves)
@@ -435,6 +600,8 @@ def exchange_stream(shards: DeviceShards, dest_builder: Callable,
     S = mex.fetch(send_mat)   # per-round caps genuinely need the host S
     account_traffic(mex, S, leaf_item_bytes(sorted_leaves))
     cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
+    if W > 1:
+        count_plan_build(mex)
 
     if W == 1:
         yield DeviceShards(mex, jax.tree.unflatten(treedef, sorted_leaves),
@@ -517,6 +684,11 @@ def _sticky_caps(mex: MeshExec, ident: Tuple, needed: Tuple[int, ...]
     if cache is None:
         cache = mex._sticky_caps = {}
     prev = cache.get(ident)
+    if prev is None:
+        # a plan-store seed (service/plan_store.py) pre-ratchets the
+        # site to its remembered steady-state capacities — monotone
+        # merge below, exactly as if this process had learned them
+        prev = _seeded_caps(mex, ident)
     grown = tuple(round_up_pow2(n) for n in needed)
     if prev is not None and len(prev) == len(grown):
         grown = tuple(max(p, g) for p, g in zip(prev, grown))
@@ -725,9 +897,28 @@ def _optimistic_ok(mex: MeshExec, cap_ident: Tuple,
         return None
     if resolve_mode(mex) != "dense":
         return None
-    if getattr(mex, "_xchg_plan", {}).get(cap_ident) != "dense":
+    plans = getattr(mex, "_xchg_plan", None)
+    if plans is None:
+        plans = mex._xchg_plan = {}
+    kind = plans.get(cap_ident)
+    if kind is None:
+        # warm restart: the plan store remembers this site's last
+        # synced verdict — a "dense" seed (with seeded capacities
+        # below) lets the FIRST exchange of a fresh process dispatch
+        # optimistically, zero host plan syncs
+        kind = plan_seed(mex, "plan", cap_ident)
+        if kind is not None:
+            kind = plans[cap_ident] = str(kind)
+    if kind != "dense":
         return None
-    caps = getattr(mex, "_sticky_caps", {}).get(cap_ident)
+    cache = getattr(mex, "_sticky_caps", None)
+    if cache is None:
+        cache = mex._sticky_caps = {}
+    caps = cache.get(cap_ident)
+    if caps is None:
+        caps = _seeded_caps(mex, cap_ident)
+        if caps is not None:
+            cache[cap_ident] = caps
     if not caps or len(caps) != 2 or caps[1] < min_cap:
         return None
     # periodic re-plan: the dense-vs-1-factor skew decision needs the
@@ -1021,6 +1212,9 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
         tree = jax.tree.unflatten(treedef, sorted_leaves)
         return DeviceShards(mex, tree, new_counts)
 
+    # every path below constructs a plan FROM THE SYNCED HOST S — the
+    # event the plan store exists to make a warm restart skip
+    count_plan_build(mex)
     cap_ident = _dense_cap_ident(ident, cap, treedef, sorted_leaves)
     mode = resolve_mode(mex)
     if mode == "ragged":

@@ -105,6 +105,17 @@ class HbmGovernor:
         self._pool = None
         self.spill_count = 0
         self.restore_count = 0
+        # service plane (service/tenancy.py): per-tenant byte ledger
+        # next to the global one. Nodes carry the tenant active when
+        # they were created (Context.current_tenant, set by the
+        # scheduler around each job); a tenant crossing ITS budget
+        # spills its own LRU-coldest shards — never another tenant's —
+        # so one tenant's pressure rides its own restore/ladder costs
+        # while its neighbors' cached results stay device-resident.
+        self.tenant_budgets: Dict[str, int] = {}
+        self.tenant_bytes: Dict[str, int] = {}
+        self.tenant_peaks: Dict[str, int] = {}
+        self.tenant_spill_count = 0
 
     # -- pool -----------------------------------------------------------
     def _spill_pool(self):
@@ -135,6 +146,57 @@ class HbmGovernor:
         import jax
         return sum(int(l.nbytes) for l in jax.tree.leaves(shards.tree))
 
+    def _tenant_add(self, node, nb: int) -> None:
+        t = getattr(node, "_tenant", None)
+        if t is None or not nb:
+            return
+        b = self.tenant_bytes.get(t, 0) + nb
+        self.tenant_bytes[t] = b
+        if b > self.tenant_peaks.get(t, 0):
+            self.tenant_peaks[t] = b
+
+    def _tenant_sub(self, node, nb: int) -> None:
+        t = getattr(node, "_tenant", None)
+        if t is None or not nb:
+            return
+        self.tenant_bytes[t] = max(self.tenant_bytes.get(t, 0) - nb, 0)
+
+    def maybe_spill_tenant(self, node) -> None:
+        """Per-tenant budget enforcement: while ``node``'s tenant is
+        over ITS budget, spill that tenant's LRU-coldest nodes — and
+        ONLY that tenant's. Best-effort like the global path (a tenant
+        whose working set is all hot stays over budget; its next
+        dispatches then pay the PR-5 ladder under real HBM limits)."""
+        t = getattr(node, "_tenant", None)
+        if t is None:
+            return
+        budget = self.tenant_budgets.get(t)
+        if not budget or self.tenant_bytes.get(t, 0) <= budget:
+            return
+        spilled = 0
+        for nid in list(self._lru.keys()):
+            if nid == node.id:
+                continue
+            cand = self._lru.get(nid)
+            if cand is None or getattr(cand, "_tenant", None) != t:
+                continue
+            before = self.tenant_bytes.get(t, 0)
+            self.spill(cand)
+            # spill() is best-effort and may DECLINE (pending check,
+            # failed serialization) leaving the node resident — count
+            # only spills that actually moved the tenant's bytes
+            if self.tenant_bytes.get(t, 0) < before:
+                spilled += 1
+            if self.tenant_bytes.get(t, 0) <= budget:
+                break
+        if spilled:
+            self.tenant_spill_count += spilled
+            log = self.context.logger
+            if log.enabled:
+                log.line(event="tenant_spill", tenant=t, nodes=spilled,
+                         bytes=self.tenant_bytes.get(t, 0),
+                         budget=budget)
+
     def on_cache(self, node) -> None:
         """A node just cached freshly computed shards."""
         nb = self._device_bytes(node._shards)
@@ -142,7 +204,9 @@ class HbmGovernor:
             return
         node._hbm_bytes = nb
         self.mem.add(nb)
+        self._tenant_add(node, nb)
         self._lru[node.id] = node
+        self.maybe_spill_tenant(node)
         self.maybe_spill(exclude=node.id)
 
     def touch(self, node) -> None:
@@ -155,6 +219,7 @@ class HbmGovernor:
             nb = self._device_bytes(node._shards)
             node._hbm_bytes = nb
             self.mem.add(nb)
+            self._tenant_add(node, nb)
             log = self.context.logger
             if log.enabled:
                 log.line(event="hbm_restore", node=node.label,
@@ -163,6 +228,7 @@ class HbmGovernor:
             self._lru[node.id] = self._lru.pop(node.id)  # move to end
         elif getattr(node, "_hbm_bytes", 0):
             self._lru[node.id] = node
+        self.maybe_spill_tenant(node)
         self.maybe_spill(exclude=node.id)
 
     def on_release(self, node, dropped) -> None:
@@ -172,6 +238,7 @@ class HbmGovernor:
         nb = getattr(node, "_hbm_bytes", 0)
         if nb:
             self.mem.subtract(nb)
+            self._tenant_sub(node, nb)
             node._hbm_bytes = 0
         self._lru.pop(node.id, None)
 
@@ -264,6 +331,7 @@ class HbmGovernor:
         nb = getattr(node, "_hbm_bytes", 0)
         if nb:
             self.mem.subtract(nb)
+            self._tenant_sub(node, nb)
             node._hbm_bytes = 0
         self._lru.pop(node.id, None)
         self.spill_count += 1

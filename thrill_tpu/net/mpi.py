@@ -128,21 +128,71 @@ class _SendEngine:
     CAP_BYTES = int(os.environ.get("THRILL_TPU_MPI_INFLIGHT_CAP",
                                    str(32 << 20)))
 
+    #: async-progress poll period. Lazy reaping alone starves rendezvous
+    #: completion when the OWNING thread blocks outside the transport
+    #: with an isend still pending — e.g. inside an XLA cross-process
+    #: collective, where no recv poll ever runs while the peer waits for
+    #: this rank's DATA. Real MPI deployments run an async progress
+    #: thread for exactly this; ours honors the serialized-call lock.
+    PROGRESS_POLL_S = 2e-3
+
     def __init__(self) -> None:
         self.pending: collections.deque = collections.deque()
         self.pending_bytes = 0
+        self._progress_wake = threading.Event()
+        self._progress_thread: Optional[threading.Thread] = None
+        self._progress_on = os.environ.get(
+            "THRILL_TPU_MPI_PROGRESS", "1") != "0"
 
     def note_send_locked(self, req, payload) -> None:
         self.pending.append((req, payload))
         self.pending_bytes += len(payload)
+        if self._progress_on:
+            if self._progress_thread is None:
+                self._progress_thread = threading.Thread(
+                    target=self._progress_loop,
+                    name="mpi-progress", daemon=True)
+                self._progress_thread.start()
+            self._progress_wake.set()
+
+    def _progress_loop(self) -> None:
+        """Daemon: complete pending isends while the app threads are
+        parked elsewhere. Parks itself (Event) whenever the pending set
+        drains, so an idle world costs nothing. MUST outlive transport
+        errors: a raising request was already dropped by reap_locked,
+        so note it and keep pumping — a dead daemon would silently
+        reinstate the rendezvous-starvation wedge, and the app threads
+        surface the peer failure through their own sends/recvs."""
+        while True:
+            self._progress_wake.wait()
+            try:
+                with _MPI_LOCK:
+                    self.reap_locked()
+                    if not self.pending:
+                        self._progress_wake.clear()
+            except Exception as e:
+                import sys
+                print(f"thrill_tpu.net.mpi: async progress dropped a "
+                      f"failing isend ({e!r}); the peer error will "
+                      f"surface on the owning thread's next transport "
+                      f"call", file=sys.stderr)
+            time.sleep(self.PROGRESS_POLL_S)
 
     def reap_locked(self) -> int:
         """One non-blocking pass over pending isends; returns how many
-        completed (and were dropped)."""
+        completed (and were dropped). A request whose Test RAISES is
+        dropped with its byte account settled before the error
+        propagates — a dead peer's send must not inflate
+        ``pending_bytes`` forever."""
         done = 0
         for _ in range(len(self.pending)):
             req, payload = self.pending.popleft()
-            if _req_done(req):
+            try:
+                ok = _req_done(req)
+            except Exception:
+                self.pending_bytes -= len(payload)
+                raise
+            if ok:
                 self.pending_bytes -= len(payload)
                 done += 1
             else:

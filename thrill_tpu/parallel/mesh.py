@@ -238,6 +238,16 @@ class MeshExec:
         # lineage recoveries: hinted joins transparently re-run without
         # their hint after a detected overflow (api/ops/join.py)
         self.stats_join_overflow_retries = 0
+        # service plane (service/): data-driven host plan constructions
+        # — synced exchange capacity plans (data/exchange.py
+        # _exchange_planned) and pre-shuffle cost-model evaluations
+        # (core/preshuffle.py) — versus plan-store seeds consumed
+        # instead. A warm restart of a known pipeline against a
+        # populated store runs with stats_plan_builds == 0 (the
+        # acceptance counter of the persistent plan store; the Context
+        # owns the store handle, service/plan_store.py)
+        self.stats_plan_builds = 0
+        self.stats_plan_store_hits = 0
         # ICI-vs-DCN split of bytes_moved (multi-slice meshes; equal to
         # bytes_moved/0 on a single slice)
         self.stats_bytes_ici = 0
@@ -540,5 +550,46 @@ class MeshExec:
             target = fn[0] if isinstance(fn, tuple) else fn
             if isinstance(target, _CountedJit):
                 target.cache_key = key
+                seed = getattr(self, "_out_bytes_seed", None)
+                if seed:
+                    # warm restart (service/plan_store.py): the
+                    # admission cost model's learned output size for
+                    # this program survives the restart — first
+                    # dispatches admit on measured bytes instead of
+                    # the est_factor cold-start guess
+                    from ..data.exchange import _ident_digest
+                    v = seed.pop(_ident_digest(key), None)
+                    if v is not None:
+                        # a bad store value may only cost recompiles,
+                        # never a dispatch failure
+                        try:
+                            target._out_bytes = int(v)
+                            self.stats_plan_store_hits += 1
+                        except (TypeError, ValueError):
+                            pass
             self._cache[key] = fn
         return fn
+
+    # -- plan-state persistence (service/plan_store.py) -----------------
+    def export_learned_sizes(self) -> dict:
+        """Learned per-program output sizes (the admission cost
+        model's ``_out_bytes``) keyed by cache-key digest, plus any
+        unconsumed imported seeds."""
+        from ..data.exchange import _ident_digest
+        out = {}
+        for key, fn in self._cache.items():
+            target = fn[0] if isinstance(fn, tuple) else fn
+            ob = getattr(target, "_out_bytes", None)
+            if ob:
+                out[_ident_digest(key)] = int(ob)
+        for dg, v in (getattr(self, "_out_bytes_seed", None)
+                      or {}).items():
+            out.setdefault(dg, v)
+        return out
+
+    def import_learned_sizes(self, m: dict) -> int:
+        seed = getattr(self, "_out_bytes_seed", None)
+        if seed is None:
+            seed = self._out_bytes_seed = {}
+        seed.update({str(k): v for k, v in m.items()})
+        return len(m)

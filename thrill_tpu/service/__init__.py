@@ -1,0 +1,31 @@
+"""Service plane: always-on multi-tenant pipeline serving.
+
+The reference framework (and this reproduction until ISSUE 8) is
+batch-shaped: one Context per program, torn down at exit. The ROADMAP
+north star — "heavy traffic from millions of users" — needs the
+opposite: ONE long-lived Context serving many pipelines submitted by
+many clients. PR 8 delivered the failure-domain precondition (a
+Context survives pipeline aborts, link drops and wedged peers); this
+package turns that healed Context into a query service:
+
+* :mod:`.scheduler` — ``ctx.submit(pipeline_fn, tenant=...) ->
+  JobFuture``: concurrent submission from client threads, serialized
+  onto the SPMD mesh in weighted-fair order across tenants, each job
+  in its own generation-scoped failure domain (a failed job raises
+  :class:`~thrill_tpu.api.PipelineError` into its OWN future and heals
+  only its generation — the queue never stalls).
+* :mod:`.tenancy` — per-tenant HBM budgets enforced through the
+  existing :class:`~thrill_tpu.mem.hbm.HbmGovernor` ledger: one
+  tenant's memory pressure spills ITS cold shards (and rides its own
+  PR-5 escalation ladder), never another tenant's cached results.
+* :mod:`.plan_store` — a vfs-backed on-disk store for the learned
+  plan state keyed by the ``MeshExec.cached`` / ``FusionPlan``
+  composite identities (sticky exchange capacities, narrow specs,
+  plan kinds, pre-shuffle verdicts), so a warm restart re-runs a
+  known pipeline with ``plan_builds == 0`` — no data-driven host plan
+  syncs at all.
+"""
+
+from .scheduler import JobFuture, Scheduler  # noqa: F401
+from .tenancy import activate, configure, set_budget  # noqa: F401
+from .plan_store import PlanStore  # noqa: F401

@@ -1,0 +1,190 @@
+"""Persistent compile/plan store: learned plan state across restarts.
+
+A long-lived service amortizes its planning cost in-process — sticky
+exchange capacities, narrow specs, plan kinds (data/exchange.py) and
+pre-shuffle verdicts (core/preshuffle.py) are learned once per
+``MeshExec.cached`` / ``FusionPlan`` composite identity and reused for
+every later query. A process RESTART used to throw all of it away:
+every exchange site paid the synced host plan step again (~one link
+RTT each — the 140 ms/dispatch class of cost the whole dispatch budget
+fights), every auto-prune site re-ran its cost model. This store
+persists that state through the vfs (file://, s3://, hdfs://) so a
+warm restart re-runs a known pipeline with ``plan_builds == 0``.
+
+Key/versioning rules:
+
+* Keys are SHA-1 digests of the ``repr`` of the in-memory identity
+  tuples (call-site ident + shapes + dtypes + treedefs) — stable for a
+  fixed program across processes, and garbage for a changed one, which
+  is exactly right: a changed pipeline simply misses and re-learns.
+* Values are CORRECTNESS-NEUTRAL by construction: a lying capacity or
+  narrow range is caught by the exchange's in-trace overflow/range
+  flag and healed by the synced re-run; a wrong plan kind or prune
+  verdict costs performance, never results. That is why a plan store
+  may be trusted at all — and why corruption handling can afford to be
+  simple: any parse/CRC/version failure degrades LOUDLY to an empty
+  store (cold recompile), never to a partial read.
+* The file carries ``version`` (STORE_VERSION — bump on any format
+  change; skewed versions are refused wholesale) and a CRC-32 over the
+  canonical entries JSON. Writes go through
+  ``vfs.write_file_atomic`` — readers see the old store or the whole
+  new one, never a torn prefix.
+
+Compiled XLA executables are deliberately NOT stored here: jax's own
+persistent compilation cache (THRILL_TPU_COMPILE_CACHE, wired since
+round 1) already buries repeat compile costs; this store covers the
+DATA-DRIVEN half of planning that jax cannot know about.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import zlib
+from typing import Optional
+
+from ..common import faults
+
+STORE_VERSION = 1
+_FILE = "plans.json"
+
+# fired at load time: an armed fire makes THIS load read as corrupt —
+# the store degrades to empty (cold recompile), results stay exact
+_F_CORRUPT = faults.declare("service.plan_store.corrupt")
+
+#: entry kinds and their owners (data/exchange.py, core/preshuffle.py,
+#: parallel/mesh.py)
+_KINDS = ("caps", "plan", "ranges", "prune_decisions", "prune_history",
+          "out_bytes")
+
+
+def _crc(entries: dict) -> int:
+    return zlib.crc32(json.dumps(entries, sort_keys=True).encode())
+
+
+class PlanStore:
+    """One on-disk plan-state file under a vfs directory."""
+
+    def __init__(self, path: str, logger=None) -> None:
+        self.path = path
+        self.file = path.rstrip("/") + "/" + _FILE
+        self.logger = logger
+        self._last_corrupt: Optional[str] = None
+
+    # -- reading --------------------------------------------------------
+    def load(self) -> dict:
+        """Entries by kind; {} when cold. NEVER raises: any failure —
+        missing file aside — is a loud degrade to empty (the service
+        recompiles; a plan store must not be able to take it down)."""
+        from ..vfs import file_io
+        self._last_corrupt = None
+        try:
+            faults.check(_F_CORRUPT, path=self.file)
+            with file_io.OpenReadStream(self.file) as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return {}
+        except Exception as e:
+            return self._corrupt(f"unreadable: {e!r}")
+        try:
+            payload = json.loads(raw.decode())
+            if not isinstance(payload, dict):
+                return self._corrupt("not a JSON object")
+            if payload.get("version") != STORE_VERSION:
+                return self._corrupt(
+                    f"version skew: {payload.get('version')!r} != "
+                    f"{STORE_VERSION}")
+            entries = payload.get("entries")
+            if not isinstance(entries, dict):
+                return self._corrupt("entries missing")
+            if _crc(entries) != payload.get("crc"):
+                return self._corrupt("CRC mismatch")
+        except Exception as e:
+            return self._corrupt(f"parse failure: {e!r}")
+        return {k: dict(v) for k, v in entries.items()
+                if k in _KINDS and isinstance(v, dict)}
+
+    def _corrupt(self, why: str) -> dict:
+        self._last_corrupt = why
+        faults.note("recovery", what="plan_store.corrupt",
+                    path=self.file, why=why[:200])
+        import sys
+        print(f"thrill_tpu.service: plan store {self.file} ignored "
+              f"({why}); recompiling cold", file=sys.stderr)
+        return {}
+
+    def attach(self, mex) -> int:
+        """Seed a MeshExec's plan state from the store; returns the
+        number of entries imported. The seeds are consumed lazily at
+        each site's first lookup (data/exchange.py plan_seed), so an
+        entry for a pipeline this process never runs costs nothing."""
+        from ..core import preshuffle
+        from ..data import exchange
+        entries = self.load()
+        n = exchange.import_plan_state(mex, entries)
+        n += preshuffle.import_plan_state(mex, entries)
+        ob = entries.get("out_bytes")
+        if isinstance(ob, dict) and hasattr(mex, "import_learned_sizes"):
+            n += mex.import_learned_sizes(ob)
+        return n
+
+    # -- writing --------------------------------------------------------
+    def save(self, mex) -> None:
+        """Persist the MeshExec's current plan state, merged with what
+        is already on disk (capacities elementwise-max; unknown
+        digests are kept — another pipeline's state is not ours to
+        drop). On posix paths the load-merge-write runs under an
+        flock, so concurrent services sharing one store only ever
+        ratchet; object-store schemes (s3://, hdfs://) have no lock
+        primitive and keep last-writer-wins there. A corrupt on-disk
+        store is replaced wholesale."""
+        with self._save_lock():
+            self._save_locked(mex)
+
+    @contextlib.contextmanager
+    def _save_lock(self):
+        if "://" in self.path and not self.path.startswith("file://"):
+            yield                        # no lock primitive: best effort
+            return
+        import os
+        d = self.path[len("file://"):] if self.path.startswith(
+            "file://") else self.path
+        os.makedirs(d, exist_ok=True)
+        import fcntl
+        with open(d.rstrip("/") + "/.plans.lock", "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lk, fcntl.LOCK_UN)
+
+    def _save_locked(self, mex) -> None:
+        from ..core import preshuffle
+        from ..data import exchange
+        from ..vfs import file_io
+        entries = exchange.export_plan_state(mex)
+        entries.update(preshuffle.export_plan_state(mex))
+        if hasattr(mex, "export_learned_sizes"):
+            entries["out_bytes"] = mex.export_learned_sizes()
+        prev = self.load()
+        if self._last_corrupt is None:
+            for kind, old in prev.items():
+                new = entries.setdefault(kind, {})
+                for dg, v in old.items():
+                    if dg not in new:
+                        new[dg] = v
+                    elif kind == "caps":
+                        try:
+                            new[dg] = [max(int(a), int(b)) for a, b
+                                       in zip(new[dg], v)] \
+                                if len(new[dg]) == len(v) else new[dg]
+                        except (TypeError, ValueError):
+                            pass
+        payload = {"version": STORE_VERSION, "crc": _crc(entries),
+                   "entries": entries}
+        file_io.write_file_atomic(
+            self.file, json.dumps(payload, sort_keys=True).encode())
+        if self.logger is not None and self.logger.enabled:
+            self.logger.line(event="plan_store_save", path=self.file,
+                             entries=sum(len(v)
+                                         for v in entries.values()))

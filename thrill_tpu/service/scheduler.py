@@ -1,0 +1,458 @@
+"""Concurrent pipeline scheduling on one long-lived Context.
+
+``ctx.submit(pipeline_fn, tenant=...)`` accepts pipelines from any
+number of client threads and returns a :class:`JobFuture`. A single
+dispatcher thread drains the queue and runs each job on the SPMD mesh
+— the Context (like the reference's) is not re-entrant, so jobs
+SERIALIZE on the device; concurrency buys queueing, fairness and
+isolation, not co-scheduling. Each job runs inside its own
+``ctx.pipeline()`` failure domain (api/context.py): a failing job
+surfaces its :class:`~thrill_tpu.api.PipelineError` into its OWN
+future while the Context heals that generation — later jobs run
+normally, the queue never stalls. An UNRECOVERABLE verdict (heartbeat-
+confirmed dead peer, failed heal) fails the whole queue loudly: that
+Context cannot serve anymore and the supervised-relaunch path owns it.
+
+Fairness is start-time weighted-fair queueing (SFQ) across tenants:
+job ``start_tag = max(global_vtime, tenant.finish)``, ``tenant.finish
+= start_tag + 1/weight``; the dispatcher always runs the queued job
+with the smallest start tag (ties break by tenant name, then FIFO), so
+a tenant with weight 2 gets ~2x the job slots of a weight-1 tenant
+under sustained load while an idle tenant's first job is admitted
+immediately. Weights come from ``THRILL_TPU_SERVE_WEIGHTS``
+("a=3,b=1") or per-submit ``weight=``.
+
+Cross-rank admission order (multi-controller meshes): there is no
+central master — every controller must submit the same jobs at the
+same program points (the lockstep contract every collective already
+has), but client-thread timing may enqueue them in different LOCAL
+orders. Rank 0's dispatcher therefore picks the next job and
+broadcasts an ordering frame ``(tenant, tenant_seq)`` over the host
+control plane (``ctx.net``); the other ranks run exactly that job.
+The frames ride the same generation-tagged wire as every PR-8
+control frame, so a heal's stale-frame drain discards ordering frames
+of an aborted generation along with everything else.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common import faults
+
+# fired at job admission, INSIDE the job's pipeline() failure domain:
+# an armed fire aborts exactly that job's generation — its future gets
+# the PipelineError, the Context heals, the next job runs normally
+_F_SUBMIT = faults.declare("service.submit")
+
+
+def _weight(v: str) -> float:
+    w = float(v)
+    if w <= 0:
+        raise ValueError(v)
+    return w
+
+
+def _parse_weights(spec: str) -> Dict[str, float]:
+    """Parse THRILL_TPU_SERVE_WEIGHTS ("a=3,b=1.5"); malformed entries
+    are skipped loudly (a typo must not silently starve a tenant)."""
+    from ..common.config import parse_kv_spec
+    return parse_kv_spec(spec, _weight, "SERVE_WEIGHTS")
+
+
+class JobFuture:
+    """Handle to one submitted pipeline.
+
+    ``result()`` blocks until the job ran and returns its value — or
+    raises the job's error (:class:`~thrill_tpu.api.PipelineError` for
+    a scoped pipeline failure, the original abort for an unrecoverable
+    one). ``queue_wait_s`` / ``run_s`` / ``generation`` are populated
+    when the job completes."""
+
+    def __init__(self, job_id: int, tenant: str, name: str) -> None:
+        self.job_id = job_id
+        self.tenant = tenant
+        self.name = name
+        self.queue_wait_s = 0.0
+        self.run_s = 0.0
+        self.generation: Optional[int] = None
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+
+    def _finish(self, result: Any = None,
+                error: Optional[BaseException] = None) -> None:
+        self._result = result
+        self._error = error
+        self._event.set()
+
+    @classmethod
+    def failed(cls, job_id: int, tenant: str, name: str,
+               error: BaseException) -> "JobFuture":
+        """A future born resolved-with-error: the one shape every
+        rejected submission (dead scheduler, closing scheduler, closed
+        Context) hands back."""
+        fut = cls(job_id, tenant, name)
+        fut._finish(error=error)
+        return fut
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def exception(self, timeout: Optional[float] = None
+                  ) -> Optional[BaseException]:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"job {self.job_id} ({self.name}) still "
+                               f"queued/running after {timeout}s")
+        return self._error
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        err = self.exception(timeout)
+        if err is not None:
+            raise err
+        return self._result
+
+
+class _Job:
+    __slots__ = ("fn", "tenant", "name", "future", "t_submit",
+                 "tenant_seq", "start_tag")
+
+    def __init__(self, fn, tenant: str, name: str, future: JobFuture,
+                 tenant_seq: int, start_tag: float) -> None:
+        self.fn = fn
+        self.tenant = tenant
+        self.name = name
+        self.future = future
+        self.t_submit = time.monotonic()
+        self.tenant_seq = tenant_seq
+        self.start_tag = start_tag
+
+
+class _TenantQ:
+    __slots__ = ("weight", "finish", "jobs", "seq")
+
+    def __init__(self, weight: float) -> None:
+        self.weight = weight
+        self.finish = 0.0         # virtual finish tag of the last job
+        self.jobs: List[_Job] = []
+        self.seq = 0              # per-tenant submission counter
+
+
+class WfqQueue:
+    """Start-time fair queue over per-tenant FIFOs (caller locks)."""
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None) -> None:
+        self._tenants: Dict[str, _TenantQ] = {}
+        self._weights = dict(weights or {})
+        self._vtime = 0.0          # start tag of the job last serviced
+        self.depth = 0
+        self.depth_peak = 0
+
+    def set_weight(self, tenant: str, weight: float) -> None:
+        self._weights[tenant] = float(weight)
+        tq = self._tenants.get(tenant)
+        if tq is not None:
+            tq.weight = float(weight)
+
+    def push(self, fn, tenant: str, name: str, future: JobFuture) -> _Job:
+        tq = self._tenants.get(tenant)
+        if tq is None:
+            tq = self._tenants[tenant] = _TenantQ(
+                self._weights.get(tenant, 1.0))
+        start = max(self._vtime, tq.finish)
+        tq.finish = start + 1.0 / tq.weight
+        tq.seq += 1
+        if not name:
+            name = f"{tenant}-{tq.seq}"
+            future.name = name
+        job = _Job(fn, tenant, name, future, tq.seq, start)
+        tq.jobs.append(job)
+        self.depth += 1
+        if self.depth > self.depth_peak:
+            self.depth_peak = self.depth
+        return job
+
+    def pop(self) -> Optional[_Job]:
+        """The queued job with the smallest start tag (ties: tenant
+        name, then FIFO — per-tenant FIFOs keep submission order)."""
+        best_t = None
+        for t, tq in sorted(self._tenants.items()):
+            if not tq.jobs:
+                continue
+            if best_t is None or (tq.jobs[0].start_tag
+                                  < self._tenants[best_t].jobs[0].start_tag):
+                best_t = t
+        if best_t is None:
+            return None
+        job = self._tenants[best_t].jobs.pop(0)
+        self.depth -= 1
+        self._vtime = max(self._vtime, job.start_tag)
+        return job
+
+    def take(self, tenant: str, tenant_seq: int) -> Optional[_Job]:
+        """Remove a SPECIFIC job (non-root ranks following rank 0's
+        ordering frame). None until the lockstep submission arrives."""
+        tq = self._tenants.get(tenant)
+        if tq is None:
+            return None
+        for i, job in enumerate(tq.jobs):
+            if job.tenant_seq == tenant_seq:
+                tq.jobs.pop(i)
+                self.depth -= 1
+                self._vtime = max(self._vtime, job.start_tag)
+                return job
+        return None
+
+    def drain(self) -> List[_Job]:
+        out = [j for tq in self._tenants.values() for j in tq.jobs]
+        for tq in self._tenants.values():
+            tq.jobs.clear()
+        self.depth = 0
+        return out
+
+
+class Scheduler:
+    """Owns the admission queue and the dispatcher thread of one
+    Context. Constructed lazily by ``Context.submit``."""
+
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+        from . import tenancy
+        tenancy.configure(ctx)          # env budgets, idempotent
+        self._cv = threading.Condition()
+        self.queue = WfqQueue(_parse_weights(
+            os.environ.get("THRILL_TPU_SERVE_WEIGHTS", "")))
+        self.jobs_submitted = 0
+        self.jobs_failed = 0
+        self._job_ids = 0
+        self._closing = False
+        self._dead: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._loop, name="thrill-serve-dispatch", daemon=True)
+        self._thread.start()
+
+    # -- client side ----------------------------------------------------
+    def submit(self, fn: Callable, tenant: str = "default",
+               name: str = "", weight: Optional[float] = None
+               ) -> JobFuture:
+        """Queue ``fn(ctx) -> result`` for execution; thread-safe."""
+        with self._cv:
+            self._job_ids += 1
+            # the default name must be RANK-DETERMINISTIC under the
+            # per-tenant lockstep contract: the global job counter
+            # depends on how tenants' client threads interleave, which
+            # may legally differ across ranks — the follower's
+            # divergence check compares names, so a counter-based
+            # default would poison a legal submission order. The
+            # per-tenant seq is what the contract agrees on.
+            if self._dead is not None:
+                return JobFuture.failed(
+                    self._job_ids, tenant,
+                    name or f"job-{self._job_ids}",
+                    RuntimeError(
+                        f"scheduler is dead after an unrecoverable "
+                        f"abort: {self._dead!r}"))
+            if self._closing:
+                return JobFuture.failed(
+                    self._job_ids, tenant,
+                    name or f"job-{self._job_ids}",
+                    RuntimeError("scheduler is closed"))
+            future = JobFuture(self._job_ids, tenant, name)
+            if weight is not None:
+                self.queue.set_weight(tenant, weight)
+            job = self.queue.push(fn, tenant, future.name, future)
+            self.jobs_submitted += 1
+            depth = self.queue.depth
+            self._cv.notify_all()
+        log = self.ctx.logger
+        if log.enabled:
+            log.line(event="job_submit", job=future.job_id,
+                     name=future.name, tenant=tenant,
+                     queue_depth=depth)
+        return future
+
+    @property
+    def alive(self) -> bool:
+        """The dispatcher thread still owns the mesh/control plane."""
+        return self._thread.is_alive()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"jobs_submitted": self.jobs_submitted,
+                    "jobs_failed": self.jobs_failed,
+                    "queue_depth_peak": self.queue.depth_peak}
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain queued jobs, then stop the dispatcher. Called by
+        ``Context.close`` — submitted futures always resolve."""
+        with self._cv:
+            self._closing = True
+            self._cv.notify_all()
+        t = self._thread
+        if t.is_alive() and t is not threading.current_thread():
+            from ..common.timeouts import scaled
+            t.join(timeout=timeout if timeout is not None
+                   else scaled(300.0))
+            if t.is_alive():
+                import sys
+                print("thrill_tpu.service: dispatcher thread did not "
+                      "drain before close timeout", file=sys.stderr)
+
+    # -- dispatcher side ------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            job = self._next_job()
+            if job is None:
+                break
+            self._run(job)
+        # whatever ended the loop, no submitted future may be left
+        # pending — close()'s contract is that every future resolves
+        # (_poison already drained on the dead paths; this covers a
+        # rank whose local queue still held jobs at the sentinel)
+        with self._cv:
+            stranded = self.queue.drain()
+            self.jobs_failed += len(stranded)
+        for job in stranded:
+            job.future._finish(error=RuntimeError(
+                "scheduler stopped before this job ran"))
+
+    def _next_job(self) -> Optional[_Job]:
+        net = self.ctx.net
+        multi = net.num_workers > 1
+        if not multi or net.group.my_rank == 0:
+            with self._cv:
+                while True:
+                    if self._dead is not None:
+                        job = None
+                        break
+                    job = self.queue.pop()
+                    if job is not None or self._closing:
+                        break
+                    self._cv.wait()
+            if multi:
+                # the admission agreement: rank 0's pick becomes the
+                # cluster's next job (or the drain sentinel). The
+                # frame rides the generation-tagged control plane.
+                # (tenant, tenant_seq) identifies the job ONLY when
+                # each tenant's submission order agrees across ranks —
+                # the per-tenant half of the lockstep contract (one
+                # submitting thread per tenant, or an order the app
+                # makes rank-deterministic). The job NAME rides along
+                # so a violated contract dies loudly on the follower
+                # instead of silently running different pipelines in
+                # the same collective slot.
+                frame = (None if job is None
+                         else (job.tenant, job.tenant_seq, job.name))
+                try:
+                    net.broadcast(frame, origin=0)
+                except Exception as e:
+                    if job is not None:
+                        # already popped: _poison's drain won't see it,
+                        # count its failure here
+                        with self._cv:
+                            self.jobs_failed += 1
+                        job.future._finish(error=e)
+                        self._poison(e)
+                    return None
+            return job
+        # non-root: follow rank 0's ordering frame, then wait for the
+        # lockstep submission to arrive locally
+        try:
+            frame = net.broadcast(None, origin=0)
+        except Exception as e:
+            self._poison(e)
+            return None
+        if frame is None:
+            return None
+        tenant, seq, name = frame
+        with self._cv:
+            while True:
+                job = self.queue.take(tenant, seq)
+                if job is not None:
+                    if job.name != name:
+                        # per-tenant submission order diverged across
+                        # ranks: running this job in rank 0's slot
+                        # would mismatch the mesh collectives — fail
+                        # LOUDLY instead
+                        err = RuntimeError(
+                            f"cross-rank admission divergence: rank 0 "
+                            f"announced ({tenant}, {seq}) = {name!r}, "
+                            f"this rank holds {job.name!r} — tenant "
+                            f"submission order must be "
+                            f"rank-deterministic")
+                        job.future._finish(error=err)
+                        self._poison(err)
+                        return None
+                    return job
+                if self._dead is not None:
+                    return None
+                # NOT an exit on _closing: rank 0 announced this job,
+                # so by the lockstep contract the local submit is on
+                # its way — leaving now would strand the future AND
+                # desert rank 0 mid-collective. The drain sentinel
+                # (frame is None) is the orderly exit; a violated
+                # contract is bounded by close()'s join timeout (the
+                # dispatcher is a daemon thread).
+                self._cv.wait()
+
+    def _run(self, job: _Job) -> None:
+        ctx = self.ctx
+        fut = job.future
+        t0 = time.monotonic()
+        fut.queue_wait_s = t0 - job.t_submit
+        from ..api.context import PipelineError
+        err: Optional[BaseException] = None
+        try:
+            with ctx.pipeline(name=job.name) as gen:
+                fut.generation = gen
+                ctx.current_tenant = job.tenant
+                faults.check(_F_SUBMIT, job=fut.job_id,
+                             tenant=job.tenant)
+                out = job.fn(ctx)
+            fut.run_s = time.monotonic() - t0
+            fut._finish(result=out)
+        except PipelineError as e:
+            # scoped failure: the Context healed; only THIS job failed
+            err = e
+            fut.generation = e.generation
+            fut.run_s = time.monotonic() - t0
+            with self._cv:
+                self.jobs_failed += 1
+            fut._finish(error=e)
+        except BaseException as e:
+            # unrecoverable abort (dead peer, failed heal): the
+            # Context cannot serve anymore — fail everything queued,
+            # loudly; supervised relaunch owns recovery from here
+            err = e
+            fut.run_s = time.monotonic() - t0
+            with self._cv:
+                self.jobs_failed += 1
+            fut._finish(error=e)
+            self._poison(e)
+        finally:
+            ctx.current_tenant = None
+        log = ctx.logger
+        if log.enabled:
+            log.line(event="job_done", job=fut.job_id, name=fut.name,
+                     tenant=job.tenant, ok=err is None,
+                     generation=fut.generation,
+                     queue_wait_s=round(fut.queue_wait_s, 4),
+                     run_s=round(fut.run_s, 4),
+                     error=(repr(err)[:200] if err is not None
+                            else None))
+
+    def _poison(self, cause: BaseException) -> None:
+        with self._cv:
+            self._dead = cause
+            stranded = self.queue.drain()
+            self.jobs_failed += len(stranded)
+            self._cv.notify_all()
+        for job in stranded:
+            job.future._finish(error=RuntimeError(
+                f"job never ran: scheduler died after an unrecoverable "
+                f"abort: {cause!r}"))
+        faults.note("recovery", what="service.scheduler_dead",
+                    stranded=len(stranded), error=repr(cause)[:200])

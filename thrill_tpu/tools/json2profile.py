@@ -98,6 +98,7 @@ def render_html(events: List[dict]) -> str:
     profiles = []
     exchanges = []
     fused = []         # fused_dispatch (api/fusion.py program stitching)
+    jobs = []          # job_submit / job_done (service/scheduler.py)
     loops = []         # iteration / loop_* (api/loop.py LoopPlan replay)
     ckpt = []          # checkpoint / ckpt_restore / resume (durability)
     overall = []       # overall_stats summary lines
@@ -141,6 +142,9 @@ def render_html(events: List[dict]) -> str:
             faults.append((t, e))
         elif e.get("event") == "fused_dispatch":
             fused.append(e)
+        elif e.get("event") in ("job_submit", "job_done",
+                                "plan_store_load", "plan_store_save"):
+            jobs.append((t, e))
         elif e.get("event") in ("iteration", "loop_replay", "loop_plan",
                                 "loop_capture_miss",
                                 "loop_replay_fallback", "loop_done",
@@ -209,6 +213,7 @@ td.hm {{ min-width: 3em; }}
 {_render_worker_lanes(exchanges, total)}
 {_render_memory_events(memory, total)}
 {_render_fused_dispatches(fused, overall)}
+{_render_service_jobs(jobs, overall, total)}
 {_render_loop_iterations(loops, overall)}
 {_render_checkpoint_events(ckpt, overall)}
 {_render_fault_events(faults)}
@@ -257,6 +262,96 @@ def _render_fused_dispatches(fused, overall) -> str:
 {summary}
 <table><tr><th class=l>stage composition</th><th>ops</th>
 <th>dispatches</th><th>saved</th></tr>{''.join(rows)}</table>"""
+
+
+def _render_service_jobs(jobs, overall, total: float) -> str:
+    """Per-job service timeline (service/scheduler.py): one row per
+    submitted job — queue wait rendered as the orange span, execution
+    as the blue one — plus the admission counters and plan-store
+    events, so serving latency decomposes visually into waiting vs
+    running the way the stage timeline decomposes a single pipeline."""
+    if not jobs:
+        return ""
+    # pair job_submit/job_done by job id (per host)
+    by_id: dict = {}
+    store_rows = []
+    for t, e in jobs:
+        if e.get("event") == "job_submit":
+            by_id.setdefault((e.get("host", 0), e.get("job")),
+                             {})["submit"] = (t, e)
+        elif e.get("event") == "job_done":
+            by_id.setdefault((e.get("host", 0), e.get("job")),
+                             {})["done"] = (t, e)
+        else:
+            store_rows.append(
+                f"<tr><td>{t:8.3f}s</td><td class=l>"
+                f"{html.escape(str(e.get('event')))}</td><td class=l>"
+                f"{html.escape(str(e.get('path', '')))}</td>"
+                f"<td>{e.get('entries', '')}</td></tr>")
+    bars = []
+    rows = []
+    for (h, jid), rec in sorted(by_id.items(),
+                                key=lambda kv: kv[1].get(
+                                    "submit", kv[1].get("done"))[0]):
+        sub = rec.get("submit")
+        done = rec.get("done")
+        t0 = sub[0] if sub else (done[0] - (done[1].get("run_s") or 0)
+                                 - (done[1].get("queue_wait_s") or 0))
+        e = done[1] if done else sub[1]
+        wait = float(e.get("queue_wait_s") or 0)
+        run = float(e.get("run_s") or 0)
+        name = e.get("name") or f"job-{jid}"
+        tenant = e.get("tenant") or "?"
+        ok = e.get("ok")
+        span = max(total, 1e-9)
+        left = 100.0 * t0 / span
+        ww = max(100.0 * wait / span, 0.1)
+        rw = max(100.0 * run / span, 0.1)
+        bars.append(
+            f'<div class="row"><span class="lbl">{html.escape(str(name))}'
+            f' [{html.escape(str(tenant))}]</span>'
+            f'<div class="track">'
+            f'<div class="mark" style="left:{left:.2f}%;width:{ww:.2f}%">'
+            f'</div>'
+            f'<div class="bar" style="left:{left + ww:.2f}%;'
+            f'width:{rw:.2f}%"></div></div>'
+            f'<span class="dur">{wait * 1e3:.1f} ms queued · '
+            f'{run * 1e3:.1f} ms run'
+            f'{" · FAILED" if ok is False else ""}</span></div>')
+        rows.append(
+            f"<tr><td>{t0:8.3f}s</td><td class=l>"
+            f"{html.escape(str(name))}</td><td class=l>"
+            f"{html.escape(str(tenant))}</td>"
+            f"<td>{wait * 1e3:.1f}</td><td>{run * 1e3:.1f}</td>"
+            f"<td class=l>{'ok' if ok else html.escape(str(e.get('error') or ('?' if ok is None else 'failed')))}"
+            f"</td><td>{e.get('generation', '')}</td></tr>")
+    summary = ""
+    if overall:
+        o = overall[-1]
+        if o.get("jobs_submitted") is not None:
+            peaks = o.get("tenant_hbm_peaks") or {}
+            peak_s = ", ".join(f"{t}: {b}" for t, b in
+                               sorted(peaks.items())) or "none"
+            summary = (
+                f"<p><b>{o.get('jobs_submitted')}</b> jobs submitted, "
+                f"{o.get('jobs_failed')} failed, queue depth peak "
+                f"{o.get('queue_depth_peak')}; plan builds "
+                f"{o.get('plan_builds')}, plan-store hits "
+                f"{o.get('plan_store_hits')}; tenant HBM peaks: "
+                f"{html.escape(peak_s)}</p>")
+    store_tbl = ""
+    if store_rows:
+        store_tbl = (f"<table><tr><th class=l>t</th><th class=l>event"
+                     f"</th><th class=l>path</th><th>entries</th></tr>"
+                     f"{''.join(store_rows)}</table>")
+    return f"""
+<h2>service jobs (queue wait + run)</h2>
+{summary}
+{''.join(bars)}
+<table><tr><th class=l>t</th><th class=l>job</th><th class=l>tenant</th>
+<th>wait ms</th><th>run ms</th><th class=l>outcome</th>
+<th>gen</th></tr>{''.join(rows)}</table>
+{store_tbl}"""
 
 
 def _render_loop_iterations(loops, overall) -> str:
