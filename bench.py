@@ -305,6 +305,30 @@ def _run_bench() -> None:
     _set(terasort_disp=disp, **_xchg_fields(mex, xs, "terasort"))
     _note_dispersion(disp)
 
+    # tracing overhead contract (common/trace.py): paired on/off
+    # timing of the SAME Sort pipeline pins what the spine costs when
+    # enabled, and the per-lane span counts say where spans come from
+    # — future PRs cannot silently regress the disabled-path cost
+    tr = ctx.tracer
+    prev_tr = tr.enabled
+    try:
+        lanes0 = dict(tr.lane_counts)       # delta, not lifetime
+        tr.enabled = True
+        dt_on, _ = _best_of(run_once, iters=2)
+        tr.enabled = False
+        dt_off, _ = _best_of(run_once, iters=2)
+        _set(trace_overhead_frac=round(
+                 max(dt_on / dt_off - 1.0, 0.0), 4),
+             trace_spans={k: int(v - lanes0.get(k, 0)) for k, v in
+                          sorted(dict(tr.lane_counts).items())
+                          if v - lanes0.get(k, 0)})
+    except Exception as e:  # observability metric never kills the line
+        _set(trace_error=repr(e)[:200])
+    finally:
+        # a raising leg must not leave the tracer forced on/off for
+        # every later workload (the fusion_report env-leak bug class)
+        tr.enabled = prev_tr
+
     # host proxy baseline on identical data (best-of-2: one spike in
     # the BASELINE leg would otherwise inflate vs_baseline)
     host_dt, host_disp = _best_of(

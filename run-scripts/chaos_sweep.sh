@@ -58,9 +58,21 @@ if [[ "${CHAOS_SERVE:-0}" == "1" ]]; then
   TARGETS+=(tests/service/test_service_chaos.py)
 fi
 
+# Flight-recorder archive: every injected abort in the sweep leaves a
+# post-mortem dump here (common/trace.py). Each dump's header records
+# the THRILL_TPU_FAULTS arming active at abort time — the seed that
+# produced the failure — so a sweep failure ships its own repro
+# context. FLIGHT_KEEP is raised so a long sweep's early failures are
+# not pruned away.
+FLIGHT_DIR=${CHAOS_FLIGHT_DIR:-/tmp/thrill_chaos_flight.$$}
+mkdir -p "$FLIGHT_DIR"
+echo "chaos_sweep: flight-recorder dumps archive to $FLIGHT_DIR" >&2
+
 exec env JAX_PLATFORMS=cpu THRILL_TPU_CHAOS_SEEDS="$N_SEEDS" \
     THRILL_TPU_CHAOS_KILL_SEEDS="$N_SEEDS" \
     THRILL_TPU_SURVIVE_SEEDS="$N_SEEDS" \
     THRILL_TPU_SERVE_SEEDS="$N_SEEDS" \
+    THRILL_TPU_FLIGHT_DIR="$FLIGHT_DIR" \
+    THRILL_TPU_FLIGHT_KEEP="${THRILL_TPU_FLIGHT_KEEP:-10000}" \
     python -m pytest -m chaos -q -p no:cacheprovider \
     "${TARGETS[@]}" "$@"

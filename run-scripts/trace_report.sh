@@ -1,0 +1,81 @@
+#!/usr/bin/env bash
+# Trace report: run a small pipeline + service-mode jobs with the
+# tracing spine on, export a Perfetto-loadable trace and the HTML
+# profile, and demonstrate the flight recorder with an injected
+# mid-exchange abort.
+#
+# Usage:
+#   run-scripts/trace_report.sh [OUT_DIR]
+#
+# Outputs (under OUT_DIR, default /tmp/thrill_tpu_trace):
+#   run-host0.json   raw JSON event log (spans + flat events)
+#   trace.json       Chrome-trace-event JSON — load in ui.perfetto.dev
+#                    or chrome://tracing (pid lane per rank, tid lane
+#                    per subsystem)
+#   report.html      the classic json2profile timeline
+#   flight/          flight-recorder dump from the injected abort (its
+#                    final spans name the failing site + generation;
+#                    the header records the THRILL_TPU_FAULTS arming)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=${1:-/tmp/thrill_tpu_trace}
+mkdir -p "$OUT"
+rm -f "$OUT"/run-host*.json
+
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    THRILL_TPU_LOG="$OUT/run.json" \
+    THRILL_TPU_FLIGHT_DIR="$OUT/flight" \
+    python - <<'PY'
+import numpy as np
+from thrill_tpu.api import Context, PipelineError
+from thrill_tpu.common import faults
+from thrill_tpu.parallel.mesh import MeshExec
+
+
+def kv(x):
+    return (x % 17, x)
+
+
+def add(a, b):
+    return a + b
+
+
+def reduce_job(c):
+    return c.Distribute(np.arange(256, dtype=np.int64)) \
+            .Map(kv).ReducePair(add).Size()
+
+
+def sort_job(c):
+    return c.Generate(512).Map(lambda x: x * 7 % 513).Sort().Size()
+
+
+ctx = Context(MeshExec(num_workers=2))
+# service-mode jobs: the trace shows queue-wait vs run per job, with
+# dispatch/exchange spans nested under each job span
+for i in range(3):
+    ctx.submit(reduce_job if i % 2 == 0 else sort_job,
+               tenant=f"tenant{i % 2}", name=f"job-{i}").result(600)
+# flight-recorder demo: a mid-exchange injected fault aborts one
+# pipeline; the Context heals and the dump lands in $OUT/flight
+with faults.inject("data.exchange.chunk", n=99):
+    try:
+        with ctx.pipeline(name="doomed"):
+            reduce_job(ctx)
+    except PipelineError as e:
+        print(f"injected abort healed (generation {e.generation}); "
+              f"flight dump written")
+ctx.submit(reduce_job, tenant="tenant0", name="post-abort").result(600)
+ctx.close()
+PY
+
+python -m thrill_tpu.tools.trace2perfetto "$OUT"/run-host0.json \
+    > "$OUT/trace.json"
+python -m thrill_tpu.tools.json2profile "$OUT"/run-host0.json \
+    > "$OUT/report.html"
+
+echo "trace:  $OUT/trace.json  (load in ui.perfetto.dev)"
+echo "report: $OUT/report.html"
+echo "flight recorder dumps:"
+ls -l "$OUT/flight" | tail -n +2

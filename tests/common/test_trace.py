@@ -1,0 +1,398 @@
+"""Tracing spine (common/trace.py), flight recorder, metrics endpoint
+and the event-log schema contract.
+
+Acceptance pins (ISSUE 10):
+* a W=2 PageRank + service-mode run produces a Perfetto-loadable trace
+  — rank (pid) lanes, nested dispatch-under-exchange-under-job spans,
+  tenant/job/generation tags;
+* an injected mid-exchange abort leaves a flight-recorder dump whose
+  final spans name the failing site and generation;
+* THRILL_TPU_TRACE=0 is a pinned no-op at the _CountedJit choke point
+  (no span objects allocated);
+* the metrics endpoint serves valid Prometheus text while a Context
+  serves, without perturbing results;
+* every logged event line carries the required schema keys
+  (event, ts, host) — json2profile silently drops malformed lines.
+"""
+
+import json
+import os
+import re
+import sys
+import tempfile
+import urllib.request
+
+import numpy as np
+import pytest
+
+from thrill_tpu.api import Context, PipelineError
+from thrill_tpu.common import faults, trace
+from thrill_tpu.common.config import Config
+from thrill_tpu.parallel.mesh import MeshExec
+from thrill_tpu.tools.json2profile import load_events
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+def _kv(x):
+    return (x % 9, x)
+
+
+def _add(a, b):
+    return a + b
+
+
+def _reduce_job(ctx):
+    return sorted((int(k), int(v)) for k, v in ctx.Distribute(
+        np.arange(72, dtype=np.int64)).Map(_kv).ReducePair(
+            _add).AllGather())
+
+
+def _examples_path():
+    p = os.path.join(os.path.dirname(__file__), "..", "..", "examples")
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def _pagerank_job(ctx):
+    _examples_path()
+    import page_rank as pr
+    edges = pr.zipf_graph(128, 512, seed=3)
+    return pr.page_rank(ctx, edges, 128, iterations=3)
+
+
+# ----------------------------------------------------------------------
+# the acceptance run: W=2 PageRank + service mode, schema-validated.
+# ONE run feeds the span-nesting test AND the Perfetto-export test
+# (module-scoped fixture: the run costs ~7s, the assertions ~0)
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def service_events(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("trace-service-run")
+    log = os.path.join(str(tmp_path), "events.json")
+    cfg = Config(log_path=log)
+    ctx = Context(MeshExec(num_workers=2), cfg)
+    f1 = ctx.submit(_pagerank_job, tenant="tenantA", name="pagerank")
+    f2 = ctx.submit(_reduce_job, tenant="tenantB", name="reduce")
+    ranks = f1.result(600)
+    red = f2.result(600)
+    ctx.close()
+    assert len(ranks) == 128 and len(red) == 9
+    return load_events(os.path.join(str(tmp_path), "events-host0.json"))
+
+
+def _span_index(events):
+    spans = [e for e in events if e.get("event") == "span"]
+    by_id = {s["span"]: s for s in spans if "span" in s}
+    return spans, by_id
+
+
+def _ancestor_cats(span, by_id):
+    cats = []
+    seen = set()
+    while span is not None and span.get("span") not in seen:
+        seen.add(span.get("span"))
+        cats.append(span.get("cat"))
+        span = by_id.get(span.get("parent"))
+    return cats
+
+
+def test_service_run_spans_nest_and_carry_tags(service_events):
+    events = service_events
+    spans, by_id = _span_index(events)
+    assert spans, "no span events logged"
+    # required span schema
+    for s in spans:
+        for k in ("ts", "cat", "name", "span", "trace", "rank",
+                  "dur_us"):
+            assert k in s, (k, s)
+    # the ISSUE acceptance nesting: a device dispatch under an exchange
+    # span under a service job span — one chain correlating all three
+    nested = [s for s in spans if s["cat"] == "dispatch"
+              and "exchange" in _ancestor_cats(s, by_id)
+              and "service" in _ancestor_cats(s, by_id)]
+    assert nested, "no dispatch-under-exchange-under-job chain"
+    # tenant/job/generation tags
+    assert any(s.get("tenant") == "tenantA"
+               and s.get("job") == "pagerank" for s in spans)
+    assert any(s.get("tenant") == "tenantB"
+               and s.get("job") == "reduce" for s in spans)
+    assert any(s.get("generation") for s in spans)
+    # the iterative job put spans on the loop lane; queue-wait and run
+    # bars exist per job
+    cats = {s["cat"] for s in spans}
+    assert {"dispatch", "exchange", "service", "loop"} <= cats
+    waits = [s for s in spans if s["name"] == "queue_wait"]
+    jobs = [s for s in spans if s["name"].startswith("job:")]
+    assert len(waits) == 2 and len(jobs) == 2
+    assert all(j.get("generation") is not None for j in jobs)
+
+
+def test_perfetto_export_is_loadable(service_events):
+    from thrill_tpu.tools.trace2perfetto import to_chrome
+    doc = to_chrome(service_events)
+    evs = doc["traceEvents"]
+    assert evs
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert xs
+    for e in xs:   # Chrome trace-event schema for complete events
+        assert set(("pid", "tid", "ts", "dur", "name", "cat")) \
+            <= set(e)
+        assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+    # rank lanes: pid per rank, thread_name metadata per subsystem lane
+    names = {m["args"]["name"] for m in evs
+             if m.get("ph") == "M" and m.get("name") == "thread_name"}
+    assert {"dispatch", "exchange", "service", "loop"} <= names
+    assert {m["args"]["name"] for m in evs if m.get("ph") == "M"
+            and m.get("name") == "process_name"} == {"rank 0"}
+    # round-trips through json
+    json.loads(json.dumps(doc))
+
+
+# ----------------------------------------------------------------------
+# disabled-path pin: THRILL_TPU_TRACE=0 allocates NO span objects
+# ----------------------------------------------------------------------
+
+def test_trace_disabled_is_pinned_noop(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_TRACE", "0")
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        assert ctx.tracer is not None and not ctx.tracer.enabled
+        d0 = ctx.mesh_exec.stats_dispatches
+        n0 = trace.SPANS_CREATED
+        assert _reduce_job(ctx) == sorted(
+            (k, sum(v for v in range(72) if v % 9 == k))
+            for k in range(9))
+        assert ctx.mesh_exec.stats_dispatches > d0, "nothing dispatched"
+        assert trace.SPANS_CREATED == n0, \
+            "span objects allocated at the dispatch choke point with " \
+            "THRILL_TPU_TRACE=0"
+        assert not ctx.tracer.ring
+    finally:
+        ctx.close()
+
+
+def test_trace_results_identical_on_off(monkeypatch):
+    want = None
+    for flag in ("1", "0"):
+        monkeypatch.setenv("THRILL_TPU_TRACE", flag)
+        ctx = Context(MeshExec(num_workers=2))
+        try:
+            got = _reduce_job(ctx)
+        finally:
+            ctx.close()
+        if want is None:
+            want = got
+        assert got == want
+
+
+# ----------------------------------------------------------------------
+# flight recorder: an injected mid-exchange abort leaves a post-mortem
+# whose final spans name the failing site and generation
+# ----------------------------------------------------------------------
+
+def test_flight_recorder_names_failing_site(tmp_path, monkeypatch):
+    fd = str(tmp_path / "flight")
+    monkeypatch.setenv("THRILL_TPU_FLIGHT_DIR", fd)
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        err = None
+        with faults.inject("data.exchange.chunk", n=99):
+            faults.REGISTRY.reset()
+            try:
+                with ctx.pipeline(name="doomed"):
+                    _reduce_job(ctx)
+            except PipelineError as e:
+                err = e
+        assert err is not None, "injected fault did not abort"
+        files = os.listdir(fd)
+        assert files, "no flight-recorder dump written"
+        lines = [json.loads(l) for l in
+                 open(os.path.join(fd, sorted(files)[-1]))]
+        hdr = lines[0]
+        assert hdr["event"] == "flight_header"
+        assert hdr["generation"] == err.generation
+        assert "data.exchange.chunk" in hdr["reason"]
+        assert hdr["faults"], "dump header lost the fault arming"
+        # the ring's FINAL spans carry the failing site + generation
+        errs = [r for r in lines[1:] if "error" in r]
+        assert errs, "no error-carrying span in the dump"
+        assert any("data.exchange.chunk" in r["error"]
+                   and r.get("generation") == err.generation
+                   and r.get("cat") == "exchange" for r in errs)
+        # the Context healed: a clean pipeline still runs
+        faults.REGISTRY.reset()
+        assert _reduce_job(ctx) == sorted(
+            (k, sum(v for v in range(72) if v % 9 == k))
+            for k in range(9))
+    finally:
+        ctx.close()
+
+
+def test_flight_dir_off_switch(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_FLIGHT_DIR", "0")
+    assert trace.flight_dir() is None
+    tr = trace.Tracer()
+    with tr.span("dispatch", "x"):
+        pass
+    assert tr.dump_flight("reason") is None
+
+
+def test_flight_dir_prune(tmp_path, monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("THRILL_TPU_FLIGHT_KEEP", "3")
+    tr = trace.Tracer()
+    tr.instant("mem", "tick")
+    for _ in range(6):
+        assert tr.dump_flight("r") is not None
+    left = [f for f in os.listdir(str(tmp_path))
+            if f.startswith("flight-")]
+    assert len(left) == 3
+
+
+# ----------------------------------------------------------------------
+# metrics endpoint
+# ----------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^(# (TYPE|HELP) .*|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+    r"[-+0-9.eE]+)$")
+
+
+def scrape(port: int) -> str:
+    txt = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30).read().decode()
+    bad = [l for l in txt.splitlines() if l and not _PROM_LINE.match(l)]
+    assert not bad, f"invalid Prometheus lines: {bad[:5]}"
+    return txt
+
+
+def test_metrics_endpoint_serves_and_closes(monkeypatch):
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "net"))
+    from portalloc import free_ports
+    port = free_ports(1)[0]
+    monkeypatch.setenv("THRILL_TPU_METRICS_PORT", str(port))
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        fut = ctx.submit(_reduce_job, tenant="tA", name="mjob")
+        assert fut.result(600) == sorted(
+            (k, sum(v for v in range(72) if v % 9 == k))
+            for k in range(9))
+        txt = scrape(port)
+        for want in ("thrill_tpu_device_dispatches",
+                     "thrill_tpu_exchanges",
+                     "thrill_tpu_jobs_submitted",
+                     "thrill_tpu_queue_depth",
+                     "thrill_tpu_jobs_in_flight",
+                     "thrill_tpu_hbm_live_bytes"):
+            assert want in txt, want
+        # span lane counters (bench satellite reads the same dict)
+        assert 'thrill_tpu_trace_spans{lane="dispatch"}' in txt
+    finally:
+        ctx.close()
+    with pytest.raises(Exception):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                               timeout=2)
+
+
+def test_metrics_unset_means_no_server(monkeypatch):
+    monkeypatch.delenv("THRILL_TPU_METRICS_PORT", raising=False)
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        assert ctx._metrics is None
+    finally:
+        ctx.close()
+
+
+# ----------------------------------------------------------------------
+# event-log schema contract (satellite: every emission site conforms)
+# ----------------------------------------------------------------------
+
+def test_log_schema_conformance(tmp_path):
+    """Every line of a real W=2 run's log — node events, exchanges,
+    spans, mem events, service events, overall_stats — parses as JSON
+    and carries the required keys: ``event`` (str), ``ts`` (int, µs),
+    ``host`` (int). json2profile silently drops malformed lines, so
+    this is the only guard."""
+    log = os.path.join(str(tmp_path), "events.json")
+    cfg = Config(log_path=log, profile=True)
+    ctx = Context(MeshExec(num_workers=2), cfg)
+    try:
+        # exercise the device, exchange and service emitters (the loop
+        # lane's schema rides the service_events fixture's run)
+        _reduce_job(ctx)
+        ctx.Generate(64).Map(lambda x: x * 3).Sort().Size()
+        ctx.submit(_reduce_job, tenant="tA").result(600)
+    finally:
+        ctx.close()
+    path = os.path.join(str(tmp_path), "events-host0.json")
+    with open(path) as f:
+        raw = [l for l in f if l.strip()]
+    assert len(raw) > 20
+    kinds = set()
+    for line in raw:
+        e = json.loads(line)           # raises = malformed line
+        assert isinstance(e.get("event"), str) and e["event"], e
+        assert isinstance(e.get("ts"), int), e
+        assert isinstance(e.get("host"), int), e
+        kinds.add(e["event"])
+    # the run above must have exercised the main emitters
+    for want in ("node_execute_start", "node_execute_done", "exchange",
+                 "span", "job_submit", "job_done", "overall_stats"):
+        assert want in kinds, (want, kinds)
+
+
+def test_logger_timestamps_are_monotonic_derived(tmp_path,
+                                                 monkeypatch):
+    """The (ts, mono) anchor satellite: a wall-clock step mid-run must
+    not skew event timestamps — ts derives from perf_counter deltas
+    off the construction-time anchor."""
+    import time as _time
+    from thrill_tpu.common.logger import JsonLogger
+    p = os.path.join(str(tmp_path), "l.json")
+    log = JsonLogger(p)
+    log.line(event="a")
+    real_time = _time.time
+    monkeypatch.setattr(_time, "time",
+                        lambda: real_time() + 3600.0)  # 1h NTP step
+    log.line(event="b")
+    log.close()
+    evs = [json.loads(l) for l in open(p) if l.strip()]
+    # had ts re-read the wall clock, b - a would be ~3600s
+    assert 0 <= evs[1]["ts"] - evs[0]["ts"] < 5_000_000
+    # child loggers share the parent's anchor
+    log2 = JsonLogger(p)
+    child = JsonLogger(parent=log2, sub=1)
+    assert child.now_us() - log2.now_us() < 1_000_000
+    log2.close()
+
+
+def test_span_of_null_path_is_shared():
+    """The disabled-guard helper returns ONE shared null context (no
+    allocation per call site on the off path)."""
+    a = trace.span_of(None, "x", "y")
+    b = trace.span_of(None, "x", "y")
+    assert a is b
+    tr = trace.Tracer(enabled=False)
+    assert trace.span_of(tr, "x", "y") is a
+
+
+def test_tracer_stack_recovers_from_leaked_spans():
+    tr = trace.Tracer(enabled=True, ring=16)
+    outer = tr.begin("loop", "outer")
+    tr.begin("dispatch", "leaked")      # never ended explicitly
+    tr.end(outer)                        # pops the leaked child too
+    assert tr.current_id() is None
+    with tr.span("fusion", "clean"):
+        pass
+    recs = list(tr.ring)
+    assert recs[-1]["name"] == "clean"
+    assert "parent" not in recs[-1]

@@ -54,7 +54,11 @@ def test_pipeline_on_bitonic_engine(monkeypatch):
     RunLocalMock(job, 4)
 
 
-@pytest.mark.parametrize("n", [1, 2, 64, 1024, 5000])
+@pytest.mark.parametrize("n", [
+    1, 2, 64, 1024,
+    # the 5000-row tail (multi-chunk path at every word count) rides
+    # the unfiltered sweep only; 1024 is the in-tier representative
+    pytest.param(5000, marks=pytest.mark.slow)])
 @pytest.mark.parametrize("nwords", [1, 2, 3])
 def test_chunked_matches_xla(monkeypatch, n, nwords):
     rng = np.random.default_rng(n * 31 + nwords)

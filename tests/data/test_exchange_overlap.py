@@ -60,7 +60,8 @@ def _assert_same(a, b):
             assert np.array_equal(ta[k], tb[k]), k
 
 
-@pytest.mark.parametrize("W", [1, 2, 4])
+@pytest.mark.parametrize("W", [
+    1, 2, pytest.param(4, marks=pytest.mark.slow)])
 def test_chunked_vs_bulk_bit_identical(W, monkeypatch):
     """Chunked (K=3), bulk (OVERLAP=0) and the optimistic second run
     (capacity-cache hit) produce byte-identical shards."""
@@ -89,7 +90,8 @@ def _plus(a, b):
     return a + b
 
 
-@pytest.mark.parametrize("W", [2, 4])
+@pytest.mark.parametrize("W", [
+    2, pytest.param(4, marks=pytest.mark.slow)])
 def test_pipeline_chunked_parity(W, monkeypatch):
     """A real fused pipeline (hash ReduceByKey across the exchange
     barrier) under chunking + the cap cache matches the bulk plane,

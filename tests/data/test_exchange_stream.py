@@ -45,7 +45,12 @@ def test_exchange_stream_delivers_every_item_once(W):
     ctx.close()
 
 
-@pytest.mark.parametrize("W", [2, 5, 8])
+@pytest.mark.parametrize("W", [
+    2,
+    # W sweep tails ride the unfiltered sweep only (tier-1 wall-clock
+    # budget; W=2 is the in-tier representative — PR-9 precedent)
+    pytest.param(5, marks=pytest.mark.slow),
+    pytest.param(8, marks=pytest.mark.slow)])
 def test_reduce_stream_matches_default(monkeypatch, W):
     rng = np.random.default_rng(W)
     vals = rng.integers(0, 40, 6000).astype(np.int64)

@@ -118,6 +118,90 @@ def test_serve_chaos_seed0(tmp_path):
     _serve_storm(0, tmp_path)
 
 
+@pytest.mark.chaos
+def test_metrics_scrape_during_serve_storm(monkeypatch):
+    """ISSUE 10 acceptance: the metrics endpoint serves valid
+    Prometheus text WHILE a fault storm runs through the service plane
+    — and the scraping perturbs no job result. Rides the CHAOS_SERVE
+    sweep (chaos mark) and tier-1 (not slow)."""
+    import re
+    import sys
+    import threading
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "net"))
+    from portalloc import free_ports
+
+    prom_line = re.compile(
+        r"^(# (TYPE|HELP) .*|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+        r"[-+0-9.eE]+)$")
+
+    def scrape(port: int) -> str:
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics",
+            timeout=30).read().decode()
+        bad = [l for l in txt.splitlines()
+               if l and not prom_line.match(l)]
+        assert not bad, f"invalid Prometheus lines: {bad[:5]}"
+        return txt
+
+    port = free_ports(1)[0]
+    monkeypatch.setenv("THRILL_TPU_METRICS_PORT", str(port))
+    monkeypatch.setenv(
+        faults.ENV_VAR,
+        "api.mesh.dispatch:p=0.5:n=2:seed=11;"
+        "data.exchange.chunk:p=0.5:n=2:seed=11")
+    faults.REGISTRY.reset()
+    ctx = Context(MeshExec(num_workers=2))
+    stop = threading.Event()
+    scrapes: list = []
+    errors: list = []
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                scrapes.append(scrape(port))
+            except AssertionError as e:   # malformed text = failure
+                errors.append(e)
+                return
+            except Exception:
+                pass                      # transient connect races ok
+            stop.wait(0.02)
+
+    t = threading.Thread(target=scraper, daemon=True)
+    t.start()
+    try:
+        futs = [ctx.submit(_job_reduce if j % 2 == 0 else _job_sum,
+                           tenant=f"t{j % 2}") for j in range(6)]
+        outcomes = []
+        for j, f in enumerate(futs):
+            try:
+                outcomes.append(f.result(300))
+            except PipelineError:
+                outcomes.append(None)
+        # storm over: a clean job is exact DESPITE concurrent scraping
+        monkeypatch.delenv(faults.ENV_VAR, raising=False)
+        faults.REGISTRY.reset()
+        clean = ctx.submit(_job_reduce, tenant="t0").result(300)
+        scrapes.append(scrape(port))      # at least one guaranteed
+    finally:
+        stop.set()
+        t.join(10)
+        ctx.close()
+    assert not errors, errors
+    assert scrapes and all("thrill_tpu_device_dispatches" in s
+                           for s in scrapes)
+    assert any("thrill_tpu_jobs_in_flight" in s for s in scrapes)
+    # every successfully-served reduce job and the clean job are exact
+    want = sorted((k, sum(v for v in range(72) if v % 9 == k))
+                  for k in range(9))
+    assert clean == want
+    for j, res in enumerate(outcomes):
+        if res is not None:
+            assert res == (want if j % 2 == 0 else sum(range(50)))
+
+
 @pytest.mark.slow
 @pytest.mark.chaos
 @pytest.mark.parametrize("seed", range(1, N_SEEDS))

@@ -103,10 +103,31 @@ class Context:
         self.mesh_exec.host_net = self.net
         self.logger = JsonLogger(
             default_log_path(self.config.log_path, host_rank=host_rank),
-            program="thrill_tpu", workers=self.num_workers)
+            program="thrill_tpu", workers=self.num_workers,
+            host=host_rank)
         # storage-layer events (device->host demotions) log through the
         # mesh the shards carry a reference to
         self.mesh_exec.logger = self.logger
+        # tracing spine (common/trace.py): one Tracer per Context,
+        # attached to the mesh (dispatch/fusion/exchange/mem/loop
+        # spans) and the net group (collective/heal spans); spans are
+        # tagged with the generation and tenant CURRENT at span start.
+        # THRILL_TPU_TRACE=0 pins the disabled fast path (no span
+        # objects anywhere); the ring doubles as the flight recorder.
+        from ..common.trace import Tracer
+        self.tracer = Tracer(rank=host_rank, logger=self.logger)
+        # getattr, not plain attribute reads: generation/current_tenant
+        # are assigned further down __init__, and a span started during
+        # construction must not crash on the not-yet-bound names
+        self.tracer.gen_fn = lambda: getattr(self, "generation", None)
+        self.tracer.tenant_fn = \
+            lambda: getattr(self, "current_tenant", None)
+        self.mesh_exec.tracer = self.tracer
+        self.net.group.tracer = self.tracer
+        # live metrics endpoint (common/metrics.py): Prometheus text on
+        # THRILL_TPU_METRICS_PORT from a daemon thread; unset = off
+        from ..common.metrics import maybe_start as _metrics_start
+        self._metrics = _metrics_start(self)
         # fault-injection / retry / abort events from every layer ride
         # the same JSON stream (tools/json2profile.py renders them);
         # counters are process-lifetime, so snapshot a baseline and
@@ -403,11 +424,16 @@ class Context:
         from .ops import read_write
         return read_write.ReadBinary(self, path_or_glob, dtype, record_shape)
 
-    def overall_stats(self) -> dict:
+    def overall_stats(self, local_only: bool = False) -> dict:
         """End-of-job summary (reference: OverallStats AllReduce,
         api/context.cpp:1235-1341). In multi-process runs the per-host
         stats are aggregated over the host control plane (``ctx.net``):
-        counters sum, peaks take the max."""
+        counters sum, peaks take the max.
+
+        ``local_only=True`` NEVER enters the cross-host collective —
+        the metrics endpoint's scrape thread (common/metrics.py) uses
+        it so a scrape can run while the service dispatcher owns the
+        control plane (the PR-9 local-view stats rule)."""
         mex = self.mesh_exec
         # fold real process RSS into the reported peak (reference:
         # malloc_tracker feeds OverallStats the true allocation peak)
@@ -460,8 +486,12 @@ class Context:
             # and the per-stage composition table
             "fused_dispatches": mex.stats_fused_dispatches,
             "fused_ops": mex.stats_fused_ops,
+            # dict() snapshot: the metrics scrape thread calls this
+            # with local_only=True while the dispatcher inserts new
+            # stage compositions — iterating the live dict would die
+            # mid-scrape on "changed size during iteration"
             "fused_stages": {" + ".join(ops): n for ops, n in
-                             mex.fused_stage_counts.items()},
+                             dict(mex.fused_stage_counts).items()},
             # iteration execution layer (api/loop.py): captures vs
             # replayed iterations (zero graph build / planning), whole-
             # loop fori_loop iterations, loud replay fallbacks, and
@@ -514,8 +544,8 @@ class Context:
         from ..common import faults
         stats.update({k: v - self._faults_base.get(k, 0)
                       for k, v in faults.REGISTRY.stats().items()})
-        if self.net.num_workers > 1 and not self._aborted \
-                and self.service is None:
+        if self.net.num_workers > 1 and not local_only \
+                and not self._aborted and self.service is None:
             # once a rank has EVER served, degrade to the local view
             # permanently: while dispatchers live, the non-root ranks'
             # park in a recv on this same untagged control plane
@@ -662,6 +692,13 @@ class Context:
                              pipeline=name or None,
                              recoverable=not unrecoverable,
                              cause=cause[:300])
+        # flight recorder: every abort leaves a self-contained
+        # post-mortem — the ring's final spans name the failing site
+        # (error attrs) and the generation. Best-effort by contract.
+        try:
+            self.tracer.dump_flight(cause, generation=failed_gen)
+        except Exception:
+            pass
         if (self.net.num_workers > 1
                 and not isinstance(exc, ClusterAbort)):
             # a RANK-LOCAL failure (user logic, per-rank I/O): the
@@ -764,6 +801,10 @@ class Context:
             self.logger.line(event="abort", origin=self.host_rank,
                              generation=self.generation,
                              cause=cause_s[:300])
+        try:
+            self.tracer.dump_flight(cause, generation=self.generation)
+        except Exception:
+            pass
         if self.net.num_workers > 1:
             self.net.group.poison_peers(cause)
         if isinstance(cause, BaseException):
@@ -804,6 +845,14 @@ class Context:
         from ..net.group import ClusterAbort, CollectiveHangTimeout
         if isinstance(exc, (ClusterAbort, CollectiveHangTimeout)):
             self._aborted = True
+            # an abort escaping the whole job (no ctx.pipeline() heal
+            # caught it) still leaves its post-mortem
+            try:
+                self.tracer.dump_flight(
+                    exc, generation=getattr(exc, "generation",
+                                            self.generation))
+            except Exception:
+                pass
 
     def close(self) -> None:
         from ..net.group import ClusterAbort
@@ -814,6 +863,11 @@ class Context:
         # supervisor would relaunch only the dead rank — stranding it
         # in bootstrap against a rank that never comes back
         discovered: Optional[BaseException] = None
+        # metrics endpoint first: no scrape may observe (or race) the
+        # teardown below
+        if getattr(self, "_metrics", None) is not None:
+            self._metrics.close()
+            self._metrics = None
         # service plane first: drain queued jobs and stop the
         # dispatcher BEFORE the stats collective (the dispatcher owns
         # the mesh while serving), then persist the learned plan state

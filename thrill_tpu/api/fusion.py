@@ -59,6 +59,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..common import faults
+from ..common import trace as _trace
 from ..common.retry import default_policy
 from ..data.shards import DeviceShards, HostShards, compact_valid
 from ..parallel.mesh import AXIS
@@ -222,10 +223,24 @@ class FusionPlan:
 
     def execute(self) -> DeviceShards:
         mex = self.mex
-        srcs = self.sources
         segs = self.all_segments
         if not segs:
-            return srcs[0]
+            return self.sources[0]
+        tr = getattr(mex, "tracer", None)
+        if tr is None or not tr.enabled:
+            return self._execute_inner()
+        # one span per stitched launch: the chunk/dispatch spans nest
+        # under it, so a Perfetto lane shows which ops each dispatch
+        # carried (trace taxonomy: cat "fusion")
+        with tr.span("fusion",
+                     "+".join(s.label for s in segs)[:120],
+                     ops=len(segs)):
+            return self._execute_inner()
+
+    def _execute_inner(self) -> DeviceShards:
+        mex = self.mex
+        srcs = self.sources
+        segs = self.all_segments
         # exchange-boundary scheduling: a source produced by an
         # OPTIMISTIC exchange (data/exchange.py capacity-plan cache)
         # still owes its deferred capacity check — run it before this
@@ -423,6 +438,8 @@ class FusionPlan:
                                 cap=src.cap)
                     faults.note("recovery", what="mem.segment_split",
                                 _quiet=True)
+                    _trace.instant_of(getattr(mex, "tracer", None),
+                                      "mem", "segment_split", k=k)
                     return out
         if all(s.host_apply is not None for s in segs):
             # last rung: the host engine (the reference's EM
@@ -430,6 +447,8 @@ class FusionPlan:
             pres.host_fallbacks += 1
             faults.note("recovery", what="mem.host_fallback",
                         ops=labels)
+            _trace.instant_of(getattr(mex, "tracer", None), "mem",
+                              "host_fallback", ops=len(labels))
             shards = src.to_host_shards(reason="memory_pressure")
             lists = shards.lists
             for seg in segs:

@@ -896,26 +896,35 @@ def Iterate(ctx, body: Callable, carry, n: int, *, name: str = "loop",
               "fori_iters": 0, "fallbacks": 0, "capture_s": 0.0,
               "replay_s": 0.0, "calls": 0, "pruned": 0,
               "donated_bytes0": mex.stats_loop_donated_bytes}
+    tracer = getattr(ctx, "tracer", None)
+    tr_on = tracer is not None and tracer.enabled
     i = start
     while i < n:
         if plan is None:
             # ---- capture (or plain) iteration ------------------------
             t0 = time.perf_counter()
             d0 = mex.stats_dispatches
-            if can_replay and miss_streak < 2:
-                state, plan = _capture(ctx, run_body, state,
-                                       name=name, it=i)
-                if plan is not None:
-                    miss_streak = 0
-                    mex.stats_loop_plan_builds += 1
-                    report["captures"] += 1
-                    report["calls"] = len(plan.calls)
-                    report["pruned"] = (plan.pruned_invariant
-                                        + plan.pruned_dead)
+            sp = (tracer.begin("loop", "capture", loop=name, iter=i)
+                  if tr_on else None)
+            try:
+                if can_replay and miss_streak < 2:
+                    state, plan = _capture(ctx, run_body, state,
+                                           name=name, it=i)
+                    if plan is not None:
+                        miss_streak = 0
+                        mex.stats_loop_plan_builds += 1
+                        report["captures"] += 1
+                        report["calls"] = len(plan.calls)
+                        report["pruned"] = (plan.pruned_invariant
+                                            + plan.pruned_dead)
+                    else:
+                        miss_streak += 1
                 else:
-                    miss_streak += 1
-            else:
-                state = run_body(state)
+                    state = run_body(state)
+            finally:
+                if sp is not None:
+                    tracer.end(sp, mode=("capture" if plan is not None
+                                         else "plain"))
             dt = time.perf_counter() - t0
             report["capture_s"] += dt
             if log.enabled:
@@ -945,65 +954,76 @@ def Iterate(ctx, body: Callable, carry, n: int, *, name: str = "loop",
             and plan.fori_eligible() and remaining > 1
         t0 = time.perf_counter()
         d0 = mex.stats_dispatches
+        sp = (tracer.begin("loop", "replay", loop=name, iter=i)
+              if tr_on else None)
         try:
-            if faults.REGISTRY.active():
-                faults.check(_F_REPLAY, loop=name, iter=i)
-            if fori_ok:
-                out = plan.run_fori(leaves, remaining)
-                if out is not None:
-                    mex.stats_loop_fori_iters += remaining
-                    report["fori_iters"] += remaining
-                    state = _rebuild_carry(out, treedef, dia_mode,
-                                           mex, plan)
-                    dt = time.perf_counter() - t0
-                    report["replay_s"] += dt
-                    if log.enabled:
-                        log.line(event="loop_replay", loop=name,
-                                 iter=i, iters=remaining, fori=True,
-                                 seconds=round(dt, 6))
-                    i = n
-                    continue
-            out = plan.replay(
-                leaves,
-                donate and not faults.REGISTRY.active(),
-                donate_carry=not fresh_plan and not ckpt)
-        except Exception as e:
-            # LOUD degradation: a failed replayed dispatch falls back
-            # to full re-planning for this iteration (the body path,
-            # which re-captures); the loop slows down, it never lies.
-            # Unless donation already consumed part of the carry mid-
-            # iteration — then there is nothing to re-plan FROM, and
-            # the only honest outcome is a clear error, not a deleted-
-            # array crash deep inside the pull recursion.
-            if any(getattr(l, "is_deleted", lambda: False)()
-                   for l in leaves):
-                raise RuntimeError(
-                    f"loop '{name}' iteration {i}: a replayed dispatch "
-                    f"failed after part of the loop carry was donated; "
-                    f"cannot degrade to re-planning. Re-run with "
-                    f"THRILL_TPU_LOOP_DONATE=0 (or from the last "
-                    f"checkpoint epoch).") from e
-            mex.stats_loop_fallbacks += 1
-            report["fallbacks"] += 1
-            faults.note("recovery", what="loop_replay", loop=name,
-                        iter=i, error=repr(e)[:200])
+            try:
+                if faults.REGISTRY.active():
+                    faults.check(_F_REPLAY, loop=name, iter=i)
+                if fori_ok:
+                    out = plan.run_fori(leaves, remaining)
+                    if out is not None:
+                        mex.stats_loop_fori_iters += remaining
+                        report["fori_iters"] += remaining
+                        state = _rebuild_carry(out, treedef, dia_mode,
+                                               mex, plan)
+                        dt = time.perf_counter() - t0
+                        report["replay_s"] += dt
+                        if sp is not None:
+                            sp.attrs["fori_iters"] = remaining
+                        if log.enabled:
+                            log.line(event="loop_replay", loop=name,
+                                     iter=i, iters=remaining, fori=True,
+                                     seconds=round(dt, 6))
+                        i = n
+                        continue
+                out = plan.replay(
+                    leaves,
+                    donate and not faults.REGISTRY.active(),
+                    donate_carry=not fresh_plan and not ckpt)
+            except Exception as e:
+                # LOUD degradation: a failed replayed dispatch falls
+                # back to full re-planning for this iteration (the body
+                # path, which re-captures); the loop slows down, it
+                # never lies. Unless donation already consumed part of
+                # the carry mid-iteration — then there is nothing to
+                # re-plan FROM, and the only honest outcome is a clear
+                # error, not a deleted-array crash deep inside the pull
+                # recursion.
+                if sp is not None:
+                    sp.attrs["error"] = repr(e)[:200]
+                if any(getattr(l, "is_deleted", lambda: False)()
+                       for l in leaves):
+                    raise RuntimeError(
+                        f"loop '{name}' iteration {i}: a replayed "
+                        f"dispatch failed after part of the loop carry "
+                        f"was donated; cannot degrade to re-planning. "
+                        f"Re-run with THRILL_TPU_LOOP_DONATE=0 (or "
+                        f"from the last checkpoint epoch).") from e
+                mex.stats_loop_fallbacks += 1
+                report["fallbacks"] += 1
+                faults.note("recovery", what="loop_replay", loop=name,
+                            iter=i, error=repr(e)[:200])
+                if log.enabled:
+                    log.line(event="loop_replay_fallback", loop=name,
+                             iter=i, error=repr(e)[:200])
+                plan = None
+                continue
+            mex.stats_loop_replays += 1
+            report["replays"] += 1
+            state = _rebuild_carry(out, treedef, dia_mode, mex, plan)
+            dt = time.perf_counter() - t0
+            report["replay_s"] += dt
             if log.enabled:
-                log.line(event="loop_replay_fallback", loop=name,
-                         iter=i, error=repr(e)[:200])
-            plan = None
-            continue
-        mex.stats_loop_replays += 1
-        report["replays"] += 1
-        state = _rebuild_carry(out, treedef, dia_mode, mex, plan)
-        dt = time.perf_counter() - t0
-        report["replay_s"] += dt
-        if log.enabled:
-            log.line(event="loop_replay", loop=name, iter=i,
-                     dispatches=mex.stats_dispatches - d0,
-                     seconds=round(dt, 6))
-        ckpt = seal(state, i)
-        fresh_plan = False
-        i += 1
+                log.line(event="loop_replay", loop=name, iter=i,
+                         dispatches=mex.stats_dispatches - d0,
+                         seconds=round(dt, 6))
+            ckpt = seal(state, i)
+            fresh_plan = False
+            i += 1
+        finally:
+            if sp is not None:
+                tracer.end(sp)
 
     report["donated_bytes"] = (mex.stats_loop_donated_bytes
                                - report.pop("donated_bytes0"))

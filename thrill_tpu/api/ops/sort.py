@@ -833,7 +833,11 @@ def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
     fb = mex.cached(key, build)
     srow = mex.put_small(S.astype(np.int32))
     scol = mex.put_small(S.T.copy().astype(np.int32))
-    out = fb(sorted_dest, srow, scol, words_mat, gidx_s, *sorted_payload)
+    from ...common import trace as _trace
+    with _trace.span_of(getattr(mex, "tracer", None), "exchange",
+                        "sort_fused", m_pad=M_pad, out_cap=out_cap):
+        out = fb(sorted_dest, srow, scol, words_mat, gidx_s,
+                 *sorted_payload)
     tree = jax.tree.unflatten(treedef, list(out))
     return DeviceShards(mex, tree, new_counts)
 

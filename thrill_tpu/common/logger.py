@@ -34,18 +34,36 @@ class JsonLogger:
         if parent is not None:
             self._file = parent._file
             self._lock = parent._lock
+            self._wall0 = parent._wall0
+            self._perf0 = parent._perf0
         else:
             self._lock = threading.Lock()
             self._file = open(path, "a", buffering=1) if path else None
+            # (wall, monotonic) anchor pair: event timestamps derive
+            # from perf_counter deltas off this one wall-clock read, so
+            # NTP steps / wall-clock drift mid-run cannot skew a
+            # multi-host merge in json2profile (events within one log
+            # are strictly ordered by real elapsed time). Field name
+            # and units ("ts", microseconds) are unchanged, so old
+            # logs still render.
+            self._wall0 = time.time()
+            self._perf0 = time.perf_counter()
 
     @property
     def enabled(self) -> bool:
         return self._file is not None and not self._file.closed
 
+    def now_us(self) -> int:
+        """Current event timestamp: the construction-time wall anchor
+        plus the monotonic delta since (shared by child loggers and
+        the tracing spine, common/trace.py)."""
+        return int((self._wall0
+                    + (time.perf_counter() - self._perf0)) * 1e6)
+
     def line(self, **fields: Any) -> None:
         if self._file is None or self._file.closed:
             return
-        rec = {"ts": int(time.time() * 1e6)}
+        rec = {"ts": self.now_us()}
         rec.update(self.common)
         rec.update(fields)
         with self._lock:

@@ -1,0 +1,177 @@
+"""Live service metrics: a Prometheus text endpoint on a daemon thread.
+
+``THRILL_TPU_METRICS_PORT=<port>`` makes every Context serve
+``GET /metrics`` (any path, in fact) with the ``overall_stats()``
+counters plus live service-plane gauges — queue depth, jobs in flight,
+per-tenant HBM bytes — in Prometheus text exposition format, so an
+always-on service (PR 9) can be scraped while it runs.
+
+Scrape safety is the PR-9 local-view stats rule: the handler calls
+``overall_stats(local_only=True)``, which NEVER enters the cross-host
+all_gather — while the service dispatcher owns the mesh the non-root
+ranks park in a recv on the same control plane, and a scrape-thread
+collective would race them for frames. Each rank therefore serves its
+own local view (scrape every rank and aggregate in the collector, the
+standard Prometheus posture). Counter reads are plain attribute reads
+under the GIL: a scrape never blocks or perturbs a running job.
+
+Unset/invalid/0 port = completely off (zero threads, zero overhead).
+Multi-process runs on one machine need distinct ports per rank.
+"""
+
+from __future__ import annotations
+
+import http.server
+import os
+import re
+import threading
+import weakref
+from typing import Optional
+
+_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _label(v) -> str:
+    return str(v).replace("\\", "").replace('"', "").replace("\n", " ")
+
+
+def render_prometheus(ctx) -> str:
+    """One scrape's worth of metrics text for ``ctx`` (local view)."""
+    lines = []
+
+    def gauge(name: str, value, labels: str = "") -> None:
+        lines.append(f"{name}{labels} {value}")
+
+    try:
+        stats = ctx.overall_stats(local_only=True)
+    except Exception as e:  # a scrape must answer, never raise
+        return f"# thrill_tpu stats unavailable: {e!r}\n"
+    for k in sorted(stats):
+        v = stats[k]
+        name = "thrill_tpu_" + _BAD.sub("_", str(k))
+        if _num(v):
+            lines.append(f"# TYPE {name} gauge")
+            gauge(name, v)
+        elif isinstance(v, dict):
+            sub = [(t, b) for t, b in sorted(v.items()) if _num(b)]
+            if sub:
+                lines.append(f"# TYPE {name} gauge")
+                for t, b in sub:
+                    gauge(name, b, f'{{key="{_label(t)}"}}')
+    # live gauges beyond the end-of-job counters: what is queued /
+    # running RIGHT NOW, and each tenant's current HBM footprint
+    svc = getattr(ctx, "service", None)
+    if svc is not None:
+        depth = getattr(getattr(svc, "queue", None), "depth", 0)
+        done = getattr(svc, "jobs_done", 0)
+        sub = getattr(svc, "jobs_submitted", 0)
+        lines.append("# TYPE thrill_tpu_queue_depth gauge")
+        gauge("thrill_tpu_queue_depth", depth)
+        lines.append("# TYPE thrill_tpu_jobs_in_flight gauge")
+        gauge("thrill_tpu_jobs_in_flight", max(sub - done, 0))
+    # live dicts are snapshotted (dict(...)) before iterating: job
+    # threads insert keys concurrently, and a scrape must answer, not
+    # die on "dictionary changed size during iteration"
+    hbm = getattr(ctx, "hbm", None)
+    if hbm is not None:
+        lines.append("# TYPE thrill_tpu_hbm_live_bytes gauge")
+        gauge("thrill_tpu_hbm_live_bytes", hbm.mem.total)
+        tb = dict(getattr(hbm, "tenant_bytes", None) or {})
+        if tb:
+            lines.append("# TYPE thrill_tpu_tenant_hbm_bytes gauge")
+            for t, b in sorted(tb.items()):
+                gauge("thrill_tpu_tenant_hbm_bytes", b,
+                      f'{{tenant="{_label(t)}"}}')
+    tr = getattr(ctx, "tracer", None)
+    lanes = dict(tr.lane_counts) if tr is not None else {}
+    if lanes:
+        lines.append("# TYPE thrill_tpu_trace_spans gauge")
+        for lane, n in sorted(lanes.items()):
+            gauge("thrill_tpu_trace_spans", n,
+                  f'{{lane="{_label(lane)}"}}')
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """ThreadingHTTPServer on a daemon thread, bound to the Context by
+    weakref (a leaked server can outlive its Context without pinning
+    the mesh)."""
+
+    def __init__(self, ctx, port: int,
+                 addr: Optional[str] = None) -> None:
+        ctx_ref = weakref.ref(ctx)
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                c = ctx_ref()
+                if c is None:
+                    self.send_response(503)
+                    self.end_headers()
+                    return
+                try:
+                    body = render_prometheus(c).encode()
+                except Exception as e:  # answer, never drop the conn
+                    body = f"# thrill_tpu scrape failed: {e!r}\n" \
+                        .encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:
+                pass                    # scrapes must not spam stderr
+
+        if addr is None:
+            # loopback by default: the endpoint exposes tenant names,
+            # job counters and HBM footprints — a network-reachable
+            # scrape target must be an EXPLICIT operator decision
+            # (THRILL_TPU_METRICS_ADDR=0.0.0.0)
+            addr = os.environ.get("THRILL_TPU_METRICS_ADDR",
+                                  "127.0.0.1")
+        self.httpd = http.server.ThreadingHTTPServer((addr, port),
+                                                     Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name="thrill-tpu-metrics", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        try:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        except Exception:
+            pass
+
+
+def maybe_start(ctx) -> Optional[MetricsServer]:
+    """Start the endpoint when THRILL_TPU_METRICS_PORT names a port.
+    A bind failure (port taken) is reported loudly and degrades to no
+    endpoint — observability must never take down the job."""
+    raw = os.environ.get("THRILL_TPU_METRICS_PORT", "")
+    if not raw:
+        return None
+    try:
+        port = int(raw)
+    except ValueError:
+        import sys
+        print(f"thrill_tpu: bad THRILL_TPU_METRICS_PORT={raw!r}; "
+              f"metrics endpoint disabled", file=sys.stderr)
+        return None
+    if port <= 0:
+        return None
+    try:
+        return MetricsServer(ctx, port)
+    except OSError as e:
+        import sys
+        print(f"thrill_tpu: metrics endpoint failed to bind port "
+              f"{port}: {e}; disabled", file=sys.stderr)
+        return None

@@ -1,0 +1,381 @@
+"""Tracing spine: correlated spans across every layer.
+
+The JsonLogger (common/logger.py) records flat event lines; this module
+adds the CORRELATION the grown system needs: lightweight spans
+(``trace_id``/``span_id``/``parent``) with a category lane per
+subsystem, tagged with rank, generation (PR-8 failure domains), tenant
+and job name (PR-9 service plane) — so a Perfetto timeline can show
+*which dispatch, in which exchange, of which job, on which rank* was on
+the critical path. Instrumented at the natural choke points the earlier
+refactors created:
+
+* ``parallel/mesh.py::_CountedJit.__call__`` — every device dispatch
+  (cat ``dispatch``), including the whole-loop fori program;
+* ``api/fusion.py::FusionPlan.execute`` — stitched segments (``fusion``);
+* ``data/exchange.py`` — phase A / chunked phase B / optimistic-vs-
+  synced verdicts / capacity-miss heals (``exchange``);
+* ``data/multiplexer.py`` — host frames + async sends (``host``);
+* ``net/group.py`` — collectives, generation heals (``net``);
+* ``mem/pressure.py`` — escalation-ladder rungs (``mem``);
+* ``api/loop.py`` — capture/replay/fori iterations (``loop``);
+* ``service/scheduler.py`` — queue-wait and run per job (``service``).
+
+Spans emit through the existing JsonLogger as ``event=span`` lines
+(json2profile ignores unknown events, so the HTML report keeps
+working) and ``tools/trace2perfetto.py`` exports Chrome-trace-event
+JSON — one pid lane per rank, one tid lane per subsystem — that loads
+directly in Perfetto / chrome://tracing.
+
+Two always-on companions make this production-shaped:
+
+* **Flight recorder**: every finished span/instant also lands in a
+  bounded in-memory ring (``THRILL_TPU_TRACE_RING`` records, default
+  512 — a deque append, near-zero cost when file logging is off). The
+  moment a pipeline aborts (PipelineError/ClusterAbort/unrecoverable
+  verdict, api/context.py hooks) the ring dumps to a timestamped file
+  under ``THRILL_TPU_FLIGHT_DIR`` — a self-contained post-mortem whose
+  final spans name the failing site and generation. The dump header
+  records the THRILL_TPU_FAULTS arming, so chaos-sweep archives carry
+  the seed that produced each failure.
+* **Live metrics**: common/metrics.py serves ``overall_stats`` +
+  service gauges in Prometheus text format from a daemon thread
+  (``THRILL_TPU_METRICS_PORT``).
+
+Overhead contract: ``THRILL_TPU_TRACE=0`` is a pinned no-op fast path
+— the dispatch choke point pays ONE attribute read plus one predicate
+check and allocates no span objects (tests/common/test_trace.py pins
+this via the module's ``SPANS_CREATED`` counter).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+#: total Span objects ever allocated in this process — the pin the
+#: THRILL_TPU_TRACE=0 no-op test asserts stays flat across dispatches
+SPANS_CREATED = 0
+
+#: shared do-nothing context manager for the disabled path (stateless,
+#: so one instance serves every call site)
+_NULL = contextlib.nullcontext()
+
+_FLIGHT_SEQ = itertools.count()
+
+
+def trace_enabled() -> bool:
+    """THRILL_TPU_TRACE=0 disables span creation everywhere (read once
+    per Tracer, at Context construction)."""
+    from .config import _env_flag
+    return _env_flag("THRILL_TPU_TRACE", True)
+
+
+def _env_int_clamped(name: str, default: int, lo: int) -> int:
+    from .config import _env_int
+    try:
+        return max(_env_int(name, default), lo)
+    except ValueError:
+        return default
+
+
+def ring_capacity() -> int:
+    """THRILL_TPU_TRACE_RING: flight-recorder ring size in records
+    (default 512; 0 disables the ring and with it the flight dumps)."""
+    return _env_int_clamped("THRILL_TPU_TRACE_RING", 512, 0)
+
+
+def flight_dir() -> Optional[str]:
+    """Directory flight-recorder dumps land in. Default: a per-USER
+    stable path under the system temp dir (the recorder is always on;
+    a shared fixed path would be owned by whichever user ran first and
+    silently unwritable for everyone else);
+    ``THRILL_TPU_FLIGHT_DIR=0|off|none`` disables dumps entirely."""
+    v = os.environ.get("THRILL_TPU_FLIGHT_DIR")
+    if v in ("0", "off", "none"):
+        return None
+    if v:
+        return v
+    import tempfile
+    uid = getattr(os, "getuid", lambda: "u")()
+    return os.path.join(tempfile.gettempdir(),
+                        f"thrill_tpu_flight-{uid}")
+
+
+def _flight_keep() -> int:
+    """Newest-N dump files kept per directory (THRILL_TPU_FLIGHT_KEEP,
+    default 40) — an abort-heavy chaos sweep must not fill the disk."""
+    return _env_int_clamped("THRILL_TPU_FLIGHT_KEEP", 40, 1)
+
+
+class Span:
+    """One timed region. Context-manager: exceptions escaping the block
+    are recorded as an ``error`` attribute before the span finishes —
+    the flight recorder's final spans name the failing site this way."""
+
+    __slots__ = ("tracer", "span_id", "parent", "cat", "name", "ts_us",
+                 "t0", "t1", "attrs", "generation", "tenant", "job")
+
+    def __init__(self, tracer: "Tracer", span_id: int,
+                 parent: Optional[int], cat: str, name: str,
+                 attrs: Dict[str, Any]) -> None:
+        self.tracer = tracer
+        self.span_id = span_id
+        self.parent = parent
+        self.cat = cat
+        self.name = name
+        self.attrs = attrs
+        self.ts_us = tracer._now_us()
+        self.t0 = time.perf_counter()
+        self.t1: Optional[float] = None
+        self.generation = tracer.gen_fn() if tracer.gen_fn is not None \
+            else None
+        self.tenant = tracer.tenant_fn() if tracer.tenant_fn is not None \
+            else None
+        self.job = tracer.current_job
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, et, ev, tb) -> None:
+        if ev is not None:
+            self.attrs["error"] = repr(ev)[:200]
+        self.tracer.end(self)
+
+    def rec(self) -> dict:
+        r = {"event": "span", "cat": self.cat, "name": self.name,
+             "trace": self.tracer.trace_id, "span": self.span_id,
+             "rank": self.tracer.rank, "ts": self.ts_us,
+             "dur_us": int(((self.t1 if self.t1 is not None
+                             else time.perf_counter()) - self.t0) * 1e6)}
+        if self.parent is not None:
+            r["parent"] = self.parent
+        if self.generation is not None:
+            r["generation"] = self.generation
+        if self.tenant is not None:
+            r["tenant"] = self.tenant
+        if self.job is not None:
+            r["job"] = self.job
+        r.update(self.attrs)
+        return r
+
+
+class Tracer:
+    """Per-Context span factory + flight-recorder ring.
+
+    Attached as ``mesh_exec.tracer`` / ``net.group.tracer`` /
+    ``ctx.tracer`` so every choke point reaches it in one attribute
+    read; ``enabled`` False (THRILL_TPU_TRACE=0) makes every guarded
+    site skip span allocation entirely. Propagation is EXPLICIT: a
+    per-thread span stack supplies the parent id; cross-thread workers
+    (the async host sender) pass ``parent=`` captured on the
+    submitting thread."""
+
+    def __init__(self, rank: int = 0, logger=None,
+                 ring: Optional[int] = None,
+                 enabled: Optional[bool] = None) -> None:
+        self.enabled = trace_enabled() if enabled is None else enabled
+        self.rank = rank
+        self.logger = logger
+        cap = ring_capacity() if ring is None else ring
+        self.ring: Optional[collections.deque] = \
+            collections.deque(maxlen=cap) if cap > 0 else None
+        self.trace_id = f"{os.getpid():x}.{rank}"
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        # context binders (Context sets them): generation / tenant of
+        # the moment a span STARTS; the scheduler sets current_job
+        # around each served job so nested spans carry the job name
+        self.gen_fn = None
+        self.tenant_fn = None
+        self.current_job: Optional[str] = None
+        # finished spans per category lane (bench.py trace lane counts)
+        self.lane_counts: Dict[str, int] = {}
+        if logger is not None and hasattr(logger, "now_us"):
+            self._now_us = logger.now_us
+        else:
+            wall0, perf0 = time.time(), time.perf_counter()
+            self._now_us = lambda: int(
+                (wall0 + time.perf_counter() - perf0) * 1e6)
+
+    # -- span lifecycle -------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def current_id(self) -> Optional[int]:
+        """The calling thread's innermost open span id (for explicit
+        cross-thread parenting)."""
+        st = getattr(self._tls, "stack", None)
+        return st[-1].span_id if st else None
+
+    def span(self, cat: str, name: str, parent: Optional[int] = None,
+             **attrs: Any) -> Span:
+        """Open a span (use as a context manager). ``parent`` defaults
+        to the calling thread's innermost open span."""
+        return self.begin(cat, name, parent=parent, **attrs)
+
+    def begin(self, cat: str, name: str, parent: Optional[int] = None,
+              **attrs: Any) -> Span:
+        """Open a span without the context-manager protocol (callers
+        with early-exit control flow pair it with ``end`` in a
+        try/finally)."""
+        global SPANS_CREATED
+        SPANS_CREATED += 1
+        st = self._stack()
+        if parent is None and st:
+            parent = st[-1].span_id
+        sp = Span(self, next(self._ids), parent, cat, name, attrs)
+        st.append(sp)
+        return sp
+
+    def end(self, sp: Span, **attrs: Any) -> None:
+        sp.t1 = time.perf_counter()
+        if attrs:
+            sp.attrs.update({k: v for k, v in attrs.items()
+                             if v is not None})
+        st = getattr(self._tls, "stack", None)
+        if st:
+            # pop the span plus anything leaked above it (an exception
+            # that skipped a child's end must not corrupt parenting)
+            for i in range(len(st) - 1, -1, -1):
+                if st[i] is sp:
+                    del st[i:]
+                    break
+        self.lane_counts[sp.cat] = self.lane_counts.get(sp.cat, 0) + 1
+        self._record(sp.rec())
+
+    def emit_span(self, cat: str, name: str, start_s: float,
+                  end_s: float, parent: Optional[int] = None,
+                  **attrs: Any) -> None:
+        """Record an already-elapsed region measured with
+        ``time.perf_counter()`` (the scheduler's queue-wait bar: the
+        wait happened before the span could be opened)."""
+        if not self.enabled:
+            return
+        now_us = self._now_us()
+        elapsed_us = int(max(time.perf_counter() - start_s, 0.0) * 1e6)
+        rec = {"event": "span", "cat": cat, "name": name,
+               "trace": self.trace_id, "span": next(self._ids),
+               "rank": self.rank, "ts": now_us - elapsed_us,
+               "dur_us": int(max(end_s - start_s, 0.0) * 1e6)}
+        if parent is not None:
+            rec["parent"] = parent
+        if self.gen_fn is not None:
+            rec["generation"] = self.gen_fn()
+        rec.update({k: v for k, v in attrs.items() if v is not None})
+        self.lane_counts[cat] = self.lane_counts.get(cat, 0) + 1
+        self._record(rec)
+
+    def instant(self, cat: str, name: str, **attrs: Any) -> None:
+        """Zero-duration marker (ladder rungs, exchange verdicts)."""
+        if not self.enabled:
+            return
+        rec = {"event": "span", "kind": "instant", "cat": cat,
+               "name": name, "trace": self.trace_id,
+               "span": next(self._ids), "rank": self.rank,
+               "ts": self._now_us(), "dur_us": 0}
+        pid = self.current_id()
+        if pid is not None:
+            rec["parent"] = pid
+        if self.gen_fn is not None:
+            rec["generation"] = self.gen_fn()
+        if self.tenant_fn is not None:
+            t = self.tenant_fn()
+            if t is not None:
+                rec["tenant"] = t
+        if self.current_job is not None:
+            rec["job"] = self.current_job
+        rec.update({k: v for k, v in attrs.items() if v is not None})
+        # instants count toward the lane totals too: the mem lane is
+        # emitted EXCLUSIVELY as instants (ladder rungs) and must show
+        # up in bench trace_spans / the trace_spans metric
+        self.lane_counts[cat] = self.lane_counts.get(cat, 0) + 1
+        self._record(rec)
+
+    def _record(self, rec: dict) -> None:
+        if self.ring is not None:
+            self.ring.append(rec)
+        log = self.logger
+        if log is not None and log.enabled:
+            log.line(**rec)
+
+    # -- flight recorder ------------------------------------------------
+    def dump_flight(self, reason: Any, generation: Optional[int] = None
+                    ) -> Optional[str]:
+        """Write the ring's records to a timestamped post-mortem file.
+        Best-effort by contract: returns the path, or None when the
+        recorder is disabled (tracing off / no ring /
+        THRILL_TPU_FLIGHT_DIR=0), the ring is empty (a header-only
+        dump would only churn the keep-N rotation — the TRACE=0 abort
+        path writes nothing), or the write fails — a failing dump must
+        never mask the abort being recorded."""
+        if not self.enabled or not self.ring:
+            return None
+        d = flight_dir()
+        if d is None:
+            return None
+        recs = list(self.ring)
+        from . import faults
+        header = {"event": "flight_header",
+                  "reason": str(reason)[:300],
+                  "generation": generation, "rank": self.rank,
+                  "trace": self.trace_id, "ts": self._now_us(),
+                  "records": len(recs),
+                  "faults": os.environ.get(faults.ENV_VAR) or None}
+        name = (f"flight-{int(time.time() * 1e3)}-p{os.getpid()}"
+                f"-r{self.rank}-{next(_FLIGHT_SEQ)}.json")
+        path = os.path.join(d, name)
+        try:
+            os.makedirs(d, exist_ok=True)
+            with open(path, "w") as f:
+                f.write(json.dumps(header, default=str) + "\n")
+                for r in recs:
+                    f.write(json.dumps(r, default=str) + "\n")
+        except OSError:
+            return None
+        try:
+            _prune(d, _flight_keep())
+        except OSError:
+            pass
+        return path
+
+
+def _prune(d: str, keep: int) -> None:
+    """Drop all but the newest ``keep`` flight dumps in ``d``."""
+    files = [os.path.join(d, f) for f in os.listdir(d)
+             if f.startswith("flight-") and f.endswith(".json")]
+    if len(files) <= keep:
+        return
+    files.sort(key=lambda p: os.path.getmtime(p), reverse=True)
+    for p in files[keep:]:
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
+def span_of(tracer: Optional[Tracer], cat: str, name: str,
+            **attrs: Any):
+    """``tracer.span(...)`` when tracing is live, the shared null
+    context otherwise — the one-liner guard for call sites where a
+    with-block reads best."""
+    if tracer is not None and tracer.enabled:
+        return tracer.span(cat, name, **attrs)
+    return _NULL
+
+
+def instant_of(tracer: Optional[Tracer], cat: str, name: str,
+               **attrs: Any) -> None:
+    """Guarded instant: the one-liner the marker sites (ladder rungs,
+    reconnects, fusion degradations) share instead of each carrying
+    the None/enabled check."""
+    if tracer is not None and tracer.enabled:
+        tracer.instant(cat, name, **attrs)

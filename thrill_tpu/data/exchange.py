@@ -61,6 +61,7 @@ import numpy as np
 from jax import lax
 
 from ..common import faults
+from ..common import trace as _trace
 from ..common.config import (cap_cache_enabled, overlap_enabled,
                              round_up_pow2, xchg_narrow_enabled)
 from ..common.partition import dense_range_bounds
@@ -523,7 +524,9 @@ def _phase_a(shards: DeviceShards, dest_builder: Callable,
         return mex.smap(fa, 1 + len(leaves), out_specs=out_specs)
 
     fa = mex.cached(key_a, build_a)
-    out_a = fa(shards.counts_device(), *leaves)
+    with _trace.span_of(getattr(mex, "tracer", None), "exchange",
+                        "phase_a", rows=W * cap):
+        out_a = fa(shards.counts_device(), *leaves)
     sorted_dest, send_mat = out_a[0], out_a[1]
     if nidx:
         sorted_leaves = list(out_a[2:-1])
@@ -1082,21 +1085,26 @@ def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     acc_pos = tuple(range(2 + n_leaves, 2 + 2 * n_leaves))
     counts_dev = flag = None
     accs: List[Any] = []
-    for j, (lo, hi) in enumerate(ranges):
-        first, last = j == 0, j == len(ranges) - 1
-        fn = chunk_program(lo, hi, first, last)
-        if armed:
-            default_policy().run(
-                lambda j=j: faults.check(_F_CHUNK, chunk=j,
-                                         chunks=len(ranges)),
-                what="xchg.chunk")
-        if first:
-            out = fn(sorted_dest, smat, *sorted_leaves)
-            counts_dev, flag = out[0], out[1]
-            accs = list(out[2:])
-        else:
-            call = fn.donating(acc_pos) if donate and acc_pos else fn
-            accs = list(call(sorted_dest, smat, *sorted_leaves, *accs))
+    with _trace.span_of(getattr(mex, "tracer", None), "exchange",
+                        "phase_b", chunks=len(ranges),
+                        narrowed=narrow is not None or None):
+        for j, (lo, hi) in enumerate(ranges):
+            first, last = j == 0, j == len(ranges) - 1
+            fn = chunk_program(lo, hi, first, last)
+            if armed:
+                default_policy().run(
+                    lambda j=j: faults.check(_F_CHUNK, chunk=j,
+                                             chunks=len(ranges)),
+                    what="xchg.chunk")
+            if first:
+                out = fn(sorted_dest, smat, *sorted_leaves)
+                counts_dev, flag = out[0], out[1]
+                accs = list(out[2:])
+            else:
+                call = fn.donating(acc_pos) if donate and acc_pos \
+                    else fn
+                accs = list(call(sorted_dest, smat, *sorted_leaves,
+                                 *accs))
     mex.stats_padded_rows += W * M_pad
     # wire truth vs raw equivalent: narrowed rows cross the fabric at
     # their cast width; the raw counter records what full-width rows
@@ -1133,15 +1141,23 @@ def _exchange_optimistic(mex: MeshExec, treedef, sorted_dest,
     if range_mat is not None:
         narrow = _pack_degraded(
             _sticky_spec(mex, cap_ident, sorted_leaves))
-    out_leaves, counts_dev, flag = _dispatch_chunked(
-        mex, treedef, sorted_dest, sorted_leaves, send_mat, M_pad,
-        out_cap, narrow=narrow)
+    with _trace.span_of(getattr(mex, "tracer", None), "exchange",
+                        "optimistic", m_pad=M_pad, out_cap=out_cap):
+        out_leaves, counts_dev, flag = _dispatch_chunked(
+            mex, treedef, sorted_dest, sorted_leaves, send_mat, M_pad,
+            out_cap, narrow=narrow)
     tree = jax.tree.unflatten(treedef, out_leaves)
     shards = DeviceShards(mex, tree, counts_dev)
 
     def check(counts: np.ndarray):
         overflowed = bool(mex._fetch_raw(flag).reshape(-1)[0])
         S = mex._fetch_raw(send_mat).astype(np.int64)
+        # the optimistic-vs-synced verdict, at the moment it is
+        # actually known (deferred-check time)
+        _trace.instant_of(getattr(mex, "tracer", None), "exchange",
+                          "cap_hit" if not overflowed
+                          else "capacity_miss",
+                          m_pad=M_pad, out_cap=out_cap)
         if not overflowed:
             # the exchange is accounted HERE, not at dispatch: a miss
             # must count one (synced) exchange, not an optimistic one
@@ -1217,32 +1233,37 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     count_plan_build(mex)
     cap_ident = _dense_cap_ident(ident, cap, treedef, sorted_leaves)
     mode = resolve_mode(mex)
-    if mode == "ragged":
-        mex._xchg_plan[cap_ident] = "sync"
-        return _exchange_ragged(mex, treedef, sorted_leaves, S, min_cap)
-    if mode == "onefactor" or (
-            mode == "dense"
-            and _skewed(S, leaf_item_bytes(sorted_leaves), mex)):
-        # a skew-flipped site stays synced: the dense-vs-1-factor
-        # decision needs the host S, which the optimistic path elides
-        mex._xchg_plan[cap_ident] = "sync"
-        return _exchange_onefactor(mex, treedef, sorted_dest,
-                                   sorted_leaves, S, min_cap, ident=ident)
+    with _trace.span_of(getattr(mex, "tracer", None), "exchange",
+                        "synced", mode=mode):
+        if mode == "ragged":
+            mex._xchg_plan[cap_ident] = "sync"
+            return _exchange_ragged(mex, treedef, sorted_leaves, S,
+                                    min_cap)
+        if mode == "onefactor" or (
+                mode == "dense"
+                and _skewed(S, leaf_item_bytes(sorted_leaves), mex)):
+            # a skew-flipped site stays synced: the dense-vs-1-factor
+            # decision needs the host S, which the optimistic path
+            # elides
+            mex._xchg_plan[cap_ident] = "sync"
+            return _exchange_onefactor(mex, treedef, sorted_dest,
+                                       sorted_leaves, S, min_cap,
+                                       ident=ident)
 
-    M_pad, out_cap = _sticky_caps(
-        mex, cap_ident,
-        (max(int(S.max()), 1), max(int(R.max()), min_cap, 1)))
-    mex._xchg_plan[cap_ident] = "dense"
-    narrow = _pack_degraded(_spec_from_ranges(
-        mex, cap_ident, sorted_leaves,
-        _narrowable_leaves(sorted_leaves), ranges))
-    smat = smat_dev if smat_dev is not None else \
-        mex.put_small(S.astype(np.int32), replicated=True)
-    out_leaves, _counts_dev, _flag = _dispatch_chunked(
-        mex, treedef, sorted_dest, sorted_leaves, smat, M_pad, out_cap,
-        narrow=narrow)
-    tree = jax.tree.unflatten(treedef, out_leaves)
-    return DeviceShards(mex, tree, new_counts)
+        M_pad, out_cap = _sticky_caps(
+            mex, cap_ident,
+            (max(int(S.max()), 1), max(int(R.max()), min_cap, 1)))
+        mex._xchg_plan[cap_ident] = "dense"
+        narrow = _pack_degraded(_spec_from_ranges(
+            mex, cap_ident, sorted_leaves,
+            _narrowable_leaves(sorted_leaves), ranges))
+        smat = smat_dev if smat_dev is not None else \
+            mex.put_small(S.astype(np.int32), replicated=True)
+        out_leaves, _counts_dev, _flag = _dispatch_chunked(
+            mex, treedef, sorted_dest, sorted_leaves, smat, M_pad,
+            out_cap, narrow=narrow)
+        tree = jax.tree.unflatten(treedef, out_leaves)
+        return DeviceShards(mex, tree, new_counts)
 
 
 def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,

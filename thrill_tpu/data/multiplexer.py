@@ -37,6 +37,7 @@ from typing import Any, Callable, List, Optional
 import numpy as np
 
 from ..common import faults
+from ..common import trace as _trace
 from ..common.config import _env_flag, overlap_enabled, round_up_pow2
 from ..common.retry import default_policy
 from ..net.group import poison_on_error
@@ -232,7 +233,10 @@ def host_exchange(mex, shards: HostShards, dest_fn: Callable[[Any], int],
     mix = _mix_delivery(rank_order)
     from ..net import wire as _wire
     csnap = _wire.compress_stats()
-    with poison_on_error(group, "host_exchange"):
+    with _trace.span_of(getattr(mex, "tracer", None), "host",
+                        "host_exchange", reason=reason,
+                        mode="async" if use_async else "serial"), \
+            poison_on_error(group, "host_exchange"):
         if use_async:
             sent_items, wire_bytes = _exchange_frames_async(
                 mex, group, outgoing, received, me, P, mix)
@@ -313,6 +317,12 @@ def _exchange_frames_async(mex, group, outgoing: List[dict],
     q: "queue.Queue" = queue.Queue(maxsize=_send_queue_depth())
     err: List[BaseException] = []
     wire_holder = [0]
+    # explicit trace propagation across the thread boundary: the
+    # sender thread's per-frame spans parent under the exchange span
+    # opened on THIS thread (a thread-local stack cannot cross)
+    tr = getattr(mex, "tracer", None)
+    tr_on = tr is not None and tr.enabled
+    parent_id = tr.current_id() if tr_on else None
 
     def _sender():
         try:
@@ -329,8 +339,14 @@ def _exchange_frames_async(mex, group, outgoing: List[dict],
                 # byte accounting rides the sender thread (and, on
                 # serializing transports, the transport's own encode),
                 # off the send critical path
-                wire_holder[0] += _send_frame(group, peer, msg,
-                                              "host_exchange")
+                if tr_on:
+                    with tr.span("host", "async_send",
+                                 parent=parent_id, peer=peer):
+                        wire_holder[0] += _send_frame(
+                            group, peer, msg, "host_exchange")
+                else:
+                    wire_holder[0] += _send_frame(group, peer, msg,
+                                                  "host_exchange")
         except BaseException as e:  # surfaced on the main thread
             err.append(e)
             # the main thread may be BLOCKED in a peer recv that can

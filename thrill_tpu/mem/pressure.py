@@ -231,6 +231,7 @@ class PressureMonitor:
         if freed:
             faults.note("mem_spill", freed=freed, estimate=est,
                         live=live, budget=self.budget)
+            self._trace_rung("admission_spill", freed=freed)
 
     def admit_stage(self, node) -> None:
         """Stage-level admission (api/dia_base.py): before a node's
@@ -256,6 +257,7 @@ class PressureMonitor:
         if freed:
             faults.note("mem_spill", freed=freed, live=live,
                         budget=self.budget, node=node.label)
+            self._trace_rung("admission_spill", freed=freed)
 
     def spill_cold(self, need: Optional[int] = None,
                    exclude: Optional[int] = None,
@@ -300,6 +302,14 @@ class PressureMonitor:
                 self.admission_spills += 1
             self.spilled_bytes += freed
         return freed
+
+    def _trace_rung(self, rung: str, **attrs) -> None:
+        """Ladder-rung marker on the "mem" trace lane (common/trace.py)
+        — a Perfetto timeline shows WHEN each escalation fired relative
+        to the dispatch/exchange spans around it."""
+        from ..common.trace import instant_of
+        instant_of(getattr(self.mex, "tracer", None), "mem", rung,
+                   **attrs)
 
     def stats(self) -> dict:
         return {
@@ -398,6 +408,7 @@ def recover_dispatch(fn, args, kwargs, exc: BaseException):
         faults.note("oom_retry", freed=freed,
                     donating=base is not None,
                     error=repr(state["last"])[:200])
+        pres._trace_rung("oom_retry", freed=freed)
         try:
             if faults.REGISTRY.active():
                 # the injection site rides every RETRY too, so a

@@ -190,6 +190,10 @@ class Group(abc.ABC):
         # missed the cluster's abort): the local barrier reads the
         # stash instead of waiting for a frame already consumed
         self._gen_markers: dict = {}
+        # tracing spine (common/trace.py), attached by the Context:
+        # every collective (_at) and generation heal becomes a span in
+        # the "net" lane; None / disabled = no allocation
+        self.tracer = None
 
     @property
     def num_hosts(self) -> int:
@@ -205,12 +209,24 @@ class Group(abc.ABC):
     @contextlib.contextmanager
     def _at(self, site: str):
         """Name the collective in flight so a hang-abort cause can say
-        WHERE the group wedged, not just that it did."""
+        WHERE the group wedged, not just that it did — and, with the
+        tracing spine attached, put every host collective on the "net"
+        span lane (one hook covers prefix_sum/broadcast/all_gather/
+        all_reduce/barrier and their nested forms)."""
         prev = self._collective_site
         self._collective_site = site
+        tr = self.tracer
+        sp = (tr.begin("net", site) if tr is not None and tr.enabled
+              and self.num_hosts > 1 else None)
         try:
             yield
+        except BaseException as e:
+            if sp is not None:
+                sp.attrs["error"] = repr(e)[:200]
+            raise
         finally:
+            if sp is not None:
+                tr.end(sp)
             self._collective_site = prev
 
     def _check_pending_abort(self) -> None:
@@ -501,6 +517,17 @@ class Group(abc.ABC):
         and :class:`ClusterAbort` when a CURRENT-generation poison
         arrives mid-drain (a new failure during the heal itself).
         Returns the number of stale frames dropped."""
+        tr = self.tracer
+        if tr is None or not tr.enabled:
+            return self._begin_generation(gen)
+        with tr.span("net", "heal", gen=int(gen)) as sp:
+            dropped = self._begin_generation(gen)
+            sp.attrs["settled_gen"] = self.generation
+            sp.attrs["stale_dropped"] = dropped
+            sp.attrs["reconnects"] = self.stats_reconnects
+            return dropped
+
+    def _begin_generation(self, gen: int) -> int:
         gen = int(gen)
         if self._gen_markers:
             # ADOPT a newer generation announced by peers whose heal

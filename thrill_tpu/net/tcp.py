@@ -751,6 +751,9 @@ class TcpGroup(Group):
         self.stats_reconnects += 1
         faults.note("recovery", what="net.reconnect", peer=peer,
                     gen=self.generation, transport="tcp")
+        from ..common.trace import instant_of
+        instant_of(getattr(self, "tracer", None), "net", "reconnect",
+                   peer=peer, gen=self.generation)
         return True
 
     def link_repairable(self, peer: int) -> bool:

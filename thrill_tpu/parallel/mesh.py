@@ -82,9 +82,33 @@ class _CountedJit:
         # with donation disarmed
         self._out_bytes: Optional[int] = None
         self._donate_base: Optional["_CountedJit"] = None
+        self._trace_label: Optional[str] = None
         functools.update_wrapper(self, jitted, updated=())
 
+    def _label(self) -> str:
+        lbl = self._trace_label
+        if lbl is None:
+            key = self.cache_key
+            if isinstance(key, tuple) and key \
+                    and isinstance(key[0], str):
+                lbl = key[0]                # "fused", "xchg_chunk"...
+            else:
+                lbl = getattr(self._jitted, "__name__", None) or "jit"
+            self._trace_label = lbl
+        return lbl
+
     def __call__(self, *args, **kwargs):
+        # tracing fast path (the pinned overhead contract,
+        # tests/common/test_trace.py): THRILL_TPU_TRACE=0 costs one
+        # attribute read plus one predicate — no span objects, no
+        # context managers, nothing else
+        tr = self._mex.tracer
+        if tr is None or not tr.enabled:
+            return self._dispatch(args, kwargs)
+        with tr.span("dispatch", self._label()):
+            return self._dispatch(args, kwargs)
+
+    def _dispatch(self, args, kwargs):
         mex = self._mex
         mex.stats_dispatches += 1
         pres = mex.pressure
@@ -226,6 +250,11 @@ class MeshExec:
         # Context once the HbmGovernor exists; None = the dispatch
         # choke point pays one attribute read and no admission runs
         self.pressure = None
+        # tracing spine (common/trace.py), attached by the Context;
+        # None (bare mesh) or tracer.enabled False (THRILL_TPU_TRACE=0)
+        # = the dispatch choke point pays one attribute read plus one
+        # predicate and allocates nothing
+        self.tracer = None
         # per-Iterate reports (phase timings, replay hit rate) for
         # bench.py / tools/loop_report.py
         self.loop_reports: list = []

@@ -227,6 +227,10 @@ class Scheduler:
             os.environ.get("THRILL_TPU_SERVE_WEIGHTS", "")))
         self.jobs_submitted = 0
         self.jobs_failed = 0
+        # jobs that LEFT the system (resolved any way: result, scoped
+        # failure, drain) — the live metrics endpoint's jobs_in_flight
+        # gauge is submitted - done (common/metrics.py)
+        self.jobs_done = 0
         self._job_ids = 0
         self._closing = False
         self._dead: Optional[BaseException] = None
@@ -315,6 +319,7 @@ class Scheduler:
         with self._cv:
             stranded = self.queue.drain()
             self.jobs_failed += len(stranded)
+            self.jobs_done += len(stranded)
         for job in stranded:
             job.future._finish(error=RuntimeError(
                 "scheduler stopped before this job ran"))
@@ -354,6 +359,7 @@ class Scheduler:
                         # count its failure here
                         with self._cv:
                             self.jobs_failed += 1
+                            self.jobs_done += 1
                         job.future._finish(error=e)
                         self._poison(e)
                     return None
@@ -383,6 +389,12 @@ class Scheduler:
                             f"this rank holds {job.name!r} — tenant "
                             f"submission order must be "
                             f"rank-deterministic")
+                        # already taken off the queue: _poison's drain
+                        # won't see it — settle its counters here
+                        # (the Condition's RLock tolerates the nested
+                        # _poison acquisition)
+                        self.jobs_failed += 1
+                        self.jobs_done += 1
                         job.future._finish(error=err)
                         self._poison(err)
                         return None
@@ -405,6 +417,22 @@ class Scheduler:
         fut.queue_wait_s = t0 - job.t_submit
         from ..api.context import PipelineError
         err: Optional[BaseException] = None
+        tr = getattr(ctx, "tracer", None)
+        sp = None
+        if tr is not None and tr.enabled:
+            # the queue-wait bar (submit -> start, measured on the
+            # monotonic clock the scheduler already uses) and the run
+            # span; every dispatch/exchange/loop span the job's
+            # pipeline emits nests under the run span and inherits the
+            # job name through the tracer's current_job tag
+            now = time.perf_counter()
+            tr.emit_span("service", "queue_wait",
+                         now - fut.queue_wait_s, now,
+                         job=fut.name, tenant=job.tenant)
+            sp = tr.begin("service", f"job:{fut.name}",
+                          tenant=job.tenant, job=fut.name,
+                          job_id=fut.job_id)
+            tr.current_job = fut.name
         try:
             with ctx.pipeline(name=job.name) as gen:
                 fut.generation = gen
@@ -434,6 +462,14 @@ class Scheduler:
             self._poison(e)
         finally:
             ctx.current_tenant = None
+            with self._cv:
+                self.jobs_done += 1
+            if sp is not None:
+                tr.current_job = None
+                tr.end(sp, generation=fut.generation,
+                       ok=err is None,
+                       error=(repr(err)[:200] if err is not None
+                              else None))
         log = ctx.logger
         if log.enabled:
             log.line(event="job_done", job=fut.job_id, name=fut.name,
@@ -449,6 +485,7 @@ class Scheduler:
             self._dead = cause
             stranded = self.queue.drain()
             self.jobs_failed += len(stranded)
+            self.jobs_done += len(stranded)
             self._cv.notify_all()
         for job in stranded:
             job.future._finish(error=RuntimeError(

@@ -50,12 +50,22 @@ def _measure(name, job):
     import numpy as np
     counts = {}
     results = {}
-    for fuse in ("1", "0"):
-        os.environ["THRILL_TPU_FUSE"] = fuse
-        job()                                    # warm: compile+cache
-        d0 = _MEX.stats_dispatches
-        results[fuse] = job()
-        counts[fuse] = _MEX.stats_dispatches - d0
+    prev = os.environ.get("THRILL_TPU_FUSE")
+    try:
+        for fuse in ("1", "0"):
+            os.environ["THRILL_TPU_FUSE"] = fuse
+            job()                                # warm: compile+cache
+            d0 = _MEX.stats_dispatches
+            results[fuse] = job()
+            counts[fuse] = _MEX.stats_dispatches - d0
+    finally:
+        # restore the caller's setting — the report used to leave
+        # THRILL_TPU_FUSE=0 behind, silently unfusing everything run
+        # in the same process afterwards
+        if prev is None:
+            os.environ.pop("THRILL_TPU_FUSE", None)
+        else:
+            os.environ["THRILL_TPU_FUSE"] = prev
     assert np.allclose(np.asarray(results["1"], dtype=np.float64),
                        np.asarray(results["0"], dtype=np.float64)), \
         f"{name}: fused and unfused results diverge"

@@ -39,17 +39,26 @@ def _measure(name, job, mex):
     import numpy as np
     results, wall = {}, {}
     report = None
-    for replay in ("1", "0"):
-        os.environ["THRILL_TPU_LOOP_REPLAY"] = replay
-        job()                                    # warm: compile+cache
-        n0 = len(mex.loop_reports)
-        t0 = time.perf_counter()
-        results[replay] = np.asarray(job(), dtype=np.float64)
-        wall[replay] = time.perf_counter() - t0
-        if replay == "1":
-            reps = [r for r in mex.loop_reports[n0:]
-                    if r["name"] == name]
-            report = reps[-1] if reps else None
+    prev = os.environ.get("THRILL_TPU_LOOP_REPLAY")
+    try:
+        for replay in ("1", "0"):
+            os.environ["THRILL_TPU_LOOP_REPLAY"] = replay
+            job()                                # warm: compile+cache
+            n0 = len(mex.loop_reports)
+            t0 = time.perf_counter()
+            results[replay] = np.asarray(job(), dtype=np.float64)
+            wall[replay] = time.perf_counter() - t0
+            if replay == "1":
+                reps = [r for r in mex.loop_reports[n0:]
+                        if r["name"] == name]
+                report = reps[-1] if reps else None
+    finally:
+        # restore the caller's setting even when a leg raises (the
+        # module-level pop in main() only covered the clean path)
+        if prev is None:
+            os.environ.pop("THRILL_TPU_LOOP_REPLAY", None)
+        else:
+            os.environ["THRILL_TPU_LOOP_REPLAY"] = prev
     assert np.array_equal(results["1"], results["0"]), \
         f"{name}: replayed and per-iteration results diverge"
     return (name, report, wall["1"], wall["0"])
@@ -86,8 +95,6 @@ def main() -> None:
                  lambda: km.k_means(ctx, points, args.clusters,
                                     iterations=args.iters), mex),
     ]
-    os.environ.pop("THRILL_TPU_LOOP_REPLAY", None)
-
     print(f"{'loop':<10} {'iters':>5} {'hit':>5} {'plans':>5} "
           f"{'fori':>5} {'donatedB':>9} {'capture_s':>10} "
           f"{'replay_s':>9} {'wall':>7} {'noreplay':>9}")
