@@ -373,6 +373,26 @@ def _run_bench() -> None:
     _set(pipeline_aborts=int(press.get("pipeline_aborts", 0)),
          conn_reconnects=int(press.get("conn_reconnects", 0)),
          heal_time_s=float(press.get("heal_time_s", 0.0)))
+    # plan observatory (common/decisions.py): cost-model estimate
+    # quality as mean |log2(predicted/actual)| per decision kind, WITH
+    # the per-lane join count and stddev — vs_* ratios are known to
+    # swing run-to-run on this rig, so a regression in estimate
+    # quality must be judged against its own dispersion, not a bare
+    # point value
+    try:
+        acc = ctx.decisions.accuracy()
+        _set(cost_model_mae={k: v["mae_log2"] for k, v in acc.items()
+                             if v.get("mae_log2") is not None},
+             cost_model_mae_n={k: v["joined"] for k, v in acc.items()
+                               if v.get("mae_log2") is not None},
+             cost_model_mae_std={k: v["stdev_log2"]
+                                 for k, v in acc.items()
+                                 if v.get("stdev_log2") is not None},
+             decisions_recorded=int(
+                 press.get("decisions_recorded", 0)),
+             decisions_joined=int(press.get("decisions_joined", 0)))
+    except Exception as e:  # observability lane never kills the line
+        _set(cost_model_error=repr(e)[:200])
     # overlapped-exchange data plane (data/exchange.py): run-wide
     # overlap fraction, capacity-plan cache hit rate, and the
     # bytes-on-wire baseline for the shrink-the-wire ROADMAP item

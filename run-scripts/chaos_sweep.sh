@@ -62,8 +62,11 @@ fi
 # post-mortem dump here (common/trace.py). Each dump's header records
 # the THRILL_TPU_FAULTS arming active at abort time — the seed that
 # produced the failure — so a sweep failure ships its own repro
-# context. FLIGHT_KEEP is raised so a long sweep's early failures are
-# not pruned away.
+# context. The decision ledger lands BESIDE each flight dump
+# (decisions-*.json, common/decisions.py): what the planner chose —
+# and how its predictions were auditing — on the road to the abort.
+# FLIGHT_KEEP is raised so a long sweep's early failures are not
+# pruned away.
 FLIGHT_DIR=${CHAOS_FLIGHT_DIR:-/tmp/thrill_chaos_flight.$$}
 mkdir -p "$FLIGHT_DIR"
 echo "chaos_sweep: flight-recorder dumps archive to $FLIGHT_DIR" >&2

@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# Plan observatory report: run a small W=2 example with the decision
+# ledger on, print the explain() tree live, then render the audited
+# offline report from the JSON log (tools/plan_report.py).
+#
+# Usage:
+#   run-scripts/plan_report.sh [OUT_DIR]
+#
+# Outputs (under OUT_DIR, default /tmp/thrill_tpu_plan):
+#   run-host0.json   raw JSON event log (event=decision /
+#                    decision_audit lines alongside spans + stages)
+#   explain.txt      ctx.explain() of the PageRank pipeline — every
+#                    fused segment, the exchange strategy per shuffle
+#                    edge, each decision with its reason and audit
+#   report.txt       tools/plan_report.py over the log: the same tree
+#                    reconstructed offline + the accuracy ledger
+#                    (per-kind mean |log2 predicted/actual|)
+#   plans/decisions.json  the accuracy summary persisted next to the
+#                    plan store (Context.close)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=${1:-/tmp/thrill_tpu_plan}
+mkdir -p "$OUT"
+rm -f "$OUT"/run-host*.json
+
+env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    THRILL_TPU_LOG="$OUT/run.json" \
+    THRILL_TPU_PLAN_STORE="$OUT/plans" \
+    THRILL_TPU_HBM_LIMIT=256Mi \
+    OUT_DIR="$OUT" \
+    python - <<'PY'
+import os
+import sys
+
+sys.path.insert(0, "examples")
+import page_rank as pr
+
+from thrill_tpu.api import Context
+from thrill_tpu.parallel.mesh import MeshExec
+
+out = os.environ["OUT_DIR"]
+ctx = Context(MeshExec(num_workers=2))
+edges = pr.zipf_graph(256, 1024, seed=7)
+
+
+def pipeline(c):
+    return pr.page_rank(c, edges, 256, iterations=3)
+
+
+txt = ctx.explain(pipeline, name="page_rank W=2")
+with open(os.path.join(out, "explain.txt"), "w") as f:
+    f.write(txt + "\n")
+print(txt)
+acc = ctx.decisions.accuracy()
+print("\naccuracy ledger:", acc)
+ctx.close()
+PY
+
+python -m thrill_tpu.tools.plan_report "$OUT"/run-host0.json \
+    > "$OUT/report.txt"
+
+echo
+echo "explain tree:     $OUT/explain.txt"
+echo "audited report:   $OUT/report.txt"
+echo "persisted ledger: $OUT/plans/decisions.json"
+tail -n 20 "$OUT/report.txt"

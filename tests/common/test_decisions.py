@@ -1,0 +1,348 @@
+"""Plan observatory (common/decisions.py): the decision ledger,
+explain(), audit joins, persistence and the disabled-path pin.
+
+Acceptance pins (ISSUE 11):
+* ctx.explain() on the W=2 PageRank pipeline names every fused
+  segment, the exchange strategy per shuffle edge, and >= 5 distinct
+  decision kinds with recorded predictions;
+* after the run, >= 3 of those kinds carry joined actuals with finite
+  error ratios;
+* THRILL_TPU_DECISIONS=0 is a pinned zero-allocation no-op at the
+  dispatch choke point (RECORDS_CREATED stays flat — the
+  SPANS_CREATED pattern);
+* the accuracy ledger persists next to the plan store
+  (decisions.json) and rides beside every flight-recorder dump.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from thrill_tpu.api import Context
+from thrill_tpu.common import decisions, faults, trace
+from thrill_tpu.common.config import Config
+from thrill_tpu.parallel.mesh import MeshExec
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+def _kv(x):
+    return (x % 9, x)
+
+
+def _add(a, b):
+    return a + b
+
+
+def _reduce_job(ctx):
+    return sorted((int(k), int(v)) for k, v in ctx.Distribute(
+        np.arange(72, dtype=np.int64)).Map(_kv).ReducePair(
+            _add).AllGather())
+
+
+def _examples_path():
+    p = os.path.join(os.path.dirname(__file__), "..", "..", "examples")
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+# ----------------------------------------------------------------------
+# the acceptance run: W=2 PageRank, explain + audited ledger.
+# Loop replay is off so iterations 2.. take the REAL planned paths
+# (replayed tapes re-plan nothing — there would be no optimistic
+# exchange decision to audit); the HBM limit arms admission so the
+# cost-model estimates are recorded and joined.
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pagerank_plan():
+    _examples_path()
+    import page_rank as pr
+    env = {"THRILL_TPU_HBM_LIMIT": "256Mi",
+           "THRILL_TPU_LOOP_REPLAY": "0"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        ctx = Context(MeshExec(num_workers=2))
+        edges = pr.zipf_graph(128, 512, seed=3)
+
+        def pipeline(c):
+            return pr.page_rank(c, edges, 128, iterations=3)
+
+        txt = ctx.explain(pipeline, name="page_rank")
+        snap = ctx.decisions.snapshot()
+        acc = ctx.decisions.accuracy()
+        ctx.close()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return txt, snap, acc
+
+
+def test_explain_names_fused_segments_and_strategies(pagerank_plan):
+    txt, snap, _acc = pagerank_plan
+    # every fused segment (the stitched programs' op compositions) is
+    # named, and FUSED nodes carry their segment annotation
+    fused_ops = {(d.get("inputs") or {}).get("ops") for d in snap
+                 if d["kind"] == "fusion"}
+    assert fused_ops, "no fusion decisions recorded"
+    for ops in fused_ops:
+        assert ops and ops in txt, ops
+    assert "~ fused into [" in txt
+    # the exchange strategy is named per shuffle edge, with the
+    # rejected alternative's estimated cost
+    assert "xchg_strategy: chose dense over onefactor est" in txt
+    # barrier reasons are first-class (why a chain ended)
+    assert "fusion_barrier" in txt and "multi-consumer" in txt
+
+
+def test_acceptance_kinds_predictions_and_joined_actuals(pagerank_plan):
+    _txt, snap, acc = pagerank_plan
+    with_pred = {d["kind"] for d in snap
+                 if d.get("predicted") is not None}
+    assert len(with_pred) >= 5, with_pred
+    joined_finite = {d["kind"] for d in snap
+                     if d.get("err_log2") is not None}
+    assert len(joined_finite) >= 3, joined_finite
+    # the optimistic exchange's deferred check audited hit/miss
+    assert any(d["kind"] == "xchg_optimistic"
+               and d.get("verdict") in ("hit", "miss") for d in snap)
+    # accuracy ledger carries finite MAEs for the joined kinds
+    for kind in joined_finite:
+        assert acc[kind]["joined"] > 0
+        assert acc[kind]["mae_log2"] is not None
+        assert np.isfinite(acc[kind]["mae_log2"])
+
+
+# ----------------------------------------------------------------------
+# disabled-path pin: THRILL_TPU_DECISIONS=0 allocates NO records at
+# the dispatch choke point (or anywhere else)
+# ----------------------------------------------------------------------
+
+def test_decisions_disabled_is_pinned_noop(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_DECISIONS", "0")
+    # arm admission so the dispatch choke point's decision site is
+    # actually reached (it must still allocate nothing)
+    monkeypatch.setenv("THRILL_TPU_HBM_LIMIT", "256Mi")
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        assert not ctx.decisions.enabled
+        d0 = ctx.mesh_exec.stats_dispatches
+        n0 = decisions.RECORDS_CREATED
+        assert _reduce_job(ctx) == sorted(
+            (k, sum(v for v in range(72) if v % 9 == k))
+            for k in range(9))
+        assert ctx.mesh_exec.stats_dispatches > d0, "nothing dispatched"
+        assert decisions.RECORDS_CREATED == n0, \
+            "DecisionRecord allocated with THRILL_TPU_DECISIONS=0"
+        assert not ctx.decisions.kind_counts
+        # explain still renders (the bare tree, no decisions)
+        txt = ctx.explain()
+        assert "ReducePair" in txt
+        stats = ctx.overall_stats()
+        assert stats["decisions_recorded"] == 0
+    finally:
+        ctx.close()
+
+
+@pytest.mark.slow
+def test_decision_results_identical_on_off(monkeypatch):
+    """Slow-marked (tier-1 rebalance): the disabled-pin test above
+    already runs the same job under THRILL_TPU_DECISIONS=0 and asserts
+    exact results — this paired on/off identity sweep is the redundant
+    tail, kept for the full (-m slow) sweep."""
+    want = None
+    for flag in ("1", "0"):
+        monkeypatch.setenv("THRILL_TPU_DECISIONS", flag)
+        ctx = Context(MeshExec(num_workers=2))
+        try:
+            got = _reduce_job(ctx)
+        finally:
+            ctx.close()
+        if want is None:
+            want = got
+        assert got == want
+
+
+# ----------------------------------------------------------------------
+# explain() snapshot: WordCount (the PageRank snapshot rides the
+# acceptance fixture above)
+# ----------------------------------------------------------------------
+
+def test_explain_wordcount_snapshot():
+    _examples_path()
+    import word_count as wc
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        lines = ["the quick brown fox", "the lazy dog",
+                 "the quick dog"] * 8
+
+        def pipeline(c):
+            out = wc.word_count(c, lines).AllGather()
+            assert dict(out)["the"] == 24
+
+        txt = ctx.explain(pipeline, name="word_count")
+        # structural snapshot: the pipeline's op spine, consumer first
+        spine = [ln.split("[")[0].strip() for ln in txt.splitlines()
+                 if ln.lstrip().startswith("- ")]
+        labels = [s.split("#")[0].lstrip("- ") for s in spine]
+        assert labels[0] == "ReduceByKey"
+        assert "FlatMapHost" in labels or "Distribute" in labels, labels
+        # the host ReduceByKey path still records its prune verdict
+        assert any(d["kind"] == "prune"
+                   for d in ctx.decisions.snapshot())
+    finally:
+        ctx.close()
+
+
+# ----------------------------------------------------------------------
+# satellite: the multiprocess "plan store loudly ignored" path emits a
+# store_skip DecisionRecord, so explain() shows why warm-start
+# didn't happen
+# ----------------------------------------------------------------------
+
+def test_store_skip_decision_on_multiprocess_mesh(tmp_path):
+    mex = MeshExec(num_workers=2)
+    mex.num_processes = 2          # fake a 2-controller topology
+    ctx = Context(mex, Config(plan_store=str(tmp_path / "plans")))
+    try:
+        assert ctx.plan_store is None
+        skips = [d for d in ctx.decisions.snapshot()
+                 if d["kind"] == "store_skip"]
+        assert skips, "no store_skip decision recorded"
+        assert skips[0]["chosen"] == "cold"
+        assert "desynchronize" in skips[0]["reason"]
+    finally:
+        mex.num_processes = 1      # close() runs single-process paths
+        ctx.close()
+
+
+# ----------------------------------------------------------------------
+# persistence: accuracy ledger next to the plan store, and beside
+# flight dumps
+# ----------------------------------------------------------------------
+
+def test_ledger_persists_next_to_plan_store(tmp_path):
+    store = str(tmp_path / "plans")
+    ctx = Context(MeshExec(num_workers=2), Config(plan_store=store))
+    try:
+        _reduce_job(ctx)
+    finally:
+        ctx.close()
+    with open(os.path.join(store, "decisions.json")) as f:
+        summary = json.load(f)
+    assert summary["decisions"] > 0
+    assert "xchg_strategy" in summary["accuracy"]
+    joined = [k for k, v in summary["accuracy"].items()
+              if v.get("mae_log2") is not None]
+    assert joined, summary
+    assert os.path.exists(os.path.join(store, "plans.json"))
+
+
+def test_dump_beside_flight_and_prune(tmp_path):
+    led = decisions.DecisionLedger(enabled=True)
+    rec = led.record("xchg_strategy", "xchg:abc", "dense",
+                     predicted=64.0)
+    led.resolve(rec, 32.0)
+    flight = tmp_path / "flight-1-p1-r0-0.json"
+    flight.write_text("{}\n")
+    path = led.dump_beside(str(flight))
+    assert path is not None and os.path.exists(path)
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["accuracy"]["xchg_strategy"]["mae_log2"] == 1.0
+    assert lines[1]["kind"] == "xchg_strategy"
+    # non-flight paths and disabled ledgers refuse quietly
+    assert led.dump_beside(str(tmp_path / "other.json")) is None
+    assert decisions.DecisionLedger(enabled=False).dump_beside(
+        str(flight)) is None
+    # the flight pruner drops the decisions sibling with its dump
+    for i in range(2, 6):
+        (tmp_path / f"flight-{i}-p1-r0-0.json").write_text("{}\n")
+    trace._prune(str(tmp_path), keep=1)
+    left = sorted(os.listdir(tmp_path))
+    assert len([f for f in left if f.startswith("flight-")]) == 1
+    assert not [f for f in left if f.startswith("decisions-")]
+
+
+# ----------------------------------------------------------------------
+# ledger unit behavior: audit math, resolve_site joins, ring bound
+# ----------------------------------------------------------------------
+
+def test_audit_math_and_verdicts():
+    led = decisions.DecisionLedger(enabled=True)
+    ok = led.record("admission", "jit:a", "admit", predicted=100.0)
+    led.resolve(ok, 60.0)
+    assert ok.verdict == "ok" and abs(ok.err_log2) < 1.0
+    off = led.record("admission", "jit:b", "admit", predicted=100.0)
+    led.resolve(off, 10.0)
+    assert off.verdict == "off"
+    un = led.record("xchg_chunks", "xchg:c", "4")
+    led.resolve(un, 5.0)
+    assert un.verdict == "unmeasured" and un.err_log2 is None
+    acc = led.accuracy()
+    assert acc["admission"]["joined"] == 2
+    assert acc["admission"]["mae_log2"] is not None
+    worst = led.worst_sites()
+    assert worst[0]["site"] == "jit:b"
+
+
+def test_resolve_site_joins_open_records():
+    led = decisions.DecisionLedger(enabled=True)
+    led.record("prune", "prune:x", "location:on", predicted=0.5,
+               join=True)
+    assert led.resolve_site("prune", "prune:x", 0.25)
+    assert not led.resolve_site("prune", "prune:x", 0.25), \
+        "a join must consume the open record"
+    assert not led.resolve_site("prune", "prune:never", 0.1)
+    assert led.accuracy()["prune"]["joined"] == 1
+
+
+def test_render_plan_drops_other_plans_node_decisions():
+    """A reused Context's ledger holds records bound to EARLIER
+    pipelines' nodes; rendering a new plan slice must drop them, not
+    misfile them under 'plan-wide decisions' (only site-less records
+    belong there)."""
+    nodes = [{"id": 5, "label": "A", "state": "EXECUTED",
+              "parents": []}]
+    decs = [{"event": "decision", "seq": 1, "kind": "fusion",
+             "chosen": "fuse", "site": "s", "dia_id": 2},
+            {"event": "decision", "seq": 2, "kind": "xchg_strategy",
+             "chosen": "dense", "site": "t"},
+            {"event": "decision", "seq": 3, "kind": "admission",
+             "chosen": "admit", "site": "u", "dia_id": 5}]
+    txt = decisions.render_plan(nodes, decs)
+    assert "admission" in txt                # this plan's node
+    assert "xchg_strategy" in txt            # site-less -> plan-wide
+    assert "fusion" not in txt               # another plan's node
+
+
+def test_render_plan_survives_deep_chains():
+    """walk() is an explicit stack: a parent chain deeper than the
+    interpreter recursion limit must render, not RecursionError."""
+    n = 3000
+    nodes = [{"id": i, "label": "Op", "state": "EXECUTED",
+              "parents": [i - 1] if i else []} for i in range(n)]
+    txt = decisions.render_plan(nodes, [])
+    assert txt.count("- Op#") == n
+
+
+def test_ring_bound_keeps_aggregates():
+    led = decisions.DecisionLedger(enabled=True, ring=4)
+    for i in range(10):
+        r = led.record("fusion", f"fuse:{i}", "fuse", predicted=8.0)
+        led.resolve(r, 4.0)
+    assert len(led.records) == 4            # ring evicts old records
+    assert led.kind_counts["fusion"] == 10  # aggregates never drop
+    assert led.accuracy()["fusion"]["joined"] == 10

@@ -59,6 +59,28 @@ def test_trace2perfetto_main(tmp_path, monkeypatch, capsys):
                for e in evs)
 
 
+def test_plan_report_main(tmp_path, monkeypatch, capsys):
+    from thrill_tpu.tools import plan_report
+    path = _make_log(tmp_path)
+    monkeypatch.setattr(sys, "argv", ["plan_report", path])
+    plan_report.main()
+    out = capsys.readouterr().out
+    # the reconstructed tree names the pipeline's ops and at least one
+    # recorded decision with its chosen alternative
+    assert "plan report" in out and "Sort" in out
+    assert ": chose" in out
+    # the audited accuracy ledger renders with per-kind joins (the
+    # small Sort run always records+joins its fused dispatches)
+    assert "decision accuracy" in out and "fusion" in out
+
+
+def test_plan_report_usage_exit(monkeypatch):
+    from thrill_tpu.tools import plan_report
+    monkeypatch.setattr(sys, "argv", ["plan_report"])
+    with pytest.raises(SystemExit):
+        plan_report.main()
+
+
 def test_trace2perfetto_usage_exit(monkeypatch):
     from thrill_tpu.tools import trace2perfetto
     monkeypatch.setattr(sys, "argv", ["trace2perfetto"])

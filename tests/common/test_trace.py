@@ -338,16 +338,35 @@ def test_log_schema_conformance(tmp_path):
         raw = [l for l in f if l.strip()]
     assert len(raw) > 20
     kinds = set()
+    audit_seqs = set()
+    decision_seqs = set()
     for line in raw:
         e = json.loads(line)           # raises = malformed line
         assert isinstance(e.get("event"), str) and e["event"], e
         assert isinstance(e.get("ts"), int), e
         assert isinstance(e.get("host"), int), e
         kinds.add(e["event"])
+        # decision-ledger schema (ISSUE 11): every event=decision line
+        # carries kind/site/chosen strings and an int seq; audits join
+        # back to a recorded seq with a verdict
+        if e["event"] == "decision":
+            for k in ("kind", "site", "chosen"):
+                assert isinstance(e.get(k), str) and e[k], (k, e)
+            assert isinstance(e.get("seq"), int), e
+            decision_seqs.add(e["seq"])
+            if "predicted" in e:
+                assert isinstance(e["predicted"], (int, float)), e
+        elif e["event"] == "decision_audit":
+            assert isinstance(e.get("seq"), int), e
+            assert isinstance(e.get("verdict"), str), e
+            audit_seqs.add(e["seq"])
     # the run above must have exercised the main emitters
     for want in ("node_execute_start", "node_execute_done", "exchange",
-                 "span", "job_submit", "job_done", "overall_stats"):
+                 "span", "job_submit", "job_done", "overall_stats",
+                 "decision", "decision_audit"):
         assert want in kinds, (want, kinds)
+    assert audit_seqs <= decision_seqs, \
+        "decision_audit lines must join a recorded decision seq"
 
 
 def test_logger_timestamps_are_monotonic_derived(tmp_path,

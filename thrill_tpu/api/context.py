@@ -124,6 +124,17 @@ class Context:
             lambda: getattr(self, "current_tenant", None)
         self.mesh_exec.tracer = self.tracer
         self.net.group.tracer = self.tracer
+        # plan observatory (common/decisions.py): one DecisionLedger
+        # per Context, attached to the mesh so every plan-choice choke
+        # point (fusion, exchange, preshuffle, admission, plan store)
+        # reaches it in one attribute read. THRILL_TPU_DECISIONS=0
+        # pins the disabled fast path (no record objects anywhere);
+        # records ride the JSON log (event=decision) and the trace's
+        # "plan" lane, and ctx.explain() renders them on the DIA tree.
+        from ..common.decisions import DecisionLedger
+        self.decisions = DecisionLedger(logger=self.logger,
+                                        tracer=self.tracer)
+        self.mesh_exec.decisions = self.decisions
         # live metrics endpoint (common/metrics.py): Prometheus text on
         # THRILL_TPU_METRICS_PORT from a daemon thread; unset = off
         from ..common.metrics import maybe_start as _metrics_start
@@ -200,6 +211,15 @@ class Context:
                   "on a multi-process mesh (per-rank seeding would "
                   "desynchronize SPMD plans); recompiling cold",
                   file=sys.stderr)
+            # first-class record, not just a log line: explain() shows
+            # WHY warm-start didn't happen (ISSUE 11 satellite)
+            if self.decisions.enabled:
+                self.decisions.record(
+                    "store_skip", "plan_store", "cold",
+                    rejected=[("warm-start", None)],
+                    reason="multi-process mesh: per-rank seeding "
+                           "would desynchronize SPMD plans",
+                    path=self.config.plan_store)
         elif self.config.plan_store:
             from ..service.plan_store import PlanStore
             self.plan_store = PlanStore(self.config.plan_store,
@@ -209,6 +229,16 @@ class Context:
                 self.logger.line(event="plan_store_load",
                                  path=self.config.plan_store,
                                  entries=seeded)
+            if self.decisions.enabled \
+                    and self.plan_store._last_corrupt is not None:
+                # the corrupt-degrade is a plan decision too: the
+                # service chose cold recompile over a torn store
+                self.decisions.record(
+                    "store_skip", "plan_store", "cold",
+                    rejected=[("warm-start", None)],
+                    reason="store corrupt: "
+                           + self.plan_store._last_corrupt[:120],
+                    path=self.config.plan_store)
         # checkpoint/resume subsystem (api/checkpoint.py): fully off —
         # ctx.checkpoint stays None, the stage driver pays one
         # attribute read — unless THRILL_TPU_CKPT_DIR is set
@@ -424,6 +454,32 @@ class Context:
         from .ops import read_write
         return read_write.ReadBinary(self, path_or_glob, dtype, record_shape)
 
+    # -- plan observatory (common/decisions.py) -------------------------
+    def explain(self, pipeline_fn: Optional[Callable] = None,
+                name: str = "") -> str:
+        """Render the physical plan as an annotated tree: ops, fused
+        segments, the exchange strategy per shuffle edge, and every
+        recorded decision with its reason and (post-run) its audit
+        verdict.
+
+        ``ctx.explain(pipeline_fn)`` runs ``pipeline_fn(ctx)`` and
+        renders exactly the nodes that run created; ``ctx.explain()``
+        renders everything this Context has built so far. Purely
+        observational: reads the decision ledger, changes no plan."""
+        from ..common.decisions import render_plan
+        lo = 0
+        if pipeline_fn is not None:
+            lo = len(self._nodes)
+            pipeline_fn(self)
+        nodes = self._nodes[lo:]
+        return render_plan(
+            [{"id": n.id, "label": n.label, "state": n.state,
+              "parents": [p.node.id for p in n.parents]}
+             for n in nodes],
+            self.decisions.snapshot(), W=self.num_workers,
+            title=name or (getattr(pipeline_fn, "__name__", "")
+                           if pipeline_fn is not None else ""))
+
     def overall_stats(self, local_only: bool = False) -> dict:
         """End-of-job summary (reference: OverallStats AllReduce,
         api/context.cpp:1235-1341). In multi-process runs the per-host
@@ -536,6 +592,18 @@ class Context:
             "tenant_spills": self.hbm.tenant_spill_count,
             "plan_builds": mex.stats_plan_builds,
             "plan_store_hits": mex.stats_plan_store_hits,
+            # plan observatory (common/decisions.py): how many plan
+            # choices were recorded, how many have joined actuals, and
+            # the per-kind accuracy ledger (mean |log2 pred/actual|) —
+            # the number the ROADMAP adaptive planner will be judged by
+            "decisions_recorded": sum(
+                self.decisions.kind_counts.values()),
+            "decisions_joined": sum(
+                self.decisions.joined_counts.values()),
+            "decision_accuracy": {
+                k: v["mae_log2"]
+                for k, v in self.decisions.accuracy().items()
+                if v.get("mae_log2") is not None},
         }
         # durability layer (api/checkpoint.py): epochs committed, bytes
         # sealed, ops skipped by resume, time spent restoring
@@ -694,9 +762,12 @@ class Context:
                              cause=cause[:300])
         # flight recorder: every abort leaves a self-contained
         # post-mortem — the ring's final spans name the failing site
-        # (error attrs) and the generation. Best-effort by contract.
+        # (error attrs) and the generation; the decision ledger lands
+        # beside it (the chaos sweep archives both: what the planner
+        # chose on the road to this abort). Best-effort by contract.
         try:
-            self.tracer.dump_flight(cause, generation=failed_gen)
+            self.decisions.dump_beside(
+                self.tracer.dump_flight(cause, generation=failed_gen))
         except Exception:
             pass
         if (self.net.num_workers > 1
@@ -802,7 +873,8 @@ class Context:
                              generation=self.generation,
                              cause=cause_s[:300])
         try:
-            self.tracer.dump_flight(cause, generation=self.generation)
+            self.decisions.dump_beside(self.tracer.dump_flight(
+                cause, generation=self.generation))
         except Exception:
             pass
         if self.net.num_workers > 1:
@@ -848,9 +920,9 @@ class Context:
             # an abort escaping the whole job (no ctx.pipeline() heal
             # caught it) still leaves its post-mortem
             try:
-                self.tracer.dump_flight(
+                self.decisions.dump_beside(self.tracer.dump_flight(
                     exc, generation=getattr(exc, "generation",
-                                            self.generation))
+                                            self.generation)))
             except Exception:
                 pass
 
@@ -892,6 +964,19 @@ class Context:
                 # a failing store must never take down a clean close
                 from ..common import faults as _faults
                 _faults.note("recovery", what="plan_store.save_failed",
+                             error=repr(e)[:200])
+            # the audited accuracy ledger persists NEXT TO the plan
+            # state it judges: plans.json says what the model learned,
+            # decisions.json says how right it was (best-effort too)
+            try:
+                if self.decisions.enabled \
+                        and self.decisions.kind_counts:
+                    self.plan_store.save_ledger(
+                        self.decisions.summary())
+            except Exception as e:
+                from ..common import faults as _faults
+                _faults.note("recovery",
+                             what="decision_ledger.save_failed",
                              error=repr(e)[:200])
         # a dead-peer verdict latched by the background heartbeat
         # monitor (net/heartbeat.py mark_dead) may arrive with NO

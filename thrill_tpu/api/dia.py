@@ -205,6 +205,31 @@ class DIA:
     def Dispose(self) -> None:
         self.node.dispose()
 
+    def explain(self) -> str:
+        """Annotated physical plan of THIS DIA's upstream subgraph:
+        ops, fused segments, exchange strategy per shuffle edge, and
+        every recorded decision with its reason and (post-run) audit
+        verdict (common/decisions.py; ``ctx.explain()`` renders the
+        whole Context). Purely observational — reads the decision
+        ledger, changes no plan or state."""
+        from ..common.decisions import render_plan
+        nodes, stack = [], [self.node]
+        seen = set()
+        while stack:
+            n = stack.pop()
+            if n.id in seen:
+                continue
+            seen.add(n.id)
+            nodes.append(n)
+            stack.extend(p.node for p in n.parents)
+        return render_plan(
+            [{"id": n.id, "label": n.label, "state": n.state,
+              "parents": [p.node.id for p in n.parents]}
+             for n in nodes],
+            self.context.decisions.snapshot(),
+            W=self.context.num_workers,
+            title=f"{self.node.label}#{self.node.id}")
+
     # ------------------------------------------------------------------
     # actions
     # ------------------------------------------------------------------

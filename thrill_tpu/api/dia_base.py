@@ -113,6 +113,29 @@ class DIABase:
         return None
 
     # -- driver ---------------------------------------------------------
+    def _barrier_decision(self, reason: str) -> None:
+        """Ledger entry for a declined fusion deferral: WHY this node
+        ends the stitched chain (common/decisions.py; explain() shows
+        the barrier reason on the node)."""
+        from ..common import decisions as _decisions
+        led = _decisions.ledger_of(self.context.mesh_exec)
+        if led is not None:
+            led.record("fusion_barrier",
+                       f"node:{self.label}#{self.id}", "materialize",
+                       rejected=[("defer", None)], reason=reason,
+                       dia=self.id, node=self.label)
+
+    def _bind_ledger_node(self):
+        """The mesh ledger with this node pushed as the current
+        decision site, or None — decisions recorded inside compute()
+        (exchange strategy, prune verdicts, admission) then attach to
+        this node in explain()."""
+        led = getattr(self.context.mesh_exec, "decisions", None)
+        if led is not None and led.enabled:
+            led.push_node(self.id, self.label)
+            return led
+        return None
+
     def materialize_plan(self, consume: bool = False):
         """Fused-stage entry: defer this node's program into its sole
         consumer's stitched dispatch when safe (sole consumer, nothing
@@ -129,6 +152,8 @@ class DIABase:
             # MATERIALIZED shards, so every DOp becomes a durable
             # stage barrier (the documented fusion tradeoff of
             # THRILL_TPU_CKPT_AUTO).
+            self._barrier_decision("checkpoint restore/auto-epoch "
+                                   "needs materialized shards")
             return self.materialize(consume=consume)
         if (fusion.enabled() and consume and self._shards is None
                 and self.state == NEW and self.consume_budget <= 1
@@ -136,9 +161,12 @@ class DIABase:
             # the legacy path would negotiate around compute(); plans
             # may fall back to mem-hungry host bodies, so grant here too
             negotiated = self.context.negotiate_mem(self)
+            led = self._bind_ledger_node()
             try:
                 plan = self.compute_plan()
             finally:
+                if led is not None:
+                    led.pop_node()
                 if negotiated:
                     self.context.release_mem(self)
             if plan is not None:
@@ -150,6 +178,17 @@ class DIABase:
                              dia_id=self.id,
                              parents=[p.node.id for p in self.parents])
                 return plan
+            self._barrier_decision("plan ineligible (host storage or "
+                                   "untraceable input)")
+        elif fusion.enabled() and consume \
+                and type(self).compute_plan is not DIABase.compute_plan:
+            # statically fusible op that cannot defer THIS pull: name
+            # the reason (the explain() barrier taxonomy). Reaching
+            # this branch with consume=True means exactly one of these
+            # two defer conditions failed.
+            self._barrier_decision(
+                "cached result" if self._shards is not None
+                or self.state != NEW else "multi-consumer (Keep)")
         return self.materialize(consume=consume)
 
     def materialize(self, consume: bool = False) -> Shards:
@@ -186,9 +225,12 @@ class DIABase:
                 # sorts, shrink the inner grants exactly like the
                 # reference's per-stage split)
                 negotiated = self.context.negotiate_mem(self)
+                led = self._bind_ledger_node()
                 try:
                     self._shards = self.compute()
                 finally:
+                    if led is not None:
+                        led.pop_node()
                     if negotiated:
                         self.context.release_mem(self)
                 if mgr is not None:

@@ -58,6 +58,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..common import decisions as _decisions
 from ..common import faults
 from ..common import trace as _trace
 from ..common.retry import default_policy
@@ -350,6 +351,26 @@ class FusionPlan:
             pres.hint_output_bytes(sum(
                 int(getattr(l, "nbytes", 0) or 0)
                 for s in srcs for l in jax.tree.leaves(s.tree)))
+        # decision ledger: the fusion split point — which ops ride this
+        # one dispatch, and what the cost model predicts its output
+        # weighs (audited below against the measured output leaves)
+        led = _decisions.ledger_of(mex)
+        dec = None
+        if led is not None:
+            ops_label = "+".join(s.label for s in segs)[:80]
+            pred = getattr(fn, "_out_bytes", None)
+            why = "learned output size"
+            if pred is None and not any(s.expands for s in segs):
+                pred = sum(int(getattr(l, "nbytes", 0) or 0)
+                           for s in srcs
+                           for l in jax.tree.leaves(s.tree))
+                why = "non-expanding chain: bounded by source bytes"
+            elif pred is None:
+                why = "expanding chain: no bound"
+            dec = led.record("fusion", "fuse:" + ops_label, "fuse",
+                             predicted=pred, reason=why,
+                             ops=ops_label, n_ops=len(segs),
+                             dia_ids=[s.dia_id for s in segs])
         try:
             out = fn(*args)
         except Exception as e:
@@ -374,6 +395,9 @@ class FusionPlan:
             log.line(event="fused_dispatch", ops=list(ops),
                      dia_ids=[s.dia_id for s in segs])
         n_out = h["n_out"]
+        if dec is not None:
+            led.resolve(dec, sum(int(getattr(l, "nbytes", 0) or 0)
+                                 for l in out[1:1 + n_out]))
         tree = jax.tree.unflatten(h["treedef"], list(out[1:1 + n_out]))
         self.aux = dict(zip(h["aux_names"], out[1 + n_out:]))
         if self.known_counts is not None:

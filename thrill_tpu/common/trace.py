@@ -349,17 +349,23 @@ class Tracer:
 
 
 def _prune(d: str, keep: int) -> None:
-    """Drop all but the newest ``keep`` flight dumps in ``d``."""
+    """Drop all but the newest ``keep`` flight dumps in ``d`` — along
+    with each pruned dump's decision-ledger sibling
+    (``decisions-*.json``, common/decisions.py), which would otherwise
+    accumulate unboundedly under an abort-heavy chaos sweep."""
     files = [os.path.join(d, f) for f in os.listdir(d)
              if f.startswith("flight-") and f.endswith(".json")]
     if len(files) <= keep:
         return
     files.sort(key=lambda p: os.path.getmtime(p), reverse=True)
     for p in files[keep:]:
-        try:
-            os.unlink(p)
-        except OSError:
-            pass
+        for victim in (p, os.path.join(
+                os.path.dirname(p), "decisions-"
+                + os.path.basename(p)[len("flight-"):])):
+            try:
+                os.unlink(victim)
+            except OSError:
+                pass
 
 
 def span_of(tracer: Optional[Tracer], cat: str, name: str,

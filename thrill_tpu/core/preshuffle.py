@@ -88,13 +88,20 @@ def register_width(est_rows: int) -> int:
 
 def record_prune(mex, token, pre_rows: int, post_rows: int) -> None:
     """Teach the site its observed prune fraction (called only where
-    both counts are already host-known — learning never adds a sync)."""
+    both counts are already host-known — learning never adds a sync).
+    This is also the prune decision's audit-join point: the fraction
+    the cost model predicted meets the fraction the filter actually
+    removed (common/decisions.py)."""
     if pre_rows <= 0:
         return
     hist = getattr(mex, "_prune_history", None)
     if hist is None:
         hist = mex._prune_history = {}
     frac = max(0.0, min(1.0, 1.0 - post_rows / pre_rows))
+    from ..common import decisions as _decisions
+    led = _decisions.ledger_of(mex)
+    if led is not None:
+        led.resolve_site("prune", _prune_site(token), max(frac, 1e-6))
     prev = hist.get(token)
     hist[token] = frac if prev is None else 0.5 * (prev + frac)
 
@@ -209,11 +216,51 @@ def import_plan_state(mex, state: dict) -> int:
 
 def _pays(rows: int, item_bytes: int, W: int, sides: int, M: int,
           frac: float) -> bool:
+    pruned, fingerprint = _pays_est(rows, item_bytes, W, sides, M,
+                                    frac)
     if W <= 1 or rows <= 0:
         return False
-    pruned = rows * item_bytes * frac * (W - 1) / W
-    fingerprint = sides * M                     # u8 registers
     return pruned > _MARGIN * fingerprint
+
+
+def _pays_est(rows: int, item_bytes: int, W: int, sides: int, M: int,
+              frac: float) -> Tuple[float, float]:
+    """(est_pruned_row_bytes, est_fingerprint_bytes): the two sides of
+    the pre-shuffle cost inequality — what the decision ledger records
+    as the verdict's inputs."""
+    pruned = max(rows, 0) * item_bytes * frac * max(W - 1, 0) / max(W, 1)
+    fingerprint = sides * M                     # u8 registers
+    return pruned, fingerprint
+
+
+def _prune_site(token) -> str:
+    from ..data.exchange import _ident_digest
+    return "prune:" + _ident_digest(token)[:10]
+
+
+def _record_verdict(mex, which: str, token, verdict: bool,
+                    rows: int, item_bytes: int, sides: int,
+                    frac: Optional[float],
+                    reason: str) -> bool:
+    """Ledger entry for one prune verdict (location/dup): the chosen
+    alternative, the rejected one's estimated cost, and the predicted
+    prune fraction — kept open for record_prune's audit join."""
+    from ..common import decisions as _decisions
+    led = _decisions.ledger_of(mex)
+    if led is not None:
+        W = getattr(mex, "num_workers", 1)
+        M = register_width(rows)
+        pruned, fp = _pays_est(rows, item_bytes, W, sides, M,
+                               frac if frac is not None else 0.0)
+        chosen = f"{which}:on" if verdict else f"{which}:off"
+        other = f"{which}:off" if verdict else f"{which}:on"
+        led.record("prune", _prune_site(token), chosen,
+                   predicted=frac, join=frac is not None,
+                   rejected=[(other, fp if verdict else pruned)],
+                   reason=reason, rows=int(rows), unit="frac",
+                   est_pruned_bytes=int(pruned),
+                   est_fingerprint_bytes=int(fp))
+    return verdict
 
 
 def auto_location_detect(mex, rows_global: int, item_bytes: int,
@@ -223,15 +270,22 @@ def auto_location_detect(mex, rows_global: int, item_bytes: int,
     learned site caps > padded upper bound)."""
     forced = location_mode()
     if forced is not None:
-        return forced
+        return _record_verdict(
+            mex, "location", token, forced, rows_global, item_bytes,
+            2, None, "THRILL_TPU_LOCATION_DETECT forced")
     if getattr(mex, "num_processes", 1) > 1:
-        return False                            # see module docstring
+        return _record_verdict(
+            mex, "location", token, False, rows_global, item_bytes,
+            2, None, "multi-controller: inputs not globally agreed")
 
     def compute():
         W = mex.num_workers
         M = register_width(rows_global)
-        return _pays(rows_global, item_bytes, W, sides=2, M=M,
-                     frac=prune_fraction(mex, token))
+        frac = prune_fraction(mex, token)
+        return _record_verdict(
+            mex, "location", token,
+            _pays(rows_global, item_bytes, W, sides=2, M=M, frac=frac),
+            rows_global, item_bytes, 2, frac, "cost model")
     return _sticky_decision(mex, "ld", token, compute)
 
 
@@ -241,15 +295,22 @@ def auto_dup_detect(mex, rows_global: int, item_bytes: int,
     globally-unique keys local instead of shuffling them."""
     forced = dup_mode()
     if forced is not None:
-        return forced
+        return _record_verdict(
+            mex, "dup", token, forced, rows_global, item_bytes, 1,
+            None, "THRILL_TPU_DUP_DETECT forced")
     if getattr(mex, "num_processes", 1) > 1:
-        return False
+        return _record_verdict(
+            mex, "dup", token, False, rows_global, item_bytes, 1,
+            None, "multi-controller: inputs not globally agreed")
 
     def compute():
         W = mex.num_workers
         M = register_width(rows_global)
-        return _pays(rows_global, item_bytes, W, sides=1, M=M,
-                     frac=prune_fraction(mex, token))
+        frac = prune_fraction(mex, token)
+        return _record_verdict(
+            mex, "dup", token,
+            _pays(rows_global, item_bytes, W, sides=1, M=M, frac=frac),
+            rows_global, item_bytes, 1, frac, "cost model")
     return _sticky_decision(mex, "dup", token, compute)
 
 

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..common import decisions as _decisions
 from ..common import faults
 from ..common import trace as _trace
 from ..common.config import (cap_cache_enabled, overlap_enabled,
@@ -156,10 +157,18 @@ def plan_seed(mex: MeshExec, kind: str, ident: Tuple):
     m = seeds.get(kind)
     if not m:
         return None
-    v = m.pop(_ident_digest(ident), None)
+    dg = _ident_digest(ident)
+    v = m.pop(dg, None)
     if v is not None:
         mex.stats_plan_store_hits = getattr(
             mex, "stats_plan_store_hits", 0) + 1
+        # decision ledger: a warm-start seed was consumed INSTEAD of a
+        # data-driven plan build — explain() shows where the plan
+        # store actually paid off (common/decisions.py)
+        led = _decisions.ledger_of(mex)
+        if led is not None:
+            led.record("store_seed", site="xchg:" + dg[:10],
+                       chosen=kind, reason="warm-start seed consumed")
     return v
 
 
@@ -605,6 +614,14 @@ def exchange_stream(shards: DeviceShards, dest_builder: Callable,
     cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
     if W > 1:
         count_plan_build(mex)
+        led = _decisions.ledger_of(mex)
+        if led is not None:
+            rec = led.record(
+                "xchg_strategy", "xchg:" + _ident_digest(cache_key)[:10],
+                "stream", reason="MixStream delivery requested",
+                items=int(S.sum()))
+            led.resolve(rec, (int(S.sum()) - int(np.trace(S)))
+                        * leaf_item_bytes(sorted_leaves))
 
     if W == 1:
         yield DeviceShards(mex, jax.tree.unflatten(treedef, sorted_leaves),
@@ -814,26 +831,36 @@ def _bytes_eq(mex: MeshExec) -> int:
     return _BYTES_EQ_MEASURED.get(platform, _BYTES_EQ_FALLBACK)
 
 
-def _skewed(S: np.ndarray, row_bytes: int, mex: MeshExec) -> bool:
-    """Does the measured cost model prefer the 1-factor schedule over
-    the single dense all_to_all for this send matrix?
+def _strategy_costs(mex: MeshExec, S: np.ndarray,
+                    row_bytes: int) -> Tuple[int, int, int]:
+    """(dense_bytes, onefactor_bytes, n_rounds): the estimated padded
+    fabric volume of each candidate plan for this send matrix — the
+    inputs of the dense-vs-1-factor choice, shared by :func:`_skewed`
+    and the decision ledger's ``xchg_strategy`` record.
 
     Rows entering the fabric: dense ships W slots of the global max per
-    worker; 1-factor ships each round's pair maximum (identity round is
-    a local scatter, no traffic). A sparse-but-balanced matrix (e.g. a
-    neighbor shift) saves nothing and stays on the single all_to_all; a
-    100:1 hot-key skew saves ~W x the padding and flips as soon as the
-    savings clear the per-round launch overhead."""
+    worker; 1-factor ships each round's pair maximum. Fabric rows
+    exclude self-traffic on BOTH sides: the dense plan's diagonal slot
+    and the 1-factor identity round are local scatters."""
     W = S.shape[0]
     M_dense = int(S.max())
     rounds = one_factor_rounds(mex)
     M_rounds = [max(int(S[np.arange(W), to].max()), 1) for to in rounds]
-    # fabric rows exclude self-traffic on BOTH sides: the dense plan's
-    # diagonal slot and the 1-factor identity round are local scatters
-    dense_rows = W * (W - 1) * M_dense
-    of_rows = W * sum(M_rounds)
-    saved = (dense_rows - of_rows) * max(row_bytes, 1)
-    return saved > len(rounds) * _bytes_eq(mex)
+    rb = max(row_bytes, 1)
+    return (W * (W - 1) * M_dense * rb, W * sum(M_rounds) * rb,
+            len(rounds))
+
+
+def _skewed(S: np.ndarray, row_bytes: int, mex: MeshExec) -> bool:
+    """Does the measured cost model prefer the 1-factor schedule over
+    the single dense all_to_all for this send matrix?
+
+    A sparse-but-balanced matrix (e.g. a neighbor shift) saves nothing
+    and stays on the single all_to_all; a 100:1 hot-key skew saves
+    ~W x the padding and flips as soon as the savings clear the
+    per-round launch overhead."""
+    dense_b, of_b, n_rounds = _strategy_costs(mex, S, row_bytes)
+    return dense_b - of_b > n_rounds * _bytes_eq(mex)
 
 
 def _dense_cap_ident(ident: Tuple, cap: int, treedef, sorted_leaves
@@ -940,7 +967,8 @@ def _optimistic_ok(mex: MeshExec, cap_ident: Tuple,
 
 
 def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
-                      smat, M_pad: int, out_cap: int, narrow=None):
+                      smat, M_pad: int, out_cap: int, narrow=None,
+                      ident: Tuple = ()):
     """The dense phase-B program(s): K row-range chunk dispatches over
     a shared output accumulator, all plan values derived IN-TRACE from
     the replicated [W, W] send matrix ``smat``.
@@ -979,6 +1007,35 @@ def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     n_leaves = len(sorted_leaves)
     item_bytes = leaf_item_bytes(sorted_leaves)
     K = _chunk_count(mex, W, M_pad, item_bytes)
+    led = _decisions.ledger_of(mex)
+    if led is not None:
+        site = "xchg:" + _ident_digest(ident)[:10]
+        vol = W * M_pad * item_bytes
+        # mirror _chunk_count's precedence exactly: the overlap kill
+        # switch wins over the env pin, and an unparseable pin falls
+        # through to the auto policy — the recorded reason must match
+        # the path actually taken
+        env_k = os.environ.get("THRILL_TPU_XCHG_CHUNKS")
+        try:
+            int(env_k)          # any parseable pin governs (clamped)
+            pinned = True
+        except (TypeError, ValueError):
+            pinned = False
+        led.record(
+            "xchg_chunks", site, str(K), predicted=vol,
+            reason=("bulk: overlap off" if not overlap_enabled()
+                    else "forced" if pinned
+                    else "bulk: volume below pipelining break-even"
+                    if K == 1 else "chunked: volume worth pipelining"))
+        if narrow is not None:
+            wide_b = W * (W - 1) * M_pad * item_bytes
+            led.record(
+                "xchg_narrow", site, "narrow",
+                predicted=W * (W - 1) * M_pad
+                * _narrow_item_bytes(sorted_leaves, narrow),
+                rejected=[("wide", wide_b)],
+                reason="learned integer ranges fit narrower dtypes",
+                leaves=sum(1 for s in narrow if s is not None))
     bounds = dense_range_bounds(M_pad, K)
     ranges = [(int(bounds[j]), int(bounds[j + 1])) for j in range(K)
               if bounds[j + 1] > bounds[j]]
@@ -1141,11 +1198,19 @@ def _exchange_optimistic(mex: MeshExec, treedef, sorted_dest,
     if range_mat is not None:
         narrow = _pack_degraded(
             _sticky_spec(mex, cap_ident, sorted_leaves))
+    # the optimistic-vs-synced decision: predicted = the cached output
+    # capacity the dispatch trusts; the actual need is only known at
+    # deferred-check time, where the audit joins (hit or miss)
+    dec = _decisions.record_of(
+        mex, "xchg_optimistic", "xchg:" + _ident_digest(ident)[:10],
+        "optimistic", predicted=out_cap,
+        rejected=[("synced", None)], unit="rows",
+        reason="capacity plan cached; host sync elided", m_pad=M_pad)
     with _trace.span_of(getattr(mex, "tracer", None), "exchange",
                         "optimistic", m_pad=M_pad, out_cap=out_cap):
         out_leaves, counts_dev, flag = _dispatch_chunked(
             mex, treedef, sorted_dest, sorted_leaves, send_mat, M_pad,
-            out_cap, narrow=narrow)
+            out_cap, narrow=narrow, ident=ident)
     tree = jax.tree.unflatten(treedef, out_leaves)
     shards = DeviceShards(mex, tree, counts_dev)
 
@@ -1158,6 +1223,12 @@ def _exchange_optimistic(mex: MeshExec, treedef, sorted_dest,
                           "cap_hit" if not overflowed
                           else "capacity_miss",
                           m_pad=M_pad, out_cap=out_cap)
+        # audit join: the truth the optimistic dispatch deferred — how
+        # many rows each worker actually had to receive vs the cached
+        # capacity it trusted (err = overprovision factor on a hit)
+        _decisions.resolve_of(
+            mex, dec, max(int(S.sum(axis=0).max()), 1),
+            verdict="hit" if not overflowed else "miss")
         if not overflowed:
             # the exchange is accounted HERE, not at dispatch: a miss
             # must count one (synced) exchange, not an optimistic one
@@ -1233,15 +1304,40 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     count_plan_build(mex)
     cap_ident = _dense_cap_ident(ident, cap, treedef, sorted_leaves)
     mode = resolve_mode(mex)
+    item_bytes = leaf_item_bytes(sorted_leaves)
+    # one cost evaluation serves both the skew verdict and the decision
+    # record, so the recorded estimates are EXACTLY the numbers the
+    # choice was made from (same math as _skewed)
+    dense_b, of_b, n_rounds = _strategy_costs(mex, S, item_bytes)
+    skew = mode == "dense" and dense_b - of_b > n_rounds * _bytes_eq(mex)
+    led = _decisions.ledger_of(mex)
+    if led is not None:
+        # the strategy choice, with the rejected plan's estimated cost
+        # — audited immediately against the true (unpadded) payload:
+        # err = how much padding the chosen plan ships per real byte
+        site = "xchg:" + _ident_digest(ident)[:10]
+        if mode == "ragged":
+            chosen, pred, rej, why = "ragged", (
+                (int(S.sum()) - int(np.trace(S))) * item_bytes), \
+                [("dense", dense_b)], "configured mode"
+        elif mode == "onefactor" or skew:
+            chosen, pred, rej = "onefactor", of_b, [("dense", dense_b)]
+            why = "skewed send matrix" if skew else "configured mode"
+        else:
+            chosen, pred, rej = "dense", dense_b, [("onefactor", of_b)]
+            why = "balanced send matrix"
+        rec = led.record("xchg_strategy", site, chosen, predicted=pred,
+                         rejected=rej, reason=why,
+                         items=int(S.sum()))
+        led.resolve(rec, (int(S.sum()) - int(np.trace(S)))
+                    * item_bytes)
     with _trace.span_of(getattr(mex, "tracer", None), "exchange",
                         "synced", mode=mode):
         if mode == "ragged":
             mex._xchg_plan[cap_ident] = "sync"
             return _exchange_ragged(mex, treedef, sorted_leaves, S,
                                     min_cap)
-        if mode == "onefactor" or (
-                mode == "dense"
-                and _skewed(S, leaf_item_bytes(sorted_leaves), mex)):
+        if mode == "onefactor" or skew:
             # a skew-flipped site stays synced: the dense-vs-1-factor
             # decision needs the host S, which the optimistic path
             # elides
@@ -1261,7 +1357,7 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
             mex.put_small(S.astype(np.int32), replicated=True)
         out_leaves, _counts_dev, _flag = _dispatch_chunked(
             mex, treedef, sorted_dest, sorted_leaves, smat, M_pad,
-            out_cap, narrow=narrow)
+            out_cap, narrow=narrow, ident=ident)
         tree = jax.tree.unflatten(treedef, out_leaves)
         return DeviceShards(mex, tree, new_counts)
 

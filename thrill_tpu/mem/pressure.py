@@ -193,8 +193,19 @@ class PressureMonitor:
         if hint is None:
             hint = getattr(fn, "_out_bytes", None)
         if hint is not None:
-            return in_bytes + int(hint)
-        return int(in_bytes * self.est_factor)
+            est = in_bytes + int(hint)
+        else:
+            est = int(in_bytes * self.est_factor)
+        if getattr(fn, "_out_bytes", None) is None:
+            # first (cold or hinted) estimate for this program: stash
+            # it for the decision ledger's predicted-vs-actual join at
+            # the dispatch choke point once the real output bytes are
+            # measured (parallel/mesh.py; common/decisions.py)
+            try:
+                fn._adm_est = (est, in_bytes)
+            except AttributeError:
+                pass               # bare stubs refusing attributes
+        return est
 
     # -- rung 1: admission ----------------------------------------------
     def admit(self, fn, args) -> None:

@@ -81,6 +81,11 @@ class _CountedJit:
         # the donating-twin back-pointer lets the OOM ladder re-dispatch
         # with donation disarmed
         self._out_bytes: Optional[int] = None
+        # (estimate, input_bytes) stashed by admission for the decision
+        # ledger's predicted-vs-actual join on the first measured call
+        # (common/decisions.py; plain attr — __getattr__ delegates
+        # unknown names to the jitted function, so it must exist here)
+        self._adm_est: Optional[Tuple[int, int]] = None
         self._donate_base: Optional["_CountedJit"] = None
         self._trace_label: Optional[str] = None
         functools.update_wrapper(self, jitted, updated=())
@@ -144,6 +149,23 @@ class _CountedJit:
             self._out_bytes = sum(
                 int(getattr(l, "nbytes", 0) or 0)
                 for l in jax.tree.leaves(out))
+            # decision-ledger join at the dispatch choke point: the
+            # admission cost model predicted this program's bytes
+            # before its first run; the measured output is the truth.
+            # THRILL_TPU_DECISIONS=0 pays exactly one attribute read
+            # plus one predicate here and allocates nothing (pinned by
+            # tests/common/test_decisions.py via RECORDS_CREATED).
+            led = mex.decisions
+            if led is not None and led.enabled \
+                    and self._adm_est is not None:
+                est, in_bytes = self._adm_est
+                self._adm_est = None
+                rec = led.record(
+                    "admission", site="jit:" + self._label(),
+                    chosen="admit", predicted=est,
+                    reason="first estimate for this program",
+                    in_bytes=in_bytes)
+                led.resolve(rec, in_bytes + self._out_bytes)
         rec = mex.loop_recorder
         if rec is not None:
             rec.on_call(self, args, kwargs, out)
@@ -255,6 +277,11 @@ class MeshExec:
         # = the dispatch choke point pays one attribute read plus one
         # predicate and allocates nothing
         self.tracer = None
+        # decision ledger (common/decisions.py), attached by the
+        # Context; same off-path contract as the tracer — None or
+        # THRILL_TPU_DECISIONS=0 means every plan-choice choke point
+        # pays one attribute read plus one predicate
+        self.decisions = None
         # per-Iterate reports (phase timings, replay hit rate) for
         # bench.py / tools/loop_report.py
         self.loop_reports: list = []
@@ -596,6 +623,15 @@ class MeshExec:
                             self.stats_plan_store_hits += 1
                         except (TypeError, ValueError):
                             pass
+                        else:
+                            led = self.decisions
+                            if led is not None and led.enabled:
+                                led.record(
+                                    "store_seed",
+                                    site="jit:" + target._label(),
+                                    chosen="out_bytes",
+                                    predicted=target._out_bytes,
+                                    reason="warm-start learned size")
             self._cache[key] = fn
         return fn
 

@@ -47,6 +47,9 @@ from ..common import faults
 
 STORE_VERSION = 1
 _FILE = "plans.json"
+#: the decision ledger's audited-accuracy summary persists NEXT TO the
+#: plan state it judges (common/decisions.py; Context.close writes it)
+_LEDGER_FILE = "decisions.json"
 
 # fired at load time: an armed fire makes THIS load read as corrupt —
 # the store degrades to empty (cold recompile), results stay exact
@@ -188,3 +191,17 @@ class PlanStore:
             self.logger.line(event="plan_store_save", path=self.file,
                              entries=sum(len(v)
                                          for v in entries.values()))
+
+    def save_ledger(self, summary: dict) -> None:
+        """Persist the decision ledger's accuracy summary beside
+        plans.json: per-kind predicted-vs-actual MAE plus the
+        worst-audited sites. Plain overwrite (no merge): the ledger is
+        a per-run audit report, not ratcheting plan state — the newest
+        run's verdict on the cost model is the one that matters."""
+        from ..vfs import file_io
+        path = self.path.rstrip("/") + "/" + _LEDGER_FILE
+        file_io.write_file_atomic(
+            path, json.dumps(summary, sort_keys=True).encode())
+        if self.logger is not None and self.logger.enabled:
+            self.logger.line(event="decision_ledger_save", path=path,
+                             decisions=summary.get("decisions", 0))

@@ -105,6 +105,7 @@ def render_html(events: List[dict]) -> str:
     device_xchg: dict = {}   # host -> ordered device-plane exchanges
     memory = []        # hbm_spill / hbm_restore / mem_negotiate / demotion
     faults = []        # fault_injected / retry / recovery / abort
+    decisions = []     # decision / decision_audit (common/decisions.py)
     t0 = min((e["ts"] for e in events), default=0)
     for e in events:
         t = (e["ts"] - t0) / 1e6
@@ -152,6 +153,8 @@ def render_html(events: List[dict]) -> str:
             loops.append((t, e))
         elif e.get("event") in ("checkpoint", "ckpt_restore", "resume"):
             ckpt.append((t, e))
+        elif e.get("event") in ("decision", "decision_audit"):
+            decisions.append(e)
         elif e.get("event") == "overall_stats":
             overall.append(e)
     if device_xchg:
@@ -213,6 +216,7 @@ td.hm {{ min-width: 3em; }}
 {_render_worker_lanes(exchanges, total)}
 {_render_memory_events(memory, total)}
 {_render_fused_dispatches(fused, overall)}
+{_render_decisions(decisions, overall)}
 {_render_service_jobs(jobs, overall, total)}
 {_render_loop_iterations(loops, overall)}
 {_render_checkpoint_events(ckpt, overall)}
@@ -262,6 +266,69 @@ def _render_fused_dispatches(fused, overall) -> str:
 {summary}
 <table><tr><th class=l>stage composition</th><th>ops</th>
 <th>dispatches</th><th>saved</th></tr>{''.join(rows)}</table>"""
+
+
+def _render_decisions(decisions, overall) -> str:
+    """Plan-observatory lane (common/decisions.py): chosen-strategy
+    counts per decision kind, the optimistic exchange's hit/heal
+    record, and the top-5 worst-audited sites by mean
+    |log2(predicted/actual)| — where the cost model lies the most."""
+    if not decisions:
+        return ""
+    chosen: dict = {}
+    hits = misses = 0
+    site_err: dict = {}
+    joined = 0
+    for e in decisions:
+        if e.get("event") == "decision":
+            key = (e.get("kind", "?"), e.get("chosen", "?"))
+            chosen[key] = chosen.get(key, 0) + 1
+            continue
+        joined += 1
+        if e.get("verdict") == "hit":
+            hits += 1
+        elif e.get("verdict") == "miss":
+            misses += 1
+        err = e.get("err_log2")
+        if err is not None:
+            se = site_err.setdefault(
+                (e.get("kind", "?"), e.get("site", "?")), [0, 0.0])
+            se[0] += 1
+            se[1] += abs(err)
+    rows = [f"<tr><td class=l>{html.escape(kind)}</td>"
+            f"<td class=l>{html.escape(str(ch))}</td><td>{n}</td></tr>"
+            for (kind, ch), n in sorted(chosen.items(),
+                                        key=lambda kv: -kv[1])]
+    n_dec = sum(chosen.values())
+    summary = (f"<p>{n_dec} decisions recorded, {joined} with joined "
+               f"actuals; optimistic-exchange audit: {hits} hits, "
+               f"{misses} misses healed</p>")
+    if overall:
+        acc = overall[-1].get("decision_accuracy") or {}
+        if isinstance(acc, dict) and acc:
+            summary += ("<p>accuracy (mean |log2 pred/actual|): "
+                        + ", ".join(f"{html.escape(str(k))}={v}"
+                                    for k, v in sorted(acc.items()))
+                        + "</p>")
+    worst = [(k, s, n, tot / n)
+             for (k, s), (n, tot) in site_err.items() if n]
+    worst.sort(key=lambda r: -r[3])
+    wrows = [f"<tr><td class=l>{html.escape(k)}</td>"
+             f"<td class=l>{html.escape(s)}</td><td>{n}</td>"
+             f"<td>{mae:.3f}</td></tr>"
+             for k, s, n, mae in worst[:5]]
+    wtable = ""
+    if wrows:
+        wtable = (f"<h3>worst-audited sites</h3>"
+                  f"<table><tr><th class=l>kind</th>"
+                  f"<th class=l>site</th><th>joins</th>"
+                  f"<th>mae log2</th></tr>{''.join(wrows)}</table>")
+    return f"""
+<h2>plan decisions (decision ledger)</h2>
+{summary}
+<table><tr><th class=l>kind</th><th class=l>chosen</th>
+<th>count</th></tr>{''.join(rows)}</table>
+{wtable}"""
 
 
 def _render_service_jobs(jobs, overall, total: float) -> str:
