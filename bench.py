@@ -393,6 +393,13 @@ def _run_bench() -> None:
              decisions_joined=int(press.get("decisions_joined", 0)))
     except Exception as e:  # observability lane never kills the line
         _set(cost_model_error=repr(e)[:200])
+    # adaptive planner (api/planner.py): how often a learned plan was
+    # invalidated and re-chosen after an audit/deferred-check lie, and
+    # how many re-choices actually changed the plan — 0/0 on a run
+    # whose learned stats held, so any nonzero value on a clean bench
+    # says the cost model's own inputs drifted mid-run
+    _set(planner_replans=int(press.get("planner_replans", 0)),
+         planner_switch_count=int(press.get("planner_switches", 0)))
     # overlapped-exchange data plane (data/exchange.py): run-wide
     # overlap fraction, capacity-plan cache hit rate, and the
     # bytes-on-wire baseline for the shrink-the-wire ROADMAP item
@@ -809,6 +816,7 @@ def _serve_metric(ctx) -> dict:
 
         lat: list = []
         waits: list = []
+        choices: list = []
         errors: list = []
         lock = threading.Lock()
 
@@ -827,6 +835,7 @@ def _serve_metric(ctx) -> dict:
                 with lock:
                     lat.append(time.perf_counter() - t0)
                     waits.append(fut.queue_wait_s)
+                    choices.append(fut.plan_decisions)
 
         threads = [threading.Thread(target=client, args=(i,), daemon=True)
                    for i in range(clients)]
@@ -850,6 +859,17 @@ def _serve_metric(ctx) -> dict:
             "queue_depth_peak": int(stats.get("queue_depth_peak", 0)),
             "plan_store_hits": int(stats.get("plan_store_hits", 0)),
             "plan_builds": int(stats.get("plan_builds", 0)),
+            # plan choices the decision ledger recorded per served job
+            # (mean/max across the lane's jobs) and re-optimizations
+            # the adaptive planner fired while serving — steady-state
+            # serving should trend toward 0 choices per job (every
+            # plan cached or seeded) and 0 replans
+            "serve_plan_choices_per_job": round(
+                sum(choices) / len(choices), 2) if choices else 0.0,
+            "serve_plan_choices_max": int(max(choices)) if choices
+            else 0,
+            "serve_planner_replans": int(
+                stats.get("planner_replans", 0)),
         }
     except Exception as e:  # secondary metric never kills the line
         return {"serve_error": repr(e)[:200]}

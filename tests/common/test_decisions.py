@@ -214,6 +214,12 @@ def test_explain_wordcount_snapshot():
 # ----------------------------------------------------------------------
 
 def test_store_skip_decision_on_multiprocess_mesh(tmp_path):
+    """Multi-process meshes now warm-start via rank-0 broadcast
+    (ISSUE 12); the loud store_skip remains ONLY when no host control
+    plane spans the controllers (nothing to broadcast over) — this
+    fake topology (2 controllers, trivial 1-host group) is exactly
+    that case. The broadcast path itself is pinned on a real
+    2-process mesh in tests/net/test_distributed.py."""
     mex = MeshExec(num_workers=2)
     mex.num_processes = 2          # fake a 2-controller topology
     ctx = Context(mex, Config(plan_store=str(tmp_path / "plans")))
@@ -223,7 +229,7 @@ def test_store_skip_decision_on_multiprocess_mesh(tmp_path):
                  if d["kind"] == "store_skip"]
         assert skips, "no store_skip decision recorded"
         assert skips[0]["chosen"] == "cold"
-        assert "desynchronize" in skips[0]["reason"]
+        assert "broadcast" in skips[0]["reason"]
     finally:
         mex.num_processes = 1      # close() runs single-process paths
         ctx.close()

@@ -1,0 +1,64 @@
+"""Child process body for the 2-process plan-store broadcast test.
+
+Launched by tests/net/test_distributed.py with:
+  python plan_store_child.py <coordinator_addr> <rank> <nproc>
+and THRILL_TPU_PLAN_STORE pointing at a shared store directory. Rank 0
+loads the store and broadcasts the entries over the host control plane
+(api/context.py), so every rank installs identical seeds; a warm
+launch re-runs the known pipeline with ``plan_builds == 0`` and every
+exchange dispatched optimistically. Prints one RESULT line for the
+parent to compare across ranks and across the cold/warm launches.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+from thrill_tpu.common.platform import force_cpu_platform
+
+force_cpu_platform()
+
+import numpy as np  # noqa: E402
+
+from thrill_tpu.api import RunDistributed  # noqa: E402
+
+
+def _kv(x):
+    return (x % 11, x)
+
+
+def _add(a, b):
+    return a + b
+
+
+def job(ctx):
+    # WordCount-shaped device pipeline: hash-partition exchange (a
+    # synced plan build when cold) + auto pre-shuffle verdict (a cost
+    # model evaluation when cold) — both kinds of data-driven plan
+    # builds a warm restart must run ZERO of
+    pairs = sorted((int(k), int(v)) for k, v in ctx.Distribute(
+        np.arange(128, dtype=np.int64)).Map(_kv).ReducePair(
+            _add).AllGather())
+    st = ctx.overall_stats()
+    return {
+        "pairs": [list(p) for p in pairs],
+        "plan_builds": int(st["plan_builds"]),
+        "plan_store_hits": int(st["plan_store_hits"]),
+        "exchanges": int(st["exchanges"]),
+        "exchanges_overlapped": int(st["exchanges_overlapped"]),
+        "cap_cache_misses": int(st["cap_cache_misses"]),
+    }
+
+
+def main() -> None:
+    coordinator, rank, nproc = (sys.argv[1], int(sys.argv[2]),
+                                int(sys.argv[3]))
+    out = RunDistributed(job, coordinator_address=coordinator,
+                         num_processes=nproc, process_id=rank)
+    print("RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

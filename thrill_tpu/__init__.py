@@ -26,6 +26,7 @@ __version__ = "0.1.0"
 #: thrill::DIA the same way); resolved lazily so importing thrill_tpu
 #: stays light
 _API_NAMES = ("Bind", "Context", "DIA", "FieldReduce", "PipelineError",
+              "Planner",
               "Run", "RunDistributed", "RunLocalMock", "RunLocalTests",
               "RunSupervised",
               "Concat", "InnerJoin", "Iterate", "Merge", "Union", "Zip",

@@ -4,4 +4,5 @@ from .context import (Context, PipelineError, Run,  # noqa: F401
 from .dia import DIA, Concat, InnerJoin, Merge, Union, Zip, ZipWindow  # noqa: F401
 from .functors import FieldReduce  # noqa: F401
 from .loop import Iterate  # noqa: F401
+from .planner import Planner  # noqa: F401
 from .stack import Bind  # noqa: F401

@@ -135,6 +135,21 @@ class Context:
         self.decisions = DecisionLedger(logger=self.logger,
                                         tracer=self.tracer)
         self.mesh_exec.decisions = self.decisions
+        # adaptive cost-based planner (api/planner.py): one model over
+        # the learned plan state that CHOOSES — exchange strategy and
+        # chunk count, optimistic-vs-synced dispatch, pre-shuffle
+        # prune verdicts, proactive fusion splits under the HBM
+        # admission estimate — and RE-OPTIMIZES when the decision
+        # ledger's audit joins reveal a learned stat lied.
+        # THRILL_TPU_PLANNER=0 restores the per-site heuristics
+        # exactly (no Planner constructed, every call site takes its
+        # legacy branch).
+        from .planner import Planner, planner_enabled
+        self.planner = None
+        if planner_enabled():
+            self.planner = Planner(self.mesh_exec)
+            self.mesh_exec.planner = self.planner
+            self.decisions.audit_hook = self.planner.on_audit
         # live metrics endpoint (common/metrics.py): Prometheus text on
         # THRILL_TPU_METRICS_PORT from a daemon thread; unset = off
         from ..common.metrics import maybe_start as _metrics_start
@@ -199,27 +214,62 @@ class Context:
         # overhead) unless THRILL_TPU_PLAN_STORE is set.
         self.plan_store = None
         if self.config.plan_store and self.mesh_exec.num_processes > 1:
-            # multi-controller meshes get NO plan store: seeds install
-            # per-rank with no cross-rank agreement, and an asymmetric
-            # read (one rank cold, one seeded; a corrupt file on one
-            # host) would make the ranks plan DIFFERENT exchange
-            # programs for the same collective slot. Cold planning is
-            # symmetric by construction. Rank-0 broadcast of loaded
-            # entries is the ROADMAP path to lifting this.
-            import sys
-            print("thrill_tpu.service: THRILL_TPU_PLAN_STORE ignored "
-                  "on a multi-process mesh (per-rank seeding would "
-                  "desynchronize SPMD plans); recompiling cold",
-                  file=sys.stderr)
-            # first-class record, not just a log line: explain() shows
-            # WHY warm-start didn't happen (ISSUE 11 satellite)
-            if self.decisions.enabled:
-                self.decisions.record(
-                    "store_skip", "plan_store", "cold",
-                    rejected=[("warm-start", None)],
-                    reason="multi-process mesh: per-rank seeding "
-                           "would desynchronize SPMD plans",
-                    path=self.config.plan_store)
+            # multi-controller meshes: RANK 0 reads the store and
+            # BROADCASTS the entries over the host control plane, so
+            # every rank installs the IDENTICAL seeds — the
+            # asymmetric-read hazard (one rank cold, one seeded; a
+            # corrupt file on one host) that used to force the loud
+            # skip cannot arise, because only one read ever happens.
+            # Rank 0 keeps the store handle (it is the single writer
+            # at close; the learned state derives from replicated plan
+            # inputs, so one rank's copy is the cluster's copy).
+            # Without a spanning host control plane there is still no
+            # agreement channel — keep the loud skip.
+            if self.net.num_workers == self.mesh_exec.num_processes:
+                from ..service.plan_store import (PlanStore,
+                                                  install_entries)
+                entries = None
+                if self.host_rank == 0:
+                    self.plan_store = PlanStore(self.config.plan_store,
+                                                logger=self.logger)
+                    entries = self.plan_store.load()
+                entries = self.net.broadcast(entries, origin=0)
+                seeded = install_entries(self.mesh_exec, entries or {})
+                # every rank now provably holds identical seeds, and
+                # state learned from here derives from the replicated
+                # send matrix: the optimistic exchange path is safe on
+                # this mesh (data/exchange.py _optimistic_ok)
+                self.mesh_exec._plan_seed_symmetric = True
+                if self.logger.enabled:
+                    self.logger.line(event="plan_store_load",
+                                     path=self.config.plan_store,
+                                     entries=seeded, broadcast=True)
+                if self.decisions.enabled:
+                    # the store_skip decision of old is now a
+                    # store_broadcast one: explain() shows the warm
+                    # start happened and how it stayed symmetric
+                    self.decisions.record(
+                        "store_broadcast", "plan_store",
+                        "warm-start" if seeded else "cold",
+                        rejected=[("per-rank-read", None)],
+                        reason="rank-0 load broadcast over ctx.net "
+                               "keeps SPMD plan seeds symmetric",
+                        entries=seeded, path=self.config.plan_store)
+            else:
+                import sys
+                print("thrill_tpu.service: THRILL_TPU_PLAN_STORE "
+                      "ignored on a multi-process mesh without a "
+                      "spanning host control plane (no channel to "
+                      "broadcast rank 0's entries); recompiling cold",
+                      file=sys.stderr)
+                if self.decisions.enabled:
+                    self.decisions.record(
+                        "store_skip", "plan_store", "cold",
+                        rejected=[("warm-start", None)],
+                        reason="multi-process mesh without a host "
+                               "control plane: rank-0 entries cannot "
+                               "be broadcast",
+                        path=self.config.plan_store)
         elif self.config.plan_store:
             from ..service.plan_store import PlanStore
             self.plan_store = PlanStore(self.config.plan_store,
@@ -592,6 +642,12 @@ class Context:
             "tenant_spills": self.hbm.tenant_spill_count,
             "plan_builds": mex.stats_plan_builds,
             "plan_store_hits": mex.stats_plan_store_hits,
+            # adaptive planner (api/planner.py): sites whose learned
+            # plan was invalidated and re-chosen after an audit/
+            # deferred-check lie, and re-choices that actually changed
+            # the plan — 0/0 on a run whose learned stats held
+            **(self.planner.stats() if self.planner is not None else
+               {"planner_replans": 0, "planner_switches": 0}),
             # plan observatory (common/decisions.py): how many plan
             # choices were recorded, how many have joined actuals, and
             # the per-kind accuracy ledger (mean |log2 pred/actual|) —
@@ -954,9 +1010,11 @@ class Context:
                 from ..common import faults as _faults
                 _faults.note("recovery", what="service.close_failed",
                              error=repr(e)[:200])
-        # plan_store is only ever constructed on single-process meshes
-        # (see __init__; multi-process needs the ROADMAP rank-0
-        # entry broadcast first), so no rank guard is needed here
+        # single-writer by construction: on multi-process meshes only
+        # rank 0 holds a store handle (it loaded and broadcast the
+        # entries at __init__), so this save needs no rank guard —
+        # and rank 0's learned state derives from replicated plan
+        # inputs, so its copy is the cluster's copy
         if self.plan_store is not None:
             try:
                 self.plan_store.save(self.mesh_exec)

@@ -315,6 +315,9 @@ class FusionPlan:
             return mex.smap(f, nd + nb, in_specs=in_specs), holder
 
         fn, h = mex.cached(key, build)
+        split = self._proactive_split(fn, srcs, segs)
+        if split is not None:
+            return split
         args = ([s.counts_device() for s in srcs]
                 + [l for f_ in src_flat for l in f_[0]]
                 + [l for bf in bound_flat for l in bf[0]])
@@ -422,6 +425,80 @@ class FusionPlan:
         plan._no_finalize = True
         return plan.execute()
 
+    def _proactive_split(self, fn, srcs, segs):
+        """Planner-chosen fusion split point under the HBM admission
+        estimate (api/planner.py): a row-local single-source chain
+        whose estimated input+output bytes cannot fit under the
+        watermark at ANY spill level executes as K row-range
+        sub-dispatches up front — the same sub-plan the OOM ladder's
+        rung 3 would reach, chosen BEFORE the dispatch instead of
+        after a retry budget's worth of failed allocations. Returns
+        the split result, or None (dispatch whole — the normal path).
+        Eligibility mirrors ``_execute_degraded`` exactly: what the
+        reactive rung could not split, the planner must not either."""
+        from .planner import planner_of
+        mex = self.mex
+        pl = planner_of(mex)
+        pres = mex.pressure
+        if pl is None or pres is None or not pres.enabled \
+                or self._no_split or self.head is not None \
+                or len(srcs) != 1 \
+                or getattr(mex, "num_processes", 1) > 1 \
+                or not all(s.row_local and s.finalize is None
+                           for s in segs):
+            return None
+        from ..mem import pressure as _pressure
+        if not _pressure.retry_enabled():
+            return None
+        src = srcs[0]
+        src_bytes = sum(int(getattr(l, "nbytes", 0) or 0)
+                        for l in jax.tree.leaves(src.tree))
+        out_est = getattr(fn, "_out_bytes", None)
+        if out_est is None:
+            out_est = (src_bytes if not any(s.expands for s in segs)
+                       else int(src_bytes * pres.est_factor))
+        est = src_bytes + int(out_est)
+        k = pl.fusion_split_k(est, src.cap)
+        if k is None:
+            return None
+        try:
+            out = self._execute_split(src, k)
+        except Exception as e:
+            if not _pressure.is_oom_error(e):
+                raise
+            # even the split chunks exhausted HBM: dispatch whole and
+            # let the reactive ladder (rungs 2-4) own the escalation —
+            # the planner's choice is advisory, never the last word
+            faults.note("recovery", what="mem.split_oom",
+                        ops=[s.label for s in segs],
+                        error=repr(e)[:200])
+            return None
+        # recorded AFTER the split succeeded: a fallback-to-whole must
+        # not leave a ledger record claiming split:K for a dispatch
+        # that actually ran whole (the whole path records its own
+        # `fusion` decision). Deliberately NOT a planner_switches tick:
+        # a chain that stays inadmissible re-splits on every execute —
+        # that is a standing choice, not a re-optimization.
+        ops_label = "+".join(s.label for s in segs)[:80]
+        led = _decisions.ledger_of(mex)
+        if led is not None:
+            led.record("fusion_split", "fuse:" + ops_label,
+                       f"split:{k}", predicted=est // k,
+                       rejected=[("whole", est)],
+                       reason="admission estimate exceeds the HBM "
+                              "watermark at any spill level",
+                       ops=ops_label, k=k,
+                       dia_ids=[s.dia_id for s in segs])
+        pres.segment_splits += 1
+        faults.note("segment_split", k=k,
+                    ops=[s.label for s in segs], cap=src.cap,
+                    proactive=True)
+        faults.note("recovery", what="mem.segment_split_proactive",
+                    _quiet=True)
+        _trace.instant_of(getattr(mex, "tracer", None), "mem",
+                          "segment_split", k=k, proactive=True)
+        return out
+
     # -- memory-pressure degradation (mem/pressure.py rungs 3-4) --------
     def _execute_degraded(self, exc: BaseException):
         """The stitched dispatch exhausted the OOM-retry budget:
@@ -443,11 +520,7 @@ class FusionPlan:
         pres = _pressure._monitor_for(mex)
         src = self.sources[0]
         if all(s.row_local and s.finalize is None for s in segs):
-            try:
-                k = int(os.environ.get("THRILL_TPU_SPLIT_K", "4") or 4)
-            except ValueError:
-                k = 4
-            k = max(2, min(k, src.cap))
+            k = _pressure.split_k(src.cap)
             if src.cap > 1:
                 try:
                     out = self._execute_split(src, k)

@@ -107,6 +107,59 @@ from .dia_base import DIABase
 _F_REPLAY = faults.declare("api.loop.replay")
 
 
+# ----------------------------------------------------------------------
+# plan-state persistence: loop-capture tape metadata
+# ----------------------------------------------------------------------
+# The capture iteration's expensive parts are the ANALYSIS — the
+# per-output-leaf taint verification re-traces call programs as jaxprs
+# — and, for loops that can never capture, the futile capture attempts
+# themselves (a full carry copy plus a recorder pass each, twice,
+# before the miss streak gives up). Both outcomes are pure functions
+# of the tape: which compiled programs ran (their MeshExec cache keys)
+# and how their arguments/outputs were wired. Persisting that
+# metadata in the plan store lets a warm restart skip the work:
+#
+# * a loop whose tape previously analyzed clean re-validates by digest
+#   (same program keys, same wiring, same fetched plan reads) and
+#   skips the taint re-traces — the tape is trusted because the
+#   analysis inputs are provably identical;
+# * a loop that previously REJECTED capture runs plain from iteration
+#   1, skipping the capture probes entirely.
+#
+# Stale metadata degrades LOUDLY: a digest mismatch logs
+# ``event=loop_seed_stale`` and runs the full fresh analysis — the
+# seed can cost nothing but the log line. Correctness-neutral like
+# every plan-store value: a trusted tape still re-records THIS run's
+# calls; only the verification that the recorded wiring is replayable
+# is reused, never the wiring itself.
+
+
+def export_plan_state(mex) -> dict:
+    """Per-loop tape metadata (plan keys + wiring + donation twins) as
+    digest maps — the plan store's on-disk form (service/plan_store.py
+    ``loop_tape`` kind)."""
+    from ..data.exchange import _ident_digest, merge_unconsumed_seeds
+    return merge_unconsumed_seeds(mex, {
+        "loop_tape": {_ident_digest(k): v for k, v in
+                      getattr(mex, "_loop_tapes", {}).items()},
+    })
+
+
+def import_plan_state(mex, state: dict) -> int:
+    from ..data.exchange import install_plan_seeds
+    return install_plan_seeds(mex, state, ("loop_tape",))
+
+
+def _note_tape(mex, token, meta: Optional[dict]) -> None:
+    """Remember this loop's capture outcome for export."""
+    if meta is None:
+        return
+    tapes = getattr(mex, "_loop_tapes", None)
+    if tapes is None:
+        tapes = mex._loop_tapes = {}
+    tapes[token] = meta
+
+
 def replay_enabled() -> bool:
     """THRILL_TPU_LOOP_REPLAY=0 restores plain per-iteration planning."""
     return os.environ.get("THRILL_TPU_LOOP_REPLAY", "1") not in (
@@ -410,23 +463,95 @@ class _LeafTaint:
 # the plan
 # ----------------------------------------------------------------------
 
+def _exact_ident(x) -> bool:
+    """Does ``_canon(x)`` carry full content identity? Mirrors
+    _canon's branches: tuples recurse; callables are exact when their
+    token embeds a bytecode hash (i.e. they have ``__code__``);
+    everything else is exact unless its repr is address-bearing (which
+    _canon degrades to a bare class name two distinct objects would
+    share)."""
+    if isinstance(x, tuple):
+        return all(_exact_ident(e) for e in x)
+    if callable(x) and not isinstance(x, type):
+        if getattr(x, "__qualname__", None):
+            return getattr(x, "__code__", None) is not None
+        # falls through to _canon's repr branch below
+    return " at 0x" not in repr(x)
+
+
+def _tape_meta(calls: List[_Call], plan_reads, carry_out,
+               n_carry: int) -> Optional[dict]:
+    """The tape's persistable identity: per-call compiled-program keys
+    (MeshExec cache-key digests) plus a wiring digest over argument
+    refs, fetched plan reads and the carry mapping — exactly the
+    inputs the capture analysis is a pure function of, so two tapes
+    with equal metadata provably analyze the same. None when any call
+    lacks a stable cache key (uncached program: no cross-process
+    identity)."""
+    import hashlib
+
+    from ..data.exchange import _canon
+    keys = []
+    exact = True
+    for c in calls:
+        key = getattr(c.fn, "cache_key", None)
+        if key is None:
+            return None
+        # _canon degrades some reprs to identities WITHOUT content
+        # hashes (address-bearing objects -> bare class, callables
+        # without __code__ -> bare qualname) — correctness-neutral for
+        # capacities (they ratchet and heal) but NOT for trusting a
+        # taint verdict: two distinct programs could digest equal.
+        # _exact_ident walks the key structurally (mirroring _canon's
+        # branches), so ordinary keys — strings, ints, dtypes,
+        # treedefs, user functions incl. lambdas/locals (their tokens
+        # carry bytecode+consts+closure hashes) — stay exact.
+        if not _exact_ident(key):
+            exact = False
+        keys.append(hashlib.sha1(_canon(key).encode()).hexdigest())
+
+    def rsig(ref):
+        if ref[0] == "const":
+            return "c"
+        if ref[0] == "tree":
+            return ("t", _canon(ref[1]),
+                    tuple(rsig(s) for s in ref[2]))
+        return ref                     # ("carry", s) / ("val", (i, j))
+
+    wiring = repr((
+        tuple(tuple(rsig(r) for r in c.arg_refs) for c in calls),
+        tuple(sorted(plan_reads)),
+        tuple("c" if r[0] == "const" else r for r in carry_out),
+        n_carry))
+    return {"capture": True, "calls": keys, "exact": exact,
+            "wiring": hashlib.sha1(wiring.encode()).hexdigest()}
+
+
 class LoopPlan:
     """A replayable tape over one loop iteration.
 
     ``carry_out``: per carry-leaf reference — ("val", (i, j)) into the
     live tape or ("carry", s) passthrough. ``counts`` (shards mode):
     the iteration-invariant host counts of the carry, or None when the
-    counts thread through the tape as a device leaf."""
+    counts thread through the tape as a device leaf. ``seed``: the
+    plan store's remembered tape metadata for this loop — a digest
+    match skips the taint re-traces (trusted tape), a mismatch is
+    STALE and runs the full fresh analysis."""
 
     def __init__(self, mex, calls: List[_Call], carry_out: List[Tuple],
                  n_carry: int, plan_reads: Optional[set] = None,
-                 name: Optional[str] = None) -> None:
+                 name: Optional[str] = None,
+                 seed: Optional[dict] = None) -> None:
         self.mex = mex
         self.calls = calls
         self.carry_out = carry_out
         self.n_carry = n_carry
         self.name = name
         self.plan_reads = plan_reads or set()
+        self.seed = seed if isinstance(seed, dict) else None
+        self.seeded = False            # trusted warm-restart metadata
+        self.seed_stale = False        # seed present but mismatched
+        self.meta: Optional[dict] = None
         # set by _analyze when the tape cannot be replayed safely
         self.invalid: Optional[str] = None
         # shards-mode carry counts: the iteration-invariant host counts
@@ -451,6 +576,24 @@ class LoopPlan:
                                          and dep[ref[1][0]]):
                     dep[i] = True
                     break
+        # tape identity (plan-store loop_tape metadata): computed over
+        # the ORIGINAL calls/wiring/plan-reads — exactly the inputs
+        # the taint verification below is a pure function of
+        self.meta = _tape_meta(calls, self.plan_reads, self.carry_out,
+                               self.n_carry)
+        trusted = False
+        if self.seed is not None:
+            if self.meta is not None and self.seed.get("capture") \
+                    and self.meta["exact"] and self.seed.get("exact") \
+                    and self.seed.get("calls") == self.meta["calls"] \
+                    and self.seed.get("wiring") == self.meta["wiring"]:
+                # warm restart: this exact tape (same compiled-program
+                # keys, same wiring, same fetched plan reads) analyzed
+                # clean before — skip the per-output-leaf taint
+                # re-traces, the capture iteration's expensive half
+                trusted = self.seeded = True
+            else:
+                self.seed_stale = True
         # host plan logic that read a CARRY-DEPENDENT value during
         # capture (data-dependent exchange send matrix, a size
         # agreement) would be frozen by the tape at iteration-1 values
@@ -462,13 +605,15 @@ class LoopPlan:
         # from a fixed key column riding next to the changing ranks —
         # per-CALL taint would reject it, per-leaf taint captures it).
         # Refinement failures fall back to the per-call verdict.
-        taint = _LeafTaint(calls, dep)
-        for i, j in self.plan_reads:
-            if dep[i] and taint.pair_dep(i, j):
-                self.invalid = ("host plan logic read a "
-                                "carry-dependent value during capture "
-                                "(data-dependent exchange plan?)")
-                break
+        if not trusted:
+            taint = _LeafTaint(calls, dep)
+            for i, j in self.plan_reads:
+                if dep[i] and taint.pair_dep(i, j):
+                    self.invalid = ("host plan logic read a "
+                                    "carry-dependent value during "
+                                    "capture (data-dependent exchange "
+                                    "plan?)")
+                    break
         # liveness (backward from the carry outputs)
         needed = [False] * n
         stack = [ref[1][0] for ref in self.carry_out if ref[0] == "val"]
@@ -517,6 +662,10 @@ class LoopPlan:
                 out.append(ref)
         self.calls = live
         self.carry_out = out
+        # donation positions are recomputed per capture (cheap, pure
+        # python over the refs) — the wiring digest in the metadata
+        # fully determines them, so a trusted seed's donation twins
+        # provably match what this analysis just derived
         self._mark_donations()
         # live calls must not pin the capture iteration's HBM: their
         # recorded outputs are never read again (invariant producers'
@@ -892,6 +1041,33 @@ def Iterate(ctx, body: Callable, carry, n: int, *, name: str = "loop",
     # recorder pass per iteration; two strikes and the rest of the
     # loop runs plain (one retry tolerates a first iteration whose
     # carry shape was still stabilizing)
+    # plan-store loop-tape metadata: the remembered capture outcome
+    # for this (name, carry-signature) loop — a clean tape's digests
+    # let the capture skip its taint re-traces, a known-uncapturable
+    # loop skips the capture probes entirely
+    tape_token = _tape_token(name, dia_mode, state, body) \
+        if can_replay else None
+    tape_seed = None
+    seed_mode: Optional[str] = None
+    last_miss: Dict[str, str] = {}
+    if tape_token is not None:
+        from ..data.exchange import plan_seed as _plan_seed
+        tape_seed = _plan_seed(mex, "loop_tape", tape_token)
+        if isinstance(tape_seed, dict) \
+                and tape_seed.get("capture") is False:
+            # warm restart: this loop previously rejected capture for
+            # a deterministic reason — run plain from iteration 1,
+            # skipping the probes (each a full carry copy + recorder
+            # pass). LOUD: logged with the remembered reason; if the
+            # body changed enough to capture now, its carry signature
+            # almost always changed too (fresh token, no seed).
+            miss_streak = 2
+            seed_mode = "nocapture"
+            _note_tape(mex, tape_token, tape_seed)
+            if log.enabled:
+                log.line(event="loop_seed_nocapture", loop=name,
+                         reason=str(tape_seed.get("reason", "?"))[:200])
+            tape_seed = None
     report = {"name": name, "iters": n - start, "captures": 0, "replays": 0,
               "fori_iters": 0, "fallbacks": 0, "capture_s": 0.0,
               "replay_s": 0.0, "calls": 0, "pruned": 0,
@@ -909,7 +1085,9 @@ def Iterate(ctx, body: Callable, carry, n: int, *, name: str = "loop",
             try:
                 if can_replay and miss_streak < 2:
                     state, plan = _capture(ctx, run_body, state,
-                                           name=name, it=i)
+                                           name=name, it=i,
+                                           seed=tape_seed,
+                                           info=last_miss)
                     if plan is not None:
                         miss_streak = 0
                         mex.stats_loop_plan_builds += 1
@@ -917,8 +1095,21 @@ def Iterate(ctx, body: Callable, carry, n: int, *, name: str = "loop",
                         report["calls"] = len(plan.calls)
                         report["pruned"] = (plan.pruned_invariant
                                             + plan.pruned_dead)
+                        if plan.seeded:
+                            seed_mode = "tape"
+                        elif plan.seed_stale:
+                            seed_mode = "stale"
+                        if tape_token is not None:
+                            _note_tape(mex, tape_token, plan.meta)
                     else:
                         miss_streak += 1
+                        if miss_streak >= 2 and tape_token is not None:
+                            # deterministic reject: remember it so a
+                            # warm restart skips the capture probes
+                            _note_tape(mex, tape_token, {
+                                "capture": False,
+                                "reason": last_miss.get("reason",
+                                                        "?")[:200]})
                 else:
                     state = run_body(state)
             finally:
@@ -1027,6 +1218,11 @@ def Iterate(ctx, body: Callable, carry, n: int, *, name: str = "loop",
 
     report["donated_bytes"] = (mex.stats_loop_donated_bytes
                                - report.pop("donated_bytes0"))
+    if seed_mode is not None:
+        # plan-store tape-metadata outcome: "tape" (trusted, analysis
+        # skipped), "stale" (digest mismatch, fresh analysis),
+        # "nocapture" (known-uncapturable, probes skipped)
+        report["seed"] = seed_mode
     mex.loop_reports.append(report)
     if log.enabled:
         log.line(event="loop_done", **{k: (round(v, 6)
@@ -1037,13 +1233,43 @@ def Iterate(ctx, body: Callable, carry, n: int, *, name: str = "loop",
     return state
 
 
-def _capture(ctx, run_body, state, name="loop", it=0):
+def _tape_token(name: str, dia_mode: bool, state,
+                body) -> Optional[Tuple]:
+    """Plan-store identity of one loop's tape: name + the BODY's
+    canonical identity (module.qualname + bytecode hash — two loops
+    sharing the default name must not share a tape record, or an
+    uncapturable sibling's ``capture: False`` would force a capturable
+    one to run plain forever) + carry signature (leaf dtypes/shapes,
+    capacity, counts mode). None when the carry cannot be signed
+    (host storage, conversion failure)."""
+    from ..data.exchange import _canon
+    try:
+        body_id = _canon(body)
+        if dia_mode:
+            if not isinstance(state, DeviceShards):
+                return None
+            sig = (_leaf_sig(jax.tree.leaves(state.tree)), state.cap,
+                   state._counts_host is not None)
+        else:
+            sig = (_leaf_sig(jax.tree.leaves(state)),)
+    except Exception:
+        return None
+    return ("loop_tape", name, bool(dia_mode), body_id, sig)
+
+
+def _capture(ctx, run_body, state, name="loop", it=0, seed=None,
+             info=None):
     """Run one body iteration with the tape recorder installed.
-    Returns (next_state, LoopPlan or None)."""
+    Returns (next_state, LoopPlan or None). ``seed`` is the plan
+    store's remembered tape metadata (LoopPlan trusts a digest match);
+    ``info`` (dict) receives the miss reason for the caller's own
+    metadata bookkeeping."""
     mex = ctx.mesh_exec
     log = ctx.logger
 
     def miss(reason, out_state):
+        if info is not None:
+            info["reason"] = reason
         if log.enabled:
             log.line(event="loop_capture_miss", loop=name, iter=it,
                      reason=reason)
@@ -1148,7 +1374,11 @@ def _capture(ctx, run_body, state, name="loop", it=0):
                         "dispatch stream (eager host math in the "
                         "body?)", out_state)
     plan = LoopPlan(mex, rec.calls, carry_out, n_carry, name=name,
-                    plan_reads=rec.plan_reads)
+                    plan_reads=rec.plan_reads, seed=seed)
+    if plan.seed_stale and log.enabled:
+        # stale plan-store metadata: LOUD, and the full fresh
+        # analysis just ran — the seed cost nothing but this line
+        log.line(event="loop_seed_stale", loop=name, iter=it)
     if plan.invalid is not None:
         return miss(plan.invalid, out_state)
     if host_counts is not None:
@@ -1158,6 +1388,7 @@ def _capture(ctx, run_body, state, name="loop", it=0):
                  pruned_invariant=plan.pruned_invariant,
                  pruned_dead=plan.pruned_dead,
                  fori=plan.fori_eligible(),
+                 seeded=plan.seeded or None,
                  donatable=sum(len(c.donate_pos) for c in plan.calls))
     return out_state, plan
 

@@ -130,13 +130,14 @@ class InnerJoinNode(DIABase):
         ld = self.location_detection
         if ld is None:
             # host path: exact local row counts feed the cost model
-            # (auto resolves OFF in multi-controller runs — local
-            # counts are not globally agreed, see core/preshuffle.py)
+            # (local_rows: multi-controller runs all-reduce them to
+            # the global count before deciding, core/preshuffle.py)
             from ...core import preshuffle
             rows = (sum(len(l) for l in left.lists)
                     + sum(len(l) for l in right.lists))
             ld = preshuffle.auto_location_detect(
-                mex, rows, 32, ("join_host", self.lkey, self.rkey))
+                mex, rows, 32, ("join_host", self.lkey, self.rkey),
+                local_rows=True)
         if ld and W > 1:
             from ...core.location_detection import (LocationDetection,
                                                     _MASK)

@@ -581,12 +581,13 @@ class ReduceNode(DIABase):
         dup = self.dup_detection
         if dup is None:
             # host path: exact local entry counts feed the cost model
-            # (auto resolves OFF multi-controller — local counts are
-            # not globally agreed, core/preshuffle.py)
+            # (local_rows: multi-controller runs all-reduce them to
+            # the global count before deciding, core/preshuffle.py)
             from ...core import preshuffle
             rows = sum(len(h) for h in pre_hashes)
             dup = preshuffle.auto_dup_detect(
-                mex, rows, 32, ("reduce_dup_host", self.token))
+                mex, rows, 32, ("reduce_dup_host", self.token),
+                local_rows=True)
         if dup and W > 1:
             from ...core import duplicate_detection as dd
             hash_lists = pre_hashes

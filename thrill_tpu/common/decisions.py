@@ -158,6 +158,11 @@ class DecisionLedger:
             else enabled
         self.logger = logger
         self.tracer = tracer
+        # audit subscriber (api/planner.py Planner.on_audit): called
+        # with every record whose actual just joined, so the adaptive
+        # planner can act on predictions that turned out to be lies.
+        # None (no planner / THRILL_TPU_PLANNER=0) = pure observatory.
+        self.audit_hook = None
         cap = ring_capacity() if ring is None else ring
         self.records: collections.deque = collections.deque(
             maxlen=cap if cap > 0 else 1)
@@ -269,6 +274,16 @@ class DecisionLedger:
                        verdict=rec.verdict,
                        err_log2=(round(rec.err_log2, 3)
                                  if rec.err_log2 is not None else None))
+        hook = self.audit_hook
+        if hook is not None:
+            # the planner's re-optimization trigger; a raising hook
+            # must not break the audit join it rides on (planning is
+            # perf, the join is observability — neither may take down
+            # the pipeline that produced the actual)
+            try:
+                hook(rec)
+            except Exception:
+                pass
 
     def resolve_site(self, kind: str, site: str, actual,
                      verdict: Optional[str] = None) -> bool:

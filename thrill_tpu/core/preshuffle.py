@@ -25,10 +25,16 @@ the rows it is expected to prune:
 Decisions are STICKY per (mesh, site): flipping mid-run would recompile
 the destination programs for nothing. Env overrides force either way:
 THRILL_TPU_LOCATION_DETECT=0/1 and THRILL_TPU_DUP_DETECT=0/1 (unset =
-auto). Multi-controller runs resolve auto to OFF unless the inputs of
-the decision are globally agreed (the device path's padded caps are;
-host-path local counts are not) — a per-process flip would desync the
-collective schedule.
+auto). Multi-controller runs AGREE the decision inputs over the host
+control plane (local counts all-reduce to the global sum, learned
+fractions to their mean) before deciding, so every controller computes
+the same verdict; only meshes WITHOUT a spanning host control plane
+still resolve auto to OFF (a per-process flip would desync the
+collective schedule). With the adaptive planner attached
+(api/planner.py) the verdict is the planner's — the same inequality,
+owned by the one cost model — and an audited prune fraction that
+contradicts the prediction re-evaluates the verdict immediately
+instead of waiting out the periodic resync window.
 
 Register fingerprints are PLAN traffic, like the send-count all_gather:
 they are deliberately not counted in ``bytes_on_wire`` (which measures
@@ -66,6 +72,55 @@ def _env_mode(name: str) -> Optional[bool]:
     if v in (None, "", "auto"):
         return None
     return v not in ("0", "off", "false")
+
+
+def _planner_of(mex):
+    """The mesh's adaptive planner (api/planner.py) when live, else
+    None — attribute reads only, the ledger_of pattern."""
+    pl = getattr(mex, "planner", None)
+    if pl is not None and pl.enabled:
+        return pl
+    return None
+
+
+def _agree_net(mex):
+    """The host control plane when it actually spans this mesh's
+    controllers (ctx.net, wired as ``mex.host_net``), else None — the
+    gate for cross-controller agreement of decision inputs."""
+    net = getattr(mex, "host_net", None)
+    if net is None:
+        return None
+    if getattr(net, "num_workers", 1) != getattr(mex, "num_processes",
+                                                 1):
+        return None
+    return net
+
+
+def _agreed_rows(mex, rows: int, local_rows: bool) -> Optional[int]:
+    """Cross-controller agreement of the cost model's row estimate:
+    LOCAL counts (host-storage paths hold only their own workers'
+    items) all-reduce by SUM into the global count; nominally-global
+    estimates all-reduce by MAX (defensive: every rank then provably
+    decides from one number). None = no host control plane — the
+    caller must resolve OFF, a per-process flip would desync the
+    collective schedule. This is a COLLECTIVE: it runs only inside
+    the sticky decision's (lockstep) compute/resync."""
+    net = _agree_net(mex)
+    if net is None:
+        return None
+    op = (lambda a, b: a + b) if local_rows else max
+    return int(net.all_reduce(int(rows), op))
+
+
+def _agreed_fraction(mex, frac: float) -> float:
+    """Cross-controller mean of the learned prune fraction (fractions
+    are learned rank-locally; the mean is deterministic and identical
+    on every rank). Callers hold a live ``_agree_net``."""
+    net = _agree_net(mex)
+    if net is None:
+        return frac
+    vals = [float(v) for v in net.all_gather(float(frac))]
+    return sum(vals) / len(vals)
 
 
 def location_mode() -> Optional[bool]:
@@ -180,7 +235,39 @@ def _sticky_decision(mex, kind: str, token, compute) -> bool:
             entry = (bool(compute()), 1)
     else:
         verdict, uses = entry
-        if uses % _DECIDE_RESYNC_EVERY == 0:
+        # replan marks are RANK-LOCAL (an audit's observed fraction
+        # derives from per-rank counts on the host paths), so honoring
+        # one on a multi-controller mesh could send a single rank into
+        # the agreement collectives inside compute() while its peers
+        # return the cached verdict — the exact desync the lockstep
+        # periodic resync below avoids (every rank re-evaluates at the
+        # same use count). Multi-controller lies wait for the resync.
+        pl = _planner_of(mex) \
+            if getattr(mex, "num_processes", 1) == 1 else None
+        why = pl.take_replan(_prune_site(token)) if pl is not None \
+            else None
+        if why is not None:
+            # audit-driven re-optimization (api/planner.py): the
+            # observed prune fraction contradicted the prediction by
+            # more than the threshold — re-evaluate NOW from the
+            # freshly observed fraction (record_prune already folded
+            # it in; no decay, this is a correction not a probe)
+            # instead of riding the stale verdict out to the periodic
+            # resync window
+            count_plan_build(mex)
+            new = bool(compute())
+            if new != verdict:
+                pl.note_switch()
+                from ..common import decisions as _decisions
+                pl.record_replan(
+                    _decisions.ledger_of(mex), _prune_site(token),
+                    f"{kind}:{'on' if new else 'off'}",
+                    predicted=None,
+                    rejected=[(f"{kind}:{'on' if verdict else 'off'}",
+                               None)],
+                    reason=why)
+            verdict = new
+        elif uses % _DECIDE_RESYNC_EVERY == 0:
             _decay_fraction(mex, token)
             count_plan_build(mex)
             verdict = bool(compute())
@@ -263,34 +350,65 @@ def _record_verdict(mex, which: str, token, verdict: bool,
     return verdict
 
 
+def _auto_verdict(mex, which: str, kind: str, token, rows_global: int,
+                  item_bytes: int, sides: int,
+                  local_rows: bool) -> bool:
+    """Shared sticky cost-model verdict for both pre-shuffle filters.
+
+    Multi-controller runs AGREE the decision inputs over the host
+    control plane before deciding (ROADMAP "globally-agreed pruning
+    inputs"): local row counts all-reduce to the global count, learned
+    fractions to their mean — every controller then provably computes
+    the same verdict from the same numbers, so ``auto`` no longer has
+    to resolve OFF. The OFF fallback remains ONLY for meshes without a
+    spanning host control plane (a per-process flip would desync the
+    collective schedule). The agreement collective runs only inside
+    the sticky decision's lockstep compute/resync, never per call."""
+    def compute():
+        W = mex.num_workers
+        rows = rows_global
+        why = "cost model"
+        if getattr(mex, "num_processes", 1) > 1:
+            agreed = _agreed_rows(mex, rows, local_rows)
+            if agreed is None:
+                return _record_verdict(
+                    mex, which, token, False, rows, item_bytes, sides,
+                    None, "multi-controller: no host control plane to "
+                          "agree decision inputs")
+            rows = agreed
+            frac = _agreed_fraction(mex, prune_fraction(mex, token))
+            why = "cost model (inputs agreed across controllers)"
+        else:
+            frac = prune_fraction(mex, token)
+        M = register_width(rows)
+        pl = _planner_of(mex)
+        verdict = (pl.prune_verdict(rows, item_bytes, W, sides, M,
+                                    frac)
+                   if pl is not None
+                   else _pays(rows, item_bytes, W, sides, M, frac))
+        return _record_verdict(mex, which, token, verdict, rows,
+                               item_bytes, sides, frac, why)
+    return _sticky_decision(mex, kind, token, compute)
+
+
 def auto_location_detect(mex, rows_global: int, item_bytes: int,
-                         token) -> bool:
+                         token, local_rows: bool = False) -> bool:
     """Cost-model verdict for the join location filter (device path).
     ``rows_global`` is the caller's best row estimate (exact counts >
-    learned site caps > padded upper bound)."""
+    learned site caps > padded upper bound); ``local_rows=True`` marks
+    a per-process partial count (host-storage paths) that must
+    all-reduce by sum before a multi-controller decision."""
     forced = location_mode()
     if forced is not None:
         return _record_verdict(
             mex, "location", token, forced, rows_global, item_bytes,
             2, None, "THRILL_TPU_LOCATION_DETECT forced")
-    if getattr(mex, "num_processes", 1) > 1:
-        return _record_verdict(
-            mex, "location", token, False, rows_global, item_bytes,
-            2, None, "multi-controller: inputs not globally agreed")
-
-    def compute():
-        W = mex.num_workers
-        M = register_width(rows_global)
-        frac = prune_fraction(mex, token)
-        return _record_verdict(
-            mex, "location", token,
-            _pays(rows_global, item_bytes, W, sides=2, M=M, frac=frac),
-            rows_global, item_bytes, 2, frac, "cost model")
-    return _sticky_decision(mex, "ld", token, compute)
+    return _auto_verdict(mex, "location", "ld", token, rows_global,
+                         item_bytes, 2, local_rows)
 
 
 def auto_dup_detect(mex, rows_global: int, item_bytes: int,
-                    token) -> bool:
+                    token, local_rows: bool = False) -> bool:
     """Cost-model verdict for ReduceByKey duplicate detection: keep
     globally-unique keys local instead of shuffling them."""
     forced = dup_mode()
@@ -298,20 +416,8 @@ def auto_dup_detect(mex, rows_global: int, item_bytes: int,
         return _record_verdict(
             mex, "dup", token, forced, rows_global, item_bytes, 1,
             None, "THRILL_TPU_DUP_DETECT forced")
-    if getattr(mex, "num_processes", 1) > 1:
-        return _record_verdict(
-            mex, "dup", token, False, rows_global, item_bytes, 1,
-            None, "multi-controller: inputs not globally agreed")
-
-    def compute():
-        W = mex.num_workers
-        M = register_width(rows_global)
-        frac = prune_fraction(mex, token)
-        return _record_verdict(
-            mex, "dup", token,
-            _pays(rows_global, item_bytes, W, sides=1, M=M, frac=frac),
-            rows_global, item_bytes, 1, frac, "cost model")
-    return _sticky_decision(mex, "dup", token, compute)
+    return _auto_verdict(mex, "dup", "dup", token, rows_global,
+                         item_bytes, 1, local_rows)
 
 
 def join_rows_estimate(mex, left, right, token_l, token_r) -> Tuple[int,

@@ -376,6 +376,16 @@ def _ex_cumsum(x):
     return jnp.cumsum(x) - x
 
 
+def _planner_of(mex):
+    """The mesh's adaptive planner (api/planner.py) when live, else
+    None — attribute reads only (no api import: the planner object is
+    attached by the Context, exactly like the decision ledger)."""
+    pl = getattr(mex, "planner", None)
+    if pl is not None and pl.enabled:
+        return pl
+    return None
+
+
 def resolve_mode(mex: MeshExec) -> str:
     """Exchange mode precedence: env THRILL_TPU_EXCHANGE, then the
     mesh's configured mode, then dense. Single source of truth for
@@ -574,7 +584,8 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
         cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
         cap_ident = _dense_cap_ident(cache_key, cap, treedef,
                                      sorted_leaves)
-        caps = _optimistic_ok(mex, cap_ident, min_cap)
+        caps = _optimistic_ok(mex, cap_ident, min_cap, ident=cache_key,
+                              counts=shards._counts_host)
         if caps is not None:
             return _exchange_optimistic(
                 mex, treedef, sorted_dest, sorted_leaves, send_mat,
@@ -880,7 +891,20 @@ def _chunk_count(mex: MeshExec, W: int, M_pad: int,
     ``THRILL_TPU_XCHG_CHUNKS=K`` pins K; the auto policy chunks only
     exchanges whose padded volume is worth pipelining (chunking a
     kilobyte shuffle pays K-1 extra dispatches for nothing — and every
-    chunk shape is its own compiled program)."""
+    chunk shape is its own compiled program). With the adaptive
+    planner attached the choice is the planner's; the policy itself is
+    :func:`chunk_policy` either way (ONE implementation — the
+    planner-on and planner-off paths cannot drift)."""
+    pl = _planner_of(mex)
+    if pl is not None:
+        return pl.chunk_count(W, M_pad, item_bytes)
+    return chunk_policy(W, M_pad, item_bytes)
+
+
+def chunk_policy(W: int, M_pad: int, item_bytes: int) -> int:
+    """The phase-B chunking policy: overlap kill switch, env pin, then
+    the measured break-even auto rule. Shared verbatim by the legacy
+    per-site branch and the adaptive planner (api/planner.py)."""
     if not overlap_enabled():
         return 1
     env = os.environ.get("THRILL_TPU_XCHG_CHUNKS")
@@ -907,8 +931,9 @@ _NARROW_MIN_BYTES = 1 << 15
 _CAP_RESYNC_EVERY = 32
 
 
-def _optimistic_ok(mex: MeshExec, cap_ident: Tuple,
-                   min_cap: int) -> Optional[Tuple[int, int]]:
+def _optimistic_ok(mex: MeshExec, cap_ident: Tuple, min_cap: int,
+                   ident: Tuple = (),
+                   counts=None) -> Optional[Tuple[int, int]]:
     """Cached (M_pad, out_cap) when this site may dispatch phase B
     WITHOUT the host sync, else None.
 
@@ -918,12 +943,32 @@ def _optimistic_ok(mex: MeshExec, cap_ident: Tuple,
     is recording (captures keep today's synced semantics so tapes bake
     the same plan they always did), and single-controller (a deferred
     per-process heal would desynchronize the collective schedule —
-    same reasoning as the memory ladder's multi-process guard)."""
+    same reasoning as the memory ladder's multi-process guard).
+
+    With the adaptive planner attached (api/planner.py), the cached
+    plan additionally survives the planner's verdict: a site marked
+    for re-optimization (an audit or deferred check caught the learned
+    state lying), or host-known input ``counts`` proving the cached
+    capacities CANNOT hold (a guaranteed miss), re-chooses the synced
+    plan instead — the stale sticky state is dropped so the re-plan
+    ratchets from the current data, exactly the plan a cold run would
+    build."""
     if not cap_cache_enabled():
         return None
     if mex.loop_recorder is not None:
         return None
-    if getattr(mex, "num_processes", 1) > 1:
+    if getattr(mex, "num_processes", 1) > 1 \
+            and not getattr(mex, "_plan_seed_symmetric", False):
+        # per-process optimism on a multi-controller mesh is safe only
+        # when every rank provably holds the SAME plan state — true
+        # once the rank-0 store broadcast installed identical seeds
+        # (api/context.py sets _plan_seed_symmetric; in-process state
+        # learned after that derives from the replicated send matrix,
+        # so it stays symmetric). The deferred heal is then lockstep:
+        # the overflow flag is a function of the replicated send
+        # matrix alone (narrow-range verdicts are pmax'd), and checks
+        # drain at the same program points on every controller.
+        # Without that guarantee, keep the synced plan every time.
         return None
     if resolve_mode(mex) != "dense":
         return None
@@ -944,13 +989,42 @@ def _optimistic_ok(mex: MeshExec, cap_ident: Tuple,
     cache = getattr(mex, "_sticky_caps", None)
     if cache is None:
         cache = mex._sticky_caps = {}
+    seeded = False
     caps = cache.get(cap_ident)
     if caps is None:
         caps = _seeded_caps(mex, cap_ident)
         if caps is not None:
             cache[cap_ident] = caps
+            seeded = True
     if not caps or len(caps) != 2 or caps[1] < min_cap:
         return None
+    pl = _planner_of(mex)
+    if pl is not None:
+        site = "xchg:" + _ident_digest(ident)[:10]
+        if seeded:
+            pl.note_seeded(site)
+        ok, why = pl.optimistic_verdict(site, caps, counts,
+                                        mex.num_workers)
+        if not ok:
+            # re-optimization: invalidate the learned state the lie
+            # lives in so the forced synced plan re-ratchets from the
+            # current data, and put the switched decision (with both
+            # plans' costs) where explain() shows it
+            cache.pop(cap_ident, None)
+            getattr(mex, "_sticky_ranges", {}).pop(cap_ident, None)
+            pl.note_switch()
+            need = None
+            if counts is not None:
+                need = -(-int(np.asarray(counts).sum())
+                         // max(mex.num_workers, 1))
+            pl.record_replan(
+                _decisions.ledger_of(mex), site, "synced",
+                predicted=need,
+                rejected=[("optimistic", float(caps[1]))],
+                reason=why, unit="rows")
+            faults.note("recovery", what="planner.replan",
+                        site=site, why=why[:120], _quiet=True)
+            return None
     # periodic re-plan: the dense-vs-1-factor skew decision needs the
     # host S, which steady-state hits elide — without this, skew that
     # develops AFTER warmup (and stays inside the monotone caps) would
@@ -1237,6 +1311,15 @@ def _exchange_optimistic(mex: MeshExec, treedef, sorted_dest,
             mex.stats_exchanges_overlapped += 1
             account_traffic(mex, S, item_bytes, overlapped=True,
                             cap_hit=True)
+            pl = _planner_of(mex)
+            if pl is not None and pl.skew_developed(S, item_bytes):
+                # the observed send matrix now prefers the 1-factor
+                # schedule: mark the site so the NEXT exchange re-syncs
+                # and re-chooses immediately instead of riding the
+                # cached dense plan out to the periodic resync window
+                pl.mark_replan(
+                    "xchg:" + _ident_digest(ident)[:10],
+                    "deferred check observed a skewed send matrix")
             return None
         # capacity (or narrow-range) miss: the cached plan truncated —
         # re-run phases host+B from the retained phase-A output (the
@@ -1307,9 +1390,19 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     item_bytes = leaf_item_bytes(sorted_leaves)
     # one cost evaluation serves both the skew verdict and the decision
     # record, so the recorded estimates are EXACTLY the numbers the
-    # choice was made from (same math as _skewed)
+    # choice was made from (same math as _skewed). With the adaptive
+    # planner attached the CHOICE is the planner's (api/planner.py
+    # exchange_strategy — the same inequality, owned by the one cost
+    # model); without it the legacy per-site form decides.
     dense_b, of_b, n_rounds = _strategy_costs(mex, S, item_bytes)
-    skew = mode == "dense" and dense_b - of_b > n_rounds * _bytes_eq(mex)
+    pl = _planner_of(mex)
+    if pl is not None:
+        chosen_mode, _, _, _why = pl.exchange_strategy(S, item_bytes,
+                                                       mode)
+        skew = mode == "dense" and chosen_mode == "onefactor"
+    else:
+        skew = (mode == "dense"
+                and dense_b - of_b > n_rounds * _bytes_eq(mex))
     led = _decisions.ledger_of(mex)
     if led is not None:
         # the strategy choice, with the rejected plan's estimated cost

@@ -111,6 +111,19 @@ def retry_enabled() -> bool:
         "0", "off", "false")
 
 
+def split_k(cap: int) -> int:
+    """THRILL_TPU_SPLIT_K clamped to [2, cap]: the rung-3 row-range
+    sub-dispatch count. ONE implementation shared by the reactive
+    ladder (api/fusion.py _execute_degraded) and the adaptive
+    planner's proactive split (api/planner.py), so the two paths
+    always produce the same sub-plan."""
+    try:
+        k = int(os.environ.get("THRILL_TPU_SPLIT_K", "4") or 4)
+    except ValueError:
+        k = 4
+    return max(2, min(k, cap))
+
+
 def detect_hbm_budget() -> int:
     """Per-device HBM budget in bytes; 0 = unknown (admission off).
 
@@ -206,6 +219,16 @@ class PressureMonitor:
             except AttributeError:
                 pass               # bare stubs refusing attributes
         return est
+
+    def inadmissible(self, est_bytes: int) -> bool:
+        """True when ``est_bytes`` cannot fit under the watermark at
+        ANY spill level — the estimate exceeds the watermark fraction
+        of the whole budget, so no amount of cold-shard eviction can
+        admit it. The adaptive planner (api/planner.py) uses this as
+        the cost model's HBM term: such a plan is chosen around
+        (proactive fusion split) instead of dispatched into a certain
+        rung-2/3 escalation."""
+        return self.enabled and est_bytes > self.budget * self.watermark
 
     # -- rung 1: admission ----------------------------------------------
     def admit(self, fn, args) -> None:

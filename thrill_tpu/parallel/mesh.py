@@ -282,6 +282,10 @@ class MeshExec:
         # THRILL_TPU_DECISIONS=0 means every plan-choice choke point
         # pays one attribute read plus one predicate
         self.decisions = None
+        # adaptive cost-based planner (api/planner.py), attached by
+        # the Context; None or THRILL_TPU_PLANNER=0 means every plan
+        # choice takes its legacy per-site heuristic branch exactly
+        self.planner = None
         # per-Iterate reports (phase timings, replay hit rate) for
         # bench.py / tools/loop_report.py
         self.loop_reports: list = []

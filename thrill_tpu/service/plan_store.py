@@ -56,13 +56,32 @@ _LEDGER_FILE = "decisions.json"
 _F_CORRUPT = faults.declare("service.plan_store.corrupt")
 
 #: entry kinds and their owners (data/exchange.py, core/preshuffle.py,
-#: parallel/mesh.py)
+#: parallel/mesh.py, api/loop.py)
 _KINDS = ("caps", "plan", "ranges", "prune_decisions", "prune_history",
-          "out_bytes")
+          "out_bytes", "loop_tape")
 
 
 def _crc(entries: dict) -> int:
     return zlib.crc32(json.dumps(entries, sort_keys=True).encode())
+
+
+def install_entries(mex, entries: dict) -> int:
+    """Install loaded store entries into a MeshExec's lazy seed
+    tables; returns how many arrived. Shared by :meth:`PlanStore.attach`
+    (this process read the file) and the Context's multi-process path
+    (rank 0 read it and BROADCAST the entries over the host control
+    plane, so every rank installs the identical seeds —
+    api/context.py)."""
+    from ..api import loop
+    from ..core import preshuffle
+    from ..data import exchange
+    n = exchange.import_plan_state(mex, entries)
+    n += preshuffle.import_plan_state(mex, entries)
+    n += loop.import_plan_state(mex, entries)
+    ob = entries.get("out_bytes")
+    if isinstance(ob, dict) and hasattr(mex, "import_learned_sizes"):
+        n += mex.import_learned_sizes(ob)
+    return n
 
 
 class PlanStore:
@@ -121,15 +140,7 @@ class PlanStore:
         number of entries imported. The seeds are consumed lazily at
         each site's first lookup (data/exchange.py plan_seed), so an
         entry for a pipeline this process never runs costs nothing."""
-        from ..core import preshuffle
-        from ..data import exchange
-        entries = self.load()
-        n = exchange.import_plan_state(mex, entries)
-        n += preshuffle.import_plan_state(mex, entries)
-        ob = entries.get("out_bytes")
-        if isinstance(ob, dict) and hasattr(mex, "import_learned_sizes"):
-            n += mex.import_learned_sizes(ob)
-        return n
+        return install_entries(mex, self.load())
 
     # -- writing --------------------------------------------------------
     def save(self, mex) -> None:
@@ -162,11 +173,13 @@ class PlanStore:
                 fcntl.flock(lk, fcntl.LOCK_UN)
 
     def _save_locked(self, mex) -> None:
+        from ..api import loop
         from ..core import preshuffle
         from ..data import exchange
         from ..vfs import file_io
         entries = exchange.export_plan_state(mex)
         entries.update(preshuffle.export_plan_state(mex))
+        entries.update(loop.export_plan_state(mex))
         if hasattr(mex, "export_learned_sizes"):
             entries["out_bytes"] = mex.export_learned_sizes()
         prev = self.load()

@@ -79,6 +79,9 @@ class JobFuture:
         self.queue_wait_s = 0.0
         self.run_s = 0.0
         self.generation: Optional[int] = None
+        # plan choices the decision ledger recorded while THIS job
+        # ran (the serve lane's plan-choices-per-job metric)
+        self.plan_decisions = 0
         self._event = threading.Event()
         self._result: Any = None
         self._error: Optional[BaseException] = None
@@ -417,6 +420,22 @@ class Scheduler:
         fut.queue_wait_s = t0 - job.t_submit
         from ..api.context import PipelineError
         err: Optional[BaseException] = None
+        # plan choices recorded during this job (decision ledger delta
+        # across the run — the dispatcher serializes jobs, so the
+        # delta is unambiguously this job's)
+        led = getattr(ctx, "decisions", None)
+        dec0 = (sum(led.kind_counts.values())
+                if led is not None and led.enabled else None)
+
+        def settle_decisions() -> None:
+            # must run BEFORE fut._finish: result() unblocks the
+            # client the instant the future's event is set, and a
+            # client reading fut.plan_decisions right after result()
+            # must not race the dispatcher's bookkeeping
+            if dec0 is not None:
+                fut.plan_decisions = (sum(led.kind_counts.values())
+                                      - dec0)
+
         tr = getattr(ctx, "tracer", None)
         sp = None
         if tr is not None and tr.enabled:
@@ -441,6 +460,7 @@ class Scheduler:
                              tenant=job.tenant)
                 out = job.fn(ctx)
             fut.run_s = time.monotonic() - t0
+            settle_decisions()
             fut._finish(result=out)
         except PipelineError as e:
             # scoped failure: the Context healed; only THIS job failed
@@ -449,6 +469,7 @@ class Scheduler:
             fut.run_s = time.monotonic() - t0
             with self._cv:
                 self.jobs_failed += 1
+            settle_decisions()
             fut._finish(error=e)
         except BaseException as e:
             # unrecoverable abort (dead peer, failed heal): the
@@ -458,6 +479,7 @@ class Scheduler:
             fut.run_s = time.monotonic() - t0
             with self._cv:
                 self.jobs_failed += 1
+            settle_decisions()
             fut._finish(error=e)
             self._poison(e)
         finally:
@@ -477,6 +499,8 @@ class Scheduler:
                      generation=fut.generation,
                      queue_wait_s=round(fut.queue_wait_s, 4),
                      run_s=round(fut.run_s, 4),
+                     plan_decisions=(fut.plan_decisions
+                                     if dec0 is not None else None),
                      error=(repr(err)[:200] if err is not None
                             else None))
 
