@@ -176,6 +176,19 @@ class BlockStore {
     blocks_.erase(it);
   }
 
+  // Is the block servable from RAM (resident, or its spill write is
+  // still in flight with the request buffer alive)? 1 = RAM, 0 = a
+  // Get would fault in from disk, -1 = unknown id. Drives the
+  // surgical merge readahead (data/file.py prefetch_reader): only
+  // disk-resident blocks are worth a background fetch.
+  int Resident(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = blocks_.find(id);
+    if (it == blocks_.end()) return -1;
+    Block& b = it->second;
+    return (!b.data.empty() || b.size == 0 || b.req) ? 1 : 0;
+  }
+
   int64_t MemUsage() {
     std::lock_guard<std::mutex> lk(mu_);
     return mem_usage_;
@@ -378,6 +391,10 @@ int bs_unpin(void* s, int64_t id) {
 
 void bs_drop(void* s, int64_t id) {
   static_cast<BlockStore*>(s)->Drop(id);
+}
+
+int bs_resident(void* s, int64_t id) {
+  return static_cast<BlockStore*>(s)->Resident(id);
 }
 
 int64_t bs_mem_usage(void* s) {

@@ -7,7 +7,13 @@
 # Runs the `chaos`-marked tests (tests/api/test_chaos.py): N_SEEDS
 # randomly composed pipelines, each under a random arming of the
 # in-process injection sites (common/faults.py) plus HBM pressure,
-# asserting EXACT results and clean recovery. The socket-level sites
+# asserting EXACT results and clean recovery. The out-of-core tier's
+# sites ride the same sweep: vfs.prefetch (background readahead fails
+# -> degrade to demand reads, never wrong data) and
+# data.spill.writeback (blockpool eviction writer degrades to RAM
+# residency here; the em-spill poison contract — async flush failure
+# fails the job with its root cause, no silent loss — is swept by the
+# chaos-marked cases in tests/api/test_out_of_core.py). The socket-level sites
 # (net.tcp.*, net.multiplexer.*, net.dispatcher.timer) are swept by
 # tests/net/test_fault_injection.py, included here too, and the
 # loop-replay site (api.loop.replay — a failed replayed dispatch must
@@ -38,7 +44,7 @@ N_SEEDS=${1:-25}
 shift || true
 
 TARGETS=(tests/api/test_chaos.py tests/net/test_fault_injection.py
-         tests/api/test_loop.py)
+         tests/api/test_loop.py tests/api/test_out_of_core.py)
 if [[ "${CHAOS_KILL:-0}" == "1" ]]; then
   TARGETS+=(tests/api/test_checkpoint.py)
 fi

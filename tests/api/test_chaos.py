@@ -43,7 +43,15 @@ _CHAOS_SITES = ("api.mesh.dispatch", "data.blockstore.put",
                 # the same shuffle sites (full-width fallback, always
                 # correct); net.wire.compress needs host frames and
                 # gets its chaos from the fault matrix
-                "data.exchange.pack")
+                "data.exchange.pack",
+                # out-of-core tier (ISSUE 13): background readahead
+                # degrades to demand reads (vfs sources, merge/restore
+                # block prefetch); the write-behind site degrades to
+                # RAM residency on the blockpool eviction writer (the
+                # em-spill POISON contract is pinned by the fault
+                # matrix + tests/api/test_out_of_core.py — these
+                # pipelines never host-EM-spill)
+                "vfs.prefetch", "data.spill.writeback")
 
 import os
 

@@ -129,7 +129,7 @@ def _seed_params(n, keep):
             for s in range(n)]
 
 
-@pytest.mark.parametrize("seed", _seed_params(12, keep=2))
+@pytest.mark.parametrize("seed", _seed_params(12, keep=1))
 def test_fuzz_pipeline_matches_python_model(seed):
     rng = np.random.default_rng(seed)
     data = rng.integers(-50, 200,
@@ -141,7 +141,7 @@ def test_fuzz_pipeline_matches_python_model(seed):
         assert got == expect, (seed, W, ops)
 
 
-@pytest.mark.parametrize("seed", _seed_params(8, keep=3))
+@pytest.mark.parametrize("seed", _seed_params(8, keep=2))
 def test_fuzz_two_chain_zip_join(seed):
     """Two independently transformed chains combined by Zip (index
     realignment exchange) or InnerJoin (hash exchange + sort-merge-
@@ -393,7 +393,7 @@ def test_fuzz_disjoint_window_partial_fn(seed):
         ctx.close()
 
 
-@pytest.mark.parametrize("seed", _seed_params(6, keep=2))
+@pytest.mark.parametrize("seed", _seed_params(6, keep=1))
 def test_fuzz_merge_sample_hll(seed):
     """Merge of sorted DIAs (quantile-split presorted exchange),
     Sample(k) (hypergeometric budget split) and HyperLogLog (register

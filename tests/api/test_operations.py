@@ -301,34 +301,42 @@ def test_inner_join_device():
     sweep(job)
 
 
-def test_inner_join_all_ones_keys():
-    """Regression: keys encoding to all-ones words (uint64.max / int64
-    max patterns) must not collide with the padding sentinel and create
-    phantom pairs (ADVICE r1: join.py validity-word fix)."""
+def _all_ones_keys_job(ctx):
+    """Regression job: keys encoding to all-ones words (uint64.max /
+    int64 max patterns) must not collide with the padding sentinel and
+    create phantom pairs (ADVICE r1: join.py validity-word fix)."""
     big = np.iinfo(np.int64).max
+    left = ctx.Distribute(np.array([1, 2, 3], dtype=np.int64)).Map(
+        lambda x: (x, x))
+    right = ctx.Distribute(np.array([2, big], dtype=np.int64)).Map(
+        lambda x: (x, x * 2))
+    j = InnerJoin(left, right,
+                  lambda kv: kv[0], lambda kv: kv[0],
+                  lambda l, r: (l[0], r[1]))
+    got = sorted((int(a), int(b)) for a, b in j.AllGather())
+    assert got == [(2, 4)]
 
-    def job(ctx):
-        left = ctx.Distribute(np.array([1, 2, 3], dtype=np.int64)).Map(
-            lambda x: (x, x))
-        right = ctx.Distribute(np.array([2, big], dtype=np.int64)).Map(
-            lambda x: (x, x * 2))
-        j = InnerJoin(left, right,
-                      lambda kv: kv[0], lambda kv: kv[0],
-                      lambda l, r: (l[0], r[1]))
-        got = sorted((int(a), int(b)) for a, b in j.AllGather())
-        assert got == [(2, 4)]
+    # both sides containing the max key: must join max with max,
+    # exactly once per pair
+    l2 = ctx.Distribute(np.array([big, 5], dtype=np.int64)).Map(
+        lambda x: (x, 1))
+    r2 = ctx.Distribute(np.array([big], dtype=np.int64)).Map(
+        lambda x: (x, 2))
+    j2 = InnerJoin(l2, r2, lambda kv: kv[0], lambda kv: kv[0],
+                   lambda l, r: (l[0], l[1] + r[1]))
+    got2 = [(int(a), int(b)) for a, b in j2.AllGather()]
+    assert got2 == [(big, 3)]
 
-        # both sides containing the max key: must join max with max,
-        # exactly once per pair
-        l2 = ctx.Distribute(np.array([big, 5], dtype=np.int64)).Map(
-            lambda x: (x, 1))
-        r2 = ctx.Distribute(np.array([big], dtype=np.int64)).Map(
-            lambda x: (x, 2))
-        j2 = InnerJoin(l2, r2, lambda kv: kv[0], lambda kv: kv[0],
-                       lambda l, r: (l[0], l[1] + r[1]))
-        got2 = [(int(a), int(b)) for a, b in j2.AllGather()]
-        assert got2 == [(big, 3)]
-    sweep(job)
+
+def test_inner_join_all_ones_keys():
+    # tier-1 budget (ISSUE 13 rebalance): W in {1, 2} keeps the
+    # sentinel regression in-tier; the full W sweep rides the slow tier
+    RunLocalTests(_all_ones_keys_job, worker_counts=(1, 2))
+
+
+@pytest.mark.slow
+def test_inner_join_all_ones_keys_sweep():
+    sweep(_all_ones_keys_job)
 
 
 def test_inner_join_dense_index_device():
@@ -535,27 +543,36 @@ def test_device_to_host_demotion_logged(tmp_path):
     assert demotions[0]["items"] == 100
 
 
-def test_group_to_index_device_fn():
+def _group_to_index_device_job(ctx):
     import jax
+    vals = np.arange(30, dtype=np.int64)
 
-    def job(ctx):
-        vals = np.arange(30, dtype=np.int64)
+    def device_fn(tree, ids, nseg):
+        return jax.ops.segment_sum(tree, ids, num_segments=nseg)
 
-        def device_fn(tree, ids, nseg):
-            return jax.ops.segment_sum(tree, ids, num_segments=nseg)
+    out = ctx.Distribute(vals).GroupToIndex(
+        lambda x: x % 5, None, 5, neutral=-1, device_fn=device_fn)
+    got = [int(x) for x in out.AllGather()]
+    want = [sum(v for v in range(30) if v % 5 == i) for i in range(5)]
+    assert got == want
 
-        out = ctx.Distribute(vals).GroupToIndex(
-            lambda x: x % 5, None, 5, neutral=-1, device_fn=device_fn)
-        got = [int(x) for x in out.AllGather()]
-        want = [sum(v for v in range(30) if v % 5 == i) for i in range(5)]
-        assert got == want
+    # neutral fill: index 3 receives nothing
+    sparse = ctx.Distribute(np.array([0, 1, 2, 4], dtype=np.int64))
+    out2 = sparse.GroupToIndex(
+        lambda x: x, None, 5, neutral=-1, device_fn=device_fn)
+    assert [int(x) for x in out2.AllGather()] == [0, 1, 2, -1, 4]
 
-        # neutral fill: index 3 receives nothing
-        sparse = ctx.Distribute(np.array([0, 1, 2, 4], dtype=np.int64))
-        out2 = sparse.GroupToIndex(
-            lambda x: x, None, 5, neutral=-1, device_fn=device_fn)
-        assert [int(x) for x in out2.AllGather()] == [0, 1, 2, -1, 4]
-    sweep(job)
+
+def test_group_to_index_device_fn():
+    # tier-1 budget (ISSUE 13 rebalance): W in {1, 2} in-tier (the
+    # group-family device engines also ride test_group_by_key_device_fn
+    # and the sorted-host-path test); full sweep in the slow tier
+    RunLocalTests(_group_to_index_device_job, worker_counts=(1, 2))
+
+
+@pytest.mark.slow
+def test_group_to_index_device_fn_sweep():
+    sweep(_group_to_index_device_job)
 
 
 @pytest.mark.slow  # tier-1 budget: test_merge_sorted keeps the merge family in-tier
@@ -706,9 +723,16 @@ def test_reduce_by_key_device_dup_detection():
     assert moved_dd < moved_base / 2, (moved_dd, moved_base)
 
 
+@pytest.mark.slow
 def test_inner_join_device_location_detection():
     """Device LocationDetection prunes non-matching keys before the
-    exchange; same results, less traffic."""
+    exchange; same results, less traffic.
+
+    Slow tier (ISSUE 13 rebalance): the LD family stays in-tier via
+    test_inner_join_location_detection_device_host_parity (both
+    engines must agree) and the bytes_on_wire pin in
+    test_dispatch_budget; this 20k-key traffic-ratio sweep is the
+    expensive tail."""
     import jax
     from thrill_tpu.api import Context
     from thrill_tpu.parallel.mesh import MeshExec

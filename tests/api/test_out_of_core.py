@@ -1,0 +1,305 @@
+"""Out-of-core storage tier (ISSUE 13): prefetching readers,
+write-behind spill, compute/IO overlap.
+
+The contracts under test:
+
+* ``THRILL_TPU_PREFETCH=0`` + ``THRILL_TPU_WRITEBACK=0`` restore the
+  synchronous ladder BYTE-IDENTICALLY — same results for
+  ReadLines/em_sort/checkpoint-restore at W in {1, 2}, same spill-file
+  naming (``purge_stale_spills`` keeps reclaiming).
+* With the tier on, the overlap is STRUCTURAL: the em sort's writer
+  really ran behind the encode, the merge really consumed readahead,
+  and the counters surface in ``ctx.overall_stats()``.
+* Failure semantics: a write-behind flush failure POISONS the job with
+  its root cause (no silent loss) and the Context stays healthy; a
+  background prefetch failure DEGRADES to demand reads (never wrong
+  data) — both under the ``data.spill.writeback`` / ``vfs.prefetch``
+  sites the chaos sweep arms.
+* The TeraSort-from-vfs flagship: a multi-GB slow-marked sweep plus a
+  scaled-down in-tier parity test (same pipeline, same knobs A/B).
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from thrill_tpu.api import Run
+from thrill_tpu.api.context import Context
+from thrill_tpu.common import faults
+from thrill_tpu.common.config import Config
+from thrill_tpu.parallel.mesh import MeshExec
+
+OVERLAP_OFF = {"THRILL_TPU_PREFETCH": "0", "THRILL_TPU_WRITEBACK": "0"}
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in ("THRILL_TPU_PREFETCH", "THRILL_TPU_WRITEBACK",
+                "THRILL_TPU_WRITEBACK_QUEUE", "THRILL_TPU_SPILL_RESIDENT",
+                "THRILL_TPU_HOST_SORT_RUN"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+def _em_items(n, seed=5):
+    rng = np.random.default_rng(seed)
+    return [f"k-{v:09d}" for v in
+            rng.integers(0, 1 << 30, size=n).tolist()]
+
+
+def _em_sort_run(ctx, items):
+    node = ctx.Distribute(list(items), storage="host").Sort().node
+    hs = node.materialize()
+    return [it for l in hs.lists for it in l], \
+        getattr(node, "_em_stats", {})
+
+
+# ----------------------------------------------------------------------
+# bit-identity: overlap on vs THRILL_TPU_PREFETCH=0 / sync writeback
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("W", [1, 2])
+def test_readlines_prefetch_bit_identity(W, monkeypatch, tmp_path):
+    lines = [f"item-{i:06d}-{(i * 7919) % 1000}" for i in range(5000)]
+    p = tmp_path / "in.txt"
+    p.write_text("\n".join(lines) + "\n")
+    ctx = Context(MeshExec(num_workers=W))
+    try:
+        on = ctx.ReadLines(str(p)).AllGather()
+        for k, v in OVERLAP_OFF.items():
+            monkeypatch.setenv(k, v)
+        off = ctx.ReadLines(str(p)).AllGather()
+        assert on == off == lines
+    finally:
+        ctx.close()
+
+
+@pytest.mark.parametrize("W", [1, 2])
+def test_em_sort_prefetch_writeback_bit_identity(W, monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "500")
+    # pin a genuinely disk-resident merge so the readahead path runs
+    monkeypatch.setenv("THRILL_TPU_SPILL_RESIDENT", "64K")
+    items = _em_items(6000)
+    ctx = Context(MeshExec(num_workers=W))
+    try:
+        spill_dir = ctx.config.spill_dir
+        on, st_on = _em_sort_run(ctx, items)
+        assert st_on.get("writeback_sync") is False
+        for k, v in OVERLAP_OFF.items():
+            monkeypatch.setenv(k, v)
+        off, st_off = _em_sort_run(ctx, items)
+        assert st_off.get("writeback_sync") is True
+        assert on == off == sorted(items)
+        # same payload through either path, and the overlapped path
+        # leaves no live-pid spill files behind (the pid/store/host
+        # naming contract purge_stale_spills depends on is unchanged)
+        assert st_on.get("writeback_bytes") == \
+            st_off.get("writeback_bytes")
+        leaked = glob.glob(os.path.join(
+            spill_dir, f"ttpu-blk-{os.getpid()}-*.spill"))
+        assert not leaked, leaked
+    finally:
+        ctx.close()
+
+
+def test_checkpoint_restore_prefetch_bit_identity(monkeypatch,
+                                                  tmp_path):
+    """Resume restores through the overlapped read path (prefetching
+    vfs reader + next-shard readahead) bit-identically to the demand
+    path, W=2 (multiple shard files = real overlap window)."""
+    def job(ctx):
+        d = ctx.Distribute(np.arange(4096, dtype=np.int64)) \
+            .Map(lambda x: x * 5 - 3).Checkpoint()
+        return sorted(int(x) for x in d.AllGather())
+
+    want = sorted(x * 5 - 3 for x in range(4096))
+    cfg = Config(ckpt_dir=str(tmp_path / "ckpt"), num_workers=2)
+    assert Run(job, cfg) == want
+    got_on = Run(job, cfg, resume=True)
+    for k, v in OVERLAP_OFF.items():
+        monkeypatch.setenv(k, v)
+    got_off = Run(job, cfg, resume=True)
+    assert got_on == got_off == want
+
+
+# ----------------------------------------------------------------------
+# the overlap is structural, and it surfaces in overall_stats
+# ----------------------------------------------------------------------
+
+def test_em_sort_overlap_structure_and_stats(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "1000")
+    monkeypatch.setenv("THRILL_TPU_SPILL_RESIDENT", "64K")
+    items = _em_items(20000, seed=9)
+    ctx = Context(MeshExec(num_workers=1))
+    try:
+        got, st = _em_sort_run(ctx, items)
+        assert got == sorted(items)
+        # the writer really ran write-behind, and background I/O time
+        # was mostly hidden (waits well under busy)
+        assert st["writeback_sync"] is False
+        assert st["writeback_bytes"] > 0
+        assert st["io_busy_s"] > 0
+        assert st["overlap_frac"] > 0.2
+        # the merge consumed the readahead path (hits or opportunistic
+        # misses — either proves blocks flowed through it)
+        s = ctx.overall_stats()
+        assert s["prefetch_hits"] + s["prefetch_misses"] > 0
+        for key in ("prefetch_hits", "prefetch_misses", "io_wait_s",
+                    "io_busy_s", "writeback_bytes",
+                    "writeback_queue_peak", "restore_overlaps"):
+            assert key in s, key
+        assert s["writeback_bytes"] >= st["writeback_bytes"]
+    finally:
+        ctx.close()
+
+
+# ----------------------------------------------------------------------
+# failure semantics (the chaos sweep arms these sites too)
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_em_sort_writeback_failure_poisons_job(monkeypatch):
+    """An async run-flush failure fails the JOB with its root cause —
+    before the merge could read the missing run (no silent loss) —
+    and the Context stays healthy for the next run."""
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "500")
+    items = _em_items(6000, seed=13)
+    ctx = Context(MeshExec(num_workers=1))
+    try:
+        monkeypatch.setenv(faults.ENV_VAR, "data.spill.writeback:n=0")
+        with pytest.raises(Exception) as ei:
+            _em_sort_run(ctx, items)
+        assert "data.spill.writeback" in str(ei.value)
+        monkeypatch.delenv(faults.ENV_VAR)
+        faults.REGISTRY.reset()
+        got, _ = _em_sort_run(ctx, items)
+        assert got == sorted(items)
+    finally:
+        ctx.close()
+
+
+@pytest.mark.chaos
+def test_em_sort_prefetch_failure_degrades_to_demand(monkeypatch):
+    """A background readahead failure during the merge degrades to
+    demand reads — results exact, recovery noted."""
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "500")
+    monkeypatch.setenv("THRILL_TPU_SPILL_RESIDENT", "64K")
+    items = _em_items(6000, seed=17)
+    ctx = Context(MeshExec(num_workers=1))
+    try:
+        monkeypatch.setenv(faults.ENV_VAR, "vfs.prefetch:n=3")
+        got, _ = _em_sort_run(ctx, items)
+        assert got == sorted(items)
+        assert faults.REGISTRY.injected >= 1
+        assert any(e.get("what", "").endswith("prefetch_degraded")
+                   for e in faults.REGISTRY.events)
+    finally:
+        ctx.close()
+
+
+# ----------------------------------------------------------------------
+# TeraSort from vfs: in-tier parity + the multi-GB flagship
+# ----------------------------------------------------------------------
+
+def _tera_lines(n, seed):
+    rng = np.random.default_rng(seed)
+    return [f"{v:010d}\t{i:08d}payload" for i, v in
+            enumerate(rng.integers(0, 1 << 31, size=n).tolist())]
+
+
+def _tera_job(src, outdir):
+    def job(ctx):
+        d = ctx.ReadLines(src).Sort(key_fn=lambda s: s[:10])
+        from thrill_tpu.api.ops.read_write import WriteLines
+        WriteLines(d, os.path.join(outdir, "part-$$$$$.txt"))
+        return ctx.overall_stats()
+    return job
+
+
+def test_terasort_from_vfs_parity_small(monkeypatch, tmp_path):
+    """Scaled-down in-tier twin of the flagship: 10-byte-key lines
+    read from vfs, EM-sorted from a bounded-residency spill store,
+    written back per worker — overlap on vs off produces byte-equal
+    output files."""
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "2000")
+    monkeypatch.setenv("THRILL_TPU_SPILL_RESIDENT", "64K")
+    lines = _tera_lines(20000, seed=11)
+    src = tmp_path / "tera.txt"
+    src.write_text("\n".join(lines) + "\n")
+    out_on = tmp_path / "on"
+    out_off = tmp_path / "off"
+    out_on.mkdir()
+    out_off.mkdir()
+    stats = Run(_tera_job(str(src), str(out_on)),
+                config=Config(num_workers=2))
+    assert stats["writeback_bytes"] > 0
+    for k, v in OVERLAP_OFF.items():
+        monkeypatch.setenv(k, v)
+    Run(_tera_job(str(src), str(out_off)), config=Config(num_workers=2))
+    files_on = sorted(os.listdir(out_on))
+    files_off = sorted(os.listdir(out_off))
+    assert files_on == files_off and len(files_on) == 2
+    merged = []
+    for f_on, f_off in zip(files_on, files_off):
+        b_on = (out_on / f_on).read_bytes()
+        assert b_on == (out_off / f_off).read_bytes()
+        merged.extend(b_on.decode().splitlines())
+    assert merged == sorted(lines, key=lambda s: (s[:10], s))
+
+
+@pytest.mark.slow
+def test_terasort_from_vfs_flagship(monkeypatch, tmp_path):
+    """The multi-GB flagship (THRILL_TPU_TERASORT_GB, default 1):
+    TeraSort-shaped lines streamed from vfs through the full
+    out-of-core pipeline — prefetching source reads, write-behind run
+    spilling, readahead k-way merge — validated by global order,
+    count, and boundary keys, with the overlap structurally asserted
+    (write-behind ran, readahead consumed, em_overlap_frac > 0.5)."""
+    try:
+        gb = float(os.environ.get("THRILL_TPU_TERASORT_GB", "") or 1.0)
+    except ValueError:
+        gb = 1.0
+    line_bytes = 30  # "{key:010d}\t{payload:08d}payload\n"
+    n = max(int(gb * (1 << 30)) // line_bytes, 1 << 20)
+    monkeypatch.setenv("THRILL_TPU_SPILL_RESIDENT", "64M")
+    # force the EM path regardless of the rig's negotiated grant (a
+    # big-RAM host would otherwise sort in memory and test nothing)
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", str(n // 64))
+    src = tmp_path / "tera.txt"
+    rng = np.random.default_rng(29)
+    with open(src, "w") as f:
+        left = n
+        i0 = 0
+        while left:
+            chunk = min(left, 1 << 20)
+            vals = rng.integers(0, 1 << 31, size=chunk).tolist()
+            f.write("".join(f"{v:010d}\t{i0 + i:08d}payload\n"
+                            for i, v in enumerate(vals)))
+            left -= chunk
+            i0 += chunk
+
+    def job(ctx):
+        node = ctx.ReadLines(str(src)) \
+            .Sort(key_fn=lambda s: s[:10]).node
+        hs = node.materialize()
+        prev = None
+        total = 0
+        for lst in hs.lists:
+            for s in lst:
+                k = s[:10]
+                assert prev is None or k >= prev
+                prev = k
+                total += 1
+        return total, getattr(node, "_em_stats", {})
+
+    total, st = Run(job, config=Config(num_workers=2))
+    assert total == n
+    assert st.get("writeback_sync") is False
+    assert st.get("writeback_bytes", 0) > (1 << 28) * gb
+    assert st.get("overlap_frac", 0) > 0.5, st
+    assert st.get("prefetch_hit_rate", 0) > 0, st

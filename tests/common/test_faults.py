@@ -551,6 +551,74 @@ def _ex_vfs_read_reopen(tmp_path=None):
     assert faults.REGISTRY.stats()["retries"] == 2
 
 
+def _ex_vfs_prefetch_degrades():
+    """vfs.prefetch: a background readahead failure DEGRADES to demand
+    reads at the exact consumed position — bytes identical, recovery
+    noted, never wrong data (the out-of-core tier's read-side
+    contract)."""
+    import tempfile
+    from thrill_tpu.vfs import file_io
+    prev = os.environ.get("THRILL_TPU_PREFETCH")
+    os.environ["THRILL_TPU_PREFETCH"] = "4"
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "data.txt")
+            payload = b"".join(b"line-%05d\n" % i for i in range(20000))
+            with open(p, "wb") as f:
+                f.write(payload)
+            with faults.inject("vfs.prefetch", n=1, seed=2):
+                with file_io.OpenReadStream(p) as f:
+                    assert isinstance(f, file_io.PrefetchingReader)
+                    assert f.read() == payload
+    finally:
+        if prev is None:
+            os.environ.pop("THRILL_TPU_PREFETCH", None)
+        else:
+            os.environ["THRILL_TPU_PREFETCH"] = prev
+    assert faults.REGISTRY.injected >= 1
+    assert any(e.get("what") == "vfs.prefetch_degraded"
+               for e in faults.REGISTRY.events)
+
+
+def _ex_spill_writeback():
+    """data.spill.writeback, both contracts: a POISON writer (em_sort
+    run spilling) re-raises the async flush failure with its root
+    cause at the barrier — no silent loss — while the blockpool
+    eviction writer DEGRADES: the block stays RAM-resident (over
+    budget beats data loss) and every byte reads back exact."""
+    import tempfile
+    from thrill_tpu.data import block_pool
+    from thrill_tpu.data.writeback import AsyncWriter
+
+    # poison contract (the em_sort spill writer)
+    w = AsyncWriter("t.em_spill", sync=False, poison=True)
+    with faults.inject("data.spill.writeback", n=1, seed=3):
+        w.submit(lambda: 0)
+        with pytest.raises(faults.InjectedFault):
+            w.flush()
+    w.close(drain=False)
+
+    # degrade contract (the fallback store's eviction writer)
+    orig = block_pool._load_native
+    block_pool._load_native = lambda: None
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            pool = block_pool.BlockPool(spill_dir=td, soft_limit=4000)
+            assert not pool.native
+            with faults.inject("data.spill.writeback", n=0, seed=3):
+                bids = [pool.put(bytes([i]) * 4000) for i in range(4)]
+                pool.flush()
+                for i, bid in enumerate(bids):
+                    assert pool.get(bid) == bytes([i]) * 4000
+            assert pool.mem_usage > 4000      # resident, not lost
+            pool.close()
+    finally:
+        block_pool._load_native = orig
+    assert faults.REGISTRY.injected >= 2
+    assert any(e.get("what") == "data.blockpool.spill.degraded"
+               for e in faults.REGISTRY.events)
+
+
 def _ckpt_roundtrip(tmp_dir):
     """One checkpointed run + one resumed run in tmp_dir; returns the
     two results (must be equal) and the resumed run's stats."""
@@ -771,6 +839,11 @@ _MATRIX = {
     "service.plan_store.corrupt": _ex_plan_store_corrupt,
     "vfs.open_read": _ex_vfs_read_reopen,
     "vfs.read": _ex_vfs_read_reopen,
+    # out-of-core tier (ISSUE 13): background readahead degrades to
+    # demand reads; a write-behind flush failure poisons (em spill) or
+    # degrades to RAM residency (blockpool eviction) — never loss
+    "vfs.prefetch": _ex_vfs_prefetch_degrades,
+    "data.spill.writeback": _ex_spill_writeback,
     "vfs.s3.read": _ex_vfs_scheme_sites,
     "vfs.hdfs.open": _ex_vfs_scheme_sites,
 }

@@ -228,6 +228,9 @@ def test_python_fallback_honors_soft_limit(_forced_fallback):
         assert not pool.native
         payloads = [bytes([i]) * 4000 for i in range(10)]  # 40 KB
         ids = [pool.put(p) for p in payloads]
+        # write-behind: puts never block on disk; flush() is the
+        # durability barrier after which residency fits the limit
+        pool.flush()
         assert pool.mem_usage <= 10_000
         spills = [f for f in os.listdir(d) if f.endswith(".spill")]
         assert spills, "expected fallback spill files"
@@ -252,9 +255,11 @@ def test_python_fallback_pin_blocks_eviction(_forced_fallback):
         first = pool.put(b"a" * 4000)
         pool.pin(first)
         pool.put(b"b" * 4000)            # over limit; first is pinned
+        pool.flush()
         assert first not in getattr(pool, "_spilled")
         pool.unpin(first)
         pool.put(b"c" * 4000)            # now first may spill
+        pool.flush()
         assert pool.mem_usage <= 5_000
         assert pool.get(first) == b"a" * 4000
         pool.close()
@@ -268,6 +273,7 @@ def test_python_fallback_stale_spills_are_purged(_forced_fallback):
         pool = BlockPool(spill_dir=d, soft_limit=1)
         pool.put(b"x" * 100)
         pool.put(b"y" * 100)
+        pool.flush()          # write-behind: barrier before listing
         spills = [f for f in os.listdir(d) if f.endswith(".spill")]
         assert spills
         fake = os.path.join(

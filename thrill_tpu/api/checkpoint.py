@@ -470,13 +470,47 @@ class CheckpointManager:
 
         def read():
             faults.check(_F_READ, file=finfo["name"])
-            with file_io.OpenReadStream(path) as f:
+            with file_io.OpenReadStream(
+                    path, tracer=getattr(self.ctx.mesh_exec, "tracer",
+                                         None)) as f:
                 return f.read()
 
         data = default_policy().run(read, what="ckpt.read")
         if zlib.crc32(data) != finfo["crc"]:
             raise IOError(f"CRC mismatch in {finfo['name']}")
         return data
+
+    def _overlapped_reads(self, edir: str, rec: dict, workers):
+        """Yield ``(worker, shard file bytes)`` with the NEXT worker's
+        file read already in flight behind the current worker's
+        decode+upload — the checkpoint-restore face of the out-of-core
+        overlap tier. Each read is the full retry+CRC path
+        (:meth:`_read_file`, itself streaming through the prefetching
+        vfs reader); a background failure degrades to the demand read
+        on this thread, so corruption/fault semantics are unchanged.
+        ``THRILL_TPU_PREFETCH=0`` restores strictly sequential reads."""
+        from ..data.writeback import make_readahead, overlapped_fetch
+        from ..vfs.file_io import prefetch_depth
+        from ..common.iostats import IO as _IOSTATS
+        workers = list(workers)
+        ra = make_readahead(prefetch_depth()) \
+            if len(workers) > 1 else None
+        st: dict = {}
+        try:
+            yield from overlapped_fetch(
+                workers,
+                lambda w: self._read_file(edir, rec["files"][str(w)]),
+                "ckpt.restore", ra, stats=st)
+            if st.get("prefetched"):
+                _IOSTATS.add(restore_overlaps=1)
+                log = self.ctx.logger
+                if log.enabled:
+                    log.line(event="restore_overlap", kind="ckpt",
+                             files=len(workers),
+                             prefetched=st["prefetched"])
+        finally:
+            if ra is not None:
+                ra.shutdown(wait=True, cancel_futures=True)
 
     def _restore_device(self, rec: dict, edir: str) -> DeviceShards:
         import jax
@@ -489,8 +523,7 @@ class CheckpointManager:
         treedef = jax.tree.structure(skeleton)
         local = self._local_workers()
         per_worker_leaves: Dict[int, List[np.ndarray]] = {}
-        for w in local:
-            data = self._read_file(edir, rec["files"][str(w)])
+        for w, data in self._overlapped_reads(edir, rec, local):
             leaves = deserialize_leaves(data)
             if len(leaves) != treedef.num_leaves:
                 raise IOError(
@@ -532,12 +565,13 @@ class CheckpointManager:
         mex = self.ctx.mesh_exec
         W = mex.num_workers
         lists: List[List[Any]] = [[] for _ in range(W)]
-        for w in self._local_workers():
-            finfo = rec["files"].get(str(w))
-            if finfo is None:
+        local = self._local_workers()
+        for w in local:
+            if rec["files"].get(str(w)) is None:
                 raise IOError(f"worker {w}: shard file missing from "
                               f"manifest")
-            lists[w] = deserialize_batch(self._read_file(edir, finfo))
+        for w, data in self._overlapped_reads(edir, rec, local):
+            lists[w] = deserialize_batch(data)
             want = int(rec["counts"].get(str(w), len(lists[w]))) \
                 if isinstance(rec["counts"], dict) \
                 else int(rec["counts"][w])

@@ -163,6 +163,10 @@ class Context:
         if self.logger.enabled:
             faults.REGISTRY.set_logger(self.logger.line)
         self._faults_base = faults.REGISTRY.stats()
+        # out-of-core I/O overlap ledger (common/iostats.py): same
+        # process-lifetime baseline pattern as the fault counters
+        from ..common.iostats import IO as _iostats
+        self._io_base = _iostats.snapshot()
         self.mem = MemoryManager(name="context")
         from ..mem.hbm import HbmGovernor
         self.hbm = HbmGovernor(self, limit=self.config.hbm_limit)
@@ -668,6 +672,13 @@ class Context:
         from ..common import faults
         stats.update({k: v - self._faults_base.get(k, 0)
                       for k, v in faults.REGISTRY.stats().items()})
+        # out-of-core storage tier (vfs prefetch readers, write-behind
+        # spill, double-buffered restore): hit/miss record, foreground
+        # seconds lost to I/O, background busy seconds, write-behind
+        # volume and queue high-water mark, restores that overlapped
+        from ..common.iostats import IO as _iostats
+        stats.update(_iostats.delta(_iostats.snapshot(),
+                                    self._io_base))
         if self.net.num_workers > 1 and not local_only \
                 and not self._aborted and self.service is None:
             # once a rank has EVER served, degrade to the local view
@@ -689,10 +700,16 @@ class Context:
             # retry/abort counters) genuinely differ across hosts.
             local_peaks = {"host_mem_peak", "recovery_time_s",
                            "hbm_high_watermark", "heal_time_s"}
+            local_peaks |= {"writeback_queue_peak"}
             local_sums = {"faults_injected", "retries", "recoveries",
                           "aborts", "ckpt_bytes_written", "oom_retries",
                           "segment_splits", "host_fallbacks",
                           "admission_spills", "pressure_spilled_bytes",
+                          # out-of-core tier: per-process background
+                          # I/O flows sum; the queue peak maxes
+                          "prefetch_hits", "prefetch_misses",
+                          "io_wait_s", "io_busy_s", "writeback_bytes",
+                          "restore_overlaps",
                           # link repairs and stale-frame drops are
                           # per-process transport events; the abort/
                           # generation counters are coordinated (host

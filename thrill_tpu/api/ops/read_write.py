@@ -97,7 +97,11 @@ def _read_delimited_range(fl: file_io.FileList, lo: int, hi: int,
             continue
         start = max(lo, f_lo) - f_lo
         end = min(hi, f_hi) - f_lo
-        with file_io.OpenReadStream(fi.path) as f:
+        # readahead horizon = the range end: the background reader must
+        # not stream blocks past the bytes this worker will consume
+        # (the tail extension past ``end`` legitimately continues on
+        # demand reads — a horizon is a hint, not EOF)
+        with file_io.OpenReadStream(fi.path, readahead_to=end) as f:
             if start > 0:
                 f.seek(start - 1)
                 if is_delim(f.read(1)):
@@ -276,7 +280,8 @@ def _read_records(fl, lo_rec, hi_rec, rec_bytes, dtype) -> np.ndarray:
             continue
         start = max(lo, f_lo) - f_lo
         end = min(hi, f_hi) - f_lo
-        with file_io.OpenReadStream(fi.path, offset=start) as f:
+        with file_io.OpenReadStream(fi.path, offset=start,
+                                    readahead_to=end) as f:
             chunks.append(f.read(end - start))
     buf = b"".join(chunks)
     return np.frombuffer(buf, dtype=dtype)

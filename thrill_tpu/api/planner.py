@@ -271,6 +271,19 @@ class Planner:
         from ..mem.pressure import split_k
         return split_k(cap)
 
+    # -- choice: out-of-core readahead depth ----------------------------
+    def io_prefetch_depth(self, site: str, default: int) -> int:
+        """Readahead depth for an out-of-core site (the em_sort merge,
+        spill/checkpoint restore). The policy is the env-pinned depth
+        (one definition: vfs/file_io.prefetch_depth, passed in as
+        ``default``); owning the choice here puts it in the decision
+        ledger so ``ctx.explain()`` and the audit loop cover I/O like
+        every other plan decision — the recorded prediction (perfect
+        hit rate) joins against the measured rate, which is the signal
+        a future depth model would learn from."""
+        self.take_replan(site)      # marks are consumed, not yet acted
+        return default
+
     # -- re-optimization ------------------------------------------------
     def note_seeded(self, site: str) -> None:
         """The site's capacity plan came from the plan store — the one

@@ -218,6 +218,17 @@ class FaultRegistry:
             log = self._log
         self._emit(log, rec)
 
+    def log_line(self, event: str, **detail: Any) -> None:
+        """Emit one JSON line through the attached logger WITHOUT
+        recording it in the bounded events ring — for I/O-lane summary
+        events (prefetch/writeback) whose volume would evict the fault
+        records the ring exists to keep."""
+        with self._lock:
+            log = self._log
+        rec = {"event": event}
+        rec.update(detail)
+        self._emit(log, rec)
+
     @staticmethod
     def _emit(log: Optional[Callable[..., None]], rec: dict) -> None:
         if log is None:

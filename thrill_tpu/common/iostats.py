@@ -1,0 +1,102 @@
+"""Process-wide out-of-core I/O counters: the overlap ledger.
+
+The storage tier (vfs prefetching readers, write-behind spill, the
+double-buffered HBM restore) runs its I/O on background threads so the
+device/compute thread never idles on disk. This module is the single
+accounting point those threads share, so ``ctx.overall_stats()`` and
+the bench em lane can report the STRUCTURE of the overlap — how much
+background I/O ran, and how much of it the foreground actually had to
+wait for — instead of inferring it from noisy totals:
+
+* ``prefetch_hits`` / ``prefetch_misses`` — a consumer needing the
+  next block found it already resident (hit) or had to block on the
+  background reader (miss).
+* ``io_wait_s``  — foreground seconds spent blocked on background I/O
+  (readahead queue empty, write-behind queue full, flush barriers).
+* ``io_busy_s``  — seconds background threads spent inside read/write
+  calls. ``overlap_frac() = 1 - io_wait_s / io_busy_s`` is the
+  fraction of I/O time hidden behind compute (1.0 = fully overlapped,
+  0.0 = the blocking ladder this tier replaced).
+* ``writeback_bytes`` / ``writeback_queue_peak`` — bytes flushed
+  through write-behind writers and the deepest their bounded queues
+  ever got.
+* ``restore_overlaps`` — spill/checkpoint restores that ran with the
+  next block's read in flight behind the current upload.
+
+Counters are process-global (the threads have no Context handle);
+``Context`` snapshots them at construction and reports deltas, the
+same baseline pattern the fault registry uses.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_COUNTERS = ("prefetch_hits", "prefetch_misses", "io_wait_s",
+             "io_busy_s", "writeback_bytes", "restore_overlaps")
+
+
+class IoStats:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.prefetch_hits = 0
+        self.prefetch_misses = 0
+        self.io_wait_s = 0.0
+        self.io_busy_s = 0.0
+        self.writeback_bytes = 0
+        self.writeback_queue_peak = 0
+        self.restore_overlaps = 0
+
+    def add(self, **kv) -> None:
+        with self._lock:
+            for k, v in kv.items():
+                setattr(self, k, getattr(self, k) + v)
+
+    def note_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            if depth > self.writeback_queue_peak:
+                self.writeback_queue_peak = depth
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {k: getattr(self, k) for k in _COUNTERS}
+            out["writeback_queue_peak"] = self.writeback_queue_peak
+            return out
+
+    @staticmethod
+    def delta(now: dict, base: dict) -> dict:
+        """Per-Context view: counters since ``base``; the queue peak is
+        a high-water mark, not a flow, so it reports raw."""
+        out = {k: now[k] - base.get(k, 0) for k in _COUNTERS}
+        out["io_wait_s"] = round(out["io_wait_s"], 4)
+        out["io_busy_s"] = round(out["io_busy_s"], 4)
+        out["writeback_queue_peak"] = now["writeback_queue_peak"]
+        return out
+
+    def reset(self) -> None:
+        """Forget everything (tests)."""
+        with self._lock:
+            self.prefetch_hits = self.prefetch_misses = 0
+            self.io_wait_s = self.io_busy_s = 0.0
+            self.writeback_bytes = self.writeback_queue_peak = 0
+            self.restore_overlaps = 0
+
+
+def overlap_frac(stats: dict) -> float:
+    """Fraction of background-I/O busy time the foreground did NOT
+    wait for, clamped to [0, 1]; 0.0 when no background I/O ran."""
+    busy = stats.get("io_busy_s", 0.0)
+    if busy <= 0:
+        return 0.0
+    return max(0.0, min(1.0, 1.0 - stats.get("io_wait_s", 0.0) / busy))
+
+
+def hit_rate(stats: dict) -> float:
+    """Prefetch hit fraction; 0.0 with no prefetch consumption."""
+    n = stats.get("prefetch_hits", 0) + stats.get("prefetch_misses", 0)
+    return (stats.get("prefetch_hits", 0) / n) if n else 0.0
+
+
+#: process-wide ledger: background reader/writer threads add here,
+#: Context.overall_stats() reads deltas against its construction base
+IO = IoStats()

@@ -39,7 +39,8 @@ def multiway_merge(runs: List[Iterable[Any]],
 
 def multiway_merge_files(files: List[File], key: Optional[Callable] = None,
                          consume: bool = False,
-                         max_merge_degree: int = 0) -> Iterator[Any]:
+                         max_merge_degree: int = 0,
+                         submit=None) -> Iterator[Any]:
     """Merge sorted Files block-lazily with bounded merge degree.
 
     At most ``max_merge_degree`` run readers are open at once
@@ -48,6 +49,11 @@ def multiway_merge_files(files: List[File], key: Optional[Callable] = None,
     are more runs, groups are partially merged into intermediate Files
     first, so memory stays bounded even for thousands of spilled runs.
     0 = default (64, the reference's prefetch-less fallback ballpark).
+
+    ``submit`` (a readahead executor's submit, data/writeback.py) gives
+    every run reader one block of readahead — the winner's next block
+    is already resident when the tournament pops it; None keeps the
+    demand readers exactly.
     """
     import os
     if max_merge_degree <= 0:
@@ -66,8 +72,9 @@ def multiway_merge_files(files: List[File], key: Optional[Callable] = None,
             pool = group[0].pool
             merged = File(pool=pool)
             with merged.writer() as w:
-                readers = [f.consume_reader() if consume
-                           else f.keep_reader() for f in group]
+                readers = [f.prefetch_reader(consume=consume,
+                                             submit=submit)
+                           for f in group]
                 for item in multiway_merge(readers, key):
                     w.put(item)
             if consume:
@@ -76,9 +83,9 @@ def multiway_merge_files(files: List[File], key: Optional[Callable] = None,
             made_intermediates.append(merged)
             files.append(merged)
 
-        readers = [f.consume_reader()
-                   if (consume or f in made_intermediates)
-                   else f.keep_reader() for f in files]
+        readers = [f.prefetch_reader(
+                       consume=(consume or f in made_intermediates),
+                       submit=submit) for f in files]
         yield from multiway_merge(readers, key)
     finally:
         for f in made_intermediates:

@@ -118,9 +118,11 @@ class _RunFeed:
 
 
 def _merge_group(item_files: List[File], key_files: List[File],
-                 consume: bool) -> Iterator[Tuple[bytes, object]]:
+                 consume: bool,
+                 submit=None) -> Iterator[Tuple[bytes, object]]:
     """Stream the native merge of one group: yields (key_bytes, item)
-    in merged order."""
+    in merged order. ``submit`` (readahead executor, data/writeback.py)
+    gives each run's key/item streams one block of readahead."""
     lib = _load()
     assert lib is not None
     k = len(item_files)
@@ -133,9 +135,10 @@ def _merge_group(item_files: List[File], key_files: List[File],
     blob_cap = 1 << 20
     need = ctypes.c_int32(-1)
     try:
-        feeds = [_RunFeed(kf.consume_reader() if consume
-                          else kf.keep_reader()) for kf in key_files]
-        item_readers = [f.consume_reader() if consume else f.keep_reader()
+        feeds = [_RunFeed(kf.prefetch_reader(consume=consume,
+                                             submit=submit))
+                 for kf in key_files]
+        item_readers = [f.prefetch_reader(consume=consume, submit=submit)
                         for f in item_files]
         for r, feed in enumerate(feeds):
             feed.feed(lib, handle, r)
@@ -178,7 +181,8 @@ def _resolve_degree(max_merge_degree: int) -> int:
 
 
 def _reduce_degree(pairs: List[Tuple[File, File]], max_merge_degree: int,
-                   consume: bool, made: List[File]) -> List[Tuple[File, File]]:
+                   consume: bool, made: List[File],
+                   submit=None) -> List[Tuple[File, File]]:
     """Partially merge the smallest (item, key) file pairs into
     intermediate pairs until at most ``max_merge_degree`` remain
     (reference: the partial multiway merge bound, api/sort.hpp:229-260).
@@ -194,7 +198,7 @@ def _reduce_degree(pairs: List[Tuple[File, File]], max_merge_degree: int,
         with mi.writer() as wi, mk.writer() as wk:
             for kb, item in _merge_group(
                     [p[0] for p in group], [p[1] for p in group],
-                    consume=consume):
+                    consume=consume, submit=submit):
                 wi.put(item)
                 kb_buf.append(kb)
                 if len(kb_buf) >= KEY_CHUNK:
@@ -215,7 +219,8 @@ def _reduce_degree(pairs: List[Tuple[File, File]], max_merge_degree: int,
 def merge_partitioned(item_files: List[File], key_files: List[File],
                       splitters_kb: List[bytes], out_lists: List[list],
                       consume: bool = True,
-                      max_merge_degree: int = 0) -> None:
+                      max_merge_degree: int = 0,
+                      submit=None) -> None:
     """Merge + splitter-partition in one pass, appending items into
     ``out_lists`` directly (the EM sort's final phase).
 
@@ -233,7 +238,8 @@ def merge_partitioned(item_files: List[File], key_files: List[File],
     lib = _load()
     assert lib is not None
     try:
-        pairs = _reduce_degree(pairs, max_merge_degree, consume, made)
+        pairs = _reduce_degree(pairs, max_merge_degree, consume, made,
+                               submit=submit)
         k = len(pairs)
         handle = lib.mwm_create(k + 1)      # +1: the splitter run
         if not handle:
@@ -242,10 +248,12 @@ def merge_partitioned(item_files: List[File], key_files: List[File],
         out_runs = np.empty(out_cap, dtype=np.uint32)
         need = ctypes.c_int32(-1)
         try:
-            feeds = [_RunFeed(p[1].consume_reader() if consume
-                              else p[1].keep_reader()) for p in pairs]
-            item_readers = [p[0].consume_reader() if consume
-                            else p[0].keep_reader() for p in pairs]
+            feeds = [_RunFeed(p[1].prefetch_reader(consume=consume,
+                                                   submit=submit))
+                     for p in pairs]
+            item_readers = [p[0].prefetch_reader(consume=consume,
+                                                 submit=submit)
+                            for p in pairs]
             for r, feed in enumerate(feeds):
                 feed.feed(lib, handle, r)
             sp_offs = np.zeros(len(splitters_kb) + 1, dtype=np.int64)
@@ -287,7 +295,7 @@ def merge_partitioned(item_files: List[File], key_files: List[File],
 
 def merge_key_files(item_files: List[File], key_files: List[File],
                     consume: bool = True,
-                    max_merge_degree: int = 0
+                    max_merge_degree: int = 0, submit=None
                     ) -> Iterator[Tuple[bytes, object]]:
     """Merge sorted (item, key) file pairs; yields (key_bytes, item).
 
@@ -299,9 +307,11 @@ def merge_key_files(item_files: List[File], key_files: List[File],
     pairs = list(zip(item_files, key_files))
     made: List[File] = []
     try:
-        pairs = _reduce_degree(pairs, max_merge_degree, consume, made)
+        pairs = _reduce_degree(pairs, max_merge_degree, consume, made,
+                               submit=submit)
         yield from _merge_group([p[0] for p in pairs],
-                                [p[1] for p in pairs], consume=consume)
+                                [p[1] for p in pairs], consume=consume,
+                                submit=submit)
     finally:
         for f in made:
             f.clear()

@@ -5,7 +5,8 @@ Equivalent of the reference's BlockPool/ByteBlock layer
 LRU eviction to disk): bytes live in the C++ store (native/
 blockstore.cpp, built on first use with g++), Python handles only ids.
 Falls back to a pure-Python store when no compiler is available — with
-the SAME soft-limit spill-to-disk ladder (synchronous writes, same
+the SAME soft-limit spill-to-disk ladder (write-behind evictions via
+data/writeback.py, synchronous with THRILL_TPU_WRITEBACK=0; same
 pid/store/host file naming so ``purge_stale_spills`` reclaims its
 files too), so a compiler-less host degrades instead of growing
 unbounded.
@@ -79,6 +80,8 @@ def _load_native() -> Optional[ctypes.CDLL]:
         lib.bs_unpin.restype = ctypes.c_int
         lib.bs_unpin.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.bs_drop.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.bs_resident.restype = ctypes.c_int
+        lib.bs_resident.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.bs_mem_usage.restype = ctypes.c_int64
         lib.bs_mem_usage.argtypes = [ctypes.c_void_p]
         lib.bs_num_blocks.restype = ctypes.c_int64
@@ -91,14 +94,31 @@ def _load_native() -> Optional[ctypes.CDLL]:
         return _LIB
 
 
+def resident_override() -> Optional[int]:
+    """``THRILL_TPU_SPILL_RESIDENT``: override a spill store's RAM
+    residency budget outright (bytes, SI/IEC suffixes; floor 64 KiB).
+    How the bench em lane and the out-of-core tests pin a genuinely
+    disk-resident merge/restore regime regardless of the rig's
+    negotiated grant; None = the owner's own sizing policy."""
+    env = os.environ.get("THRILL_TPU_SPILL_RESIDENT")
+    if not env:
+        return None
+    from ..common.config import parse_si_iec_units
+    try:
+        return max(parse_si_iec_units(env), 1 << 16)
+    except (ValueError, TypeError):
+        return None
+
+
 def spill_pool(spill_dir: str, mem_limit) -> "BlockPool":
     """The EM operators' shared spill-store sizing policy: keep a
     quarter of the negotiated grant resident before evicting to disk
     (floor 8 MiB; 64 MiB residency when ungranted). One definition so
     Sort/Reduce/GroupBy spill behavior can never silently diverge."""
-    return BlockPool(spill_dir=spill_dir,
-                     soft_limit=max((mem_limit or 256 << 20) // 4,
-                                    8 << 20))
+    soft = resident_override()
+    if soft is None:
+        soft = max((mem_limit or 256 << 20) // 4, 8 << 20)
+    return BlockPool(spill_dir=spill_dir, soft_limit=soft)
 
 
 class BlockPool:
@@ -116,17 +136,26 @@ class BlockPool:
         # one policy per pool, not per block (env knobs are stable for
         # a pool's lifetime)
         self._policy = default_policy()
+        # cumulative payload bytes accepted by put() — the write-behind
+        # accounting hook (em_sort measures a spill job's bytes as the
+        # delta across its writes; single-writer FIFO makes that exact)
+        self.bytes_put = 0
         self._refs: Dict[int, int] = {}   # shared-Block refcounts (>1)
         self._ref_lock = threading.Lock()
         if self.native:
             self._h = self._lib.bs_create(spill_dir.encode(), soft_limit,
                                           1 if async_io else 0)
         else:
-            # pure-python fallback: resident dict + synchronous spill
-            # to disk past the soft limit, the same degradation ladder
-            # as the native store (a host without a compiler must not
-            # grow unbounded — it gets slower, not bigger). Spill files
-            # carry the native pid/store/host naming so
+            # pure-python fallback: resident dict + spill to disk past
+            # the soft limit, the same degradation ladder as the
+            # native store (a host without a compiler must not grow
+            # unbounded — it gets slower, not bigger). With
+            # ``async_io`` (and THRILL_TPU_WRITEBACK on) the spill
+            # writes ride a bounded write-behind thread like the
+            # native store's writer — Put never blocks on disk; the
+            # block stays RAM-resident until its write completes, so a
+            # failed flush degrades to over-budget, never data loss.
+            # Spill files carry the native pid/store/host naming so
             # purge_stale_spills reclaims them after a kill -9.
             self._blocks: Dict[int, bytes] = {}   # resident (insertion=LRU)
             self._spilled: Dict[int, str] = {}    # block id -> file path
@@ -136,6 +165,10 @@ class BlockPool:
             self._mem = 0
             self._spill_dir = spill_dir
             self._host_tag = _sanitized_host()
+            self._py_lock = threading.RLock()
+            self._async_io = async_io
+            self._writer = None                   # lazy AsyncWriter
+            self._inflight: Dict[int, int] = {}   # bid -> len(data)
 
     # -- pure-python spill ladder ---------------------------------------
     def _spill_path(self, block_id: int) -> str:
@@ -148,34 +181,100 @@ class BlockPool:
         """Evict coldest unpinned resident blocks to disk until the
         resident bytes fit the soft limit. A failed write keeps the
         block resident (over budget beats data loss), mirroring the
-        native store's failed-spill handling."""
+        native store's failed-spill handling. With write-behind armed
+        the evictions are POSTED to the bounded writer thread and the
+        caller returns immediately; the block leaves RAM only when its
+        bytes are durably on disk."""
         if self._soft <= 0 or self._mem <= self._soft:
             return
-        for bid in list(self._blocks.keys()):
-            if self._mem <= self._soft:
-                break
-            if self._pins.get(bid, 0) > 0:
-                continue
-            data = self._blocks[bid]
-            path = self._spill_path(bid)
+        if self._async_io:
+            from .writeback import writeback_enabled
+            if writeback_enabled():
+                return self._spill_async_py()
+        # synchronous path: the same write-then-locked-move job the
+        # writer thread runs (readahead threads may hold _py_lock in
+        # get()/resident() concurrently even in sync-writeback mode,
+        # so the mutations must take the lock here too)
+        with self._py_lock:
+            victims = [(bid, self._blocks[bid])
+                       for bid in self._blocks
+                       if self._pins.get(bid, 0) <= 0]
+        for bid, data in victims:
+            with self._py_lock:
+                if self._mem <= self._soft:
+                    break
+                if bid not in self._blocks:
+                    continue
+            self._spill_job(bid, data)
+
+    # -- write-behind spill (fallback store) ----------------------------
+    def _get_writer(self):
+        if self._writer is None:
+            from .writeback import AsyncWriter
+            # degrade semantics, not poison: a failed eviction write
+            # keeps the block resident — over budget beats data loss,
+            # exactly the synchronous path's contract
+            self._writer = AsyncWriter("data.blockpool.spill",
+                                       poison=False,
+                                       on_error=self._spill_failed)
+        return self._writer
+
+    def _spill_failed(self, exc: BaseException, bid) -> None:
+        with self._py_lock:
+            self._inflight.pop(bid, None)
+
+    def _spill_async_py(self) -> None:
+        """Post enough unpinned cold blocks to the write-behind queue
+        that the PROJECTED residency (current minus in-flight) fits
+        the soft limit; each block leaves ``_blocks`` only when its
+        file is fully written."""
+        writer = self._get_writer()
+        with self._py_lock:
+            projected = self._mem - sum(self._inflight.values())
+            victims = []
+            for bid in list(self._blocks.keys()):
+                if projected <= self._soft:
+                    break
+                if self._pins.get(bid, 0) > 0 or bid in self._inflight:
+                    continue
+                victims.append((bid, self._blocks[bid]))
+                self._inflight[bid] = len(self._blocks[bid])
+                projected -= len(self._blocks[bid])
+        for bid, data in victims:
+            writer.submit(
+                lambda bid=bid, data=data: self._spill_job(bid, data),
+                tag=bid)
+
+    def _spill_job(self, bid: int, data: bytes) -> int:
+        """One write-behind eviction (runs on the writer thread)."""
+        path = self._spill_path(bid)
+        try:
+            with open(path, "wb") as f:
+                f.write(data)
+        except OSError as e:
             try:
-                with open(path, "wb") as f:
-                    f.write(data)
-            except OSError as e:
-                # a mid-write failure (ENOSPC) leaves a truncated file
-                # close() would never sweep (it is not in _spilled) —
-                # unlink it; it is consuming exactly the disk whose
-                # shortage failed the spill
+                os.unlink(path)
+            except OSError:
+                pass
+            faults.note("recovery", what="blockpool.spill_skipped",
+                        block=bid, error=repr(e)[:200])
+            with self._py_lock:
+                self._inflight.pop(bid, None)
+            return 0
+        with self._py_lock:
+            self._inflight.pop(bid, None)
+            if bid not in self._blocks or self._pins.get(bid, 0) > 0:
+                # dropped or pinned while the write was in flight: the
+                # RAM copy stays authoritative; discard the file
                 try:
                     os.unlink(path)
                 except OSError:
                     pass
-                faults.note("recovery", what="blockpool.spill_skipped",
-                            block=bid, error=repr(e)[:200])
-                continue
+                return 0
             self._spilled[bid] = path
             del self._blocks[bid]
             self._mem -= len(data)
+        return len(data)
 
     def put(self, data: bytes) -> int:
         return self._policy.run(lambda: self._put_once(data),
@@ -183,12 +282,14 @@ class BlockPool:
 
     def _put_once(self, data: bytes) -> int:
         faults.check(_F_PUT, nbytes=len(data))
+        self.bytes_put += len(data)
         if self.native:
             return self._lib.bs_put(self._h, data, len(data))
-        bid = self._next
-        self._next += 1
-        self._blocks[bid] = bytes(data)
-        self._mem += len(data)
+        with self._py_lock:
+            bid = self._next
+            self._next += 1
+            self._blocks[bid] = bytes(data)
+            self._mem += len(data)
         self._maybe_spill_py()
         return bid
 
@@ -207,39 +308,58 @@ class BlockPool:
             if rc != 0:
                 raise IOError(f"block {block_id} fetch failed rc={rc}")
             return buf.raw[:size]
-        if block_id in self._blocks:
-            return self._blocks[block_id]
-        path = self._spilled.get(block_id)
+        with self._py_lock:
+            if block_id in self._blocks:
+                return self._blocks[block_id]
+            path = self._spilled.get(block_id)
         if path is None:
             raise KeyError(f"unknown block {block_id}")
         with open(path, "rb") as f:
             return f.read()
 
+    def resident(self, block_id: int) -> bool:
+        """Is the block servable from RAM (no disk read)? Drives the
+        surgical merge readahead: a background fetch only pays for
+        itself when the demand read would actually touch disk, so
+        RAM-resident blocks are read inline. Unknown ids report True —
+        the demand read is where a missing block must surface."""
+        if self.native:
+            return self._lib.bs_resident(self._h, block_id) != 0
+        with self._py_lock:
+            # unknown ids (not spilled either) report True, matching
+            # the native -1 mapping: the DEMAND read surfaces them
+            return block_id in self._blocks \
+                or block_id not in self._spilled
+
     def pin(self, block_id: int) -> None:
         if self.native:
             self._lib.bs_pin(self._h, block_id)
         else:
-            self._pins[block_id] = self._pins.get(block_id, 0) + 1
+            with self._py_lock:
+                self._pins[block_id] = self._pins.get(block_id, 0) + 1
 
     def unpin(self, block_id: int) -> None:
         if self.native:
             self._lib.bs_unpin(self._h, block_id)
         else:
-            n = self._pins.get(block_id, 0) - 1
-            if n > 0:
-                self._pins[block_id] = n
-            else:
-                self._pins.pop(block_id, None)
+            with self._py_lock:
+                n = self._pins.get(block_id, 0) - 1
+                if n > 0:
+                    self._pins[block_id] = n
+                else:
+                    self._pins.pop(block_id, None)
 
     def drop(self, block_id: int) -> None:
         if self.native:
             self._lib.bs_drop(self._h, block_id)
         else:
-            data = self._blocks.pop(block_id, None)
-            if data is not None:
-                self._mem -= len(data)
-            self._pins.pop(block_id, None)
-            path = self._spilled.pop(block_id, None)
+            with self._py_lock:
+                data = self._blocks.pop(block_id, None)
+                if data is not None:
+                    self._mem -= len(data)
+                self._pins.pop(block_id, None)
+                self._inflight.pop(block_id, None)
+                path = self._spilled.pop(block_id, None)
             if path is not None:
                 try:
                     os.unlink(path)
@@ -268,22 +388,29 @@ class BlockPool:
         """Wait for every queued/in-flight spill write to complete."""
         if self.native:
             self._lib.bs_flush(self._h)
+        elif self._writer is not None:
+            self._writer.flush()
 
     @property
     def pending_spills(self) -> int:
-        return self._lib.bs_pending(self._h) if self.native else 0
+        if self.native:
+            return self._lib.bs_pending(self._h)
+        with self._py_lock:
+            return len(self._inflight)
 
     @property
     def mem_usage(self) -> int:
         if self.native:
             return self._lib.bs_mem_usage(self._h)
-        return self._mem
+        with self._py_lock:
+            return self._mem
 
     @property
     def num_blocks(self) -> int:
         if self.native:
             return self._lib.bs_num_blocks(self._h)
-        return len(self._blocks) + len(self._spilled)
+        with self._py_lock:
+            return len(self._blocks) + len(self._spilled)
 
     def close(self) -> None:
         if self.native:
@@ -291,14 +418,22 @@ class BlockPool:
                 self._lib.bs_destroy(self._h)
                 self._h = None
         else:
-            for path in self._spilled.values():
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
-            self._spilled.clear()
-            self._blocks.clear()
-            self._mem = 0
+            if self._writer is not None:
+                # abandon the eviction backlog (those blocks are still
+                # RAM-resident — nothing is lost) and join the thread
+                # so no late job races the file sweep below
+                self._writer.close(drain=False)
+                self._writer = None
+            with self._py_lock:
+                for path in self._spilled.values():
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                self._spilled.clear()
+                self._blocks.clear()
+                self._inflight.clear()
+                self._mem = 0
 
     def __del__(self):  # pragma: no cover
         try:

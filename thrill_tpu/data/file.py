@@ -73,6 +73,67 @@ class File:
                 yield it
             b.release()
 
+    def prefetch_reader(self, consume: bool = False,
+                        submit=None) -> Iterator[Any]:
+        """Keep/consume reader with ONE block read ahead on a shared
+        readahead pool — the k-way merge's per-run prefetch slot
+        (reference: BlockPool prefetch, thrill/data/block_pool.hpp:177):
+        while this run's current block decodes and drains, its next
+        block's bytes are already being fetched from the spill store,
+        so the merge winner's successor block is resident when the
+        tournament needs it.
+
+        ``submit`` is a readahead executor's submit (data/writeback.py
+        ``make_readahead``); None degrades to the plain reader. A
+        background fetch failure falls back to a demand read on the
+        consumer thread — never wrong data. With ``consume``, a
+        generator abandoned mid-stream may strand its <= 2 in-flight
+        blocks until ``pool.close()`` (callers already clear files and
+        close the pool in their cleanup)."""
+        if submit is None:
+            return self.consume_reader() if consume \
+                else self.keep_reader()
+        return self._prefetch_iter(consume, submit)
+
+    def _prefetch_iter(self, consume: bool, submit) -> Iterator[Any]:
+        from .serializer import deserialize_slice
+        from .writeback import readahead_get, readahead_job
+        pool = self.pool
+        idx = 0
+
+        def next_block():
+            nonlocal idx
+            if consume:
+                return self.blocks.pop(0) if self.blocks else None
+            if idx < len(self.blocks):
+                idx += 1
+                return self.blocks[idx - 1]
+            return None
+
+        def start(b):
+            # surgical readahead: a RAM-resident block's get is a
+            # memcpy — backgrounding it buys queue overhead, not
+            # latency. Only blocks a demand read would fault in from
+            # disk ride the pool.
+            if pool.resident(b.bid):
+                return None
+            return submit(readahead_job(
+                lambda: pool.get(b.bid), "file.prefetch"))
+
+        b = next_block()
+        fut = start(b) if b is not None else None
+        while b is not None:
+            nb = next_block()
+            nfut = start(nb) if nb is not None else None
+            raw = readahead_get(fut, lambda blk=b: pool.get(blk.bid),
+                                "file.prefetch")
+            items = deserialize_slice(raw, b.lo, b.hi) if b.hi > b.lo \
+                else []
+            yield from items
+            if consume:
+                b.release()
+            b, fut = nb, nfut
+
     def _cumulative(self) -> List[int]:
         out = [0]
         for b in self.blocks:

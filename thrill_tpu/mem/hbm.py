@@ -63,28 +63,78 @@ class SpilledShards:
         self.leaf_meta = leaf_meta   # (dtype, global shape) per leaf
 
     def restore(self):
+        """Rebuild the sharded device arrays, double-buffered: while
+        block k's host array uploads (``jax.device_put`` dispatches
+        asynchronously), block k+1's bytes are already being fetched
+        from the spill store on a readahead thread — the HBM-pressure
+        analog of the prefetching vfs reader, reusing the same
+        surgical policy (RAM-resident blocks read inline) and degrade
+        contract (a background failure falls back to the demand read,
+        which owns the retry machinery). ``THRILL_TPU_PREFETCH=0``
+        restores the strictly sequential ladder."""
         from ..data.shards import DeviceShards
+        from ..data.writeback import make_readahead, overlapped_fetch
+        from ..vfs.file_io import prefetch_depth
+        from ..common.iostats import IO as _IOSTATS
         import jax
         mex = self.mesh_exec
-        leaves = []
-        for blocks, (dt, shape) in zip(self.leaf_blocks, self.leaf_meta):
-            shard_shape = (1,) + tuple(shape[1:])
-            singles = []
-            for dev_pos, bid in blocks:
-                # injection-only site (real storage faults retry
-                # inside pool.get, data.blockstore.get — wrapping it
-                # here would nest two backoff budgets), so the
-                # disarmed steady state skips the policy machinery
-                if faults.REGISTRY.active():
-                    default_policy().run(
-                        lambda bid=bid: faults.check(_F_RESTORE,
-                                                     block=bid),
-                        what="hbm.restore")
-                raw = self.pool.get(bid)
-                arr = np.frombuffer(raw, dtype=dt).reshape(shard_shape)
-                singles.append(jax.device_put(arr, mex.devices[dev_pos]))
-            leaves.append(jax.make_array_from_single_device_arrays(
-                tuple(shape), mex.sharded, singles))
+
+        def fetch(item):
+            li, dev_pos, bid = item
+            # injection-only site (real storage faults retry inside
+            # pool.get, data.blockstore.get — wrapping it here would
+            # nest two backoff budgets), so the disarmed steady state
+            # skips the policy machinery
+            if faults.REGISTRY.active():
+                default_policy().run(
+                    lambda: faults.check(_F_RESTORE, block=bid),
+                    what="hbm.restore")
+            return self.pool.get(bid)
+
+        flat = [(li, dev_pos, bid)
+                for li, blocks in enumerate(self.leaf_blocks)
+                for dev_pos, bid in blocks]
+        depth = prefetch_depth()
+        pl = getattr(mex, "planner", None)
+        if pl is not None and pl.enabled:
+            depth = pl.io_prefetch_depth("hbm.restore", depth)
+        ra = make_readahead(depth) if len(flat) > 1 else None
+        singles_per_leaf = [[] for _ in self.leaf_blocks]
+        st: dict = {}
+        tr = getattr(mex, "tracer", None)
+        from ..common.trace import span_of
+        try:
+            with span_of(tr, "io", "hbm_restore", blocks=len(flat),
+                         depth=depth if ra is not None else 0):
+                for (li, dev_pos, _bid), raw in overlapped_fetch(
+                        flat, fetch, "hbm.restore", ra,
+                        skip_fn=lambda it: self.pool.resident(it[2]),
+                        stats=st):
+                    dt, shape = self.leaf_meta[li]
+                    arr = np.frombuffer(raw, dtype=dt).reshape(
+                        (1,) + tuple(shape[1:]))
+                    singles_per_leaf[li].append(
+                        jax.device_put(arr, mex.devices[dev_pos]))
+        finally:
+            if ra is not None:
+                ra.shutdown(wait=True, cancel_futures=True)
+        overlapped = st.get("prefetched", 0)
+        if overlapped:
+            _IOSTATS.add(restore_overlaps=1)
+            log = getattr(mex, "logger", None)
+            if log is not None and log.enabled:
+                log.line(event="restore_overlap", kind="hbm",
+                         blocks=len(flat), prefetched=overlapped)
+            from ..common.decisions import record_of
+            record_of(mex, "io_prefetch", "hbm.restore",
+                      f"depth={depth}",
+                      reason="overlap next block's read with the "
+                             "current upload",
+                      blocks=len(flat), prefetched=overlapped)
+        leaves = [jax.make_array_from_single_device_arrays(
+                      tuple(shape), mex.sharded, singles)
+                  for singles, (dt, shape) in zip(singles_per_leaf,
+                                                  self.leaf_meta)]
         tree = jax.tree.unflatten(self.treedef, leaves)
         return DeviceShards(mex, tree, self.counts)
 
@@ -132,7 +182,11 @@ class HbmGovernor:
                     host_ram = 8 << 30
             # past this soft limit the store evicts to disk: the
             # HBM -> host DRAM -> disk ladder
-            soft = MemoryConfig.split(host_ram).ram_block_pool_soft
+            # (THRILL_TPU_SPILL_RESIDENT pins it for tests/bench)
+            from ..data.block_pool import resident_override
+            soft = resident_override()
+            if soft is None:
+                soft = MemoryConfig.split(host_ram).ram_block_pool_soft
             self._pool = BlockPool(spill_dir=cfg.spill_dir,
                                    soft_limit=soft)
         return self._pool

@@ -335,6 +335,16 @@ class PressureMonitor:
             if admission:
                 self.admission_spills += 1
             self.spilled_bytes += freed
+            # the eviction choice in the decision ledger: which policy
+            # (LRU-cold) ran, what it was asked to free, what it freed
+            # — ctx.explain()'s I/O coverage alongside io_prefetch
+            from ..common.decisions import record_of, resolve_of
+            rec = record_of(self.mex, "io_evict", "mem.pressure",
+                            "spill-lru-cold",
+                            predicted=need if need else None,
+                            reason="admission watermark" if admission
+                            else "oom-retry ladder")
+            resolve_of(self.mex, rec, freed)
         return freed
 
     def _trace_rung(self, rung: str, **attrs) -> None:

@@ -104,6 +104,7 @@ def render_html(events: List[dict]) -> str:
     overall = []       # overall_stats summary lines
     device_xchg: dict = {}   # host -> ordered device-plane exchanges
     memory = []        # hbm_spill / hbm_restore / mem_negotiate / demotion
+    io_events = []     # prefetch / writeback / restore_overlap (ISSUE 13)
     faults = []        # fault_injected / retry / recovery / abort
     decisions = []     # decision / decision_audit (common/decisions.py)
     t0 = min((e["ts"] for e in events), default=0)
@@ -134,6 +135,9 @@ def render_html(events: List[dict]) -> str:
                                 "host_replicate", "mem_spill",
                                 "oom_retry", "segment_split"):
             memory.append((t, e))
+        elif e.get("event") in ("prefetch", "writeback",
+                                "restore_overlap"):
+            io_events.append((t, e))
         elif e.get("event") in ("fault_injected", "retry", "recovery",
                                 "abort", "pipeline_abort", "heal"):
             # the abort/heal lane: scoped pipeline failures and their
@@ -215,6 +219,7 @@ td.hm {{ min-width: 3em; }}
 {_render_wire_lane(overall)}
 {_render_worker_lanes(exchanges, total)}
 {_render_memory_events(memory, total)}
+{_render_io_lane(io_events, overall)}
 {_render_fused_dispatches(fused, overall)}
 {_render_decisions(decisions, overall)}
 {_render_service_jobs(jobs, overall, total)}
@@ -701,6 +706,69 @@ def _render_memory_events(memory, total: float) -> str:
     if not lanes:
         return ""
     return "<h2>memory pressure</h2>" + "".join(lanes)
+
+
+def _render_io_lane(io_events, overall) -> str:
+    """Out-of-core I/O lane (ISSUE 13): per-site prefetch summaries
+    (hits/misses/wait), write-behind flush summaries (bytes/jobs), and
+    restore-overlap markers, with the run's overlap ledger from
+    overall_stats (hit rate, io_wait vs io_busy, write-behind volume,
+    queue high-water mark)."""
+    if not io_events and not any(
+            o.get("io_busy_s") for o in overall):
+        return ""
+    rows = []
+    for _, e in io_events:
+        kind = e.get("event")
+        if kind == "prefetch":
+            detail = (f"hits {e.get('hits', 0)} · misses "
+                      f"{e.get('misses', 0)} · wait "
+                      f"{e.get('wait_s', 0):.3f}s · depth "
+                      f"{e.get('depth', '?')}")
+            where = e.get("what") or e.get("path", "?")
+        elif kind == "writeback":
+            detail = (f"{(e.get('bytes', 0) or 0) / 1e6:.1f} MB · "
+                      f"{e.get('jobs', 0)} jobs"
+                      f"{' · SYNC' if e.get('sync') else ''}")
+            where = e.get("what", "?")
+        else:                                   # restore_overlap
+            detail = (f"{e.get('prefetched', 0)} of "
+                      f"{e.get('blocks', e.get('files', '?'))} blocks "
+                      f"prefetched")
+            where = e.get("kind", "?")
+        rows.append(f"<tr><td class='l'>{kind}</td>"
+                    f"<td class='l'>{html.escape(str(where))}</td>"
+                    f"<td class='l'>{detail}</td></tr>")
+    # one aggregate over every host's overall_stats line (flows sum,
+    # the queue peak maxes), through the ONE formula definition
+    # (common/iostats.py) so report and stats can never diverge
+    from ..common.iostats import hit_rate, overlap_frac
+    agg = {"prefetch_hits": 0, "prefetch_misses": 0, "io_wait_s": 0.0,
+           "io_busy_s": 0.0, "writeback_bytes": 0,
+           "writeback_queue_peak": 0, "restore_overlaps": 0}
+    for o in overall:
+        for k in agg:
+            v = o.get(k, 0) or 0
+            agg[k] = max(agg[k], v) if k == "writeback_queue_peak" \
+                else agg[k] + v
+    summary = ""
+    if agg["io_busy_s"]:
+        n = agg["prefetch_hits"] + agg["prefetch_misses"]
+        summary = (
+            f"<p>prefetch hit rate {hit_rate(agg):.2f} "
+            f"({agg['prefetch_hits']}/{n})"
+            f" · io_wait {agg['io_wait_s']:.3f}s of "
+            f"{agg['io_busy_s']:.3f}s busy "
+            f"(overlap {overlap_frac(agg):.2f})"
+            f" · write-behind {agg['writeback_bytes'] / 1e6:.1f} MB, "
+            f"queue peak {agg['writeback_queue_peak']}"
+            f" · {agg['restore_overlaps']} overlapped restores</p>")
+    if not rows and not summary:
+        return ""
+    table = ("<table><tr><th class='l'>event</th><th class='l'>site"
+             "</th><th class='l'>detail</th></tr>"
+             + "".join(rows) + "</table>") if rows else ""
+    return "<h2>out-of-core I/O</h2>" + summary + table
 
 
 def _render_exchange_volume(exchanges, total: float) -> str:
