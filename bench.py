@@ -442,6 +442,7 @@ def _wordcount_metric(ctx, n: int) -> dict:
     import collections
     from thrill_tpu.api import FieldReduce
     try:
+        doc_snap = _doctor_snapshot(getattr(ctx, "doctor", None))
         rng = np.random.default_rng(1)
         vocab_n = max(1024, n // 64)
         ids = np.minimum(rng.zipf(1.3, size=n) - 1, vocab_n - 1)
@@ -471,9 +472,14 @@ def _wordcount_metric(ctx, n: int) -> dict:
         host_dt, host_disp = _best_of(
             lambda: collections.Counter(strs), iters=2)
         _note_dispersion(host_disp)
+        # doctor lane (common/doctor.py): this lane's zipf keys are
+        # the bench's natural skew probe — per-lane deltas, so earlier
+        # lanes' waits/skew on the shared ctx cannot leak in
         return {"wordcount_mitems_s": round(n / dt / 1e6, 3),
                 "wordcount_vs_counter": round(host_dt / dt, 3),
-                "wordcount_disp": disp}
+                "wordcount_disp": disp,
+                **_doctor_fields(getattr(ctx, "doctor", None),
+                                 doc_snap, "wordcount")}
     except Exception as e:  # secondary metric never kills the line
         return {"wordcount_error": repr(e)[:200]}
 
@@ -507,6 +513,33 @@ def _loop_phase_fields(ctx, name: str, prefix: str) -> dict:
             f"{prefix}_plan_builds": r["captures"],
             f"{prefix}_replay_s": round(r["replay_s"], 4),
             f"{prefix}_capture_s": round(r["capture_s"], 4)}
+
+
+def _doctor_snapshot(doc) -> tuple | None:
+    """Per-lane doctor baseline: (exchange-wait seconds, per-site
+    exchange counts) — the shared bench ctx accumulates doctor state
+    across lanes, so each lane must report DELTAS, the _xchg_snapshot
+    pattern."""
+    if doc is None:
+        return None
+    return (doc.wait_exchange_s,
+            {s: st["exchanges"] for s, st in doc.skew_by_site.items()})
+
+
+def _doctor_fields(doc, snap, prefix: str) -> dict:
+    """This lane's exchange-barrier wait and the worst skew ratio
+    among sites whose exchange count GREW during the lane (a site's
+    ratio is its own pipeline's — bench lanes don't share exchange
+    call sites)."""
+    if doc is None or snap is None:
+        return {f"{prefix}_skew_ratio": 0.0,
+                f"{prefix}_xchg_wait_s": 0.0}
+    wait0, sites0 = snap
+    ratios = [st["ratio"] for s, st in doc.skew_by_site.items()
+              if st["exchanges"] > sites0.get(s, 0)]
+    return {f"{prefix}_skew_ratio": round(max(ratios, default=0.0), 3),
+            f"{prefix}_xchg_wait_s": round(
+                max(doc.wait_exchange_s - wait0, 0.0), 4)}
 
 
 def _xchg_snapshot(mex) -> tuple:
@@ -816,6 +849,7 @@ def _serve_metric(ctx) -> dict:
 
         _examples_path()
         import page_rank as pr
+        doc_snap = _doctor_snapshot(getattr(ctx, "doctor", None))
         n_wc = 1 << 13
         edges = pr.zipf_graph(512, 1 << 12, seed=5)
         try:
@@ -898,6 +932,21 @@ def _serve_metric(ctx) -> dict:
             else 0,
             "serve_planner_replans": int(
                 stats.get("planner_replans", 0)),
+            # deterministic-bucket twins of the wall-clock quantiles:
+            # the scheduler's per-tenant log2 histograms (ISSUE 14;
+            # worst tenant shown — the per-tenant split lives in
+            # overall_stats serve_p50_ms/serve_p99_ms)
+            "serve_hist_p50_ms": max(
+                (stats.get("serve_p50_ms") or {}).values(),
+                default=0.0),
+            "serve_hist_p99_ms": max(
+                (stats.get("serve_p99_ms") or {}).values(),
+                default=0.0),
+            # doctor lane: the serve lane's OWN exchange-barrier
+            # seconds and worst skew (per-lane deltas — the shared
+            # ctx's lifetime totals include every earlier lane)
+            **_doctor_fields(getattr(ctx, "doctor", None), doc_snap,
+                             "serve"),
         }
     except Exception as e:  # secondary metric never kills the line
         return {"serve_error": repr(e)[:200]}

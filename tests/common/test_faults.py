@@ -67,6 +67,30 @@ def test_spec_is_deterministic_per_seed(monkeypatch):
     assert 0 < sum(a) < 32              # actually probabilistic
 
 
+def test_delay_spec_sleeps_instead_of_raising(monkeypatch):
+    """site:delay=50ms sleeps at the site (latency mode) — no raise,
+    counted under faults_delayed; duration suffixes parse; a negative
+    delay is malformed and skipped loudly."""
+    import time as _time
+    faults.declare("t.lat")
+    monkeypatch.setenv(faults.ENV_VAR, "t.lat:delay=20ms:n=2")
+    t0 = _time.perf_counter()
+    faults.check("t.lat")               # must NOT raise
+    assert _time.perf_counter() - t0 >= 0.015
+    st = faults.REGISTRY.stats()
+    assert st["faults_delayed"] == 1
+    assert st["faults_injected"] == 0
+    assert faults.parse_duration_s("2s") == 2.0
+    assert faults.parse_duration_s("0.25") == 0.25
+    with pytest.raises(ValueError):
+        faults.parse_duration_s("-5ms")
+    # malformed delay disables the entry, not the parser
+    assert faults.parse_spec("a.b:delay=oops") == []
+    # an event record lands in the same stream as raising fires
+    assert any(e.get("kind") == "delay"
+               for e in faults.REGISTRY.events)
+
+
 def test_wildcard_patterns_and_malformed_entries(monkeypatch, capsys):
     faults.declare("t.wild.one")
     faults.declare("t.wild.two")
@@ -551,6 +575,71 @@ def _ex_vfs_read_reopen(tmp_path=None):
     assert faults.REGISTRY.stats()["retries"] == 2
 
 
+def _ex_vfs_read_delay():
+    """vfs.read.delay (ISSUE 14 latency mode): armed WITH delay= the
+    read SLEEPS (deterministic slow disk — bytes identical, counted
+    under faults_delayed); armed WITHOUT delay= it raises inside the
+    same transient-retry scope as vfs.read."""
+    import tempfile
+    import time as _time
+    from thrill_tpu.vfs import file_io
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "data.txt")
+        payload = b"delay-me\n" * 64
+        with open(p, "wb") as f:
+            f.write(payload)
+        with faults.inject("vfs.read.delay", n=2, delay=0.02):
+            t0 = _time.perf_counter()
+            r = file_io.RetryingReader(p)
+            try:
+                assert r.read() == payload
+            finally:
+                r.close()
+            assert _time.perf_counter() - t0 >= 0.015
+        assert faults.REGISTRY.stats()["faults_delayed"] >= 1
+        assert faults.REGISTRY.injected == 0      # slept, never raised
+        base = faults.REGISTRY.stats()["retries"]
+        with faults.inject("vfs.read.delay", n=1, seed=3):
+            r = file_io.RetryingReader(p)
+            try:
+                assert r.read() == payload        # retried + reopened
+            finally:
+                r.close()
+        assert faults.REGISTRY.stats()["retries"] > base
+
+
+def _ex_net_group_delay():
+    """net.group.delay.r<rank> (ISSUE 14 latency mode): a delay arm
+    slows exactly the named rank at collective entry — the collective
+    still completes and the straggler is visible in faults_delayed
+    (the doctor's wait attribution pins the rank,
+    tests/common/test_doctor.py). Armed WITHOUT delay= it raises at
+    collective entry, before any frame is sent — a clean error."""
+    import threading
+    from thrill_tpu.net.mock import MockNetwork
+    groups = MockNetwork.construct(2)
+    errs = []
+    with faults.inject("net.group.delay.r1", n=2, delay=0.01):
+        def run(g):
+            try:
+                assert g.all_reduce(g.my_rank + 1) == 3
+            except BaseException as e:  # surfaced below
+                errs.append(e)
+        ts = [threading.Thread(target=run, args=(g,), daemon=True)
+              for g in groups]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+            assert not t.is_alive()
+    assert not errs, errs
+    assert faults.REGISTRY.stats()["faults_delayed"] >= 1
+    with faults.inject("net.group.delay.r0", n=1):
+        with pytest.raises(faults.InjectedFault):
+            with groups[0]._at("barrier"):
+                pass
+
+
 def _ex_vfs_prefetch_degrades():
     """vfs.prefetch: a background readahead failure DEGRADES to demand
     reads at the exact consumed position — bytes identical, recovery
@@ -839,6 +928,11 @@ _MATRIX = {
     "service.plan_store.corrupt": _ex_plan_store_corrupt,
     "vfs.open_read": _ex_vfs_read_reopen,
     "vfs.read": _ex_vfs_read_reopen,
+    # latency-injection fault mode (ISSUE 14): delay= arms SLEEP at
+    # the site instead of raising — the deterministic straggler/slow-
+    # disk generators the doctor's attribution tests build on
+    "vfs.read.delay": _ex_vfs_read_delay,
+    "net.group.delay*": _ex_net_group_delay,
     # out-of-core tier (ISSUE 13): background readahead degrades to
     # demand reads; a write-behind flush failure poisons (em spill) or
     # degrades to RAM residency (blockpool eviction) — never loss

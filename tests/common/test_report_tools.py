@@ -40,6 +40,9 @@ def test_json2profile_main(tmp_path, monkeypatch, capsys):
     html = capsys.readouterr().out
     assert html.startswith("<!doctype html>")
     assert "stage timeline" in html and "Sort" in html
+    # skew lane (ISSUE 14): the exchange lines carry skew_ratio /
+    # hot_worker, rendered as the per-site partition-skew table
+    assert "partition skew" in html and "exchange site" in html
 
 
 def test_trace2perfetto_main(tmp_path, monkeypatch, capsys):
@@ -57,6 +60,66 @@ def test_trace2perfetto_main(tmp_path, monkeypatch, capsys):
     # flat log events ride the "log" lane next to the spans
     assert any(e.get("cat") == "log" and e.get("name") == "exchange"
                for e in evs)
+
+
+def test_trace2perfetto_merge_two_ranks(tmp_path, monkeypatch, capsys):
+    """--merge golden smoke over two ranks' logs (ISSUE 14): the
+    merged trace keeps ONE pid lane per rank and the spans' job tags
+    stay correlated across both lanes."""
+    from thrill_tpu.common.logger import JsonLogger
+    from thrill_tpu.common.trace import Tracer
+    from thrill_tpu.tools import trace2perfetto
+    paths = []
+    for r in range(2):
+        p = os.path.join(str(tmp_path), f"events-host{r}.json")
+        log = JsonLogger(p, program="t", workers=2, host=r)
+        tr = Tracer(rank=r, logger=log)
+        tr.current_job = "jobA"
+        with tr.span("service", "job:jobA"):
+            with tr.span("exchange", "phase_a"):
+                pass
+        log.line(event="exchange", items=4)
+        log.close()
+        paths.append(p)
+    monkeypatch.setattr(sys, "argv",
+                        ["trace2perfetto", "--merge"] + paths)
+    trace2perfetto.main()
+    doc = json.loads(capsys.readouterr().out)
+    evs = doc["traceEvents"]
+    pids = {e["pid"] for e in evs
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert pids == {0, 1}                     # one pid lane per rank
+    spans = [e for e in evs if e.get("ph") == "X"]
+    assert {e["pid"] for e in spans} == {0, 1}
+    assert all(e["args"].get("job") == "jobA" for e in spans)
+    # the flat exchange log lines land on each rank's own log lane
+    logs = [e for e in evs
+            if e.get("cat") == "log" and e.get("name") == "exchange"]
+    assert {e["pid"] for e in logs} == {0, 1}
+    # merged stream is timestamp-ordered
+    ts = [e["ts"] for e in evs if e.get("ph") != "M"]
+    assert ts == sorted(ts)
+
+
+def test_doctor_report_main(tmp_path, monkeypatch, capsys):
+    """Offline doctor report (tools/doctor_report.py) over a real
+    run's log: wait decomposition, skew table, critical path."""
+    from thrill_tpu.tools import doctor_report
+    path = _make_log(tmp_path)
+    monkeypatch.setattr(sys, "argv", ["doctor_report", path])
+    doctor_report.main()
+    out = capsys.readouterr().out
+    assert "performance doctor" in out
+    assert "collective wait" in out
+    # the Sort pipeline's exchange span makes the critical path
+    assert "critical path" in out and "exchange" in out
+
+
+def test_doctor_report_usage_exit(monkeypatch):
+    from thrill_tpu.tools import doctor_report
+    monkeypatch.setattr(sys, "argv", ["doctor_report"])
+    with pytest.raises(SystemExit):
+        doctor_report.main()
 
 
 def test_plan_report_main(tmp_path, monkeypatch, capsys):

@@ -124,6 +124,48 @@ def test_concurrent_submission_bit_identical_to_serial(W):
     assert stats["pipeline_aborts"] == 1
 
 
+def test_latency_histogram_quantiles_and_stats():
+    """ISSUE 14: deterministic log2-bucket accept-to-result
+    histograms per tenant — the bucket/quantile math unit-pinned, the
+    per-tenant serve_p50/p99 surfaced in overall_stats, and the
+    Prometheus text shape."""
+    from thrill_tpu.service.scheduler import (_lat_bucket,
+                                              _lat_quantile,
+                                              _LAT_BUCKETS)
+    # bucket i covers [2^(i-1), 2^i) ms; upper bound is the quantile
+    assert _lat_bucket(0.4) == 0
+    assert _lat_bucket(1.0) == 1
+    assert _lat_bucket(3.9) == 2
+    assert _lat_bucket(1e12) == _LAT_BUCKETS - 1
+    counts = [0] * _LAT_BUCKETS
+    counts[2] = 9                       # nine jobs in [2, 4) ms
+    counts[5] = 1                       # one tail job in [16, 32) ms
+    assert _lat_quantile(counts, 0.50) == 4.0
+    assert _lat_quantile(counts, 0.99) == 32.0
+    assert _lat_quantile([0] * _LAT_BUCKETS, 0.5) == 0.0
+
+    ctx = Context(MeshExec(num_workers=1))
+    try:
+        ctx.submit(_reduce_job, tenant="a").result(300)
+        ctx.submit(_reduce_job2, tenant="b").result(300)
+        ctx.submit(_reduce_job, tenant="a").result(300)
+        stats = ctx.overall_stats()
+        assert set(stats["serve_p50_ms"]) == {"a", "b"}
+        assert stats["serve_p50_ms"]["a"] > 0
+        assert stats["serve_p99_ms"]["a"] >= stats["serve_p50_ms"]["a"]
+        hist = ctx.service.latency_histogram()
+        counts_a, n_a, sum_a = hist["a"]
+        assert n_a == 2 and sum(counts_a) == 2 and sum_a > 0
+        # Prometheus export: cumulative buckets + count + sum
+        from thrill_tpu.common.metrics import render_prometheus
+        text = render_prometheus(ctx)
+        assert "thrill_tpu_serve_latency_ms_bucket" in text
+        assert 'tenant="a",le="+Inf"' in text
+        assert "thrill_tpu_serve_latency_ms_count" in text
+    finally:
+        ctx.close()
+
+
 def _boom_job(ctx):
     ctx.Distribute(np.arange(8, dtype=np.int64)).Map(_kv7).Size()
     raise ValueError("boom: user logic failed mid-pipeline")

@@ -124,6 +124,16 @@ class Context:
             lambda: getattr(self, "current_tenant", None)
         self.mesh_exec.tracer = self.tracer
         self.net.group.tracer = self.tracer
+        # performance doctor (common/doctor.py): per-peer collective
+        # wait attribution + partition-skew detection + the critical-
+        # path pass over the span ring. THRILL_TPU_DOCTOR=0 pins the
+        # disabled fast path (no Doctor anywhere: every choke point
+        # pays one attribute read, allocates nothing).
+        from ..common.doctor import Doctor, doctor_enabled
+        self.doctor = Doctor(rank=host_rank) if doctor_enabled() \
+            else None
+        self.mesh_exec.doctor = self.doctor
+        self.net.group.doctor = self.doctor
         # plan observatory (common/decisions.py): one DecisionLedger
         # per Context, attached to the mesh so every plan-choice choke
         # point (fusion, exchange, preshuffle, admission, plan store)
@@ -534,6 +544,18 @@ class Context:
             title=name or (getattr(pipeline_fn, "__name__", "")
                            if pipeline_fn is not None else ""))
 
+    def doctor_report(self, k: int = 5) -> dict:
+        """The performance doctor's full diagnosis for this Context:
+        wait attribution + straggler scores, per-site skew table, and
+        the critical path computed over the tracer's span ring (the
+        post-run pass; tools/doctor_report.py is the offline twin over
+        merged logs). Returns {} with THRILL_TPU_DOCTOR=0. Purely
+        observational — local state only, never a collective."""
+        if self.doctor is None:
+            return {}
+        ring = self.tracer.ring if self.tracer.enabled else None
+        return self.doctor.report(ring=ring or (), k=k)
+
     def overall_stats(self, local_only: bool = False) -> dict:
         """End-of-job summary (reference: OverallStats AllReduce,
         api/context.cpp:1235-1341). In multi-process runs the per-host
@@ -664,6 +686,22 @@ class Context:
                 k: v["mae_log2"]
                 for k, v in self.decisions.accuracy().items()
                 if v.get("mae_log2") is not None},
+            # performance doctor (common/doctor.py): seconds blocked
+            # at collectives/exchange barriers with the per-peer
+            # arrival deltas and the net/exchange/io/skew
+            # decomposition, plus the worst partition-skew ratio any
+            # exchange site observed
+            **(self.doctor.stats() if self.doctor is not None else
+               {"collective_wait_s": 0.0, "wait_net_s": 0.0,
+                "wait_exchange_s": 0.0, "wait_io_s": 0.0,
+                "wait_skew_s": 0.0, "straggler_waits": {},
+                "skew_ratio": 0.0}),
+            # service-plane latency histograms (service/scheduler.py):
+            # deterministic log2-bucket accept-to-result quantiles per
+            # tenant, {} until a job completed
+            **({"serve_p50_ms": {}, "serve_p99_ms": {}}
+               if self.service is None
+               else self.service.latency_quantiles()),
         }
         # durability layer (api/checkpoint.py): epochs committed, bytes
         # sealed, ops skipped by resume, time spent restoring
@@ -701,7 +739,10 @@ class Context:
             local_peaks = {"host_mem_peak", "recovery_time_s",
                            "hbm_high_watermark", "heal_time_s"}
             local_peaks |= {"writeback_queue_peak"}
-            local_sums = {"faults_injected", "retries", "recoveries",
+            # the worst skew any rank observed is the cluster's skew
+            local_peaks |= {"skew_ratio"}
+            local_sums = {"faults_injected", "faults_delayed",
+                          "retries", "recoveries",
                           "aborts", "ckpt_bytes_written", "oom_retries",
                           "segment_splits", "host_fallbacks",
                           "admission_spills", "pressure_spilled_bytes",
@@ -727,7 +768,13 @@ class Context:
                           # host 0's copy, the default). The
                           # tenant_hbm_peaks DICT also stays host 0's
                           # view: per-process governor ledgers.
-                          "tenant_spills"}
+                          "tenant_spills",
+                          # doctor wait ledgers are per-process blocked
+                          # seconds: cluster view sums them (the
+                          # straggler_waits DICT merges per-key below)
+                          "collective_wait_s", "wait_net_s",
+                          "wait_exchange_s", "wait_io_s",
+                          "wait_skew_s"}
             stats = {
                 k: (max(h[k] for h in per_host) if k in local_peaks
                     else sum(h.get(k, 0) for h in per_host)
@@ -741,6 +788,14 @@ class Context:
                 + stats["bytes_wire_host_saved"])
             stats["wire_compress_ratio"] = _wire_ratio(
                 stats["bytes_on_wire_raw"], stats["bytes_on_wire"])
+            # global straggler blame: rank r's score is the sum over
+            # EVERY rank of the seconds that rank spent waiting on r
+            merged_waits: dict = {}
+            for h in per_host:
+                for p, w in (h.get("straggler_waits") or {}).items():
+                    merged_waits[p] = merged_waits.get(p, 0.0) + w
+            stats["straggler_waits"] = {
+                p: round(w, 4) for p, w in sorted(merged_waits.items())}
             stats["hosts"] = len(per_host)
         return stats
 

@@ -859,9 +859,12 @@ def _fused_exchange_merge(mex, sorted_dest, words_mat, gidx_s,
         mex, cap_ident, (max(int(S.max()), 1), max(int(R.max()), 1)))
     mex.stats_padded_rows += W * M_pad
 
-    # carrier = payload + words matrix + gidx (the shipped columns)
+    # carrier = payload + words matrix + gidx (the shipped columns);
+    # the site tag keeps each Sort call site its own doctor skew
+    # bucket (same convention as the generic exchange paths)
     exchange.account_traffic(
-        mex, S, exchange.leaf_item_bytes(sorted_payload) + 8 * (nwords + 1))
+        mex, S, exchange.leaf_item_bytes(sorted_payload) + 8 * (nwords + 1),
+        site="xchg:" + exchange._ident_digest(cap_ident)[:10])
 
     Wp = 1 << (W - 1).bit_length()                # runs padded to pow2
     Np = Wp * M_pad

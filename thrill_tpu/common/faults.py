@@ -23,6 +23,12 @@ named, seeded, reproducible event:
     ``(seed, site)`` so two sites armed by one pattern fire
     independently but reproducibly (default 0)
   - ``after=<int>`` skip the first k eligible hits (default 0)
+  - ``delay=<dur>`` LATENCY mode: a fire SLEEPS for ``<dur>``
+    (``50ms``, ``2s`` or plain seconds) at the site instead of
+    raising — the deterministic way to CREATE a slow rank or a slow
+    disk, so straggler attribution (common/doctor.py) is testable
+    without real contention. Delayed fires are counted separately
+    (``faults_delayed``) and logged with ``kind=delay``.
 * Every trigger is recorded in :data:`REGISTRY` and logged as a JSON
   ``event=fault_injected`` line (visible to tools/json2profile.py)
   when a logger is attached (api/context.py attaches the Context's).
@@ -80,12 +86,13 @@ class _Arm:
     """One armed spec entry (pattern, probability, budget, RNG)."""
 
     def __init__(self, pattern: str, p: float, n: int, seed: int,
-                 after: int) -> None:
+                 after: int, delay: Optional[float] = None) -> None:
         self.pattern = pattern
         self.p = p
         self.n = n                  # 0 = unbounded
         self.seed = seed
         self.after = after
+        self.delay = delay          # seconds to sleep instead of raise
         self._rngs: Dict[str, random.Random] = {}
         self._fired: Dict[str, int] = {}
         self._seen: Dict[str, int] = {}
@@ -118,6 +125,7 @@ class FaultRegistry:
         self.sites: Dict[str, Site] = {}
         self.events: List[dict] = []      # recent fault_injected records
         self.injected = 0                 # total faults raised
+        self.delayed = 0                  # latency-mode fires (slept)
         self.retries = 0                  # retry-policy sleeps taken
         self.recoveries = 0               # successful recovery events
         self.aborts = 0                   # poison frames broadcast
@@ -173,25 +181,39 @@ class FaultRegistry:
             if site is None:
                 site = self.sites[name] = Site(name, TRANSIENT,
                                                InjectedIOError)
-            fired = False
+            fired_arm = None
             for arm in self._arms:
                 if arm.matches(name):
                     site.hits += 1
                     if arm.fire(name):
-                        fired = True
+                        fired_arm = arm
                         break
-            if not fired:
+            if fired_arm is None:
                 return
-            site.fires += 1
-            self.injected += 1
-            rec = {"event": "fault_injected", "site": name,
-                   "kind": site.kind, "fire": site.fires}
+            delay_s = fired_arm.delay
+            if delay_s is not None:
+                # latency mode: the fire SLEEPS at the site instead of
+                # raising — a deterministic straggler, not an error
+                self.delayed += 1
+                rec = {"event": "fault_injected", "site": name,
+                       "kind": "delay", "delay_s": delay_s}
+            else:
+                site.fires += 1
+                self.injected += 1
+                rec = {"event": "fault_injected", "site": name,
+                       "kind": site.kind, "fire": site.fires}
             rec.update(detail)
             self.events.append(rec)
             if len(self.events) > 1024:
                 del self.events[:512]
             log = self._log
         self._emit(log, rec)
+        if delay_s is not None:
+            # sleep OUTSIDE the registry lock: a delayed rank must not
+            # serialize every other thread's disarmed fast path
+            import time
+            time.sleep(delay_s)
+            return
         raise site.exc(name, site.kind)
 
     # -- observability -------------------------------------------------
@@ -246,6 +268,7 @@ class FaultRegistry:
     def stats(self) -> dict:
         with self._lock:
             return {"faults_injected": self.injected,
+                    "faults_delayed": self.delayed,
                     "retries": self.retries,
                     "recoveries": self.recoveries,
                     "aborts": self.aborts}
@@ -256,10 +279,24 @@ class FaultRegistry:
             self._spec = None
             self._arms = []
             self.events = []
-            self.injected = self.retries = 0
+            self.injected = self.retries = self.delayed = 0
             self.recoveries = self.aborts = 0
             for s in self.sites.values():
                 s.hits = s.fires = 0
+
+
+def parse_duration_s(v: str) -> float:
+    """``50ms`` / ``2s`` / plain seconds -> non-negative seconds."""
+    v = v.strip()
+    if v.endswith("ms"):
+        out = float(v[:-2]) / 1e3
+    elif v.endswith("s"):
+        out = float(v[:-1])
+    else:
+        out = float(v)
+    if out < 0:
+        raise ValueError(v)
+    return out
 
 
 def parse_spec(spec: str) -> List[_Arm]:
@@ -272,6 +309,7 @@ def parse_spec(spec: str) -> List[_Arm]:
             continue
         parts = entry.split(":")
         pattern, p, n, seed, after = parts[0].strip(), 1.0, 1, 0, 0
+        delay: Optional[float] = None
         ok = bool(pattern)
         for kv in parts[1:]:
             k, _, v = kv.partition("=")
@@ -284,12 +322,14 @@ def parse_spec(spec: str) -> List[_Arm]:
                     seed = int(v)
                 elif k == "after":
                     after = int(v)
+                elif k == "delay":
+                    delay = parse_duration_s(v)
                 else:
                     raise ValueError(k)
             except ValueError:
                 ok = False
         if ok:
-            arms.append(_Arm(pattern, p, n, seed, after))
+            arms.append(_Arm(pattern, p, n, seed, after, delay))
         else:
             import sys
             print(f"thrill_tpu.faults: malformed {ENV_VAR} entry "
@@ -318,8 +358,11 @@ class inject:
     """
 
     def __init__(self, pattern: str, p: float = 1.0, n: int = 1,
-                 seed: int = 0, after: int = 0) -> None:
+                 seed: int = 0, after: int = 0,
+                 delay: Optional[float] = None) -> None:
         self.entry = f"{pattern}:p={p}:n={n}:seed={seed}:after={after}"
+        if delay is not None:
+            self.entry += f":delay={delay}"
         self._prev: Optional[str] = None
 
     def __enter__(self) -> "inject":

@@ -73,6 +73,32 @@ def render_prometheus(ctx) -> str:
         gauge("thrill_tpu_queue_depth", depth)
         lines.append("# TYPE thrill_tpu_jobs_in_flight gauge")
         gauge("thrill_tpu_jobs_in_flight", max(sub - done, 0))
+        # per-tenant accept-to-result latency: a real Prometheus
+        # histogram (cumulative le buckets at the fixed log2
+        # boundaries the scheduler records into) — what the front-door
+        # scrape will alert on
+        hist = getattr(svc, "latency_histogram", None)
+        hist = hist() if callable(hist) else {}
+        if hist:
+            name = "thrill_tpu_serve_latency_ms"
+            lines.append(f"# TYPE {name} histogram")
+            for tenant, (counts, count, sum_ms) in hist.items():
+                t = _label(tenant)
+                cum = 0
+                for i, c in enumerate(counts[:-1]):
+                    # the last bucket is the CLAMP bucket (latencies
+                    # past every boundary): no finite le may claim to
+                    # bound it — it folds into +Inf only
+                    if not c:
+                        continue
+                    cum += c
+                    gauge(f"{name}_bucket", cum,
+                          f'{{tenant="{t}",le="{1 << i}"}}')
+                gauge(f"{name}_bucket", count,
+                      f'{{tenant="{t}",le="+Inf"}}')
+                gauge(f"{name}_count", count, f'{{tenant="{t}"}}')
+                gauge(f"{name}_sum", round(sum_ms, 3),
+                      f'{{tenant="{t}"}}')
     # live dicts are snapshotted (dict(...)) before iterating: job
     # threads insert keys concurrently, and a scrape must answer, not
     # die on "dictionary changed size during iteration"

@@ -52,6 +52,7 @@ Overlapped data plane (the MixStream-analog dispatch discipline):
 from __future__ import annotations
 
 import os
+import time
 import weakref
 from typing import Any, Callable, List, Optional, Tuple
 
@@ -591,7 +592,15 @@ def exchange(shards: DeviceShards, dest_builder: Callable, cache_key: Tuple,
                 mex, treedef, sorted_dest, sorted_leaves, send_mat,
                 caps, ident=cache_key, min_cap=min_cap,
                 range_mat=range_mat)
+    # the exchange barrier: the host plan sync blocks until phase A's
+    # send matrix lands — wait attribution (common/doctor.py) charges
+    # the blocked window to the "exchange" lane
+    doc = getattr(mex, "doctor", None)
+    t0 = time.perf_counter() if doc is not None else 0.0
     S = mex.fetch(send_mat)                       # [W, W] S[w, d]
+    if doc is not None:
+        doc.record_wait("xchg.plan_sync", None,
+                        time.perf_counter() - t0, lane="exchange")
     # the tiny [L, 2] range matrix rides the SAME host-sync window as
     # the send matrix (raw transfer: one logical plan sync, not a
     # second counted mid-pipeline fetch)
@@ -620,8 +629,16 @@ def exchange_stream(shards: DeviceShards, dest_builder: Callable,
     # streamed rounds ship full-width by design — skip range analysis
     treedef, sorted_dest, sorted_leaves, send_mat, _ = _phase_a(
         shards, dest_builder, cache_key, want_ranges=False)
-    S = mex.fetch(send_mat)   # per-round caps genuinely need the host S
-    account_traffic(mex, S, leaf_item_bytes(sorted_leaves))
+    # per-round caps genuinely need the host S — the same exchange
+    # barrier as the planned path, charged to the same wait lane
+    doc = getattr(mex, "doctor", None)
+    t0 = time.perf_counter() if doc is not None else 0.0
+    S = mex.fetch(send_mat)
+    if doc is not None:
+        doc.record_wait("xchg.plan_sync", None,
+                        time.perf_counter() - t0, lane="exchange")
+    account_traffic(mex, S, leaf_item_bytes(sorted_leaves),
+                    site="xchg:" + _ident_digest(cache_key)[:10])
     cap = sorted_leaves[0].shape[1] if sorted_leaves else 0
     if W > 1:
         count_plan_build(mex)
@@ -738,14 +755,20 @@ def dense_all_to_all_applies(mex: MeshExec, S: np.ndarray,
 
 
 def account_traffic(mex: MeshExec, S: np.ndarray, item_bytes: int,
-                    **log_extra: Any) -> None:
+                    site: str = "", **log_extra: Any) -> None:
     """Traffic accounting shared by every exchange plan (reference:
     net::Manager tx/rx counters feeding the end-of-job OverallStats
     AllReduce, api/context.cpp:1275-1341). On multi-slice meshes the
     bytes are split by tier: same-slice pairs ride ICI, cross-slice
     pairs DCN. Called exactly once per LOGICAL exchange — the
     optimistic path calls it at deferred-check time (hit), or lets the
-    healed synced re-run account instead (miss)."""
+    healed synced re-run account instead (miss).
+
+    Partition-skew attribution (common/doctor.py) rides the same
+    choke point: the per-worker receive totals of THIS send matrix
+    feed the site's hot-slot detector, the ``skew_ratio`` lane fields
+    of the exchange log line, and the ``kind=skew`` plan-lane
+    instants ``ctx.explain()`` renders."""
     moved = int(S.sum()) - int(np.trace(S))       # off-diagonal items
     mex.stats_exchanges += 1
     mex.stats_items_moved += moved
@@ -759,15 +782,42 @@ def account_traffic(mex: MeshExec, S: np.ndarray, item_bytes: int,
     else:
         dcn_items = 0
         mex.stats_bytes_ici += moved * item_bytes
+    skew_ratio = hot_worker = hot_rows = None
+    doc = getattr(mex, "doctor", None)
+    if doc is not None and S.shape[0] > 1:
+        # total receive rows per worker INCLUDING the diagonal: the
+        # hot slot is whoever holds the most rows after the shuffle,
+        # local items included — that worker's downstream compute is
+        # the one the partition function overloaded
+        skew = doc.record_exchange(
+            site or "xchg:?", S.sum(axis=0), item_bytes,
+            tracer=getattr(mex, "tracer", None),
+            ledger=_decisions.ledger_of(mex))
+        if skew is not None:
+            ratio, hot_worker, hot_rows = skew
+            skew_ratio = round(ratio, 3)
     log = getattr(mex, "logger", None)
     if log is not None and log.enabled:
         sent = (S.sum(axis=1) - np.diag(S)).astype(int)
         recv = (S.sum(axis=0) - np.diag(S)).astype(int)
+        skew_extra = {}
+        if site:
+            skew_extra["site"] = site
+        if skew_ratio is not None:
+            # hot_rows is the hot worker's DIAGONAL-INCLUDED receive
+            # total — the figure the ratio was computed from
+            # (per_worker_recv below is off-diagonal by its own
+            # long-standing contract); the offline doctor_report
+            # reads it so both reports state the same rows
+            skew_extra["skew_ratio"] = skew_ratio
+            skew_extra["hot_worker"] = hot_worker
+            skew_extra["hot_rows"] = hot_rows
         log.line(event="exchange", items=moved,
                  bytes=moved * item_bytes,
                  bytes_dcn=dcn_items * item_bytes,
                  per_worker_sent=sent.tolist(),
-                 per_worker_recv=recv.tolist(), **log_extra)
+                 per_worker_recv=recv.tolist(),
+                 **skew_extra, **log_extra)
 
 
 def one_factor_rounds(mex: MeshExec) -> List[np.ndarray]:
@@ -1289,8 +1339,13 @@ def _exchange_optimistic(mex: MeshExec, treedef, sorted_dest,
     shards = DeviceShards(mex, tree, counts_dev)
 
     def check(counts: np.ndarray):
+        doc = getattr(mex, "doctor", None)
+        t0 = time.perf_counter() if doc is not None else 0.0
         overflowed = bool(mex._fetch_raw(flag).reshape(-1)[0])
         S = mex._fetch_raw(send_mat).astype(np.int64)
+        if doc is not None:
+            doc.record_wait("xchg.deferred_check", None,
+                            time.perf_counter() - t0, lane="exchange")
         # the optimistic-vs-synced verdict, at the moment it is
         # actually known (deferred-check time)
         _trace.instant_of(getattr(mex, "tracer", None), "exchange",
@@ -1309,8 +1364,9 @@ def _exchange_optimistic(mex: MeshExec, treedef, sorted_dest,
             # plus its healed re-run
             mex.stats_cap_cache_hits += 1
             mex.stats_exchanges_overlapped += 1
-            account_traffic(mex, S, item_bytes, overlapped=True,
-                            cap_hit=True)
+            account_traffic(mex, S, item_bytes,
+                            site="xchg:" + _ident_digest(ident)[:10],
+                            overlapped=True, cap_hit=True)
             pl = _planner_of(mex)
             if pl is not None and pl.skew_developed(S, item_bytes):
                 # the observed send matrix now prefers the 1-factor
@@ -1375,7 +1431,8 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     R = S.sum(axis=0)                             # recv totals per worker
     new_counts = R.astype(np.int64)
 
-    account_traffic(mex, S, leaf_item_bytes(sorted_leaves))
+    account_traffic(mex, S, leaf_item_bytes(sorted_leaves),
+                    site="xchg:" + _ident_digest(ident)[:10])
 
     if W == 1:
         # no movement: items are already dest-sorted (valid first)

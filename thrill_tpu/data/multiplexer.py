@@ -233,10 +233,15 @@ def host_exchange(mex, shards: HostShards, dest_fn: Callable[[Any], int],
     mix = _mix_delivery(rank_order)
     from ..net import wire as _wire
     csnap = _wire.compress_stats()
+    # group._at names the phase for the watchdog AND routes the
+    # per-peer recv waits to the doctor's exchange lane (the site
+    # prefix "host_exchange" classifies them, common/doctor.py) — the
+    # host-plane exchange barrier's arrival deltas
     with _trace.span_of(getattr(mex, "tracer", None), "host",
                         "host_exchange", reason=reason,
                         mode="async" if use_async else "serial"), \
-            poison_on_error(group, "host_exchange"):
+            poison_on_error(group, "host_exchange"), \
+            group._at("host_exchange"):
         if use_async:
             sent_items, wire_bytes = _exchange_frames_async(
                 mex, group, outgoing, received, me, P, mix)

@@ -58,6 +58,14 @@ _F_STALE = faults.declare("net.group.stale_frame")
 F_HEARTBEAT = faults.declare("net.heartbeat",
                              exc=faults.InjectedConnectionError)
 
+#: latency-injection site at every host-collective entry. Checked as
+#: the PER-RANK name ``net.group.delay.r<rank>`` so a delay arm
+#: (``net.group.delay.r1:delay=50ms:n=0``) slows exactly one rank —
+#: the deterministic straggler the doctor's wait attribution pins
+#: (common/doctor.py). Armed WITHOUT ``delay=`` it raises at
+#: collective entry like any site (nothing sent yet — a clean abort).
+_F_DELAY = faults.declare("net.group.delay")
+
 
 class CollectiveHangTimeout(TimeoutError):
     """A blocking collective recv exceeded THRILL_TPU_HANG_TIMEOUT_S
@@ -194,6 +202,12 @@ class Group(abc.ABC):
         # every collective (_at) and generation heal becomes a span in
         # the "net" lane; None / disabled = no allocation
         self.tracer = None
+        # performance doctor (common/doctor.py), attached by the
+        # Context: every blocking collective recv records how long
+        # this rank was blocked and on WHOM (per-peer arrival deltas
+        # -> straggler attribution). None (THRILL_TPU_DOCTOR=0) = one
+        # attribute read per recv, zero allocations
+        self.doctor = None
 
     @property
     def num_hosts(self) -> int:
@@ -213,6 +227,14 @@ class Group(abc.ABC):
         tracing spine attached, put every host collective on the "net"
         span lane (one hook covers prefix_sum/broadcast/all_gather/
         all_reduce/barrier and their nested forms)."""
+        if self.num_hosts > 1 and faults.REGISTRY.active():
+            # latency injection: a delay arm on this rank's site name
+            # sleeps HERE, before the collective's first frame — the
+            # peers observe the lateness as per-peer recv wait. The
+            # detail key is ``at`` (NOT ``site``): detail fields merge
+            # into the fault_injected record, and a ``site`` key would
+            # clobber the fault-site name in the event stream.
+            faults.check(f"net.group.delay.r{self.my_rank}", at=site)
         prev = self._collective_site
         self._collective_site = site
         tr = self.tracer
@@ -300,6 +322,14 @@ class Group(abc.ABC):
                                 "gen": self.generation - 1}}
                 if obj is None:
                     conn = self.connection(peer)
+                    doc = self.doctor
+                    if doc is not None:
+                        # lock-free attribute reads (benign race): the
+                        # background-I/O busy delta across the blocked
+                        # window caps the wait's I/O attribution
+                        from ..common.iostats import IO as _io
+                        t0 = time.perf_counter()
+                        io0 = _io.io_busy_s
                     if deadline_at is None:
                         obj = conn.recv()
                     else:
@@ -307,6 +337,10 @@ class Group(abc.ABC):
                         if remaining <= 0:
                             raise CollectiveHangTimeout("deadline spent")
                         obj = conn.recv_deadline(remaining)
+                    if doc is not None:
+                        doc.record_wait(site, peer,
+                                        time.perf_counter() - t0,
+                                        io_s=_io.io_busy_s - io0)
             except CollectiveHangTimeout:
                 cause = (f"hang at {site}: rank {self.my_rank} "
                          f"received no frame from rank {peer} within "

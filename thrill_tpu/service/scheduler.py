@@ -56,6 +56,34 @@ def _weight(v: str) -> float:
     return w
 
 
+#: accept-to-result latency histogram buckets: fixed log2 boundaries,
+#: bucket i = [2^(i-1), 2^i) milliseconds (bucket 0 = sub-millisecond).
+#: 28 buckets reach ~37 hours. The BUCKETING is deterministic — two
+#: runs whose jobs land in the same buckets report identical
+#: serve_p50/p99 — which is what lets the quantiles ride stats
+#: contracts where raw wall clocks cannot.
+_LAT_BUCKETS = 28
+
+
+def _lat_bucket(ms: float) -> int:
+    return min(max(int(ms), 0).bit_length(), _LAT_BUCKETS - 1)
+
+
+def _lat_quantile(counts: List[int], q: float) -> float:
+    """Upper bucket boundary (ms) at quantile ``q`` — 2^i for bucket
+    i, deterministic given the counts."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    need = max(1, -(-int(total * q * 1000) // 1000))  # ceil(q*total)
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= need:
+            return float(1 << i)
+    return float(1 << (_LAT_BUCKETS - 1))
+
+
 def _parse_weights(spec: str) -> Dict[str, float]:
     """Parse THRILL_TPU_SERVE_WEIGHTS ("a=3,b=1.5"); malformed entries
     are skipped loudly (a typo must not silently starve a tenant)."""
@@ -234,6 +262,14 @@ class Scheduler:
         # failure, drain) — the live metrics endpoint's jobs_in_flight
         # gauge is submitted - done (common/metrics.py)
         self.jobs_done = 0
+        # per-tenant accept-to-result latency histograms (fixed log2
+        # buckets — see _LAT_BUCKETS): serve_p50/p99 in
+        # overall_stats() and the Prometheus histogram export both
+        # read these. Only jobs that RAN are recorded (a drained
+        # future's latency is the shutdown's, not the service's).
+        self._lat: Dict[str, List[int]] = {}
+        self._lat_count: Dict[str, int] = {}
+        self._lat_sum_ms: Dict[str, float] = {}
         self._job_ids = 0
         self._closing = False
         self._dead: Optional[BaseException] = None
@@ -291,6 +327,38 @@ class Scheduler:
             return {"jobs_submitted": self.jobs_submitted,
                     "jobs_failed": self.jobs_failed,
                     "queue_depth_peak": self.queue.depth_peak}
+
+    def _note_latency(self, tenant: str, seconds: float) -> None:
+        ms = seconds * 1e3
+        with self._cv:
+            counts = self._lat.get(tenant)
+            if counts is None:
+                counts = self._lat[tenant] = [0] * _LAT_BUCKETS
+                self._lat_count[tenant] = 0
+                self._lat_sum_ms[tenant] = 0.0
+            counts[_lat_bucket(ms)] += 1
+            self._lat_count[tenant] += 1
+            self._lat_sum_ms[tenant] += ms
+
+    def latency_quantiles(self) -> dict:
+        """Per-tenant accept-to-result p50/p99 (log2-bucket upper
+        bounds, ms) — the overall_stats() serve-latency summary the
+        front-door work will be judged by."""
+        with self._cv:
+            return {
+                "serve_p50_ms": {t: _lat_quantile(c, 0.50)
+                                 for t, c in sorted(self._lat.items())},
+                "serve_p99_ms": {t: _lat_quantile(c, 0.99)
+                                 for t, c in sorted(self._lat.items())},
+            }
+
+    def latency_histogram(self) -> dict:
+        """Raw per-tenant histogram state for the Prometheus export:
+        {tenant: (bucket_counts, count, sum_ms)}."""
+        with self._cv:
+            return {t: (list(c), self._lat_count[t],
+                        self._lat_sum_ms[t])
+                    for t, c in sorted(self._lat.items())}
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Drain queued jobs, then stop the dispatcher. Called by
@@ -484,6 +552,11 @@ class Scheduler:
             self._poison(e)
         finally:
             ctx.current_tenant = None
+            # accept-to-result: submit() call to future resolution,
+            # queue wait included — the latency a CLIENT of this
+            # tenant actually observed for the job
+            self._note_latency(job.tenant,
+                               time.monotonic() - job.t_submit)
             with self._cv:
                 self.jobs_done += 1
             if sp is not None:

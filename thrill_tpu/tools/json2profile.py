@@ -218,6 +218,7 @@ td.hm {{ min-width: 3em; }}
 {_render_overlap_lane(exchanges, overall, total)}
 {_render_wire_lane(overall)}
 {_render_worker_lanes(exchanges, total)}
+{_render_skew_lane(exchanges, overall)}
 {_render_memory_events(memory, total)}
 {_render_io_lane(io_events, overall)}
 {_render_fused_dispatches(fused, overall)}
@@ -909,6 +910,44 @@ def _render_worker_lanes(exchanges, total: float) -> str:
             f'<div class="track">{"".join(marks)}</div>'
             f'<span class="dur">{sent_total} items sent</span></div>')
     return "<h2>per-worker exchange lanes</h2>" + "".join(lanes)
+
+
+def _render_skew_lane(exchanges, overall) -> str:
+    """Partition-skew lane (common/doctor.py): per exchange SITE, the
+    worst receive-side max/mean ratio and the hot worker it lands on —
+    the per-site table behind the run's ``skew_ratio`` summary. A HOT
+    verdict (ratio past THRILL_TPU_SKEW_HOT) is the signal to re-key
+    or pre-aggregate that operator."""
+    from ..common.doctor import fold_skew_sites
+    sites = fold_skew_sites(e for _, e in exchanges)
+    if not sites:
+        return ""
+    head = ("<tr><th class='l'>exchange site</th><th>exchanges</th>"
+            "<th>items moved</th><th>max skew</th><th>hot worker</th>"
+            "<th class='l'>verdict</th></tr>")
+    rows = []
+    for site, st in sorted(sites.items(), key=lambda kv: -kv[1]["ratio"]):
+        verdict = (f"HOT ({st['ratio']:.1f}x the mean on worker "
+                   f"{st['worker']})" if st["hot"]
+                   else "balanced")
+        rows.append(
+            f"<tr><td class='l'>{html.escape(site)}</td>"
+            f"<td>{st['exchanges']}</td><td>{st['items']}</td>"
+            f"<td>{st['ratio']:.2f}x</td><td>{st['worker']}</td>"
+            f"<td class='l'>{verdict}</td></tr>")
+    summary = ""
+    if overall:
+        o = overall[-1]
+        if o.get("skew_ratio") is not None:
+            summary = (f"<p>run skew_ratio {o.get('skew_ratio')} · "
+                       f"collective_wait_s "
+                       f"{o.get('collective_wait_s', 0)}s (net "
+                       f"{o.get('wait_net_s', 0)} / exchange "
+                       f"{o.get('wait_exchange_s', 0)} / io "
+                       f"{o.get('wait_io_s', 0)} / skew "
+                       f"{o.get('wait_skew_s', 0)})</p>")
+    return ("<h2>partition skew</h2>" + summary
+            + "<table>" + head + "".join(rows) + "</table>")
 
 
 def main() -> None:

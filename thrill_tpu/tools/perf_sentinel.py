@@ -1,0 +1,306 @@
+"""Deterministic perf-contract sentinel.
+
+Wall-clock bench ratios swing 2-7x on shared rigs, so perf regressions
+hide in the noise — but the counters the framework already maintains
+are DETERMINISTIC for a fixed program: device dispatches (fusion
+breaking shows up as a dispatch-count jump), data-driven plan builds
+(plan-store/optimism regressions), exchange counts and overlap,
+tracked fetches, and the bytes-on-wire totals (the wire codec
+silently disabling doubles them). This tool snapshots those counters
+per bench-shaped workload into ``PERF_CONTRACT.json`` and diffs a
+fresh run against the snapshot:
+
+* **counters** compare EXACTLY — any drift is a contract violation;
+* **byte totals** compare ratio-banded (``THRILL_TPU_SENTINEL_BAND``,
+  default 0.25): padded capacities may legally wiggle with pow2
+  ratcheting, silent 2x regressions may not.
+
+Usage::
+
+    python -m thrill_tpu.tools.perf_sentinel --snapshot [PATH]
+    python -m thrill_tpu.tools.perf_sentinel --check    [PATH]
+
+(``run-scripts/perf_sentinel.sh`` wraps both with the env pinned.)
+``--check`` exits 1 with a loud per-field diff on any violation. The
+contract assumes default knobs: warm plan stores / armed faults are
+scrubbed around the measurement (never a legitimate sentinel state),
+while counter-relevant knobs like THRILL_TPU_FUSE are deliberately
+honored — a knob-skewed run failing on its counters is exactly the
+silent-regression class this tool exists to catch (the snapshot's
+``env`` note tells the human what the contract ran under).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Callable, Dict, List
+
+import numpy as np
+
+#: counters that must match EXACTLY between contract and fresh run
+COUNTERS = (
+    "device_dispatches", "device_uploads", "device_fetches",
+    "fused_dispatches", "fused_ops",
+    "exchanges", "exchanges_overlapped",
+    "cap_cache_hits", "cap_cache_misses",
+    "plan_builds", "items_moved",
+)
+
+#: byte totals compared ratio-banded (pow2 capacity ratchets may move
+#: padded volume without a real regression)
+BYTE_FIELDS = ("bytes_on_wire", "bytes_on_wire_raw", "bytes_moved")
+
+#: knobs that change the counters — recorded INFORMATIONALLY into the
+#: contract (a human diffing a failure sees what the snapshot ran
+#: under). Deliberately NOT a comparison guard: "someone ran with
+#: THRILL_TPU_FUSE=0" is exactly the silent-regression class the
+#: sentinel exists to catch, so a knob-skewed check must fail on the
+#: COUNTERS, loudly, not be excused by an env note.
+ENV_NOTE = (
+    "THRILL_TPU_FUSE", "THRILL_TPU_OVERLAP", "THRILL_TPU_XCHG_CHUNKS",
+    "THRILL_TPU_XCHG_CAP_CACHE", "THRILL_TPU_XCHG_NARROW",
+    "THRILL_TPU_WIRE_COMPRESS", "THRILL_TPU_PLANNER",
+    "THRILL_TPU_EXCHANGE",
+    "THRILL_TPU_LOCATION_DETECT", "THRILL_TPU_DUP_DETECT",
+    "THRILL_TPU_LOOP_REPLAY", "THRILL_TPU_FORI",
+)
+
+#: state that is NEVER legitimate during a sentinel measurement — a
+#: warm plan store zeroes plan_builds by design and armed faults
+#: change retry paths: both are scrubbed around the runs (and
+#: restored), so the contract always measures the cold default
+_SCRUB = ("THRILL_TPU_PLAN_STORE", "THRILL_TPU_FAULTS",
+          "THRILL_TPU_CKPT_DIR", "THRILL_TPU_RESUME")
+
+VERSION = 1
+
+
+def _band() -> float:
+    try:
+        v = float(os.environ.get("THRILL_TPU_SENTINEL_BAND", "0.25"))
+    except ValueError:
+        return 0.25
+    return v if v > 0 else 0.25
+
+
+# ----------------------------------------------------------------------
+# workloads: small, fixed-seed, W=2 — each is a fresh Context so the
+# counters depend only on the program, never on a previous workload's
+# learned state
+# ----------------------------------------------------------------------
+
+def _wc_scale(x):
+    return x * 3 + 1
+
+
+def _wc_odd(x):
+    return x % 2 == 1
+
+
+def _wc_kv(x):
+    return (x % 13, x)
+
+
+def _wc_add(a, b):
+    return a + b
+
+
+def _wordcount(ctx):
+    """ReduceByKey-shaped with an LOp stack on top: fusion (the stack
+    collapses into the reduce's pre-phase — FUSE=0 moves
+    device_dispatches, not just fused_*), hash exchange, preshuffle."""
+    return sorted(
+        (int(k), int(v)) for k, v in ctx.Distribute(
+            np.arange(384, dtype=np.int64)).Map(_wc_scale).Filter(
+                _wc_odd).Map(_wc_kv).ReducePair(_wc_add).AllGather())
+
+
+def _sort(ctx):
+    """Sample-sort shaped: splitter agreement + range exchange."""
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 1 << 30, size=512).astype(np.int64)
+    return ctx.Distribute(data).Sort().AllGather()
+
+
+def _kv_mod(x):
+    return (x % 24, x)
+
+
+def _kv_ident(x):
+    return (x, x * 3)
+
+
+def _key0(kv):
+    return kv[0]
+
+
+def _join_vals(left, right):
+    return (left[1], right[1])
+
+
+def _joinish(ctx):
+    """Hash-join shaped: two shuffles + the pre-shuffle location
+    filter's cost-model path — the wire-heaviest contract workload."""
+    from ..api.dia import InnerJoin
+    left = ctx.Distribute(np.arange(240, dtype=np.int64)).Map(_kv_mod)
+    right = ctx.Distribute(np.arange(24, dtype=np.int64)).Map(
+        _kv_ident)
+    j = InnerJoin(left, right, _key0, _key0, _join_vals)
+    return sorted((int(a), int(b)) for a, b in j.AllGather())
+
+
+def _chain_inc(x):
+    return x + 1
+
+
+def _chain(ctx):
+    """Fully-fusible row-local DOp chain: ONE stitched dispatch when
+    fusion is healthy, one per DOp when it breaks —
+    ``device_dispatches`` is the contract that catches it."""
+    return ctx.Distribute(np.arange(256, dtype=np.int64)).PrefixSum() \
+        .Map(_chain_inc).ZipWithIndex().AllGather()
+
+
+WORKLOADS: Dict[str, Callable] = {
+    "wordcount": _wordcount,
+    "sort": _sort,
+    "join": _joinish,
+    "chain": _chain,
+}
+
+
+def _run_workload(fn, workers: int = 2) -> dict:
+    from ..api.context import RunLocalMock
+    stats_box = {}
+
+    def job(ctx):
+        fn(ctx)
+        stats_box.update(ctx.overall_stats())
+
+    RunLocalMock(job, workers)
+    out = {k: int(stats_box.get(k, 0)) for k in COUNTERS}
+    out.update({k: int(stats_box.get(k, 0)) for k in BYTE_FIELDS})
+    return out
+
+
+def snapshot(workloads=None, workers: int = 2) -> dict:
+    """Run each workload on a fresh W=``workers`` mesh and collect its
+    counter contract."""
+    # unknown names (a contract from a newer checkout) simply don't
+    # run — diff() then reports them missing, loudly
+    names = [n for n in (workloads or WORKLOADS) if n in WORKLOADS]
+    saved = {k: os.environ.pop(k) for k in _SCRUB if k in os.environ}
+    try:
+        runs = {name: _run_workload(WORKLOADS[name], workers)
+                for name in names}
+    finally:
+        os.environ.update(saved)
+    return {
+        "version": VERSION,
+        "workers": workers,
+        "env": {k: os.environ.get(k) for k in ENV_NOTE
+                if os.environ.get(k) is not None},
+        "workloads": runs,
+    }
+
+
+def diff(contract: dict, fresh: dict) -> List[str]:
+    """Violations of ``fresh`` against ``contract`` (empty = clean).
+    The env note is NOT compared — a knob-skewed run must fail on the
+    counters themselves (that is the regression class being hunted),
+    with the recorded env available for the human reading the diff."""
+    problems: List[str] = []
+    if contract.get("version") != fresh.get("version"):
+        problems.append(
+            f"contract version {contract.get('version')} != "
+            f"{fresh.get('version')} (re-snapshot)")
+        return problems
+    band = _band()
+    for name, want in contract.get("workloads", {}).items():
+        got = fresh.get("workloads", {}).get(name)
+        if got is None:
+            problems.append(f"{name}: workload missing from fresh run")
+            continue
+        for k in COUNTERS:
+            if int(got.get(k, 0)) != int(want.get(k, 0)):
+                problems.append(
+                    f"{name}.{k}: {want.get(k, 0)} -> {got.get(k, 0)} "
+                    f"(exact counter contract)")
+        for k in BYTE_FIELDS:
+            w, g = int(want.get(k, 0)), int(got.get(k, 0))
+            if w == 0 and g == 0:
+                continue
+            lo, hi = w * (1 - band), w * (1 + band)
+            if not (lo <= g <= hi):
+                problems.append(
+                    f"{name}.{k}: {w} -> {g} "
+                    f"(outside the +/-{band:.0%} byte band)")
+    for name in fresh.get("workloads", {}):
+        if name not in contract.get("workloads", {}):
+            problems.append(
+                f"{name}: not in the contract (re-snapshot to adopt)")
+    return problems
+
+
+def default_path() -> str:
+    """PERF_CONTRACT.json at the repo root (next to bench.py) when run
+    from a checkout, else the current directory. The checkout test is
+    the bench.py marker — the package grandparent always EXISTS (the
+    module was imported from it), so a mere isdir check would route a
+    pip-installed run's contract next to site-packages."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if os.path.isfile(os.path.join(here, "bench.py")):
+        return os.path.join(here, "PERF_CONTRACT.json")
+    return os.path.abspath("PERF_CONTRACT.json")
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    mode = None
+    if argv and argv[0] in ("--snapshot", "--check"):
+        mode = argv.pop(0)
+    if mode is None:
+        print("usage: perf_sentinel --snapshot|--check "
+              "[PERF_CONTRACT.json]", file=sys.stderr)
+        return 2
+    path = argv.pop(0) if argv else default_path()
+    # the virtual W=2 CPU mesh needs the device-count flag BEFORE jax
+    # initializes (no-op when the harness already set it)
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    from ..common.platform import force_cpu_platform
+    force_cpu_platform()
+    if mode == "--snapshot":
+        snap = snapshot()
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"perf_sentinel: contract written to {path} "
+              f"({len(snap['workloads'])} workloads)")
+        return 0
+    try:
+        with open(path) as f:
+            contract = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"perf_sentinel: cannot read contract {path}: {e}",
+              file=sys.stderr)
+        return 2
+    fresh = snapshot(workloads=contract.get("workloads"))
+    problems = diff(contract, fresh)
+    if problems:
+        print(f"perf_sentinel: {len(problems)} contract violation(s) "
+              f"vs {path}:", file=sys.stderr)
+        for p in problems:
+            print(f"  REGRESSION {p}", file=sys.stderr)
+        return 1
+    print(f"perf_sentinel: clean — "
+          f"{len(contract.get('workloads', {}))} workloads match "
+          f"{path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

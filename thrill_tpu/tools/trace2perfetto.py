@@ -21,8 +21,16 @@ the Chrome trace-event format that loads directly in Perfetto
 
 Usage::
 
-    python -m thrill_tpu.tools.trace2perfetto LOG.json [LOG2.json ...] \
-        > trace.json
+    python -m thrill_tpu.tools.trace2perfetto [--merge] \
+        LOG.json [LOG2.json ...] > trace.json
+
+``--merge`` is the explicit multi-host spelling: every rank's log
+merges into ONE timeline on the shared timestamp axis — one pid lane
+per rank, correlated by the generation/job tags the spans carry.
+Records without a ``rank``/``host`` tag take their FILE's index as
+the pid lane (an untagged rank's events must not collapse onto rank
+0's lane). Passing several logs without the flag behaves identically
+— one merge implementation serves both spellings.
 
 (or ``run-scripts/trace_report.sh`` for the one-command demo).
 """
@@ -99,11 +107,18 @@ def to_chrome(events: List[dict]) -> dict:
 
 
 def main() -> None:
-    if len(sys.argv) < 2:
-        print("usage: trace2perfetto LOG.json [LOG2.json ...] "
-              "> trace.json", file=sys.stderr)
+    # --merge is the explicit multi-host spelling; the merge itself is
+    # load_many's contract either way (per-file host default -> one
+    # pid lane per rank even in hand-rolled logs; ts-sorted axis) —
+    # ONE implementation, so the two spellings cannot drift
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--merge":
+        argv = argv[1:]
+    if not argv:
+        print("usage: trace2perfetto [--merge] LOG.json "
+              "[LOG2.json ...] > trace.json", file=sys.stderr)
         sys.exit(2)
-    doc = to_chrome(load_many(sys.argv[1:]))
+    doc = to_chrome(load_many(argv))
     json.dump(doc, sys.stdout)
     sys.stdout.write("\n")
 

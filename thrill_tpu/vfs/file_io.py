@@ -34,6 +34,9 @@ COMPRESSED_SUFFIXES = (".gz", ".bz2", ".xz")
 # failing a whole pipeline for one flaky read
 _F_OPEN = faults.declare("vfs.open_read")
 _F_READ = faults.declare("vfs.read")
+# latency-injection twin of vfs.read: arm with :delay=<dur> to make
+# THIS process's reads deterministically slow (straggler/IO-wait tests)
+_F_READ_DELAY = faults.declare("vfs.read.delay")
 # background-readahead failure (fires on the reader THREAD): the
 # prefetching layer degrades to demand reads at the exact consumed
 # position — slower, never wrong data. Bytes already queued before the
@@ -214,6 +217,11 @@ class RetryingReader:
 
         def op():
             faults.check(_F_READ, path=self._path, pos=self._pos)
+            # latency injection (``vfs.read.delay:delay=50ms``): a
+            # deterministic slow disk for straggler/IO-wait tests —
+            # armed WITHOUT delay= it raises inside the same retry
+            # scope as vfs.read (nothing consumed yet)
+            faults.check(_F_READ_DELAY, path=self._path, pos=self._pos)
             if self._f is None:       # previous attempt lost the handle
                 self._f = _open_at(self._path, self._pos)
             try:
