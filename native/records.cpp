@@ -1,0 +1,97 @@
+// Native columnar spill records: the GIL-free encode/decode engine of
+// the out-of-core hot path.
+//
+// The reference's data plane serializes fixed-size items with a plain
+// memcpy and spills them through foxxll's async writer threads — the
+// encode itself never contends with compute (thrill/data/
+// serialization.hpp:34 POD path, block_writer.hpp:53). The Python
+// port's write-behind spill (data/writeback.py) overlapped the disk
+// I/O but NOT the encode: the per-run pickle/tuple work in em_sort run
+// spilling holds the GIL, so the writer thread and the main thread
+// time-slice one interpreter (ROADMAP "Out-of-core tier, remaining
+// edges (a)"; PR 13 measured the wall-clock ceiling at ~1.0-1.05x).
+//
+// This engine is the missing piece: the columnar run state em_sort
+// already maintains (a fixed-width key-byte matrix plus fixed-dtype
+// payload columns, data/records.py) sorts and encodes HERE, through
+// two ctypes entry points that release the GIL for their whole
+// duration (ctypes releases it around every foreign call):
+//
+// * rec_argsort — lexicographic (memcmp) argsort of n fixed-width
+//   rows. Rows carry a big-endian position suffix (core/order_key.py),
+//   so they are globally unique and any comparison sort yields THE
+//   total order; memcmp order equals numpy's S-dtype order (trailing
+//   \0 padding is the minimum byte), so the native and numpy engines
+//   are interchangeable row for row.
+// * rec_gather — gather rows [i0, i1) of a permutation from ncols
+//   fixed-width columns into one contiguous column-major output
+//   buffer: the payload bytes of one spill block, written straight
+//   into the caller-allocated buffer that already holds the block
+//   header (data/serializer.py columnar container kind). One pointer
+//   handoff per block instead of per-item tuple+pickle work.
+//
+// Python (data/records.py) owns schemas, headers and block slicing;
+// this file owns only bytes — the same split as blockstore.cpp.
+//
+// Build: g++ -O3 -shared -fPIC -std=c++17 records.cpp -o librecords.so
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+
+extern "C" {
+
+// Lexicographic argsort of n rows of w bytes each (memcmp order, row
+// index tiebreak). out must hold n int64 slots. Returns 0, -1 on bad
+// arguments.
+int32_t rec_argsort(const uint8_t* rows, int64_t w, int64_t n,
+                    int64_t* out) {
+  if (!rows || !out || w <= 0 || n < 0) return -1;
+  std::iota(out, out + n, static_cast<int64_t>(0));
+  const size_t width = static_cast<size_t>(w);
+  std::sort(out, out + n, [rows, width](int64_t a, int64_t b) {
+    const int c = std::memcmp(rows + static_cast<size_t>(a) * width,
+                              rows + static_cast<size_t>(b) * width,
+                              width);
+    if (c != 0) return c < 0;
+    return a < b;  // rows are unique (pos suffix); keep it total anyway
+  });
+  return 0;
+}
+
+// Gather rows order[i0:i1] from ncols columns (widths[c] bytes per
+// row, each column C-contiguous) into out, column-major: col 0's
+// gathered rows, then col 1's, ... Returns total bytes written, or -1
+// on bad arguments. The caller guarantees order values index every
+// column validly.
+int64_t rec_gather(int32_t ncols, const uint8_t* const* cols,
+                   const int64_t* widths, const int64_t* order,
+                   int64_t i0, int64_t i1, uint8_t* out) {
+  if (ncols < 0 || !out || i0 < 0 || i1 < i0 ||
+      (ncols > 0 && (!cols || !widths || !order))) {
+    return -1;
+  }
+  uint8_t* dst = out;
+  for (int32_t c = 0; c < ncols; ++c) {
+    const uint8_t* src = cols[c];
+    const size_t w = static_cast<size_t>(widths[c]);
+    if (!src || widths[c] <= 0) return -1;
+    switch (w) {
+      case 8:  // the dominant case: int64/float64 scalar columns
+        for (int64_t j = i0; j < i1; ++j) {
+          std::memcpy(dst, src + static_cast<size_t>(order[j]) * 8, 8);
+          dst += 8;
+        }
+        break;
+      default:
+        for (int64_t j = i0; j < i1; ++j) {
+          std::memcpy(dst, src + static_cast<size_t>(order[j]) * w, w);
+          dst += w;
+        }
+    }
+  }
+  return static_cast<int64_t>(dst - out);
+}
+
+}  // extern "C"

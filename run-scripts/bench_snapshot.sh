@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Archive one bench run as BENCH_r<NN>.json at the repo root.
+#
+# Usage:
+#   run-scripts/bench_snapshot.sh [NN] [env VAR=... passthrough via environment]
+#
+# The historical trajectory snapshots (BENCH_r01..r05) stop at r05;
+# newer perf evidence rides bench.py's JSON line — this script turns
+# one such run into the same archival shape (cmd/rc/tail/parsed) so a
+# PR can pin its numbers durably. NN defaults to one past the highest
+# existing snapshot. Remember the rig-variance rule (ADVICE.md /
+# ROADMAP): vs_* and *_ab ratios swing 2-7x run-over-run on shared
+# rigs, so judge PAIRED same-run A/B lanes (em_overlap_ab,
+# em_records_ab, em_sort_vs_py_engine, trace_overhead_frac...) and the
+# structural counters, not cross-snapshot wall clocks; when in doubt
+# take the median of >= 3 snapshots.
+#
+# Env of note (recorded implicitly in the archived line):
+#   THRILL_TPU_BENCH_EM_N        em lane size (default 1<<22)
+#   THRILL_TPU_TERASORT_GB       flagship scale (slow sweep only)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+NN=${1:-}
+if [[ -z "$NN" ]]; then
+  last=$(ls BENCH_r*.json 2>/dev/null |
+         sed -E 's/^BENCH_r0*([0-9]+)\.json$/\1/' | sort -n | tail -1)
+  NN=$(printf '%02d' $(( ${last:-0} + 1 )))
+fi
+OUT="BENCH_r${NN}.json"
+if [[ -e "$OUT" ]]; then
+  echo "bench_snapshot: $OUT already exists; pass an explicit NN" >&2
+  exit 2
+fi
+
+TAIL_FILE=$(mktemp)
+trap 'rm -f "$TAIL_FILE"' EXIT
+CMD="python bench.py"
+rc=0
+$CMD 2>&1 | tee "$TAIL_FILE" || rc=$?
+
+python - "$OUT" "$NN" "$CMD" "$rc" "$TAIL_FILE" <<'PY'
+import json, sys
+out, nn, cmd, rc, tail_file = sys.argv[1:6]
+tail = open(tail_file, errors="replace").read()
+# the bench line is the last JSON object line in the output
+parsed = {}
+for line in reversed(tail.strip().splitlines()):
+    line = line.strip()
+    if line.startswith("{"):
+        try:
+            parsed = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+snap = {"n": int(nn), "cmd": cmd, "rc": int(rc),
+        "tail": tail[-8000:], "parsed": parsed}
+with open(out, "w") as f:
+    json.dump(snap, f, indent=2)
+    f.write("\n")
+print(f"bench_snapshot: archived -> {out}"
+      + ("" if parsed else " (WARNING: no JSON bench line parsed)"))
+PY
+exit "$rc"

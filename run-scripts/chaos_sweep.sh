@@ -13,7 +13,10 @@
 # data.spill.writeback (blockpool eviction writer degrades to RAM
 # residency here; the em-spill poison contract — async flush failure
 # fails the job with its root cause, no silent loss — is swept by the
-# chaos-marked cases in tests/api/test_out_of_core.py). The socket-level sites
+# chaos-marked cases in tests/api/test_out_of_core.py), as does
+# data.records.encode (ISSUE 15: the native columnar record encode
+# degrades to the pickle container — slower blocks, identical data).
+# The socket-level sites
 # (net.tcp.*, net.multiplexer.*, net.dispatcher.timer) are swept by
 # tests/net/test_fault_injection.py, included here too, and the
 # loop-replay site (api.loop.replay — a failed replayed dispatch must

@@ -51,7 +51,11 @@ _CHAOS_SITES = ("api.mesh.dispatch", "data.blockstore.put",
                 # em-spill POISON contract is pinned by the fault
                 # matrix + tests/api/test_out_of_core.py — these
                 # pipelines never host-EM-spill)
-                "vfs.prefetch", "data.spill.writeback")
+                "vfs.prefetch", "data.spill.writeback",
+                # native columnar spill records (ISSUE 15): an encode
+                # failure anywhere (serializer blocks, em run spill)
+                # degrades to the pickle container — never wrong data
+                "data.records.encode")
 
 import os
 

@@ -38,7 +38,7 @@ OVERLAP_OFF = {"THRILL_TPU_PREFETCH": "0", "THRILL_TPU_WRITEBACK": "0"}
 def _clean(monkeypatch):
     for var in ("THRILL_TPU_PREFETCH", "THRILL_TPU_WRITEBACK",
                 "THRILL_TPU_WRITEBACK_QUEUE", "THRILL_TPU_SPILL_RESIDENT",
-                "THRILL_TPU_HOST_SORT_RUN"):
+                "THRILL_TPU_HOST_SORT_RUN", "THRILL_TPU_NATIVE_RECORDS"):
         monkeypatch.delenv(var, raising=False)
     monkeypatch.delenv(faults.ENV_VAR, raising=False)
     faults.REGISTRY.reset()
@@ -198,6 +198,134 @@ def test_em_sort_prefetch_failure_degrades_to_demand(monkeypatch):
         assert faults.REGISTRY.injected >= 1
         assert any(e.get("what", "").endswith("prefetch_degraded")
                    for e in faults.REGISTRY.events)
+    finally:
+        ctx.close()
+
+
+# ----------------------------------------------------------------------
+# native columnar spill records (ISSUE 15): on/off x prefetch x W
+# ----------------------------------------------------------------------
+
+# in-tier: prefetch-on legs at both W; the prefetch-off legs repeat
+# the same comparison through the synchronous ladder and ride the slow
+# sweep (tier-1 budget rule: one representative per axis in-tier)
+@pytest.mark.parametrize("W,prefetch", [
+    (1, True), (2, True),
+    pytest.param(1, False, marks=pytest.mark.slow),
+    pytest.param(2, False, marks=pytest.mark.slow)])
+def test_em_sort_native_records_bit_identity(W, prefetch, monkeypatch):
+    """THRILL_TPU_NATIVE_RECORDS on vs off over the EM sort in the
+    pinned disk regime: identical results, and the structural witness
+    that the on leg really encoded columnar blocks while the off leg
+    produced none (spilling today's pickle runs exactly)."""
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "500")
+    monkeypatch.setenv("THRILL_TPU_SPILL_RESIDENT", "64K")
+    if not prefetch:
+        for k, v in OVERLAP_OFF.items():
+            monkeypatch.setenv(k, v)
+    items = _em_items(6000, seed=21)
+    ctx = Context(MeshExec(num_workers=W))
+    try:
+        on, st_on = _em_sort_run(ctx, items)
+        monkeypatch.setenv("THRILL_TPU_NATIVE_RECORDS", "0")
+        off, st_off = _em_sort_run(ctx, items)
+        assert on == off == sorted(items)
+        assert st_on.get("records_blocks", 0) > 0
+        assert st_off.get("records_blocks", 0) == 0
+    finally:
+        ctx.close()
+
+
+def test_em_sort_tuple_items_native_records(monkeypatch):
+    """Composite (int, float, str) items ride the columnar format too
+    — per-field columns, exact tuple rebuild at the merge."""
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "500")
+    rng = np.random.default_rng(31)
+    items = [(int(v), float(v % 97) / 8, f"s{v % 13}")
+             for v in rng.integers(0, 1 << 30, size=4000).tolist()]
+    ctx = Context(MeshExec(num_workers=1))
+    try:
+        got, st = _em_sort_run(ctx, items)
+        assert got == sorted(items)
+        assert st.get("records_blocks", 0) > 0
+    finally:
+        ctx.close()
+
+
+def test_checkpoint_host_shards_native_records_bit_identity(
+        monkeypatch, tmp_path):
+    """Host-storage checkpoint shards encode through serialize_batch —
+    columnar with the records format on. A resume with the knob ON and
+    a resume with it OFF (decode of all container kinds always stays
+    on) both restore the columnar epoch bit-identically."""
+    items = [f"v-{(i * 7919) % 100000:05d}" for i in range(1500)]
+
+    def job(ctx):
+        node = ctx.Distribute(list(items), storage="host") \
+            .Checkpoint().node
+        hs = node.materialize()
+        return [it for lst in hs.lists for it in lst]
+
+    cfg = Config(ckpt_dir=str(tmp_path / "ckpt"), num_workers=2)
+    base = Run(job, cfg)
+    assert base == items
+    got_on = Run(job, cfg, resume=True)
+    monkeypatch.setenv("THRILL_TPU_NATIVE_RECORDS", "0")
+    got_off = Run(job, cfg, resume=True)
+    assert got_on == got_off == items
+
+
+def test_pressure_spill_native_records_bit_identity(monkeypatch):
+    """The HBM pressure spill/restore ladder (device leaves park in
+    the block store by pointer now) is knob-independent and exact
+    under both settings of the records format."""
+    monkeypatch.setenv("THRILL_TPU_HBM_LIMIT", "64Ki")
+    monkeypatch.setenv("THRILL_TPU_SPILL_RESIDENT", "64K")
+    want = None
+    for knob in ("1", "0"):
+        monkeypatch.setenv("THRILL_TPU_NATIVE_RECORDS", knob)
+        ctx = Context(MeshExec(num_workers=2))
+        try:
+            a = ctx.Distribute(np.arange(8192, dtype=np.int64))
+            a.Keep(2)
+            assert a.Size() == 8192
+            got = sorted(int(x) for x in ctx.Distribute(
+                np.arange(8192, dtype=np.int64))
+                .Map(lambda x: x * 3).AllGather())
+            restored = [int(x) for x in a.AllGather()]
+            assert ctx.overall_stats()["hbm_spills"] >= 1
+        finally:
+            ctx.close()
+        if want is None:
+            want = (got, restored)
+        else:
+            assert (got, restored) == want
+    assert want[0] == [x * 3 for x in range(8192)]
+    assert want[1] == list(range(8192))
+
+
+def test_em_sort_learned_prefetch_depth_replans(monkeypatch):
+    """ROADMAP edge (b): a poor audited hit rate at em_sort.merge
+    grows THAT site's readahead depth on the next run and lands a
+    kind=replan ledger record naming the rate."""
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "400")
+    monkeypatch.setenv("THRILL_TPU_SPILL_RESIDENT", "64K")
+    items = _em_items(4000, seed=33)
+    ctx = Context(MeshExec(num_workers=1))
+    try:
+        r1, _ = _em_sort_run(ctx, items)
+        pl = ctx.mesh_exec.planner
+        rate = pl._io_rate.get("em_sort.merge")
+        assert rate is not None
+        if rate >= pl.IO_HIT_TARGET:
+            pytest.skip(f"rig's readahead kept up (rate {rate:.2f}) — "
+                        f"nothing to replan")
+        r2, _ = _em_sort_run(ctx, items)
+        assert r1 == r2 == sorted(items)
+        assert pl._io_depth.get("em_sort.merge", 0) > 0
+        replans = [r for r in ctx.mesh_exec.decisions.records
+                   if r.kind == "replan" and r.site == "em_sort.merge"]
+        assert replans and "hit rate" in replans[-1].reason
     finally:
         ctx.close()
 

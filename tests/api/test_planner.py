@@ -274,6 +274,47 @@ def test_prune_verdict_reoptimizes_on_observed_fraction(monkeypatch):
     assert recs and "fraction" in recs[-1]["reason"]
 
 
+def test_io_prefetch_depth_learns_from_audited_hit_rate():
+    """ISSUE 15 satellite (ROADMAP edge (b)): an io_prefetch audit
+    whose measured hit rate lands under the target marks the site; the
+    next depth choice AT THAT SITE doubles (capped), lands a
+    kind=replan ledger record naming both depths and the rate, and
+    other sites keep their seed."""
+    mex = _StubMex()
+    mex.decisions = DecisionLedger(enabled=True)
+    mex.planner = Planner(mex, enabled=True)
+    mex.decisions.audit_hook = mex.planner.on_audit
+    pl = mex.planner
+    # healthy site: rate above target -> seed depth unchanged
+    rec = mex.decisions.record("io_prefetch", "em_sort.merge",
+                               "depth=4", predicted=1.0, depth=4)
+    mex.decisions.resolve(rec, 0.9)
+    assert pl.io_prefetch_depth("em_sort.merge", 4) == 4
+    # poor site: rate under target -> depth doubles, replan recorded
+    rec = mex.decisions.record("io_prefetch", "ckpt.restore",
+                               "depth=4", predicted=1.0, depth=4)
+    mex.decisions.resolve(rec, 0.25)
+    assert pl.io_prefetch_depth("ckpt.restore", 4) == 8
+    assert pl.io_prefetch_depth("ckpt.restore", 4) == 8  # sticky
+    assert pl.io_prefetch_depth("em_sort.merge", 4) == 4  # per-site
+    recs = [d for d in mex.decisions.snapshot()
+            if d["kind"] == "replan" and d["site"] == "ckpt.restore"]
+    assert recs and "hit rate" in recs[-1]["reason"]
+    assert recs[-1]["chosen"] == "depth=8"
+    # repeated poor audits keep growing, but never past the cap
+    for _ in range(8):
+        rec = mex.decisions.record("io_prefetch", "ckpt.restore",
+                                   "depth=8", predicted=1.0)
+        mex.decisions.resolve(rec, 0.1)
+        pl.io_prefetch_depth("ckpt.restore", 4)
+    assert pl.io_prefetch_depth("ckpt.restore", 4) == pl.IO_DEPTH_CAP
+    # an explicit prefetch-off (THRILL_TPU_PREFETCH=0 passes default
+    # 0) is NEVER overridden by a learned depth — the synchronous
+    # ladder restoration contract (and the bench sync leg) depend on it
+    assert pl.io_prefetch_depth("ckpt.restore", 0) == 0
+    assert pl.io_prefetch_depth("em_sort.merge", 0) == 0
+
+
 def test_prune_inputs_agree_across_controllers():
     """ROADMAP satellite: multi-controller auto no longer resolves OFF
     — local counts all-reduce to the global sum over the host control

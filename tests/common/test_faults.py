@@ -708,6 +708,48 @@ def _ex_spill_writeback():
                for e in faults.REGISTRY.events)
 
 
+def _ex_records_encode_degrades():
+    """data.records.encode: an encode failure DEGRADES to the pickle
+    container — the bytes differ, the DATA never does — on both the
+    serializer path (any File block) and the em_sort run-spill path
+    (the native job falls back to per-item writes on the writer
+    thread; the job completes, nothing poisons)."""
+    from thrill_tpu.data import serializer
+
+    items = [(i, f"s{i}") for i in range(100)]
+    with faults.inject("data.records.encode", n=0, seed=4):
+        blob = serializer.serialize_batch(items)
+        assert serializer._parse_header(blob)[0] == serializer._PICKLE
+        assert serializer.deserialize_batch(blob) == items
+
+        # the real spill path: run-encode degrades, results exact
+        from thrill_tpu.api.context import Context
+        from thrill_tpu.parallel.mesh import MeshExec
+        prev = os.environ.get("THRILL_TPU_HOST_SORT_RUN")
+        os.environ["THRILL_TPU_HOST_SORT_RUN"] = "200"
+        try:
+            ctx = Context(MeshExec(num_workers=1))
+            try:
+                data = [f"k-{(i * 7919) % 1000:04d}" for i in
+                        range(600)]
+                node = ctx.Distribute(data, storage="host").Sort().node
+                hs = node.materialize()
+                got = [it for lst in hs.lists for it in lst]
+                assert got == sorted(data)
+                assert getattr(node, "_em_stats",
+                               {}).get("records_blocks", 0) == 0
+            finally:
+                ctx.close()
+        finally:
+            if prev is None:
+                os.environ.pop("THRILL_TPU_HOST_SORT_RUN", None)
+            else:
+                os.environ["THRILL_TPU_HOST_SORT_RUN"] = prev
+    assert faults.REGISTRY.injected >= 2
+    assert any(e.get("what") == "records.encode_degraded"
+               for e in faults.REGISTRY.events)
+
+
 def _ckpt_roundtrip(tmp_dir):
     """One checkpointed run + one resumed run in tmp_dir; returns the
     two results (must be equal) and the resumed run's stats."""
@@ -938,6 +980,9 @@ _MATRIX = {
     # degrades to RAM residency (blockpool eviction) — never loss
     "vfs.prefetch": _ex_vfs_prefetch_degrades,
     "data.spill.writeback": _ex_spill_writeback,
+    # native columnar spill records (ISSUE 15): encode failures fall
+    # back to the pickle container — slower, never wrong data
+    "data.records.encode": _ex_records_encode_degrades,
     "vfs.s3.read": _ex_vfs_scheme_sites,
     "vfs.hdfs.open": _ex_vfs_scheme_sites,
 }
@@ -963,6 +1008,7 @@ def test_every_registered_site_is_covered():
     import thrill_tpu.api.checkpoint  # noqa: F401
     import thrill_tpu.api.context  # noqa: F401
     import thrill_tpu.data.block_pool  # noqa: F401
+    import thrill_tpu.data.records  # noqa: F401
     import thrill_tpu.net.heartbeat  # noqa: F401
     import thrill_tpu.data.multiplexer  # noqa: F401
     import thrill_tpu.mem.hbm  # noqa: F401

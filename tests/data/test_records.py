@@ -1,0 +1,235 @@
+"""Native columnar spill records (ISSUE 15): the serializer's columnar
+container kind, the schema probe's exactness contract, the native
+sort/gather engine, and the GIL-release property the whole tentpole
+exists for.
+
+Contracts under test:
+
+* Round trips are EXACT — values and python types (True is not 1, int
+  is not float, str is not bytes) — for every supported schema, and
+  anything the format cannot represent exactly falls back to pickle
+  (never wrong data, never a lossy column).
+* ``THRILL_TPU_NATIVE_RECORDS=0`` restores the pre-columnar
+  ``serialize_batch`` bytes BIT-IDENTICALLY (pinned against a local
+  reference implementation of the old encoder).
+* The native engine's argsort/gather agree with numpy row for row, and
+  a ctypes encode call RELEASES the GIL (a spinning main thread makes
+  real progress while a worker thread encodes).
+* ``data.records.encode`` degrades to pickle with a recovery note.
+"""
+
+import pickle
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from thrill_tpu.common import faults
+from thrill_tpu.data import records, serializer
+from thrill_tpu.data.file import File
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("THRILL_TPU_NATIVE_RECORDS", raising=False)
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+def _legacy_serialize_batch(items):
+    """The pre-ISSUE-15 serialize_batch, verbatim, for the knob-off
+    bit-identity pin (ndarray batches unchanged either way)."""
+    if items and all(isinstance(it, np.ndarray) for it in items) and \
+            len({(it.dtype.str, it.shape) for it in items}) == 1:
+        arr = np.stack(items)
+        header = pickle.dumps((0, arr.dtype.str, arr.shape))
+        return struct.pack("<I", len(header)) + header + \
+            np.ascontiguousarray(arr).tobytes()
+    header = pickle.dumps((1, None, len(items)))
+    return struct.pack("<I", len(header)) + header + \
+        pickle.dumps(items)
+
+
+# ----------------------------------------------------------------------
+# round trips: values AND types exact
+# ----------------------------------------------------------------------
+
+ROUNDTRIP_BATCHES = [
+    [1, 2, -5, 2 ** 62],
+    [True, False, True],
+    [1.5, -0.0, float("inf")],
+    ["abc", "x", "defgh", ""],
+    ["ключ-1", "ключ-2"],                     # non-ASCII: U column
+    [b"ab", b"c", b"a\x00b"],                 # interior NUL is fine
+    [(0, "abc"), (1, "x")],
+    [(5, (1, 2.5)), (6, (3, -1.5))],
+    [(1, ("a", b"b", True, 2, 3.5)), (2, ("c", b"d", False, 4, 5.5))],
+]
+
+
+@pytest.mark.parametrize("items", ROUNDTRIP_BATCHES,
+                         ids=lambda b: repr(b)[:30])
+def test_columnar_roundtrip_exact(items):
+    blob = serializer.serialize_batch(items)
+    assert serializer._parse_header(blob)[0] == serializer._COLS
+    back = serializer.deserialize_batch(blob)
+    assert back == items
+    assert [type(x) for x in back] == [type(x) for x in items]
+    # nested element types too (True == 1 would pass the == above)
+    def flat(x):
+        return sum((flat(e) for e in x), []) if isinstance(x, tuple) \
+            else [x]
+    assert [type(v) for it in back for v in flat(it)] == \
+        [type(v) for it in items for v in flat(it)]
+    # byte-arithmetic slice + lazy iterator agree
+    assert serializer.deserialize_slice(blob, 1, len(items)) == \
+        items[1:]
+    assert list(serializer.deserialize_iter(blob, 0, len(items))) == \
+        items
+
+
+def test_columnar_projection_skips_columns():
+    items = [(i, f"s{i}") for i in range(5)]
+    blob = serializer.serialize_batch(items)
+    assert list(serializer.deserialize_iter(blob, 0, 5, project=1)) \
+        == [f"s{i}" for i in range(5)]
+    assert list(serializer.deserialize_iter(blob, 2, 4, project=0)) \
+        == [2, 3]
+
+
+def test_ascii_strings_compact_to_one_byte_per_char():
+    """Spill volume is the out-of-core tier's currency: ASCII str
+    columns must ride S storage (1 byte/char), not UCS-4."""
+    items = ["k" * 16] * 64
+    blob = serializer.serialize_batch(items)
+    assert serializer._parse_header(blob)[0] == serializer._COLS
+    assert len(blob) < 64 * 16 * 2      # UCS-4 would be ~4096 payload
+    assert serializer.deserialize_batch(blob) == items
+
+
+@pytest.mark.parametrize("items", [
+    [1, "a"],                      # mixed types at one position
+    [1 << 70],                     # out of int64
+    [True, 1],                     # bool/int mix must not widen
+    ["a\x00"],                     # trailing NUL: U strips it
+    [b"a\x00"],                    # trailing NUL: S strips it
+    [np.int64(3)],                 # numpy scalars: not canonical items
+    [(1, 2), (1, 2, 3)],           # ragged arity (zip would truncate!)
+    [(1, 2), "ab"],                # tuple/non-tuple mix
+    [(1, np.arange(3))],           # ndarray payload
+    [()],                          # empty tuple
+], ids=lambda b: repr(b)[:30])
+def test_inexact_schemas_fall_back_to_pickle(items):
+    blob = serializer.serialize_batch(items)
+    assert serializer._parse_header(blob)[0] == serializer._PICKLE
+    back = serializer.deserialize_batch(blob)
+    assert pickle.dumps(back) == pickle.dumps(items)
+
+
+def test_knob_off_restores_legacy_bytes(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_NATIVE_RECORDS", "0")
+    for items in ROUNDTRIP_BATCHES + [[np.arange(4), np.arange(4)]]:
+        assert serializer.serialize_batch(items) == \
+            _legacy_serialize_batch(items)
+
+
+def test_raw_ndarray_batches_unchanged_with_knob_on():
+    items = [np.arange(6, dtype=np.int32)] * 3
+    assert serializer.serialize_batch(items) == \
+        _legacy_serialize_batch(items)
+
+
+# ----------------------------------------------------------------------
+# the native engine
+# ----------------------------------------------------------------------
+
+def _rows(n, w=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(n, w),
+                        dtype=np.uint8).reshape(-1).view(f"S{w}")
+
+
+def test_native_argsort_and_gather_match_numpy():
+    arr = _rows(4096)
+    order = records.argsort_rows(arr)
+    want = np.argsort(arr)
+    assert (arr[order] == arr[want]).all()
+    assert (records.gather_rows(arr, order) == arr[order]).all()
+
+
+def test_write_run_blocks_roundtrip_and_projection():
+    f = File(block_items=16)
+    items = [f"k{i % 7}-{i}" for i in range(50)]
+    enc = records.make_run_encoder(items[0])
+    assert enc is not None
+    tmpl, cols = enc(items)
+    order = np.arange(49, -1, -1, dtype=np.int64)
+    records.write_run_blocks(f, order, 100, cols, tmpl, f.block_items)
+    assert len(f.blocks) == 4                 # 16+16+16+2
+    want = [(100 + int(i), items[int(i)]) for i in order]
+    assert list(f.keep_reader()) == want
+    assert f.get_item_at(3) == want[3]
+    assert list(f.slice(10, 20).consume_reader()) == want[10:20]
+    assert list(f.consume_reader(project=1)) == [w[1] for w in want]
+    f.close()
+
+
+@pytest.mark.skipif(not records.native_available(),
+                    reason="native toolchain unavailable")
+def test_encode_releases_the_gil():
+    """THE tentpole property: a worker thread's native argsort makes
+    the main thread's pure-python spin loop progress freely. With the
+    GIL held for the call's duration the spin count would be ~0 (the
+    main thread cannot be scheduled until the call returns)."""
+    arr = _rows(1 << 21, seed=3)              # ~32 MiB, ~0.5 s sort
+    done = threading.Event()
+
+    def work():
+        records.argsort_rows(arr)
+        done.set()
+
+    t = threading.Thread(target=work)
+    t.start()
+    spins = 0
+    t0 = time.perf_counter()
+    while not done.is_set() and time.perf_counter() - t0 < 30:
+        spins += 1
+    t.join(30)
+    assert done.is_set()
+    assert spins > 10_000, (
+        f"main thread spun only {spins} times while the native encode "
+        f"ran — the GIL was not released")
+
+
+def test_encode_fault_degrades_to_pickle():
+    items = [(i, f"s{i}") for i in range(10)]
+    with faults.inject("data.records.encode", n=1, seed=7):
+        blob = serializer.serialize_batch(items)
+    assert serializer._parse_header(blob)[0] == serializer._PICKLE
+    assert serializer.deserialize_batch(blob) == items
+    assert faults.REGISTRY.injected >= 1
+    assert any(e.get("what") == "records.encode_degraded"
+               for e in faults.REGISTRY.events)
+
+
+def test_blockwriter_produces_columnar_blocks_and_mixed_files_read():
+    """A File whose writer sees columnar-able batches produces _COLS
+    blocks; pickle-only batches coexist in the same File and every
+    reader walks both."""
+    f = File(block_items=8)
+    with f.writer() as w:
+        for i in range(8):
+            w.put((i, float(i)))          # -> one columnar block
+        for i in range(8):
+            w.put((i, [i]))               # list payload -> pickle
+    kinds = {serializer._parse_header(f.pool.get(b.bid))[0]
+             for b in f.blocks}
+    assert kinds == {serializer._COLS, serializer._PICKLE}
+    got = list(f.keep_reader())
+    assert got == [(i, float(i)) for i in range(8)] + \
+        [(i, [i]) for i in range(8)]
+    f.close()

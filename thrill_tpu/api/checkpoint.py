@@ -491,11 +491,34 @@ class CheckpointManager:
         ``THRILL_TPU_PREFETCH=0`` restores strictly sequential reads."""
         from ..data.writeback import make_readahead, overlapped_fetch
         from ..vfs.file_io import prefetch_depth
+        from ..common.decisions import record_of, resolve_io_prefetch
         from ..common.iostats import IO as _IOSTATS
+        from .planner import planner_of
         workers = list(workers)
-        ra = make_readahead(prefetch_depth()) \
-            if len(workers) > 1 else None
+        mex = self.ctx.mesh_exec
+        ra = None
+        drec = None
         st: dict = {}
+        io0 = _IOSTATS.snapshot()
+        if len(workers) > 1:
+            # planner consult + decision record only when a readahead
+            # pool actually runs — a 1-file restore must not consume a
+            # replan mark or ledger a re-optimization it never
+            # exercised
+            depth = prefetch_depth()
+            pl = planner_of(mex)
+            if pl is not None:
+                # per-site learned depth (seeded from this site's
+                # audited hit rate, not just the one env default)
+                depth = pl.io_prefetch_depth("ckpt.restore", depth)
+            ra = make_readahead(depth)
+            if ra is not None:
+                drec = record_of(
+                    mex, "io_prefetch", "ckpt.restore",
+                    f"depth={depth}", predicted=1.0,
+                    reason="overlap next shard's read with the "
+                           "current decode+upload",
+                    files=len(workers), depth=depth)
         try:
             yield from overlapped_fetch(
                 workers,
@@ -511,6 +534,8 @@ class CheckpointManager:
         finally:
             if ra is not None:
                 ra.shutdown(wait=True, cancel_futures=True)
+            resolve_io_prefetch(
+                mex, drec, _IOSTATS.delta(_IOSTATS.snapshot(), io0))
 
     def _restore_device(self, rec: dict, edir: str) -> DeviceShards:
         import jax

@@ -750,7 +750,8 @@ class Context:
                           # I/O flows sum; the queue peak maxes
                           "prefetch_hits", "prefetch_misses",
                           "io_wait_s", "io_busy_s", "writeback_bytes",
-                          "restore_overlaps",
+                          "restore_overlaps", "spill_runs",
+                          "prefetch_submits", "records_blocks",
                           # link repairs and stale-frame drops are
                           # per-process transport events; the abort/
                           # generation counters are coordinated (host

@@ -239,10 +239,12 @@ class SortNode(DIABase):
         disposed the parent), shard lists are released as they spill so
         the spilled copy replaces — not duplicates — the resident items.
         """
-        from ...common.decisions import record_of, resolve_of
+        from ...common import faults
+        from ...common.decisions import record_of, resolve_io_prefetch
         from ...common.iostats import IO as _IOSTATS, hit_rate, \
             overlap_frac
         from ...common.sampling import ReservoirSamplingGrow
+        from ...data import records as native_records
         from ...data.block_pool import spill_pool
         from ...data.writeback import AsyncWriter, make_readahead
         from ...core import native_merge, order_key
@@ -287,6 +289,18 @@ class SortNode(DIABase):
         col_arrs: list = []
         col_items: list = []
         col_pos0 = 0
+        # native-record spiller: when the ITEMS themselves vectorize
+        # into fixed-dtype columns (data/records.py schema probe), a
+        # fully-columnar run spills through _records_job — the payload
+        # encode, memcmp argsort, pos+payload gather and block handoff
+        # ALL run inside the write-behind job, off the main thread's
+        # critical path, and the native calls release the GIL so the
+        # writer genuinely overlaps the next run's encode. A run the
+        # encoder cannot represent exactly degrades to the per-item
+        # path inside the job (never wrong data); the key-columnar
+        # state is unaffected.
+        rec_probe = "probe"
+        rec_enc = None
         pos = 0
         # real-memory feedback: run_size is an ESTIMATE from one
         # pickled item; the RSS budget is ground truth and spills the
@@ -326,21 +340,25 @@ class SortNode(DIABase):
         writer = AsyncWriter("em_sort.spill",
                              tracer=getattr(mex, "tracer", None))
 
+        def _widen_concat(arrs):
+            """One S-W key array from per-batch arrays of possibly
+            different widths (str batches pad to their own max): widen
+            with zero pads — order-safe by the padding argument in
+            order_key make_array_batch_encoder."""
+            W_ = max(a.dtype.itemsize for a in arrs)
+            for j, a in enumerate(arrs):
+                w_ = a.dtype.itemsize
+                if w_ != W_:
+                    buf = np.zeros((len(a), W_), np.uint8)
+                    buf[:, :w_] = a.view(np.uint8).reshape(
+                        len(a), w_)               # zero-copy source
+                    arrs[j] = buf.reshape(-1).view(f"S{W_}")
+            return arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+
         def _columnar_job(arrs, items_, p0, slot):
             def job():
                 b0 = pool.bytes_put
-                # widths may differ (str batches pad to their own max):
-                # widen with zero pads — order-safe by the padding
-                # argument in order_key make_array_batch_encoder
-                W_ = max(a.dtype.itemsize for a in arrs)
-                for j, a in enumerate(arrs):
-                    w_ = a.dtype.itemsize
-                    if w_ != W_:
-                        buf = np.zeros((len(a), W_), np.uint8)
-                        buf[:, :w_] = a.view(np.uint8).reshape(
-                            len(a), w_)           # zero-copy source
-                        arrs[j] = buf.reshape(-1).view(f"S{W_}")
-                arr = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+                arr = _widen_concat(arrs)
                 order = np.argsort(arr)
                 f = File(pool=pool)
                 with f.writer() as w:
@@ -348,6 +366,48 @@ class SortNode(DIABase):
                         w.put((p0 + i, items_[i]))
                 kf = File(pool=pool)
                 native_merge.write_key_chunks_fixed(kf, arr[order])
+                files[slot] = f
+                key_files[slot] = kf
+                return pool.bytes_put - b0
+            return job
+
+        def _records_job(arrs, items_, p0, slot):
+            """Native-records spill: the whole encode — vectorized
+            payload columns, memcmp argsort, pos/payload gather, block
+            handoff — runs INSIDE the write-behind job, so the main
+            thread pays nothing beyond handing over the item list it
+            already held, and the native calls (native/records.cpp)
+            release the GIL for the job's heavy part. Any encode
+            failure (schema deviation inside the run, injected
+            ``data.records.encode``, or real) DEGRADES to the per-item
+            pickle path on the same data — slower, never wrong, never
+            poisons."""
+            def job():
+                b0 = pool.bytes_put
+                arr = _widen_concat(arrs)
+                order = native_records.argsort_rows(arr)
+                f = File(pool=pool)
+                enc = None
+                try:
+                    enc = rec_enc(items_)
+                    if enc is not None:
+                        native_records.write_run_blocks(
+                            f, order, p0, enc[1], enc[0],
+                            f.block_items)
+                except Exception as e:
+                    faults.note("recovery",
+                                what="records.encode_degraded",
+                                error=repr(e)[:200])
+                    f.clear()
+                    f = File(pool=pool)
+                    enc = None
+                if enc is None:
+                    with f.writer() as w:
+                        for i in order.tolist():
+                            w.put((p0 + i, items_[i]))
+                kf = File(pool=pool)
+                native_merge.write_key_chunks_fixed(
+                    kf, native_records.gather_rows(arr, order))
                 files[slot] = f
                 key_files[slot] = kf
                 return pool.bytes_put - b0
@@ -382,15 +442,26 @@ class SortNode(DIABase):
             slot = len(files)
             files.append(None)
             key_files.append(None)
+            _IOSTATS.add(spill_runs=1)
             if col_items:
                 # fully-columnar run: ordering is ONE argsort over the
                 # S-w rows (C memcmp — no Python compares, no per-key
                 # objects); the key file writes vectorized slices of
                 # the sorted array. The pos suffix makes every row
-                # distinct, so argsort stability is immaterial.
-                writer.submit(_columnar_job(list(col_arrs),
-                                            list(col_items), col_pos0,
-                                            slot), tag=slot)
+                # distinct, so argsort stability is immaterial. With a
+                # records-encodable item schema the whole job (payload
+                # columns + sort + gather + handoff) runs natively in
+                # the writer.
+                if rec_enc is not None:
+                    writer.submit(_records_job(list(col_arrs),
+                                               list(col_items),
+                                               col_pos0, slot),
+                                  tag=slot)
+                else:
+                    writer.submit(_columnar_job(list(col_arrs),
+                                                list(col_items),
+                                                col_pos0, slot),
+                                  tag=slot)
                 col_arrs.clear()
                 col_items.clear()
             elif enc is not None:
@@ -420,12 +491,16 @@ class SortNode(DIABase):
             bottleneck of the whole EM sort, bigger than the merge it
             feeds."""
             nonlocal enc, enc_state, enc_arr, pos, col_pos0
+            nonlocal rec_probe, rec_enc
             if enc_state == "probe" and batch:
                 enc = order_key.make_batch_encoder(sort_key(batch[0]))
                 enc_state = "on" if enc is not None else "off"
                 if enc is not None:
                     enc_arr = order_key.make_array_batch_encoder(
                         sort_key(batch[0]))
+            if rec_probe == "probe" and batch:
+                rec_probe = "done"
+                rec_enc = native_records.make_run_encoder(batch[0])
             if enc is not None:
                 keys = list(map(sort_key, batch))
                 try:
@@ -488,8 +563,13 @@ class SortNode(DIABase):
                 spill()
             # pre-merge barrier: every run durably spilled (a writer
             # error re-raises HERE with its root cause — the merge
-            # never reads a half-flushed run)
+            # never reads a half-flushed run), THEN the block store's
+            # own eviction queue drained — the merge's surgical
+            # readahead consults resident(), and a settled store makes
+            # that policy (and the perf sentinel's prefetch counters) a
+            # pure function of the program, not of writer-thread timing
             writer.flush()
+            pool.flush()
             t_phase1 = _time.perf_counter()
 
             # merge readahead: one prefetch slot per run (planner-
@@ -538,15 +618,16 @@ class SortNode(DIABase):
             io_all = _IOSTATS.delta(_IOSTATS.snapshot(), io_base)
             io_merge = _IOSTATS.delta(_IOSTATS.snapshot(), io_merge0)
             hr = hit_rate(io_merge)
-            # a measured ALL-MISS merge must resolve as actual=0-ish
-            # (the audit's strongest signal); only a merge that never
-            # consumed readahead at all stays unmeasured
-            consumed = io_merge["prefetch_hits"] \
-                + io_merge["prefetch_misses"]
-            resolve_of(mex, rec, max(hr, 1e-3) if consumed else None)
+            # shared audit-join formula (common/decisions.py): the
+            # planner's learned depth feeds off exactly this signal at
+            # every readahead site
+            resolve_io_prefetch(mex, rec, io_merge)
             self._em_stats = {
                 "runs": len(files), "engine":
                     "native" if enc is not None else "py",
+                # columnar blocks the native record format encoded (0 =
+                # every run spilled through the per-item pickle path)
+                "records_blocks": io_all.get("records_blocks", 0),
                 "spill_s": round(t_phase1 - t_phase0, 3),
                 "merge_s": round(_time.perf_counter() - t_phase1, 3),
                 "overlap_frac": round(overlap_frac(io_all), 3),

@@ -126,6 +126,11 @@ class Planner:
         # counters (ctx.overall_stats: planner_replans / _switches)
         self.replans = 0        # sites invalidated and re-chosen
         self.switches = 0       # re-choices that changed the plan
+        # learned per-site readahead depths (ISSUE 15 / ROADMAP edge
+        # (b)): grown from the audited io_prefetch hit rate, replacing
+        # the single THRILL_TPU_PREFETCH default per site
+        self._io_depth: Dict[str, int] = {}
+        self._io_rate: Dict[str, float] = {}
 
     # -- cost model -----------------------------------------------------
     def bytes_eq(self) -> int:
@@ -272,17 +277,59 @@ class Planner:
         return split_k(cap)
 
     # -- choice: out-of-core readahead depth ----------------------------
+
+    #: grow the depth when a site's audited hit rate falls under this
+    #: (log2(1/0.75) ~ 0.415 on the pred=1.0 io_prefetch records)
+    IO_HIT_TARGET = 0.75
+    #: never grow past this — beyond it the readahead pool itself (not
+    #: depth) is the bound, and RAM cost scales with depth blocks
+    IO_DEPTH_CAP = 32
+
     def io_prefetch_depth(self, site: str, default: int) -> int:
-        """Readahead depth for an out-of-core site (the em_sort merge,
-        spill/checkpoint restore). The policy is the env-pinned depth
-        (one definition: vfs/file_io.prefetch_depth, passed in as
-        ``default``); owning the choice here puts it in the decision
-        ledger so ``ctx.explain()`` and the audit loop cover I/O like
-        every other plan decision — the recorded prediction (perfect
-        hit rate) joins against the measured rate, which is the signal
-        a future depth model would learn from."""
-        self.take_replan(site)      # marks are consumed, not yet acted
-        return default
+        """LEARNED per-site readahead depth for an out-of-core site
+        (the em_sort merge, spill/checkpoint restore).
+
+        Seeding: the env-pinned depth (vfs/file_io.prefetch_depth,
+        passed in as ``default``) the first time a site runs. Learning:
+        every run records an ``io_prefetch`` decision predicting a
+        perfect hit rate; the audit join (:meth:`on_audit`) marks the
+        site when the MEASURED rate lands under ``IO_HIT_TARGET`` —
+        the consumer outran the readahead — and the next run at that
+        site doubles its depth (capped) instead of riding the one env
+        default forever. Each re-choice lands as a ``kind=replan``
+        ledger record carrying both depths and the measured rate, so
+        ``ctx.explain()`` names the switch like any other plan
+        re-optimization. ``default <= 0`` means prefetch is DISABLED
+        (THRILL_TPU_PREFETCH=0 / OVERLAP=0) — the learned depth never
+        overrides an explicit off switch (the synchronous-ladder
+        restoration contract)."""
+        if default <= 0:
+            return default
+        with self._lock:
+            depth = self._io_depth.get(site, default)
+            if depth >= self.IO_DEPTH_CAP:
+                # at the cap there is nothing to re-choose: drop any
+                # pending mark WITHOUT counting a replan (the counter
+                # counts performed re-optimizations, and none happens)
+                self._replan.pop(site, None)
+                return depth
+        why = self.take_replan(site)
+        if why is None:
+            return depth
+        new = min(max(depth * 2, default), self.IO_DEPTH_CAP)
+        with self._lock:
+            self._io_depth[site] = new
+            rate = self._io_rate.get(site)
+        if new != depth:
+            self.note_switch()
+        from ..common.decisions import ledger_of
+        self.record_replan(
+            ledger_of(self.mex), site, f"depth={new}",
+            predicted=float(new),
+            rejected=[(f"depth={depth}", rate)], reason=why,
+            depth=new, prev_depth=depth,
+            measured_hit_rate=rate)
+        return new
 
     # -- re-optimization ------------------------------------------------
     def note_seeded(self, site: str) -> None:
@@ -354,6 +401,20 @@ class Planner:
                     rec.site,
                     f"observed prune fraction off the prediction "
                     f"{2 ** abs(err):.1f}x")
+        elif rec.kind == "io_prefetch":
+            # predicted = 1.0 (perfect hit rate); a measured rate
+            # under the target means the consumer outran the
+            # readahead — grow that SITE's depth on its next run
+            rate = rec.actual
+            if rate is None:
+                return
+            with self._lock:
+                self._io_rate[rec.site] = float(rate)
+            if rate < self.IO_HIT_TARGET:
+                self.mark_replan(
+                    rec.site,
+                    f"prefetch hit rate {rate:.2f} under the "
+                    f"{self.IO_HIT_TARGET:.2f} target")
 
     def record_replan(self, led, site: str, chosen: str, predicted,
                       rejected, reason: str, **inputs: Any) -> None:

@@ -417,6 +417,25 @@ def resolve_of(mex, rec: Optional[DecisionRecord], actual,
         led.resolve(rec, actual, verdict=verdict)
 
 
+def resolve_io_prefetch(mex, rec: Optional[DecisionRecord],
+                        io_delta: dict) -> None:
+    """THE audit-join formula for ``io_prefetch`` decisions, shared by
+    every readahead site (em_sort merge, checkpoint/hbm restore):
+    joined actual = the measured hit rate over the window's consumed
+    readahead, clamped away from zero so an all-miss run resolves as a
+    loud finite error; a window that never consumed readahead at all
+    stays unmeasured. One definition — the planner's learned per-site
+    depth grows from this signal, and the sites must not drift apart
+    in what they feed it."""
+    if rec is None:
+        return
+    from .iostats import hit_rate
+    consumed = io_delta.get("prefetch_hits", 0) \
+        + io_delta.get("prefetch_misses", 0)
+    resolve_of(mex, rec,
+               max(hit_rate(io_delta), 1e-3) if consumed else None)
+
+
 # ----------------------------------------------------------------------
 # the shared explain() renderer
 # ----------------------------------------------------------------------

@@ -32,8 +32,16 @@ from __future__ import annotations
 
 import threading
 
+#: ``spill_runs`` (sorted runs handed to the write-behind spiller),
+#: ``prefetch_submits`` (block-readahead jobs actually submitted to a
+#: pool) and ``records_blocks`` (columnar blocks the native record
+#: format encoded) are DETERMINISTIC for a fixed program — the perf
+#: sentinel's em_sort contract compares them exactly, so a silent
+#: fallback to the pickle spill path fails a counter diff instead of
+#: hiding in wall-clock noise (ISSUE 15).
 _COUNTERS = ("prefetch_hits", "prefetch_misses", "io_wait_s",
-             "io_busy_s", "writeback_bytes", "restore_overlaps")
+             "io_busy_s", "writeback_bytes", "restore_overlaps",
+             "spill_runs", "prefetch_submits", "records_blocks")
 
 
 class IoStats:
@@ -46,6 +54,9 @@ class IoStats:
         self.writeback_bytes = 0
         self.writeback_queue_peak = 0
         self.restore_overlaps = 0
+        self.spill_runs = 0
+        self.prefetch_submits = 0
+        self.records_blocks = 0
 
     def add(self, **kv) -> None:
         with self._lock:
@@ -80,6 +91,8 @@ class IoStats:
             self.io_wait_s = self.io_busy_s = 0.0
             self.writeback_bytes = self.writeback_queue_peak = 0
             self.restore_overlaps = 0
+            self.spill_runs = self.prefetch_submits = 0
+            self.records_blocks = 0
 
 
 def overlap_frac(stats: dict) -> float:

@@ -6,8 +6,10 @@ buffered_multiway_merge.hpp — there used by Sort/GroupByKey to merge
 spilled sorted runs from data::Files). Here it is the standalone merge
 primitive for spilled File runs; the DIA device Sort instead merges via
 one bitonic pass on-device. File readers are merged lazily — only one
-block per run is resident, so merging stays external-memory-friendly;
-heapq plays the role of the tournament tree.
+block per run is resident, and a block's decode is deferred to its
+consumption (columnar native-record batches decode zero-copy column
+views with no pickle parse, data/file.py readers) — so merging stays
+external-memory-friendly; heapq plays the role of the tournament tree.
 """
 
 from __future__ import annotations

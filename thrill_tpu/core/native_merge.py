@@ -78,13 +78,25 @@ def write_key_chunks(keys_file: File, key_bytes: List[bytes]) -> None:
 def write_key_chunks_fixed(keys_file: File, arr: np.ndarray) -> None:
     """Fixed-width variant of :func:`write_key_chunks`: ``arr`` is a
     key-sorted ``S{w}`` array; offsets are an arange and the blob is
-    one raw-memory copy — no per-key Python objects at all."""
+    one raw-memory copy — no per-key Python objects at all.
+
+    With native records on, each chunk spills as ONE raw ndarray item
+    (the serializer RAW kind: header + memcpy, no pickle) and the feed
+    side points the native merge straight into the decoded array —
+    zero-copy both ways. ``THRILL_TPU_NATIVE_RECORDS=0`` restores the
+    pickled ``(offs, blob)`` chunk items bit-identically."""
+    from ..data import records
     w_ = arr.dtype.itemsize
+    raw = records.enabled()
     with keys_file.writer() as wtr:
         for i in range(0, len(arr), KEY_CHUNK):
             chunk = arr[i:i + KEY_CHUNK]
-            offs = np.arange(len(chunk) + 1, dtype=np.int64) * w_
-            wtr.put((offs, chunk.tobytes()))
+            if raw:
+                wtr.put(np.ascontiguousarray(chunk))
+                wtr.flush()        # one RAW block per chunk item
+            else:
+                offs = np.arange(len(chunk) + 1, dtype=np.int64) * w_
+                wtr.put((offs, chunk.tobytes()))
 
 
 class _RunFeed:
@@ -104,6 +116,18 @@ class _RunFeed:
             rc = lib.mwm_set_chunk(
                 handle, r, 0, self.offs.ctypes.data_as(ctypes.c_void_p),
                 None, 1)
+        elif isinstance(nxt, np.ndarray):
+            # raw fixed-width chunk (write_key_chunks_fixed, native
+            # records): synthesize arange offsets and point the engine
+            # straight into the decoded array — no per-chunk bytes copy
+            w = nxt.dtype.itemsize
+            arr = np.ascontiguousarray(nxt)
+            self.offs = np.arange(len(arr) + 1, dtype=np.int64) * w
+            self.blob = arr               # owns the live buffer
+            rc = lib.mwm_set_chunk(
+                handle, r, len(arr),
+                self.offs.ctypes.data_as(ctypes.c_void_p),
+                arr.ctypes.data_as(ctypes.c_void_p), 0)
         else:
             offs, blob = nxt
             self.offs = np.ascontiguousarray(offs, dtype=np.int64)
@@ -251,8 +275,12 @@ def merge_partitioned(item_files: List[File], key_files: List[File],
             feeds = [_RunFeed(p[1].prefetch_reader(consume=consume,
                                                    submit=submit))
                      for p in pairs]
+            # project=1: only the item half of each (pos, item) record
+            # is consumed here — columnar run blocks never decode
+            # their pos columns at all (lazy decode, ISSUE 15)
             item_readers = [p[0].prefetch_reader(consume=consume,
-                                                 submit=submit)
+                                                 submit=submit,
+                                                 project=1)
                             for p in pairs]
             for r, feed in enumerate(feeds):
                 feed.feed(lib, handle, r)
@@ -280,7 +308,7 @@ def merge_partitioned(item_files: List[File], key_files: List[File],
                             w += 1
                             cur = out_lists[w]
                         else:
-                            cur.append(next(item_readers[r])[1])
+                            cur.append(next(item_readers[r]))
                 if need.value >= 0:
                     feeds[need.value].feed(lib, handle, need.value)
                     continue

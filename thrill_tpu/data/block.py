@@ -15,7 +15,7 @@ from __future__ import annotations
 
 from typing import Any, List
 
-from .serializer import deserialize_slice
+from .serializer import deserialize_iter, deserialize_slice
 
 
 class Block:
@@ -38,6 +38,19 @@ class Block:
             return []
         return deserialize_slice(self.pool.get(self.bid), self.lo,
                                  self.hi)
+
+    def iter_items(self, project=None):
+        """Items as an iterator with decode deferred to the first pull
+        (serializer.deserialize_iter): columnar batches (native
+        records, ``_COLS``) slice zero-copy column views, and
+        ``project`` yields only tuple element ``project`` — the other
+        elements' columns are never decoded (the partitioned merge
+        reads just the item half of its (pos, item) records, skipping
+        the pos columns entirely)."""
+        if self.hi == self.lo:
+            return iter(())
+        return deserialize_iter(self.pool.get(self.bid), self.lo,
+                                self.hi, project)
 
     def item_at(self, i: int) -> Any:
         return deserialize_slice(self.pool.get(self.bid),

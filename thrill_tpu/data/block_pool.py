@@ -68,7 +68,11 @@ def _load_native() -> Optional[ctypes.CDLL]:
         lib.bs_pending.restype = ctypes.c_int64
         lib.bs_pending.argtypes = [ctypes.c_void_p]
         lib.bs_put.restype = ctypes.c_int64
-        lib.bs_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+        # c_void_p (not c_char_p) so numpy buffers pass by POINTER:
+        # a spill of an ndarray (native records block, an HBM leaf
+        # shard) hands the store its memory without first copying it
+        # into a python bytes object on the GIL
+        lib.bs_put.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                ctypes.c_int64]
         lib.bs_size.restype = ctypes.c_int64
         lib.bs_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
@@ -276,20 +280,33 @@ class BlockPool:
             self._mem -= len(data)
         return len(data)
 
-    def put(self, data: bytes) -> int:
+    def put(self, data) -> int:
+        """Store one immutable byte block; returns its id. ``data`` is
+        ``bytes`` or a C-contiguous ``np.ndarray`` — arrays reach the
+        native store as a raw pointer (its Put copies internally, GIL
+        released for the whole ctypes call), so the encode side never
+        materializes an interpreter-side bytes copy."""
         return self._policy.run(lambda: self._put_once(data),
                                 what="blockstore.put")
 
-    def _put_once(self, data: bytes) -> int:
-        faults.check(_F_PUT, nbytes=len(data))
-        self.bytes_put += len(data)
+    def _put_once(self, data) -> int:
+        import numpy as np
+        is_arr = isinstance(data, np.ndarray)
+        if is_arr and not data.flags.c_contiguous:
+            data = np.ascontiguousarray(data)
+        n = data.nbytes if is_arr else len(data)
+        faults.check(_F_PUT, nbytes=n)
+        self.bytes_put += n
         if self.native:
-            return self._lib.bs_put(self._h, data, len(data))
+            ptr = data.ctypes.data_as(ctypes.c_void_p) if is_arr \
+                else data
+            return self._lib.bs_put(self._h, ptr, n)
         with self._py_lock:
             bid = self._next
             self._next += 1
-            self._blocks[bid] = bytes(data)
-            self._mem += len(data)
+            self._blocks[bid] = data.tobytes() if is_arr \
+                else bytes(data)
+            self._mem += n
         self._maybe_spill_py()
         return bid
 

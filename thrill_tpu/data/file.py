@@ -57,24 +57,27 @@ class File:
         return sum(b.num_items for b in self.blocks)
 
     # -- reading --------------------------------------------------------
-    def keep_reader(self) -> Iterator[Any]:
+    # All readers decode blocks at consumption (Block.iter_items):
+    # columnar batches decode zero-copy column views with no pickle
+    # parse, and ``project`` reads only one tuple element's columns —
+    # the k-way merge's item feeds skip the pos columns entirely
+    # (ISSUE 15).
+    def keep_reader(self, project=None) -> Iterator[Any]:
         """Stream items without consuming the file
         (reference: KeepFileBlockSource, file.hpp:349)."""
         for b in self.blocks:
-            for it in b.items():
-                yield it
+            yield from b.iter_items(project)
 
-    def consume_reader(self) -> Iterator[Any]:
+    def consume_reader(self, project=None) -> Iterator[Any]:
         """Stream items, dropping each block after it is read
         (reference: ConsumeFileBlockSource, file.hpp:414)."""
         while self.blocks:
             b = self.blocks.pop(0)
-            for it in b.items():
-                yield it
+            yield from b.iter_items(project)
             b.release()
 
     def prefetch_reader(self, consume: bool = False,
-                        submit=None) -> Iterator[Any]:
+                        submit=None, project=None) -> Iterator[Any]:
         """Keep/consume reader with ONE block read ahead on a shared
         readahead pool — the k-way merge's per-run prefetch slot
         (reference: BlockPool prefetch, thrill/data/block_pool.hpp:177):
@@ -91,12 +94,13 @@ class File:
         blocks until ``pool.close()`` (callers already clear files and
         close the pool in their cleanup)."""
         if submit is None:
-            return self.consume_reader() if consume \
-                else self.keep_reader()
-        return self._prefetch_iter(consume, submit)
+            return self.consume_reader(project) if consume \
+                else self.keep_reader(project)
+        return self._prefetch_iter(consume, submit, project)
 
-    def _prefetch_iter(self, consume: bool, submit) -> Iterator[Any]:
-        from .serializer import deserialize_slice
+    def _prefetch_iter(self, consume: bool, submit,
+                       project=None) -> Iterator[Any]:
+        from .serializer import deserialize_iter
         from .writeback import readahead_get, readahead_job
         pool = self.pool
         idx = 0
@@ -127,9 +131,8 @@ class File:
             nfut = start(nb) if nb is not None else None
             raw = readahead_get(fut, lambda blk=b: pool.get(blk.bid),
                                 "file.prefetch")
-            items = deserialize_slice(raw, b.lo, b.hi) if b.hi > b.lo \
-                else []
-            yield from items
+            if b.hi > b.lo:
+                yield from deserialize_iter(raw, b.lo, b.hi, project)
             if consume:
                 b.release()
             b, fut = nb, nfut

@@ -5,18 +5,48 @@ Equivalent of the reference's Serialization traits
 pairs/tuples, vectors; optional cereal adapter). Fixed-size numeric
 records take a raw-bytes fast path (the memcpy analog); everything else
 goes through pickle (the cereal analog).
+
+Container kinds of one block payload::
+
+    [u32 hlen][hlen pickled header][payload]
+
+* ``_RAW``    — header ``(0, dtype_str, shape)``; payload is one
+  contiguous ndarray (a stack of same-shape ndarray items).
+* ``_PICKLE`` — header ``(1, None, n)``; payload pickles the item list.
+* ``_COLS``   — header ``(2, (template, dtype_strs), nrows)``; payload
+  is the concatenation of fixed-dtype scalar COLUMNS, one per template
+  leaf. The native-records kind (ISSUE 15): items built from python
+  scalars and (nested) tuples of them encode as numpy columns with NO
+  per-item pickle work, decode by zero-copy ``np.frombuffer`` views,
+  and slice by byte arithmetic like ``_RAW``. The schema probe and the
+  vectorized encode live in data/records.py; anything it cannot
+  represent EXACTLY (mixed types, out-of-int64 ints, trailing-NUL
+  strings, ndarray/ragged payloads) falls back to ``_PICKLE``
+  byte-compatibly, and ``THRILL_TPU_NATIVE_RECORDS=0`` restores the
+  pre-columnar encode bit-identically (decode of all three kinds stays
+  on, so stores written by either setting always read back).
+
+The template grammar is tiny: ``"x"`` is one scalar leaf consuming one
+column (unboxed exactly like ``data/shards.itemize`` unboxes device
+columns — ``ndarray.tolist()`` element types: int64->int, bool->bool,
+float64->float, U->str, S->bytes, so item types never depend on which
+engine materialized them); ``"s"`` is a str leaf COMPACTED to an S
+(1 byte/char) column — ASCII only, chosen at encode time so spilled
+strings do not pay UCS-4's 4x on disk, decoded back by one vectorized
+``S->U`` cast; ``("T", sub, ...)`` is a tuple of sub-templates.
 """
 
 from __future__ import annotations
 
 import pickle
 import struct
-from typing import Any, List, Tuple
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 _RAW = 0       # np.ndarray with given dtype/shape
 _PICKLE = 1
+_COLS = 2      # fixed-dtype scalar columns (native records)
 
 
 def serialize_batch(items: List[Any]) -> bytes:
@@ -27,18 +57,105 @@ def serialize_batch(items: List[Any]) -> bytes:
         header = pickle.dumps((_RAW, arr.dtype.str, arr.shape))
         return struct.pack("<I", len(header)) + header + \
             np.ascontiguousarray(arr).tobytes()
+    if items:
+        # the columnar fast path (knob-gated inside records; returns
+        # None for anything it cannot represent exactly)
+        from . import records
+        enc = records.encode_batch_columns(items)
+        if enc is not None:
+            return serialize_columns(enc[0], enc[1])
     header = pickle.dumps((_PICKLE, None, len(items)))
     return struct.pack("<I", len(header)) + header + pickle.dumps(items)
 
 
-def deserialize_batch(data: bytes) -> List[Any]:
+# ----------------------------------------------------------------------
+# the columnar container kind
+# ----------------------------------------------------------------------
+
+def leaf_count(tmpl) -> int:
+    """Columns a template consumes (one per scalar leaf)."""
+    if tmpl in ("x", "s"):
+        return 1
+    return sum(leaf_count(s) for s in tmpl[1:])
+
+
+def columnar_header(tmpl, dstrs: Sequence[str], nrows: int) -> bytes:
+    """Length-prefixed header of a columnar block (the caller appends
+    exactly ``nrows`` rows of each column, in order)."""
+    header = pickle.dumps((_COLS, (tmpl, tuple(dstrs)), nrows))
+    return struct.pack("<I", len(header)) + header
+
+
+def serialize_columns(tmpl, cols: List[np.ndarray]) -> bytes:
+    """Pack template + columns into one block payload (the pure-python
+    assembly; the em_sort run spiller writes the same layout through
+    the native gather instead, data/records.py)."""
+    nrows = len(cols[0]) if cols else 0
+    head = columnar_header(tmpl, [c.dtype.str for c in cols], nrows)
+    return head + b"".join(
+        np.ascontiguousarray(c).tobytes() for c in cols)
+
+
+def _cols_views(data: bytes, dstrs, nrows: int, base: int, lo: int,
+                hi: int, take: Optional[Sequence[int]] = None
+                ) -> List[np.ndarray]:
+    """Zero-copy views of rows [lo, hi) of each column (or only the
+    column indices in ``take``)."""
+    out = []
+    off = base
+    for c, dstr in enumerate(dstrs):
+        isz = np.dtype(dstr).itemsize
+        if take is None or c in take:
+            out.append(np.frombuffer(data, dtype=np.dtype(dstr),
+                                     count=hi - lo,
+                                     offset=off + lo * isz))
+        off += nrows * isz
+    return out
+
+
+def _build_items(tmpl, cols: List[np.ndarray]) -> List[Any]:
+    """Rebuild the item list from sliced column views: one ``tolist``
+    per column (C-speed unboxing), tuples assembled by ``zip``."""
+    it = iter(cols)
+
+    def build(t):
+        if t == "x":
+            return next(it).tolist()
+        if t == "s":   # ASCII-compacted str: one vectorized S->U cast
+            col = next(it)
+            return col.astype(f"U{col.dtype.itemsize}").tolist()
+        parts = [build(s) for s in t[1:]]
+        return list(zip(*parts))
+
+    return build(tmpl)
+
+
+def _sub_template(tmpl, project: int):
+    """(sub_template, column_indices) of tuple element ``project``."""
+    assert tmpl not in ("x", "s") and len(tmpl) > project + 1, \
+        (tmpl, project)
+    skip = sum(leaf_count(s) for s in tmpl[1:1 + project])
+    sub = tmpl[1 + project]
+    return sub, range(skip, skip + leaf_count(sub))
+
+
+def _parse_header(data: bytes):
     (hlen,) = struct.unpack_from("<I", data, 0)
-    kind, dstr, shape_or_n = pickle.loads(data[4:4 + hlen])
-    payload = data[4 + hlen:]
+    kind, meta, n = pickle.loads(data[4:4 + hlen])
+    return kind, meta, n, 4 + hlen
+
+
+def deserialize_batch(data: bytes) -> List[Any]:
+    kind, meta, shape_or_n, base = _parse_header(data)
+    payload = data[base:]
     if kind == _RAW:
-        arr = np.frombuffer(payload, dtype=np.dtype(dstr)).reshape(
+        arr = np.frombuffer(payload, dtype=np.dtype(meta)).reshape(
             shape_or_n)
         return list(arr)
+    if kind == _COLS:
+        tmpl, dstrs = meta
+        return _build_items(tmpl, _cols_views(data, dstrs, shape_or_n,
+                                              base, 0, shape_or_n))
     return pickle.loads(payload)
 
 
@@ -77,19 +194,53 @@ def deserialize_leaves(data: bytes) -> List[np.ndarray]:
 def deserialize_slice(data: bytes, lo: int, hi: int) -> List[Any]:
     """Decode only items [lo, hi) of a batch payload.
 
-    Fixed-size records (the RAW path) decode exactly the requested
-    rows by byte arithmetic — the analog of the reference's
+    Fixed-size records (the RAW and COLS paths) decode exactly the
+    requested rows by byte arithmetic — the analog of the reference's
     ``is_fixed_size`` scatter fast path (thrill/data/serialization.hpp,
     stream.hpp:77-210: Blocks are re-sliced without deserializing).
     Variable items (pickle) must decode the whole batch first."""
-    (hlen,) = struct.unpack_from("<I", data, 0)
-    kind, dstr, shape_or_n = pickle.loads(data[4:4 + hlen])
+    kind, meta, shape_or_n, base = _parse_header(data)
     if kind == _RAW:
-        dt = np.dtype(dstr)
+        dt = np.dtype(meta)
         row_shape = tuple(shape_or_n[1:])
         row_bytes = dt.itemsize * int(np.prod(row_shape, dtype=np.int64))
-        base = 4 + hlen + lo * row_bytes
         arr = np.frombuffer(data, dtype=dt, count=(hi - lo) *
-                            (row_bytes // dt.itemsize), offset=base)
+                            (row_bytes // dt.itemsize),
+                            offset=base + lo * row_bytes)
         return list(arr.reshape((hi - lo,) + row_shape))
-    return pickle.loads(data[4 + hlen:])[lo:hi]
+    if kind == _COLS:
+        tmpl, dstrs = meta
+        return _build_items(tmpl, _cols_views(data, dstrs, shape_or_n,
+                                              base, lo, hi))
+    return pickle.loads(data[base:])[lo:hi]
+
+
+def deserialize_iter(data: bytes, lo: int, hi: int,
+                     project: Optional[int] = None) -> Iterator[Any]:
+    """Items [lo, hi) as an iterator whose DECODE is deferred to the
+    first pull (nothing happens at generator construction): columnar
+    blocks slice their column views zero-copy and ``project`` yields
+    only tuple element ``project`` of each item — the OTHER elements'
+    columns are never decoded at all (the partitioned merge consumes
+    only the item half of its (pos, item) records, so the pos columns
+    stay raw bytes). The item OBJECTS of a block still materialize
+    together at that first pull (one ``tolist`` per column + zip —
+    C-speed, no pickle); per-block memory matches the eager path.
+    Non-columnar kinds degrade to the eager decode."""
+    if hi <= lo:
+        return
+    kind, meta, shape_or_n, base = _parse_header(data)
+    if kind == _COLS:
+        tmpl, dstrs = meta
+        take = None
+        if project is not None:
+            tmpl, take = _sub_template(tmpl, project)
+        views = _cols_views(data, dstrs, shape_or_n, base, lo, hi, take)
+        yield from _build_items(tmpl, views)
+        return
+    items = deserialize_slice(data, lo, hi)
+    if project is None:
+        yield from items
+    else:
+        for t in items:
+            yield t[project]

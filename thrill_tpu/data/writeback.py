@@ -342,7 +342,12 @@ def overlapped_fetch(items, fetch: Callable[[Any], Any], what: str,
 def readahead_job(fn: Callable[[], Any],
                   what: str) -> Callable[[], Any]:
     """Wrap a block-load callable for the readahead pool: the
-    ``vfs.prefetch`` injection gate plus busy-time accounting."""
+    ``vfs.prefetch`` injection gate plus busy-time accounting. Every
+    wrap is one SUBMISSION (``prefetch_submits``) — with the spill
+    store settled at the merge barrier this count is deterministic,
+    which is what lets the perf sentinel contract it exactly."""
+    _IOSTATS.add(prefetch_submits=1)
+
     def job():
         if faults.REGISTRY.active():
             faults.check("vfs.prefetch", what=what)

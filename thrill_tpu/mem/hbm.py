@@ -96,13 +96,25 @@ class SpilledShards:
                 for dev_pos, bid in blocks]
         depth = prefetch_depth()
         pl = getattr(mex, "planner", None)
-        if pl is not None and pl.enabled:
+        if pl is not None and pl.enabled and len(flat) > 1:
+            # consult (and possibly grow) the learned depth only when
+            # a readahead pool will actually run — a 1-block restore
+            # must not consume a replan mark it cannot exercise
             depth = pl.io_prefetch_depth("hbm.restore", depth)
         ra = make_readahead(depth) if len(flat) > 1 else None
         singles_per_leaf = [[] for _ in self.leaf_blocks]
         st: dict = {}
         tr = getattr(mex, "tracer", None)
         from ..common.trace import span_of
+        from ..common.decisions import record_of, resolve_io_prefetch
+        io0 = _IOSTATS.snapshot()
+        rec = None
+        if ra is not None:
+            rec = record_of(mex, "io_prefetch", "hbm.restore",
+                            f"depth={depth}", predicted=1.0,
+                            reason="overlap next block's read with the "
+                                   "current upload",
+                            blocks=len(flat), depth=depth)
         try:
             with span_of(tr, "io", "hbm_restore", blocks=len(flat),
                          depth=depth if ra is not None else 0):
@@ -118,6 +130,11 @@ class SpilledShards:
         finally:
             if ra is not None:
                 ra.shutdown(wait=True, cancel_futures=True)
+        # audit join (shared formula, common/decisions.py): measured
+        # hit rate against the perfect-rate prediction — the signal the
+        # planner's learned per-site depth grows from
+        resolve_io_prefetch(mex, rec,
+                            _IOSTATS.delta(_IOSTATS.snapshot(), io0))
         overlapped = st.get("prefetched", 0)
         if overlapped:
             _IOSTATS.add(restore_overlaps=1)
@@ -125,12 +142,6 @@ class SpilledShards:
             if log is not None and log.enabled:
                 log.line(event="restore_overlap", kind="hbm",
                          blocks=len(flat), prefetched=overlapped)
-            from ..common.decisions import record_of
-            record_of(mex, "io_prefetch", "hbm.restore",
-                      f"depth={depth}",
-                      reason="overlap next block's read with the "
-                             "current upload",
-                      blocks=len(flat), prefetched=overlapped)
         leaves = [jax.make_array_from_single_device_arrays(
                       tuple(shape), mex.sharded, singles)
                   for singles, (dt, shape) in zip(singles_per_leaf,
@@ -361,9 +372,11 @@ class HbmGovernor:
                 leaf_blocks.append(blocks)
                 for sh in leaf.addressable_shards:
                     faults.check(_F_SPILL, node=node.label)
-                    arr = np.asarray(sh.data)
-                    blocks.append((dev_pos[sh.device],
-                                   pool.put(arr.tobytes())))
+                    arr = np.ascontiguousarray(np.asarray(sh.data))
+                    # the array goes to the store by POINTER (native
+                    # Put copies with the GIL released) — no
+                    # interpreter-side tobytes() copy per leaf shard
+                    blocks.append((dev_pos[sh.device], pool.put(arr)))
                 meta.append((leaf.dtype, tuple(leaf.shape)))
         except Exception as e:
             # spill failed mid-way: free the partial blocks and keep

@@ -39,13 +39,25 @@ from typing import Callable, Dict, List
 
 import numpy as np
 
-#: counters that must match EXACTLY between contract and fresh run
+#: counters that must match EXACTLY between contract and fresh run.
+#: The out-of-core row (ISSUE 15): spilled runs, write-behind bytes,
+#: readahead submissions and native-record blocks are deterministic
+#: for a fixed program — em_sort settles its spill store at the
+#: pre-merge barrier, so residency (and therefore every prefetch
+#: submission) is a pure function of the program. A silent fallback
+#: from the columnar record format to the pickle spill path moves
+#: records_blocks AND writeback_bytes, failing this contract instead
+#: of hiding in wall-clock noise. (The em workload assumes the baked
+#: toolchain: a compiler-less host runs the python block store, whose
+#: eviction order differs.)
 COUNTERS = (
     "device_dispatches", "device_uploads", "device_fetches",
     "fused_dispatches", "fused_ops",
     "exchanges", "exchanges_overlapped",
     "cap_cache_hits", "cap_cache_misses",
     "plan_builds", "items_moved",
+    "spill_runs", "records_blocks", "prefetch_submits",
+    "writeback_bytes",
 )
 
 #: byte totals compared ratio-banded (pow2 capacity ratchets may move
@@ -65,6 +77,8 @@ ENV_NOTE = (
     "THRILL_TPU_EXCHANGE",
     "THRILL_TPU_LOCATION_DETECT", "THRILL_TPU_DUP_DETECT",
     "THRILL_TPU_LOOP_REPLAY", "THRILL_TPU_FORI",
+    "THRILL_TPU_NATIVE_RECORDS", "THRILL_TPU_PREFETCH",
+    "THRILL_TPU_WRITEBACK",
 )
 
 #: state that is NEVER legitimate during a sentinel measurement — a
@@ -163,15 +177,41 @@ def _chain(ctx):
         .Map(_chain_inc).ZipWithIndex().AllGather()
 
 
+def _em_sort(ctx):
+    """Host EM sort (ISSUE 15): fixed-seed string items spilled as
+    sorted runs through the native columnar record format in a pinned
+    disk-resident regime, then k-way merged with readahead. The
+    out-of-core counter row (spill_runs / records_blocks /
+    prefetch_submits / writeback_bytes) is this workload's contract."""
+    rng = np.random.default_rng(23)
+    # ~170 KiB spilled: comfortably past the 64 KiB residency floor,
+    # so the merge genuinely faults blocks from disk and its readahead
+    # submissions are a nonzero, deterministic part of the contract
+    items = [f"k-{int(v):09d}" for v in
+             rng.integers(0, 1 << 30, size=4096)]
+    node = ctx.Distribute(items, storage="host").Sort().node
+    hs = node.materialize()
+    assert sum(len(lst) for lst in hs.lists) == len(items)
+
+
 WORKLOADS: Dict[str, Callable] = {
     "wordcount": _wordcount,
     "sort": _sort,
     "join": _joinish,
     "chain": _chain,
+    "em_sort": _em_sort,
+}
+
+#: per-workload env pins (set around the run, restored after): the em
+#: workload needs a deterministic spill regime — a forced run size and
+#: a floor-pinned resident budget — regardless of the rig's RAM
+ENV_PINS: Dict[str, Dict[str, str]] = {
+    "em_sort": {"THRILL_TPU_HOST_SORT_RUN": "256",
+                "THRILL_TPU_SPILL_RESIDENT": "64K"},
 }
 
 
-def _run_workload(fn, workers: int = 2) -> dict:
+def _run_workload(fn, workers: int = 2, pins=None) -> dict:
     from ..api.context import RunLocalMock
     stats_box = {}
 
@@ -179,7 +219,16 @@ def _run_workload(fn, workers: int = 2) -> dict:
         fn(ctx)
         stats_box.update(ctx.overall_stats())
 
-    RunLocalMock(job, workers)
+    saved = {k: os.environ.get(k) for k in (pins or {})}
+    os.environ.update(pins or {})
+    try:
+        RunLocalMock(job, workers)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     out = {k: int(stats_box.get(k, 0)) for k in COUNTERS}
     out.update({k: int(stats_box.get(k, 0)) for k in BYTE_FIELDS})
     return out
@@ -193,7 +242,8 @@ def snapshot(workloads=None, workers: int = 2) -> dict:
     names = [n for n in (workloads or WORKLOADS) if n in WORKLOADS]
     saved = {k: os.environ.pop(k) for k in _SCRUB if k in os.environ}
     try:
-        runs = {name: _run_workload(WORKLOADS[name], workers)
+        runs = {name: _run_workload(WORKLOADS[name], workers,
+                                    pins=ENV_PINS.get(name))
                 for name in names}
     finally:
         os.environ.update(saved)
