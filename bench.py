@@ -422,9 +422,13 @@ def _run_bench() -> None:
     # regressions as loud as the dispatch budgets
     sv = _serve_metric(ctx)
 
+    # elastic-mesh micro-lane (ISSUE 16): fenced W=2->3->2 resize cost
+    # under a live job stream, in its own forced-multi-device process
+    el = _elastic_metric()
+
     _emit(value=round(mrec_s, 3),
           vs_baseline=round(mrec_s / host_mrec_s, 3),
-          **wc, **prm, **kmm, **sfm, **em, **ck, **sv)
+          **wc, **prm, **kmm, **sfm, **em, **ck, **sv, **el)
     ctx.close()
 
 
@@ -947,6 +951,10 @@ def _serve_metric(ctx) -> dict:
             "serve_jobs": len(lat),
             "queue_wait_s": round(sum(waits) / len(waits), 4),
             "queue_depth_peak": int(stats.get("queue_depth_peak", 0)),
+            # bounded admission (ISSUE 16): 0 on this uncapped lane —
+            # a nonzero value means something set THRILL_TPU_SERVE_QUEUE
+            # and the closed-loop clients still managed to trip it
+            "serve_jobs_rejected": int(stats.get("jobs_rejected", 0)),
             "plan_store_hits": int(stats.get("plan_store_hits", 0)),
             "plan_builds": int(stats.get("plan_builds", 0)),
             # plan choices the decision ledger recorded per served job
@@ -978,6 +986,65 @@ def _serve_metric(ctx) -> dict:
         }
     except Exception as e:  # secondary metric never kills the line
         return {"serve_error": repr(e)[:200]}
+
+
+_ELASTIC_CODE = r'''
+import json
+
+import numpy as np
+
+from thrill_tpu.api import Context
+from thrill_tpu.parallel.mesh import MeshExec
+
+ctx = Context(MeshExec(num_workers=2))
+
+
+def job(c):
+    return int(c.Distribute(np.arange(1 << 12, dtype=np.int64)).Map(
+        lambda x: x % 97).Sum())
+
+
+ctx.submit(job, tenant="a").result(300)     # start + warm the service
+f1 = [ctx.submit(job, tenant="a") for _ in range(2)]
+up = ctx.resize(3)                          # fenced: lands mid-stream
+f2 = [ctx.submit(job, tenant="b") for _ in range(2)]
+down = ctx.resize(2)
+want = job(Context(MeshExec(num_workers=2)))
+assert all(f.result(300) == want for f in f1 + f2)
+st = ctx.overall_stats()
+print("ELASTIC " + json.dumps({
+    "resize_up_s": round(up, 4), "resize_down_s": round(down, 4),
+    "resize_time_s": round(float(st["resize_time_s"]), 4),
+    "resizes": int(st["resizes"]),
+    "jobs_rejected": int(st["jobs_rejected"])}))
+ctx.close()
+'''
+
+
+def _elastic_metric() -> dict:
+    """Elastic-mesh micro-lane (ISSUE 16): a serving Context resizes
+    W=2->3->2 through the scheduler fence under a live job stream —
+    reports the resize wall time (the re-partition + generation-bump
+    cost the elastic protocol adds at a W change) and the shed-load
+    counter (0 on this uncapped lane: elastic machinery costs nothing
+    when unused). Runs out-of-process with a forced 4-device CPU mesh
+    because the elastic protocol needs more addressable devices than
+    the main bench mesh has on a 1-device CPU rig."""
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    env.pop("THRILL_TPU_SERVE_QUEUE", None)
+    try:
+        out = subprocess.run([sys.executable, "-c", _ELASTIC_CODE],
+                             env=env, capture_output=True, text=True,
+                             timeout=900)
+        for line in reversed(out.stdout.splitlines()):
+            if line.startswith("ELASTIC "):
+                return json.loads(line[len("ELASTIC "):])
+        return {"resize_error":
+                (out.stderr or "no ELASTIC line")[-200:]}
+    except Exception as e:  # secondary metric never kills the line
+        return {"resize_error": repr(e)[:200]}
 
 
 def _ckpt_metric(n: int) -> dict:

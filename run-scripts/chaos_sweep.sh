@@ -46,8 +46,14 @@ cd "$(dirname "$0")/.."
 N_SEEDS=${1:-25}
 shift || true
 
+# tests/net/test_elastic.py rides every sweep: its chaos-marked cases
+# arm the elastic-mesh sites (net.group.resize_handshake,
+# ckpt.repartition — ISSUE 16) across seeded W=2->3->2 resizes, both
+# on live Context shards and on a lockstep mock group; every armed
+# fire must land before any mutation and recover bit-identical.
 TARGETS=(tests/api/test_chaos.py tests/net/test_fault_injection.py
-         tests/api/test_loop.py tests/api/test_out_of_core.py)
+         tests/api/test_loop.py tests/api/test_out_of_core.py
+         tests/net/test_elastic.py)
 if [[ "${CHAOS_KILL:-0}" == "1" ]]; then
   TARGETS+=(tests/api/test_checkpoint.py)
 fi

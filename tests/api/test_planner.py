@@ -315,6 +315,57 @@ def test_io_prefetch_depth_learns_from_audited_hit_rate():
     assert pl.io_prefetch_depth("em_sort.merge", 0) == 0
 
 
+def test_io_prefetch_depth_shrinks_after_sustained_high_hit_rate():
+    """ISSUE 16 satellite: a site whose audited hit rate holds >= 0.95
+    for TWO consecutive runs halves its learned depth back toward the
+    default (floor at the default, an explicit off never overridden),
+    landing a kind=replan record naming both depths. One high run is
+    not enough — a lull must not throw away a depth a burst needed."""
+    mex = _StubMex()
+    mex.decisions = DecisionLedger(enabled=True)
+    mex.planner = Planner(mex, enabled=True)
+    mex.decisions.audit_hook = mex.planner.on_audit
+    pl = mex.planner
+    site = "spill.restore"
+
+    def audit(rate):
+        rec = mex.decisions.record("io_prefetch", site,
+                                   f"depth={pl._io_depth.get(site, 4)}",
+                                   predicted=1.0)
+        mex.decisions.resolve(rec, rate)
+
+    # grow 4 -> 8 -> 16 via two poor audits
+    for _ in range(2):
+        audit(0.25)
+        pl.io_prefetch_depth(site, 4)
+    assert pl.io_prefetch_depth(site, 4) == 16
+    # one near-perfect audit is NOT enough to shrink
+    audit(0.97)
+    assert pl.io_prefetch_depth(site, 4) == 16
+    # a dip resets the streak: the next high audit starts over
+    audit(0.90)
+    audit(0.99)
+    assert pl.io_prefetch_depth(site, 4) == 16
+    # two consecutive >= 0.95 runs: halve toward the default
+    audit(1.0)
+    assert pl.io_prefetch_depth(site, 4) == 8
+    recs = [d for d in mex.decisions.snapshot()
+            if d["kind"] == "replan" and d["site"] == site]
+    assert recs and recs[-1]["chosen"] == "depth=8"
+    assert recs[-1]["rejected"][0][0] == "depth=16"
+    assert "consecutive" in recs[-1]["reason"]
+    # keep shrinking on a sustained streak, but NEVER below the
+    # default floor
+    audit(0.99)
+    audit(0.99)
+    assert pl.io_prefetch_depth(site, 4) == 4
+    audit(0.99)
+    audit(0.99)
+    assert pl.io_prefetch_depth(site, 4) == 4      # floor holds
+    # the explicit off switch still wins over everything learned
+    assert pl.io_prefetch_depth(site, 0) == 0
+
+
 def test_prune_inputs_agree_across_controllers():
     """ROADMAP satellite: multi-controller auto no longer resolves OFF
     — local counts all-reduce to the global sum over the host control

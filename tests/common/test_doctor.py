@@ -300,6 +300,27 @@ def test_sentinel_byte_band_and_missing_workload():
     assert not any("bytes_on_wire_raw" in p for p in probs)
 
 
+def test_sentinel_serve_row_pins_elastic_machinery_idle():
+    """Elastic mesh (ISSUE 16): the checked-in serve row must claim
+    EXACTLY zero resizes / resize wall time / admission rejections —
+    the machinery costs nothing when a serving Context never uses it —
+    and a fresh resize-free serve run must match that claim."""
+    from thrill_tpu.tools import perf_sentinel as ps
+    path = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "PERF_CONTRACT.json")
+    with open(path) as f:
+        contract = json.load(f)
+    row = contract["workloads"]["serve"]
+    assert row["resizes"] == 0
+    assert row["resize_time_ms"] == 0
+    assert row["jobs_rejected"] == 0
+    assert row["jobs_failed"] == 0
+    assert row["jobs_submitted"] == 3
+    fresh = ps.snapshot(workloads=["serve"])
+    assert ps.diff({**contract, "workloads": {"serve": row}},
+                   fresh) == []
+
+
 @pytest.mark.slow
 def test_repo_perf_contract_matches_fresh_run():
     """The checked-in PERF_CONTRACT.json must describe THIS tree: a

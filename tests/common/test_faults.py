@@ -924,6 +924,86 @@ def _ex_plan_store_corrupt():
         shutil.rmtree(td, ignore_errors=True)
 
 
+def _ex_ckpt_repartition():
+    """ckpt.repartition (api/checkpoint.py): fires at STAGE time,
+    BEFORE the mesh or any shard mutates — the resize raises, the
+    Context keeps its width, generation and cached results, and the
+    RETRIED resize succeeds with bit-identical data (the copy-then-
+    commit contract of the elastic re-partition step)."""
+    from thrill_tpu.api import Context
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        d = ctx.Distribute(np.arange(32, dtype=np.int64)).Map(
+            lambda x: x * 3 + 1)
+        d.Keep(4)
+        want = sorted(int(x) for x in d.AllGather())
+        gen0 = ctx.generation
+        with faults.inject("ckpt.repartition", n=1, seed=5):
+            try:
+                ctx.resize(3)
+                assert False, "armed repartition did not fire"
+            except IOError:
+                pass
+        # nothing mutated: width, generation and the live result are
+        # exactly as before the failed attempt
+        assert ctx.num_workers == 2
+        assert ctx.generation == gen0
+        assert sorted(int(x) for x in d.AllGather()) == want
+        # the next attempt (fault budget exhausted) succeeds
+        ctx.resize(3)
+        assert ctx.num_workers == 3
+        assert sorted(int(x) for x in d.AllGather()) == want
+        assert faults.REGISTRY.injected >= 1
+    finally:
+        ctx.close()
+
+
+def _ex_net_resize_handshake():
+    """net.group.resize_handshake (net/group.py): fires at the resize
+    gate BEFORE any membership mutation — width and generation hold,
+    and the next resize attempt (W=1→2→1 on the mock transport, with
+    a live joiner) succeeds with correct collectives at every width."""
+    import threading
+
+    from thrill_tpu.net import mock as mock_net
+
+    net = mock_net.MockNetwork(1)
+    g0 = net.group(0)
+    g0.begin_generation(1)
+    with faults.inject("net.group.resize_handshake", n=1, seed=3):
+        try:
+            g0.resize(1, 2)
+            assert False, "armed resize did not fire"
+        except ConnectionError:
+            pass
+    assert g0.num_hosts == 1
+    assert g0.generation == 1
+    # retry: grow the mock fabric, admit rank 1, then shrink it away
+    joiners = net.grow(2)
+    g1 = joiners[0]
+    out = {}
+
+    def joiner():
+        g1.begin_generation(2)
+        out["sum2"] = g1.all_reduce(1, lambda a, b: a + b)
+        g1.resize(1, 3)                       # departing rank
+
+    t = threading.Thread(target=joiner, daemon=True)
+    t.start()
+    g0.resize(2, 2)
+    assert g0.num_hosts == 2
+    assert g0.all_reduce(1, lambda a, b: a + b) == 2
+    g0.resize(1, 3)
+    t.join(60)
+    assert not t.is_alive()
+    assert g0.num_hosts == 1
+    assert out["sum2"] == 2
+    assert g0.all_reduce(5, lambda a, b: a + b) == 5
+    assert faults.REGISTRY.injected >= 1
+
+
 # sites whose exercisers live in tests/net/test_fault_injection.py
 # (they need real sockets / multi-rank groups)
 _NET_SITES = {
@@ -947,6 +1027,11 @@ _MATRIX = {
     "api.fuse.*": _ex_fused_per_op_sites,
     "api.loop.replay": _ex_loop_replay,
     "ckpt.write": _ex_ckpt_write_and_manifest,
+    # elastic mesh (ISSUE 16): both resize-path sites fire BEFORE any
+    # mutation, so a failed attempt leaves width/generation/results
+    # intact and the retry succeeds bit-identical
+    "ckpt.repartition": _ex_ckpt_repartition,
+    "net.group.resize_handshake": _ex_net_resize_handshake,
     "ckpt.manifest": _ex_ckpt_write_and_manifest,
     "ckpt.read": _ex_ckpt_read,
     "data.blockstore.put": _ex_blockstore,

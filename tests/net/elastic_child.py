@@ -1,0 +1,111 @@
+"""Child body for the real-TCP elastic mesh test (test_elastic.py).
+
+Four OS processes, no JAX: ranks 0/1 are the long-lived members, rank
+2 is a DOOMED joiner that completes the resize_join transport
+handshake and then SIGKILLs itself before the commit barrier (the
+"mid-resize" kill), rank 3 is the replacement joiner whose admission
+must succeed bit-identically after the members healed. Phases are
+gated through filesystem flags (no sleeps): a joiner only starts
+dialing once every member wrote the flag saying it is about to enter
+``Group.resize``; the dial itself retries through the window where the
+member has not bound its accept port yet.
+"""
+
+import json
+import os
+import signal
+import sys
+import time
+
+from thrill_tpu.net.tcp import (construct_tcp_group, join_tcp_group,
+                                parse_hostlist)
+
+SECRET = b"elastic-test-secret"
+
+
+def _touch(flags, name):
+    with open(os.path.join(flags, name), "w") as f:
+        f.write("1")
+
+
+def _await(flags, names, timeout_s=120.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if all(os.path.exists(os.path.join(flags, n)) for n in names):
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"flags {names} never appeared in {flags}")
+
+
+def _member(rank, hosts, flags):
+    out = {"rank": rank}
+    g = construct_tcp_group(rank, hosts[:2], timeout=120, secret=SECRET)
+    g.begin_generation(1)
+    out["sum_w2"] = g.all_reduce(g.my_rank + 1, lambda a, b: a + b)
+    # -- doomed grow: the joiner dies between handshake and barrier --
+    _touch(flags, f"m{rank}.w2")
+    try:
+        g.resize(3, 2)
+        out["doomed"] = "NO-ERROR"
+    except Exception as e:
+        out["doomed"] = type(e).__name__
+    out["healed_w"] = g.num_hosts
+    out["healed_gen"] = g.generation
+    out["sum_after_rollback"] = g.all_reduce(g.my_rank + 1,
+                                             lambda a, b: a + b)
+    # -- the NEXT resize attempt: replacement joiner enters as rank 2 -
+    _touch(flags, f"m{rank}.healed")
+    g.resize(3, 3)
+    out["grown_w"] = g.num_hosts
+    out["grown_gen"] = g.generation
+    out["sum_w3"] = g.all_reduce(g.my_rank + 1, lambda a, b: a + b)
+    out["gather_w3"] = g.all_gather(g.my_rank * 10)
+    # -- graceful shrink back: rank 2 departs, frames drained ---------
+    g.resize(2, 4)
+    out["shrunk_w"] = g.num_hosts
+    out["sum_w2_again"] = g.all_reduce(g.my_rank + 1, lambda a, b: a + b)
+    g.close()
+    return out
+
+
+def _doomed_joiner(hosts, flags):
+    _await(flags, ["m0.w2", "m1.w2"])
+    # the transport handshake COMPLETES on both members; the death
+    # lands between it and the generation barrier that would commit
+    # the membership — the members must roll back and heal
+    join_tcp_group(2, hosts[:3], generation=2, timeout=120,
+                   secret=SECRET)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _replacement_joiner(hosts, flags):
+    _await(flags, ["m0.healed", "m1.healed"])
+    new_hosts = [hosts[0], hosts[1], hosts[3]]
+    g = join_tcp_group(2, new_hosts, generation=3, timeout=120,
+                       secret=SECRET)
+    g.begin_generation(3)
+    out = {"rank": 3}
+    out["grown_gen"] = g.generation
+    out["sum_w3"] = g.all_reduce(g.my_rank + 1, lambda a, b: a + b)
+    out["gather_w3"] = g.all_gather(g.my_rank * 10)
+    g.resize(2, 4)                        # departing rank: drains, leaves
+    g.close()
+    return out
+
+
+def main():
+    rank = int(sys.argv[1])
+    hosts = parse_hostlist(os.environ["THRILL_TPU_ELASTIC_HOSTS"])
+    flags = os.environ["THRILL_TPU_ELASTIC_FLAGS"]
+    if rank in (0, 1):
+        out = _member(rank, hosts, flags)
+    elif rank == 2:
+        _doomed_joiner(hosts, flags)
+        return                            # unreachable: SIGKILLed above
+    else:
+        out = _replacement_joiner(hosts, flags)
+    print("RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

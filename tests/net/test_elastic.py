@@ -1,0 +1,430 @@
+"""Elastic mesh: ranks join and leave under live traffic (ISSUE 16).
+
+Pinned acceptance, three layers:
+
+* net layer, REAL processes: a 2-member TCP group admits a joiner that
+  SIGKILLs itself mid-resize (after the authenticated transport
+  handshake, before the commit barrier) — the members roll the
+  membership back, settle the generation among themselves, and the
+  NEXT resize attempt (a replacement joiner) succeeds with
+  bit-identical collectives at W=3; the graceful shrink drains the
+  departing rank behind the generation barrier.
+* net layer, mock transport: the same join/leave protocol swept over
+  longer width paths on threads (the cheap analog of the reference's
+  mpirun size sweep) — tails ride the slow lane, one W=2->3->2
+  representative stays in tier via the TCP test above.
+* api layer, single controller: a SERVING Context resizes W=2->3->2
+  at generation boundaries under live mixed WordCount/PageRank
+  traffic — every JobFuture resolves, results are bit-identical to
+  fixed-W reference runs, and a mid-resize injected failure heals
+  without wedging the scheduler.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from portalloc import free_ports, load_scaled
+
+from thrill_tpu.api import Context
+from thrill_tpu.common import faults
+from thrill_tpu.parallel.mesh import MeshExec
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                "..", "..", "examples"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+# ----------------------------------------------------------------------
+# real processes: SIGKILL mid-resize, heal, retry bit-identical
+# ----------------------------------------------------------------------
+
+ELASTIC_CHILD = os.path.join(os.path.dirname(__file__),
+                             "elastic_child.py")
+
+
+def _launch_elastic(flags_dir):
+    ports = free_ports(4)
+    hostlist = " ".join(f"127.0.0.1:{p}" for p in ports)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    procs = []
+    for rank in range(4):
+        env = dict(os.environ)
+        env.update({
+            "PYTHONPATH": repo_root + os.pathsep
+            + env.get("PYTHONPATH", ""),
+            "THRILL_TPU_ELASTIC_HOSTS": hostlist,
+            "THRILL_TPU_ELASTIC_FLAGS": flags_dir,
+            # bound the members' barrier wait against the killed
+            # joiner: the doomed grow must FAIL fast, not sit out the
+            # default 30s heal budget twice
+            "THRILL_TPU_HEAL_TIMEOUT_S": "6",
+            "THRILL_TPU_RESIZE_TIMEOUT_S": "60",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, ELASTIC_CHILD, str(rank)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env))
+    return procs
+
+
+def _drain_elastic(procs, timeout_s):
+    """Like test_distributed._drain_results, except rank 2 is SUPPOSED
+    to die by SIGKILL mid-resize and prints no RESULT line."""
+    import concurrent.futures as cf
+    timeout_s = load_scaled(timeout_s)
+    with cf.ThreadPoolExecutor(len(procs)) as ex:
+        futs = [ex.submit(p.communicate, None, timeout_s)
+                for p in procs]
+        try:
+            drained = [f.result(timeout=timeout_s + 20) for f in futs]
+        except (cf.TimeoutError, subprocess.TimeoutExpired):
+            for q in procs:
+                q.kill()
+            raise AssertionError(
+                f"elastic child timed out ({timeout_s:.0f}s)") from None
+    results = {}
+    for rank, (p, (out, err)) in enumerate(zip(procs, drained)):
+        if rank == 2:
+            assert p.returncode == -9, (
+                f"doomed joiner exited {p.returncode}, expected "
+                f"SIGKILL:\n{err[-2000:]}")
+            continue
+        assert p.returncode == 0, \
+            f"rank {rank} failed:\n{err[-3000:]}"
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, f"rank {rank}: no RESULT line:\n{out}\n{err[-2000:]}"
+        results[rank] = json.loads(lines[-1][len("RESULT "):])
+    return results
+
+
+def test_rank_join_and_leave_on_real_tcp_with_sigkill_mid_resize(
+        tmp_path):
+    """The in-tier W=2->3->2 representative on REAL sockets and real
+    process death: generation heals after the mid-resize SIGKILL and
+    the next resize attempt succeeds bit-identical."""
+    def run(flags_dir):
+        os.makedirs(flags_dir, exist_ok=True)
+        return _drain_elastic(_launch_elastic(flags_dir), 180)
+
+    try:
+        results = run(str(tmp_path / "f1"))
+    except AssertionError as e:         # one retry on a loaded box
+        print(f"elastic children: first attempt failed; retrying "
+              f"once.\n{e}", flush=True)
+        results = run(str(tmp_path / "f2"))
+
+    m0, m1, r3 = results[0], results[1], results[3]
+    for m in (m0, m1):
+        # the doomed grow FAILED loudly (never a silent half-commit)...
+        assert m["doomed"] != "NO-ERROR"
+        # ...and rolled back: width restored, generation settled among
+        # the survivors, collectives exact on the healed group
+        assert m["healed_w"] == 2
+        assert m["healed_gen"] == 2
+        assert m["sum_w2"] == m["sum_after_rollback"] == 3
+        # the NEXT attempt admitted the replacement joiner
+        assert m["grown_w"] == 3 and m["grown_gen"] == 3
+        assert m["sum_w3"] == 6
+        assert m["gather_w3"] == [0, 10, 20]
+        # graceful shrink: departing rank drained, survivors exact
+        assert m["shrunk_w"] == 2
+        assert m["sum_w2_again"] == 3
+    # bit-identical across every live rank, including the joiner's
+    # own view of the W=3 collectives
+    assert m0 == {**m1, "rank": 0}
+    assert r3["sum_w3"] == 6 and r3["gather_w3"] == [0, 10, 20]
+    assert r3["grown_gen"] == 3
+
+
+# ----------------------------------------------------------------------
+# mock transport: the width-path sweep on threads
+# ----------------------------------------------------------------------
+
+def _run_phase(jobs):
+    """One lockstep phase: run jobs[rank]() on a thread per rank."""
+    import threading
+    results = {}
+    errors = {}
+
+    def target(r, fn):
+        try:
+            results[r] = fn()
+        except Exception as e:          # surfaced below
+            errors[r] = e
+
+    threads = [threading.Thread(target=target, args=(r, fn),
+                                daemon=True) for r, fn in jobs.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=load_scaled(60))
+    for e in errors.values():
+        raise e
+    assert len(results) == len(jobs), "resize phase deadlocked"
+    return results
+
+
+def _sweep_mock_path(path):
+    """Walk a width path on the mock transport: every step is a real
+    Group.resize (joiners enter via MockNetwork.grow + the generation
+    barrier), with collectives verified at every width."""
+    from thrill_tpu.net import MockNetwork
+    net = MockNetwork(path[0])
+    groups = {r: net.group(r) for r in range(path[0])}
+    gen = 1
+    _run_phase({r: (lambda g=g: g.begin_generation(1))
+                for r, g in groups.items()})
+    for w_new in path[1:]:
+        w_old = len(groups)
+        gen += 1
+        if w_new > w_old:
+            joiners = dict(zip(range(w_old, w_new),
+                               net.grow(w_new, from_hosts=w_old)))
+            jobs = {r: (lambda g=g: g.resize(w_new, gen))
+                    for r, g in groups.items()}
+            jobs.update({r: (lambda g=g: g.begin_generation(gen))
+                         for r, g in joiners.items()})
+            _run_phase(jobs)
+            groups.update(joiners)
+        else:
+            _run_phase({r: (lambda g=g: g.resize(w_new, gen))
+                        for r, g in groups.items()})
+            groups = {r: g for r, g in groups.items() if r < w_new}
+        sums = _run_phase({r: (lambda g=g: g.all_reduce(
+            g.my_rank + 1, lambda a, b: a + b))
+            for r, g in groups.items()})
+        assert set(sums.values()) == {w_new * (w_new + 1) // 2}, path
+        gathers = _run_phase({r: (lambda g=g: g.all_gather(g.my_rank))
+                              for r, g in groups.items()})
+        assert set(map(tuple, gathers.values())) == \
+            {tuple(range(w_new))}, path
+
+
+@pytest.mark.parametrize("path", [
+    (2, 3, 2),
+    pytest.param((1, 3, 1), marks=pytest.mark.slow),
+    pytest.param((2, 4, 3, 2), marks=pytest.mark.slow),
+    pytest.param((3, 5, 2, 4, 1), marks=pytest.mark.slow)])
+def test_mock_resize_width_sweep(path):
+    _sweep_mock_path(path)
+
+
+# ----------------------------------------------------------------------
+# serving Context: resize under live mixed traffic
+# ----------------------------------------------------------------------
+
+def _wordcount(ctx):
+    vals = np.arange(512, dtype=np.int64)
+    hist = ctx.Distribute(vals).Map(lambda x: (x % 13, 1)) \
+        .ReducePair(lambda a, b: a + b)
+    return sorted([int(k), int(v)] for k, v in hist.AllGather())
+
+
+def _pagerank_job(edges, n):
+    import page_rank as pr
+
+    def fn(ctx):
+        return pr.page_rank(ctx, edges, n, iterations=3).tolist()
+    return fn
+
+
+def test_serving_context_resizes_under_live_traffic():
+    """THE single-controller acceptance: W=2->3->2 at generation
+    boundaries under live mixed WordCount/PageRank traffic from two
+    tenants — every JobFuture resolves, results bit-identical to
+    fixed-W reference runs, the elastic counters move and nothing is
+    shed."""
+    rng = np.random.default_rng(0)
+    edges = np.unique(rng.integers(0, 32, size=(200, 2)), axis=0)
+    pr_job = _pagerank_job(edges, 32)
+
+    # fixed-W references (PageRank float reduction order is W-shaped,
+    # so each width gets its own pinned reference; WordCount's integer
+    # result must be identical at any W)
+    refs = {}
+    for w in (2, 3):
+        rctx = Context(MeshExec(num_workers=w))
+        refs[w] = {"wc": _wordcount(rctx), "pr": pr_job(rctx)}
+        rctx.close()
+    assert refs[2]["wc"] == refs[3]["wc"]
+    wc_ref = refs[2]["wc"]
+
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        gen0 = ctx.generation
+        # drained batch at W=2
+        assert ctx.submit(_wordcount, tenant="alpha").result(300) \
+            == wc_ref
+        assert ctx.submit(pr_job, tenant="beta").result(300) \
+            == refs[2]["pr"]
+        # LIVE batch: the fence lands at the next job boundary — the
+        # in-flight job finishes on the old mesh, queued jobs run on
+        # the new one; either way the integer results are W-invariant
+        live = [ctx.submit(_wordcount, tenant=t, name=f"live-{i}")
+                for i, t in enumerate(["alpha", "beta"] * 2)]
+        dt = ctx.resize(3)
+        assert dt >= 0.0
+        assert ctx.num_workers == 3
+        assert ctx.mesh_exec.num_workers == 3
+        for f in live:
+            assert f.result(300) == wc_ref
+        # drained batch at W=3: PageRank matches the fixed-W=3 run
+        assert ctx.submit(pr_job, tenant="beta").result(300) \
+            == refs[3]["pr"]
+        # back down to W=2 under live traffic again
+        live2 = [ctx.submit(_wordcount, tenant="alpha", name=f"dn-{i}")
+                 for i in range(2)]
+        ctx.resize(2)
+        assert ctx.num_workers == 2
+        for f in live2:
+            assert f.result(300) == wc_ref
+        # W=2 again: bit-identical to the ORIGINAL fixed-W=2 reference
+        # (warm per-W state restored, nothing stale survived)
+        assert ctx.submit(pr_job, tenant="beta").result(300) \
+            == refs[2]["pr"]
+        assert ctx.generation > gen0
+        svc = ctx.service.stats()
+        assert svc["jobs_failed"] == 0
+        assert svc["jobs_rejected"] == 0
+        stats = ctx.overall_stats()
+        assert stats["resizes"] == 2
+        assert stats["resize_time_s"] > 0.0
+    finally:
+        ctx.close()
+
+
+def test_mid_resize_fault_heals_without_wedging_the_scheduler():
+    """An injected failure at ckpt.repartition surfaces to the
+    resize() caller, mutates NOTHING (width, generation, live shards
+    intact), and the scheduler keeps serving — later submits and the
+    retried resize both succeed, results bit-identical."""
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        d = ctx.Distribute(np.arange(48, dtype=np.int64)).Map(
+            lambda x: x * 7 + 1)
+        d.Keep(4)
+        want = sorted(int(x) for x in d.AllGather())
+        # start the service plane with a real job first
+        wc_ref = ctx.submit(_wordcount, tenant="alpha").result(300)
+        gen0 = ctx.generation
+        w0 = ctx.num_workers
+        with faults.inject("ckpt.repartition", n=1, seed=7):
+            with pytest.raises(IOError):
+                ctx.resize(3)
+        assert ctx.num_workers == w0
+        assert ctx.generation == gen0
+        # not wedged: the queue still drains
+        assert ctx.submit(_wordcount, tenant="beta").result(300) \
+            == wc_ref
+        # the RETRIED resize succeeds and the live shards moved
+        ctx.resize(3)
+        assert ctx.num_workers == 3
+        assert sorted(int(x) for x in d.AllGather()) == want
+        assert ctx.submit(_wordcount, tenant="alpha").result(300) \
+            == wc_ref
+        assert ctx.overall_stats()["resizes"] == 1
+    finally:
+        ctx.close()
+
+
+N_RESIZE_SEEDS = int(os.environ.get("THRILL_TPU_CHAOS_SEEDS", "2"))
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", range(N_RESIZE_SEEDS))
+def test_chaos_resize_sites_recover_exactly(seed, monkeypatch):
+    """Seeded chaos over BOTH elastic fault sites (armed by
+    run-scripts/chaos_sweep.sh at full seed count): every armed fire
+    lands before any mutation, so a bounded retry reaches the resized
+    state with bit-identical data — at the api layer through
+    ckpt.repartition, at the net layer through
+    net.group.resize_handshake on a lockstep mock group."""
+    import random
+    rng = random.Random(9000 + seed)
+    n_ck, n_net = rng.randint(1, 2), rng.randint(1, 2)
+    monkeypatch.setenv(
+        faults.ENV_VAR,
+        f"ckpt.repartition:n={n_ck}:seed={seed};"
+        f"net.group.resize_handshake:n={n_net}:seed={seed}")
+
+    # api layer: live shards re-partition across W=2->3->2
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        d = ctx.Distribute(np.arange(40, dtype=np.int64)).Map(
+            lambda x: x * 5 + seed)
+        d.Keep(8)
+        want = sorted(int(x) for x in d.AllGather())
+        w = 2
+        for target in (3, 2):
+            for attempt in range(4):        # n <= 2 < the retry budget
+                try:
+                    ctx.resize(target)
+                    break
+                except faults.InjectedFault:
+                    assert ctx.num_workers == w   # nothing mutated
+            w = target
+            assert ctx.num_workers == w
+            assert sorted(int(x) for x in d.AllGather()) == want
+    finally:
+        ctx.close()
+
+    # net layer: a lockstep mock resize where each rank retries its
+    # own gate fire (the site raises BEFORE any membership change, so
+    # a retried rank re-enters the still-pending collective)
+    from thrill_tpu.net import MockNetwork
+    net = MockNetwork(2)
+    groups = {r: net.group(r) for r in range(2)}
+
+    def _retrying(fn):
+        def run():
+            for attempt in range(6):
+                try:
+                    return fn()
+                except faults.InjectedFault:
+                    continue
+            raise AssertionError("fire budget outlived the retries")
+        return run
+
+    _run_phase({r: (lambda g=g: g.begin_generation(1))
+                for r, g in groups.items()})
+    joiners = dict(zip([2], net.grow(3, from_hosts=2)))
+    jobs = {r: _retrying(lambda g=g: g.resize(3, 2))
+            for r, g in groups.items()}
+    jobs.update({r: (lambda g=g: g.begin_generation(2))
+                 for r, g in joiners.items()})
+    _run_phase(jobs)
+    groups.update(joiners)
+    sums = _run_phase({r: (lambda g=g: g.all_reduce(
+        g.my_rank + 1, lambda a, b: a + b)) for r, g in groups.items()})
+    assert set(sums.values()) == {6}
+    _run_phase({r: _retrying(lambda g=g: g.resize(2, 3))
+                for r, g in groups.items()})
+    groups = {r: g for r, g in groups.items() if r < 2}
+    sums = _run_phase({r: (lambda g=g: g.all_reduce(
+        g.my_rank + 1, lambda a, b: a + b)) for r, g in groups.items()})
+    assert set(sums.values()) == {3}
+    assert faults.REGISTRY.injected >= 1
+
+
+def test_resize_disabled_is_loud(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_RESIZE", "0")
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        with pytest.raises(RuntimeError, match="THRILL_TPU_RESIZE"):
+            ctx.resize(3)
+        assert ctx.num_workers == 2
+    finally:
+        ctx.close()

@@ -156,11 +156,15 @@ def test_injected_corrupt_site_degrades(tmp_path):
 
 def test_save_merges_and_ratchets_capacities(tmp_path):
     """Two services sharing one store only ever RATCHET capacities;
-    unknown digests (another pipeline's state) are kept."""
+    unknown digests (another pipeline's state) are kept. On-disk keys
+    carry the w{W}: width prefix (elastic mesh): the max-merge only
+    ever collides entries learned at the SAME width, and a mesh of a
+    different width keeps (but never installs) these entries."""
     store = PlanStore(str(tmp_path))
 
     class _Mex:
         process_index = 0
+        num_workers = 2
         _sticky_caps = {("site_a",): (4, 8)}
         _xchg_plan = {("site_a",): "dense"}
 
@@ -172,9 +176,25 @@ def test_save_merges_and_ratchets_capacities(tmp_path):
     store.save(m2)
     entries = store.load()
     from thrill_tpu.data.exchange import _ident_digest
-    assert entries["caps"][_ident_digest(("site_a",))] == [16, 8]
-    assert entries["caps"][_ident_digest(("site_b",))] == [2, 2]
-    assert entries["plan"][_ident_digest(("site_b",))] == "sync"
+    assert entries["caps"]["w2:" + _ident_digest(("site_a",))] == [16, 8]
+    assert entries["caps"]["w2:" + _ident_digest(("site_b",))] == [2, 2]
+    assert entries["plan"]["w2:" + _ident_digest(("site_b",))] == "sync"
+    # a 3-wide mesh installs NONE of the 2-wide entries (a 2-long cap
+    # vector would be garbage on a 3-wide exchange), yet a save from
+    # it keeps them on disk for the next W=2 service
+    from thrill_tpu.service.plan_store import install_entries
+
+    class _Mex3(_Mex):
+        num_workers = 3
+        _sticky_caps = {("site_c",): (1, 1, 1)}
+        _xchg_plan = {}
+
+    m3 = _Mex3()
+    assert install_entries(m3, entries) == 0
+    store.save(m3)
+    entries = store.load()
+    assert entries["caps"]["w2:" + _ident_digest(("site_a",))] == [16, 8]
+    assert entries["caps"]["w3:" + _ident_digest(("site_c",))] == [1, 1, 1]
 
 
 @pytest.mark.slow

@@ -28,6 +28,7 @@ def _clean_registry(monkeypatch):
     monkeypatch.delenv(faults.ENV_VAR, raising=False)
     monkeypatch.delenv("THRILL_TPU_SERVE_WEIGHTS", raising=False)
     monkeypatch.delenv("THRILL_TPU_SERVE_HBM_BUDGETS", raising=False)
+    monkeypatch.delenv("THRILL_TPU_SERVE_QUEUE", raising=False)
     faults.REGISTRY.reset()
     yield
     faults.REGISTRY.reset()
@@ -279,6 +280,74 @@ def test_first_submit_after_context_close_resolves_failed():
     f = ctx.submit(_float_job)
     assert isinstance(f.exception(5), RuntimeError)
     assert ctx.service is None          # no dispatcher was created
+
+
+def test_admission_queue_cap_sheds_loudly(monkeypatch, capsys):
+    """ISSUE 16 satellite: THRILL_TPU_SERVE_QUEUE bounds the admission
+    queue — a submit at the cap resolves IMMEDIATELY with a distinct
+    QueueFull cause (nothing queued, nothing wedged), the shed is
+    counted total and per tenant, and everything already admitted
+    still completes exactly."""
+    from thrill_tpu.service.scheduler import QueueFull
+    monkeypatch.setenv("THRILL_TPU_SERVE_QUEUE", "2")
+    ctx = Context(MeshExec(num_workers=1))
+    gate = threading.Event()
+    started = threading.Event()
+
+    def blocker(c):
+        started.set()
+        assert gate.wait(120)
+        return "done"
+
+    try:
+        fb = ctx.submit(blocker, tenant="a", name="blocker")
+        assert started.wait(120)
+        # dispatcher busy on the blocker: fill the queue to the cap...
+        q1 = ctx.submit(_float_job, tenant="a")
+        q2 = ctx.submit(_float_job, tenant="b")
+        # ...then two more submits shed, one per tenant
+        e1 = ctx.submit(_float_job, tenant="a").exception(5)
+        e2 = ctx.submit(_float_job, tenant="b").exception(5)
+        for e in (e1, e2):
+            assert isinstance(e, QueueFull)
+            assert e.cap == 2 and e.depth >= 2
+            assert "THRILL_TPU_SERVE_QUEUE" in str(e)
+        assert (e1.tenant, e2.tenant) == ("a", "b")
+        err = capsys.readouterr().err
+        assert err.count("shedding load") == 2   # first shed per tenant
+        gate.set()
+        # admitted work is untouched by the sheds
+        assert fb.result(300) == "done"
+        assert q1.result(300) == q2.result(300) == pytest.approx(
+            _expected_float(), abs=0)
+        # below the cap again: submits flow normally
+        assert ctx.submit(_float_job, tenant="a").result(300) \
+            == pytest.approx(_expected_float(), abs=0)
+        svc = ctx.service.stats()
+        assert svc["jobs_rejected"] == 2
+        assert svc["jobs_submitted"] == 4        # sheds never counted
+        assert ctx.service.rejected_by_tenant == {"a": 1, "b": 1}
+        assert ctx.overall_stats()["jobs_rejected"] == 2
+    finally:
+        gate.set()
+        ctx.close()
+
+
+def test_queue_cap_env_parsing(monkeypatch, capsys):
+    """0/unset = unbounded; malformed values are skipped LOUDLY (a
+    typo must not silently shed traffic); negatives clamp to off."""
+    from thrill_tpu.service.scheduler import _queue_cap
+    monkeypatch.delenv("THRILL_TPU_SERVE_QUEUE", raising=False)
+    assert _queue_cap() == 0
+    monkeypatch.setenv("THRILL_TPU_SERVE_QUEUE", "0")
+    assert _queue_cap() == 0
+    monkeypatch.setenv("THRILL_TPU_SERVE_QUEUE", "7")
+    assert _queue_cap() == 7
+    monkeypatch.setenv("THRILL_TPU_SERVE_QUEUE", "-3")
+    assert _queue_cap() == 0
+    monkeypatch.setenv("THRILL_TPU_SERVE_QUEUE", "lots")
+    assert _queue_cap() == 0
+    assert "THRILL_TPU_SERVE_QUEUE" in capsys.readouterr().err
 
 
 def _sustained(W, clients, per_client):

@@ -72,6 +72,12 @@ _F_WRITE = faults.declare("ckpt.write")
 _F_READ = faults.declare("ckpt.read")
 _F_MANIFEST = faults.declare("ckpt.manifest")
 
+# elastic re-partition (Context.resize): fired at STAGE time, before
+# any shard or mesh state mutates — an injected failure aborts the
+# resize with every old-W shard intact, so the generation heals and
+# the next resize attempt runs from exactly the same state
+_F_REPART = faults.declare("ckpt.repartition")
+
 
 def node_key(node) -> str:
     return f"{node.id}:{node.label}"
@@ -647,6 +653,100 @@ class CheckpointManager:
                 "ckpt_bytes_written": self.bytes_written,
                 "resume_skipped_ops": self.resume_skipped_ops,
                 "recovery_time_s": round(self.recovery_time_s, 4)}
+
+
+# ----------------------------------------------------------------------
+# elastic re-partition (api/context.py Context.resize)
+# ----------------------------------------------------------------------
+#
+# Live shards move across a W change in two phases so a mid-resize
+# failure can never strand half-moved data:
+#
+# * stage_repartition — runs BEFORE anything mutates: every live
+#   shard's valid rows serialize through the checkpoint serializer
+#   (the same columnar records an epoch file holds, data/serializer.py)
+#   into an in-memory staging blob. Any failure here (including the
+#   injected ``ckpt.repartition`` site) aborts the resize with the old
+#   mesh, membership and shards untouched.
+# * commit_repartition — runs AFTER ``MeshExec.resize``: the staged
+#   records deserialize behind the PR-13/15 prefetching reader
+#   (writeback.overlapped_fetch — the next worker's decode is in
+#   flight behind the current upload), re-split across
+#   ``dense_range_bounds(total, W')`` and upload to the new mesh.
+#   The split is exactly the layout a fresh W'-wide run would build,
+#   which is what keeps post-resize results bit-identical to a
+#   fixed-W' run.
+
+
+def stage_repartition(shards) -> dict:
+    """Serialize one live shard store for a W change; returns the
+    staging blob ``commit_repartition`` consumes. Pure read: the
+    shards stay valid and untouched."""
+    import jax as _jax
+    faults.check(_F_REPART, kind=type(shards).__name__,
+                 workers=shards.num_workers)
+    if isinstance(shards, DeviceShards):
+        per_worker = shards.to_worker_arrays()
+        _, treedef = _jax.tree.flatten(shards.tree)
+        skeleton = _jax.tree.unflatten(
+            treedef, list(range(treedef.num_leaves)))
+        payloads = [serialize_leaves(
+            [np.asarray(l) for l in _jax.tree.leaves(t)])
+            for t in per_worker]
+        return {"kind": "device", "skeleton": skeleton,
+                "payloads": payloads}
+    if isinstance(shards, HostShards):
+        from ..data.serializer import serialize_batch
+        return {"kind": "host",
+                "payloads": [serialize_batch(list(items))
+                             for items in shards.lists]}
+    raise TypeError(f"cannot repartition {type(shards).__name__}")
+
+
+def _overlapped_staged(mex, payloads):
+    """Yield ``(worker, payload)`` with the next worker's record fetch
+    in flight behind the current decode — the same planner-consulted
+    readahead the checkpoint restore path runs, at its own
+    ``ckpt.repartition`` site."""
+    from ..data.writeback import make_readahead, overlapped_fetch
+    from ..vfs.file_io import prefetch_depth
+    from .planner import planner_of
+    workers = list(range(len(payloads)))
+    ra = None
+    if len(workers) > 1:
+        depth = prefetch_depth()
+        pl = planner_of(mex)
+        if pl is not None:
+            depth = pl.io_prefetch_depth("ckpt.repartition", depth)
+        ra = make_readahead(depth)
+    try:
+        yield from overlapped_fetch(
+            workers, lambda w: payloads[w], "ckpt.repartition", ra)
+    finally:
+        if ra is not None:
+            ra.shutdown(wait=True, cancel_futures=True)
+
+
+def commit_repartition(mex, staged: dict):
+    """Rebuild one staged shard store against the RESIZED mesh (device
+    kind) or the new worker count (host kind)."""
+    import jax as _jax
+    if staged["kind"] == "host":
+        from ..data.serializer import deserialize_batch
+        lists: List[List[Any]] = []
+        for _, payload in _overlapped_staged(mex, staged["payloads"]):
+            lists.append(deserialize_batch(payload))
+        return HostShards(len(lists), lists).repartition(
+            mex.num_workers)
+    from ..data.shards import resplit_leaves
+    treedef = _jax.tree.structure(staged["skeleton"])
+    per_worker_leaves: List[List[np.ndarray]] = [
+        deserialize_leaves(payload)
+        for _, payload in _overlapped_staged(mex, staged["payloads"])]
+    new_leaves = resplit_leaves(per_worker_leaves, mex.num_workers)
+    per_worker = [_jax.tree.unflatten(treedef, leaves)
+                  for leaves in new_leaves]
+    return DeviceShards.from_worker_arrays(mex, per_worker)
 
 
 def _count_upstream_new(node) -> int:

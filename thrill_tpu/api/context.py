@@ -212,6 +212,11 @@ class Context:
         self.net.group.generation = self.generation
         self.stats_pipeline_aborts = 0
         self.stats_heal_time_s = 0.0
+        # elastic mesh (Context.resize): resizes completed on this
+        # Context and the wall seconds they cost — the serve lane
+        # reports both (a resize-free run must show 0 / 0.0)
+        self.stats_resizes = 0
+        self.stats_resize_time_s = 0.0
         # service plane (thrill_tpu/service/): the scheduler is
         # constructed lazily by the first submit(); current_tenant is
         # the tenant nodes created right now are stamped with (the
@@ -414,6 +419,121 @@ class Context:
                     svc = self.service = Scheduler(self)
         return svc.submit(pipeline_fn, tenant=tenant, name=name,
                           weight=weight)
+
+    # -- elastic mesh: W is a per-generation property --------------------
+    def resize(self, num_workers: int) -> float:
+        """Resize the mesh to ``num_workers`` logical workers at a
+        generation boundary; returns the wall seconds it took.
+
+        Every LIVE cached result (node shards held by ``.Keep`` or a
+        pending consumer) is re-partitioned across the new W by the
+        checkpoint serializer — the same dense-range split a fresh
+        ``W'``-wide run lays data out with, so post-resize pipelines
+        compute bit-identical to a fixed-``W'`` Context. Learned plan
+        state is W-SHAPED and swaps atomically: the old W's sticky
+        exchange capacities, cached programs and loop tapes are parked
+        in a per-W archive (a later resize BACK restores them warm),
+        while the HBM governor's tenant ledger, the scheduler and its
+        WFQ queue carry across unchanged.
+
+        On a SERVING Context the swap runs fenced on the dispatcher
+        thread at the next job boundary: the in-flight job finishes on
+        the old mesh, the swap runs exclusively (ahead of the queue —
+        under sustained traffic the queue may never drain), and every
+        queued future then runs on the new mesh and resolves normally.
+        A job observes exactly one W for its whole run, never a
+        half-swapped mesh.
+
+        Single-process only: a JAX device mesh cannot change its
+        process set, so on multi-controller deployments membership
+        changes happen in the host control plane instead
+        (``net.Group.resize`` / ``net.tcp.join_tcp_group``) and each
+        process keeps its local devices. ``THRILL_TPU_RESIZE=0`` pins
+        W entirely (this method raises)."""
+        from ..net.group import resize_enabled
+        if self._closed:
+            raise RuntimeError("Context is closed")
+        if not resize_enabled():
+            raise RuntimeError(
+                "THRILL_TPU_RESIZE=0 pins the worker count for this "
+                "process; unset it to allow Context.resize")
+        new_w = int(num_workers)
+        if new_w < 1:
+            raise ValueError("cannot resize to an empty mesh")
+        if self.mesh_exec.num_processes > 1 \
+                or self.net.num_workers > 1 or jax.process_count() > 1:
+            raise RuntimeError(
+                "Context.resize is single-process only: a JAX device "
+                "mesh cannot add or drop processes at runtime. On a "
+                "multi-controller deployment, change membership in "
+                "the host control plane (net.Group.resize for "
+                "survivors/leavers, net.tcp.join_tcp_group for a "
+                "joining rank) and relaunch the job at the new W — "
+                "see ARCHITECTURE.md \"Elastic mesh\"")
+        if new_w == self.num_workers:
+            return 0.0
+        svc = self.service
+        if svc is not None and svc.alive:
+            # fenced: the dispatcher runs the swap between jobs, so no
+            # pipeline ever traces against a half-swapped mesh
+            return svc.fence(lambda: self._resize_now(new_w))
+        return self._resize_now(new_w)
+
+    def _resize_now(self, new_w: int) -> float:
+        from ..mem.hbm import SpilledShards
+        from .checkpoint import (commit_repartition, stage_repartition)
+        t0 = time.monotonic()
+        mex = self.mesh_exec
+        old_w = mex.num_workers
+        plat = mex.devices[0].platform
+        devs = [d for d in jax.devices() if d.platform == plat]
+        if new_w > len(devs):
+            raise ValueError(
+                f"resize to {new_w} needs {new_w} {plat} devices, "
+                f"have {len(devs)}; set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={new_w} "
+                f"for CPU meshes")
+        # 1) STAGE: serialize every live result to host bytes through
+        # the checkpoint serializer. Pure reads — the repartition
+        # fault site fires here, BEFORE anything mutated, so an
+        # injected failure leaves the Context exactly as it was and
+        # the next resize attempt starts clean.
+        live = []
+        for node in self._nodes:
+            if getattr(node, "_shards", None) is None:
+                continue
+            if isinstance(node._shards, SpilledShards):
+                # re-split works on materialized shards; touch()
+                # transparently restores the spilled result first
+                self.hbm.touch(node)
+            live.append((node, stage_repartition(node._shards)))
+        # 2) SWAP: the mesh itself (per-W plan state parks in the
+        # archive inside), then the worker-level flow channel, which
+        # is W-wide by construction
+        mex.resize(devs[:new_w])
+        self.flow = LocalFlowControl(new_w)
+        # 3) COMMIT: rebuild every staged result on the new mesh and
+        # re-admit it to the HBM ledger at its new true size (tenant
+        # budgets and spill counters carry across untouched)
+        for node, blob in live:
+            self.hbm.on_release(node, None)
+            node._shards = commit_repartition(mex, blob)
+            self.hbm.on_cache(node)
+        # 4) a fresh generation: results computed from here belong to
+        # the new W's failure domain (the host group is trivial in a
+        # single-process Context, so the barrier is local bookkeeping)
+        self._gen_counter += 1
+        self.generation = self._gen_counter
+        self.net.group.begin_generation(self.generation)
+        dt = time.monotonic() - t0
+        self.stats_resizes += 1
+        self.stats_resize_time_s += dt
+        if self.logger.enabled:
+            self.logger.line(event="resize", workers_old=old_w,
+                             workers_new=new_w, nodes_moved=len(live),
+                             generation=self.generation,
+                             resize_time_s=round(dt, 4))
+        return dt
 
     # -- stage memory negotiation ---------------------------------------
     # Reference: the StageBuilder distributes worker RAM per stage —
@@ -653,6 +773,10 @@ class Context:
             "generation": self.generation,
             "pipeline_aborts": self.stats_pipeline_aborts,
             "heal_time_s": round(self.stats_heal_time_s, 4),
+            # elastic mesh: W changes this Context performed and their
+            # wall cost (0 / 0.0 proves the machinery idle when unused)
+            "resizes": self.stats_resizes,
+            "resize_time_s": round(self.stats_resize_time_s, 4),
             "conn_reconnects": getattr(self.net.group,
                                        "stats_reconnects", 0),
             "stale_frames_dropped": getattr(self.net.group,
@@ -663,7 +787,7 @@ class Context:
             # restart of a known pipeline reports plan_builds == 0
             **(self.service.stats() if self.service is not None else
                {"jobs_submitted": 0, "jobs_failed": 0,
-                "queue_depth_peak": 0}),
+                "jobs_rejected": 0, "queue_depth_peak": 0}),
             "tenant_hbm_peaks": dict(self.hbm.tenant_peaks),
             "tenant_spills": self.hbm.tenant_spill_count,
             "plan_builds": mex.stats_plan_builds,

@@ -131,6 +131,11 @@ class Planner:
         # the single THRILL_TPU_PREFETCH default per site
         self._io_depth: Dict[str, int] = {}
         self._io_rate: Dict[str, float] = {}
+        # shrink side of the loop: consecutive runs a site's audited
+        # hit rate held >= IO_HIT_SHRINK, and the pending one-shot
+        # shrink marks (site -> reason) the streak produced
+        self._io_hi_streak: Dict[str, int] = {}
+        self._io_shrink: Dict[str, str] = {}
 
     # -- cost model -----------------------------------------------------
     def bytes_eq(self) -> int:
@@ -284,6 +289,12 @@ class Planner:
     #: never grow past this — beyond it the readahead pool itself (not
     #: depth) is the bound, and RAM cost scales with depth blocks
     IO_DEPTH_CAP = 32
+    #: shrink a LEARNED depth back toward the default when the audited
+    #: hit rate holds at least this for two consecutive runs — the
+    #: readahead is comfortably ahead of the consumer, so half the
+    #: depth (and half the pinned host RAM) likely still hits; an
+    #: overshoot re-grows on the very next sub-target audit
+    IO_HIT_SHRINK = 0.95
 
     def io_prefetch_depth(self, site: str, default: int) -> int:
         """LEARNED per-site readahead depth for an out-of-core site
@@ -302,11 +313,39 @@ class Planner:
         re-optimization. ``default <= 0`` means prefetch is DISABLED
         (THRILL_TPU_PREFETCH=0 / OVERLAP=0) — the learned depth never
         overrides an explicit off switch (the synchronous-ladder
-        restoration contract)."""
+        restoration contract).
+
+        Shrinking: a site whose audited hit rate held at least
+        ``IO_HIT_SHRINK`` for two consecutive runs HALVES its learned
+        depth back toward ``default`` (floor at ``default`` — the
+        explicit/env setting is never undercut), reclaiming the pinned
+        readahead RAM a transient burst grew. The re-choice lands as
+        the same ``kind=replan`` record, carrying both depths."""
         if default <= 0:
             return default
         with self._lock:
             depth = self._io_depth.get(site, default)
+            shrink_why = self._io_shrink.pop(site, None)
+            shrink_rate = self._io_rate.get(site)
+        if shrink_why is not None and depth > default:
+            new = max(default, depth // 2)
+            with self._lock:
+                self._io_depth[site] = new
+                self._io_hi_streak[site] = 0
+                # a stale grow mark cannot coexist with a sustained
+                # >= IO_HIT_SHRINK streak — drop it without counting
+                self._replan.pop(site, None)
+            self.note_replan()
+            self.note_switch()
+            from ..common.decisions import ledger_of
+            self.record_replan(
+                ledger_of(self.mex), site, f"depth={new}",
+                predicted=float(new),
+                rejected=[(f"depth={depth}", shrink_rate)],
+                reason=shrink_why, depth=new, prev_depth=depth,
+                measured_hit_rate=shrink_rate)
+            return new
+        with self._lock:
             if depth >= self.IO_DEPTH_CAP:
                 # at the cap there is nothing to re-choose: drop any
                 # pending mark WITHOUT counting a replan (the counter
@@ -404,12 +443,27 @@ class Planner:
         elif rec.kind == "io_prefetch":
             # predicted = 1.0 (perfect hit rate); a measured rate
             # under the target means the consumer outran the
-            # readahead — grow that SITE's depth on its next run
+            # readahead — grow that SITE's depth on its next run. A
+            # rate holding >= IO_HIT_SHRINK two runs straight means
+            # the depth overshoots — shrink it back toward default.
             rate = rec.actual
             if rate is None:
                 return
             with self._lock:
                 self._io_rate[rec.site] = float(rate)
+                if rate >= self.IO_HIT_SHRINK:
+                    streak = self._io_hi_streak.get(rec.site, 0) + 1
+                    self._io_hi_streak[rec.site] = streak
+                    if streak >= 2:
+                        self._io_shrink.setdefault(
+                            rec.site,
+                            f"prefetch hit rate held >= "
+                            f"{self.IO_HIT_SHRINK:.2f} for {streak} "
+                            f"consecutive runs: learned depth "
+                            f"overshoots")
+                else:
+                    self._io_hi_streak[rec.site] = 0
+                    self._io_shrink.pop(rec.site, None)
             if rate < self.IO_HIT_TARGET:
                 self.mark_replan(
                     rec.site,

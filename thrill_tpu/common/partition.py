@@ -26,3 +26,13 @@ def dense_range_bounds(n: int, W: int) -> np.ndarray:
     worker ``w`` owns ``[bounds[w], bounds[w+1])``."""
     return np.array([(w * n) // W for w in range(W + 1)],
                     dtype=np.int64)
+
+
+def dense_range_sizes(n: int, W: int) -> np.ndarray:
+    """Per-worker row counts of the dense split — ``diff`` of
+    :func:`dense_range_bounds`. The elastic re-partition step
+    (api/checkpoint.py) re-splits live shards by exactly this layout
+    so a resized mesh addresses rows the same way a fresh ``W'``-wide
+    run would (the dense join's gidx formula above depends on it)."""
+    b = dense_range_bounds(n, W)
+    return (b[1:] - b[:-1]).astype(np.int64)

@@ -208,6 +208,17 @@ def install_plan_seeds(mex, state: dict, kinds) -> int:
     return n
 
 
+#: MeshExec attributes owned by this module whose VALUES are shaped by
+#: the worker count W (per-worker capacity vectors, W-specific plan
+#: kinds and narrow ranges, unconsumed store seeds keyed under the
+#: current W). An elastic resize (parallel/mesh.py MeshExec.resize)
+#: archives them per W instead of letting a W' pipeline consume a
+#: W-shaped capacity — a lying cap is healed by the overflow flag, but
+#: a WRONG-LENGTH cap vector would be garbage, not a lie.
+W_STATE_ATTRS = ("_sticky_caps", "_sticky_ranges", "_xchg_plan",
+                 "_xchg_plan_uses", "_plan_seed")
+
+
 def export_plan_state(mex: MeshExec) -> dict:
     """This mesh's exchange plan state as JSON-serializable digest
     maps (the plan store's on-disk form)."""

@@ -31,6 +31,26 @@ from ..parallel.mesh import MeshExec
 from ..common.partition import dense_range_bounds
 
 
+def resplit_leaves(per_worker_leaves: List[List[np.ndarray]],
+                   new_w: int) -> List[List[np.ndarray]]:
+    """Re-split per-worker leaf lists across a NEW worker count: the
+    concatenation (old worker-rank order) sliced by
+    ``dense_range_bounds(total, new_w)`` — exactly the layout a fresh
+    ``new_w``-wide run of the same pipeline would produce, which is
+    what keeps a resized mesh's results bit-identical to a fixed-W
+    run (api/checkpoint.py repartition)."""
+    if not per_worker_leaves:
+        return [[] for _ in range(new_w)]
+    nleaves = len(per_worker_leaves[0])
+    merged = [np.concatenate([pw[i] for pw in per_worker_leaves],
+                             axis=0)
+              for i in range(nleaves)]
+    n = merged[0].shape[0] if merged else 0
+    bounds = dense_range_bounds(n, new_w).tolist()
+    return [[leaf[bounds[w]:bounds[w + 1]] for leaf in merged]
+            for w in range(new_w)]
+
+
 def tree_leaves(tree):
     return jax.tree.leaves(tree)
 
@@ -326,6 +346,19 @@ class HostShards:
         no-op keeps the fused-boundary contract uniform (a plan's
         memory-pressure host fallback returns HostShards through
         ``FusionPlan.finish``, which validates unconditionally)."""
+
+    def repartition(self, new_w: int) -> "HostShards":
+        """Re-split the items across ``new_w`` workers by the dense
+        range layout (concatenate in worker-rank order, slice by
+        ``dense_range_bounds`` — the same split every layout site
+        uses, common/partition.py)."""
+        merged: List[Any] = []
+        for items in self.lists:
+            merged.extend(items)
+        bounds = dense_range_bounds(len(merged), new_w).tolist()
+        return HostShards(new_w,
+                          [merged[bounds[w]:bounds[w + 1]]
+                           for w in range(new_w)])
 
     def to_device(self, mesh_exec: MeshExec) -> DeviceShards:
         """Columnarize (requires items be fixed-shape pytrees of numbers)."""

@@ -66,6 +66,34 @@ F_HEARTBEAT = faults.declare("net.heartbeat",
 #: collective entry like any site (nothing sent yet — a clean abort).
 _F_DELAY = faults.declare("net.group.delay")
 
+#: elastic-mesh resize handshake (Group.resize / tcp.join_tcp_group):
+#: fired before any membership mutation, so an injected failure leaves
+#: the old membership intact — the generation settles among the
+#: survivors and the NEXT resize attempt starts from a clean group
+F_RESIZE = faults.declare("net.group.resize_handshake",
+                          exc=faults.InjectedConnectionError)
+
+
+def resize_enabled() -> bool:
+    """Elastic membership changes are on by default;
+    ``THRILL_TPU_RESIZE=0`` pins W for the process lifetime (a caller
+    asking anyway gets a loud RuntimeError, never a silent no-op)."""
+    return os.environ.get("THRILL_TPU_RESIZE", "1") != "0"
+
+
+def resize_timeout_s() -> float:
+    """Budget for one membership change (join handshakes + the
+    generation barrier on the new membership):
+    ``THRILL_TPU_RESIZE_TIMEOUT_S``, default = the heal budget. Like
+    the heal it MUST be bounded — waiting forever on a joiner that
+    died mid-handshake is a hang, not patience."""
+    v = os.environ.get("THRILL_TPU_RESIZE_TIMEOUT_S", "")
+    try:
+        t = float(v)
+    except ValueError:
+        return heal_timeout_s()
+    return t if t > 0 else heal_timeout_s()
+
 
 class CollectiveHangTimeout(TimeoutError):
     """A blocking collective recv exceeded THRILL_TPU_HANG_TIMEOUT_S
@@ -670,6 +698,154 @@ class Group(abc.ABC):
                 dropped += 1
                 continue
             dropped += 1                    # pre-abort payload frame
+
+    # ------------------------------------------------------------------
+    # elastic membership (resize at a generation boundary)
+    # ------------------------------------------------------------------
+
+    def _grow_transport(self, new_num_hosts: int, gen: int,
+                        deadline_at: float) -> None:
+        """Admit ranks ``[num_hosts, new_num_hosts)``: establish an
+        authenticated connection to each joiner (transport-specific;
+        tcp accepts the joiner's dial on this rank's own hostlist
+        port, mock extends the queue matrix). Must NOT mutate
+        ``_num_hosts`` — the caller commits membership only after
+        every joiner connected."""
+        raise NotImplementedError(
+            f"{type(self).__name__} cannot admit new ranks")
+
+    def _shrink_transport(self, new_num_hosts: int) -> None:
+        """Drop connections to ranks ``>= new_num_hosts`` (they have
+        drained and left, or were already dead). Default: nothing —
+        queue-backed transports just stop addressing them."""
+
+    def resize(self, new_num_hosts: int, gen: int) -> None:
+        """Collective membership change: every CURRENT rank (including
+        the ranks about to leave) calls this in lockstep with the same
+        ``(new_num_hosts, gen)``. A JOINING rank does not call it — it
+        enters through the transport's join constructor
+        (``tcp.join_tcp_group`` / ``MockNetwork.grow``) and then runs
+        ``begin_generation(gen)`` like everyone else.
+
+        Grow: admit the joiners, commit the new membership, then
+        barrier on it — the joiners' first collective is the
+        generation barrier itself. A failed admission (joiner died
+        mid-handshake, injected ``net.group.resize_handshake``) rolls
+        the membership back and settles ``gen`` among the old ranks,
+        so the group is healed and the next resize attempt starts
+        clean.
+
+        Shrink: barrier on the OLD membership first — the departing
+        rank's in-flight frames drain behind the existing generation
+        barrier — then the survivors drop the departed links. A
+        departing peer that is ALREADY DEAD is skipped with a note:
+        scale-down of a dead peer is the graceful form of the
+        dead-peer verdict (it was leaving anyway). A departing rank
+        returns with its frames drained; the caller closes the group.
+        """
+        new_w = int(new_num_hosts)
+        old_w = self.num_hosts
+        gen = int(gen)
+        if not resize_enabled():
+            raise RuntimeError(
+                "elastic resize is disabled (THRILL_TPU_RESIZE=0); "
+                "the worker count is pinned for the process lifetime")
+        if new_w < 1:
+            raise ValueError(f"cannot resize to {new_w} hosts")
+        faults.check(F_RESIZE, old=old_w, new=new_w, gen=gen,
+                     rank=self.my_rank)
+        if new_w == old_w:
+            self.begin_generation(gen)
+            return
+        if new_w > old_w:
+            deadline_at = time.monotonic() + resize_timeout_s()
+            try:
+                self._grow_transport(new_w, gen, deadline_at)
+                self._num_hosts = new_w
+                self.begin_generation(gen)
+            except (ConnectionError, OSError, TimeoutError):
+                # roll back: drop whatever joiner links landed, settle
+                # the generation among the OLD membership so a retry
+                # (or plain continued traffic) starts from a healed
+                # group instead of a half-admitted one
+                self._num_hosts = old_w
+                self._shrink_transport(old_w)
+                faults.note("recovery", what="net.resize_rollback",
+                            old=old_w, new=new_w, gen=gen)
+                self.begin_generation(gen)
+                raise
+            faults.note("recovery", what="net.resize", old=old_w,
+                        new=new_w, gen=gen, _quiet=True)
+            return
+        # -- shrink --------------------------------------------------
+        departing = frozenset(range(new_w, old_w))
+        self._resize_barrier(gen, lenient=departing)
+        if self.my_rank in departing:
+            return                  # drained; caller closes the group
+        self._num_hosts = new_w
+        self._shrink_transport(new_w)
+        self._hb_last = {p: t for p, t in self._hb_last.items()
+                         if p < new_w}
+        faults.note("recovery", what="net.resize", old=old_w,
+                    new=new_w, gen=gen, _quiet=True)
+
+    def _resize_barrier(self, gen: int, lenient: frozenset) -> int:
+        """Generation barrier over the CURRENT membership in which a
+        barrier failure against a ``lenient`` peer (the departing set)
+        is skipped instead of escalated — an unreachable peer that is
+        leaving anyway must not wedge the survivors. Mirrors
+        :meth:`_begin_generation` otherwise (marker exchange, stale
+        drain, pending-abort latch, repair-retry for survivors)."""
+        gen = int(gen)
+        if self._gen_markers:
+            gen = max(gen, max(self._gen_markers.values()))
+        ab = self._pending_abort
+        if ab is not None:
+            if (getattr(ab, "recoverable", True)
+                    and getattr(ab, "generation", -1) < gen):
+                self._pending_abort = None
+            else:
+                raise ab
+        self._poison_relayed.clear()
+        self.generation = gen
+        dropped = 0
+        if self.num_hosts > 1:
+            deadline_at = time.monotonic() + resize_timeout_s()
+            frame = {GENERATION_KEY: {"gen": self.generation,
+                                      "rank": self.my_rank}}
+            for peer in range(self.num_hosts):
+                if peer == self.my_rank:
+                    continue
+                while True:
+                    try:
+                        dropped += self._gen_barrier_peer(
+                            peer, frame, deadline_at)
+                        break
+                    except ClusterAbort:
+                        raise
+                    except (CollectiveHangTimeout, ConnectionError,
+                            OSError) as e:
+                        if peer in lenient:
+                            # already-dead departing peer: the
+                            # graceful form of the dead-peer verdict
+                            faults.note("recovery",
+                                        what="net.resize_skip_dead",
+                                        peer=peer, gen=gen,
+                                        error=repr(e)[:200])
+                            break
+                        if isinstance(e, CollectiveHangTimeout):
+                            raise
+                        if (time.monotonic() >= deadline_at
+                                or not self._repair_connection(
+                                    peer, deadline_at, e)):
+                            raise
+        self._gen_markers = {p: g for p, g in self._gen_markers.items()
+                             if g > self.generation}
+        self.stats_stale_dropped += dropped
+        if dropped:
+            faults.note("recovery", what="net.generation_drain",
+                        gen=self.generation, dropped=dropped)
+        return dropped
 
     # ------------------------------------------------------------------
     # collectives (generic over connections; reference net/collective.hpp)

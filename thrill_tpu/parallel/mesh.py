@@ -662,3 +662,65 @@ class MeshExec:
             seed = self._out_bytes_seed = {}
         seed.update({str(k): v for k, v in m.items()})
         return len(m)
+
+    # -- elastic resize (api/context.py Context.resize) -----------------
+    def _w_state_attrs(self) -> Tuple[str, ...]:
+        """Lazily-created attributes whose values are W-shaped and must
+        swap with the worker count: exchange plan state (capacity
+        vectors, plan kinds, narrow ranges, store seeds), pre-shuffle
+        verdicts, loop tapes (their donation twins are compiled against
+        W-sharded buffers), learned output sizes, and the compiled
+        program cache itself (every program closes over the mesh)."""
+        from ..data.exchange import W_STATE_ATTRS
+        return W_STATE_ATTRS + ("_prune_decisions", "_prune_history",
+                                "_loop_tapes", "_out_bytes_seed",
+                                "_cache")
+
+    def resize(self, devices: Sequence[Any]) -> None:
+        """Re-point the executor at a new device set (a new W) at a
+        generation boundary. The old W's learned and compiled state is
+        ARCHIVED, not discarded, and any state learned the last time
+        the new W was active is restored — a W=2→3→2 cycle returns to
+        warm plans instead of cold ones. Per-run content caches
+        (replicated small uploads, deferred checks, an in-flight loop
+        recorder) are device-addressed and simply dropped.
+
+        The caller owns everything above the executor: live shards
+        must already be extracted for re-partitioning (the old mesh's
+        arrays stay readable — jax arrays carry their sharding — but
+        nothing new may be laid out against it), and the host group's
+        membership changes through ``net.Group.resize``."""
+        devices = list(devices)
+        new_w = len(devices)
+        if new_w < 1:
+            raise ValueError("cannot resize to an empty device set")
+        old_w = self.num_workers
+        if new_w == old_w and devices == self.devices:
+            return
+        arch = getattr(self, "_w_archive", None)
+        if arch is None:
+            arch = self._w_archive = {}
+        saved = {}
+        for a in self._w_state_attrs():
+            if a in self.__dict__:
+                saved[a] = self.__dict__.pop(a)
+        arch[old_w] = saved
+        for a, v in arch.pop(new_w, {}).items():
+            setattr(self, a, v)
+        if "_cache" not in self.__dict__:
+            self._cache = {}
+        if "_xchg_plan" not in self.__dict__:
+            self._xchg_plan = {}
+        self.devices = devices
+        self.num_workers = new_w
+        self.mesh = Mesh(np.asarray(self.devices), (AXIS,))
+        self.slice_id = self._detect_slices()
+        self.num_slices = int(self.slice_id.max()) + 1 \
+            if len(self.slice_id) else 1
+        self.worker_process = np.array(
+            [getattr(d, "process_index", 0) for d in self.devices],
+            dtype=np.int64)
+        self.num_processes = len(set(self.worker_process.tolist())) or 1
+        self._put_small_cache.clear()
+        self._pending_checks.clear()
+        self.loop_recorder = None

@@ -17,6 +17,14 @@ Key/versioning rules:
   tuples (call-site ident + shapes + dtypes + treedefs) — stable for a
   fixed program across processes, and garbage for a changed one, which
   is exactly right: a changed pipeline simply misses and re-learns.
+* Every on-disk key carries a ``w{W}:`` prefix (the mesh width the
+  entry was learned at): capacities, narrow ranges and loop tapes are
+  W-SHAPED vectors, and an elastic service that resizes W=2→3 must
+  not install 2-wide caps into a 3-wide mesh. Loads filter to the
+  CURRENT width and strip the prefix; entries of other widths stay on
+  disk untouched, so a resize back to a previously-served W warm
+  starts again. (This is the on-disk twin of MeshExec.resize's in-
+  memory per-W archive, parallel/mesh.py.)
 * Values are CORRECTNESS-NEUTRAL by construction: a lying capacity or
   narrow range is caught by the exchange's in-trace overflow/range
   flag and healed by the synced re-run; a wrong plan kind or prune
@@ -45,7 +53,10 @@ from typing import Optional
 
 from ..common import faults
 
-STORE_VERSION = 1
+# v2: keys gained the w{W}: width prefix (elastic mesh) — v1 stores
+# carry width-ambiguous keys and are refused wholesale by the version
+# check (loud cold recompile), exactly the documented skew behavior
+STORE_VERSION = 2
 _FILE = "plans.json"
 #: the decision ledger's audited-accuracy summary persists NEXT TO the
 #: plan state it judges (common/decisions.py; Context.close writes it)
@@ -65,16 +76,29 @@ def _crc(entries: dict) -> int:
     return zlib.crc32(json.dumps(entries, sort_keys=True).encode())
 
 
+def _for_width(entries: dict, w: int) -> dict:
+    """The store slice learned at mesh width ``w``: keep only
+    ``w{w}:``-prefixed keys, stripped. Entries of other widths (or
+    unprefixed strays) are simply not installed — they are not wrong,
+    they are for a differently-shaped mesh."""
+    pre = f"w{w}:"
+    return {kind: {k[len(pre):]: v for k, v in m.items()
+                   if isinstance(k, str) and k.startswith(pre)}
+            for kind, m in entries.items() if isinstance(m, dict)}
+
+
 def install_entries(mex, entries: dict) -> int:
     """Install loaded store entries into a MeshExec's lazy seed
     tables; returns how many arrived. Shared by :meth:`PlanStore.attach`
     (this process read the file) and the Context's multi-process path
     (rank 0 read it and BROADCAST the entries over the host control
     plane, so every rank installs the identical seeds —
-    api/context.py)."""
+    api/context.py). Filters to the mesh's CURRENT width (keys are
+    ``w{W}:``-prefixed on disk — see the module docstring)."""
     from ..api import loop
     from ..core import preshuffle
     from ..data import exchange
+    entries = _for_width(entries, mex.num_workers)
     n = exchange.import_plan_state(mex, entries)
     n += preshuffle.import_plan_state(mex, entries)
     n += loop.import_plan_state(mex, entries)
@@ -182,6 +206,12 @@ class PlanStore:
         entries.update(loop.export_plan_state(mex))
         if hasattr(mex, "export_learned_sizes"):
             entries["out_bytes"] = mex.export_learned_sizes()
+        # stamp every exported key with the width it was learned at
+        # (the in-memory tables are all CURRENT-W state: MeshExec.resize
+        # parks other widths in its archive, never in these exports)
+        pre = f"w{mex.num_workers}:"
+        entries = {kind: {pre + dg: v for dg, v in m.items()}
+                   for kind, m in entries.items()}
         prev = self.load()
         if self._last_corrupt is None:
             for kind, old in prev.items():

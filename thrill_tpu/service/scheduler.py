@@ -49,6 +49,42 @@ from ..common import faults
 _F_SUBMIT = faults.declare("service.submit")
 
 
+class QueueFull(RuntimeError):
+    """submit() shed this job: the admission queue sits at its
+    THRILL_TPU_SERVE_QUEUE depth cap. The rejection is IMMEDIATE and
+    per-job — the returned future is born resolved with this error,
+    nothing was queued, and the scheduler keeps serving everything
+    already admitted. Carries the tenant and the depth/cap pair so a
+    client's backpressure loop can tell "my tenant is flooding" from
+    "the service is drowning"."""
+
+    def __init__(self, tenant: str, depth: int, cap: int) -> None:
+        super().__init__(
+            f"admission queue full: depth {depth} >= cap {cap} "
+            f"(THRILL_TPU_SERVE_QUEUE); job for tenant {tenant!r} shed")
+        self.tenant = tenant
+        self.depth = depth
+        self.cap = cap
+
+
+def _queue_cap() -> int:
+    """THRILL_TPU_SERVE_QUEUE admission depth cap; 0 = unbounded
+    (the default). Malformed values are skipped loudly — a typo must
+    not silently shed traffic."""
+    v = os.environ.get("THRILL_TPU_SERVE_QUEUE", "")
+    if not v:
+        return 0
+    try:
+        cap = int(v)
+    except ValueError:
+        import sys
+        print(f"thrill_tpu.service: ignoring malformed "
+              f"THRILL_TPU_SERVE_QUEUE={v!r} (want an integer); "
+              f"queue is unbounded", file=sys.stderr)
+        return 0
+    return max(cap, 0)
+
+
 def _weight(v: str) -> float:
     w = float(v)
     if w <= 0:
@@ -258,6 +294,20 @@ class Scheduler:
             os.environ.get("THRILL_TPU_SERVE_WEIGHTS", "")))
         self.jobs_submitted = 0
         self.jobs_failed = 0
+        # bounded admission (THRILL_TPU_SERVE_QUEUE): jobs shed at the
+        # cap, total and per tenant. Enforced ONLY on single-controller
+        # meshes — admission is per-rank client-thread timing, so two
+        # controllers could legally disagree on which submit hits the
+        # cap, and a job rank 0 runs that a follower rejected wedges
+        # the mesh collectives. Multi-controller: loud one-time skip.
+        self.queue_cap = _queue_cap()
+        self.jobs_rejected = 0
+        self.rejected_by_tenant: Dict[str, int] = {}
+        self._cap_skip_noted = False
+        # resize fencing (Context.resize): callables the dispatcher
+        # runs EXCLUSIVELY, between jobs — never concurrent with a
+        # pipeline that would trace W-shaped programs mid-swap
+        self._fences: List[Any] = []
         # jobs that LEFT the system (resolved any way: result, scoped
         # failure, drain) — the live metrics endpoint's jobs_in_flight
         # gauge is submitted - done (common/metrics.py)
@@ -303,6 +353,22 @@ class Scheduler:
                     self._job_ids, tenant,
                     name or f"job-{self._job_ids}",
                     RuntimeError("scheduler is closed"))
+            if self.queue_cap and self.queue.depth >= self.queue_cap:
+                if self.ctx.net.num_workers > 1 \
+                        or self.ctx.mesh_exec.num_processes > 1:
+                    # cross-rank divergent rejection would be fatal
+                    # (see __init__) — never shed on multi-controller
+                    if not self._cap_skip_noted:
+                        self._cap_skip_noted = True
+                        import sys
+                        print("thrill_tpu.service: THRILL_TPU_SERVE_"
+                              "QUEUE ignored on a multi-controller "
+                              "mesh — per-rank shed decisions could "
+                              "diverge and desync the lockstep "
+                              "admission contract; queue is unbounded",
+                              file=sys.stderr)
+                else:
+                    return self._reject(tenant, name)
             future = JobFuture(self._job_ids, tenant, name)
             if weight is not None:
                 self.queue.set_weight(tenant, weight)
@@ -317,6 +383,82 @@ class Scheduler:
                      queue_depth=depth)
         return future
 
+    def _reject(self, tenant: str, name: str) -> JobFuture:
+        """Shed one job at the admission cap (caller holds _cv)."""
+        self.jobs_rejected += 1
+        n = self.rejected_by_tenant.get(tenant, 0) + 1
+        self.rejected_by_tenant[tenant] = n
+        depth = self.queue.depth
+        err = QueueFull(tenant, depth, self.queue_cap)
+        fut = JobFuture.failed(self._job_ids, tenant,
+                               name or f"job-{self._job_ids}", err)
+        log = self.ctx.logger
+        if log.enabled:
+            log.line(event="job_reject", tenant=tenant, depth=depth,
+                     cap=self.queue_cap, tenant_rejected=n,
+                     jobs_rejected=self.jobs_rejected)
+        if n == 1:
+            # first shed PER TENANT goes to stderr: a flooding client
+            # must be visible even without the JSON log
+            import sys
+            print(f"thrill_tpu.service: shedding load for tenant "
+                  f"{tenant!r} — admission queue at depth {depth} >= "
+                  f"cap {self.queue_cap} (THRILL_TPU_SERVE_QUEUE)",
+                  file=sys.stderr)
+        return fut
+
+    def fence(self, fn: Callable[[], Any],
+              timeout: Optional[float] = None) -> Any:
+        """Run ``fn()`` EXCLUSIVELY on the dispatcher thread, at the
+        next job boundary, and return its result (or re-raise its
+        error). Fences take PRIORITY over queued jobs — under
+        sustained traffic the queue may never drain, and a resize must
+        not wait for it. This is how ``Context.resize`` swaps the mesh
+        under live traffic: the in-flight job finishes on the old W,
+        queued jobs run on the new — no pipeline ever observes a
+        half-swapped mesh.
+
+        Deliberately NOT wrapped in ``ctx.pipeline()``: pipeline()
+        restores the parent generation on exit, which would undo the
+        generation bump a resize performs. Single-controller only (the
+        callers that need multi-controller coordination — there are
+        none today — would have to broadcast the fence like a job)."""
+        if self.ctx.net.num_workers > 1 \
+                or self.ctx.mesh_exec.num_processes > 1:
+            raise RuntimeError(
+                "Scheduler.fence is single-controller only: a fence is "
+                "not part of the cross-rank admission agreement")
+        done = threading.Event()
+        cell: Dict[str, Any] = {}
+        with self._cv:
+            if self._dead is not None:
+                raise RuntimeError(
+                    f"scheduler is dead after an unrecoverable abort: "
+                    f"{self._dead!r}")
+            self._fences.append((fn, done, cell))
+            self._cv.notify_all()
+        if not done.wait(timeout):
+            raise TimeoutError(
+                f"fence did not run within {timeout}s (dispatcher "
+                f"busy or stopped)")
+        if "error" in cell:
+            raise cell["error"]
+        return cell.get("result")
+
+    def _run_fence(self, fence) -> None:
+        fn, done, cell = fence
+        try:
+            cell["result"] = fn()
+        except BaseException as e:
+            cell["error"] = e
+        finally:
+            done.set()
+
+    def _fail_fences(self, fences, cause: str) -> None:
+        for _fn, done, cell in fences:
+            cell["error"] = RuntimeError(cause)
+            done.set()
+
     @property
     def alive(self) -> bool:
         """The dispatcher thread still owns the mesh/control plane."""
@@ -326,6 +468,7 @@ class Scheduler:
         with self._cv:
             return {"jobs_submitted": self.jobs_submitted,
                     "jobs_failed": self.jobs_failed,
+                    "jobs_rejected": self.jobs_rejected,
                     "queue_depth_peak": self.queue.depth_peak}
 
     def _note_latency(self, tenant: str, seconds: float) -> None:
@@ -386,28 +529,46 @@ class Scheduler:
         # whatever ended the loop, no submitted future may be left
         # pending — close()'s contract is that every future resolves
         # (_poison already drained on the dead paths; this covers a
-        # rank whose local queue still held jobs at the sentinel)
+        # rank whose local queue still held jobs at the sentinel).
+        # Pending fences resolve too: a resize blocked on fence()
+        # must not hang forever on a stopping dispatcher.
         with self._cv:
             stranded = self.queue.drain()
+            fences, self._fences = self._fences, []
             self.jobs_failed += len(stranded)
             self.jobs_done += len(stranded)
         for job in stranded:
             job.future._finish(error=RuntimeError(
                 "scheduler stopped before this job ran"))
+        self._fail_fences(fences,
+                          "scheduler stopped before this fence ran")
 
     def _next_job(self) -> Optional[_Job]:
         net = self.ctx.net
         multi = net.num_workers > 1
         if not multi or net.group.my_rank == 0:
-            with self._cv:
-                while True:
-                    if self._dead is not None:
-                        job = None
-                        break
-                    job = self.queue.pop()
-                    if job is not None or self._closing:
-                        break
-                    self._cv.wait()
+            while True:
+                fence = None
+                with self._cv:
+                    while True:
+                        if self._dead is not None:
+                            job = None
+                            break
+                        if self._fences:
+                            # between-jobs exclusivity: the fence runs
+                            # HERE, on the dispatcher thread, before
+                            # the next job is even picked (fences are
+                            # single-controller only — see fence())
+                            fence = self._fences.pop(0)
+                            job = None
+                            break
+                        job = self.queue.pop()
+                        if job is not None or self._closing:
+                            break
+                        self._cv.wait()
+                if fence is None:
+                    break
+                self._run_fence(fence)
             if multi:
                 # the admission agreement: rank 0's pick becomes the
                 # cluster's next job (or the drain sentinel). The
@@ -581,6 +742,7 @@ class Scheduler:
         with self._cv:
             self._dead = cause
             stranded = self.queue.drain()
+            fences, self._fences = self._fences, []
             self.jobs_failed += len(stranded)
             self.jobs_done += len(stranded)
             self._cv.notify_all()
@@ -588,5 +750,8 @@ class Scheduler:
             job.future._finish(error=RuntimeError(
                 f"job never ran: scheduler died after an unrecoverable "
                 f"abort: {cause!r}"))
+        self._fail_fences(
+            fences, f"fence never ran: scheduler died after an "
+                    f"unrecoverable abort: {cause!r}")
         faults.note("recovery", what="service.scheduler_dead",
                     stranded=len(stranded), error=repr(cause)[:200])

@@ -58,6 +58,16 @@ COUNTERS = (
     "plan_builds", "items_moved",
     "spill_runs", "records_blocks", "prefetch_submits",
     "writeback_bytes",
+    # elastic-mesh / service-plane row (ISSUE 16): a resize-free run
+    # must report EXACTLY zero resizes and zero admission rejections —
+    # the elastic machinery and the bounded submit queue cost nothing
+    # when unused. resize_time_ms is derived from resize_time_s in
+    # _run_workload; it is only contract-deterministic BECAUSE it must
+    # be zero here (wall time appears the moment a resize does, which
+    # is itself the violation being caught). jobs_submitted pins the
+    # serve workload's job count; the batch workloads report 0.
+    "jobs_submitted", "jobs_failed", "jobs_rejected",
+    "resizes", "resize_time_ms",
 )
 
 #: byte totals compared ratio-banded (pow2 capacity ratchets may move
@@ -85,8 +95,14 @@ ENV_NOTE = (
 #: warm plan store zeroes plan_builds by design and armed faults
 #: change retry paths: both are scrubbed around the runs (and
 #: restored), so the contract always measures the cold default
+#: THRILL_TPU_SERVE_QUEUE is scrubbed too: admission rejections depend
+#: on submit-vs-drain TIMING under a finite cap, so a capped serve run
+#: can never honor an exact jobs_rejected contract — unlike FUSE-style
+#: knobs, whose counter effects are deterministic and therefore
+#: deliberately honored
 _SCRUB = ("THRILL_TPU_PLAN_STORE", "THRILL_TPU_FAULTS",
-          "THRILL_TPU_CKPT_DIR", "THRILL_TPU_RESUME")
+          "THRILL_TPU_CKPT_DIR", "THRILL_TPU_RESUME",
+          "THRILL_TPU_SERVE_QUEUE")
 
 VERSION = 1
 
@@ -194,12 +210,42 @@ def _em_sort(ctx):
     assert sum(len(lst) for lst in hs.lists) == len(items)
 
 
+def _serve_wc(ctx):
+    return sorted(
+        (int(k), int(v)) for k, v in ctx.Distribute(
+            np.arange(128, dtype=np.int64)).Map(_wc_kv).ReducePair(
+                _wc_add).AllGather())
+
+
+def _serve_chain(ctx):
+    return [int(v) for v in ctx.Distribute(
+        np.arange(96, dtype=np.int64)).Map(_chain_inc).PrefixSum()
+        .AllGather()]
+
+
+def _serve(ctx):
+    """Resize-free serving lane (ISSUE 16): tenant-tagged jobs through
+    ``ctx.submit`` on a W=2 mesh that never changes width. The elastic
+    row (resizes / resize_time_ms) and the admission counter
+    (jobs_rejected) must be EXACTLY zero — the elastic mesh and the
+    bounded submit queue cost nothing when a Context never uses them —
+    while jobs_submitted pins the lane's job count. Jobs serialize on
+    the dispatcher, so the dispatch/exchange counters stay a pure
+    function of the program just like the batch workloads."""
+    futs = [ctx.submit(_serve_wc, tenant="a", name="wc0"),
+            ctx.submit(_serve_chain, tenant="b", name="chain0"),
+            ctx.submit(_serve_wc, tenant="a", name="wc1")]
+    got = [f.result(timeout=120) for f in futs]
+    assert got[0] == got[2], "serve lane: repeated job diverged"
+
+
 WORKLOADS: Dict[str, Callable] = {
     "wordcount": _wordcount,
     "sort": _sort,
     "join": _joinish,
     "chain": _chain,
     "em_sort": _em_sort,
+    "serve": _serve,
 }
 
 #: per-workload env pins (set around the run, restored after): the em
@@ -230,6 +276,11 @@ def _run_workload(fn, workers: int = 2, pins=None) -> dict:
             else:
                 os.environ[k] = v
     out = {k: int(stats_box.get(k, 0)) for k in COUNTERS}
+    # derived: resize wall time in whole ms — int() on the raw seconds
+    # would truncate a 0.9 s resize to 0 and hide exactly the
+    # machinery-engaged-when-unused violation this field exists for
+    out["resize_time_ms"] = int(round(
+        float(stats_box.get("resize_time_s", 0.0)) * 1000))
     out.update({k: int(stats_box.get(k, 0)) for k in BYTE_FIELDS})
     return out
 
