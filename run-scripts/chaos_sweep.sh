@@ -16,6 +16,15 @@
 # chaos-marked cases in tests/api/test_out_of_core.py), as does
 # data.records.encode (ISSUE 15: the native columnar record encode
 # degrades to the pickle container — slower blocks, identical data).
+# The remote object-store tier (ISSUE 17) adds vfs.http.read /
+# vfs.http.write / vfs.http.list — one-shot HTTP transport faults
+# that must surface to the vfs retry seam and replay (ranged GET at
+# the consumed offset, full-object PUT re-send) — and
+# em.run.manifest, armed at both run-commit (the run silently stays
+# non-resumable) and run-load (a suspect manifest degrades LOUDLY to
+# re-forming the run, never wrong data); all four ride the same
+# randomized arming in tests/api/test_chaos.py, including sweeps over
+# a live in-repo object server with injected latency.
 # The socket-level sites
 # (net.tcp.*, net.multiplexer.*, net.dispatcher.timer) are swept by
 # tests/net/test_fault_injection.py, included here too, and the

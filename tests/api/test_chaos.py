@@ -55,7 +55,16 @@ _CHAOS_SITES = ("api.mesh.dispatch", "data.blockstore.put",
                 # native columnar spill records (ISSUE 15): an encode
                 # failure anywhere (serializer blocks, em run spill)
                 # degrades to the pickle container — never wrong data
-                "data.records.encode")
+                "data.records.encode",
+                # remote object store + resumable runs (ISSUE 17):
+                # transport request faults retry/reopen under the
+                # shared policy; a suspect run manifest degrades to a
+                # full re-form. Unreached in the in-memory fuzz
+                # pipelines (armed here so spec composition covers
+                # them); the REACHING sweep is
+                # test_chaos_remote_pipeline_exact_under_injection
+                "vfs.http.read", "vfs.http.write", "vfs.http.list",
+                "em.run.manifest")
 
 import os
 
@@ -179,3 +188,64 @@ def test_chaos_injection_actually_fires():
     assert got == [x * 2 for x in range(32)]
     assert faults.REGISTRY.injected >= 1
     assert faults.REGISTRY.stats()["retries"] >= 1
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", range(max(2, N_SEEDS // 3)))
+def test_chaos_remote_pipeline_exact_under_injection(seed, monkeypatch):
+    """Chaos over the REMOTE storage tier (ISSUE 17): a ReadLines ->
+    Sort -> Checkpoint pipeline against the in-repo object server,
+    with the transport sites (vfs.http.*) randomly armed at bounded
+    budgets AND the server itself refusing a random fraction of
+    requests with 503 — results bit-exact, every fault absorbed."""
+    from thrill_tpu.api.context import RunLocalMock
+    from tests.vfs.object_server import ObjectServer
+    rng = np.random.default_rng(40_000 + seed)
+    monkeypatch.setenv("THRILL_TPU_RETRY_BASE_S", "0.01")
+    sites = ("vfs.http.read", "vfs.http.write", "vfs.http.list")
+    spec = ";".join(
+        f"{s}:n={int(rng.integers(1, 3))}"
+        f":seed={int(rng.integers(0, 1 << 16))}" for s in sites)
+    monkeypatch.setenv(faults.ENV_VAR, spec)
+    with ObjectServer() as srv:
+        lines = [f"r-{int(v):07d}" for v in
+                 rng.integers(0, 1 << 20, size=120)]
+        srv.put("b/in-00.txt", "\n".join(lines[:60]).encode() + b"\n")
+        srv.put("b/in-01.txt", "\n".join(lines[60:]).encode() + b"\n")
+        srv.set_fail_rate(float(rng.uniform(0.0, 0.05)),
+                          seed=int(rng.integers(0, 1 << 16)))
+        got = RunLocalMock(
+            lambda ctx: ctx.ReadLines(f"{srv.url}/b/in-*")
+            .Sort().Checkpoint().AllGather(), 2,
+            config=Config(ckpt_dir=f"{srv.url}/b/ck"))
+    assert got == sorted(lines), (seed, faults.REGISTRY.events)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", range(max(2, N_SEEDS // 3)))
+def test_chaos_em_resume_exact_under_manifest_faults(seed, monkeypatch,
+                                                     tmp_path):
+    """Chaos over the run-resume protocol (ISSUE 17): form + commit
+    runs, then resume with em.run.manifest randomly armed — every
+    injected load fault degrades that run to a re-form (loud), output
+    bit-identical either way."""
+    from thrill_tpu.api.context import RunLocalMock
+    rng = np.random.default_rng(41_000 + seed)
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "100")
+    n = 1200
+    data = [(f"k{(i * 7919) % n:05d}", float(i)) for i in range(n)]
+
+    def job(ctx):
+        return ctx.Distribute(list(data), storage="host").Sort(
+            key_fn=lambda t: t[0]).AllGather()
+
+    ck = str(tmp_path / "ck")
+    assert RunLocalMock(job, 2, config=Config(ckpt_dir=ck)) == \
+        sorted(data, key=lambda t: t[0])
+    spec = (f"em.run.manifest:n={int(rng.integers(1, 4))}"
+            f":p={float(rng.uniform(0.3, 1.0)):.2f}"
+            f":seed={int(rng.integers(0, 1 << 16))}")
+    monkeypatch.setenv(faults.ENV_VAR, spec)
+    got = RunLocalMock(job, 2, config=Config(ckpt_dir=ck, resume=True))
+    assert got == sorted(data, key=lambda t: t[0]), \
+        (seed, faults.REGISTRY.events)

@@ -1,0 +1,212 @@
+"""Resumable external runs (ISSUE 17): the spilled run IS a
+checkpoint (core/em_runs.py).
+
+The contracts under test:
+
+* With checkpointing on, every spilled run commits a CRC'd manifest
+  (bin first, manifest after — ``write_file_atomic``), and a relaunch
+  with ``resume=True`` reuses EVERY committed run: ``runs_reused``
+  counts them, ``spill_runs`` does not, output bit-identical.
+* A SIGKILL mid-sort leaves only committed, verifiable runs; the
+  relaunch reuses exactly those and re-forms the rest — the
+  acceptance's "merge-only restart" once all runs committed.
+* A CORRUPT manifest or bin re-forms the run from scratch LOUDLY
+  (``faults.note("recovery", what="em_runs.manifest_invalid")``) —
+  never wrong data, never a silent fallback.
+* The ``em.run.manifest`` fault site covers both edges: injected at
+  commit the run simply stays non-resumable; injected at load the
+  reuse degrades to a full re-form, loudly.
+* ``THRILL_TPU_EM_RESUME=0`` disables the store entirely.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from thrill_tpu.api.context import Config, RunLocalMock
+from thrill_tpu.common import faults
+from thrill_tpu.common.iostats import IO
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_HOST_SORT_RUN", "100")
+    monkeypatch.delenv("THRILL_TPU_EM_RESUME", raising=False)
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+N = 2000
+
+
+def _data():
+    return [(f"k{(i * 7919) % N:05d}", float(i)) for i in range(N)]
+
+
+def _job(ctx):
+    return ctx.Distribute(_data(), storage="host").Sort(
+        key_fn=lambda t: t[0]).AllGather()
+
+
+def _expect():
+    return sorted(_data(), key=lambda t: t[0])
+
+
+def _manifests(ck):
+    return sorted(glob.glob(os.path.join(ck, "em_runs", "*", "*.json")))
+
+
+def test_runs_commit_and_resume_reuses_all(tmp_path):
+    ck = str(tmp_path / "ck")
+    s0 = IO.snapshot()
+    assert RunLocalMock(_job, 2, config=Config(ckpt_dir=ck)) == _expect()
+    s1 = IO.snapshot()
+    formed = s1["spill_runs"] - s0["spill_runs"]
+    assert formed > 0
+    mans = _manifests(ck)
+    assert len(mans) == formed           # every spilled run committed
+    man = json.loads(open(mans[0]).read())
+    assert {"slot", "pos0", "n", "fp", "crc", "bin_bytes",
+            "has_keys"} <= set(man)
+
+    # relaunch with resume: merge-only restart — zero runs re-formed
+    out = RunLocalMock(_job, 2, config=Config(ckpt_dir=ck, resume=True))
+    s2 = IO.snapshot()
+    assert out == _expect()
+    assert s2["spill_runs"] - s1["spill_runs"] == 0
+    assert s2["runs_reused"] - s1["runs_reused"] == formed
+
+
+def test_no_store_without_checkpoint_dir(tmp_path):
+    s0 = IO.snapshot()
+    assert RunLocalMock(_job, 2) == _expect()
+    assert IO.snapshot()["runs_reused"] == s0["runs_reused"]
+
+
+def test_em_resume_knob_disables_store(tmp_path, monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_EM_RESUME", "0")
+    ck = str(tmp_path / "ck")
+    assert RunLocalMock(_job, 2, config=Config(ckpt_dir=ck)) == _expect()
+    assert _manifests(ck) == []
+
+
+def test_corrupt_manifest_reforms_loudly(tmp_path):
+    ck = str(tmp_path / "ck")
+    RunLocalMock(_job, 2, config=Config(ckpt_dir=ck))
+    mans = _manifests(ck)
+    with open(mans[0], "w") as f:
+        f.write("{not json")                       # corrupt manifest
+    with open(mans[1].replace(".json", ".bin"), "r+b") as f:
+        f.truncate(10)                             # corrupt bin
+    ev0 = len(faults.REGISTRY.events)
+    s0 = IO.snapshot()
+    out = RunLocalMock(_job, 2, config=Config(ckpt_dir=ck, resume=True))
+    s1 = IO.snapshot()
+    assert out == _expect()                        # never wrong data
+    assert s1["spill_runs"] - s0["spill_runs"] == 2    # re-formed
+    assert s1["runs_reused"] - s0["runs_reused"] == len(mans) - 2
+    loud = [e for e in faults.REGISTRY.events[ev0:]
+            if e.get("what") == "em_runs.manifest_invalid"]
+    assert len(loud) == 2
+
+
+def test_manifest_fault_at_commit_leaves_run_nonresumable(tmp_path):
+    ck = str(tmp_path / "ck")
+    with faults.inject("em.run.manifest", n=2):
+        assert RunLocalMock(
+            _job, 2, config=Config(ckpt_dir=ck)) == _expect()
+    s0 = IO.snapshot()
+    out = RunLocalMock(_job, 2, config=Config(ckpt_dir=ck, resume=True))
+    s1 = IO.snapshot()
+    assert out == _expect()
+    # the 2 uncommitted runs re-form silently (normal crash-window
+    # behavior), the rest reuse
+    assert s1["spill_runs"] - s0["spill_runs"] == 2
+
+
+def test_manifest_fault_at_load_reforms_loudly(tmp_path):
+    ck = str(tmp_path / "ck")
+    RunLocalMock(_job, 2, config=Config(ckpt_dir=ck))
+    formed = len(_manifests(ck))
+    ev0 = len(faults.REGISTRY.events)
+    s0 = IO.snapshot()
+    with faults.inject("em.run.manifest", n=1):
+        out = RunLocalMock(
+            _job, 2, config=Config(ckpt_dir=ck, resume=True))
+    s1 = IO.snapshot()
+    assert out == _expect()
+    assert s1["spill_runs"] - s0["spill_runs"] == 1
+    assert s1["runs_reused"] - s0["runs_reused"] == formed - 1
+    assert any(e.get("what") == "em_runs.manifest_invalid"
+               for e in faults.REGISTRY.events[ev0:])
+
+
+def test_resume_skipped_runs_in_ctx_stats(tmp_path):
+    ck = str(tmp_path / "ck")
+    RunLocalMock(_job, 2, config=Config(ckpt_dir=ck))
+    stats = {}
+
+    def job(ctx):
+        out = _job(ctx)
+        stats.update(ctx.overall_stats())
+        return out
+
+    assert RunLocalMock(
+        job, 2, config=Config(ckpt_dir=ck, resume=True)) == _expect()
+    assert stats["resume_skipped_runs"] > 0
+
+
+_CHILD = """
+import os, signal
+from thrill_tpu.api.context import RunLocalMock, Config
+from thrill_tpu.core import em_runs
+
+orig = em_runs.RunStore.commit
+count = [0]
+def killing_commit(self, *a, **kw):
+    ok = orig(self, *a, **kw)
+    count[0] += 1
+    if count[0] >= 4:            # >= 2 committed runs per worker
+        os.kill(os.getpid(), signal.SIGKILL)
+    return ok
+em_runs.RunStore.commit = killing_commit
+
+N = 2000
+data = [(f"k{(i * 7919) % N:05d}", float(i)) for i in range(N)]
+def job(ctx):
+    return ctx.Distribute(data, storage="host").Sort(
+        key_fn=lambda t: t[0]).AllGather()
+RunLocalMock(job, 2, config=Config(ckpt_dir=CKPT))
+"""
+
+
+def test_sigkill_midsort_relaunch_reuses_committed_runs(tmp_path):
+    """The acceptance scenario: SIGKILL the process after >= 2 runs
+    committed; the relaunch (fresh process state, same program) reuses
+    every committed run and re-forms only the rest, bit-identical."""
+    ck = str(tmp_path / "ck")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               THRILL_TPU_HOST_SORT_RUN="100")
+    p = subprocess.run(
+        [sys.executable, "-c", _CHILD.replace("CKPT", repr(ck))],
+        env=env, capture_output=True, timeout=240)
+    assert p.returncode == -signal.SIGKILL, p.stderr.decode()[-2000:]
+    committed = len(_manifests(ck))
+    assert committed >= 2
+    # every committed manifest has its durable bin beside it
+    assert all(os.path.isfile(m.replace(".json", ".bin"))
+               for m in _manifests(ck))
+
+    s0 = IO.snapshot()
+    out = RunLocalMock(_job, 2, config=Config(ckpt_dir=ck, resume=True))
+    s1 = IO.snapshot()
+    assert out == _expect()
+    assert s1["runs_reused"] - s0["runs_reused"] == committed

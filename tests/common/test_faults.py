@@ -1004,6 +1004,89 @@ def _ex_net_resize_handshake():
     assert faults.REGISTRY.injected >= 1
 
 
+def _ex_vfs_http_sites():
+    """vfs.http.read / vfs.http.write / vfs.http.list (ISSUE 17): the
+    object-store transport's per-request sites. Raising arms retry
+    through the SHARED policy (the read reopens at the tracked offset,
+    the part PUT is idempotent and re-PUTs, the listing re-requests);
+    delay= is the per-request latency regime the em-remote bench lane
+    runs under — bytes identical, only slower."""
+    import time as _time
+    from thrill_tpu.vfs import file_io
+    from tests.vfs.object_server import ObjectServer
+    os.environ["THRILL_TPU_RETRY_BASE_S"] = "0.01"
+    try:
+        with ObjectServer() as srv:
+            payload = b"remote-bytes\n" * 64
+            srv.put("b/k", payload)
+            base = faults.REGISTRY.stats()["retries"]
+            with faults.inject("vfs.http.read", n=1, seed=6):
+                with file_io.OpenReadStream(f"{srv.url}/b/k") as r:
+                    assert r.read() == payload
+            assert faults.REGISTRY.stats()["retries"] > base
+            with faults.inject("vfs.http.write", n=1, seed=6):
+                file_io.write_file_atomic(f"{srv.url}/b/out", payload)
+            assert srv.objects["b/out"] == payload
+            with faults.inject("vfs.http.list", n=1, seed=6):
+                infos = file_io.Glob(f"{srv.url}/b/k*")
+                assert [i.path for i in infos] == [f"{srv.url}/b/k"]
+            assert faults.REGISTRY.injected == 3
+            # delay arm: the high-latency storage regime, not an error
+            with faults.inject("vfs.http.read", n=2, delay=0.02):
+                t0 = _time.perf_counter()
+                with file_io.OpenReadStream(f"{srv.url}/b/k") as r:
+                    assert r.read() == payload
+                assert _time.perf_counter() - t0 >= 0.015
+            assert faults.REGISTRY.stats()["faults_delayed"] >= 1
+    finally:
+        os.environ.pop("THRILL_TPU_RETRY_BASE_S", None)
+
+
+def _ex_em_run_manifest():
+    """em.run.manifest (ISSUE 17): injected at COMMIT the run simply
+    stays non-resumable (noted, never poisons the sort); injected at
+    LOAD the reuse degrades to a full re-form of the run, LOUDLY —
+    never wrong data from a suspect manifest."""
+    import tempfile
+    import types
+    from thrill_tpu.core.em_runs import RunStore, fingerprint
+    from thrill_tpu.data.file import File
+
+    items = [(i, f"v{i}") for i in range(64)]
+    with tempfile.TemporaryDirectory() as td:
+        mgr = types.SimpleNamespace(resume=True, resume_skipped_runs=0)
+        store = RunStore(os.path.join(td, "sig"), mgr=mgr)
+        f = File()
+        with f.writer() as w:
+            for it in items:
+                w.put(it)
+        fp = fingerprint(items[0])
+        # commit-side fault: noted, run stays non-resumable
+        with faults.inject("em.run.manifest", n=1):
+            assert store.commit(0, 0, len(items), fp, f) is False
+        assert store.try_load(0, 0, len(items), fp, f.pool,
+                              f.block_items) is None
+        assert any(e.get("what") == "em_runs.commit_failed"
+                   for e in faults.REGISTRY.events)
+        # clean commit, then a load-side fault: loud degrade to re-form
+        assert store.commit(0, 0, len(items), fp, f) is True
+        with faults.inject("em.run.manifest", n=1):
+            assert store.try_load(0, 0, len(items), fp, f.pool,
+                                  f.block_items) is None
+        assert any(e.get("what") == "em_runs.manifest_invalid"
+                   for e in faults.REGISTRY.events)
+        assert mgr.resume_skipped_runs == 0
+        # no fault: the committed run reloads bit-identical
+        got = store.try_load(0, 0, len(items), fp, f.pool,
+                             f.block_items)
+        assert got is not None
+        gf, gkf = got
+        assert list(gf.keep_reader()) == items and gkf is None
+        assert mgr.resume_skipped_runs == 1
+        gf.clear()
+        f.close()
+
+
 # sites whose exercisers live in tests/net/test_fault_injection.py
 # (they need real sockets / multi-rank groups)
 _NET_SITES = {
@@ -1070,6 +1153,13 @@ _MATRIX = {
     "data.records.encode": _ex_records_encode_degrades,
     "vfs.s3.read": _ex_vfs_scheme_sites,
     "vfs.hdfs.open": _ex_vfs_scheme_sites,
+    # remote object store (ISSUE 17): per-HTTP-request sites (raise ->
+    # retry/reopen under the shared policy; delay= -> the high-latency
+    # storage regime) and the resumable-run manifest protocol
+    "vfs.http.read": _ex_vfs_http_sites,
+    "vfs.http.write": _ex_vfs_http_sites,
+    "vfs.http.list": _ex_vfs_http_sites,
+    "em.run.manifest": _ex_em_run_manifest,
 }
 
 
@@ -1092,6 +1182,7 @@ def test_every_registered_site_is_covered():
     import every layer, then require full coverage."""
     import thrill_tpu.api.checkpoint  # noqa: F401
     import thrill_tpu.api.context  # noqa: F401
+    import thrill_tpu.core.em_runs  # noqa: F401
     import thrill_tpu.data.block_pool  # noqa: F401
     import thrill_tpu.data.records  # noqa: F401
     import thrill_tpu.net.heartbeat  # noqa: F401
@@ -1104,6 +1195,7 @@ def test_every_registered_site_is_covered():
     import thrill_tpu.service.scheduler  # noqa: F401
     import thrill_tpu.vfs.file_io  # noqa: F401
     import thrill_tpu.vfs.hdfs_file  # noqa: F401
+    import thrill_tpu.vfs.object_store  # noqa: F401
     import thrill_tpu.vfs.s3_file  # noqa: F401
     registered = {n for n in faults.REGISTRY.sites if not
                   n.startswith(("t.", "demo."))}      # test-local sites

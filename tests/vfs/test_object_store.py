@@ -1,0 +1,294 @@
+"""Remote object-store vfs backend (ISSUE 17): stdlib HTTP transport
+against the in-repo S3-compatible mock server.
+
+The contracts under test:
+
+* Transport correctness over a REAL socket: ranged GETs resume at an
+  offset, listings page through ListObjectsV2, writes ≥ the part
+  threshold go multipart (bounded memory — nothing buffers the whole
+  object), an aborted write publishes NOTHING.
+* Failure semantics ride the SHARED retry policy (common/retry.py):
+  503s are transient and retried with backoff, 404 is permanent and
+  maps to FileNotFoundError, and a server that IGNORES Range makes the
+  reader fail LOUDLY rather than silently restart from byte 0.
+* The ``s3://`` scheme works WITHOUT boto3 when
+  ``THRILL_TPU_OBJECT_STORE_ENDPOINT`` names an endpoint — same
+  transport, path-style REST.
+* End to end: ReadLines -> Sort -> Checkpoint entirely against the
+  object server at injected per-GET latency is BIT-IDENTICAL to the
+  same pipeline over ``file://``, in CI, with no cloud credentials.
+"""
+
+import os
+
+import pytest
+
+from thrill_tpu.common import faults, iostats
+from thrill_tpu.vfs import file_io, object_store
+from tests.vfs.object_server import ObjectServer
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in ("THRILL_TPU_OBJECT_STORE_ENDPOINT",
+                "THRILL_TPU_OBJECT_STORE_PART",
+                "THRILL_TPU_OBJECT_STORE_TIMEOUT",
+                "AWS_ENDPOINT_URL", "THRILL_TPU_RETRY_BASE_S"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("THRILL_TPU_RETRY_BASE_S", "0.01")
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.REGISTRY.reset()
+    object_store.latency_reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+@pytest.fixture()
+def srv():
+    with ObjectServer() as s:
+        yield s
+
+
+# ----------------------------------------------------------------------
+# transport units
+# ----------------------------------------------------------------------
+
+def test_put_get_roundtrip_and_ranged_read(srv):
+    data = bytes(range(256)) * 64
+    with file_io.OpenWriteStream(f"{srv.url}/b/obj.bin") as w:
+        w.write(data)
+    assert srv.objects["b/obj.bin"] == data
+    with object_store.http_open_read(f"{srv.url}/b/obj.bin") as r:
+        assert r.read() == data
+    # reopen at offset = one ranged GET, bytes from there on only
+    with object_store.http_open_read(f"{srv.url}/b/obj.bin",
+                                     offset=1000) as r:
+        assert r.read() == data[1000:]
+
+
+def test_glob_lists_keys_with_sizes(srv):
+    for i in range(3):
+        srv.put(f"b/in-{i:02d}.txt", b"x" * (10 + i))
+    srv.put(f"b/other.txt", b"zz")
+    infos = file_io.Glob(f"{srv.url}/b/in-*")
+    assert [i.path for i in infos] == \
+        [f"{srv.url}/b/in-{k:02d}.txt" for k in range(3)]
+    assert [i.size for i in infos] == [10, 11, 12]
+    assert srv.stats()["lists"] >= 1
+
+
+def test_retry_through_503(srv):
+    """503 at open is transient: the vfs seam's retry policy reopens
+    until the server recovers (the transport itself stays one-shot)."""
+    srv.put("b/k", b"payload-bytes")
+    srv.fail_next(2)
+    with file_io.OpenReadStream(f"{srv.url}/b/k") as r:
+        assert r.read() == b"payload-bytes"
+    # 2 refused with 503 (before the GET counter) + 1 served
+    assert srv.stats()["requests"] == 3
+    assert srv.stats()["gets"] == 1
+
+
+def test_404_is_permanent(srv):
+    with pytest.raises(FileNotFoundError):
+        object_store.http_open_read(f"{srv.url}/b/missing")
+    # permanent: exactly one GET hit the wire, no retry storm
+    assert srv.stats()["gets"] == 1
+
+
+def test_range_ignored_is_loud(srv):
+    """A server answering 200 to a ranged GET would silently feed the
+    reader bytes from position 0 — that MUST be a loud error, never a
+    silent wrong-offset read."""
+    srv.put("b/k", b"0123456789")
+    srv.set_honor_range(False)
+    with pytest.raises(object_store.HTTPStatusError):
+        object_store.http_open_read(f"{srv.url}/b/k", offset=4)
+
+
+def _raise_reset(*a, **kw):
+    raise ConnectionResetError("connection died mid-stream")
+
+
+def test_reader_reopens_at_offset_through_vfs_seam(srv, monkeypatch):
+    """The generic RetryingReader recovery: a mid-stream connection
+    fault reopens AT THE CURRENT OFFSET (one ranged GET), bytes
+    bit-identical. Prefetch off so the reader's live connection is
+    reachable for the kill."""
+    monkeypatch.setenv("THRILL_TPU_PREFETCH", "0")
+    data = os.urandom(1 << 16)
+    srv.put("b/k", data)
+    got = b""
+    with file_io.OpenReadStream(f"{srv.url}/b/k") as r:
+        got += r.read(100)
+        # break the live response under the reader: the next read
+        # fails mid-stream and must resume via ONE ranged GET at the
+        # tracked offset — not a restart from byte 0
+        r._f.raw._resp.read = _raise_reset
+        got += r.read()
+    assert got == data
+    assert srv.stats()["gets"] == 2      # original + reopen
+
+
+def test_multipart_upload(srv, monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_OBJECT_STORE_PART", str(1 << 16))
+    data = os.urandom(5 * (1 << 16) + 123)
+    with file_io.OpenWriteStream(f"{srv.url}/b/big.bin") as w:
+        # dribble writes smaller than the part size: the stream
+        # buffers to the threshold, never the whole object
+        for off in range(0, len(data), 1000):
+            w.write(data[off:off + 1000])
+    assert srv.objects["b/big.bin"] == data
+    assert srv.stats()["puts"] >= 6      # 5 full parts + final
+
+
+def test_aborted_write_publishes_nothing(srv, monkeypatch):
+    monkeypatch.setenv("THRILL_TPU_OBJECT_STORE_PART", str(1 << 16))
+    with pytest.raises(RuntimeError, match="boom"):
+        with file_io.OpenWriteStream(f"{srv.url}/b/never.bin") as w:
+            w.write(os.urandom(1 << 17))     # >= 2 parts in flight
+            raise RuntimeError("boom")
+    assert "b/never.bin" not in srv.objects
+
+
+def test_write_file_atomic_over_http(srv):
+    file_io.write_file_atomic(f"{srv.url}/b/at.bin", b"atomic-bytes")
+    assert srv.objects["b/at.bin"] == b"atomic-bytes"
+
+
+def test_remote_counters_and_latency(srv):
+    srv.put("b/k", b"abc")
+    srv.set_latency(0.005)
+    io0 = iostats.IO.snapshot()
+    object_store.latency_reset()
+    with object_store.http_open_read(f"{srv.url}/b/k") as r:
+        r.read()
+    with file_io.OpenWriteStream(f"{srv.url}/b/k2") as w:
+        w.write(b"def")
+    d = iostats.IO.delta(iostats.IO.snapshot(), io0)
+    assert d["remote_gets"] >= 1 and d["remote_puts"] >= 1
+    assert object_store.get_p50_ms() >= 5.0
+
+
+# ----------------------------------------------------------------------
+# s3:// without the SDK
+# ----------------------------------------------------------------------
+
+def test_s3_scheme_via_rest_fallback(srv, monkeypatch):
+    import builtins
+    real_import = builtins.__import__
+
+    def no_boto3(name, *a, **kw):
+        if name == "boto3":
+            raise ImportError("no boto3")
+        return real_import(name, *a, **kw)
+    monkeypatch.setattr(builtins, "__import__", no_boto3)
+    monkeypatch.setenv("THRILL_TPU_OBJECT_STORE_ENDPOINT", srv.url)
+
+    with file_io.OpenWriteStream("s3://b/via-rest.txt") as w:
+        w.write(b"hello s3\n")
+    assert srv.objects["b/via-rest.txt"] == b"hello s3\n"
+    with file_io.OpenReadStream("s3://b/via-rest.txt") as r:
+        assert r.read() == b"hello s3\n"
+    infos = file_io.Glob("s3://b/via-*")
+    assert [i.path for i in infos] == ["s3://b/via-rest.txt"]
+
+
+def test_s3_still_gated_without_endpoint(monkeypatch):
+    """No boto3 AND no endpoint env: the original NotImplementedError
+    gate stays (nothing to talk to)."""
+    import builtins
+    real_import = builtins.__import__
+
+    def no_boto3(name, *a, **kw):
+        if name == "boto3":
+            raise ImportError("no boto3")
+        return real_import(name, *a, **kw)
+    monkeypatch.setattr(builtins, "__import__", no_boto3)
+    with pytest.raises(NotImplementedError):
+        file_io.Glob("s3://bucket/prefix*")
+
+
+# ----------------------------------------------------------------------
+# end to end: the dataflow over remote storage
+# ----------------------------------------------------------------------
+
+def _seed_lines(srv, n=400, shards=4):
+    lines = [f"line-{(i * 7919) % n:06d}" for i in range(n)]
+    per = n // shards
+    for s in range(shards):
+        body = "\n".join(lines[s * per:(s + 1) * per]) + "\n"
+        srv.put(f"b/input-{s:02d}.txt", body.encode())
+    return sorted(lines)
+
+
+def _pipeline(ctx, glob_url):
+    return ctx.ReadLines(glob_url).Sort().Checkpoint().AllGather()
+
+
+@pytest.mark.parametrize("W", [1, 2])
+def test_read_sort_checkpoint_over_http_matches_file(W, tmp_path):
+    """The flagship E2E: the whole pipeline — input lines, checkpoint
+    shards — against the object server at 20ms per request, output
+    bit-identical to the same pipeline over file://. One in-tier
+    latency point; the sweep is slow-marked below."""
+    from thrill_tpu.api.context import Config, RunLocalMock
+    with ObjectServer(latency_s=0.02) as srv:
+        expect = _seed_lines(srv)
+        # same inputs on local disk
+        for k, v in srv.objects.items():
+            p = tmp_path / "in" / k
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_bytes(v)
+
+        remote = RunLocalMock(
+            lambda ctx: _pipeline(ctx, f"{srv.url}/b/input-*"), W,
+            config=Config(ckpt_dir=f"{srv.url}/b/ck"))
+        local = RunLocalMock(
+            lambda ctx: _pipeline(ctx, str(tmp_path / "in/b/input-*")),
+            W, config=Config(ckpt_dir=str(tmp_path / "ck")))
+        assert remote == local == expect
+        # the checkpoint epoch really lives on the server
+        assert any(k.startswith("b/ck/epoch_") for k in srv.objects)
+
+        # and it RESUMES from the remote epoch
+        resumed = RunLocalMock(
+            lambda ctx: _pipeline(ctx, f"{srv.url}/b/input-*"), W,
+            config=Config(ckpt_dir=f"{srv.url}/b/ck", resume=True))
+        assert resumed == expect
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("latency_ms", [5, 20, 50])
+def test_latency_sweep_read_sort_checkpoint(latency_ms, tmp_path):
+    from thrill_tpu.api.context import Config, RunLocalMock
+    with ObjectServer(latency_s=latency_ms / 1e3) as srv:
+        expect = _seed_lines(srv)
+        got = RunLocalMock(
+            lambda ctx: _pipeline(ctx, f"{srv.url}/b/input-*"), 2,
+            config=Config(ckpt_dir=f"{srv.url}/b/ck"))
+        assert got == expect
+
+
+def test_readbinary_over_http(srv):
+    import numpy as np
+    from thrill_tpu.api.context import RunLocalMock
+    arr = np.arange(300, dtype=np.int64)
+    srv.put("b/data-00.bin", arr[:150].tobytes())
+    srv.put("b/data-01.bin", arr[150:].tobytes())
+    out = RunLocalMock(
+        lambda ctx: ctx.ReadBinary(f"{srv.url}/b/data-*",
+                                   dtype=np.int64).AllGather(), 2)
+    assert [int(x) for x in out] == list(range(300))
+
+
+def test_flaky_server_e2e(srv):
+    """5% of requests 503 — the pipeline still completes bit-correct
+    through the shared retry policy."""
+    from thrill_tpu.api.context import RunLocalMock
+    expect = _seed_lines(srv, n=200, shards=2)
+    srv.set_fail_rate(0.05, seed=11)
+    got = RunLocalMock(
+        lambda ctx: ctx.ReadLines(f"{srv.url}/b/input-*")
+        .Sort().AllGather(), 2)
+    assert got == expect

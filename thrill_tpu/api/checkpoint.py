@@ -93,6 +93,15 @@ def _epoch_num(path: str) -> Optional[int]:
         return None
 
 
+def _is_remote(path: str) -> bool:
+    """Object-store (s3/hdfs/http) checkpoint directory: no mkdir, no
+    rmtree, no posix stat — discovery goes through the vfs Glob and
+    a missing manifest is detected by the read itself. Everything else
+    (shard writes, manifest commit, restores) already rides the
+    scheme-agnostic vfs seam."""
+    return "://" in path and not path.startswith("file://")
+
+
 class CheckpointManager:
     """Owned by :class:`api.context.Context`; saves materialized shard
     state at stage barriers and restores it on resume."""
@@ -107,12 +116,16 @@ class CheckpointManager:
         self.epochs_written = 0
         self.bytes_written = 0
         self.resume_skipped_ops = 0
+        # EM-sort runs reloaded from the run store instead of re-formed
+        # (core/em_runs.py bumps this on every successful try_load)
+        self.resume_skipped_runs = 0
         self.restored_nodes = 0
         self.recovery_time_s = 0.0
         self.resume_epoch: Optional[int] = None
         self._inflight_dir: Optional[str] = None
         self._manifest: Optional[dict] = None
-        os.makedirs(self.dir, exist_ok=True)
+        if not _is_remote(self.dir):
+            os.makedirs(self.dir, exist_ok=True)
         self._next_epoch = 1 + max(
             (e for e in (_epoch_num(p) for p in self._epoch_dirs())
              if e is not None), default=-1)
@@ -166,6 +179,20 @@ class CheckpointManager:
         return list(range(mex.num_workers))
 
     def _epoch_dirs(self) -> List[str]:
+        if _is_remote(self.dir):
+            # object stores have no directories: list the epoch_*
+            # object prefix and fold keys back into epoch "dirs"
+            base = self.dir.rstrip("/")
+            seen: Dict[str, None] = {}
+            try:
+                listing = file_io.Glob(base + "/epoch_*")
+            except (OSError, NotImplementedError):
+                return []
+            for fi in listing:
+                rest = fi.path[len(base) + 1:]
+                if "/" in rest:
+                    seen.setdefault(rest.split("/", 1)[0], None)
+            return [f"{base}/{d}" for d in seen]
         return [p for p in glob.glob(os.path.join(self.dir, "epoch_*"))
                 if os.path.isdir(p)]
 
@@ -202,7 +229,8 @@ class CheckpointManager:
         epoch = self._next_epoch
         self._next_epoch += 1
         edir = os.path.join(self.dir, _EPOCH_FMT.format(epoch))
-        os.makedirs(edir, exist_ok=True)
+        if not _is_remote(self.dir):
+            os.makedirs(edir, exist_ok=True)
         self._inflight_dir = edir
         if isinstance(shards, DeviceShards):
             rec, nbytes = self._save_device(node, shards, edir)
@@ -322,11 +350,21 @@ class CheckpointManager:
 
     def _try_load_manifest(self, edir: str) -> Optional[dict]:
         mpath = os.path.join(edir, MANIFEST)
-        if not os.path.isfile(mpath):
+        if not _is_remote(self.dir) and not os.path.isfile(mpath):
             return None
         try:
-            with open(mpath, "rb") as f:
-                m = json.loads(f.read().decode())
+            if _is_remote(self.dir):
+                try:
+                    with file_io.OpenReadStream(mpath) as f:
+                        raw = f.read()
+                except FileNotFoundError:
+                    # no manifest object = uncommitted epoch, exactly
+                    # the missing-file case the posix isfile probe hits
+                    return None
+            else:
+                with open(mpath, "rb") as f:
+                    raw = f.read()
+            m = json.loads(raw.decode())
             if m.get("format") != 1:
                 raise ValueError(f"unknown format {m.get('format')}")
             if m.get("workers") != self.ctx.mesh_exec.num_workers:
@@ -626,6 +664,10 @@ class CheckpointManager:
         previous run is dead by definition) and from the abort path
         (only this run's own in-flight epoch is fresh)."""
         removed = 0
+        if _is_remote(self.dir):
+            # no delete verb on the vfs seam — harmless: an epoch
+            # without a manifest is invisible to resume discovery
+            return 0
         for edir in self._epoch_dirs():
             if os.path.isfile(os.path.join(edir, MANIFEST)):
                 continue
@@ -642,6 +684,8 @@ class CheckpointManager:
     def abort_cleanup(self) -> None:
         """Drop this run's uncommitted in-flight epoch (if any)."""
         edir, self._inflight_dir = self._inflight_dir, None
+        if edir and _is_remote(self.dir):
+            return
         if edir and not os.path.isfile(os.path.join(edir, MANIFEST)):
             try:
                 shutil.rmtree(edir)
@@ -652,6 +696,7 @@ class CheckpointManager:
         return {"checkpoint_epochs": self.epochs_written,
                 "ckpt_bytes_written": self.bytes_written,
                 "resume_skipped_ops": self.resume_skipped_ops,
+                "resume_skipped_runs": self.resume_skipped_runs,
                 "recovery_time_s": round(self.recovery_time_s, 4)}
 
 

@@ -258,6 +258,16 @@ class SortNode(DIABase):
         # before evicting runs to disk
         pool = spill_pool(self.context.config.spill_dir,
                           self.mem_limit)
+        # resumable runs (core/em_runs.py): with checkpointing on, each
+        # spilled run commits a CRC'd manifest under the checkpoint
+        # dir; a relaunch with resume reloads committed runs instead of
+        # re-sorting them (identity-checked — slot, position range,
+        # first-item fingerprint). None when ctx.checkpoint is None or
+        # THRILL_TPU_EM_RESUME=0: zero overhead on the default path.
+        from ...core import em_runs
+        run_store = em_runs.store_for(
+            self.context, node_id=self.id, label=self.label, W=W,
+            run_size=run_size, total=shards.total)
         sampler = ReservoirSamplingGrow(np.random.default_rng(17))
         # items carry their stream position: the (key, position)
         # tiebreak makes the EM sort stable AND lets splitters cut
@@ -307,7 +317,7 @@ class SortNode(DIABase):
         # run early when actual interpreter growth passes the grant
         # (reference: ReceiveItems spills on mem::memory_exceeded,
         # api/sort.hpp:679)
-        from ...data.file import File
+        from ...data.file import DEFAULT_BLOCK_ITEMS, File
         from ...mem.manager import RssBudget
         budget = RssBudget(self.mem_limit or 0)
 
@@ -355,7 +365,7 @@ class SortNode(DIABase):
                     arrs[j] = buf.reshape(-1).view(f"S{W_}")
             return arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
 
-        def _columnar_job(arrs, items_, p0, slot):
+        def _columnar_job(arrs, items_, p0, slot, meta=None):
             def job():
                 b0 = pool.bytes_put
                 arr = _widen_concat(arrs)
@@ -368,10 +378,12 @@ class SortNode(DIABase):
                 native_merge.write_key_chunks_fixed(kf, arr[order])
                 files[slot] = f
                 key_files[slot] = kf
+                if meta is not None:
+                    run_store.submit_commit(slot, *meta, f, kf)
                 return pool.bytes_put - b0
             return job
 
-        def _records_job(arrs, items_, p0, slot):
+        def _records_job(arrs, items_, p0, slot, meta=None):
             """Native-records spill: the whole encode — vectorized
             payload columns, memcmp argsort, pos/payload gather, block
             handoff — runs INSIDE the write-behind job, so the main
@@ -410,10 +422,12 @@ class SortNode(DIABase):
                     kf, native_records.gather_rows(arr, order))
                 files[slot] = f
                 key_files[slot] = kf
+                if meta is not None:
+                    run_store.submit_commit(slot, *meta, f, kf)
                 return pool.bytes_put - b0
             return job
 
-        def _encoded_job(this_run, slot):
+        def _encoded_job(this_run, slot, meta=None):
             def job():
                 b0 = pool.bytes_put
                 this_run.sort()          # kb unique (pos suffix): pure
@@ -425,13 +439,18 @@ class SortNode(DIABase):
                 native_merge.write_key_chunks(kf, [t[0] for t in this_run])
                 files[slot] = f
                 key_files[slot] = kf
+                if meta is not None:
+                    run_store.submit_commit(slot, *meta, f, kf)
                 return pool.bytes_put - b0
             return job
 
-        def _generic_job(this_run, slot):
+        def _generic_job(this_run, slot, meta=None):
             def job():
                 b0 = pool.bytes_put
-                files[slot] = _spill_run(pool, this_run, pair_key)
+                f = _spill_run(pool, this_run, pair_key)
+                files[slot] = f
+                if meta is not None:
+                    run_store.submit_commit(slot, *meta, f, None)
                 return pool.bytes_put - b0
             return job
 
@@ -442,6 +461,31 @@ class SortNode(DIABase):
             slot = len(files)
             files.append(None)
             key_files.append(None)
+            meta = None
+            if run_store is not None:
+                # run identity in arrival order: (pos0, n, first-item
+                # fingerprint) — computed BEFORE the job sorts anything
+                if col_items:
+                    p0, n_, first = col_pos0, len(col_items), \
+                        col_items[0]
+                elif enc is not None:
+                    p0, n_, first = run[0][1], len(run), run[0][2]
+                else:
+                    p0, n_, first = run[0][0], len(run), run[0][1]
+                meta = (p0, n_, em_runs.fingerprint(first))
+                got = run_store.try_load(slot, *meta, pool,
+                                         DEFAULT_BLOCK_ITEMS)
+                if got is not None:
+                    # committed run from the previous launch: adopt its
+                    # blocks, skip the sort+serialize+write entirely.
+                    # runs_reused counts here; spill_runs does NOT —
+                    # the perf sentinel separates formed from reloaded.
+                    files[slot], key_files[slot] = got
+                    _IOSTATS.add(runs_reused=1)
+                    col_arrs.clear()
+                    col_items.clear()
+                    run = []
+                    return
             _IOSTATS.add(spill_runs=1)
             if col_items:
                 # fully-columnar run: ordering is ONE argsort over the
@@ -455,19 +499,19 @@ class SortNode(DIABase):
                 if rec_enc is not None:
                     writer.submit(_records_job(list(col_arrs),
                                                list(col_items),
-                                               col_pos0, slot),
+                                               col_pos0, slot, meta),
                                   tag=slot)
                 else:
                     writer.submit(_columnar_job(list(col_arrs),
                                                 list(col_items),
-                                                col_pos0, slot),
+                                                col_pos0, slot, meta),
                                   tag=slot)
                 col_arrs.clear()
                 col_items.clear()
             elif enc is not None:
-                writer.submit(_encoded_job(run, slot), tag=slot)
+                writer.submit(_encoded_job(run, slot, meta), tag=slot)
             else:
-                writer.submit(_generic_job(run, slot), tag=slot)
+                writer.submit(_generic_job(run, slot, meta), tag=slot)
             run = []
 
         def demote():
@@ -569,6 +613,11 @@ class SortNode(DIABase):
             # that policy (and the perf sentinel's prefetch counters) a
             # pure function of the program, not of writer-thread timing
             writer.flush()
+            if run_store is not None:
+                # every in-flight run commit joined too: after this
+                # barrier what is committed is committed, and the
+                # consuming merge below may release the pool blocks
+                run_store.drain()
             pool.flush()
             t_phase1 = _time.perf_counter()
 
@@ -628,6 +677,9 @@ class SortNode(DIABase):
                 # columnar blocks the native record format encoded (0 =
                 # every run spilled through the per-item pickle path)
                 "records_blocks": io_all.get("records_blocks", 0),
+                # committed runs reloaded from the run store instead of
+                # re-formed (core/em_runs.py; 0 without resume)
+                "runs_reused": io_all.get("runs_reused", 0),
                 "spill_s": round(t_phase1 - t_phase0, 3),
                 "merge_s": round(_time.perf_counter() - t_phase1, 3),
                 "overlap_frac": round(overlap_frac(io_all), 3),
@@ -647,6 +699,8 @@ class SortNode(DIABase):
                          wait_s=io_merge["io_wait_s"], depth=depth)
         finally:
             writer.close(drain=False)
+            if run_store is not None:
+                run_store.close()
             if ra is not None:
                 ra.shutdown(wait=True, cancel_futures=True)
             for f in files + key_files:

@@ -39,9 +39,15 @@ import threading
 #: sentinel's em_sort contract compares them exactly, so a silent
 #: fallback to the pickle spill path fails a counter diff instead of
 #: hiding in wall-clock noise (ISSUE 15).
+#: ``remote_gets`` / ``remote_puts`` (object-store requests issued by
+#: vfs/object_store) and ``runs_reused`` (spilled runs rebuilt from
+#: committed manifests instead of re-sorted, core/em_runs) are likewise
+#: exact for a fixed program — a silent fallback to whole-file reads or
+#: a broken run manifest fails a sentinel counter diff (ISSUE 17).
 _COUNTERS = ("prefetch_hits", "prefetch_misses", "io_wait_s",
              "io_busy_s", "writeback_bytes", "restore_overlaps",
-             "spill_runs", "prefetch_submits", "records_blocks")
+             "spill_runs", "prefetch_submits", "records_blocks",
+             "remote_gets", "remote_puts", "runs_reused")
 
 
 class IoStats:
@@ -57,6 +63,9 @@ class IoStats:
         self.spill_runs = 0
         self.prefetch_submits = 0
         self.records_blocks = 0
+        self.remote_gets = 0
+        self.remote_puts = 0
+        self.runs_reused = 0
 
     def add(self, **kv) -> None:
         with self._lock:
@@ -93,6 +102,8 @@ class IoStats:
             self.restore_overlaps = 0
             self.spill_runs = self.prefetch_submits = 0
             self.records_blocks = 0
+            self.remote_gets = self.remote_puts = 0
+            self.runs_reused = 0
 
 
 def overlap_frac(stats: dict) -> float:

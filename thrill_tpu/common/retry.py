@@ -80,6 +80,14 @@ class RetryPolicy:
             return faults.PERMANENT
         if isinstance(exc, faults.InjectedFault):
             return exc.kind          # injection declares its own class
+        # object-store responses carry their status (vfs/object_store):
+        # server-side failures and throttles are worth retrying, any
+        # other 4xx is a deterministic request error
+        status = getattr(exc, "http_status", None)
+        if status is not None:
+            return (faults.TRANSIENT
+                    if status >= 500 or status in (408, 429)
+                    else faults.PERMANENT)
         if isinstance(exc, tuple(self.transient)):
             return faults.TRANSIENT
         return faults.PERMANENT

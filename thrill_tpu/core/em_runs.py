@@ -1,0 +1,389 @@
+"""Resumable external-memory runs: the spilled run IS a checkpoint.
+
+The EM sort (api/ops/sort.py) forms sorted runs and spills them through
+the write-behind writer; until now a crash between run formation and
+the merge threw ALL of that work away — the relaunch re-sorted the
+world. This module makes each spilled run durable and reusable: the
+spill job serializes the run's blocks to
+``<ckpt_dir>/em_runs/<signature>/run_<slot>.bin`` and, only after those
+bytes are durably on storage, commits a CRC'd JSON manifest beside
+them via ``write_file_atomic`` — the same publish-then-commit protocol
+the epoch checkpoints use (api/checkpoint.py), so a SIGKILL at ANY
+point leaves either a committed, verifiable run or nothing visible.
+
+On relaunch with ``Config(resume=True)``, the sort re-streams its
+input (the scan and the reservoir sampler must see identical items for
+bit-identical splitters) but each run's expensive tail — argsort,
+serialize, disk write — is skipped when a committed run matches the
+identity check: same slot, same position range, same first-item
+fingerprint. Matches count ``runs_reused`` (common/iostats.py) and
+``resume_skipped_runs`` (the checkpoint manager's resume ledger);
+a missing manifest silently re-forms the run (normal — the crash beat
+the commit), while a CORRUPT or mismatching one is reported LOUDLY via
+``faults.note("recovery", ...)`` and the run re-forms from scratch —
+never wrong data, never a silent fallback.
+
+The run signature pins (node id, label, W, run_size, input size, host
+rank): node ids are deterministic per-Context counters, so a relaunch
+of the same program maps each Sort to the same store directory, and two
+different Sorts (different key functions) can never alias. Run
+BOUNDARIES must also line up — they do whenever ``run_size`` governs
+the cut; an RSS-pressure early spill (mem/manager.py) that fired in one
+launch but not the other shifts ``pos0`` and fails the identity check,
+degrading to a re-sort of that run (documented in ARCHITECTURE.md).
+
+All storage goes through the vfs seam (vfs/file_io.py), so run stores
+work unchanged over ``file://`` and remote object stores.
+``THRILL_TPU_EM_RESUME=0`` disables the store entirely (no writes, no
+reuse). Every public entry point is exception-safe: a store failure
+degrades to the non-resumable behavior, it never poisons the sort.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import struct
+import threading
+import zlib
+from typing import Optional, Tuple
+
+from ..common import faults
+
+# fault site: armed at manifest commit AND manifest load, so the chaos
+# matrix can prove both "crash before commit re-forms the run" and
+# "corrupt manifest re-forms LOUDLY" (tests/common/test_faults.py)
+_F_MANIFEST = faults.declare("em.run.manifest")
+
+_MAGIC = 0x454D5231  # "EMR1"
+
+
+def _enabled() -> bool:
+    return os.environ.get("THRILL_TPU_EM_RESUME", "1") != "0"
+
+
+def _is_remote(path: str) -> bool:
+    return "://" in path and not path.startswith("file://")
+
+
+def fingerprint(item) -> int:
+    """Cheap identity of a run: CRC of the FIRST item in arrival
+    order. Combined with (slot, pos0, n) this pins the run to its exact
+    position range of the exact input stream — a changed input or a
+    shifted run boundary cannot silently reuse stale bytes."""
+    try:
+        return zlib.crc32(pickle.dumps(item, protocol=4)) & 0xFFFFFFFF
+    except Exception:
+        return 0
+
+
+def store_for(ctx, node_id: int, label: str, W: int, run_size: int,
+              total: int) -> Optional["RunStore"]:
+    """The run store of one EM sort, or None when checkpointing is off
+    (``ctx.checkpoint is None``) or ``THRILL_TPU_EM_RESUME=0``."""
+    ckpt = getattr(ctx, "checkpoint", None)
+    if ckpt is None or not _enabled():
+        return None
+    try:
+        sig = (f"n{node_id}_{label.lower()}_w{W}_r{run_size}"
+               f"_t{total}_h{getattr(ctx, 'host_rank', 0)}")
+        base = os.path.join(ckpt.dir.rstrip("/"), "em_runs", sig)
+        return RunStore(base, mgr=ckpt)
+    except Exception as e:
+        faults.note("recovery", what="em_runs.store_unavailable",
+                    error=repr(e)[:200])
+        return None
+
+
+class RunStore:
+    """Commit/reload of one sort's spilled runs under one signature
+    directory. ``commit`` runs inside the write-behind spill job (the
+    run's blocks are resident right after the job wrote them);
+    ``try_load`` runs on the main thread inside ``spill()`` before the
+    job would be submitted."""
+
+    def __init__(self, base: str, mgr=None) -> None:
+        self.base = base
+        self.mgr = mgr          # CheckpointManager (resume ledger)
+        self.resume = bool(getattr(mgr, "resume", False))
+        # commit concurrency: commits of DIFFERENT runs are
+        # independent (only bin-before-manifest within one run is
+        # ordered), and against remote storage each one is
+        # latency-bound — serializing them behind the single
+        # write-behind thread would put 2 round trips per run on the
+        # spill critical path. A small pool overlaps them; the sync
+        # ladder (THRILL_TPU_WRITEBACK=0) keeps commits inline on the
+        # caller so the bench A/B measures exactly this machinery.
+        self._pool = None
+        self._pending: list = []
+        # resume-side warm state (one Glob + concurrent manifest
+        # fetches on first try_load; bins ride a bounded readahead
+        # window) — against remote storage the old 2-serial-GETs-per-
+        # run on the foreground thread cost MORE than re-forming runs
+        self._manfut: dict = {}             # manifest path -> Future
+        self._committed: Optional[set] = None   # slots seen in Glob
+        self._binfut: dict = {}             # bin path -> Future
+        self._warm_evt: Optional[threading.Event] = None
+        if not _is_remote(base):
+            os.makedirs(base, exist_ok=True)
+        if self.resume:
+            # warm from CONSTRUCTION, not first try_load: the sort
+            # re-streams its whole input before it cuts the first run,
+            # so the LIST + manifest GETs (and the first bin window)
+            # finish behind that scan instead of on the reuse path
+            self._warm_evt = threading.Event()
+            threading.Thread(target=self._warm_bg, daemon=True,
+                             name="thrill-tpu-em-warm").start()
+
+    def _commit_async(self) -> bool:
+        from ..data.writeback import writeback_enabled
+        return writeback_enabled()
+
+    def _conc(self) -> int:
+        try:
+            return max(1, int(os.environ.get(
+                "THRILL_TPU_EM_COMMIT_CONC", "4") or 4))
+        except ValueError:
+            return 4
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._conc(),
+                thread_name_prefix="thrill-tpu-em-commit")
+        return self._pool
+
+    @staticmethod
+    def _read_path(path: str) -> bytes:
+        from ..vfs.file_io import OpenReadStream
+        with OpenReadStream(path) as r:
+            return r.read()
+
+    def _paths(self, slot: int) -> Tuple[str, str]:
+        return (os.path.join(self.base, f"run_{slot:06d}.bin"),
+                os.path.join(self.base, f"run_{slot:06d}.json"))
+
+    # -- serialization ---------------------------------------------------
+    @staticmethod
+    def _pack_file(f) -> bytes:
+        """Blocks of one File as length-prefixed payload records.
+        Layout: [u32 nblocks] then per block [u32 lo][u32 hi]
+        [u64 len][payload] — lo/hi preserved so sliced views (never
+        produced by the spill jobs today, but cheap to carry) rebuild
+        exactly."""
+        parts = [struct.pack("<I", len(f.blocks))]
+        for b in f.blocks:
+            payload = f.pool.get(b.bid)
+            parts.append(struct.pack("<IIQ", b.lo, b.hi, len(payload)))
+            parts.append(payload)
+        return b"".join(parts)
+
+    @staticmethod
+    def _unpack_file(body: bytes, off: int, pool, block_items: int):
+        from ..data.file import File
+        from ..data.block import Block
+        (nblocks,) = struct.unpack_from("<I", body, off)
+        off += 4
+        f = File(pool=pool, block_items=block_items)
+        for _ in range(nblocks):
+            lo, hi, plen = struct.unpack_from("<IIQ", body, off)
+            off += 16
+            payload = body[off:off + plen]
+            if len(payload) != plen:
+                raise ValueError("truncated run payload")
+            off += plen
+            bid = pool.put(payload)
+            f.blocks.append(Block(pool, bid, lo, hi))
+        return f, off
+
+    # -- commit ----------------------------------------------------------
+    def commit(self, slot: int, pos0: int, n: int, fp: int,
+               f, kf=None) -> bool:
+        """Persist one spilled run. Called from the spill job AFTER
+        ``files[slot]``/``key_files[slot]`` are set (blocks durable in
+        the pool). Exception-safe: a failed commit is noted and the run
+        simply stays non-resumable."""
+        from ..vfs.file_io import write_file_atomic
+        bin_path, man_path = self._paths(slot)
+        try:
+            body = struct.pack("<I", _MAGIC) + self._pack_file(f)
+            has_keys = kf is not None and kf.blocks
+            body += self._pack_file(kf) if has_keys \
+                else struct.pack("<I", 0)
+            # bin first, manifest only after the bytes are durable —
+            # the manifest's existence IS the commit record
+            write_file_atomic(bin_path, body)
+            faults.check(_F_MANIFEST, path=man_path, op="commit")
+            manifest = {"slot": slot, "pos0": pos0, "n": n, "fp": fp,
+                        "crc": zlib.crc32(body) & 0xFFFFFFFF,
+                        "bin_bytes": len(body),
+                        "has_keys": bool(has_keys)}
+            write_file_atomic(
+                man_path, json.dumps(manifest).encode("ascii"))
+            if self._committed is not None:
+                self._committed.add(slot)   # keep the warm listing's
+                                            # negative cache truthful
+            return True
+        except Exception as e:
+            faults.note("recovery", what="em_runs.commit_failed",
+                        slot=slot, error=repr(e)[:200])
+            return False
+
+    def submit_commit(self, slot: int, pos0: int, n: int, fp: int,
+                      f, kf=None) -> None:
+        """Commit, concurrently when the overlap tier is on. The spill
+        job calls this after ``files[slot]`` is set; the blocks are
+        immutable from then on, so packing them on a pool thread races
+        nothing. ``drain()`` joins every pending commit at the sort's
+        pre-merge barrier. Exception-safe like ``commit``."""
+        if not self._commit_async():
+            self.commit(slot, pos0, n, fp, f, kf)
+            return
+        try:
+            self._pending.append(self._ensure_pool().submit(
+                self.commit, slot, pos0, n, fp, f, kf))
+        except Exception as e:
+            faults.note("recovery", what="em_runs.commit_failed",
+                        slot=slot, error=repr(e)[:200])
+
+    def drain(self) -> None:
+        """Join every in-flight commit (the sort's pre-merge barrier —
+        after this, what is committed is committed and the merge may
+        consume the pool blocks). Never raises: ``commit`` degrades
+        internally."""
+        pending, self._pending = self._pending, []
+        for fut in pending:
+            try:
+                fut.result()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self.drain()
+        self._binfut.clear()
+        self._manfut.clear()
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    # -- reuse -----------------------------------------------------------
+    def _warm_bg(self) -> None:
+        try:
+            self._warm()
+            if self._committed:
+                self._prefetch_bins(min(self._committed))
+        except Exception as e:
+            faults.note("recovery", what="em_runs.warm_failed",
+                        error=repr(e)[:200])
+        finally:
+            self._warm_evt.set()
+
+    def _warm(self) -> None:
+        """One Glob, then a manifest fetch IN FLIGHT for every
+        committed run. Against remote storage the per-slot probe
+        pattern (manifest GET, then bin GET, serial, on the foreground
+        thread) costs two round trips per run — at 20 ms each that
+        made resume SLOWER than re-forming the runs. Only futures are
+        installed here (the warm event sets right after), so the first
+        ``try_load`` blocks on ITS slot's manifest alone, not on the
+        whole gather; a slot absent from the listing returns None with
+        zero requests."""
+        try:
+            from ..vfs.file_io import Glob
+            fl = Glob(os.path.join(self.base, "run_*.json"))
+            listed = [fi.path for fi in fl.files]
+        except Exception as e:
+            faults.note("recovery", what="em_runs.warm_failed",
+                        error=repr(e)[:200])
+            return                # fall back to per-slot direct reads
+        committed = set()
+        for p in listed:
+            stem = os.path.basename(p)
+            try:
+                committed.add(int(stem[len("run_"):-len(".json")]))
+            except ValueError:
+                pass
+        ex = self._ensure_pool()
+        self._manfut = {p: ex.submit(self._read_path, p)
+                        for p in listed}
+        self._committed = committed
+
+    def _prefetch_bins(self, slot: int) -> None:
+        """Keep the bins of the next few committed slots in flight —
+        the merge consumes runs in slot order, so by the time
+        ``try_load(slot)`` validates its manifest the bin bytes are
+        usually already here. Window = pool width, so at most that
+        many bins are buffered (popped as consumed)."""
+        if self._committed is None:
+            return
+        ex = self._ensure_pool()
+        for s in range(slot, slot + self._conc()):
+            if s in self._committed:
+                bp = self._paths(s)[0]
+                if bp not in self._binfut:
+                    self._binfut[bp] = ex.submit(self._read_path, bp)
+
+    def try_load(self, slot: int, pos0: int, n: int, fp: int, pool,
+                 block_items: int):
+        """(item_file, key_file_or_None) of a committed matching run,
+        or None. A missing manifest is silent (the run was never
+        committed); a corrupt/mismatching one is LOUD — the caller
+        re-forms the run either way, so the only cost of corruption is
+        the re-sort, never wrong data."""
+        if not self.resume:
+            return None
+        if self._warm_evt is not None:
+            self._warm_evt.wait()
+        bin_path, man_path = self._paths(slot)
+        try:
+            raw = None
+            if self._committed is not None:
+                if slot not in self._committed:
+                    return None       # never committed: zero requests
+                fut = self._manfut.pop(man_path, None)
+                if fut is not None:
+                    try:
+                        raw = fut.result()
+                    except Exception:
+                        raw = None    # direct read decides loud/silent
+            if raw is None:
+                try:
+                    raw = self._read_path(man_path)
+                except FileNotFoundError:
+                    return None           # never committed: normal
+            faults.check(_F_MANIFEST, path=man_path, op="load")
+            man = json.loads(raw.decode("ascii"))
+            if (man.get("slot") != slot or man.get("pos0") != pos0
+                    or man.get("n") != n or man.get("fp") != fp):
+                raise ValueError(
+                    f"run identity mismatch: manifest "
+                    f"{({k: man.get(k) for k in ('slot', 'pos0', 'n', 'fp')})} "
+                    f"!= live (slot={slot}, pos0={pos0}, n={n}, fp={fp})")
+            self._prefetch_bins(slot)
+            fut = self._binfut.pop(bin_path, None)
+            body = fut.result() if fut is not None \
+                else self._read_path(bin_path)
+            if len(body) != man["bin_bytes"] or \
+                    (zlib.crc32(body) & 0xFFFFFFFF) != man["crc"]:
+                raise ValueError("run bin CRC/length mismatch")
+            (magic,) = struct.unpack_from("<I", body, 0)
+            if magic != _MAGIC:
+                raise ValueError(f"bad run magic {magic:#x}")
+            f, off = self._unpack_file(body, 4, pool, block_items)
+            kf = None
+            if man["has_keys"]:
+                kf, off = self._unpack_file(body, off, pool,
+                                            block_items)
+            if self.mgr is not None:
+                self.mgr.resume_skipped_runs += 1
+            return f, kf
+        except FileNotFoundError:
+            return None
+        except Exception as e:
+            # LOUD: corruption/mismatch re-forms the run from scratch
+            faults.note("recovery", what="em_runs.manifest_invalid",
+                        slot=slot, path=man_path,
+                        error=repr(e)[:200])
+            return None

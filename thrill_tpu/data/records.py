@@ -106,6 +106,17 @@ def template_of(item: Any):
     t = type(item)
     if t in _LEAF_TYPES:
         return "x"
+    if t is np.ndarray:
+        # fixed-shape, fixed-dtype ndarray leaf (ISSUE 17): one
+        # ``("A", dstr, shape)`` column of V rows. The probe pins the
+        # EXACT dtype.str and shape — any batch member deviating
+        # (ragged shapes, upcast dtypes, 0-d, empty, object dtype)
+        # makes the encoder return None and that batch pickles.
+        if (item.ndim >= 1 and item.size > 0
+                and item.dtype.kind in "biufcSU"
+                and item.dtype.itemsize > 0):
+            return ("A", item.dtype.str, item.shape)
+        return None
     if t is tuple and item:
         subs = tuple(template_of(e) for e in item)
         if any(s is None for s in subs):
@@ -114,9 +125,13 @@ def template_of(item: Any):
     return None
 
 
+def _is_leaf(tmpl) -> bool:
+    return tmpl in ("x", "s") or tmpl[0] == "A"
+
+
 def _leaf_values(tmpl, items: List[Any], out: List[list]) -> None:
     """Transpose items into per-leaf value lists (template order)."""
-    if tmpl == "x":
+    if _is_leaf(tmpl):
         out.append(items)
         return
     # every row must be a tuple of EXACTLY the probed arity (both
@@ -127,6 +142,15 @@ def _leaf_values(tmpl, items: List[Any], out: List[list]) -> None:
         raise TypeError("tuple shape deviates from the probed schema")
     for sub, vals in zip(tmpl[1:], zip(*items)):
         _leaf_values(sub, list(vals), out)
+
+
+def _leaf_templates(tmpl, out: List[Any]) -> None:
+    """Flatten a probe template into its leaves, in column order."""
+    if _is_leaf(tmpl):
+        out.append(tmpl)
+        return
+    for sub in tmpl[1:]:
+        _leaf_templates(sub, out)
 
 
 def _encode_leaf(vals: list
@@ -172,10 +196,33 @@ def _encode_leaf(vals: list
     return None
 
 
+def _encode_array_leaf(vals: list, tmpl
+                       ) -> Optional[Tuple[Any, np.ndarray]]:
+    """One ndarray-leaf column: the (N, *shape) stack's bytes as a 1D
+    ``|V{row_bytes}`` array (itemsize == one element's bytes), so the
+    downstream byte machinery — run-block gather, slice arithmetic,
+    native widths — treats it exactly like any other fixed-width
+    column. None when any value deviates from the probed dtype/shape
+    (ragged batches pickle, never lie)."""
+    _, dstr, shape = tmpl
+    shape = tuple(shape)
+    for v in vals:
+        if type(v) is not np.ndarray or v.dtype.str != dstr \
+                or v.shape != shape:
+            return None
+    n = len(vals)
+    stacked = np.ascontiguousarray(np.stack(vals))
+    rb = stacked.dtype.itemsize * int(
+        np.prod(shape, dtype=np.int64))
+    col = stacked.reshape(n, -1).view(f"V{rb}").reshape(n)
+    return tmpl, col
+
+
 def _retag(tmpl, tags) -> Any:
-    """Template with each scalar leaf replaced by its encode-time tag
-    (``tags`` iterates in leaf order)."""
-    if tmpl == "x":
+    """Template with each leaf replaced by its encode-time tag
+    (``tags`` iterates in leaf order; ndarray leaves tag as their full
+    ``("A", ...)`` template)."""
+    if _is_leaf(tmpl):
         return next(tags)
     return ("T",) + tuple(_retag(s, tags) for s in tmpl[1:])
 
@@ -186,10 +233,13 @@ def _encode_columns(tmpl, items: List[Any]
     the fallback)."""
     leaves: List[list] = []
     _leaf_values(tmpl, items, leaves)
+    ltmpls: List[Any] = []
+    _leaf_templates(tmpl, ltmpls)
     cols: List[np.ndarray] = []
-    tags: List[str] = []
-    for vals in leaves:
-        enc = _encode_leaf(vals)
+    tags: List[Any] = []
+    for lt, vals in zip(ltmpls, leaves):
+        enc = _encode_array_leaf(vals, lt) if lt != "x" \
+            else _encode_leaf(vals)
         if enc is None:
             return None
         tags.append(enc[0])

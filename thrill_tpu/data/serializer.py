@@ -33,7 +33,14 @@ float64->float, U->str, S->bytes, so item types never depend on which
 engine materialized them); ``"s"`` is a str leaf COMPACTED to an S
 (1 byte/char) column — ASCII only, chosen at encode time so spilled
 strings do not pay UCS-4's 4x on disk, decoded back by one vectorized
-``S->U`` cast; ``("T", sub, ...)`` is a tuple of sub-templates.
+``S->U`` cast; ``("T", sub, ...)`` is a tuple of sub-templates; ``("A", dstr, shape)``
+is a fixed-shape, fixed-dtype ndarray leaf (ISSUE 17) stored as ONE
+column of ``|V{row_bytes}`` rows — the (N, *shape) stack's bytes laid
+out row-major, so the byte arithmetic (slices, native gather, run
+spills) that works for scalar columns works unchanged, and decode is
+one zero-copy dtype view + reshape per column. Ragged or
+dtype-deviating batches fall back to pickle per batch (the probe
+template pins the exact ``dtype.str`` and shape).
 """
 
 from __future__ import annotations
@@ -73,8 +80,8 @@ def serialize_batch(items: List[Any]) -> bytes:
 # ----------------------------------------------------------------------
 
 def leaf_count(tmpl) -> int:
-    """Columns a template consumes (one per scalar leaf)."""
-    if tmpl in ("x", "s"):
+    """Columns a template consumes (one per scalar or ndarray leaf)."""
+    if tmpl in ("x", "s") or tmpl[0] == "A":
         return 1
     return sum(leaf_count(s) for s in tmpl[1:])
 
@@ -124,6 +131,16 @@ def _build_items(tmpl, cols: List[np.ndarray]) -> List[Any]:
         if t == "s":   # ASCII-compacted str: one vectorized S->U cast
             col = next(it)
             return col.astype(f"U{col.dtype.itemsize}").tolist()
+        if t[0] == "A":
+            # ndarray leaf: the V rows view back to the element dtype
+            # (one zero-copy reinterpret + reshape for the whole
+            # column); like _RAW, items are read-only views into the
+            # block's buffer
+            _, dstr, shape = t
+            col = next(it)
+            arr = col.view(np.dtype(dstr)).reshape(
+                (len(col),) + tuple(shape))
+            return list(arr)
         parts = [build(s) for s in t[1:]]
         return list(zip(*parts))
 
@@ -132,8 +149,8 @@ def _build_items(tmpl, cols: List[np.ndarray]) -> List[Any]:
 
 def _sub_template(tmpl, project: int):
     """(sub_template, column_indices) of tuple element ``project``."""
-    assert tmpl not in ("x", "s") and len(tmpl) > project + 1, \
-        (tmpl, project)
+    assert tmpl not in ("x", "s") and tmpl[0] == "T" \
+        and len(tmpl) > project + 1, (tmpl, project)
     skip = sum(leaf_count(s) for s in tmpl[1:1 + project])
     sub = tmpl[1 + project]
     return sub, range(skip, skip + leaf_count(sub))

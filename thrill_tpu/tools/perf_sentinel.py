@@ -68,6 +68,15 @@ COUNTERS = (
     # serve workload's job count; the batch workloads report 0.
     "jobs_submitted", "jobs_failed", "jobs_rejected",
     "resizes", "resize_time_ms",
+    # remote object store + resumable runs (ISSUE 17): the batch and
+    # em workloads above must report EXACTLY zero — the HTTP transport
+    # and the run store cost nothing when unused. The em_remote
+    # workload pins the transport's request economy (a lost Range
+    # header or a dropped reader reopen moves remote_gets; a per-part
+    # PUT regression moves remote_puts); em_resume pins the
+    # merge-only-restart contract (every committed run reused, zero
+    # new spills on the resume leg).
+    "remote_gets", "remote_puts", "runs_reused",
 )
 
 #: byte totals compared ratio-banded (pow2 capacity ratchets may move
@@ -210,6 +219,61 @@ def _em_sort(ctx):
     assert sum(len(lst) for lst in hs.lists) == len(items)
 
 
+def _em_remote(ctx):
+    """Remote storage lane (ISSUE 17): ReadLines -> Sort ->
+    WriteLinesOne entirely against the in-repo object server at ZERO
+    latency and ZERO failure rate — retries and reopens would make the
+    request counts timing-dependent, so the sentinel measures the
+    fault-free request economy (the chaos sweep owns the faulted
+    paths). remote_gets / remote_puts are this workload's contract: a
+    transport that silently stops ranging, re-lists, or splits PUTs
+    moves them."""
+    from .object_server import ObjectServer
+    rng = np.random.default_rng(29)
+    lines = sorted(f"r-{int(v):09d}" for v in
+                   rng.integers(0, 1 << 30, size=512))
+    with ObjectServer() as srv:
+        srv.put("b/in-00.txt",
+                "\n".join(lines[0::2]).encode() + b"\n")
+        srv.put("b/in-01.txt",
+                "\n".join(lines[1::2]).encode() + b"\n")
+        d = ctx.ReadLines(f"{srv.url}/b/in-*").Sort()
+        d.WriteLinesOne(f"{srv.url}/b/out.txt")
+        got = ctx.ReadLines(f"{srv.url}/b/out.txt").AllGather()
+    assert got == lines, "em_remote: remote roundtrip diverged"
+
+
+def _er_key(t):
+    return t[0]
+
+
+def _em_resume(ctx):
+    """Resumable external runs (ISSUE 17): an EM sort with
+    checkpointing on forms + commits its spilled runs, then the SAME
+    program relaunches with resume — the second leg must reuse every
+    committed run (runs_reused == the first leg's spill count) and
+    form ZERO new ones. Both legs run as nested local mocks inside
+    the sentinel's outer context: iostats is process-global and the
+    outer context reports the delta, so the pair lands in one row."""
+    import tempfile
+    from ..api.context import RunLocalMock
+    from ..common.config import Config
+    n = 1600
+    data = [(f"k{(i * 7919) % n:05d}", float(i)) for i in range(n)]
+
+    def job(c):
+        return c.Distribute(data, storage="host").Sort(
+            key_fn=_er_key).AllGather()
+
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "ck")
+        first = RunLocalMock(job, 2, config=Config(ckpt_dir=ck))
+        again = RunLocalMock(job, 2,
+                             config=Config(ckpt_dir=ck, resume=True))
+    assert first == again == sorted(data, key=_er_key), \
+        "em_resume: resumed sort diverged"
+
+
 def _serve_wc(ctx):
     return sorted(
         (int(k), int(v)) for k, v in ctx.Distribute(
@@ -245,6 +309,8 @@ WORKLOADS: Dict[str, Callable] = {
     "join": _joinish,
     "chain": _chain,
     "em_sort": _em_sort,
+    "em_remote": _em_remote,
+    "em_resume": _em_resume,
     "serve": _serve,
 }
 
@@ -254,6 +320,12 @@ WORKLOADS: Dict[str, Callable] = {
 ENV_PINS: Dict[str, Dict[str, str]] = {
     "em_sort": {"THRILL_TPU_HOST_SORT_RUN": "256",
                 "THRILL_TPU_SPILL_RESIDENT": "64K"},
+    # the resume pair needs the SAME forced run size on both legs so
+    # run identities match; a fast retry base keeps the (fault-free)
+    # remote lane from sleeping if the rig's loopback hiccups
+    "em_resume": {"THRILL_TPU_HOST_SORT_RUN": "200",
+                  "THRILL_TPU_SPILL_RESIDENT": "64K"},
+    "em_remote": {"THRILL_TPU_RETRY_BASE_S": "0.01"},
 }
 
 

@@ -130,10 +130,19 @@ def Glob(path_or_glob: str) -> FileList:
                                   p.endswith(COMPRESSED_SUFFIXES)))
             psum += sz
         return FileList(files)
+    if scheme in ("http", "https"):
+        from . import object_store
+        files = []
+        psum = 0
+        for p, sz in object_store.http_glob(path_or_glob):
+            files.append(FileInfo(p, sz, psum,
+                                  p.endswith(COMPRESSED_SUFFIXES)))
+            psum += sz
+        return FileList(files)
     if scheme != "file":
         raise NotImplementedError(
-            f"vfs scheme '{scheme}' is not implemented; file://, s3:// "
-            f"and hdfs:// are")
+            f"vfs scheme '{scheme}' is not implemented; file://, s3://, "
+            f"hdfs:// and http(s):// are")
     pat = path_or_glob[len("file://"):] if path_or_glob.startswith("file://") \
         else path_or_glob
     if os.path.isdir(pat):
@@ -164,6 +173,12 @@ def _open_at(path: str, offset: int) -> IO[bytes]:
     if scheme == "hdfs":
         from . import hdfs_file
         return hdfs_file.hdfs_open_read(path, offset)
+    if scheme in ("http", "https"):
+        if path.endswith(COMPRESSED_SUFFIXES):
+            raise ValueError(
+                "compressed http objects are read whole-file")
+        from . import object_store
+        return object_store.http_open_read(path, offset)
     f = _open_filtered(path, "rb")
     if offset:
         if path.endswith(COMPRESSED_SUFFIXES):
@@ -277,6 +292,22 @@ class RetryingReader:
     def seek(self, pos: int, whence: int = os.SEEK_SET) -> int:
         if self._closed:
             raise ValueError("I/O operation on closed file")
+        if whence == os.SEEK_CUR:
+            pos, whence = self._pos + pos, os.SEEK_SET
+        if whence == os.SEEK_SET:
+            if pos == self._pos:
+                return pos                  # no-op probe, keep handle
+            if self._f is not None and self._f.seekable():
+                self._pos = self._f.seek(pos)
+            else:
+                # no live handle, or a ranged-transport stream (http)
+                # that cannot seek: reposition the tracker and drop —
+                # the next read opens a fresh stream at the target
+                # (for http, one ranged GET)
+                self._drop()
+                self._pos = pos
+            return self._pos
+        # size-relative (SEEK_END) needs a real handle
         if self._f is None:
             self._f = _open_at(self._path, self._pos)
         out = self._f.seek(pos, whence)
@@ -735,6 +766,9 @@ def OpenWriteStream(path: str) -> IO[bytes]:
     if _scheme(path) == "hdfs":
         from . import hdfs_file
         return hdfs_file.hdfs_open_write(path)
+    if _scheme(path) in ("http", "https"):
+        from . import object_store
+        return object_store.http_open_write(path)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

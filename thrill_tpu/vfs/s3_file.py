@@ -32,8 +32,24 @@ def _boto3():
         raise NotImplementedError(
             "vfs scheme 's3' needs the boto3 SDK, which is not "
             "installed in this image (no network to fetch it); install "
-            "boto3 and configure AWS credentials to enable s3:// paths"
+            "boto3 and configure AWS credentials, or point "
+            "THRILL_TPU_OBJECT_STORE_ENDPOINT at an S3-compatible "
+            "endpoint to use the SDK-free REST transport"
         ) from e
+
+
+def _rest():
+    """The SDK-free transport (vfs/object_store) — used when boto3 is
+    absent but ``THRILL_TPU_OBJECT_STORE_ENDPOINT`` names an
+    S3-compatible endpoint; None when boto3 is importable (the SDK
+    stays authoritative: it owns credentials, region signing, and the
+    non-path-style addressing modes)."""
+    try:
+        import boto3  # type: ignore # noqa: F401
+        return None
+    except ImportError:
+        from . import object_store
+        return object_store if object_store.endpoint() else None
 
 
 def parse_s3_path(path: str) -> Tuple[str, str]:
@@ -48,6 +64,13 @@ def parse_s3_path(path: str) -> Tuple[str, str]:
 def s3_glob(path_or_glob: str) -> List[Tuple[str, int]]:
     """List (s3://bucket/key, size) matching the path or '*'-suffix
     prefix glob, sorted by key (reference: S3 list in vfs::Glob)."""
+    rest = _rest()
+    if rest is not None:
+        out = [(f"s3://{url[len(rest.endpoint()) + 1:]}", sz)
+               for url, sz in rest.http_glob(
+                   rest.s3_rest_url(path_or_glob))]
+        out.sort()
+        return out
     boto3 = _boto3()
     bucket, key = parse_s3_path(path_or_glob)
     client = boto3.client("s3")
@@ -211,6 +234,9 @@ class _S3WriteStream(io.RawIOBase):
 
 
 def s3_open_read(path: str, offset: int = 0) -> IO[bytes]:
+    rest = _rest()
+    if rest is not None:
+        return rest.http_open_read(rest.s3_rest_url(path), offset)
     bucket, key = parse_s3_path(path)
     return io.BufferedReader(_S3ReadStream(bucket, key, offset))
 
@@ -231,5 +257,8 @@ class _AbortingWriter(io.BufferedWriter):
 
 
 def s3_open_write(path: str) -> IO[bytes]:
+    rest = _rest()
+    if rest is not None:
+        return rest.http_open_write(rest.s3_rest_url(path))
     bucket, key = parse_s3_path(path)
     return _AbortingWriter(_S3WriteStream(bucket, key))
