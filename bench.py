@@ -428,6 +428,11 @@ def _run_bench() -> None:
     # regressions as loud as the dispatch budgets
     sv = _serve_metric(ctx)
 
+    # external-traffic lane (ISSUE 18): real socket clients through
+    # the front door at ~2x overload — accept-to-result latency for
+    # served jobs plus the served-vs-rejected shed split
+    fdm = _front_door_metric(ctx)
+
     # elastic-mesh micro-lane (ISSUE 16): fenced W=2->3->2 resize cost
     # under a live job stream, in its own forced-multi-device process
     el = _elastic_metric()
@@ -435,7 +440,7 @@ def _run_bench() -> None:
     _emit(value=round(mrec_s, 3),
           vs_baseline=round(mrec_s / host_mrec_s, 3),
           **wc, **prm, **kmm, **sfm, **em, **emr, **ema, **ck,
-          **sv, **el)
+          **sv, **fdm, **el)
     ctx.close()
 
 
@@ -1189,6 +1194,136 @@ def _serve_metric(ctx) -> dict:
         }
     except Exception as e:  # secondary metric never kills the line
         return {"serve_error": repr(e)[:200]}
+
+
+def _front_door_metric(ctx) -> dict:
+    """External-traffic lane (ISSUE 18, service/front_door.py): N REAL
+    socket clients — the full admission protocol, auth flag, framing,
+    chunked result streaming — driving the same mixed WordCount/
+    PageRank tenants through a FrontDoor at ~2x overload. The
+    per-tenant token-bucket rate is set to HALF the capacity the
+    warmup measured, so the closed-loop clients (offering at about
+    capacity) run the shed path for real: the lane reports
+    accept-to-result p50/p99 for SERVED jobs and the served-vs-
+    rejected split — all of it also exported through the existing
+    Prometheus surface (fd_* counters and the serve latency
+    histograms ride overall_stats, common/metrics.py)."""
+    try:
+        import threading
+
+        from thrill_tpu.service.client import FrontDoorClient, Rejected
+        from thrill_tpu.service.front_door import FrontDoor
+        from thrill_tpu.service.scheduler import _parse_rates
+
+        _examples_path()
+        import page_rank as pr
+        doc_snap = _doctor_snapshot(getattr(ctx, "doctor", None))
+        edges = pr.zipf_graph(512, 1 << 12, seed=5)
+        data = np.arange(1 << 13, dtype=np.int64)
+        try:
+            clients = int(os.environ.get("THRILL_TPU_BENCH_FD_CLIENTS",
+                                         "") or 4)
+            per_client = int(os.environ.get("THRILL_TPU_BENCH_FD_JOBS",
+                                            "") or 6)
+        except ValueError:
+            clients, per_client = 4, 6
+
+        def wordcount_pipe(c, args):
+            c.Distribute(data).Map(_serve_kv).ReducePair(
+                _serve_add).Size()
+            return None
+
+        def pagerank_pipe(c, args):
+            return pr.page_rank(c, edges, 512, iterations=2)
+
+        fd = FrontDoor(ctx, port=0)
+        fd.register("wc", wordcount_pipe)
+        fd.register("pr", pagerank_pipe)
+        try:
+            # warmup over the socket (compiles out of the timed
+            # window) doubles as the capacity probe for the 2x
+            # overload point
+            t0 = time.perf_counter()
+            with FrontDoorClient("127.0.0.1", fd.port,
+                                 tenant="t0") as wcli:
+                wcli.submit("wc", None).result(600)
+                wcli.submit("pr", None).result(600)
+            cap_qps = 2.0 / max(time.perf_counter() - t0, 1e-3)
+            # per-tenant rate = capacity/(2*tenants): total admitted
+            # ~= capacity/2 while the clients offer ~capacity -> 2x.
+            # Closed-loop algebra: a reject is instant, a served job
+            # holds its client for ~1/capacity, so per tenant
+            # served ~= rate*wall + burst ~= served/2 + burst, i.e.
+            # served ~= 2*burst. burst = offered/(tenants*4) puts the
+            # split near half served / half shed.
+            burst = max(per_client * clients // 8, 1)
+            svc = ctx.service
+            prev_rates, prev_buckets = svc._rates, svc._buckets
+            svc._rates = _parse_rates(
+                f"default={max(cap_qps / 4.0, 0.1):.4f}:{burst}")
+            svc._buckets = {}
+
+            lat: list = []
+            rejected = [0]
+            errors: list = []
+            lock = threading.Lock()
+
+            def client(i: int):
+                try:
+                    with FrontDoorClient("127.0.0.1", fd.port,
+                                         tenant=f"t{i % 2}") as c:
+                        for j in range(per_client):
+                            name = "wc" if (i + j) % 2 == 0 else "pr"
+                            t1 = time.perf_counter()
+                            try:
+                                c.submit(name, None).result(600)
+                            except Rejected:
+                                with lock:
+                                    rejected[0] += 1
+                                continue
+                            with lock:
+                                lat.append(time.perf_counter() - t1)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(repr(e)[:200])
+
+            threads = [threading.Thread(target=client, args=(i,),
+                                        daemon=True)
+                       for i in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            svc._rates, svc._buckets = prev_rates, prev_buckets
+            if errors or not lat:
+                return {"fd_error": (errors
+                                     or ["no jobs served"])[0]}
+            lat.sort()
+            stats = ctx.overall_stats()
+            return {
+                "fd_qps": round(len(lat) / wall, 3),
+                "fd_p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+                "fd_p99_ms": round(
+                    lat[min(int(len(lat) * 0.99),
+                            len(lat) - 1)] * 1e3, 2),
+                # served-vs-rejected under ~2x overload: BOTH must be
+                # nonzero for the lane to have exercised shed-load
+                "fd_served": len(lat),
+                "fd_rejected": rejected[0],
+                "fd_conns": int(stats.get("fd_conns_accepted", 0)),
+                "fd_chunks": int(stats.get("fd_chunks_sent", 0)),
+                # 0 on a healthy lane: loopback clients drain fine
+                "fd_slow_clients": int(
+                    stats.get("fd_slow_clients", 0)),
+                **_doctor_fields(getattr(ctx, "doctor", None),
+                                 doc_snap, "fd"),
+            }
+        finally:
+            fd.close(drain=False)
+    except Exception as e:  # secondary metric never kills the line
+        return {"fd_error": repr(e)[:200]}
 
 
 _ELASTIC_CODE = r'''

@@ -216,9 +216,20 @@ class Dispatcher {
     auto pred = [&] { return done_.count(id) > 0; };
     if (timeout_s < 0) {
       cv_.wait(lk, pred);
-    } else if (!cv_.wait_for(
-                   lk, std::chrono::duration<double>(timeout_s), pred)) {
-      return 0;  // timeout, still pending
+    } else {
+      // wait_until(system_clock), not wait_for: wait_for waits on the
+      // steady clock, which libstdc++ lowers to pthread_cond_clockwait
+      // — a call this toolchain's libtsan does not intercept, so TSan
+      // loses track of the condvar's internal unlock/relock and
+      // reports a bogus "double lock of a mutex" on the next acquire.
+      // pthread_cond_timedwait (what system_clock waits use) is
+      // intercepted. A wall-clock step can stretch/shrink the timeout;
+      // completion wakeups are condvar-signaled either way.
+      auto deadline = std::chrono::system_clock::now() +
+                      std::chrono::microseconds(
+                          static_cast<int64_t>(timeout_s * 1e6));
+      if (!cv_.wait_until(lk, deadline, pred))
+        return 0;  // timeout, still pending
     }
     auto& d = done_[id];
     return d.status > 0 ? 1 : d.status;

@@ -78,8 +78,14 @@ if [[ "${CHAOS_SERVE:-0}" == "1" ]]; then
   # resolve its OWN future as a PipelineError while the queue drains
   # the rest exactly, and a corrupt/version-skewed plan store must
   # degrade loudly to recompile, never wrong results. N_SEEDS scales
-  # the sweep via THRILL_TPU_SERVE_SEEDS.
-  TARGETS+=(tests/service/test_service_chaos.py)
+  # the sweep via THRILL_TPU_SERVE_SEEDS. The network edge (ISSUE 18)
+  # rides along: tests/service/test_front_door.py's chaos-marked
+  # seeds arm the socket-edge sites (service.front_door.accept /
+  # .stream / .slow_client, net.tcp.client_disconnect) against real
+  # socket clients — every submit must resolve (result or typed
+  # rejection/error), the serving Context must outlive the storm.
+  TARGETS+=(tests/service/test_service_chaos.py
+            tests/service/test_front_door.py)
 fi
 
 # Flight-recorder archive: every injected abort in the sweep leaves a

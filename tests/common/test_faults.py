@@ -1103,6 +1103,16 @@ _NET_SITES = {
     "net.tcp.disconnect", "net.group.stale_frame",
 }
 
+# serving-edge sites (ISSUE 18): exercised against a live FrontDoor +
+# real socket clients in tests/service/test_front_door.py (accept-time
+# drop redialed, mid-stream fault -> typed error on a surviving conn,
+# client vanish mid-stream, forced slow-client shed) and swept by the
+# seeded edge chaos storms there.
+_EDGE_SITES = {
+    "service.front_door.accept", "service.front_door.stream",
+    "service.front_door.slow_client", "net.tcp.client_disconnect",
+}
+
 _MATRIX = {
     "api.mesh.dispatch": _ex_mesh_dispatch,
     # the fused per-op site family (api.fuse.<OpLabel>) shares one
@@ -1191,6 +1201,7 @@ def test_every_registered_site_is_covered():
     import thrill_tpu.net.dispatcher  # noqa: F401
     import thrill_tpu.net.tcp  # noqa: F401
     import thrill_tpu.parallel.mesh  # noqa: F401
+    import thrill_tpu.service.front_door  # noqa: F401
     import thrill_tpu.service.plan_store  # noqa: F401
     import thrill_tpu.service.scheduler  # noqa: F401
     import thrill_tpu.vfs.file_io  # noqa: F401
@@ -1199,7 +1210,7 @@ def test_every_registered_site_is_covered():
     import thrill_tpu.vfs.s3_file  # noqa: F401
     registered = {n for n in faults.REGISTRY.sites if not
                   n.startswith(("t.", "demo."))}      # test-local sites
-    covered = set(_MATRIX) | _NET_SITES
+    covered = set(_MATRIX) | _NET_SITES | _EDGE_SITES
     # pattern entries cover their whole dynamically-named family
     # (api.fuse.<OpLabel> sites materialize on first armed check)
     import fnmatch

@@ -208,3 +208,63 @@ def test_unconsumed_seeds_survive_a_save_cycle(tmp_path):
     ctx.close()
     _, stats = _run_ctx(cfg, runs=1)
     assert stats["plan_builds"] == 0
+
+
+# -- plan-seed symmetry attestation (ISSUE 18, planner edge (a)) ---------
+#
+# The optimistic exchange gate on a multi-controller mesh requires
+# every rank to hold the SAME plan state. In-process-learned state is
+# symmetric BY CONSTRUCTION (it derives from the replicated send
+# matrix under the lockstep submission contract), so the flag defaults
+# open; only a non-attested seed install (a per-rank store read) may
+# close it. The rank-0 broadcast path attests symmetric=True.
+
+class _SeedMex:
+    num_workers = 2
+    num_processes = 2
+
+
+def _seed_entries():
+    return {"caps": {"dg1": [8, 8]}, "plan": {"dg2": "dense"}}
+
+
+def test_default_symmetric_flag_is_open():
+    from thrill_tpu.data.exchange import install_plan_seeds
+    m = _SeedMex()
+    # no install at all: in-process-learned state needs no attestation
+    assert getattr(m, "_plan_seed_symmetric", True) is True
+    # an EMPTY install (nothing arrived) must not close the gate either
+    assert install_plan_seeds(m, {}, ("caps", "plan")) == 0
+    assert getattr(m, "_plan_seed_symmetric", True) is True
+
+
+def test_non_attested_install_closes_gate():
+    from thrill_tpu.data.exchange import install_plan_seeds
+    m = _SeedMex()
+    n = install_plan_seeds(m, _seed_entries(), ("caps", "plan"))
+    assert n == 2
+    assert m._plan_seed_symmetric is False
+
+
+def test_attested_broadcast_install_keeps_gate_open():
+    from thrill_tpu.data.exchange import install_plan_seeds
+    m = _SeedMex()
+    n = install_plan_seeds(m, _seed_entries(), ("caps", "plan"),
+                           symmetric=True)
+    assert n == 2
+    assert getattr(m, "_plan_seed_symmetric", True) is True
+
+
+def test_install_entries_threads_attestation(tmp_path):
+    """install_entries (the rank-0 broadcast entry point) passes the
+    attestation through every importer, width-filtered."""
+    from thrill_tpu.service.plan_store import install_entries
+    entries = {"caps": {"w2:dgA": [4, 4]}, "plan": {"w2:dgB": "dense"},
+               "ranges": {"w3:dgC": [[0, 1]]}}   # wrong width: dropped
+    m = _SeedMex()
+    n = install_entries(m, entries, symmetric=True)
+    assert n == 2
+    assert getattr(m, "_plan_seed_symmetric", True) is True
+    m2 = _SeedMex()
+    assert install_entries(m2, entries) == 2    # per-rank read path
+    assert m2._plan_seed_symmetric is False

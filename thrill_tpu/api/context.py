@@ -226,6 +226,14 @@ class Context:
         self._service_lock = threading.Lock()
         self._closed = False
         self.current_tenant: Optional[str] = None
+        # network front door (service/front_door.py): set when a
+        # FrontDoor binds to this Context — closed before the
+        # scheduler so no reader thread submits into a draining queue.
+        # THRILL_TPU_SERVE_PORT auto-starts one (mirror of the metrics
+        # endpoint above); loud degrade on bind failure, never fatal.
+        self.front_door = None
+        from ..service.front_door import maybe_start as _fd_start
+        _fd_start(self)
         # persistent plan store (service/plan_store.py): learned
         # exchange capacities / narrow specs / plan kinds / pre-shuffle
         # verdicts seed the fresh mesh, so a warm restart re-runs a
@@ -253,11 +261,14 @@ class Context:
                                                 logger=self.logger)
                     entries = self.plan_store.load()
                 entries = self.net.broadcast(entries, origin=0)
-                seeded = install_entries(self.mesh_exec, entries or {})
+                seeded = install_entries(self.mesh_exec, entries or {},
+                                         symmetric=True)
                 # every rank now provably holds identical seeds, and
                 # state learned from here derives from the replicated
-                # send matrix: the optimistic exchange path is safe on
-                # this mesh (data/exchange.py _optimistic_ok)
+                # send matrix: the optimistic exchange path stays open
+                # on this mesh (data/exchange.py _optimistic_ok —
+                # symmetric=True is the attestation; a storeless mesh
+                # is symmetric by default, planner edge (a))
                 self.mesh_exec._plan_seed_symmetric = True
                 if self.logger.enabled:
                     self.logger.line(event="plan_store_load",
@@ -787,7 +798,17 @@ class Context:
             # restart of a known pipeline reports plan_builds == 0
             **(self.service.stats() if self.service is not None else
                {"jobs_submitted": 0, "jobs_failed": 0,
-                "jobs_rejected": 0, "queue_depth_peak": 0}),
+                "jobs_rejected": 0, "jobs_rate_limited": 0,
+                "queue_depth_peak": 0}),
+            # front door (service/front_door.py): socket-edge counters
+            # when this Context serves external clients — all zero (and
+            # absent machinery) otherwise
+            **(self.front_door.stats()
+               if getattr(self, "front_door", None) is not None
+               else {"fd_conns_accepted": 0, "fd_conns_dropped": 0,
+                     "fd_jobs_submitted": 0, "fd_jobs_rejected": 0,
+                     "fd_chunks_sent": 0, "fd_slow_clients": 0,
+                     "fd_deadline_expired": 0}),
             "tenant_hbm_peaks": dict(self.hbm.tenant_peaks),
             "tenant_spills": self.hbm.tenant_spill_count,
             "plan_builds": mex.stats_plan_builds,
@@ -1200,6 +1221,18 @@ class Context:
         # replicated plan inputs, so one copy is the cluster's copy)
         with self._service_lock:
             self._closed = True
+        # front door before the scheduler: stop accepting sockets and
+        # flush streamed results while the dispatcher can still run
+        # the in-flight jobs those streams are waiting on
+        if self.front_door is not None:
+            try:
+                self.front_door.close()
+            except Exception as e:
+                from ..common import faults as _faults
+                _faults.note("recovery",
+                             what="front_door.close_failed",
+                             error=repr(e)[:200])
+            self.front_door = None
         if self.service is not None:
             try:
                 self.service.close()

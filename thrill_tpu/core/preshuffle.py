@@ -293,12 +293,14 @@ def export_plan_state(mex) -> dict:
     })
 
 
-def import_plan_state(mex, state: dict) -> int:
+def import_plan_state(mex, state: dict, *,
+                      symmetric: bool = False) -> int:
     """Install pre-shuffle seeds into the shared ``mex._plan_seed``
     table (consumed lazily by the lookup helpers above)."""
     from ..data.exchange import install_plan_seeds
     return install_plan_seeds(
-        mex, state, ("prune_decisions", "prune_history"))
+        mex, state, ("prune_decisions", "prune_history"),
+        symmetric=symmetric)
 
 
 def _pays(rows: int, item_bytes: int, W: int, sides: int, M: int,

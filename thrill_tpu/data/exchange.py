@@ -192,10 +192,22 @@ def merge_unconsumed_seeds(mex, out: dict) -> dict:
     return out
 
 
-def install_plan_seeds(mex, state: dict, kinds) -> int:
+def install_plan_seeds(mex, state: dict, kinds, *,
+                       symmetric: bool = False) -> int:
     """Merge digest maps for ``kinds`` into the shared lazy seed table
     (``mex._plan_seed``); returns how many entries arrived. Shared by
-    every plan-state importer."""
+    every plan-state importer.
+
+    ``symmetric`` is the caller's attestation that every rank of a
+    multi-controller mesh installs these EXACT entries (the rank-0
+    broadcast path, api/context.py). A non-attested install — e.g. a
+    per-rank store read — flips ``mex._plan_seed_symmetric`` off, and
+    with it the optimistic exchange gate (``_optimistic_ok``): seeds
+    of unknown provenance could differ across ranks, and per-process
+    optimism over divergent plans desyncs the collective schedule.
+    IN-PROCESS learned state needs no attestation: it derives from the
+    replicated send matrix under the lockstep submission contract, so
+    it is symmetric by construction (the flag's default)."""
     seeds = getattr(mex, "_plan_seed", None)
     if seeds is None:
         seeds = mex._plan_seed = {}
@@ -205,6 +217,8 @@ def install_plan_seeds(mex, state: dict, kinds) -> int:
         if isinstance(m, dict) and m:
             seeds.setdefault(kind, {}).update(m)
             n += len(m)
+    if n and not symmetric:
+        mex._plan_seed_symmetric = False
     return n
 
 
@@ -235,10 +249,12 @@ def export_plan_state(mex: MeshExec) -> dict:
     })
 
 
-def import_plan_state(mex: MeshExec, state: dict) -> int:
+def import_plan_state(mex: MeshExec, state: dict, *,
+                      symmetric: bool = False) -> int:
     """Install exchange plan-state seeds (digest maps, as produced by
     :func:`export_plan_state`); returns how many entries arrived."""
-    return install_plan_seeds(mex, state, ("caps", "plan", "ranges"))
+    return install_plan_seeds(mex, state, ("caps", "plan", "ranges"),
+                              symmetric=symmetric)
 
 
 def _seeded_caps(mex: MeshExec, ident: Tuple) -> Optional[Tuple[int, ...]]:
@@ -1019,17 +1035,21 @@ def _optimistic_ok(mex: MeshExec, cap_ident: Tuple, min_cap: int,
     if mex.loop_recorder is not None:
         return None
     if getattr(mex, "num_processes", 1) > 1 \
-            and not getattr(mex, "_plan_seed_symmetric", False):
+            and not getattr(mex, "_plan_seed_symmetric", True):
         # per-process optimism on a multi-controller mesh is safe only
-        # when every rank provably holds the SAME plan state — true
-        # once the rank-0 store broadcast installed identical seeds
-        # (api/context.py sets _plan_seed_symmetric; in-process state
-        # learned after that derives from the replicated send matrix,
-        # so it stays symmetric). The deferred heal is then lockstep:
-        # the overflow flag is a function of the replicated send
-        # matrix alone (narrow-range verdicts are pmax'd), and checks
-        # drain at the same program points on every controller.
-        # Without that guarantee, keep the synced plan every time.
+        # when every rank provably holds the SAME plan state. That is
+        # the DEFAULT: in-process-learned state derives from the
+        # replicated send matrix under the lockstep submission
+        # contract, so a storeless steady-state service overlaps its
+        # exchanges too (planner edge (a), ISSUE 18). The deferred
+        # heal is then lockstep: the overflow flag is a function of
+        # the replicated send matrix alone (narrow-range verdicts are
+        # pmax'd), and checks drain at the same program points on
+        # every controller. The flag only goes FALSE when seeds of
+        # unknown provenance were installed (a per-rank store read —
+        # install_plan_seeds without the symmetric attestation); the
+        # rank-0 broadcast path re-attests it True. Without the
+        # guarantee, keep the synced plan every time.
         return None
     if resolve_mode(mex) != "dense":
         return None

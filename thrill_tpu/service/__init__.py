@@ -24,8 +24,20 @@ package turns that healed Context into a query service:
   plan kinds, pre-shuffle verdicts), so a warm restart re-runs a
   known pipeline with ``plan_builds == 0`` — no data-driven host plan
   syncs at all.
+* :mod:`.front_door` / :mod:`.client` — the NETWORK edge (ISSUE 18):
+  a TCP admission protocol over the PR-8 authenticated transport with
+  per-tenant token-bucket rate limits and bounded queues ahead of the
+  scheduler, typed shed-load rejections carrying retry-after hints,
+  chunked result streaming as job egress drains, read/write deadlines
+  on every client socket (slow-loris and half-open clients are
+  dropped, never waited on), and graceful SIGTERM drain. The client
+  library retries sheds with ``max(server hint, full jitter)``.
 """
 
-from .scheduler import JobFuture, Scheduler  # noqa: F401
+from .scheduler import (JobFuture, QueueFull, RateLimited,  # noqa: F401
+                        Scheduler, ShedLoad, TenantQueueFull)
 from .tenancy import activate, configure, set_budget  # noqa: F401
 from .plan_store import PlanStore  # noqa: F401
+from .front_door import FrontDoor  # noqa: F401
+from .client import (FrontDoorClient, Rejected,  # noqa: F401
+                     RemoteJob, RemoteJobError)

@@ -87,24 +87,34 @@ def _for_width(entries: dict, w: int) -> dict:
             for kind, m in entries.items() if isinstance(m, dict)}
 
 
-def install_entries(mex, entries: dict) -> int:
+def install_entries(mex, entries: dict, *,
+                    symmetric: bool = False) -> int:
     """Install loaded store entries into a MeshExec's lazy seed
     tables; returns how many arrived. Shared by :meth:`PlanStore.attach`
     (this process read the file) and the Context's multi-process path
     (rank 0 read it and BROADCAST the entries over the host control
     plane, so every rank installs the identical seeds —
-    api/context.py). Filters to the mesh's CURRENT width (keys are
-    ``w{W}:``-prefixed on disk — see the module docstring)."""
+    api/context.py; that caller passes ``symmetric=True``, the
+    attestation that keeps the optimistic exchange gate open on
+    multi-controller meshes — data/exchange.py install_plan_seeds).
+    Filters to the mesh's CURRENT width (keys are ``w{W}:``-prefixed
+    on disk — see the module docstring)."""
     from ..api import loop
     from ..core import preshuffle
     from ..data import exchange
     entries = _for_width(entries, mex.num_workers)
-    n = exchange.import_plan_state(mex, entries)
-    n += preshuffle.import_plan_state(mex, entries)
-    n += loop.import_plan_state(mex, entries)
+    n = exchange.import_plan_state(mex, entries, symmetric=symmetric)
+    n += preshuffle.import_plan_state(mex, entries,
+                                      symmetric=symmetric)
+    n += loop.import_plan_state(mex, entries, symmetric=symmetric)
     ob = entries.get("out_bytes")
     if isinstance(ob, dict) and hasattr(mex, "import_learned_sizes"):
-        n += mex.import_learned_sizes(ob)
+        n_ob = mex.import_learned_sizes(ob)
+        if n_ob and not symmetric:
+            # learned sizes ride the same provenance rule as the seed
+            # table: a non-attested install closes the optimism gate
+            mex._plan_seed_symmetric = False
+        n += n_ob
     return n
 
 

@@ -49,7 +49,27 @@ from ..common import faults
 _F_SUBMIT = faults.declare("service.submit")
 
 
-class QueueFull(RuntimeError):
+class ShedLoad(RuntimeError):
+    """Base of every typed admission rejection — a shed job's future
+    (and the front door's reject frame) always carries one of these,
+    never a silent drop. ``kind`` is the rejection taxonomy label
+    (ARCHITECTURE.md "Front door & overload control"); ``retry_after_s``
+    is the server's backoff hint — the earliest moment a retry could
+    plausibly be admitted (queue drain estimate for depth sheds, token
+    refill time for rate sheds). Clients honoring it
+    (service/client.py submit_retry) turn an overload spike into a
+    delayed success instead of a retry storm."""
+
+    kind = "shed"
+
+    def __init__(self, msg: str, tenant: str,
+                 retry_after_s: float = 0.0) -> None:
+        super().__init__(msg)
+        self.tenant = tenant
+        self.retry_after_s = max(float(retry_after_s), 0.0)
+
+
+class QueueFull(ShedLoad):
     """submit() shed this job: the admission queue sits at its
     THRILL_TPU_SERVE_QUEUE depth cap. The rejection is IMMEDIATE and
     per-job — the returned future is born resolved with this error,
@@ -58,20 +78,59 @@ class QueueFull(RuntimeError):
     client's backpressure loop can tell "my tenant is flooding" from
     "the service is drowning"."""
 
-    def __init__(self, tenant: str, depth: int, cap: int) -> None:
+    kind = "queue_full"
+
+    def __init__(self, tenant: str, depth: int, cap: int,
+                 retry_after_s: float = 0.0) -> None:
         super().__init__(
             f"admission queue full: depth {depth} >= cap {cap} "
-            f"(THRILL_TPU_SERVE_QUEUE); job for tenant {tenant!r} shed")
-        self.tenant = tenant
+            f"(THRILL_TPU_SERVE_QUEUE); job for tenant {tenant!r} shed",
+            tenant, retry_after_s)
         self.depth = depth
         self.cap = cap
 
 
-def _queue_cap() -> int:
-    """THRILL_TPU_SERVE_QUEUE admission depth cap; 0 = unbounded
-    (the default). Malformed values are skipped loudly — a typo must
-    not silently shed traffic."""
-    v = os.environ.get("THRILL_TPU_SERVE_QUEUE", "")
+class TenantQueueFull(ShedLoad):
+    """submit() shed this job: THIS tenant's queue sits at its
+    THRILL_TPU_SERVE_TENANT_QUEUE depth cap. Per-tenant bounding is
+    the isolation half of backpressure: one flooding tenant fills its
+    own queue and sheds, while every other tenant keeps its full
+    admission depth."""
+
+    kind = "tenant_queue_full"
+
+    def __init__(self, tenant: str, depth: int, cap: int,
+                 retry_after_s: float = 0.0) -> None:
+        super().__init__(
+            f"tenant queue full: tenant {tenant!r} at depth {depth} "
+            f">= cap {cap} (THRILL_TPU_SERVE_TENANT_QUEUE); job shed",
+            tenant, retry_after_s)
+        self.depth = depth
+        self.cap = cap
+
+
+class RateLimited(ShedLoad):
+    """submit() shed this job: the tenant's token bucket
+    (THRILL_TPU_SERVE_RATE) is empty. ``retry_after_s`` is the exact
+    refill time of the next token — the one rejection whose hint is a
+    guarantee, not an estimate."""
+
+    kind = "rate_limited"
+
+    def __init__(self, tenant: str, rate: float,
+                 retry_after_s: float) -> None:
+        super().__init__(
+            f"rate limited: tenant {tenant!r} over {rate:g} jobs/s "
+            f"(THRILL_TPU_SERVE_RATE); retry after "
+            f"{retry_after_s:.3f}s", tenant, retry_after_s)
+        self.rate = rate
+
+
+def _queue_cap(var: str = "THRILL_TPU_SERVE_QUEUE") -> int:
+    """Admission depth cap from ``var``; 0 = unbounded (the default).
+    Malformed values are skipped loudly — a typo must not silently
+    shed traffic."""
+    v = os.environ.get(var, "")
     if not v:
         return 0
     try:
@@ -79,10 +138,56 @@ def _queue_cap() -> int:
     except ValueError:
         import sys
         print(f"thrill_tpu.service: ignoring malformed "
-              f"THRILL_TPU_SERVE_QUEUE={v!r} (want an integer); "
+              f"{var}={v!r} (want an integer); "
               f"queue is unbounded", file=sys.stderr)
         return 0
     return max(cap, 0)
+
+
+class _TokenBucket:
+    """One tenant's admission token bucket: ``rate`` tokens/s refill,
+    ``burst`` capacity (a freshly-seen tenant starts full, so a burst
+    up to ``burst`` jobs is admitted before pacing kicks in)."""
+
+    __slots__ = ("rate", "burst", "tokens", "t_last")
+
+    def __init__(self, rate: float, burst: float) -> None:
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.t_last = time.monotonic()
+
+    def try_take(self) -> float:
+        """0.0 when a token was taken (admitted); else the seconds
+        until the next token exists — the retry-after hint."""
+        now = time.monotonic()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.t_last) * self.rate)
+        self.t_last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return 0.0
+        return (1.0 - self.tokens) / self.rate
+
+
+def _rate_entry(v: str):
+    """One THRILL_TPU_SERVE_RATE value: ``rps`` or ``rps:burst``."""
+    rps, _, burst = v.partition(":")
+    r = float(rps)
+    if r <= 0:
+        raise ValueError(v)
+    b = float(burst) if burst else max(1.0, r)
+    if b < 1.0:
+        raise ValueError(v)
+    return (r, b)
+
+
+def _parse_rates(spec: str) -> Dict[str, tuple]:
+    """Parse THRILL_TPU_SERVE_RATE ("a=5,b=2:10,default=50") —
+    jobs/s[:burst] per tenant; the ``default`` key covers tenants not
+    named. Malformed entries are skipped loudly."""
+    from ..common.config import parse_kv_spec
+    return parse_kv_spec(spec, _rate_entry, "SERVE_RATE")
 
 
 def _weight(v: str) -> float:
@@ -224,6 +329,10 @@ class WfqQueue:
         if tq is not None:
             tq.weight = float(weight)
 
+    def tenant_depth(self, tenant: str) -> int:
+        tq = self._tenants.get(tenant)
+        return len(tq.jobs) if tq is not None else 0
+
     def push(self, fn, tenant: str, name: str, future: JobFuture) -> _Job:
         tq = self._tenants.get(tenant)
         if tq is None:
@@ -301,8 +410,20 @@ class Scheduler:
         # cap, and a job rank 0 runs that a follower rejected wedges
         # the mesh collectives. Multi-controller: loud one-time skip.
         self.queue_cap = _queue_cap()
+        # per-tenant backpressure (ISSUE 18): a flooding tenant fills
+        # its OWN bounded queue / drains its OWN token bucket and
+        # sheds, while other tenants keep their full admission depth.
+        # Same single-controller-only rule as the global cap.
+        self.tenant_queue_cap = _queue_cap("THRILL_TPU_SERVE_TENANT_QUEUE")
+        self._rates = _parse_rates(
+            os.environ.get("THRILL_TPU_SERVE_RATE", ""))
+        self._buckets: Dict[str, _TokenBucket] = {}
         self.jobs_rejected = 0
+        self.jobs_rate_limited = 0
         self.rejected_by_tenant: Dict[str, int] = {}
+        # EWMA of completed-job run seconds: the drain-time estimate
+        # behind queue-full retry-after hints (depth * ewma)
+        self._run_ewma_s = 0.0
         self._cap_skip_noted = False
         # resize fencing (Context.resize): callables the dispatcher
         # runs EXCLUSIVELY, between jobs — never concurrent with a
@@ -353,7 +474,8 @@ class Scheduler:
                     self._job_ids, tenant,
                     name or f"job-{self._job_ids}",
                     RuntimeError("scheduler is closed"))
-            if self.queue_cap and self.queue.depth >= self.queue_cap:
+            err = self._admission_verdict(tenant)
+            if err is not None:
                 if self.ctx.net.num_workers > 1 \
                         or self.ctx.mesh_exec.num_processes > 1:
                     # cross-rank divergent rejection would be fatal
@@ -362,13 +484,14 @@ class Scheduler:
                         self._cap_skip_noted = True
                         import sys
                         print("thrill_tpu.service: THRILL_TPU_SERVE_"
-                              "QUEUE ignored on a multi-controller "
-                              "mesh — per-rank shed decisions could "
-                              "diverge and desync the lockstep "
-                              "admission contract; queue is unbounded",
+                              "QUEUE / _TENANT_QUEUE / _RATE ignored "
+                              "on a multi-controller mesh — per-rank "
+                              "shed decisions could diverge and "
+                              "desync the lockstep admission "
+                              "contract; admission is unbounded",
                               file=sys.stderr)
                 else:
-                    return self._reject(tenant, name)
+                    return self._reject(tenant, name, err)
             future = JobFuture(self._job_ids, tenant, name)
             if weight is not None:
                 self.queue.set_weight(tenant, weight)
@@ -383,28 +506,56 @@ class Scheduler:
                      queue_depth=depth)
         return future
 
-    def _reject(self, tenant: str, name: str) -> JobFuture:
-        """Shed one job at the admission cap (caller holds _cv)."""
+    def _admission_verdict(self, tenant: str) -> Optional[ShedLoad]:
+        """The typed shed verdict for one would-be submission, or None
+        when admitted (caller holds _cv). Check order: rate limit
+        first (cheapest hint, and a paced tenant should not consume
+        queue headroom), then the tenant depth cap, then the global
+        cap. Retry-after hints: token refill time is exact; depth
+        sheds estimate drain as depth * run-seconds EWMA."""
+        rate = self._rates.get(tenant) or self._rates.get("default")
+        if rate is not None:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = self._buckets[tenant] = _TokenBucket(*rate)
+            wait = bucket.try_take()
+            if wait > 0.0:
+                return RateLimited(tenant, rate[0], wait)
+        ewma = self._run_ewma_s or 0.05
+        if self.tenant_queue_cap:
+            depth = self.queue.tenant_depth(tenant)
+            if depth >= self.tenant_queue_cap:
+                return TenantQueueFull(
+                    tenant, depth, self.tenant_queue_cap,
+                    retry_after_s=round(depth * ewma, 3))
+        if self.queue_cap and self.queue.depth >= self.queue_cap:
+            depth = self.queue.depth
+            return QueueFull(tenant, depth, self.queue_cap,
+                             retry_after_s=round(depth * ewma, 3))
+        return None
+
+    def _reject(self, tenant: str, name: str,
+                err: ShedLoad) -> JobFuture:
+        """Shed one job with its typed verdict (caller holds _cv)."""
         self.jobs_rejected += 1
+        if isinstance(err, RateLimited):
+            self.jobs_rate_limited += 1
         n = self.rejected_by_tenant.get(tenant, 0) + 1
         self.rejected_by_tenant[tenant] = n
-        depth = self.queue.depth
-        err = QueueFull(tenant, depth, self.queue_cap)
         fut = JobFuture.failed(self._job_ids, tenant,
                                name or f"job-{self._job_ids}", err)
         log = self.ctx.logger
         if log.enabled:
-            log.line(event="job_reject", tenant=tenant, depth=depth,
-                     cap=self.queue_cap, tenant_rejected=n,
+            log.line(event="job_reject", tenant=tenant, kind=err.kind,
+                     retry_after_s=err.retry_after_s,
+                     depth=self.queue.depth, tenant_rejected=n,
                      jobs_rejected=self.jobs_rejected)
         if n == 1:
             # first shed PER TENANT goes to stderr: a flooding client
             # must be visible even without the JSON log
             import sys
             print(f"thrill_tpu.service: shedding load for tenant "
-                  f"{tenant!r} — admission queue at depth {depth} >= "
-                  f"cap {self.queue_cap} (THRILL_TPU_SERVE_QUEUE)",
-                  file=sys.stderr)
+                  f"{tenant!r} — {err}", file=sys.stderr)
         return fut
 
     def fence(self, fn: Callable[[], Any],
@@ -469,6 +620,7 @@ class Scheduler:
             return {"jobs_submitted": self.jobs_submitted,
                     "jobs_failed": self.jobs_failed,
                     "jobs_rejected": self.jobs_rejected,
+                    "jobs_rate_limited": self.jobs_rate_limited,
                     "queue_depth_peak": self.queue.depth_peak}
 
     def _note_latency(self, tenant: str, seconds: float) -> None:
@@ -720,6 +872,10 @@ class Scheduler:
                                time.monotonic() - job.t_submit)
             with self._cv:
                 self.jobs_done += 1
+                # drain-time estimate behind retry-after hints
+                self._run_ewma_s = (fut.run_s if not self._run_ewma_s
+                                    else 0.8 * self._run_ewma_s
+                                    + 0.2 * fut.run_s)
             if sp is not None:
                 tr.current_job = None
                 tr.end(sp, generation=fut.generation,

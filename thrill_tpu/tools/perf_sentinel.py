@@ -35,6 +35,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 from typing import Callable, Dict, List
 
 import numpy as np
@@ -77,6 +78,15 @@ COUNTERS = (
     # merge-only-restart contract (every committed run reused, zero
     # new spills on the resume leg).
     "remote_gets", "remote_puts", "runs_reused",
+    # network front door (ISSUE 18): the batch and serve workloads
+    # must report EXACTLY zero on every fd_* counter — a Context that
+    # never binds a FrontDoor pays nothing for the socket edge. The
+    # front_door workload pins the admission + streaming economy of
+    # one real loopback client: conns, submits, chunks, and the clean
+    # zero row for sheds/slow-client drops on an unloaded lane.
+    "fd_conns_accepted", "fd_conns_dropped", "fd_jobs_submitted",
+    "fd_jobs_rejected", "fd_chunks_sent", "fd_slow_clients",
+    "fd_deadline_expired",
 )
 
 #: byte totals compared ratio-banded (pow2 capacity ratchets may move
@@ -111,7 +121,13 @@ ENV_NOTE = (
 #: deliberately honored
 _SCRUB = ("THRILL_TPU_PLAN_STORE", "THRILL_TPU_FAULTS",
           "THRILL_TPU_CKPT_DIR", "THRILL_TPU_RESUME",
-          "THRILL_TPU_SERVE_QUEUE")
+          "THRILL_TPU_SERVE_QUEUE",
+          # same timing-dependence argument for the edge knobs: rate
+          # limits and tenant caps shed by wall clock, and a set
+          # SERVE_PORT would auto-bind a front door into EVERY
+          # workload's Context, polluting their all-zero fd_* rows
+          "THRILL_TPU_SERVE_RATE", "THRILL_TPU_SERVE_TENANT_QUEUE",
+          "THRILL_TPU_SERVE_PORT")
 
 VERSION = 1
 
@@ -287,6 +303,44 @@ def _serve_chain(ctx):
         .AllGather()]
 
 
+def _fd_stream(ctx, args):
+    for i in range(int(args["k"])):
+        yield i * i
+
+
+def _fd_wc(ctx, args):
+    return _serve_wc(ctx)
+
+
+def _front_door(ctx):
+    """Network-edge workload (ISSUE 18): ONE real loopback client
+    through a FrontDoor bound to the Context — the full admission
+    protocol (auth flag, hello/welcome, framing) plus both result
+    modes. Sequential deterministic submits pin the edge's counter
+    economy: 1 conn, 3 submits, 1 blob chunk per wc + 4 item chunks,
+    zero sheds / slow-client drops / deadline expiries on an unloaded
+    loopback lane. The FrontDoor is left attached so the stats capture
+    (and the Prometheus surface it feeds) sees the live counters;
+    Context.close tears it down like any serving process would."""
+    from ..service.client import FrontDoorClient
+    from ..service.front_door import FrontDoor
+    fd = FrontDoor(ctx, port=0)
+    fd.register("wc", _fd_wc)
+    fd.register("stream", _fd_stream)
+    with FrontDoorClient("127.0.0.1", fd.port, tenant="a") as cli:
+        r1 = cli.submit("wc", None).result(120)
+        r2 = cli.submit("wc", None).result(120)
+        assert r1 == r2, "front_door: repeated job diverged"
+        items = list(cli.submit("stream", {"k": 4}).chunks(timeout=120))
+        assert items == [0, 1, 4, 9], "front_door: stream diverged"
+    # the client's bye lands asynchronously: wait for the drop so
+    # fd_conns_dropped is contract-deterministic, bounded not flaky
+    deadline = time.monotonic() + 30.0
+    while fd.conns_dropped < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fd.conns_dropped == 1, "front_door: bye never landed"
+
+
 def _serve(ctx):
     """Resize-free serving lane (ISSUE 16): tenant-tagged jobs through
     ``ctx.submit`` on a W=2 mesh that never changes width. The elastic
@@ -312,6 +366,7 @@ WORKLOADS: Dict[str, Callable] = {
     "em_remote": _em_remote,
     "em_resume": _em_resume,
     "serve": _serve,
+    "front_door": _front_door,
 }
 
 #: per-workload env pins (set around the run, restored after): the em
