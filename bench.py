@@ -437,10 +437,14 @@ def _run_bench() -> None:
     # under a live job stream, in its own forced-multi-device process
     el = _elastic_metric()
 
+    # Pallas/narrowing A/B lanes (ISSUE 19): same Sort pipeline under
+    # flipped single knobs, one process per leg
+    ab = _pallas_ab_metric()
+
     _emit(value=round(mrec_s, 3),
           vs_baseline=round(mrec_s / host_mrec_s, 3),
           **wc, **prm, **kmm, **sfm, **em, **emr, **ema, **ck,
-          **sv, **fdm, **el)
+          **sv, **fdm, **el, **ab)
     ctx.close()
 
 
@@ -1324,6 +1328,95 @@ def _front_door_metric(ctx) -> dict:
             fd.close(drain=False)
     except Exception as e:  # secondary metric never kills the line
         return {"fd_error": repr(e)[:200]}
+
+
+_AB_CODE = r'''
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from thrill_tpu.api import Context
+from thrill_tpu.parallel.mesh import MeshExec
+
+ctx = Context(MeshExec(num_workers=4))
+mex = ctx.mesh_exec
+rng = np.random.default_rng(41)
+n = 1 << 15
+vals = rng.integers(0, 1 << 20, size=n).astype(np.int64)
+pay = rng.integers(0, 1 << 10, size=n).astype(np.int32)
+
+
+def once():
+    sh = ctx.Distribute({"k": vals, "p": pay}).Sort(
+        key_fn=lambda t: t["k"]).node.materialize()
+    import jax
+    jax.block_until_ready(jax.tree.leaves(sh.tree))
+
+
+once()                                       # compile leg
+t0 = time.perf_counter()
+once()                                       # steady-state leg
+dt = time.perf_counter() - t0
+st = ctx.overall_stats()
+print("ABLANE " + json.dumps({
+    "s": round(dt, 4),
+    "wire": int(st["bytes_wire_device"]),
+    "wire_raw": int(st["bytes_wire_device_raw"])}))
+ctx.close()
+'''
+
+
+def _pallas_ab_metric() -> dict:
+    """Paired A/B lanes (ISSUE 19): the SAME W=4 Sort pipeline under
+    flipped single knobs, each leg its own process so executable caches
+    and learned specs never bleed across legs — (a) phase-B narrowing
+    on vs off (wire bytes are the primary observable; wall clock on a
+    CPU rig mostly prices the cast), and (b) the radix engine vs the
+    default engine choice. The presorted exchange path is forced
+    (SORT_FUSED=0) so both knobs actually engage."""
+
+    def leg(extra):
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS":
+                        "--xla_force_host_platform_device_count=4",
+                    "THRILL_TPU_SORT_FUSED": "0"})
+        env.update(extra)
+        try:
+            out = subprocess.run([sys.executable, "-c", _AB_CODE],
+                                 env=env, capture_output=True,
+                                 text=True, timeout=900)
+            for line in reversed(out.stdout.splitlines()):
+                if line.startswith("ABLANE "):
+                    return json.loads(line[len("ABLANE "):])
+            return {"error": (out.stderr or "no ABLANE line")[-200:]}
+        except Exception as e:   # secondary metric never kills the line
+            return {"error": repr(e)[:200]}
+
+    non = leg({"THRILL_TPU_XCHG_NARROW": "1"})
+    noff = leg({"THRILL_TPU_XCHG_NARROW": "0"})
+    rad = leg({"THRILL_TPU_SORT_IMPL": "radix"})
+    auto = leg({"THRILL_TPU_SORT_IMPL": "auto"})
+    out = {}
+    if "error" not in non and "error" not in noff:
+        out.update(ab_narrow_on_s=non["s"], ab_narrow_off_s=noff["s"],
+                   ab_narrow_wire=non["wire"],
+                   ab_narrow_off_wire=noff["wire"],
+                   ab_narrow_wire_ratio=round(
+                       non["wire"] / noff["wire"], 3)
+                   if noff["wire"] else 1.0)
+    else:
+        out["ab_narrow_error"] = str(
+            non.get("error") or noff.get("error"))[:200]
+    if "error" not in rad and "error" not in auto:
+        out.update(ab_radix_s=rad["s"], ab_engine_auto_s=auto["s"])
+    else:
+        out["ab_engine_error"] = str(
+            rad.get("error") or auto.get("error"))[:200]
+    return out
 
 
 _ELASTIC_CODE = r'''

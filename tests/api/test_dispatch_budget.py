@@ -359,6 +359,45 @@ def test_bytes_on_wire_pinned():
     ctx2.close()
 
 
+def test_onefactor_narrowed_bytes_on_wire_lower(monkeypatch):
+    """A 1-factor-planned exchange with learned narrow specs ships
+    STRICTLY fewer bytes_on_wire than the same plan full-width, and the
+    raw counter keeps the full-width equivalent (the compression
+    denominator). Same pipeline, same plan, only the narrowing knob
+    differs."""
+    import jax.numpy as jnp
+    from thrill_tpu.data import exchange as ex
+
+    def run(narrow):
+        monkeypatch.setenv("THRILL_TPU_XCHG_NARROW", narrow)
+        # captured at mesh construction: set before MeshExec
+        monkeypatch.setenv("THRILL_TPU_EXCHANGE", "onefactor")
+        mex = MeshExec(num_workers=4)
+        ctx = Context(mex)
+        vals = (np.arange(6000, dtype=np.int64) * 11) % 1000
+        outs = []
+        for _ in range(2):
+            shards = ctx.Distribute({"k": vals}).node.materialize()
+
+            def dest(tree, mask, widx):
+                return (tree["k"] % 4).astype(jnp.int32)
+
+            out = ex.exchange(shards, dest, ("of_narrow_budget",))
+            outs.append([np.sort(np.asarray(t["k"]))
+                         for t in out.to_worker_arrays()])
+        stats = ctx.overall_stats()
+        ctx.close()
+        return outs, stats
+
+    outs_on, on = run("1")
+    outs_off, off = run("0")
+    for a, b in zip(outs_on, outs_off):
+        for ta, tb in zip(a, b):
+            assert np.array_equal(ta, tb)
+    assert on["bytes_on_wire"] < off["bytes_on_wire"]
+    assert on["bytes_wire_device_raw"] == off["bytes_on_wire"]
+
+
 def test_put_small_content_cache():
     mex = MeshExec(num_workers=2)
     u0 = mex.stats_uploads

@@ -36,7 +36,9 @@ def _model(data, n):
     return model
 
 
-@pytest.mark.parametrize("W", [1, 2])
+@pytest.mark.parametrize("W", [
+    2,
+    pytest.param(1, marks=pytest.mark.slow)])  # tier-1 budget: W=2
 def test_field_reduce_matches_model_and_generic(W, monkeypatch):
     rng = np.random.default_rng(11)
     n = 20000

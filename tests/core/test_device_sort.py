@@ -131,6 +131,69 @@ def test_merge_sorted_runs():
     assert np.array_equal(merged_iota, iota.reshape(-1)[order])
 
 
+@pytest.mark.parametrize("n", [1, 64, 1024, 5000])
+@pytest.mark.parametrize("nwords", [1, 2])
+def test_radix_matches_xla(monkeypatch, n, nwords):
+    """The radix engine (lax.scan partition fallback on CPU) produces
+    the identical stable permutation — the unique one, thanks to the
+    iota tiebreak — as the xla engine."""
+    rng = np.random.default_rng(n * 13 + nwords)
+    words = [jnp.asarray(rng.integers(0, max(n // 4, 2), n)
+                         .astype(np.uint64)) for _ in range(nwords)]
+    monkeypatch.setenv("THRILL_TPU_SORT_IMPL", "xla")
+    perm_xla = np.asarray(jax.jit(device_sort.argsort_words)(words))
+    monkeypatch.setenv("THRILL_TPU_SORT_IMPL", "radix")
+    perm_rad = np.asarray(jax.jit(device_sort.argsort_words)(words))
+    assert np.array_equal(perm_xla, perm_rad)
+
+
+def test_sort_engine_policy_pins(monkeypatch):
+    """The cost model's load-bearing regions (edge (e)): xla below the
+    compile cliff / on CPU, radix past the cliff when eligible, chunked
+    when the Pallas kernel cannot engage."""
+    monkeypatch.setattr(device_sort.jax, "default_backend",
+                        lambda: "cpu")
+    eng, costs, _ = device_sort.sort_engine_policy(1 << 20, 64, True)
+    assert eng == "xla"                      # CPU: lowering healthy
+
+    monkeypatch.setattr(device_sort.jax, "default_backend",
+                        lambda: "tpu")
+    small = device_sort.XLA_SORT_MAX_N
+    eng, _, _ = device_sort.sort_engine_policy(small, 64, True)
+    assert eng == "xla"                      # below the compile cliff
+    eng, costs, reason = device_sort.sort_engine_policy(
+        1 << 22, 64, True)
+    assert eng == "radix" and "radix" in costs and "chunked" in costs
+    assert costs["radix"] < costs["chunked"]
+    eng, costs, reason = device_sort.sort_engine_policy(
+        1 << 22, 64, False)
+    assert eng == "chunked" and "radix" not in costs
+    assert "ineligible" in reason
+    # many wide words: enough passes to price radix past chunked
+    eng, costs, _ = device_sort.sort_engine_policy(1 << 22, 64 * 40,
+                                                  True)
+    assert eng == "chunked" and costs["chunked"] < costs["radix"]
+
+
+@pytest.mark.parametrize("w", [
+    4,
+    pytest.param(1, marks=pytest.mark.slow),   # tier-1 budget: W=4
+    pytest.param(2, marks=pytest.mark.slow)])  # exercises the sweep
+def test_pipeline_on_radix_engine(w, monkeypatch):
+    """Full Sort pipeline on the radix engine at W in {1, 2, 4}:
+    bit-identical results vs the default engine (stable sorts share the
+    unique permutation, so equality is exact, not just sorted-equal)."""
+    from thrill_tpu.api import RunLocalMock
+
+    def job(ctx):
+        rng = np.random.default_rng(4)
+        vals = rng.integers(0, 500, 3000).astype(np.int64)
+        assert [int(x) for x in ctx.Distribute(vals).Sort().AllGather()] \
+            == sorted(vals.tolist())
+    monkeypatch.setenv("THRILL_TPU_SORT_IMPL", "radix")
+    RunLocalMock(job, w)
+
+
 def test_pipeline_u32_engine(monkeypatch):
     """Full Sort pipeline (incl. the fused run-merge exchange) on the
     u32 split path across worker counts incl. non-power-of-two."""

@@ -43,3 +43,88 @@ def test_dispatch_fallback_off_tpu():
     dest = jnp.asarray(np.array([0, 2, 2, 5], dtype=np.int32))
     got = np.asarray(pk.partition_histogram(dest, 6))
     assert got.tolist() == [1, 0, 2, 0, 0, 1]
+
+
+@pytest.mark.parametrize("n,M", [(10, 4), (512, 64), (3000, 500),
+                                 (4096, 1024)])
+def test_presence_fill_matches_scatter(n, M):
+    rng = np.random.default_rng(n + M)
+    h = rng.integers(0, M, n).astype(np.int32)
+    valid = (rng.random(n) < 0.7)
+    got = np.asarray(pk.presence_fill_pallas(
+        jnp.asarray(h), jnp.asarray(valid), M, interpret=True))
+    want = np.zeros(M, np.uint8)
+    want[h[valid]] = 1
+    assert got.dtype == np.uint8
+    assert np.array_equal(got, want)
+
+
+def test_presence_fill_ignores_sentinel_and_invalid():
+    # -1 padding sentinel, >= M overflow values, and valid=0 rows are
+    # all ignored by BOTH engines
+    h = np.array([0, -1, 3, 99, 3, 2], dtype=np.int32)
+    valid = np.array([1, 1, 1, 1, 0, 1], dtype=bool)
+    a = np.asarray(pk.presence_fill_pallas(
+        jnp.asarray(h), jnp.asarray(valid), 4, interpret=True))
+    b = np.asarray(pk.presence_fill(jnp.asarray(h), jnp.asarray(valid), 4))
+    assert a.tolist() == [1, 0, 1, 1]   # 0, 2, and the valid 3
+    assert np.array_equal(a, b)
+
+
+def test_presence_fill_empty_input():
+    h = np.zeros(0, np.int32)
+    valid = np.zeros(0, bool)
+    a = np.asarray(pk.presence_fill_pallas(
+        jnp.asarray(h), jnp.asarray(valid), 8, interpret=True))
+    b = np.asarray(pk.presence_fill(jnp.asarray(h), jnp.asarray(valid), 8))
+    assert a.tolist() == [0] * 8
+    assert np.array_equal(a, b)
+
+
+def test_segment_sum_empty_input():
+    ids = jnp.zeros(0, jnp.int32)
+    vals = jnp.zeros(0, jnp.float32)
+    got = np.asarray(pk.segment_sum_pallas(ids, vals, 5, interpret=True))
+    assert got.tolist() == [0.0] * 5
+
+
+def test_histogram_empty_input():
+    got = np.asarray(pk.partition_histogram_pallas(
+        jnp.zeros(0, jnp.int32), 4, interpret=True))
+    assert got.tolist() == [0] * 4
+
+
+def test_refusal_gates_pinned():
+    """Size gates the dispatchers refuse past: >2^24 rows (f32 one-hot
+    accumulation would lose exactness), oversized register/segment
+    columns (one-hot cost crosses over vs XLA scatter)."""
+    assert pk.rows_ok(pk.MAX_ROWS - 1)
+    assert not pk.rows_ok(pk.MAX_ROWS)
+    assert pk.presence_fill_ok(pk.PRESFILL_MAX_REGS - 1, 100)
+    assert not pk.presence_fill_ok(pk.PRESFILL_MAX_REGS + 1, 100)
+    assert not pk.presence_fill_ok(10, pk.MAX_ROWS)
+    assert pk.segment_sum_ok(pk.SEGSUM_MAX_SEGS - 1, 100)
+    assert not pk.segment_sum_ok(pk.SEGSUM_MAX_SEGS + 1, 100)
+    assert not pk.segment_sum_ok(10, pk.MAX_ROWS)
+
+
+def test_pallas_knob_cached_at_mesh_construction(monkeypatch):
+    """THRILL_TPU_PALLAS is captured ONCE when the mesh is built (the
+    _env_exchange pattern): flipping os.environ afterwards must not
+    change a live mesh's engine choice mid-run."""
+    class _Mex:
+        pass
+
+    monkeypatch.setattr(pk.jax, "default_backend", lambda: "tpu")
+    mex_off = _Mex()
+    mex_off._env_pallas = None          # built with the var unset
+    mex_on = _Mex()
+    mex_on._env_pallas = "1"            # built with the var set
+    monkeypatch.setenv("THRILL_TPU_PALLAS", "1")
+    assert not pk.pallas_enabled(mex_off)
+    assert pk.pallas_enabled(mex_on)
+    monkeypatch.delenv("THRILL_TPU_PALLAS")
+    assert pk.pallas_enabled(mex_on)    # cached value survives env loss
+    # no mesh in scope: the live env read is the documented fallback
+    monkeypatch.setenv("THRILL_TPU_PALLAS", "1")
+    assert pk.pallas_enabled(_Mex())

@@ -1,0 +1,172 @@
+"""Narrowing endgame (ISSUE 19): the learned narrow specs reach EVERY
+phase-B flavor — the 1-factor rounds, the ragged builder, and the
+presorted Sort/Merge phase-B — not just the dense chunked path.
+
+Pins:
+
+* Sort's presorted exchange (THRILL_TPU_SORT_FUSED=0 forces it) is
+  bit-identical narrow on vs off, and the narrowed run ships strictly
+  fewer device-wire bytes with the raw counter keeping the full-width
+  equivalent;
+* Merge's presorted exchange: same contract;
+* the sort-engine decision (edge (e)) lands in the ledger and renders
+  in ctx.explain();
+* _bytes_eq live calibration (edge (b)): fresh meshes keep the static
+  platform constant; a warmed dispatch-latency spine calibrates it,
+  clamped to [static/4, static*4]; THRILL_TPU_XCHG_BYTES_EQ pins and
+  THRILL_TPU_XCHG_BYTES_EQ_CAL=0 escapes;
+* chunk-accumulator donation never fires on CPU (XLA:CPU has no
+  input-output aliasing) — the counter is the TPU-bench observable.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from thrill_tpu.api import Context
+from thrill_tpu.parallel.mesh import MeshExec
+
+
+def _ctx(W):
+    return Context(MeshExec(devices=jax.devices("cpu")[:W]))
+
+
+def _sort_run(W, vals, pays, monkeypatch, narrow):
+    monkeypatch.setenv("THRILL_TPU_XCHG_NARROW", narrow)
+    monkeypatch.setenv("THRILL_TPU_SORT_FUSED", "0")
+    ctx = _ctx(W)
+    mex = ctx.mesh_exec
+    outs = []
+    for _ in range(2):                    # second run: sticky spec path
+        sh = ctx.Distribute({"k": vals, "p": pays}) \
+            .Sort(key_fn=lambda t: t["k"]).node.materialize()
+        g = sh.to_global_numpy()
+        outs.append((g["k"].tobytes(), g["p"].tobytes()))
+    wire = (mex.stats_bytes_wire_device, mex.stats_bytes_wire_device_raw)
+    led = ctx.decisions
+    kinds = set(r.kind for r in led.records) if led.enabled else set()
+    txt = ctx.explain()
+    ctx.close()
+    return outs, wire, kinds, txt
+
+
+def test_sort_presorted_narrowed_bit_identical(monkeypatch):
+    W = 4
+    rng = np.random.default_rng(11)
+    vals = rng.integers(0, 900, 12000).astype(np.int64)
+    pays = rng.integers(0, 100, 12000).astype(np.int32)
+    on, wire_on, kinds, txt = _sort_run(W, vals, pays, monkeypatch, "1")
+    off, wire_off, _, _ = _sort_run(W, vals, pays, monkeypatch, "0")
+    assert on == off                      # byte-identical, both runs
+    assert wire_on[0] < wire_off[0]       # strictly fewer wire bytes
+    assert wire_on[1] == wire_off[0] == wire_off[1]
+    # the engine decision (edge (e)) is recorded and rendered
+    assert "sort_engine" in kinds
+    assert "sort_engine" in txt
+
+
+@pytest.mark.slow  # tier-1 budget: Sort pins the presorted contract
+def test_merge_presorted_narrowed_bit_identical(monkeypatch):
+    from thrill_tpu.api.dia import Merge
+
+    W = 4
+    rng = np.random.default_rng(5)
+    a = np.sort(rng.integers(0, 4000, 9000).astype(np.int64))
+    b = np.sort(rng.integers(0, 4000, 7000).astype(np.int64))
+
+    def run(narrow):
+        monkeypatch.setenv("THRILL_TPU_XCHG_NARROW", narrow)
+        ctx = _ctx(W)
+        mex = ctx.mesh_exec
+        da, db = ctx.Distribute({"k": a}), ctx.Distribute({"k": b})
+        m = Merge(da, db, key_fn=lambda t: t["k"]).node.materialize()
+        got = m.to_global_numpy()["k"].tobytes()
+        wire = (mex.stats_bytes_wire_device,
+                mex.stats_bytes_wire_device_raw)
+        ctx.close()
+        return got, wire
+
+    on, wire_on = run("1")
+    off, wire_off = run("0")
+    assert on == off
+    assert wire_on[0] < wire_off[0]
+    assert wire_on[1] == wire_off[0] == wire_off[1]
+
+
+def test_bytes_eq_live_calibration(monkeypatch):
+    from thrill_tpu.data import exchange as ex
+
+    monkeypatch.delenv("THRILL_TPU_XCHG_BYTES_EQ", raising=False)
+    mex = MeshExec(devices=jax.devices("cpu")[:2])
+    static = ex._BYTES_EQ_MEASURED["cpu"]
+    # fresh mesh: too few samples, deterministic static constant
+    assert ex._bytes_eq(mex) == static
+    # warmed spine at the measured overhead: calibrated ~= static
+    mex._disp_lat_n = ex._BYTES_EQ_MIN_SAMPLES
+    mex._disp_lat_min = 119e-6
+    cal = ex._bytes_eq(mex)
+    assert abs(cal - static) / static < 0.05
+    # clamp: a 100x-faster launch floor cannot leave the measured
+    # regime (static/4), nor can a pathological stall exceed static*4
+    mex._disp_lat_min = 1e-6
+    assert ex._bytes_eq(mex) == static // 4
+    mex._disp_lat_min = 1.0
+    assert ex._bytes_eq(mex) == static * 4
+    # escapes: CAL=0 pins static; the explicit byte override wins
+    monkeypatch.setenv("THRILL_TPU_XCHG_BYTES_EQ_CAL", "0")
+    assert ex._bytes_eq(mex) == static
+    monkeypatch.setenv("THRILL_TPU_XCHG_BYTES_EQ", "777")
+    assert ex._bytes_eq(mex) == 777
+
+
+def test_bytes_eq_calibration_recorded(monkeypatch):
+    """The calibrated value lands in the decision ledger once per mesh,
+    audited against the static constant (live drift observable)."""
+    from thrill_tpu.data import exchange as ex
+
+    monkeypatch.delenv("THRILL_TPU_XCHG_BYTES_EQ", raising=False)
+    monkeypatch.delenv("THRILL_TPU_XCHG_BYTES_EQ_CAL", raising=False)
+    ctx = _ctx(2)
+    mex = ctx.mesh_exec
+    mex._disp_lat_n = ex._BYTES_EQ_MIN_SAMPLES
+    mex._disp_lat_min = 119e-6
+    ex._bytes_eq(mex)
+    ex._bytes_eq(mex)                     # second call: no duplicate
+    recs = [r for r in ctx.decisions.records if r.kind == "bytes_eq"]
+    assert len(recs) == 1
+    ctx.close()
+
+
+def test_xchg_donated_counter_cpu_zero(monkeypatch):
+    """XLA:CPU has no input-output aliasing: the chunked phase-B must
+    never arm donation there, and the counter stays 0 (on TPU it counts
+    donated accumulator handoffs — the A/B bench observable)."""
+    from thrill_tpu.data import exchange as ex
+
+    ctx = _ctx(2)
+    mex = ctx.mesh_exec
+    assert mex.stats_xchg_donated == 0
+    vals = (np.arange(4000, dtype=np.int64) * 3) % 700
+    shards = ctx.Distribute({"k": vals}).node.materialize()
+
+    def dest(tree, mask, widx):
+        return (tree["k"] % 2).astype(jnp.int32)
+
+    out = ex.exchange(shards, dest, ("donate_cpu",))
+    out.to_worker_arrays()
+    assert mex.stats_xchg_donated == 0
+    ctx.close()
+
+
+def test_ragged_builder_accepts_narrow_spec():
+    """The ragged builder folds the narrow spec into its traced cast
+    chain (TPU executes it; here the builder must at least construct
+    and the cache key must distinguish specs)."""
+    from thrill_tpu.data import exchange as ex
+
+    mex = MeshExec(devices=jax.devices("cpu")[:2])
+    fb_wide = ex._ragged_builder(mex, 8, 1, narrow=None)
+    fb_narrow = ex._ragged_builder(mex, 8, 1, narrow=("int16",))
+    assert fb_wide is not None and fb_narrow is not None

@@ -787,11 +787,12 @@ def _location_filter(left: DeviceShards, right: DeviceShards,
                 keymod.encode_key_words(rkey(rtree)))
                 % jnp.uint64(M)).astype(jnp.int32)
             # u8 presence registers: a quarter of the i32 form's
-            # fabric bytes, same verdict
-            pres_l = jnp.zeros(M, jnp.uint8).at[hl].max(
-                lvalid.astype(jnp.uint8))
-            pres_r = jnp.zeros(M, jnp.uint8).at[hr].max(
-                rvalid.astype(jnp.uint8))
+            # fabric bytes, same verdict. Filled by the Pallas
+            # presence kernel where it engages (bit-identical —
+            # presence is 0/1, no float reassociation).
+            from ...core.pallas_kernels import presence_fill
+            pres_l = presence_fill(hl, lvalid, M)
+            pres_r = presence_fill(hr, rvalid, M)
             pres_l = lax.pmax(pres_l, AXIS)
             pres_r = lax.pmax(pres_r, AXIS)
             keep_l = lvalid & (jnp.take(pres_r, hl) > 0)

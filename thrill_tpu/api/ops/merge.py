@@ -173,10 +173,21 @@ def _device_merge(inputs: List[DeviceShards], key_fn: Callable,
                                          shards.counts.copy()))
             continue
 
+        # carrier leaf templates ({__gidx, __words, tree} flatten order)
+        # so the phase-B narrowing's range analysis can ride this
+        # classify program — encode_key_words always emits uint64 words
+        carrier_templates, _ = jax.tree.flatten({
+            "__words": jax.ShapeDtypeStruct((W, cap, nwords),
+                                            jnp.uint64),
+            "__gidx": jax.ShapeDtypeStruct((W, cap), jnp.int64),
+            "tree": jax.tree.unflatten(treedef, list(leaves))})
+        nidx3 = exchange.presorted_range_leaves(mex, cap,
+                                                carrier_templates)
         key2 = ("merge_classify", token, i, W, cap, nwords, treedef,
-                tuple((l.dtype, l.shape[2:]) for l in leaves))
+                nidx3, tuple((l.dtype, l.shape[2:]) for l in leaves))
 
-        def build2(cap=cap, treedef=treedef, i=i, nleaves=len(leaves)):
+        def build2(cap=cap, treedef=treedef, i=i, nleaves=len(leaves),
+                   nidx3=nidx3):
             def f(spl_a, counts_dev, offset_dev, *ls):
                 spl = spl_a[0]                      # [W-1, nwords+2]
                 count = counts_dev[0, 0]
@@ -197,13 +208,19 @@ def _device_merge(inputs: List[DeviceShards], key_fn: Callable,
                     d = d + gt.astype(jnp.int32)
                 dest = jnp.where(valid, d, W)
                 all_send = exchange.send_counts(dest, W)
-                return (dest[None], all_send, wm[None], gidx[None],
+                outs = (dest[None], all_send, wm[None], gidx[None],
                         *[l for l in ls])
+                if nidx3:
+                    carrier = [gidx, wm] + [l[0] for l in ls]
+                    outs = outs + (exchange.leaf_ranges_traced(
+                        [carrier[li] for li in nidx3], valid),)
+                return outs
 
             from jax.sharding import PartitionSpec as P
-            return mex.smap(f, 3 + nleaves,
-                            out_specs=(P(AXIS), P())
-                            + (P(AXIS),) * (2 + nleaves))
+            out_specs = (P(AXIS), P()) + (P(AXIS),) * (2 + nleaves)
+            if nidx3:
+                out_specs = out_specs + (P(),)
+            return mex.smap(f, 3 + nleaves, out_specs=out_specs)
 
         f2 = mex.cached(key2, build2)
         spl_dev = mex.put_small(np.broadcast_to(
@@ -211,14 +228,17 @@ def _device_merge(inputs: List[DeviceShards], key_fn: Callable,
         out2 = f2(spl_dev, shards.counts_device(),
                   mex.put_small(offsets.astype(np.int64)[:, None]), *leaves)
         sorted_dest, send_mat = out2[0], out2[1]
+        payload_end = len(out2) - 1 if nidx3 else len(out2)
+        range_mat = out2[-1] if nidx3 else None
         carrier_tree = {"__words": out2[2], "__gidx": out2[3],
-                        "tree": jax.tree.unflatten(treedef,
-                                                   list(out2[4:]))}
+                        "tree": jax.tree.unflatten(
+                            treedef, list(out2[4:payload_end]))}
         carrier_leaves, treedef3 = jax.tree.flatten(carrier_tree)
         S = mex.fetch(send_mat)
+        ranges = None if range_mat is None else mex._fetch_raw(range_mat)
         carriers.append(exchange.exchange_presorted(
             mex, treedef3, sorted_dest, carrier_leaves, S,
-            ident=("merge_x", token, i)))
+            ident=("merge_x", token, i), ranges=ranges))
 
     # ---- phase 3: one local merge sort over all received runs -------
     caps = tuple(c.cap for c in carriers)

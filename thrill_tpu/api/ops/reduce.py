@@ -470,8 +470,14 @@ class ReduceNode(DIABase):
                 # keep colliding keys local — wrong results, not just
                 # extra traffic.
                 reg_dt = jnp.uint8 if W < 256 else jnp.int32
-                local = jnp.zeros(M, reg_dt).at[reg].max(
-                    mask.astype(reg_dt))
+                if reg_dt == jnp.uint8:
+                    # register fill through the Pallas presence kernel
+                    # where it engages (bit-identical: presence is 0/1)
+                    from ...core.pallas_kernels import presence_fill
+                    local = presence_fill(reg, mask, M)
+                else:
+                    local = jnp.zeros(M, reg_dt).at[reg].max(
+                        mask.astype(reg_dt))
                 holders = lax.psum(local, AXIS)
                 mine_only = (jnp.take(holders, reg) == 1) & \
                     (jnp.take(local, reg) == 1)
@@ -900,8 +906,20 @@ def _scatter_reduce_apply(tree, valid, local_idx, range_size, out_cap,
             col = jnp.take(leaf, jnp.clip(w, 0, cap - 1), axis=0)
             present = w < cap
         elif s == "sum":
-            col = jnp.zeros((out_cap + 1,) + trail,
-                            leaf.dtype).at[pos].add(leaf)[:out_cap]
+            from ...core import pallas_kernels as _pk
+            if (leaf.dtype == jnp.float32 and not trail
+                    and _pk.pallas_enabled()
+                    and _pk.segment_sum_ok(out_cap, cap)):
+                # additive f32 fold through the Pallas segment-sum
+                # kernel (the PageRank/k-means hot shape). Sum order
+                # differs from the scatter (per-block partials), which
+                # the unordered-reduce contract permits; the scatter
+                # below stays THE path whenever the knob is off, so
+                # THRILL_TPU_PALLAS=0 is bit-identical by construction.
+                col = _pk.segment_sum_pallas(pos, leaf, out_cap)
+            else:
+                col = jnp.zeros((out_cap + 1,) + trail,
+                                leaf.dtype).at[pos].add(leaf)[:out_cap]
             if nv is None or not np.any(np.asarray(nv)):
                 # zero neutral == the scatter base: skip the presence
                 # arbitration entirely (the PageRank/k-means hot shape)

@@ -835,7 +835,15 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
     # generic exchange's phase-A argsort entirely. Splitters are a
     # RUNTIME operand (replicated like the send-count matrix), never
     # baked into the cached executable.
-    key2 = ("sort_classify", token, W, cap, nwords, treedef,
+    # the eventual carrier is {__gidx, __words, tree}: build matching
+    # leaf templates up front so the phase-B narrowing's range analysis
+    # (exchange.leaf_ranges_traced) can ride this classify program —
+    # the data is already resident here, no extra pass
+    carrier_templates, _ = jax.tree.flatten({
+        "__words": words_mat, "__gidx": gidx_s,
+        "tree": jax.tree.unflatten(treedef, list(leaves))})
+    nidx3 = exchange.presorted_range_leaves(mex, cap, carrier_templates)
+    key2 = ("sort_classify", token, W, cap, nwords, treedef, nidx3,
             tuple((l.dtype, l.shape[2:]) for l in leaves))
 
     def build2():
@@ -855,12 +863,19 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
             # the ONE payload gather of this phase
             from ...core.rowmove import take_rows_multi
             sorted_ls = take_rows_multi([l[0] for l in ls], p)
-            return (dest[None], all_send,
+            outs = (dest[None], all_send,
                     *[sl[None] for sl in sorted_ls])
+            if nidx3:
+                carrier = [gi, wm] + list(sorted_ls)
+                outs = outs + (exchange.leaf_ranges_traced(
+                    [carrier[li] for li in nidx3], valid),)
+            return outs
 
         from jax.sharding import PartitionSpec as P
-        return mex.smap(f, 5 + len(leaves),
-                        out_specs=(P(AXIS), P()) + (P(AXIS),) * len(leaves))
+        out_specs = (P(AXIS), P()) + (P(AXIS),) * len(leaves)
+        if nidx3:
+            out_specs = out_specs + (P(),)
+        return mex.smap(f, 5 + len(leaves), out_specs=out_specs)
 
     f2 = mex.cached(key2, build2)
     spl_dev = mex.put_small(np.broadcast_to(
@@ -868,7 +883,12 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
     out2 = f2(spl_dev, words_mat, gidx_s, perm_dev,
               shards.counts_device(), *leaves)
     sorted_dest, send_mat = out2[0], out2[1]
-    sorted_payload = list(out2[2:])
+    if nidx3:
+        sorted_payload = list(out2[2:-1])
+        range_mat = out2[-1]
+    else:
+        sorted_payload = list(out2[2:])
+        range_mat = None
     S = mex.fetch(send_mat)
 
     # fused dense path: ship + MERGE the received rank-ordered runs in
@@ -890,8 +910,11 @@ def _device_sample_sort(shards: DeviceShards, key_fn: Callable,
         "tree": jax.tree.unflatten(treedef, sorted_payload),
     }
     carrier_leaves, treedef3 = jax.tree.flatten(carrier_tree)
+    ranges = None if range_mat is None else mex._fetch_raw(range_mat)
     carrier = exchange.exchange_presorted(mex, treedef3, sorted_dest,
-                                          carrier_leaves, S)
+                                          carrier_leaves, S,
+                                          ident=("sort_x", token),
+                                          ranges=ranges)
 
     # ---- phase 3: merge received runs (keys-only sort + one gather) --
     cap3 = carrier.cap

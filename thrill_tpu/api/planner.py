@@ -155,6 +155,18 @@ class Planner:
             return math.inf
         return c
 
+    def sort_engine(self, n: int, total_bits: int, radix_ok: bool,
+                    site: Optional[str] = None):
+        """Device sort engine choice (edge (e)): delegates to the one
+        shared cost model in core/device_sort.py so the planner and the
+        legacy auto path can never disagree; a pending replan mark on
+        the sort site is consumed here (the decision is re-recorded by
+        the caller either way)."""
+        from ..core.device_sort import sort_engine_policy
+        if site is not None:
+            self.take_replan(site)
+        return sort_engine_policy(n, total_bits, radix_ok)
+
     def hbm_inadmissible(self, est_bytes: int) -> bool:
         """True when ``est_bytes`` cannot be admitted at any spill
         level: it exceeds the watermark fraction of the whole HBM

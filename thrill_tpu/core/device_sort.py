@@ -27,6 +27,7 @@ compile fine).
 
 from __future__ import annotations
 
+import math
 import os
 from typing import List
 
@@ -38,6 +39,13 @@ from jax import lax
 # above this row count, accelerator backends switch engines in auto
 XLA_SORT_MAX_N = 1 << 16
 
+# coarse per-platform element-op throughput, converting modeled engine
+# costs into µs — the unit the dispatch-latency audit joins back in
+# (parallel/mesh.py resolves sort_engine records with the program's
+# measured post-compile dispatch wall time). Deliberately order-of-
+# magnitude: the audit checks magnitude, not percent.
+_OPS_PER_US = {"cpu": 2e2, "tpu": 2e4}
+
 
 def _impl(n: int) -> str:
     mode = os.environ.get("THRILL_TPU_SORT_IMPL", "auto")
@@ -46,6 +54,80 @@ def _impl(n: int) -> str:
     if jax.default_backend() == "cpu" or n <= XLA_SORT_MAX_N:
         return "xla"
     return "chunked"
+
+
+def sort_engine_policy(n: int, total_bits: int, radix_ok: bool):
+    """THE cost model for the device sort engine choice (ROADMAP
+    planner edge (e)) — shared verbatim by the auto path here and by
+    ``Planner.sort_engine`` so both always agree.
+
+    Returns ``(engine, costs_us, reason)`` where ``costs_us`` maps each
+    candidate engine to its modeled cost in µs:
+
+    * xla     — one ``lax.sort``: ~n·log n work, but only where the
+                lowering is healthy (CPU, or n below the TPU compile
+                cliff at ``XLA_SORT_MAX_N``);
+    * chunked — batched 64K-tile sorts + bitonic merge tree:
+                n·(log²(64K)/2 + log C·log n) compare-exchanges;
+    * radix   — LSD 8-bit passes over the key words (pallas_sort):
+                ~3n per pass (histogram + offsets + scatter),
+                ``total_bits/8`` passes, eligible only when the Pallas
+                stable-partition kernel engages (``radix_ok``).
+    """
+    plat = jax.default_backend()
+    ops = _OPS_PER_US.get(plat, 2e3)
+    lg = max(1.0, math.log2(max(n, 2)))
+    if plat == "cpu" or n <= XLA_SORT_MAX_N:
+        return ("xla", {"xla": n * lg / ops},
+                "xla sort lowering healthy at this size")
+    costs = {}
+    lgc = math.log2(XLA_SORT_MAX_N)
+    c_tiles = max(1.0, n / XLA_SORT_MAX_N)
+    costs["chunked"] = n * (lgc * lgc / 2.0
+                            + math.log2(c_tiles) * lg) / ops
+    if radix_ok:
+        passes = max(1, (total_bits + 7) // 8)
+        costs["radix"] = 3.0 * n * passes / ops
+        reason = "past the xla compile cliff; radix eligible"
+    else:
+        reason = ("past the xla compile cliff; radix ineligible "
+                  "(Pallas off or too many rows)")
+    engine = min(costs, key=costs.get)
+    return engine, costs, reason
+
+
+def _auto_engine(words: List[jnp.ndarray], n: int) -> str:
+    """Resolve auto mode to an engine, routing through the planner's
+    cost model when one is attached and recording the choice in the
+    decision ledger (audited later with the program's measured dispatch
+    latency — see _CountedJit._dispatch)."""
+    from ..parallel import mesh as _mesh
+    from .pallas_kernels import MAX_ROWS, pallas_enabled
+
+    mex = _mesh.current_mex()
+    radix_ok = pallas_enabled(mex) and n < MAX_ROWS
+    total_bits = sum(32 if w.dtype == jnp.uint32 else 64 for w in words)
+    site = f"sort:n{n}:w{len(words)}"
+    pl = getattr(mex, "planner", None) if mex is not None else None
+    if pl is not None and pl.enabled:
+        engine, costs, reason = pl.sort_engine(n, total_bits, radix_ok,
+                                               site=site)
+    else:
+        engine, costs, reason = sort_engine_policy(n, total_bits,
+                                                   radix_ok)
+    if mex is not None:
+        led = getattr(mex, "decisions", None)
+        if led is not None and led.enabled:
+            rec = led.record(
+                "sort_engine", site=site,
+                chosen=engine, predicted=costs.get(engine),
+                rejected=[(e, c) for e, c in sorted(costs.items())
+                          if e != engine],
+                reason=reason, n=n, total_bits=total_bits)
+            prog = _mesh.current_program()
+            if prog is not None and not prog._engine_armed:
+                prog._engine_recs.append(rec)
+    return engine
 
 
 def _use_u32() -> bool:
@@ -95,7 +177,9 @@ def prepare_sort_words(words: List[jnp.ndarray], n: int):
 def argsort_words(words: List[jnp.ndarray]) -> jnp.ndarray:
     """Stable argsort by uint64 key words (lexicographic). [n] int32."""
     n = words[0].shape[0]
-    impl = _impl(n)
+    mode = os.environ.get("THRILL_TPU_SORT_IMPL", "auto")
+    impl = mode if mode in ("xla", "bitonic", "chunked", "radix") \
+        else _auto_engine(words, n)
     if impl == "radix":
         # LSD radix over 8-bit digits (O(n * passes), no comparison
         # network, no XLA-sort compile cliff): Pallas stable-partition

@@ -42,9 +42,49 @@ SUBLANES = 8         # Mosaic block rule: trailing dims divisible by
 COLS = BLOCK // SUBLANES   # 64 lanes per tile row
 
 
-def pallas_enabled() -> bool:
-    return os.environ.get("THRILL_TPU_PALLAS", "0") == "1" and \
-        jax.default_backend() == "tpu"
+# f32 one-hot partials stay exact below this row count (same bound as
+# pallas_sort._F32_EXACT); every dispatcher refuses larger inputs
+MAX_ROWS = 1 << 24
+# one-hot register fill / segment sum are O(bins*n) lane-compares: a
+# clear win only while the bin column stays small (the preshuffle
+# _REG_MIN clamp's home turf); above these XLA's native scatter wins
+PRESFILL_MAX_REGS = 1 << 13
+SEGSUM_MAX_SEGS = 1 << 12
+
+_MISSING = object()
+
+
+def pallas_enabled(mex=None) -> bool:
+    """True when the Pallas kernel tier should drive eligible hot loops
+    (THRILL_TPU_PALLAS=1 on a real TPU backend).
+
+    The knob is resolved ONCE at MeshExec construction (mirroring the
+    THRILL_TPU_EXCHANGE contract): inside a dispatch or trace the
+    owning mesh's cached value wins — flipping the env var after the
+    mesh exists deliberately does nothing. Outside any dispatch (bare
+    kernel calls, unit tests) fall back to the live env read.
+    """
+    if mex is None:
+        from ..parallel.mesh import current_mex
+        mex = current_mex()
+    env = getattr(mex, "_env_pallas", _MISSING) if mex is not None \
+        else _MISSING
+    if env is _MISSING:
+        env = os.environ.get("THRILL_TPU_PALLAS", "0")
+    return env == "1" and jax.default_backend() == "tpu"
+
+
+def rows_ok(n: int) -> bool:
+    """Row-count refusal gate shared by every kernel dispatcher."""
+    return n < MAX_ROWS
+
+
+def presence_fill_ok(num_regs: int, n: int) -> bool:
+    return num_regs <= PRESFILL_MAX_REGS and rows_ok(n)
+
+
+def segment_sum_ok(num_segments: int, n: int) -> bool:
+    return num_segments <= SEGSUM_MAX_SEGS and rows_ok(n)
 
 
 def _round_up(n: int, g: int) -> int:
@@ -105,7 +145,7 @@ def partition_histogram(dest: jnp.ndarray, num_bins: int) -> jnp.ndarray:
     Both paths ignore values outside [0, num_bins) — negative or
     too-large ids are padding sentinels, never counted.
     """
-    if pallas_enabled():
+    if pallas_enabled() and rows_ok(dest.shape[0]):
         return partition_histogram_pallas(dest, num_bins)
     sanitized = jnp.where((dest >= 0) & (dest < num_bins), dest, num_bins)
     return jnp.bincount(sanitized,
@@ -135,7 +175,7 @@ def _segsum_kernel(seg_ref, val_ref, out_ref, *, num_segs_padded: int):
 def segment_sum(seg_ids: jnp.ndarray, values: jnp.ndarray,
                 num_segments: int) -> jnp.ndarray:
     """Dispatch: Pallas on TPU when enabled, else jax segment_sum."""
-    if pallas_enabled():
+    if pallas_enabled() and segment_sum_ok(num_segments, values.shape[0]):
         return segment_sum_pallas(seg_ids, values, num_segments)
     import jax.ops
     safe = jnp.where((seg_ids >= 0) & (seg_ids < num_segments),
@@ -173,3 +213,69 @@ def segment_sum_pallas(seg_ids: jnp.ndarray, values: jnp.ndarray,
         interpret=interpret,
     )(s.reshape(-1, COLS), v.reshape(-1, COLS))
     return out[:num_segments, 0]
+
+
+def _presfill_kernel(h_ref, v_ref, out_ref, *, num_regs_padded: int):
+    from jax.experimental import pallas as pl
+
+    pi = pl.program_id(0)
+
+    @pl.when(pi == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    regs = jax.lax.broadcasted_iota(
+        jnp.int32, (num_regs_padded, COLS), 0)         # [M, COLS]
+    acc = jnp.zeros((num_regs_padded, 1), jnp.float32)
+    for r in range(SUBLANES):                          # static unroll
+        h_r = h_ref[r:r + 1, :]                        # [1, COLS] int32
+        v_r = v_ref[r:r + 1, :]                        # [1, COLS] f32
+        onehot = (regs == h_r).astype(jnp.float32)     # [M, COLS]
+        acc = jnp.maximum(
+            acc, jnp.max(onehot * v_r, axis=1, keepdims=True))
+    out_ref[:] = jnp.maximum(out_ref[:], acc.astype(jnp.int32))
+
+
+def presence_fill_pallas(h: jnp.ndarray, valid: jnp.ndarray,
+                         num_regs: int,
+                         interpret: bool = False) -> jnp.ndarray:
+    """u8 presence registers: out[m] = 1 iff some i has ``h[i] == m``
+    and ``valid[i]`` truthy. Values of ``h`` outside [0, num_regs) are
+    ignored (padding sentinel -1)."""
+    from jax.experimental import pallas as pl
+
+    n = h.shape[0]
+    n_pad = _round_up(max(n, 1), BLOCK)
+    mpad = _round_up(max(num_regs, 1), LANES)
+    hp = jnp.full(n_pad, -1, jnp.int32).at[:n].set(h.astype(jnp.int32))
+    vp = jnp.zeros(n_pad, jnp.float32).at[:n].set(
+        valid.astype(jnp.float32))
+
+    kernel = functools.partial(_presfill_kernel, num_regs_padded=mpad)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // BLOCK,),
+        in_specs=[pl.BlockSpec((SUBLANES, COLS), lambda i: (i, 0)),
+                  pl.BlockSpec((SUBLANES, COLS), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((mpad, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((mpad, 1), jnp.int32),
+        interpret=interpret,
+    )(hp.reshape(-1, COLS), vp.reshape(-1, COLS))
+    return (out[:num_regs, 0] > 0).astype(jnp.uint8)
+
+
+def presence_fill(h: jnp.ndarray, valid: jnp.ndarray,
+                  num_regs: int) -> jnp.ndarray:
+    """Dispatch: Pallas on TPU when enabled and the register column is
+    small enough that one-hot compares beat XLA's scatter, else the
+    scatter-max fallback (bit-identical — presence is 0/1, no float
+    reassociation). This is the device analog of the reference's
+    Golomb-coded fingerprint columns (duplicate detection,
+    arXiv:1608.05634): the pre-shuffle presence registers that
+    location-detect and dup-detect fill before any data ships.
+    """
+    if pallas_enabled() and presence_fill_ok(num_regs, h.shape[0]):
+        return presence_fill_pallas(h, valid, num_regs)
+    safe = jnp.where((h >= 0) & (h < num_regs), h, num_regs)
+    return jnp.zeros(num_regs + 1, jnp.uint8).at[safe].max(
+        valid.astype(jnp.uint8))[:num_regs]

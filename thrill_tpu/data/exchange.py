@@ -400,6 +400,44 @@ def _narrow_item_bytes(leaves, spec) -> int:
     return total
 
 
+def leaf_ranges_traced(xs, mask):
+    """Traced helper (inside shard_map): all-reduced [len(xs), 2] int64
+    ``[min, max]`` of each leaf's valid items — the range analysis the
+    phase-B narrowing feeds on. Shared by phase A and by the presorted
+    classify programs (Sort/Merge phase 2), so every phase-B flavor
+    learns from the same math. u64 values past int64.max are clamped
+    BEFORE the int64 cast: they saturate at int64.max, which correctly
+    reads as "cannot narrow" without poisoning the leaf's sticky range
+    when a shard merely happened to be empty."""
+    i64max = np.iinfo(np.int64).max
+    rows = []
+    for x in xs:
+        info = jnp.iinfo(x.dtype)
+        smax = info.max
+        if x.dtype == jnp.uint64:
+            x = jnp.minimum(x, jnp.uint64(i64max))
+            smax = i64max
+        m = mask.reshape((-1,) + (1,) * (x.ndim - 1))
+        lo = lax.pmin(jnp.min(jnp.where(m, x, smax))
+                      .astype(jnp.int64), AXIS)
+        hi = lax.pmax(jnp.max(jnp.where(m, x, info.min))
+                      .astype(jnp.int64), AXIS)
+        rows.append(jnp.stack([lo, hi]))
+    return jnp.stack(rows)
+
+
+def presorted_range_leaves(mex: MeshExec, cap: int, leaves) -> Tuple[int, ...]:
+    """Narrowable leaf indices when a presorted classify program should
+    bolt on the range analysis — the same worth-it policy as phase A
+    (volume gate, W > 1, knob on, no capture in flight)."""
+    W = mex.num_workers
+    if not (W > 1 and xchg_narrow_enabled()
+            and mex.loop_recorder is None
+            and W * cap * leaf_item_bytes(leaves) >= _NARROW_MIN_BYTES):
+        return ()
+    return _narrowable_leaves(leaves)
+
+
 def _ex_cumsum(x):
     return jnp.cumsum(x) - x
 
@@ -466,7 +504,9 @@ def send_counts(dest: jnp.ndarray, W: int) -> jnp.ndarray:
 
 def exchange_presorted(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                        S: np.ndarray, min_cap: int = 1,
-                       ident: Tuple = ()) -> DeviceShards:
+                       ident: Tuple = (),
+                       ranges: Optional[np.ndarray] = None
+                       ) -> DeviceShards:
     """Ship items that are ALREADY grouped by destination.
 
     Public entry for operators whose upstream order makes destinations
@@ -476,10 +516,14 @@ def exchange_presorted(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     worker's valid items contiguous per destination in rank order
     (monotone suffices) and W marking invalid slots; ``sorted_leaves``
     are [W, cap, ...] in that same order; ``S[w, d]`` counts w's items
-    bound for d (as produced by ``send_counts``).
+    bound for d (as produced by ``send_counts``). ``ranges`` ([L, 2]
+    int64 over the narrowable leaves, see
+    :func:`presorted_range_leaves`) opts the call into phase-B row
+    narrowing — presorted callers compute it inside their own phase-A
+    program, where the data is already resident.
     """
     return _exchange_planned(mex, treedef, sorted_dest, sorted_leaves, S,
-                             min_cap=min_cap, ident=ident)
+                             min_cap=min_cap, ident=ident, ranges=ranges)
 
 
 def _phase_a(shards: DeviceShards, dest_builder: Callable,
@@ -538,30 +582,8 @@ def _phase_a(shards: DeviceShards, dest_builder: Callable,
             outs = (sorted_dest[None], all_send,
                     *[sl[None] for sl in sorted_ls])
             if nidx:
-                i64max = np.iinfo(np.int64).max
-                rows = []
-                for li in nidx:
-                    x = ls[li][0]
-                    info = jnp.iinfo(x.dtype)
-                    smax = info.max
-                    if x.dtype == jnp.uint64:
-                        # clamp values AND the empty-shard sentinel
-                        # BEFORE the int64 cast: u64 quantities past
-                        # int64.max would wrap negative and corrupt
-                        # the pmin — clamped they saturate at
-                        # int64.max, which correctly reads as "cannot
-                        # narrow" without poisoning the leaf's sticky
-                        # range when a shard merely happened to be
-                        # empty
-                        x = jnp.minimum(x, jnp.uint64(i64max))
-                        smax = i64max
-                    m = mask.reshape((-1,) + (1,) * (x.ndim - 1))
-                    lo = lax.pmin(jnp.min(jnp.where(m, x, smax))
-                                  .astype(jnp.int64), AXIS)
-                    hi = lax.pmax(jnp.max(jnp.where(m, x, info.min))
-                                  .astype(jnp.int64), AXIS)
-                    rows.append(jnp.stack([lo, hi]))
-                outs = outs + (jnp.stack(rows),)
+                outs = outs + (leaf_ranges_traced(
+                    [ls[li][0] for li in nidx], mask),)
             return outs
 
         from jax.sharding import PartitionSpec as P
@@ -905,6 +927,14 @@ def leaf_item_bytes(leaves) -> int:
 # Override with THRILL_TPU_XCHG_BYTES_EQ.
 _BYTES_EQ_MEASURED = {"cpu": 45_000}
 _BYTES_EQ_FALLBACK = 1 << 20
+# Exchange bandwidth (bytes/s) for the LIVE calibration below — the
+# other factor of BYTES_EQ. The launch-overhead factor is measured on
+# this very mesh (the dispatch-latency spine); bandwidth stays a
+# baked platform constant because measuring it needs a sized payload
+# sweep (benchmarks/exchange_crossover.py), not a passive observer.
+_BYTES_EQ_BANDWIDTH = {"cpu": 378e6}
+_BYTES_EQ_BANDWIDTH_FALLBACK = 4e9      # TPU ICI order of magnitude
+_BYTES_EQ_MIN_SAMPLES = 256
 
 
 def _bytes_eq(mex: MeshExec) -> int:
@@ -916,7 +946,39 @@ def _bytes_eq(mex: MeshExec) -> int:
         except ValueError:
             pass
     platform = mex.devices[0].platform if mex.devices else "cpu"
-    return _BYTES_EQ_MEASURED.get(platform, _BYTES_EQ_FALLBACK)
+    static = _BYTES_EQ_MEASURED.get(platform, _BYTES_EQ_FALLBACK)
+    # Live calibration: the dispatch-latency spine's running minimum
+    # (parallel/mesh.py) is this mesh's pure launch overhead — compile
+    # calls and data-bound dispatches are strictly slower, so the min
+    # converges on it from above. BYTES_EQ = overhead * bandwidth, so a
+    # machine 4x slower than the constants were measured on flips the
+    # dense/1-factor choice where its hardware actually crosses over.
+    # Clamped to [static/4, static*4] (the min is an estimate, not a
+    # license to leave the measured regime) and gated on a sample count
+    # so fresh meshes — including every plan-choice test — keep the
+    # deterministic static constant. THRILL_TPU_XCHG_BYTES_EQ_CAL=0
+    # pins the static value regardless of history.
+    if (os.environ.get("THRILL_TPU_XCHG_BYTES_EQ_CAL", "1") != "0"
+            and getattr(mex, "_disp_lat_n", 0) >= _BYTES_EQ_MIN_SAMPLES):
+        bw = _BYTES_EQ_BANDWIDTH.get(platform,
+                                     _BYTES_EQ_BANDWIDTH_FALLBACK)
+        cal = int(mex._disp_lat_min * bw)
+        cal = max(static // 4, min(cal, static * 4))
+        led = _decisions.ledger_of(mex)
+        if led is not None and led.enabled \
+                and not getattr(mex, "_bytes_eq_logged", False):
+            # once per mesh: the drift of the live measurement vs the
+            # baked constant, audited immediately (actual = static)
+            mex._bytes_eq_logged = True
+            rec = led.record(
+                "bytes_eq", "xchg:bytes_eq", "calibrated",
+                predicted=cal, rejected=[("static", static)],
+                reason="launch-min %.0fus x %s bw"
+                       % (mex._disp_lat_min * 1e6, platform),
+                samples=int(mex._disp_lat_n))
+            led.resolve(rec, static)
+        return cal
+    return static
 
 
 def _strategy_costs(mex: MeshExec, S: np.ndarray,
@@ -1313,8 +1375,14 @@ def _dispatch_chunked(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                 counts_dev, flag = out[0], out[1]
                 accs = list(out[2:])
             else:
-                call = fn.donating(acc_pos) if donate and acc_pos \
-                    else fn
+                if donate and acc_pos:
+                    call = fn.donating(acc_pos)
+                    # aliasing is real here (non-CPU, no capture): count
+                    # the chunk handoffs whose accumulators were donated
+                    # so benchmarks can report measured donation traffic
+                    mex.stats_xchg_donated += len(acc_pos)
+                else:
+                    call = fn
                 accs = list(call(sorted_dest, smat, *sorted_leaves,
                                  *accs))
     mex.stats_padded_rows += W * M_pad
@@ -1512,12 +1580,22 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                          items=int(S.sum()))
         led.resolve(rec, (int(S.sum()) - int(np.trace(S)))
                     * item_bytes)
+    # the narrow spec is derived ONCE, before the strategy branch, and
+    # keyed by the DENSE cap_ident — every phase-B flavor (dense
+    # chunked, 1-factor rounds, ragged) shares one learned range store
+    # per site. Synced paths union the current ranges in, so the spec
+    # covers this exchange's data by construction (cast is exact, no
+    # in-trace guard needed); the chunk-0 overflow guard remains on
+    # the optimistic dense path, which trusts ranges it did not fetch.
+    narrow = _pack_degraded(_spec_from_ranges(
+        mex, cap_ident, sorted_leaves,
+        _narrowable_leaves(sorted_leaves), ranges))
     with _trace.span_of(getattr(mex, "tracer", None), "exchange",
                         "synced", mode=mode):
         if mode == "ragged":
             mex._xchg_plan[cap_ident] = "sync"
             return _exchange_ragged(mex, treedef, sorted_leaves, S,
-                                    min_cap)
+                                    min_cap, narrow=narrow)
         if mode == "onefactor" or skew:
             # a skew-flipped site stays synced: the dense-vs-1-factor
             # decision needs the host S, which the optimistic path
@@ -1525,15 +1603,12 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
             mex._xchg_plan[cap_ident] = "sync"
             return _exchange_onefactor(mex, treedef, sorted_dest,
                                        sorted_leaves, S, min_cap,
-                                       ident=ident)
+                                       ident=ident, narrow=narrow)
 
         M_pad, out_cap = _sticky_caps(
             mex, cap_ident,
             (max(int(S.max()), 1), max(int(R.max()), min_cap, 1)))
         mex._xchg_plan[cap_ident] = "dense"
-        narrow = _pack_degraded(_spec_from_ranges(
-            mex, cap_ident, sorted_leaves,
-            _narrowable_leaves(sorted_leaves), ranges))
         smat = smat_dev if smat_dev is not None else \
             mex.put_small(S.astype(np.int32), replicated=True)
         out_leaves, _counts_dev, _flag = _dispatch_chunked(
@@ -1545,7 +1620,8 @@ def _exchange_planned(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
 
 def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                         S: np.ndarray, min_cap: int = 1,
-                        ident: Tuple = ()) -> DeviceShards:
+                        ident: Tuple = (),
+                        narrow=None) -> DeviceShards:
     """Skew-proof dense exchange: W-1 ``ppermute`` rounds, one partner
     per round, each round padded only to ITS pair maximum.
 
@@ -1569,12 +1645,18 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     caps = _sticky_caps(mex, cap_ident, needed)
     M_rounds, out_cap = caps[:-1], caps[-1]
     mex.stats_padded_rows += sum(M_rounds)
-    of_bytes = W * sum(M_rounds) * leaf_item_bytes(sorted_leaves)
-    mex.stats_bytes_wire_device += of_bytes
-    mex.stats_bytes_wire_device_raw += of_bytes
+    # rounds ship at the narrowed width; _raw keeps the full-width
+    # equivalent (the two halves of wire_compress_ratio)
+    of_rows = W * sum(M_rounds)
+    mex.stats_bytes_wire_device += of_rows * _narrow_item_bytes(
+        sorted_leaves, narrow)
+    mex.stats_bytes_wire_device_raw += of_rows * leaf_item_bytes(
+        sorted_leaves)
 
     key_b = ("xchg_of", cap, M_rounds, out_cap, mex.num_slices, treedef,
+             narrow,
              tuple((l.dtype, l.shape[2:]) for l in sorted_leaves))
+    wide_dts = [l.dtype for l in sorted_leaves]
 
     def build_b():
         def fb(sdest, srow, scol, *ls):
@@ -1586,10 +1668,19 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
             roff = _ex_cumsum(S_col)
             i = jnp.arange(cap)
             widx = lax.axis_index(AXIS)
+            raw = [l[0] for l in ls]
+            if narrow is not None:
+                # cast eligible leaves to their learned narrow dtype
+                # before any round ships; the spec covers this data
+                # (synced plan, ranges union'd), so the round-trip
+                # cast is exact
+                raw = [x if narrow[li] is None
+                       else x.astype(np.dtype(narrow[li]))
+                       for li, x in enumerate(raw)]
             if rowmove.enabled():
-                xs, metas = rowmove.pack_leaves([l[0] for l in ls])
+                xs, metas = rowmove.pack_leaves(raw)
             else:
-                xs, metas = [l[0] for l in ls], [None] * len(ls)
+                xs, metas = raw, [None] * len(raw)
             outs = [jnp.zeros((out_cap + 1,) + x.shape[1:], x.dtype)
                     for x in xs]
             # identity round: local scatter, no communication
@@ -1616,9 +1707,13 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
                     buf = buf.at[send_idx].set(x)[:M_r]
                     recv = lax.ppermute(buf, AXIS, perm=perm)
                     outs[li] = outs[li].at[pos].set(recv)
-            return tuple(
-                rowmove.unpack_rows(o[:out_cap], m)[None]
-                for o, m in zip(outs, metas))
+            res = []
+            for li, (o, m) in enumerate(zip(outs, metas)):
+                y = rowmove.unpack_rows(o[:out_cap], m)
+                if y.dtype != wide_dts[li]:
+                    y = y.astype(wide_dts[li])     # widen back
+                res.append(y[None])
+            return tuple(res)
 
         return mex.smap(fb, 3 + len(sorted_leaves))
 
@@ -1630,10 +1725,13 @@ def _exchange_onefactor(mex: MeshExec, treedef, sorted_dest, sorted_leaves,
     return DeviceShards(mex, tree, new_counts)
 
 
-def _ragged_builder(mex: MeshExec, out_cap: int, num_leaves: int):
+def _ragged_builder(mex: MeshExec, out_cap: int, num_leaves: int,
+                    narrow=None):
     """The jitted ragged-exchange program (shared by the execution path
     and by :func:`lower_ragged_exchange`, which plan-validates it on
-    builds whose XLA backend cannot execute the op)."""
+    builds whose XLA backend cannot execute the op). ``narrow`` casts
+    eligible leaves to their learned narrow dtype before the collective
+    and widens after (exact: the synced spec covers the data)."""
 
     def f(srow, scol, olanding, *ls):
         from ..core import rowmove
@@ -1645,13 +1743,21 @@ def _ragged_builder(mex: MeshExec, out_cap: int, num_leaves: int):
         out_off = olanding[0].astype(jnp.int32)
         pack = rowmove.enabled()
         outs = []
-        for l in ls:
-            x, m = rowmove.pack_rows(l[0]) if pack else (l[0], None)
+        for li, l in enumerate(ls):
+            x0 = l[0]
+            wide_dt = x0.dtype
+            nd = narrow[li] if narrow is not None else None
+            if nd is not None:
+                x0 = x0.astype(np.dtype(nd))
+            x, m = rowmove.pack_rows(x0) if pack else (x0, None)
             out = jnp.zeros((out_cap,) + x.shape[1:], x.dtype)
             res = lax.ragged_all_to_all(
                 x, out, in_off, S_row, out_off, S_col,
                 axis_name=AXIS)
-            outs.append(rowmove.unpack_rows(res, m)[None])
+            y = rowmove.unpack_rows(res, m)
+            if y.dtype != wide_dt:
+                y = y.astype(wide_dt)              # widen back
+            outs.append(y[None])
         return tuple(outs)
 
     return mex.smap(f, 3 + num_leaves)
@@ -1675,7 +1781,7 @@ def _warn_ragged_untested(mex: MeshExec) -> None:
 
 
 def _exchange_ragged(mex: MeshExec, treedef, sorted_leaves, S: np.ndarray,
-                     min_cap: int = 1) -> DeviceShards:
+                     min_cap: int = 1, narrow=None) -> DeviceShards:
     """TPU fast path: ``lax.ragged_all_to_all`` — no per-pair padding.
 
     Phase-A output is already destination-contiguous, which is exactly
@@ -1688,16 +1794,19 @@ def _exchange_ragged(mex: MeshExec, treedef, sorted_leaves, S: np.ndarray,
     _warn_ragged_untested(mex)
     R = S.sum(axis=0)
     new_counts = R.astype(np.int64)
-    # ragged ships exactly the off-diagonal items — no padding tax
-    ragged_bytes = ((int(S.sum()) - int(np.trace(S)))
-                    * leaf_item_bytes(sorted_leaves))
-    mex.stats_bytes_wire_device += ragged_bytes
-    mex.stats_bytes_wire_device_raw += ragged_bytes
+    # ragged ships exactly the off-diagonal items — no padding tax;
+    # narrowed widths on the device counter, full width on _raw
+    ragged_items = int(S.sum()) - int(np.trace(S))
+    mex.stats_bytes_wire_device += ragged_items * _narrow_item_bytes(
+        sorted_leaves, narrow)
+    mex.stats_bytes_wire_device_raw += ragged_items * leaf_item_bytes(
+        sorted_leaves)
     out_cap = round_up_pow2(max(int(R.max()), min_cap, 1))
-    key = ("xchg_ragged", out_cap, treedef,
+    key = ("xchg_ragged", out_cap, treedef, narrow,
            tuple((l.dtype, l.shape[1:]) for l in sorted_leaves))
     fb = mex.cached(key, lambda: _ragged_builder(mex, out_cap,
-                                                 len(sorted_leaves)))
+                                                 len(sorted_leaves),
+                                                 narrow=narrow))
     srow = mex.put_small(S.astype(np.int32))
     scol = mex.put_small(S.T.copy().astype(np.int32))
     # landing[w, d] = sum of S[0:w, d] (receiver-side offset of w's chunk)

@@ -18,6 +18,8 @@ SPMD from the start.
 from __future__ import annotations
 
 import functools
+import threading
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -51,6 +53,25 @@ AXIS = "w"
 # RPC, a preempted PJRT stream — retries safely under the shared
 # backoff policy before surfacing
 _F_DISPATCH = faults.declare("api.mesh.dispatch")
+
+# Trace-time back-channel: while a program dispatches (including its
+# FIRST call, when jax traces the python builder), the owning mesh and
+# the _CountedJit being run are visible here. Plan choke points that
+# live INSIDE traced builders (core/device_sort.py's engine choice)
+# use this to reach the decision ledger / planner without threading a
+# mex handle through every functional signature.
+_TL = threading.local()
+
+
+def current_mex() -> Optional["MeshExec"]:
+    """The MeshExec whose program is currently dispatching (or being
+    traced) on this thread; None outside a dispatch."""
+    return getattr(_TL, "mex", None)
+
+
+def current_program() -> Optional["_CountedJit"]:
+    """The _CountedJit currently dispatching on this thread."""
+    return getattr(_TL, "prog", None)
 
 
 class _CountedJit:
@@ -88,6 +109,12 @@ class _CountedJit:
         self._adm_est: Optional[Tuple[int, int]] = None
         self._donate_base: Optional["_CountedJit"] = None
         self._trace_label: Optional[str] = None
+        # sort-engine decisions recorded while THIS program traced
+        # (core/device_sort.py via current_program()); resolved with
+        # the first post-compile dispatch latency (the tracing call's
+        # wall time is compile, not dispatch)
+        self._engine_recs: list = []
+        self._engine_armed = False
         functools.update_wrapper(self, jitted, updated=())
 
     def _label(self) -> str:
@@ -122,29 +149,58 @@ class _CountedJit:
             # output+workspace bytes and pre-spill cold cached shards
             # when the governor ledger says HBM is near the watermark
             pres.admit(self, args)
+        prev_mex = getattr(_TL, "mex", None)
+        prev_prog = getattr(_TL, "prog", None)
+        _TL.mex, _TL.prog = mex, self
+        t0 = time.perf_counter()
         try:
-            if not faults.REGISTRY.active():
-                # disarmed hot path: dispatch-per-iteration is the
-                # budgeted cost in this codebase — no policy
-                # construction, no env reads beyond active()'s one
-                out = self._jitted(*args, **kwargs)
-            else:
-                def dispatch():
-                    faults.check(_F_DISPATCH)
-                    faults.check(_pressure._F_OOM)
-                    return self._jitted(*args, **kwargs)
+            try:
+                if not faults.REGISTRY.active():
+                    # disarmed hot path: dispatch-per-iteration is the
+                    # budgeted cost in this codebase — no policy
+                    # construction, no env reads beyond active()'s one
+                    out = self._jitted(*args, **kwargs)
+                else:
+                    def dispatch():
+                        faults.check(_F_DISPATCH)
+                        faults.check(_pressure._F_OOM)
+                        return self._jitted(*args, **kwargs)
 
-                out = default_policy().run(dispatch,
-                                           what="mesh.dispatch")
-        except Exception as e:
-            # rung 2, OOM-retry: device RESOURCE_EXHAUSTED spills the
-            # LRU cache and re-dispatches (donation disarmed) under
-            # the shared backoff budget; anything else — and every
-            # error with the ladder disabled — re-raises unchanged
-            if not (_pressure.retry_enabled()
-                    and _pressure.is_oom_error(e)):
-                raise
-            out = _pressure.recover_dispatch(self, args, kwargs, e)
+                    out = default_policy().run(dispatch,
+                                               what="mesh.dispatch")
+            except Exception as e:
+                # rung 2, OOM-retry: device RESOURCE_EXHAUSTED spills
+                # the LRU cache and re-dispatches (donation disarmed)
+                # under the shared backoff budget; anything else — and
+                # every error with the ladder disabled — re-raises
+                # unchanged
+                if not (_pressure.retry_enabled()
+                        and _pressure.is_oom_error(e)):
+                    raise
+                out = _pressure.recover_dispatch(self, args, kwargs, e)
+        finally:
+            _TL.mex, _TL.prog = prev_mex, prev_prog
+        # Dispatch-latency spine (ROADMAP planner edge (b)): the
+        # running MIN over calls converges on the pure launch overhead
+        # (trace/compile calls are strictly slower, so min excludes
+        # them); data/exchange.py calibrates bytes_eq from it once
+        # enough samples accumulate. Two perf_counter reads per
+        # dispatch — no allocation, no env reads.
+        dt = time.perf_counter() - t0
+        if dt < mex._disp_lat_min:
+            mex._disp_lat_min = dt
+        mex._disp_lat_n += 1
+        if self._engine_recs:
+            if not self._engine_armed:
+                # this call traced the program (and recorded the
+                # engine decision); its wall time is compile time
+                self._engine_armed = True
+            else:
+                led = mex.decisions
+                if led is not None and led.enabled:
+                    for erec in self._engine_recs:
+                        led.resolve(erec, dt * 1e6)
+                self._engine_recs = []
         if pres is not None and pres.enabled and self._out_bytes is None:
             self._out_bytes = sum(
                 int(getattr(l, "nbytes", 0) or 0)
@@ -234,6 +290,11 @@ class MeshExec:
         # two halves of wire_compress_ratio in overall_stats
         self.stats_bytes_wire_device_raw = 0
         self.stats_bytes_wire_host_saved = 0
+        # chunked-exchange accumulator donation (data/exchange.py
+        # _dispatch_chunked): dispatches that actually armed
+        # donate_argnums on the chunk accumulator — 0 on CPU where
+        # aliasing is never real, >0 on TPU where the HBM reuse pays
+        self.stats_xchg_donated = 0
         # per-exchange-site plan kind ('dense' = optimistic-eligible,
         # 'sync' = the site needs the host plan step every time); the
         # capacity values themselves live in _sticky_caps
@@ -321,6 +382,17 @@ class MeshExec:
         self.exchange_mode = "dense"
         import os as _os
         self._env_exchange = _os.environ.get("THRILL_TPU_EXCHANGE")
+        # Pallas kernel tier knob, resolved ONCE here (same contract
+        # as _env_exchange above): core/pallas_kernels.pallas_enabled()
+        # used to pay an os.environ lookup per call, and it runs inside
+        # traced builders — set THRILL_TPU_PALLAS before constructing
+        # the mesh
+        self._env_pallas = _os.environ.get("THRILL_TPU_PALLAS")
+        # dispatch-latency spine for the planner's live bytes_eq
+        # calibration (edge (b)): running min + sample count, updated
+        # at the _CountedJit choke point
+        self._disp_lat_min = float("inf")
+        self._disp_lat_n = 0
         # slice topology: collectives between same-slice workers ride
         # ICI, cross-slice DCN. Detected from the device objects'
         # slice_index (real multi-slice pods); THRILL_TPU_SLICES=k

@@ -108,6 +108,8 @@ ENV_NOTE = (
     "THRILL_TPU_LOOP_REPLAY", "THRILL_TPU_FORI",
     "THRILL_TPU_NATIVE_RECORDS", "THRILL_TPU_PREFETCH",
     "THRILL_TPU_WRITEBACK",
+    "THRILL_TPU_PALLAS", "THRILL_TPU_SORT_IMPL",
+    "THRILL_TPU_XCHG_BYTES_EQ", "THRILL_TPU_XCHG_BYTES_EQ_CAL",
 )
 
 #: state that is NEVER legitimate during a sentinel measurement — a
@@ -216,6 +218,37 @@ def _chain(ctx):
     ``device_dispatches`` is the contract that catches it."""
     return ctx.Distribute(np.arange(256, dtype=np.int64)).PrefixSum() \
         .Map(_chain_inc).ZipWithIndex().AllGather()
+
+
+def _radix_sort(ctx):
+    """Radix-engine sort lane (ISSUE 19): the sample-sort shape forced
+    through the LSD radix engine (Pallas stable-partition kernel on
+    TPU, the lax.scan partition fallback here). The dispatch/exchange
+    counters pin the engine's program economy — a silent fallback to
+    another engine (or a dead-pass skip regression) moves them."""
+    rng = np.random.default_rng(17)
+    data = rng.integers(0, 1 << 30, size=512).astype(np.int64)
+    got = [int(x) for x in ctx.Distribute(data).Sort().AllGather()]
+    assert got == sorted(int(x) for x in data), "radix_sort diverged"
+
+
+def _ss_key(t):
+    return t["k"]
+
+
+def _segsum(ctx):
+    """Additive FieldReduce lane (ISSUE 19): an f32 'sum' fold, the
+    shape the segment-sum kernel serves on TPU (scatter-add fallback
+    here — counters are engine-independent). ReduceByKey's shuffle +
+    fold economy is this workload's contract."""
+    from ..api.functors import FieldReduce
+    rng = np.random.default_rng(19)
+    n = 768
+    ks = rng.integers(0, 48, size=n).astype(np.int64)
+    vs = (rng.random(n) * 4).astype(np.float32)
+    out = ctx.Distribute({"k": ks, "v": vs}).ReduceByKey(
+        _ss_key, FieldReduce({"k": "first", "v": "sum"})).AllGather()
+    assert len(out) == len(set(int(k) for k in ks)), "segsum diverged"
 
 
 def _em_sort(ctx):
@@ -360,6 +393,8 @@ def _serve(ctx):
 WORKLOADS: Dict[str, Callable] = {
     "wordcount": _wordcount,
     "sort": _sort,
+    "radix_sort": _radix_sort,
+    "segsum": _segsum,
     "join": _joinish,
     "chain": _chain,
     "em_sort": _em_sort,
@@ -373,6 +408,12 @@ WORKLOADS: Dict[str, Callable] = {
 #: workload needs a deterministic spill regime — a forced run size and
 #: a floor-pinned resident budget — regardless of the rig's RAM
 ENV_PINS: Dict[str, Dict[str, str]] = {
+    # the radix lane forces its engine; both new ISSUE-19 lanes pin
+    # the bytes_eq calibration off so the dense/1-factor choice never
+    # depends on this rig's measured launch overhead
+    "radix_sort": {"THRILL_TPU_SORT_IMPL": "radix",
+                   "THRILL_TPU_XCHG_BYTES_EQ_CAL": "0"},
+    "segsum": {"THRILL_TPU_XCHG_BYTES_EQ_CAL": "0"},
     "em_sort": {"THRILL_TPU_HOST_SORT_RUN": "256",
                 "THRILL_TPU_SPILL_RESIDENT": "64K"},
     # the resume pair needs the SAME forced run size on both legs so
