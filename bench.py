@@ -437,6 +437,11 @@ def _run_bench() -> None:
     # under a live job stream, in its own forced-multi-device process
     el = _elastic_metric()
 
+    # supervised process-elasticity lane (ISSUE 20): the same walk as
+    # a drain -> seal -> relaunch-with-resume move on real processes
+    # under supervise.sh, autoscaler-driven, front-door traffic live
+    elp = _elastic_proc_metric()
+
     # Pallas/narrowing A/B lanes (ISSUE 19): same Sort pipeline under
     # flipped single knobs, one process per leg
     ab = _pallas_ab_metric()
@@ -444,7 +449,7 @@ def _run_bench() -> None:
     _emit(value=round(mrec_s, 3),
           vs_baseline=round(mrec_s / host_mrec_s, 3),
           **wc, **prm, **kmm, **sfm, **em, **emr, **ema, **ck,
-          **sv, **fdm, **el, **ab)
+          **sv, **fdm, **el, **elp, **ab)
     ctx.close()
 
 
@@ -1476,6 +1481,161 @@ def _elastic_metric() -> dict:
                 (out.stderr or "no ELASTIC line")[-200:]}
     except Exception as e:  # secondary metric never kills the line
         return {"resize_error": repr(e)[:200]}
+
+
+_ELASTIC_PROC_CODE = r'''
+import json
+import os
+import time
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+from thrill_tpu.common.platform import force_cpu_platform
+
+force_cpu_platform()
+
+import numpy as np
+
+from thrill_tpu.api import Context
+from thrill_tpu.api.context import ResizeRelaunch
+from thrill_tpu.common.config import Config
+from thrill_tpu.parallel.mesh import MeshExec
+from thrill_tpu.service.autoscale import AutoscalePolicy, Autoscaler
+from thrill_tpu.service.client import FrontDoorClient
+from thrill_tpu.service.front_door import FrontDoor
+
+HOT = {"queue_depth": 99, "jobs_rejected": 0, "jobs_in_flight": 2,
+       "serve_p99_ms": 0.0}
+IDLE = {"queue_depth": 0, "jobs_rejected": 0, "jobs_in_flight": 0,
+        "serve_p99_ms": 0.0}
+
+
+def _wc(c, args):
+    hist = c.Distribute(np.arange(256, dtype=np.int64)).Map(
+        lambda x: (x % 7, 1)).ReducePair(lambda a, b: a + b)
+    return sorted([int(k), int(v)] for k, v in hist.AllGather())
+
+
+ck = os.environ["THRILL_TPU_CKPT_DIR"]
+phase = int(os.environ.get("THRILL_TPU_SUPERVISE_ROUND", "0"))
+w = int(os.environ.get("THRILL_TPU_RESIZE_W", "2"))
+resumed = os.environ.get("THRILL_TPU_RESUME") == "1"
+
+ctx = Context(MeshExec(num_workers=w), config=Config(ckpt_dir=ck),
+              resume=resumed)
+out = {"phase": phase, "w": w}
+d = ctx.Distribute(np.arange(1 << 10, dtype=np.int64)).Map(
+    lambda x: x * 3 + 1).Checkpoint("stage")
+d.Keep(4)
+d.Execute()
+
+# the move clock spans two processes: the exiting phase stamps
+# wall time right before ResizeRelaunch, the resumed phase reads
+# it back once its state is restored and serving again
+stamp = os.path.join(ck, "bench_move_t0.json")
+if resumed and os.path.isfile(stamp):
+    with open(stamp) as f:
+        rec = json.load(f)
+    os.remove(stamp)
+    out["move_s"] = round(time.time() - rec["t"], 4)
+    out["move_to"] = rec["to"]
+    out["resume_skipped_ops"] = int(
+        ctx.overall_stats().get("resume_skipped_ops", 0))
+
+# live front-door traffic: a real loopback socket client with jobs
+# still in flight when the move begins (the drain resolves them)
+fd = FrontDoor(ctx, port=0)
+fd.register("wc", _wc)
+cli = FrontDoorClient("127.0.0.1", fd.port, tenant="bench")
+want = cli.submit("wc", None).result(300)
+live = [cli.submit("wc", None) for _ in range(2)]
+for j in live:
+    # admitted but unread: the move's drain must finish these (a
+    # submit still in the socket gets a draining reject instead —
+    # not the in-flight shape this lane times)
+    j.wait_accepted(60)
+
+if phase >= 2:
+    assert all(j.result(300) == want for j in live)
+    cli.close()
+    print("ELASTIC_PROC " + json.dumps(out), flush=True)
+    ctx.close()
+else:
+    a = Autoscaler(ctx, policy=AutoscalePolicy(
+        min_w=2, max_w=3, up_queue=8, confirm_ticks=2,
+        idle_ticks=2, cooldown_ticks=0))
+    target = None
+    for m in [HOT] * 4 if phase == 0 else [IDLE] * 4:
+        target = a.observe(m, ctx.num_workers)
+        if target is not None:
+            break
+    assert target == (3 if phase == 0 else 2), target
+    out["decisions"] = a.decisions_made
+    try:
+        ctx.resize_processes(target, state=d)
+    except ResizeRelaunch:
+        # the drain already resolved the in-flight socket jobs
+        assert all(j.result(30) == want for j in live)
+        out["seal_s"] = round(ctx.stats_resize_time_s, 4)
+        with open(stamp, "w") as f:
+            json.dump({"t": time.time(), "to": target}, f)
+        print("ELASTIC_PROC " + json.dumps(out), flush=True)
+        raise
+    raise AssertionError("resize_processes returned")
+'''
+
+
+def _elastic_proc_metric() -> dict:
+    """Supervised process-elasticity lane (ISSUE 20): a 2-process-
+    shaped run under run-scripts/supervise.sh walks W=2->3->2 through
+    the REAL autoscaling policy (injected hot/idle metric sequences)
+    with live front-door socket traffic in flight at each move —
+    reports the full move walls (exit-to-serving-again, up and down:
+    the relaunch + RESIZE-epoch resume cost process elasticity adds
+    over the in-process fenced resize above) and the policy decision
+    count. Out-of-process like the elastic micro-lane, plus the
+    supervisor in between."""
+    import shutil
+    import tempfile
+    sup = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "run-scripts", "supervise.sh")
+    td = tempfile.mkdtemp(prefix="ttpu-bench-elproc-")
+    env = dict(os.environ)
+    for k in ("XLA_FLAGS", "THRILL_TPU_RESUME", "THRILL_TPU_RESIZE_W",
+              "THRILL_TPU_SERVE_QUEUE", "THRILL_TPU_AUTOSCALE_S"):
+        env.pop(k, None)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "THRILL_TPU_CKPT_DIR": os.path.join(td, "ck"),
+                # the in-flight jobs compile fresh XLA programs at the
+                # new W; don't let a loaded rig turn a slow compile
+                # into a spurious drain abort
+                "THRILL_TPU_RESIZE_TIMEOUT_S": "120"})
+    try:
+        out = subprocess.run(
+            ["bash", sup, "-n", "2", "--", sys.executable, "-c",
+             _ELASTIC_PROC_CODE],
+            env=env, capture_output=True, text=True, timeout=1200)
+        lines = [json.loads(l[len("ELASTIC_PROC "):])
+                 for l in out.stdout.splitlines()
+                 if l.startswith("ELASTIC_PROC ")]
+        if out.returncode != 0 or len(lines) != 3:
+            return {"resize_proc_error":
+                    (out.stderr or "bad phase count")[-200:]}
+        up = next(l for l in lines if l.get("move_to") == 3)
+        down = next(l for l in lines if l.get("move_to") == 2)
+        return {
+            "resize_proc_up_s": up["move_s"],
+            "resize_proc_down_s": down["move_s"],
+            # in-process share of the moves (drain+seal+gate+marker)
+            "resize_proc_seal_s": round(sum(
+                l.get("seal_s", 0.0) for l in lines), 4),
+            "autoscale_decisions": sum(
+                l.get("decisions", 0) for l in lines),
+        }
+    except Exception as e:  # secondary metric never kills the line
+        return {"resize_proc_error": repr(e)[:200]}
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
 
 
 def _ckpt_metric(n: int) -> dict:

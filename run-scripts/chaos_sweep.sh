@@ -72,6 +72,18 @@ if [[ "${CHAOS_SURVIVE:-0}" == "1" ]]; then
   # -m 'not slow' keeps only one representative seed per fault class
   TARGETS+=(tests/api/test_survive.py tests/net/test_generation.py)
 fi
+if [[ "${CHAOS_ELASTIC:-0}" == "1" ]]; then
+  # supervised process-elasticity sweep (ISSUE 20): the chaos-marked
+  # cases in tests/net/test_resize_proc.py arm the three move sites
+  # (ckpt.resize_manifest, net.group.relaunch, svc.autoscale.decide)
+  # across seeded drain->seal->gate->marker attempts — every armed
+  # fire must leave NOTHING mutated (width, generation, marker) and
+  # the clean retry must commit the whole move; the SIGKILL-mid-move
+  # window (kill between marker commit and relaunch exit) rides along
+  # via the supervised acceptance in the same file. N_SEEDS scales
+  # the site sweep via THRILL_TPU_ELASTIC_SEEDS.
+  TARGETS+=(tests/net/test_resize_proc.py)
+fi
 if [[ "${CHAOS_SERVE:-0}" == "1" ]]; then
   # service-plane sweep (tests/service/, chaos-marked): seeded fault
   # classes fired into a serving Context — every failed job must
@@ -105,6 +117,7 @@ exec env JAX_PLATFORMS=cpu THRILL_TPU_CHAOS_SEEDS="$N_SEEDS" \
     THRILL_TPU_CHAOS_KILL_SEEDS="$N_SEEDS" \
     THRILL_TPU_SURVIVE_SEEDS="$N_SEEDS" \
     THRILL_TPU_SERVE_SEEDS="$N_SEEDS" \
+    THRILL_TPU_ELASTIC_SEEDS="$N_SEEDS" \
     THRILL_TPU_FLIGHT_DIR="$FLIGHT_DIR" \
     THRILL_TPU_FLIGHT_KEEP="${THRILL_TPU_FLIGHT_KEEP:-10000}" \
     python -m pytest -m chaos -q -p no:cacheprovider \

@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
-# Supervised re-launch: survive worker loss by restarting with resume.
+# Supervised re-launch: survive worker loss by restarting with resume,
+# and complete committed elastic resize moves (exit code 75).
 #
 # Usage:
 #   THRILL_TPU_CKPT_DIR=/shared/ckpt run-scripts/supervise.sh \
-#       [-n MAX_RESTARTS] -- <command> [args...]
+#       [-n MAX_RESTARTS] [-w NPROCS] -- <command> [args...]
 #
 # Runs <command> (a thrill_tpu job — typically one rank of a
 # RunDistributed launch, or a whole single-host Run). If it exits
@@ -14,6 +15,25 @@
 # post-checkpoint work. Without THRILL_TPU_CKPT_DIR the relaunch
 # simply recomputes from scratch.
 #
+# Elastic resize (Context.resize_processes): a worker that commits a
+# resize move exits 75 (RESIZE_EXIT_CODE) with a RESIZE.json marker in
+# the checkpoint dir naming the target W. The supervisor reads the
+# marker, adopts the new width (and, in -w mode, the new process
+# count), and relaunches with resume — a FREE relaunch, no restart
+# budget consumed. A crash AFTER the marker committed (SIGKILL between
+# seal and relaunch) is the crash path + marker path combined: the
+# attempt is charged to the restart budget, but the relaunch still
+# honors the marker, so the move completes instead of reviving the old
+# W. The marker is cleared by the resumed run itself once it comes up
+# at the target W; the width stays sticky here (THRILL_TPU_RESIZE_W)
+# so later crash-restarts keep W' even after the marker is gone.
+#
+# -w NPROCS spawns NPROCS copies of <command> per round with
+# THRILL_TPU_RANK=r / THRILL_TPU_NPROC=N exported, reaps them all, and
+# treats the round as a resize round if ANY child exited 75. Each
+# round also exports THRILL_TPU_SUPERVISE_ROUND so children can derive
+# fresh ports per relaunch (TIME_WAIT hygiene).
+#
 # The in-process analog (single-controller jobs and tests) is
 # thrill_tpu.api.RunSupervised. Cluster launchers (launch_ssh.sh /
 # launch_slurm.sbatch) can wrap their per-rank command in this script
@@ -23,34 +43,107 @@
 set -uo pipefail
 
 MAX_RESTARTS=3
+NPROCS=0                      # 0 = single-command mode
 while [[ $# -gt 0 ]]; do
   case "$1" in
     -n) MAX_RESTARTS="$2"; shift 2 ;;
+    -w) NPROCS="$2"; shift 2 ;;
     --) shift; break ;;
     *)  break ;;
   esac
 done
 
 if [[ $# -eq 0 ]]; then
-  echo "usage: supervise.sh [-n MAX_RESTARTS] -- <command> [args...]" >&2
+  echo "usage: supervise.sh [-n MAX_RESTARTS] [-w NPROCS]" \
+       "-- <command> [args...]" >&2
   exit 2
 fi
 
+MARKER="${THRILL_TPU_CKPT_DIR:-}/RESIZE.json"
+
+# "W P" from the marker (target_w target_procs), empty on any problem
+read_marker() {
+  python3 - "$1" 2>/dev/null <<'PY'
+import json, sys
+try:
+    m = json.load(open(sys.argv[1]))
+    print(int(m["target_w"]), int(m.get("target_procs") or 1))
+except Exception:
+    pass
+PY
+}
+
 attempt=0
+round=0
 while :; do
-  if [[ $attempt -gt 0 ]]; then
-    export THRILL_TPU_RESUME=1
-    echo "supervise: restart $attempt/$MAX_RESTARTS (resume enabled," \
-         "ckpt dir: ${THRILL_TPU_CKPT_DIR:-<unset: recompute>})" >&2
+  export THRILL_TPU_SUPERVISE_ROUND=$round
+  resize=0
+  if [[ $NPROCS -gt 0 ]]; then
+    pids=()
+    for ((r = 0; r < NPROCS; r++)); do
+      THRILL_TPU_RANK=$r THRILL_TPU_NPROC=$NPROCS "$@" &
+      pids+=($!)
+    done
+    rc=0
+    for pid in "${pids[@]}"; do
+      wait "$pid"; crc=$?
+      if [[ $crc -eq 75 ]]; then
+        resize=1
+      elif [[ $crc -ne 0 && $rc -eq 0 ]]; then
+        rc=$crc
+      fi
+    done
+  else
+    "$@"
+    rc=$?
+    if [[ $rc -eq 75 ]]; then resize=1; rc=0; fi
   fi
-  "$@"
-  rc=$?
+  round=$((round + 1))
+
+  target=""
+  if [[ -n "${THRILL_TPU_CKPT_DIR:-}" && -f "$MARKER" ]]; then
+    target="$(read_marker "$MARKER")"
+  fi
+  if [[ $resize -eq 1 && -z "$target" ]]; then
+    # exit 75 with no readable marker: the move never committed —
+    # plain crash semantics
+    resize=0
+    [[ $rc -eq 0 ]] && rc=75
+  fi
+
+  if [[ -n "$target" && ( $resize -eq 1 || $rc -ne 0 ) ]]; then
+    tw="${target%% *}"
+    tp="${target##* }"
+    if [[ $rc -ne 0 ]]; then
+      # SIGKILL (or any crash) after the marker committed: charge the
+      # restart budget, but still complete the move
+      attempt=$((attempt + 1))
+      if [[ $attempt -gt $MAX_RESTARTS ]]; then
+        echo "supervise: giving up after $MAX_RESTARTS restarts" \
+             "(rc=$rc, resize to W=$tw still pending)" >&2
+        exit "$rc"
+      fi
+      echo "supervise: crash (rc=$rc) with committed resize marker;" \
+           "completing move to W=$tw on restart $attempt/$MAX_RESTARTS" >&2
+    else
+      echo "supervise: resize move committed; relaunching at W=$tw" \
+           "(procs=$tp, resume enabled)" >&2
+    fi
+    export THRILL_TPU_RESIZE_W="$tw"
+    [[ $NPROCS -gt 0 ]] && NPROCS="$tp"
+    export THRILL_TPU_RESUME=1
+    continue
+  fi
+
   [[ $rc -eq 0 ]] && exit 0
   attempt=$((attempt + 1))
   if [[ $attempt -gt $MAX_RESTARTS ]]; then
     echo "supervise: giving up after $MAX_RESTARTS restarts (rc=$rc)" >&2
     exit "$rc"
   fi
-  echo "supervise: command failed (rc=$rc); relaunching in 2s" >&2
+  export THRILL_TPU_RESUME=1
+  echo "supervise: command failed (rc=$rc); restart $attempt/$MAX_RESTARTS" \
+       "in 2s (resume enabled, ckpt dir:" \
+       "${THRILL_TPU_CKPT_DIR:-<unset: recompute>})" >&2
   sleep 2
 done

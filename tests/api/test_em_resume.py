@@ -60,7 +60,7 @@ def _expect():
 
 
 def _manifests(ck):
-    return sorted(glob.glob(os.path.join(ck, "em_runs", "*", "*.json")))
+    return sorted(glob.glob(os.path.join(ck, "em_runs", "*", "run_*.json")))
 
 
 def test_runs_commit_and_resume_reuses_all(tmp_path):
@@ -210,3 +210,105 @@ def test_sigkill_midsort_relaunch_reuses_committed_runs(tmp_path):
     s1 = IO.snapshot()
     assert out == _expect()
     assert s1["runs_reused"] - s0["runs_reused"] == committed
+
+
+# -- orphan-run adoption (elastic mesh, ISSUE 20) -------------------------
+
+def _dead_pid():
+    """A pid guaranteed dead: a child that already exited and was
+    reaped cannot be signalled (``os.kill(pid, 0)`` raises)."""
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait()
+    return p.pid
+
+
+def _orphan_store(ck):
+    """Re-own every signature dir of a populated store to a dead pid,
+    as a departed rank's store looks to its replacement."""
+    from thrill_tpu.core import em_runs
+    pid = _dead_pid()
+    sigs = sorted(glob.glob(os.path.join(ck, "em_runs", "*")))
+    assert sigs
+    for sdir in sigs:
+        with open(os.path.join(sdir, "OWNER.json"), "w") as f:
+            json.dump({"pid": pid}, f)
+    return sigs
+
+
+def test_orphan_adoption_by_replacement_joiner(tmp_path, monkeypatch):
+    from thrill_tpu.core import em_runs
+    monkeypatch.setattr(em_runs, "_adopted", 0)
+    ck = str(tmp_path / "ck")
+    RunLocalMock(_job, 2, config=Config(ckpt_dir=ck))
+    formed = len(_manifests(ck))
+    sigs = _orphan_store(ck)
+
+    adopted = em_runs.adopt_orphan_runs(ck, my_rank=0)
+    assert adopted == formed
+    assert em_runs.adopted_total() == formed
+    for sdir in sigs:
+        mark = json.load(open(os.path.join(sdir, "ADOPTED.json")))
+        assert mark["by_pid"] == os.getpid()
+        owner = json.load(open(os.path.join(sdir, "OWNER.json")))
+        assert owner["pid"] == os.getpid()
+
+    # the ADOPTED store loads its runs WITHOUT global resume mode —
+    # "adopts them instead of re-forming them", mechanically
+    s0 = IO.snapshot()
+    stats = {}
+
+    def job(ctx):
+        out = _job(ctx)
+        stats.update(ctx.overall_stats())
+        return out
+
+    assert RunLocalMock(job, 2, config=Config(ckpt_dir=ck)) == _expect()
+    s1 = IO.snapshot()
+    assert s1["spill_runs"] - s0["spill_runs"] == 0
+    assert s1["runs_reused"] - s0["runs_reused"] == formed
+    assert stats["runs_adopted"] == formed
+
+    # a second scan is idempotent: everything already claimed
+    assert em_runs.adopt_orphan_runs(ck, my_rank=0) == 0
+
+
+def test_adoption_skips_live_owner_and_other_ranks(tmp_path,
+                                                   monkeypatch):
+    from thrill_tpu.core import em_runs
+    monkeypatch.setattr(em_runs, "_adopted", 0)
+    ck = str(tmp_path / "ck")
+    RunLocalMock(_job, 2, config=Config(ckpt_dir=ck))
+    # owner records written by the run itself name THIS live process:
+    # not orphans, nothing to adopt
+    assert em_runs.adopt_orphan_runs(ck, my_rank=0) == 0
+    # a live FOREIGN owner is not an orphan either
+    for sdir in glob.glob(os.path.join(ck, "em_runs", "*")):
+        with open(os.path.join(sdir, "OWNER.json"), "w") as f:
+            json.dump({"pid": os.getppid()}, f)
+    assert em_runs.adopt_orphan_runs(ck, my_rank=0) == 0
+    # dead owner but the WRONG rank id: the signature suffix pins the
+    # input partition to its rank, so rank 1 adopts nothing from _h0
+    _orphan_store(ck)
+    assert em_runs.adopt_orphan_runs(ck, my_rank=1) == 0
+    assert em_runs.adopted_total() == 0
+    assert not glob.glob(os.path.join(ck, "em_runs", "*",
+                                      "ADOPTED.json"))
+
+
+def test_adoption_verifies_each_run_and_skips_damage(tmp_path,
+                                                     monkeypatch):
+    from thrill_tpu.core import em_runs
+    monkeypatch.setattr(em_runs, "_adopted", 0)
+    ck = str(tmp_path / "ck")
+    RunLocalMock(_job, 2, config=Config(ckpt_dir=ck))
+    formed = len(_manifests(ck))
+    assert formed >= 2
+    _orphan_store(ck)
+    bad = _manifests(ck)[0]
+    with open(bad.replace(".json", ".bin"), "r+b") as f:
+        f.truncate(3)                       # bin shorter than manifested
+    ev0 = len(faults.REGISTRY.events)
+    adopted = em_runs.adopt_orphan_runs(ck, my_rank=0)
+    assert adopted == formed - 1            # damaged run NOT claimed
+    assert any(e.get("what") == "em_runs.adopt_skipped_run"
+               for e in faults.REGISTRY.events[ev0:])

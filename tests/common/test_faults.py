@@ -10,6 +10,8 @@ corruption. A new ``faults.declare`` without a matrix entry fails
 ``test_every_registered_site_is_covered``.
 """
 
+import glob
+import json
 import os
 import socket
 import threading
@@ -1087,6 +1089,117 @@ def _ex_em_run_manifest():
         f.close()
 
 
+def _ex_ckpt_resize_manifest():
+    """ckpt.resize_manifest (api/checkpoint.py): BOTH stages of the
+    process-resize move fire before any byte lands. stage=seal — a
+    failed seal leaves no epoch directory and the retried seal commits
+    a W'-worker epoch tagged with the resize provenance. stage=marker
+    — a failed marker commit leaves no RESIZE.json (the move never
+    happened; relaunch heals at the old W), and the retry lands a
+    marker the supervisor can complete."""
+    import tempfile
+
+    from thrill_tpu.api import Context
+    from thrill_tpu.api.checkpoint import pending_resize_target
+    from thrill_tpu.common.config import Config
+    from thrill_tpu.parallel.mesh import MeshExec
+
+    with tempfile.TemporaryDirectory() as td:
+        ck = os.path.join(td, "ck")
+        ctx = Context(MeshExec(num_workers=2),
+                      config=Config(ckpt_dir=ck))
+        try:
+            d = ctx.Distribute(np.arange(48, dtype=np.int64)).Map(
+                lambda x: x * 2 + 1)
+            d.Keep(4)
+            want = sorted(int(x) for x in d.AllGather())
+            node = d.node
+            assert node._shards is not None
+            # stage=seal fires at entry: no epoch dir, live data intact
+            with faults.inject("ckpt.resize_manifest", n=1):
+                try:
+                    ctx.checkpoint.seal_resize(node, node._shards, 3)
+                    assert False, "armed seal did not fire"
+                except faults.InjectedFault:
+                    pass
+            assert not glob.glob(os.path.join(ck, "epoch_*"))
+            assert sorted(int(x) for x in d.AllGather()) == want
+            # clean retry: a committed W'=3 epoch with resize provenance
+            ep = ctx.checkpoint.seal_resize(node, node._shards, 3)
+            mpath = glob.glob(os.path.join(ck, "epoch_*",
+                                           "MANIFEST.json"))
+            assert len(mpath) == 1
+            man = json.loads(open(mpath[0]).read())
+            assert man["workers"] == 3
+            assert man["resize"] == {"from": 2, "to": 3}
+            # stage=marker fires BEFORE the write: no RESIZE.json
+            with faults.inject("ckpt.resize_manifest", n=1):
+                try:
+                    ctx.checkpoint.commit_resize_marker(
+                        3, epoch=ep, generation=2, procs=1)
+                    assert False, "armed marker did not fire"
+                except faults.InjectedFault:
+                    pass
+            assert pending_resize_target(ck) is None
+            # retry: the marker lands and names the full move
+            ctx.checkpoint.commit_resize_marker(
+                3, epoch=ep, generation=2, procs=1)
+            mark = pending_resize_target(ck)
+            assert mark["target_w"] == 3 and mark["epoch"] == ep
+        finally:
+            ctx.close()
+
+
+def _ex_net_group_relaunch():
+    """net.group.relaunch (net/group.py): the relaunch gate fires
+    BEFORE its (mutation-free) agreement — width and generation hold
+    exactly, and the clean retry settles the move's generation while
+    leaving membership intact (every process exits for the supervised
+    relaunch; nothing to mutate)."""
+    from thrill_tpu.net import mock as mock_net
+
+    net = mock_net.MockNetwork(1)
+    g = net.group(0)
+    g.begin_generation(1)
+    with faults.inject("net.group.relaunch", n=1, seed=11):
+        try:
+            g.prepare_relaunch(2, 2)
+            assert False, "armed relaunch gate did not fire"
+        except ConnectionError:
+            pass
+    assert g.num_hosts == 1 and g.generation == 1
+    # clean retry: generation settles for the move, membership
+    # untouched (the relaunch, not this gate, changes the process set)
+    g.prepare_relaunch(2, 2)
+    assert g.num_hosts == 1 and g.generation == 2
+    assert g.all_reduce(5, lambda a, b: a + b) == 5
+
+
+def _ex_autoscale_decide():
+    """svc.autoscale.decide (service/autoscale.py): fires at the top
+    of the tick, before the sample and before any counter moves — the
+    failed tick mutates NOTHING (tick count, streaks, cooldown,
+    decision count all hold) and the clean retry advances normally."""
+    from thrill_tpu.service.autoscale import (AutoscalePolicy,
+                                              Autoscaler)
+
+    a = Autoscaler(policy=AutoscalePolicy(min_w=1, max_w=4,
+                                          confirm_ticks=1,
+                                          idle_ticks=99))
+    a.tick()
+    before = (a._tick, a._hot, a._idle, a._cooldown, a.decisions_made)
+    with faults.inject("svc.autoscale.decide", n=1):
+        try:
+            a.tick()
+            assert False, "armed decide gate did not fire"
+        except faults.InjectedFault:
+            pass
+    assert (a._tick, a._hot, a._idle, a._cooldown,
+            a.decisions_made) == before
+    a.tick()
+    assert a._tick == before[0] + 1
+
+
 # sites whose exercisers live in tests/net/test_fault_injection.py
 # (they need real sockets / multi-rank groups)
 _NET_SITES = {
@@ -1170,6 +1283,12 @@ _MATRIX = {
     "vfs.http.write": _ex_vfs_http_sites,
     "vfs.http.list": _ex_vfs_http_sites,
     "em.run.manifest": _ex_em_run_manifest,
+    # supervised process elasticity (ISSUE 20): every step of the
+    # drain -> seal -> gate -> marker -> relaunch move proves
+    # nothing-mutated-on-failure, then clean retry
+    "ckpt.resize_manifest": _ex_ckpt_resize_manifest,
+    "net.group.relaunch": _ex_net_group_relaunch,
+    "svc.autoscale.decide": _ex_autoscale_decide,
 }
 
 

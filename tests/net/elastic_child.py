@@ -68,8 +68,28 @@ def _member(rank, hosts, flags):
     return out
 
 
+def _leave_orphan_store(ckpt_dir):
+    """One committed EM run under this (about to die) process's
+    ownership — the replacement joiner must ADOPT it, not re-form it."""
+    import zlib
+    sdir = os.path.join(ckpt_dir, "em_runs", "n1_sort_w3_r10_t100_h2")
+    os.makedirs(sdir, exist_ok=True)
+    body = b"\x42" * 64
+    with open(os.path.join(sdir, "run_000000.bin"), "wb") as f:
+        f.write(body)
+    with open(os.path.join(sdir, "run_000000.json"), "w") as f:
+        json.dump({"slot": 0, "pos0": 0, "n": 10, "fp": 7,
+                   "crc": zlib.crc32(body) & 0xFFFFFFFF,
+                   "bin_bytes": len(body), "has_keys": False}, f)
+    with open(os.path.join(sdir, "OWNER.json"), "w") as f:
+        json.dump({"pid": os.getpid()}, f)
+
+
 def _doomed_joiner(hosts, flags):
     _await(flags, ["m0.w2", "m1.w2"])
+    ckpt_dir = os.environ.get("THRILL_TPU_CKPT_DIR", "")
+    if ckpt_dir:
+        _leave_orphan_store(ckpt_dir)
     # the transport handshake COMPLETES on both members; the death
     # lands between it and the generation barrier that would commit
     # the membership — the members must roll back and heal
@@ -85,6 +105,10 @@ def _replacement_joiner(hosts, flags):
                        secret=SECRET)
     g.begin_generation(3)
     out = {"rank": 3}
+    # the joiner replaces the DEAD rank 2: join_tcp_group adopted the
+    # orphaned run store it left behind (identity-verified, claimed)
+    from thrill_tpu.core.em_runs import adopted_total
+    out["runs_adopted"] = adopted_total()
     out["grown_gen"] = g.generation
     out["sum_w3"] = g.all_reduce(g.my_rank + 1, lambda a, b: a + b)
     out["gather_w3"] = g.all_gather(g.my_rank * 10)

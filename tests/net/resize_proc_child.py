@@ -1,0 +1,148 @@
+"""Child body for the supervised process-resize acceptance
+(test_resize_proc.py), launched UNDER run-scripts/supervise.sh:
+
+  bash run-scripts/supervise.sh -n 2 -- python resize_proc_child.py
+
+One launch = one PHASE of the 2 -> 3 -> 2 move; the phase counter
+lives in TEST_STATE_DIR (the supervisor relaunches the same command,
+so the child discovers its role from durable state, exactly like a
+production relaunch would):
+
+* phase 0 (W=2, fresh): run the job, checkpoint it, then drive the
+  scale-UP through the real autoscaling policy on an injected hot
+  metric sequence — the confirmed decision calls
+  ``ctx.resize_processes(3, state=...)``, which seals the RESIZE
+  epoch, commits the marker and exits 75 for the supervisor.
+* phase 1 (W=3, resumed): the relaunch restored the RESIZE epoch
+  through the standard resume path (asserted via resume_skipped_ops,
+  result bit-identical to phase 0) and consumed the marker; a
+  sustained-idle injected sequence then drives the scale-DOWN to 2.
+* phase 2 (W=2, resumed): verify once more and exit 0 clean.
+
+Test hook ``TEST_KILL_AFTER_MARKER=1``: phase 0 SIGKILLs itself right
+after the marker commit returns — the SIGKILL between seal and
+relaunch. The supervisor must treat it as crash + committed marker:
+charge the restart budget but COMPLETE the move at W'=3 (phase 1 then
+verifies and exits clean).
+
+Prints one ``PHASE {json}`` line per launch; the parent parses them
+from the supervisor's aggregate stdout.
+"""
+
+import json
+import os
+import signal
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+from thrill_tpu.common.platform import force_cpu_platform
+
+force_cpu_platform()
+
+import numpy as np  # noqa: E402
+
+from thrill_tpu.api import Context  # noqa: E402
+from thrill_tpu.api import checkpoint as _ck  # noqa: E402
+from thrill_tpu.common.config import Config  # noqa: E402
+from thrill_tpu.parallel.mesh import MeshExec  # noqa: E402
+from thrill_tpu.service.autoscale import (AutoscalePolicy,  # noqa: E402
+                                          Autoscaler)
+
+N = 96
+
+HOT = {"queue_depth": 99, "jobs_rejected": 0, "jobs_in_flight": 3,
+       "serve_p99_ms": 0.0}
+IDLE = {"queue_depth": 0, "jobs_rejected": 0, "jobs_in_flight": 0,
+        "serve_p99_ms": 0.0}
+
+
+def _bump_phase(state_dir):
+    path = os.path.join(state_dir, "phase")
+    try:
+        with open(path) as f:
+            phase = int(f.read())
+    except (OSError, ValueError):
+        phase = -1
+    phase += 1
+    with open(path, "w") as f:
+        f.write(str(phase))
+    return phase
+
+
+def _decide(ctx, samples, policy):
+    """Feed the injected metric sequence through the REAL policy
+    until a decision confirms; returns the target W."""
+    a = Autoscaler(ctx, policy=policy)
+    for m in samples:
+        target = a.observe(m, ctx.num_workers)
+        if target is not None:
+            return target
+    raise AssertionError(
+        f"policy produced no decision over {len(samples)} samples")
+
+
+def main():
+    state_dir = os.environ["TEST_STATE_DIR"]
+    ck = os.environ["THRILL_TPU_CKPT_DIR"]
+    phase = _bump_phase(state_dir)
+    w = int(os.environ.get("THRILL_TPU_RESIZE_W", "2"))
+    resumed = os.environ.get("THRILL_TPU_RESUME") == "1"
+    kill_mode = os.environ.get("TEST_KILL_AFTER_MARKER") == "1"
+
+    if kill_mode and phase == 0:
+        orig = _ck.CheckpointManager.commit_resize_marker
+
+        def commit_then_die(self, *a, **kw):
+            path = orig(self, *a, **kw)
+            sys.stdout.flush()
+            os.kill(os.getpid(), signal.SIGKILL)  # no goodbye protocol
+            return path
+
+        _ck.CheckpointManager.commit_resize_marker = commit_then_die
+
+    ctx = Context(MeshExec(num_workers=w),
+                  config=Config(ckpt_dir=ck), resume=resumed)
+    d = ctx.Distribute(np.arange(N, dtype=np.int64)) \
+        .Map(lambda x: x * 3 + 1).Checkpoint("stage")
+    d.Keep(4)
+    out = sorted(int(x) for x in d.AllGather())
+    stats = ctx.overall_stats()
+
+    print("PHASE " + json.dumps({
+        "phase": phase, "w": w, "resumed": resumed,
+        "round": int(os.environ.get("THRILL_TPU_SUPERVISE_ROUND",
+                                    "-1")),
+        "result": out,
+        "resume_skipped_ops": stats.get("resume_skipped_ops", 0),
+        "marker_pending": os.path.isfile(
+            os.path.join(ck, "RESIZE.json")),
+    }), flush=True)
+
+    policy = AutoscalePolicy(min_w=2, max_w=3, up_queue=8,
+                             confirm_ticks=2, idle_ticks=2,
+                             cooldown_ticks=0)
+    if phase == 0:
+        assert w == 2 and not resumed
+        target = _decide(ctx, [HOT] * 4, policy)
+        assert target == 3, target
+        ctx.resize_processes(target, state=d)   # raises SystemExit(75)
+        raise AssertionError("resize_processes returned")
+    if phase == 1:
+        assert w == 3 and resumed
+        assert stats.get("resume_skipped_ops", 0) >= 1, \
+            "relaunch did not restore the RESIZE epoch"
+        if kill_mode:
+            ctx.close()                          # move completed: done
+            return
+        target = _decide(ctx, [IDLE] * 4, policy)
+        assert target == 2, target
+        ctx.resize_processes(target, state=d)
+        raise AssertionError("resize_processes returned")
+    assert phase == 2 and w == 2 and resumed
+    assert stats.get("resume_skipped_ops", 0) >= 1
+    ctx.close()
+
+
+if __name__ == "__main__":
+    main()

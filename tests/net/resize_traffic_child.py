@@ -1,0 +1,146 @@
+"""Child body for the REAL-PROCESS supervised elasticity acceptance
+(test_resize_proc_traffic.py), launched under
+``run-scripts/supervise.sh -w NPROCS``.
+
+Each supervisor round is one phase of the 2 -> 3 -> 2 process move;
+every rank runs this same body (standard SPMD). The supervisor
+exports THRILL_TPU_RANK / THRILL_TPU_NPROC / THRILL_TPU_SUPERVISE_ROUND
+per round; the parent pre-allocates a port pool (TEST_PORTS) and each
+round carves its own coordinator + hostlist slice from it (fresh
+ports per relaunch — TIME_WAIT hygiene).
+
+The job submits LIVE scheduler traffic (the lockstep multi-controller
+submit path), reads some futures, leaves others IN FLIGHT, and then
+drives the resize through the real autoscaling policy on an injected
+metric sequence. ``resize_processes`` drains the service plane first,
+so by the time the move is committed every outstanding JobFuture has
+resolved — the child records their values from inside the
+``ResizeRelaunch`` window and re-raises so the process still exits 75
+for the supervisor.
+
+``TEST_FIXED_W=1`` turns the child into a fixed-W reference run: same
+job, no traffic-driven resize, exit 0 — the parent compares the
+elastic run's results against these bit-for-bit.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+from thrill_tpu.common.platform import force_cpu_platform
+
+force_cpu_platform()
+
+import numpy as np  # noqa: E402
+
+from thrill_tpu.api import RunDistributed  # noqa: E402
+from thrill_tpu.api.context import ResizeRelaunch  # noqa: E402
+from thrill_tpu.common.timeouts import scaled  # noqa: E402
+from thrill_tpu.service.autoscale import (AutoscalePolicy,  # noqa: E402
+                                          Autoscaler)
+
+N = 64
+
+HOT = {"queue_depth": 99, "jobs_rejected": 0, "jobs_in_flight": 3,
+       "serve_p99_ms": 0.0}
+IDLE = {"queue_depth": 0, "jobs_rejected": 0, "jobs_in_flight": 0,
+        "serve_p99_ms": 0.0}
+
+
+def _emit(out):
+    """One atomic pipe write per RESULT line: every rank shares the
+    supervisor's stdout, and print()'s separate text/newline writes
+    interleave across ranks (a line under PIPE_BUF written in ONE
+    os.write never does)."""
+    os.write(1, ("RESULT " + json.dumps(out) + "\n").encode())
+
+
+def _wordcount(mod):
+    def fn(ctx):
+        vals = np.arange(400, dtype=np.int64)
+        hist = ctx.Distribute(vals).Map(lambda x: (x % mod, 1)) \
+            .ReducePair(lambda a, b: a + b)
+        return sorted([int(k), int(v)] for k, v in hist.AllGather())
+    return fn
+
+
+def _decide(ctx, samples, policy):
+    a = Autoscaler(ctx, policy=policy)
+    for m in samples:
+        target = a.observe(m, ctx.num_workers)
+        if target is not None:
+            return target
+    raise AssertionError("policy produced no decision")
+
+
+def job(ctx):
+    rnd = int(os.environ.get("THRILL_TPU_SUPERVISE_ROUND", "0"))
+    fixed = os.environ.get("TEST_FIXED_W") == "1"
+    out = {"round": rnd, "w": ctx.num_workers,
+           "resumed": os.environ.get("THRILL_TPU_RESUME") == "1"}
+
+    d = ctx.Distribute(np.arange(N, dtype=np.int64)) \
+        .Map(lambda x: x * 7 + 3).Checkpoint("stage")
+    d.Keep(4)
+    out["base"] = sorted(int(x) for x in d.AllGather())
+
+    # live traffic: every rank submits the SAME jobs in the same
+    # order (the lockstep multi-controller contract)
+    futs = {name: ctx.submit(_wordcount(m), tenant=t, name=name)
+            for name, m, t in (("a1", 5, "alpha"), ("b1", 7, "beta"),
+                               ("a2", 3, "alpha"), ("b2", 11, "beta"))}
+    # read two now; a2/b2 stay IN FLIGHT when the move begins
+    out["early"] = {k: futs.pop(k).result(scaled(180))
+                    for k in ("a1", "b1")}
+    stats = ctx.overall_stats()
+    out["resume_skipped_ops"] = stats.get("resume_skipped_ops", 0)
+    out["runs_adopted"] = stats.get("runs_adopted", 0)
+
+    policy = AutoscalePolicy(min_w=2, max_w=3, up_queue=8,
+                             confirm_ticks=2, idle_ticks=2,
+                             cooldown_ticks=0)
+    if fixed or rnd >= 2:
+        out["late"] = {k: f.result(scaled(180)) for k, f in futs.items()}
+        _emit(out)
+        return out
+    target = _decide(ctx, [HOT] * 4 if rnd == 0 else [IDLE] * 4,
+                     policy)
+    assert target == (3 if rnd == 0 else 2), target
+    out["autoscale_target"] = target
+    try:
+        ctx.resize_processes(target, state=d)
+    except ResizeRelaunch:
+        # the drain resolved every in-flight future before the seal:
+        # their values are already final, bit-identical or bust
+        out["late"] = {k: f.result(0) for k, f in futs.items()}
+        out["inflight_resolved_by_drain"] = all(
+            f.done() for f in futs.values())
+        out["resizes_proc"] = ctx.stats_resizes_proc
+        _emit(out)
+        raise
+    raise AssertionError("resize_processes returned")
+
+
+def main():
+    if os.environ.get("TEST_FAULTHANDLER"):
+        import faulthandler
+        faulthandler.dump_traceback_later(
+            int(os.environ["TEST_FAULTHANDLER"]), exit=False)
+    rank = int(os.environ["THRILL_TPU_RANK"])
+    nproc = int(os.environ["THRILL_TPU_NPROC"])
+    rnd = int(os.environ.get("THRILL_TPU_SUPERVISE_ROUND", "0"))
+    ports = os.environ["TEST_PORTS"].split()
+    block = ports[rnd * 4:(rnd + 1) * 4]
+    coordinator = f"127.0.0.1:{block[0]}"
+    os.environ["THRILL_TPU_HOSTLIST"] = " ".join(
+        f"127.0.0.1:{p}" for p in block[1:1 + nproc])
+    RunDistributed(
+        job, coordinator_address=coordinator, num_processes=nproc,
+        process_id=rank,
+        resume=os.environ.get("THRILL_TPU_RESUME") == "1")
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,9 @@ def _launch_elastic(flags_dir):
             + env.get("PYTHONPATH", ""),
             "THRILL_TPU_ELASTIC_HOSTS": hostlist,
             "THRILL_TPU_ELASTIC_FLAGS": flags_dir,
+            # the doomed joiner leaves an orphaned EM run store here;
+            # the replacement joiner must ADOPT it on join
+            "THRILL_TPU_CKPT_DIR": os.path.join(flags_dir, "ck"),
             # bound the members' barrier wait against the killed
             # joiner: the doomed grow must FAIL fast, not sit out the
             # default 30s heal budget twice
@@ -147,6 +150,9 @@ def test_rank_join_and_leave_on_real_tcp_with_sigkill_mid_resize(
     assert m0 == {**m1, "rank": 0}
     assert r3["sum_w3"] == 6 and r3["gather_w3"] == [0, 10, 20]
     assert r3["grown_gen"] == 3
+    # the replacement joiner adopted the dead rank 2's orphaned run
+    # store instead of leaving it to be re-formed
+    assert r3["runs_adopted"] == 1
 
 
 # ----------------------------------------------------------------------

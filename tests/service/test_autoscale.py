@@ -1,0 +1,213 @@
+"""Autoscaling policy (service/autoscale.py): deterministic,
+tick-counted decisions pinned by injected metric sequences — no wall
+clocks anywhere in the core assertions.
+
+Pinned acceptance:
+
+* scale-up fires on EXACTLY the ``confirm_ticks``-th consecutive hot
+  sample (queue depth, reject delta, or p99 watermark), never on a
+  single spike;
+* scale-down fires on exactly the ``idle_ticks``-th consecutive idle
+  sample, and both directions respect the [min_w, max_w] clamp;
+* every decision opens a ``cooldown_ticks`` window in which no second
+  decision lands — but streaks keep counting through it, so a
+  sustained condition fires on the first eligible tick;
+* decisions land in the decision ledger (kind=autoscale) and in
+  ``ctx.explain()``;
+* the ``svc.autoscale.decide`` fault site proves
+  nothing-mutated-on-failure then clean retry;
+* the live thread (maybe_start / THRILL_TPU_AUTOSCALE_S) applies a
+  real decision through ``ctx.resize`` on a single-process mesh.
+"""
+
+import time
+
+import pytest
+
+from thrill_tpu.api import Context
+from thrill_tpu.common import faults
+from thrill_tpu.parallel.mesh import MeshExec
+from thrill_tpu.service.autoscale import (Autoscaler, AutoscalePolicy,
+                                          maybe_start)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    for k in ("THRILL_TPU_AUTOSCALE_S", "THRILL_TPU_AUTOSCALE_MIN_W",
+              "THRILL_TPU_AUTOSCALE_MAX_W",
+              "THRILL_TPU_AUTOSCALE_UP_QUEUE",
+              "THRILL_TPU_AUTOSCALE_CONFIRM",
+              "THRILL_TPU_AUTOSCALE_IDLE_TICKS",
+              "THRILL_TPU_AUTOSCALE_COOLDOWN"):
+        monkeypatch.delenv(k, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+def _m(depth=0, rejected=0, inflight=0, p99=0.0):
+    return {"queue_depth": depth, "jobs_rejected": rejected,
+            "jobs_in_flight": inflight, "serve_p99_ms": p99}
+
+
+HOT = _m(depth=99, inflight=3)
+IDLE = _m()
+
+
+def _policy(**kw):
+    kw.setdefault("min_w", 1)
+    kw.setdefault("max_w", 4)
+    kw.setdefault("up_queue", 8)
+    kw.setdefault("confirm_ticks", 2)
+    kw.setdefault("idle_ticks", 3)
+    kw.setdefault("cooldown_ticks", 2)
+    return AutoscalePolicy(**kw)
+
+
+# -- deterministic core -------------------------------------------------
+
+def test_scale_up_on_exactly_the_confirmation_tick():
+    a = Autoscaler(policy=_policy(confirm_ticks=3))
+    assert a.observe(HOT, 2) is None          # tick 1
+    assert a.observe(HOT, 2) is None          # tick 2
+    assert a.observe(HOT, 2) == 3             # tick 3: confirmed
+    assert a.last_decision["tick"] == 3
+    assert a.last_decision["from_w"] == 2
+    assert a.stats() == {"autoscale_decisions": 1,
+                         "autoscale_ticks": 3}
+
+
+def test_single_spike_never_scales():
+    a = Autoscaler(policy=_policy(confirm_ticks=2))
+    busy = _m(depth=3, inflight=1)            # busy but not idle/hot
+    for sample in (HOT, busy, HOT, busy, HOT, busy):
+        assert a.observe(sample, 2) is None
+    assert a.decisions_made == 0
+
+
+def test_reject_delta_trigger_uses_deltas_not_cumulative():
+    a = Autoscaler(policy=_policy(confirm_ticks=2, up_rejects=1))
+    # first sample only sets the baseline: a restarting policy must
+    # not treat an old cumulative counter as a fresh burst
+    assert a.observe(_m(rejected=100, inflight=1), 2) is None
+    assert a.observe(_m(rejected=101, inflight=1), 2) is None  # hot 1
+    assert a.observe(_m(rejected=103, inflight=1), 2) == 3     # hot 2
+    # flat counter afterwards is not hot
+    a2 = Autoscaler(policy=_policy(confirm_ticks=1, up_rejects=1))
+    assert a2.observe(_m(rejected=100, inflight=1), 2) is None
+    assert a2.observe(_m(rejected=100, inflight=1), 2) is None
+
+
+def test_p99_watermark_disabled_at_zero():
+    a = Autoscaler(policy=_policy(confirm_ticks=1, up_p99_ms=0.0))
+    assert a.observe(_m(p99=10_000.0, inflight=1), 2) is None
+    b = Autoscaler(policy=_policy(confirm_ticks=1, up_p99_ms=500.0))
+    assert b.observe(_m(p99=10_000.0, inflight=1), 2) == 3
+
+
+def test_scale_down_on_exactly_the_idle_tick_and_clamps():
+    a = Autoscaler(policy=_policy(idle_ticks=3, cooldown_ticks=0))
+    assert a.observe(IDLE, 2) is None
+    assert a.observe(IDLE, 2) is None
+    assert a.observe(IDLE, 2) == 1
+    # at min_w the same sustained idle never goes below the floor
+    assert a.observe(IDLE, 1) is None
+    assert a.observe(IDLE, 1) is None
+    assert a.observe(IDLE, 1) is None
+    assert a.decisions_made == 1
+    # and at max_w sustained heat never goes above the ceiling
+    b = Autoscaler(policy=_policy(confirm_ticks=1))
+    assert b.observe(HOT, 4) is None
+
+
+def test_cooldown_suppresses_then_streak_fires_first_eligible_tick():
+    a = Autoscaler(policy=_policy(confirm_ticks=2, cooldown_ticks=2))
+    assert a.observe(HOT, 2) is None          # hot 1
+    assert a.observe(HOT, 2) == 3             # decision, cooldown=2
+    assert a.observe(HOT, 3) is None          # cooldown 2->1 (hot 1)
+    assert a.observe(HOT, 3) is None          # cooldown 1->0 (hot 2)
+    # first eligible tick: streak already >= confirm, fires at once
+    assert a.observe(HOT, 3) == 4
+    assert a.decisions_made == 2
+
+
+def test_interrupted_streaks_reset():
+    a = Autoscaler(policy=_policy(confirm_ticks=2, idle_ticks=2,
+                                  cooldown_ticks=0))
+    assert a.observe(HOT, 2) is None
+    assert a.observe(IDLE, 2) is None         # hot streak broken
+    assert a.observe(HOT, 2) is None          # hot 1 again
+    assert a.observe(_m(depth=1), 2) is None  # neither hot nor idle
+    assert a.observe(IDLE, 2) is None         # idle 1
+    assert a.observe(IDLE, 2) == 1            # idle 2: down
+
+
+# -- audit + fault matrix ----------------------------------------------
+
+def test_decisions_land_in_ledger_and_explain():
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        a = Autoscaler(ctx, policy=_policy(confirm_ticks=1))
+        assert a.observe(HOT, 2) == 3
+        assert ctx.decisions.kind_counts.get("autoscale") == 1
+        assert "autoscale" in ctx.explain()
+    finally:
+        ctx.close()
+
+
+def test_decide_fault_site_mutates_nothing_then_clean_retry():
+    a = Autoscaler(policy=_policy(confirm_ticks=1))
+    a.observe(_m(rejected=7, inflight=1), 2)  # seed baseline + tick 1
+    before = (a._tick, a._hot, a._idle, a._cooldown, a._last_rejected,
+              a.decisions_made)
+    with faults.inject("svc.autoscale.decide", n=1):
+        with pytest.raises(faults.InjectedFault):
+            a.tick()
+    assert (a._tick, a._hot, a._idle, a._cooldown, a._last_rejected,
+            a.decisions_made) == before
+    # clean retry advances normally (ctx-free tick samples all-zero
+    # metrics: one idle tick)
+    assert a.tick() is None
+    assert a._tick == before[0] + 1
+
+
+# -- live side ----------------------------------------------------------
+
+def test_live_thread_applies_decision_through_apply_fn():
+    ctx = Context(MeshExec(num_workers=2))
+    applied = []
+    try:
+        a = Autoscaler(ctx, policy=_policy(idle_ticks=2,
+                                           cooldown_ticks=0),
+                       apply_fn=applied.append, tick_s=0.01).start()
+        deadline = time.monotonic() + 10.0
+        while not applied and time.monotonic() < deadline:
+            time.sleep(0.01)
+        a.stop()
+        assert applied and applied[0] == 1    # idle 2-worker ctx: down
+    finally:
+        ctx.close()
+
+
+def test_maybe_start_off_by_default_and_live_resize(monkeypatch):
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        assert maybe_start(ctx) is None       # no env: no thread
+    finally:
+        ctx.close()
+    monkeypatch.setenv("THRILL_TPU_AUTOSCALE_S", "0.01")
+    monkeypatch.setenv("THRILL_TPU_AUTOSCALE_IDLE_TICKS", "2")
+    monkeypatch.setenv("THRILL_TPU_AUTOSCALE_COOLDOWN", "0")
+    ctx = Context(MeshExec(num_workers=2))
+    try:
+        assert ctx.autoscaler is not None     # wired by __init__
+        deadline = time.monotonic() + 10.0
+        while ctx.stats_resizes == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ctx.num_workers == 1           # idle ctx scaled down
+        stats = ctx.overall_stats()
+        assert stats["autoscale_decisions"] >= 1
+        assert stats["resizes"] >= 1
+    finally:
+        ctx.close()

@@ -1,0 +1,196 @@
+"""Front-door protocol versioning + the resize verdict fence.
+
+Pinned acceptance (satellites of ISSUE 20):
+
+* the hello carries a ``[min, max]`` protocol range; the server
+  negotiates the highest common version into the welcome (with its
+  own supported range), and v2 accept frames carry the mesh
+  generation the job runs under;
+* a legacy client offering a plain int ``proto`` keeps working,
+  negotiated down to v1 with no ``gen`` stamp;
+* an out-of-range (or garbage) offer gets a TYPED
+  ``version_mismatch`` reject naming the supported range — the
+  library client raises the permanent :class:`VersionMismatch`, and
+  the server survives to serve the next client;
+* REGRESSION: a socket submit that reaches its admission verdict
+  while a ``Context.resize`` fence is pending must NOT be told
+  "accept" with the generation the swap is about to invalidate — the
+  verdict waits out the swap and names the post-resize generation.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from thrill_tpu.api import Context
+from thrill_tpu.common import faults
+from thrill_tpu.net.tcp import TcpConnection, _exchange_auth_flag
+from thrill_tpu.parallel.mesh import MeshExec
+from thrill_tpu.service import client as client_mod
+from thrill_tpu.service.client import FrontDoorClient, VersionMismatch
+from thrill_tpu.service.front_door import (PROTO_MAX, PROTO_MIN,
+                                           FrontDoor)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("THRILL_TPU_SERVE_PORT", raising=False)
+    monkeypatch.delenv("THRILL_TPU_SECRET", raising=False)
+    monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults.REGISTRY.reset()
+    yield
+    faults.REGISTRY.reset()
+
+
+@pytest.fixture
+def ctx():
+    c = Context(MeshExec(num_workers=2))
+    yield c
+    c.close()
+
+
+def _echo(ctx2, args):
+    return args
+
+
+def _front(ctx):
+    fd = FrontDoor(ctx, port=0)
+    fd.register("echo", _echo)
+    return fd
+
+
+def _raw_hello(fd, proto, tenant="raw"):
+    """Dial, speak the handshake with an arbitrary ``proto`` offer,
+    and return (conn, first reply frame)."""
+    sock = socket.create_connection(("127.0.0.1", fd.port), timeout=10)
+    conn = TcpConnection(sock)
+    _exchange_auth_flag(conn, False)
+    conn.send(("hello", {"tenant": tenant, "proto": proto}))
+    return conn, conn.recv_deadline(10.0)
+
+
+# -- negotiation ----------------------------------------------------------
+
+def test_v2_negotiation_welcome_range_and_gen_stamped_accept(ctx):
+    fd = _front(ctx)
+    with FrontDoorClient("127.0.0.1", fd.port) as c:
+        assert c.proto == PROTO_MAX == 2
+        assert c.server_range == (PROTO_MIN, PROTO_MAX)
+        job = c.submit("echo", {"x": 1})
+        assert job.result(60) == {"x": 1}
+        # v2 accepts are stamped with the mesh generation
+        assert job.generation == ctx.generation
+    fd.close()
+
+
+def test_v1_int_hello_still_works(ctx):
+    fd = _front(ctx)
+    conn, frame = _raw_hello(fd, proto=1)
+    assert frame[0] == "welcome"
+    assert frame[1]["proto"] == 1                 # negotiated DOWN
+    assert frame[1]["range"] == [PROTO_MIN, PROTO_MAX]
+    conn.send(("submit", {"id": 1, "pipeline": "echo", "args": 7}))
+    accept = conn.recv_deadline(30.0)
+    assert accept[0] == "accept" and accept[1] == 1
+    assert "gen" not in accept[2]                 # no v2 fields leak
+    conn.send(("bye",))
+    conn.close()
+    fd.close()
+
+
+def test_wider_future_range_negotiates_to_server_max(ctx):
+    fd = _front(ctx)
+    conn, frame = _raw_hello(fd, proto=[1, 99])
+    assert frame[0] == "welcome" and frame[1]["proto"] == PROTO_MAX
+    conn.close()
+    fd.close()
+
+
+# -- typed mismatch -------------------------------------------------------
+
+def test_out_of_range_offer_is_typed_reject_then_bye(ctx):
+    fd = _front(ctx)
+    conn, frame = _raw_hello(fd, proto=[PROTO_MAX + 1, PROTO_MAX + 3])
+    assert frame[0] == "reject" and frame[2] == "version_mismatch"
+    assert f"[{PROTO_MIN},{PROTO_MAX}]" in frame[4]
+    bye = conn.recv_deadline(10.0)
+    assert bye[0] == "bye"
+    conn.close()
+    # the server survives: a conforming client gets right in
+    with FrontDoorClient("127.0.0.1", fd.port) as c:
+        assert c.submit("echo", "ok").result(60) == "ok"
+    fd.close()
+
+
+def test_garbage_proto_offer_rejected_not_crashed(ctx):
+    fd = _front(ctx)
+    conn, frame = _raw_hello(fd, proto="banana")
+    assert frame[0] == "reject" and frame[2] == "version_mismatch"
+    conn.close()
+    with FrontDoorClient("127.0.0.1", fd.port) as c:
+        assert c.submit("echo", 1).result(60) == 1
+    fd.close()
+
+
+def test_library_client_raises_permanent_version_mismatch(
+        ctx, monkeypatch):
+    fd = _front(ctx)
+    # a future client whose floor is past this server's ceiling
+    monkeypatch.setattr(client_mod, "PROTO_MIN", PROTO_MAX + 1)
+    monkeypatch.setattr(client_mod, "PROTO_MAX", PROTO_MAX + 2)
+    with pytest.raises(VersionMismatch) as ei:
+        FrontDoorClient("127.0.0.1", fd.port)
+    assert f"[{PROTO_MIN},{PROTO_MAX}]" in str(ei.value)
+    fd.close()
+
+
+# -- resize verdict fence -------------------------------------------------
+
+def test_resize_fence_holds_verdict_until_post_resize_generation(ctx):
+    """The regression this PR fixes: with the dispatcher paused on a
+    running job and a resize fence pending, a socket submit must park
+    BEFORE its admission verdict. Releasing the blocker lets the
+    fenced swap run first; the accept then names the post-resize
+    generation — never the one the swap invalidated."""
+    fd = _front(ctx)
+    gen_before = ctx.generation
+    started, release = threading.Event(), threading.Event()
+
+    def _hold(c2):
+        started.set()
+        release.wait(30)
+
+    try:
+        ctx.submit(_hold, name="hold")
+        assert started.wait(30)           # dispatcher busy: fence waits
+
+        resized = threading.Event()
+
+        def _resize():
+            ctx.resize(1)
+            resized.set()
+
+        t = threading.Thread(target=_resize, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while not fd._fencing and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fd._fencing, "resize fence never closed the gate"
+
+        with FrontDoorClient("127.0.0.1", fd.port) as c:
+            job = c.submit("echo", {"ok": True})
+            # no verdict while the fence is pending
+            with pytest.raises(TimeoutError):
+                job.wait_accepted(0.5)
+            assert job.generation is None
+            release.set()
+            assert resized.wait(60), "fenced resize never completed"
+            job.wait_accepted(60)
+            assert ctx.num_workers == 1
+            assert job.generation == ctx.generation > gen_before
+            assert job.result(60) == {"ok": True}
+    finally:
+        release.set()
+        fd.close()

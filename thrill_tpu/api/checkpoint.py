@@ -64,6 +64,12 @@ from ..vfs import file_io
 
 MANIFEST = "MANIFEST.json"
 _EPOCH_FMT = "epoch_{:06d}"
+#: the commit record of an orchestrated process-level resize
+#: (Context.resize_processes): written atomically AFTER the RESIZE
+#: epoch seals and the net layer agreed to relaunch, consumed by the
+#: supervisor (run-scripts/supervise.sh reads target_w) and cleared by
+#: the relaunched run once it is actually running at the new W
+RESIZE_MARKER = "RESIZE.json"
 
 # checkpoint I/O is idempotent (files are rewritten whole, manifests
 # commit atomically), so transient storage faults retry under the
@@ -78,9 +84,68 @@ _F_MANIFEST = faults.declare("ckpt.manifest")
 # the next resize attempt runs from exactly the same state
 _F_REPART = faults.declare("ckpt.repartition")
 
+# process-level resize (Context.resize_processes): fired at RESIZE-
+# epoch seal entry and again at marker commit, both BEFORE their
+# writes — an injected failure leaves either nothing (seal) or a
+# sealed-but-unannounced epoch an old-W resume rejects by the workers
+# gate (marker), so the caller aborts with the old mesh fully intact
+# and a clean retry runs the identical move
+_F_RESIZE_MANIFEST = faults.declare("ckpt.resize_manifest")
+
 
 def node_key(node) -> str:
     return f"{node.id}:{node.label}"
+
+
+def resize_marker_path(directory: str) -> str:
+    return os.path.join(directory, RESIZE_MARKER)
+
+
+def pending_resize_target(directory: str) -> Optional[dict]:
+    """The committed-but-unconsumed resize marker under ``directory``,
+    or None. Module-level (no Context needed): the supervisor parses
+    ``target_w`` from it before relaunching, and a relaunched child
+    reads it to size its mesh before the Context even exists. A
+    corrupt marker is LOUD and treated as absent — the relaunch then
+    proceeds at the old W, whose epochs are still committed."""
+    path = resize_marker_path(directory)
+    try:
+        if _is_remote(directory):
+            with file_io.OpenReadStream(path) as f:
+                raw = f.read()
+        else:
+            if not os.path.isfile(path):
+                return None
+            with open(path, "rb") as f:
+                raw = f.read()
+        m = json.loads(raw.decode())
+        if int(m.get("target_w", 0)) < 1:
+            raise ValueError(f"bad target_w {m.get('target_w')!r}")
+        return m
+    except FileNotFoundError:
+        return None
+    except (ValueError, KeyError, OSError) as e:
+        import sys
+        print(f"thrill_tpu.checkpoint: ignoring corrupt resize "
+              f"marker {path}: {e}", file=sys.stderr)
+        return None
+
+
+def clear_resize_marker(directory: str) -> bool:
+    """Consume the resize marker (the move completed: the relaunched
+    run is up at the target W). Remote stores have no delete verb on
+    the vfs seam — the relaunched run's workers gate makes a stale
+    remote marker harmless, so this degrades to False."""
+    path = resize_marker_path(directory)
+    if _is_remote(directory):
+        return False
+    try:
+        os.remove(path)
+        return True
+    except FileNotFoundError:
+        return False
+    except OSError:
+        return False
 
 
 def _epoch_num(path: str) -> Optional[int]:
@@ -164,6 +229,21 @@ class CheckpointManager:
                 if log.enabled:
                     log.line(event="resume", epoch=self.resume_epoch,
                              node=self._manifest["node"]["key"])
+            # consume a committed resize marker once the relaunch is
+            # actually UP at the target W: from here the move is
+            # complete and the supervisor must not relaunch again. A
+            # marker for a DIFFERENT W stays (this run is not the
+            # relaunch the move asked for — its epochs are still
+            # gated per-W, so nothing wrong can restore).
+            marker = pending_resize_target(self.dir)
+            if marker is not None and self._host_rank() == 0 \
+                    and int(marker["target_w"]) \
+                    == self.ctx.mesh_exec.num_workers:
+                clear_resize_marker(self.dir)
+                faults.note("recovery", what="ckpt.resize_complete",
+                            target_w=int(marker["target_w"]),
+                            from_w=marker.get("from_w"),
+                            epoch=marker.get("epoch"))
 
     # -- topology helpers ----------------------------------------------
     def _host_rank(self) -> int:
@@ -338,6 +418,161 @@ class CheckpointManager:
                "label": node.label, "kind": "host",
                "counts": counts, "files": files}
         return rec, nbytes
+
+    # ------------------------------------------------------------------
+    # orchestrated process-level resize (Context.resize_processes)
+    # ------------------------------------------------------------------
+    def seal_resize(self, node, shards, target_w: int) -> int:
+        """Seal a RESIZE epoch: ``shards`` re-partitioned to
+        ``target_w`` AT SEAL TIME and written as a ``target_w``-worker
+        epoch. The relaunched W'-wide run then restores through the
+        completely standard resume path — its workers gate
+        (``_try_load_manifest``) matches, and the shard layout is the
+        ``dense_range_bounds`` split a fixed-W' run of the same
+        pipeline would have produced, so every post-resume result is
+        bit-identical to a fixed-W' reference.
+
+        Crash-safety: the ``ckpt.resize_manifest`` site fires at entry
+        before any byte lands; an uncommitted epoch (SIGKILL mid-seal)
+        is swept by ``cleanup_incomplete`` at the next resume; a
+        COMMITTED W' epoch with no marker is rejected by an old-W
+        resume's workers gate — in every case either the old state or
+        the sealed move survives, never a mix."""
+        from ..net.group import poison_on_error
+        grp = self.ctx.net.group if self._multihost() else None
+        with poison_on_error(grp, "ckpt.seal_resize"):
+            return self._seal_resize_guarded(node, shards, target_w)
+
+    def _seal_resize_guarded(self, node, shards, target_w: int) -> int:
+        import jax
+        from ..data.serializer import (deserialize_batch,
+                                       serialize_batch)
+        from ..data.shards import resplit_leaves
+        t0 = time.perf_counter()
+        target_w = int(target_w)
+        old_w = self.ctx.mesh_exec.num_workers
+        faults.check(_F_RESIZE_MANIFEST, stage="seal",
+                     target=target_w, old=old_w)
+        # gather the FULL per-worker view over the host control plane
+        # (each process serializes only its local workers; rank 0 ends
+        # up holding everything and writes every W' shard file — the
+        # joiners of a grow do not exist yet, so nobody else can)
+        if isinstance(shards, DeviceShards):
+            per_worker = shards.to_worker_arrays(local_only=True)
+            _, treedef = jax.tree.flatten(shards.tree)
+            skeleton = jax.tree.unflatten(
+                treedef, list(range(treedef.num_leaves)))
+            local_tab = {
+                w: serialize_leaves([np.asarray(l) for l in
+                                     jax.tree.leaves(per_worker[w])])
+                for w in self._local_workers()
+                if per_worker[w] is not None}
+            kind = "device"
+        elif isinstance(shards, HostShards):
+            skeleton = None
+            local_tab = {w: serialize_batch(list(shards.lists[w]))
+                         for w in self._local_workers()}
+            kind = "host"
+        else:
+            raise TypeError(
+                f"cannot seal {type(shards).__name__} for a resize")
+        if self._multihost():
+            full: Dict[int, bytes] = {}
+            for tab in self.ctx.net.all_gather(local_tab):
+                full.update({int(w): p for w, p in tab.items()})
+        else:
+            full = dict(local_tab)
+        epoch = self._next_epoch
+        self._next_epoch += 1
+        edir = os.path.join(self.dir, _EPOCH_FMT.format(epoch))
+        nbytes = 0
+        if self._host_rank() == 0:
+            if not _is_remote(self.dir):
+                os.makedirs(edir, exist_ok=True)
+            self._inflight_dir = edir
+            if kind == "device":
+                per_worker_leaves = [
+                    deserialize_leaves(full[w]) for w in range(old_w)]
+                new_leaves = resplit_leaves(per_worker_leaves,
+                                            target_w)
+                counts = [int(l[0].shape[0]) if l else 0
+                          for l in new_leaves]
+                payloads = [serialize_leaves(l) for l in new_leaves]
+                rec: Dict[str, Any] = {
+                    "key": node_key(node), "dia_id": node.id,
+                    "label": node.label, "kind": "device",
+                    "counts": counts, "cap": max([1] + counts),
+                    "skeleton": base64.b64encode(
+                        pickle.dumps(skeleton)).decode("ascii")}
+            else:
+                lists = [deserialize_batch(full[w])
+                         for w in range(old_w)]
+                new = HostShards(old_w, lists).repartition(target_w)
+                counts = [len(l) for l in new.lists]
+                payloads = [serialize_batch(l) for l in new.lists]
+                rec = {"key": node_key(node), "dia_id": node.id,
+                       "label": node.label, "kind": "host",
+                       "counts": counts}
+            files: Dict[str, Any] = {}
+            for w in range(target_w):
+                files[str(w)] = self._write_file(
+                    edir, f"n{node.id}.w{w}.bin", payloads[w])
+                nbytes += len(payloads[w])
+            rec["files"] = files
+            manifest = {"format": 1, "epoch": epoch,
+                        "workers": target_w,
+                        "resize": {"from": old_w, "to": target_w},
+                        "node": rec}
+            payload = json.dumps(manifest, sort_keys=True).encode()
+
+            def commit():
+                faults.check(_F_MANIFEST, epoch=epoch)
+                file_io.write_file_atomic(
+                    os.path.join(edir, MANIFEST), payload)
+
+            default_policy().run(commit, what="ckpt.manifest")
+            self._inflight_dir = None
+        if self._multihost():
+            self.ctx.net.barrier()
+        self.epochs_written += 1
+        self.bytes_written += nbytes
+        log = self.ctx.logger
+        if log.enabled:
+            log.line(event="resize_seal", epoch=epoch,
+                     node=node.label, dia_id=node.id,
+                     workers_old=old_w, workers_new=target_w,
+                     bytes=nbytes,
+                     seconds=round(time.perf_counter() - t0, 4))
+        return epoch
+
+    def commit_resize_marker(self, target_w: int,
+                             epoch: Optional[int] = None,
+                             generation: Optional[int] = None,
+                             procs: Optional[int] = None) -> str:
+        """Commit the resize move: the marker's existence tells the
+        supervisor (and any relaunch, however it died) that the move
+        is ON and what W to relaunch at (``target_procs`` is the
+        process count the supervisor's multi-worker mode spawns; the
+        single-child mode re-sizes the one child's mesh to
+        ``target_w`` instead). Atomic (tmp+rename); the fault site
+        fires first, so an injected failure commits nothing and the
+        caller aborts with the old W intact."""
+        faults.check(_F_RESIZE_MANIFEST, stage="marker",
+                     target=int(target_w))
+        payload = json.dumps(
+            {"format": 1, "target_w": int(target_w),
+             "from_w": self.ctx.mesh_exec.num_workers,
+             "target_procs": int(procs) if procs else 1,
+             "epoch": epoch, "generation": generation},
+            sort_keys=True).encode()
+        path = resize_marker_path(self.dir)
+        if self._host_rank() == 0:
+            default_policy().run(
+                lambda: file_io.write_file_atomic(path, payload),
+                what="ckpt.resize_marker")
+        if self._multihost():
+            self.ctx.net.barrier()
+        return path
 
     # ------------------------------------------------------------------
     # resume / restore

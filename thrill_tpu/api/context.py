@@ -39,6 +39,18 @@ def _wire_ratio(raw: int, actual: int) -> float:
     return round(raw / actual, 3) if actual else 1.0
 
 
+def _em_adopted() -> int:
+    """Process-wide count of EM runs adopted from departed ranks
+    (core/em_runs.py). Adoption only ever happens in a rank that
+    joined/relaunched into an elastic group, so this is exactly zero
+    for every non-elastic workload — the perf sentinel pins it."""
+    try:
+        from ..core.em_runs import adopted_total
+        return adopted_total()
+    except Exception:
+        return 0
+
+
 class PipelineError(RuntimeError):
     """One pipeline run on a Context failed — and ONLY that pipeline:
     the Context healed (generation-scoped failure domain) and stays
@@ -59,6 +71,37 @@ class PipelineError(RuntimeError):
         self.cause = cause
         self.generation = generation
         self.root = root
+
+
+# process-level elasticity: the exit code a supervised worker exits
+# with once a resize move is COMMITTED (marker on disk). EX_TEMPFAIL —
+# "try again", which is literally the contract: the supervisor reads
+# the RESIZE marker and relaunches at the target W with resume.
+RESIZE_EXIT_CODE = 75
+
+
+class ResizeRelaunch(SystemExit):
+    """Raised by :meth:`Context.resize_processes` once the move is
+    committed: this process must exit so the supervisor
+    (run-scripts/supervise.sh) can relaunch the job at the target W
+    with ``THRILL_TPU_RESUME=1``. A SystemExit subclass with code
+    ``RESIZE_EXIT_CODE`` — left uncaught it exits the worker with
+    exactly the code the supervisor's resize branch watches for, and
+    no retry policy classifies it transient. Raise it only on the MAIN
+    thread (a SystemExit in a helper thread kills just that thread);
+    autoscaler deployments signal the main loop from ``apply_fn`` and
+    let it call resize_processes."""
+
+    def __init__(self, target_w: int, epoch: Optional[int] = None,
+                 generation: Optional[int] = None) -> None:
+        super().__init__(RESIZE_EXIT_CODE)
+        self.target_w = int(target_w)
+        self.epoch = epoch
+        self.generation = generation
+
+    def __str__(self) -> str:
+        return (f"resize move to W={self.target_w} committed: exiting "
+                f"{RESIZE_EXIT_CODE} for supervised relaunch")
 
 
 class Context:
@@ -217,6 +260,13 @@ class Context:
         # reports both (a resize-free run must show 0 / 0.0)
         self.stats_resizes = 0
         self.stats_resize_time_s = 0.0
+        # process-level elasticity (resize_processes): moves this
+        # Context committed, and the exiting-for-relaunch latch —
+        # once the marker is on disk the shutdown is LOCAL (the group
+        # membership already drained; a shrink's survivors and its
+        # departing ranks no longer share collective membership)
+        self.stats_resizes_proc = 0
+        self._resize_exiting = False
         # service plane (thrill_tpu/service/): the scheduler is
         # constructed lazily by the first submit(); current_tenant is
         # the tenant nodes created right now are stamped with (the
@@ -234,6 +284,14 @@ class Context:
         self.front_door = None
         from ..service.front_door import maybe_start as _fd_start
         _fd_start(self)
+        # autoscaler (service/autoscale.py): the policy thread that
+        # watches queue depth / rejects / serve p99 and drives resize.
+        # Off (None, zero overhead) unless THRILL_TPU_AUTOSCALE_S > 0;
+        # stopped in close() before the front door so no decision
+        # fires into a draining service plane.
+        self.autoscaler = None
+        from ..service.autoscale import maybe_start as _as_start
+        self.autoscaler = _as_start(self)
         # persistent plan store (service/plan_store.py): learned
         # exchange capacities / narrow specs / plan kinds / pre-shuffle
         # verdicts seed the fresh mesh, so a warm restart re-runs a
@@ -486,8 +544,20 @@ class Context:
         svc = self.service
         if svc is not None and svc.alive:
             # fenced: the dispatcher runs the swap between jobs, so no
-            # pipeline ever traces against a half-swapped mesh
-            return svc.fence(lambda: self._resize_now(new_w))
+            # pipeline ever traces against a half-swapped mesh. The
+            # front door's verdict gate closes FIRST: a socket submit
+            # that reaches its admission verdict while this fence is
+            # pending must not be told "accept" with the generation
+            # (and W) the swap is about to invalidate — its verdict
+            # waits out the swap and names the post-resize generation.
+            fd = self.front_door
+            if fd is not None:
+                fd.begin_resize_fence()
+            try:
+                return svc.fence(lambda: self._resize_now(new_w))
+            finally:
+                if fd is not None:
+                    fd.end_resize_fence()
         return self._resize_now(new_w)
 
     def _resize_now(self, new_w: int) -> float:
@@ -545,6 +615,157 @@ class Context:
                              generation=self.generation,
                              resize_time_s=round(dt, 4))
         return dt
+
+    # -- process-level elasticity: drain → seal → relaunch as one move --
+    def resize_processes(self, num_workers: int, state=None,
+                         drain_timeout_s: Optional[float] = None):
+        """Orchestrated process-level resize: drain the service plane,
+        seal a RESIZE checkpoint epoch re-partitioned to ``W'``,
+        agree the relaunch over the host group, commit the RESIZE
+        marker, and exit every process with :data:`RESIZE_EXIT_CODE`
+        so the supervisor (run-scripts/supervise.sh) relaunches the
+        job at ``W'`` with ``THRILL_TPU_RESUME=1``. Never returns:
+        raises :class:`ResizeRelaunch` (a SystemExit) on success.
+
+        ``state`` is the DIA whose materialized shards carry across
+        the move (``Execute()``/``.Keep`` it first); ``None`` commits
+        a data-free move — the relaunch starts the job body from
+        scratch at ``W'``. Call it on the MAIN thread only; an
+        autoscaler ``apply_fn`` should signal the main loop rather
+        than call this from the policy thread (a SystemExit raised on
+        a helper thread kills just that thread).
+
+        Crash-safety, step by step (the fault-matrix contract):
+
+        1. DRAIN — front door stops admitting (typed ``draining``
+           rejects, clients redial post-relaunch), local queue runs
+           dry. Nothing durable changed; failure aborts clean.
+        2. SEAL (``ckpt.resize_manifest``) — the W'-worker epoch.
+           SIGKILL mid-seal leaves an uncommitted dir swept at next
+           resume; a COMMITTED epoch with no marker is inert (the
+           old-W resume's workers gate rejects it).
+        3. GATE (``net.group.relaunch``) — mutation-free agreement
+           every rank reached the move (shrink settles through the
+           lenient departing-peer barrier). Failure aborts clean.
+        4. MARKER (``ckpt.resize_manifest``, stage=marker) — the
+           point of no return. Before it lands: relaunch heals at the
+           old W. After: any relaunch — including the supervisor's
+           retry after a SIGKILL right here — reads the marker and
+           completes the move at ``W'``.
+        5. EXIT — every rank raises :class:`ResizeRelaunch`; close()
+           runs collective-free (``_resize_exiting``) since ranks exit
+           at their own pace from here.
+        """
+        from ..common import faults
+        from ..net.group import resize_enabled, resize_timeout_s
+        if self._closed:
+            raise RuntimeError("Context is closed")
+        if not resize_enabled():
+            raise RuntimeError(
+                "THRILL_TPU_RESIZE=0 pins the worker count for this "
+                "job; unset it to allow Context.resize_processes")
+        if self.checkpoint is None:
+            raise ValueError(
+                "resize_processes needs THRILL_TPU_CKPT_DIR: the "
+                "RESIZE epoch and the relaunch marker live in the "
+                "checkpoint directory")
+        new_w = int(num_workers)
+        if new_w < 1:
+            raise ValueError("cannot resize to an empty mesh")
+        old_w = self.num_workers
+        if new_w == old_w:
+            raise ValueError(
+                f"already running W={old_w}: resize_processes is a "
+                f"whole-process relaunch, a same-W move would restart "
+                f"the job for nothing")
+        procs = max(1, self.mesh_exec.num_processes)
+        local = max(1, old_w // procs)
+        if procs > 1 and new_w % local:
+            raise ValueError(
+                f"W'={new_w} is not a multiple of the {local} "
+                f"workers each process contributes; the supervisor "
+                f"relaunches whole processes")
+        target_procs = (new_w // local) if procs > 1 else 1
+        timeout = (drain_timeout_s if drain_timeout_s is not None
+                   else resize_timeout_s())
+        t0 = time.monotonic()
+        # 1) DRAIN
+        if self.front_door is not None:
+            self.front_door.drain()
+        self._quiesce_service(timeout)
+        # 2) SEAL
+        epoch = None
+        if state is not None:
+            node = getattr(state, "node", state)
+            shards = getattr(node, "_shards", None)
+            if shards is None:
+                raise ValueError(
+                    f"resize_processes state {node.label!r} has no "
+                    f"materialized shards; Execute()/Keep() it before "
+                    f"the move")
+            epoch = self.checkpoint.seal_resize(node, shards, new_w)
+        # 3) GATE — settle the move's generation over the old group
+        gen = self._gen_counter + 1
+        self.net.group.prepare_relaunch(target_procs, gen)
+        self._gen_counter = gen
+        self.generation = gen
+        # 4) MARKER — the point of no return
+        self.checkpoint.commit_resize_marker(
+            new_w, epoch=epoch, generation=gen, procs=target_procs)
+        # 5) EXIT
+        self._resize_exiting = True
+        self.stats_resizes_proc += 1
+        dt = time.monotonic() - t0
+        self.stats_resize_time_s += dt
+        faults.note("recovery", what="ctx.resize_processes",
+                    old_w=old_w, new_w=new_w, epoch=epoch,
+                    generation=gen, _quiet=True)
+        if self.logger.enabled:
+            self.logger.line(event="resize_processes",
+                             workers_old=old_w, workers_new=new_w,
+                             procs_old=procs, procs_new=target_procs,
+                             epoch=epoch, generation=gen,
+                             seconds=round(dt, 4))
+        raise ResizeRelaunch(new_w, epoch=epoch, generation=gen)
+
+    def _quiesce_service(self, timeout: float) -> None:
+        """Wait until the local scheduler has no queued or in-flight
+        job (``jobs_done`` catches up to ``jobs_submitted``). The
+        front door is already draining, so no NEW work arrives over
+        the socket edge; direct ``ctx.submit`` callers are expected to
+        stop submitting around a resize — under sustained direct
+        traffic this times out and the move aborts clean."""
+        svc = self.service
+        if svc is None or not svc.alive:
+            return
+        deadline = time.monotonic() + max(0.1, float(timeout))
+        while True:
+            with svc._cv:
+                idle = (svc.queue.depth == 0
+                        and svc.jobs_done >= svc.jobs_submitted)
+            if idle:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"resize_processes: service did not drain within "
+                    f"{timeout:.1f}s (queued={svc.queue.depth}, "
+                    f"in_flight="
+                    f"{svc.jobs_submitted - svc.jobs_done}); the move "
+                    f"aborted with nothing mutated")
+            time.sleep(0.02)
+        if self.net.num_workers > 1:
+            # multi-controller: the follower dispatchers park in a net
+            # recv waiting for rank 0's next ordering frame, so the
+            # move's seal/gate collectives below would race that recv
+            # for frames. Stop the scheduler collectively instead —
+            # rank 0's close broadcasts the drain sentinel and every
+            # rank's dispatcher exits at the same control-plane point
+            # (TCP ordering puts the sentinel after the last job's
+            # frames). Every drained future has already resolved; a
+            # submit after an aborted move lazily builds a fresh
+            # scheduler, so the abort still leaves a serving Context.
+            svc.close(timeout=timeout)
+            self.service = None
 
     # -- stage memory negotiation ---------------------------------------
     # Reference: the StageBuilder distributes worker RAM per stage —
@@ -788,6 +1009,16 @@ class Context:
             # wall cost (0 / 0.0 proves the machinery idle when unused)
             "resizes": self.stats_resizes,
             "resize_time_s": round(self.stats_resize_time_s, 4),
+            # process-level elasticity (resize_processes) and the
+            # autoscaler that drives it: orchestrated moves committed
+            # by this Context, policy decisions/ticks, and EM runs
+            # adopted from departed ranks — all pinned EXACTLY zero on
+            # non-elastic workloads by the perf sentinel
+            "resizes_proc": self.stats_resizes_proc,
+            **(self.autoscaler.stats()
+               if getattr(self, "autoscaler", None) is not None
+               else {"autoscale_decisions": 0, "autoscale_ticks": 0}),
+            "runs_adopted": _em_adopted(),
             "conn_reconnects": getattr(self.net.group,
                                        "stats_reconnects", 0),
             "stale_frames_dropped": getattr(self.net.group,
@@ -863,7 +1094,8 @@ class Context:
         stats.update(_iostats.delta(_iostats.snapshot(),
                                     self._io_base))
         if self.net.num_workers > 1 and not local_only \
-                and not self._aborted and self.service is None:
+                and not self._aborted and self.service is None \
+                and not self._resize_exiting:
             # once a rank has EVER served, degrade to the local view
             # permanently: while dispatchers live, the non-root ranks'
             # park in a recv on this same untagged control plane
@@ -902,6 +1134,10 @@ class Context:
                           # generation counters are coordinated (host
                           # 0's copy, the default, is the global view)
                           "conn_reconnects", "stale_frames_dropped",
+                          # adopted EM runs are per-process transport-
+                          # local events too (each adopting rank
+                          # rewrote its own OWNER records)
+                          "runs_adopted",
                           # host frames (and their codec savings) are
                           # per-process partials; the device wire
                           # bytes — actual and raw — derive from the
@@ -1221,6 +1457,16 @@ class Context:
         # replicated plan inputs, so one copy is the cluster's copy)
         with self._service_lock:
             self._closed = True
+        # autoscaler before everything in the service plane: no policy
+        # decision may fire a resize into the teardown below
+        if getattr(self, "autoscaler", None) is not None:
+            try:
+                self.autoscaler.stop()
+            except Exception as e:
+                from ..common import faults as _faults
+                _faults.note("recovery", what="autoscale.stop_failed",
+                             error=repr(e)[:200])
+            self.autoscaler = None
         # front door before the scheduler: stop accepting sockets and
         # flush streamed results while the dispatcher can still run
         # the in-flight jobs those streams are waiting on
@@ -1309,7 +1555,10 @@ class Context:
             from ..data.block_pool import purge_stale_spills
             purge_stale_spills(self.config.spill_dir)
         if self.net.num_workers > 1:
-            if not self._aborted:
+            # an exiting-for-relaunch rank closes collective-free too:
+            # after the marker barrier every rank exits at its own
+            # pace (the supervisor is the next synchronization point)
+            if not self._aborted and not self._resize_exiting:
                 try:
                     self.net.barrier()
                 except (ClusterAbort, ConnectionError,

@@ -58,6 +58,130 @@ _F_MANIFEST = faults.declare("em.run.manifest")
 
 _MAGIC = 0x454D5231  # "EMR1"
 
+# orphan-run adoption (elastic mesh): a rank that JOINS an elastic
+# group (net.tcp.join_tcp_group) as the replacement for a departed
+# rank scans the run store for its rank id's committed runs and adopts
+# them instead of re-forming them. OWNER.json records which process
+# owns a signature dir (liveness-checked before adoption — a store
+# whose owner still runs is NOT an orphan); ADOPTED.json marks a
+# claimed store so its RunStore loads runs even when the joiner's own
+# Context is not in global resume mode. Adoption is deliberately
+# scoped to the SAME rank id: the host rank in the run signature pins
+# the input partition that rank processed, so another rank's runs
+# could never pass the (slot, pos0, n, fp) identity check anyway.
+_OWNER = "OWNER.json"
+_ADOPTED = "ADOPTED.json"
+_adopt_lock = threading.Lock()
+_adopted = 0
+
+
+def adopted_total() -> int:
+    """Process-wide count of runs adopted from departed owners —
+    surfaced as ``runs_adopted`` in ``Context.overall_stats`` and
+    pinned EXACTLY zero on non-elastic workloads by the perf
+    sentinel."""
+    with _adopt_lock:
+        return _adopted
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except (OSError, ValueError, TypeError):
+        return False
+
+
+def adopt_orphan_runs(ckpt_dir: str, my_rank: int) -> int:
+    """Adopt the committed EM runs a DEPARTED rank left behind.
+
+    Called by a rank joining an elastic group (and by the relaunch
+    path when a resize marker is consumed): scan
+    ``<ckpt_dir>/em_runs/*_h<my_rank>`` for signature dirs whose
+    recorded owner process is gone, verify each committed run
+    (manifest JSON validity + bin present at the manifested byte
+    size — the full CRC still runs at ``try_load`` before any byte is
+    reused), claim ownership, and mark the store ADOPTED so its runs
+    load without global resume mode. Returns the number of runs
+    adopted; exception-safe and silent on a missing store (a joiner
+    into a group that never spilled adopts nothing). Remote object
+    stores are skipped — there is no cheap liveness/listing seam, and
+    the joiner's normal resume path covers them."""
+    global _adopted
+    if not _enabled() or not ckpt_dir or _is_remote(ckpt_dir):
+        return 0
+    base = os.path.join(ckpt_dir.rstrip("/"), "em_runs")
+    suffix = f"_h{int(my_rank)}"
+    total = 0
+    try:
+        sigs = sorted(os.listdir(base))
+    except OSError:
+        return 0                       # no store: nothing ever spilled
+    for sig in sigs:
+        if not sig.endswith(suffix):
+            continue
+        sdir = os.path.join(base, sig)
+        if not os.path.isdir(sdir) \
+                or os.path.isfile(os.path.join(sdir, _ADOPTED)):
+            continue                   # already claimed
+        try:
+            owner = None
+            opath = os.path.join(sdir, _OWNER)
+            try:
+                with open(opath, "rb") as fh:
+                    owner = json.loads(fh.read().decode("ascii"))
+            except (OSError, ValueError):
+                owner = None           # ownerless pre-adoption store
+            if owner is not None:
+                pid = owner.get("pid")
+                if pid == os.getpid():
+                    continue           # my own store, nothing to adopt
+                if _pid_alive(pid):
+                    continue           # owner still runs: NOT an orphan
+            verified = 0
+            for name in sorted(os.listdir(sdir)):
+                if not (name.startswith("run_")
+                        and name.endswith(".json")):
+                    continue
+                try:
+                    with open(os.path.join(sdir, name), "rb") as fh:
+                        man = json.loads(fh.read().decode("ascii"))
+                    bin_path = os.path.join(
+                        sdir, name[:-len(".json")] + ".bin")
+                    if not all(k in man for k in
+                               ("slot", "pos0", "n", "fp",
+                                "crc", "bin_bytes")):
+                        raise ValueError("manifest missing keys")
+                    if os.path.getsize(bin_path) != man["bin_bytes"]:
+                        raise ValueError("bin size mismatch")
+                    verified += 1
+                except (OSError, ValueError) as e:
+                    faults.note("recovery",
+                                what="em_runs.adopt_skipped_run",
+                                sig=sig, run=name,
+                                error=repr(e)[:200])
+            if not verified:
+                continue               # nothing committed to claim
+            from ..vfs.file_io import write_file_atomic
+            write_file_atomic(
+                os.path.join(sdir, _ADOPTED),
+                json.dumps({"runs": verified, "by_pid": os.getpid(),
+                            "from_pid": (owner or {}).get("pid")}
+                           ).encode("ascii"))
+            write_file_atomic(opath, json.dumps(
+                {"pid": os.getpid(),
+                 "rank": int(my_rank)}).encode("ascii"))
+            total += verified
+            faults.note("recovery", what="em_runs.adopted",
+                        sig=sig, runs=verified, _quiet=True)
+        except Exception as e:
+            faults.note("recovery", what="em_runs.adopt_failed",
+                        sig=sig, error=repr(e)[:200])
+    if total:
+        with _adopt_lock:
+            _adopted += total
+    return total
+
 
 def _enabled() -> bool:
     return os.environ.get("THRILL_TPU_EM_RESUME", "1") != "0"
@@ -107,6 +231,14 @@ class RunStore:
         self.base = base
         self.mgr = mgr          # CheckpointManager (resume ledger)
         self.resume = bool(getattr(mgr, "resume", False))
+        # an ADOPTED store (orphan runs claimed by this process after
+        # an elastic join) loads its runs even without global resume
+        # mode. Probed only when adoption actually happened in this
+        # process — non-elastic workloads never pay the stat.
+        if not self.resume and adopted_total() > 0 \
+                and not _is_remote(base) \
+                and os.path.isfile(os.path.join(base, _ADOPTED)):
+            self.resume = True
         # commit concurrency: commits of DIFFERENT runs are
         # independent (only bin-before-manifest within one run is
         # ordered), and against remote storage each one is
@@ -127,6 +259,18 @@ class RunStore:
         self._warm_evt: Optional[threading.Event] = None
         if not _is_remote(base):
             os.makedirs(base, exist_ok=True)
+            # ownership record for the elastic orphan-adoption scan:
+            # which process currently owns this signature dir. Local
+            # stores only (adoption itself is local-only) and best-
+            # effort — a failed write just makes the store ownerless,
+            # which adoption treats as adoptable-after-verification.
+            try:
+                from ..vfs.file_io import write_file_atomic
+                write_file_atomic(
+                    os.path.join(base, _OWNER),
+                    json.dumps({"pid": os.getpid()}).encode("ascii"))
+            except Exception:
+                pass
         if self.resume:
             # warm from CONSTRUCTION, not first try_load: the sort
             # re-streams its whole input before it cuts the first run,

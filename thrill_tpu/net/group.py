@@ -73,6 +73,15 @@ _F_DELAY = faults.declare("net.group.delay")
 F_RESIZE = faults.declare("net.group.resize_handshake",
                           exc=faults.InjectedConnectionError)
 
+#: orchestrated process-level relaunch (Context.resize_processes):
+#: fired at the relaunch GATE — after the RESIZE epoch sealed, before
+#: the resize marker commits and before any membership drains — so an
+#: injected failure aborts the whole move with the old-W group fully
+#: intact (the sealed W' epoch is inert: an old-W resume rejects it by
+#: the workers gate) and a clean retry re-runs the identical move
+F_RELAUNCH = faults.declare("net.group.relaunch",
+                            exc=faults.InjectedConnectionError)
+
 
 def resize_enabled() -> bool:
     """Elastic membership changes are on by default;
@@ -788,6 +797,45 @@ class Group(abc.ABC):
                          if p < new_w}
         faults.note("recovery", what="net.resize", old=old_w,
                     new=new_w, gen=gen, _quiet=True)
+
+    def prepare_relaunch(self, new_num_hosts: int, gen: int) -> None:
+        """The net-layer step of an orchestrated process-level resize
+        (``Context.resize_processes``): agree the group is ready to be
+        torn down and relaunched at ``new_num_hosts``.
+
+        Collective over the CURRENT membership, and deliberately
+        mutation-free: every process — survivor, departing, and (for
+        a grow) the current ranks the joiners will meet again — exits
+        for the supervised relaunch right after the move commits, so
+        the only job here is agreement that every current rank
+        reached the relaunch point. Shrink settles the generation
+        through the PR-16 lenient departing-peer barrier (an
+        already-dead departing rank must not wedge the survivors'
+        move); grow is a plain generation barrier (the joiners do not
+        exist until the supervisor spawns them — admission happens in
+        the relaunched processes' authenticated bootstrap). Because
+        nothing mutates, the marker commit that follows still runs
+        its cross-rank barrier over the intact old membership, and an
+        injected failure at ANY point before the marker leaves the
+        old-W group exactly as it was. The ``net.group.relaunch``
+        fault site fires FIRST — the nothing-mutated proof for this
+        step."""
+        new_w = int(new_num_hosts)
+        old_w = self.num_hosts
+        gen = int(gen)
+        if not resize_enabled():
+            raise RuntimeError(
+                "elastic resize is disabled (THRILL_TPU_RESIZE=0); "
+                "the worker count is pinned for the process lifetime")
+        faults.check(F_RELAUNCH, old=old_w, new=new_w,
+                     gen=gen, rank=self.my_rank)
+        if new_w < old_w:
+            departing = frozenset(range(new_w, old_w))
+            self._resize_barrier(gen, lenient=departing)
+        else:
+            self.begin_generation(gen)
+        faults.note("recovery", what="net.relaunch_ready",
+                    old=old_w, new=new_w, gen=gen, _quiet=True)
 
     def _resize_barrier(self, gen: int, lenient: frozenset) -> int:
         """Generation barrier over the CURRENT membership in which a

@@ -1259,6 +1259,19 @@ def join_tcp_group(rank: int, hosts: List[Tuple[str, int]],
         group.enable_lazy_async()
     from . import heartbeat
     group._heartbeat = heartbeat.maybe_start(group)
+    # orphan-run adoption: a joiner replacing a departed rank claims
+    # that rank's committed EM runs (core/em_runs.py) so the first
+    # elastic-generation sort reuses them instead of re-forming them.
+    # Best-effort and strictly additive — a failed scan only means
+    # the runs re-form, exactly as before adoption existed.
+    ckpt_dir = os.environ.get("THRILL_TPU_CKPT_DIR", "")
+    if ckpt_dir:
+        try:
+            from ..core.em_runs import adopt_orphan_runs
+            adopt_orphan_runs(ckpt_dir, rank)
+        except Exception as e:
+            faults.note("recovery", what="em_runs.adopt_failed",
+                        error=repr(e)[:200])
     return group
 
 

@@ -38,7 +38,7 @@ from typing import Any, Iterator, Optional
 from ..common.retry import default_policy
 from ..net import wire
 from ..net.tcp import TcpConnection, _exchange_auth_flag
-from .front_door import PROTO_VERSION
+from .front_door import PROTO_MAX, PROTO_MIN, PROTO_VERSION
 
 
 class Rejected(RuntimeError):
@@ -50,6 +50,19 @@ class Rejected(RuntimeError):
                          f"{retry_after_s:.3f}s): {msg}")
         self.kind = kind
         self.retry_after_s = float(retry_after_s)
+
+
+class VersionMismatch(RuntimeError):
+    """The server speaks no protocol version in this client's range —
+    PERMANENT by construction (a plain RuntimeError subclass, so the
+    connect retry policy surfaces it immediately instead of redialing
+    a server that will refuse forever). Carries the server's
+    supported range parsed from the typed reject."""
+
+    def __init__(self, msg: str) -> None:
+        super().__init__(f"protocol version mismatch: {msg} "
+                         f"(this client speaks "
+                         f"[{PROTO_MIN},{PROTO_MAX}])")
 
 
 class RemoteJobError(RuntimeError):
@@ -72,6 +85,10 @@ class RemoteJob:
     def __init__(self, jid: int) -> None:
         self.id = jid
         self.mode = "blob"
+        # v2 servers stamp the accept with the mesh generation the
+        # job runs under (None from v1 servers) — the elastic-fence
+        # regression test pins that a resize can never invalidate it
+        self.generation: Optional[int] = None
         self._chunks: deque = deque()
         self._raw: list = []
         self._cv = threading.Condition()
@@ -84,6 +101,8 @@ class RemoteJob:
         with self._cv:
             self._accepted = True
             self.mode = str(meta.get("mode", "blob"))
+            gen = meta.get("gen")
+            self.generation = int(gen) if gen is not None else None
             self._cv.notify_all()
 
     def _on_chunk(self, payload: bytes) -> None:
@@ -189,6 +208,10 @@ class FrontDoorClient:
         self._closed = False
         self._conn_lost: Optional[BaseException] = None
         self._bye_reason: Optional[str] = None
+        # filled by the handshake: the negotiated protocol version and
+        # the server's advertised [min, max]
+        self.proto = PROTO_VERSION
+        self.server_range = (PROTO_VERSION, PROTO_VERSION)
 
         def dial() -> TcpConnection:
             sock = socket.create_connection(
@@ -199,17 +222,35 @@ class FrontDoorClient:
                 _exchange_auth_flag(conn, self.secret is not None)
                 if self.secret is not None:
                     conn.authenticate(self.secret, "client")
+                # v2 hello: offer the whole range. A v1 server reads
+                # the field with int() and rejects the list with its
+                # "proto mismatch" bye — falling back to a plain int
+                # there is not needed in-tree (server and client ship
+                # together); cross-version cover is the v2 server
+                # accepting v1 clients' single-int hellos.
                 conn.send(("hello", {"tenant": self.tenant,
-                                     "proto": PROTO_VERSION}))
+                                     "proto": [PROTO_MIN, PROTO_MAX]}))
                 frame = conn.recv_deadline(connect_timeout_s)
             except BaseException:
                 conn.close()
                 raise
+            if (isinstance(frame, (tuple, list)) and len(frame) >= 5
+                    and frame[0] == "reject"
+                    and frame[2] == "version_mismatch"):
+                conn.close()
+                raise VersionMismatch(str(frame[4]))
             if not (isinstance(frame, (tuple, list)) and frame
                     and frame[0] == "welcome"):
                 conn.close()
                 raise ConnectionError(
                     f"front door refused handshake: {frame!r}")
+            # negotiated version + server range (v1 servers send just
+            # {"proto": 1}: range degrades to [proto, proto])
+            meta = frame[1] if len(frame) > 1 \
+                and isinstance(frame[1], dict) else {}
+            self.proto = int(meta.get("proto", PROTO_VERSION))
+            rng = meta.get("range") or [self.proto, self.proto]
+            self.server_range = (int(rng[0]), int(rng[1]))
             return conn
 
         # a restarting / briefly-saturated server is a transient:

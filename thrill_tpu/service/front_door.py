@@ -75,6 +75,16 @@ from ..net.tcp import F_CLIENT_DISCONNECT, TcpConnection, \
     _exchange_auth_flag
 from .scheduler import ShedLoad
 
+# protocol range this server speaks. v1: the original frame set, the
+# hello carries a single int and equality decides. v2: the hello may
+# carry ``[min, max]``, the server negotiates the highest common
+# version into the welcome (``{"proto": negotiated, "range": [..]}``)
+# and stamps the mesh generation onto accept frames (elastic resize
+# awareness). Out-of-range clients get a TYPED ``version_mismatch``
+# reject naming the supported range — never a silent EOF.
+PROTO_MIN = 1
+PROTO_MAX = 2
+# legacy shorthand: the single version a pre-range peer offers/expects
 PROTO_VERSION = 1
 
 # fired per accepted socket, before the handshake: an armed fire drops
@@ -115,7 +125,7 @@ class _Conn:
     slow client costs the mesh at most one bounded offer, never a
     blocked collective."""
 
-    __slots__ = ("conn", "peer", "tenant", "out", "out_bytes",
+    __slots__ = ("conn", "peer", "tenant", "proto", "out", "out_bytes",
                  "cv", "dead", "inflight", "reader", "writer",
                  "t_last_frame", "fd")
 
@@ -125,6 +135,7 @@ class _Conn:
         self.conn = conn
         self.peer = peer
         self.tenant = "default"
+        self.proto = PROTO_MIN     # negotiated up in the handshake
         self.out: deque = deque()
         self.out_bytes = 0
         self.cv = threading.Condition()
@@ -224,6 +235,15 @@ class FrontDoor:
         self._draining = False
         self._closed = False
         self.drained = threading.Event()
+        # resize verdict gate: while a Context.resize has REQUESTED
+        # its dispatcher fence but the swap has not completed, no
+        # admission verdict frame may be emitted — an accept sent in
+        # that window would name a generation (and mesh W) the resize
+        # is about to invalidate. Reader threads block on this gate at
+        # the top of _handle_submit; Context.resize brackets its
+        # fenced swap with begin/end (see that method).
+        self._fence_cv = threading.Condition()
+        self._fencing = 0
         # the fd_* counter row (Context.overall_stats merges stats(),
         # so the Prometheus endpoint exports these for free)
         self.conns_accepted = 0
@@ -335,12 +355,32 @@ class FrontDoor:
                     and frame[0] == "hello"
                     and isinstance(frame[1], dict)):
                 raise ConnectionError(f"bad hello {frame!r}")
-            if int(frame[1].get("proto", -1)) != PROTO_VERSION:
-                c.enqueue(("bye", f"proto mismatch: want "
-                                  f"{PROTO_VERSION}"))
+            # version negotiation: a v2+ client offers [min, max], a
+            # v1 client offers a single int (min == max). The server
+            # picks the highest common version; no overlap is a TYPED
+            # version_mismatch reject naming the supported range —
+            # the client surfaces it as a permanent error, not a
+            # redial-forever ConnectionError.
+            offered = frame[1].get("proto", -1)
+            try:
+                if isinstance(offered, (list, tuple)) \
+                        and len(offered) == 2:
+                    cmin, cmax = int(offered[0]), int(offered[1])
+                else:
+                    cmin = cmax = int(offered)
+            except (TypeError, ValueError):
+                cmin = cmax = -1          # garbage: out of any range
+            if cmin > cmax or cmax < PROTO_MIN or cmin > PROTO_MAX:
+                c.enqueue(("reject", 0, "version_mismatch", 0.0,
+                           f"server supports protocol "
+                           f"[{PROTO_MIN},{PROTO_MAX}], client "
+                           f"offered [{cmin},{cmax}]"))
+                c.enqueue(("bye", "version mismatch"))
                 return False
+            c.proto = min(cmax, PROTO_MAX)
             c.tenant = str(frame[1].get("tenant") or "default")
-            c.enqueue(("welcome", {"proto": PROTO_VERSION}))
+            c.enqueue(("welcome", {"proto": c.proto,
+                                   "range": [PROTO_MIN, PROTO_MAX]}))
             return True
         except (ConnectionError, OSError, CollectiveHangTimeout,
                 wire.AuthError) as e:
@@ -415,6 +455,16 @@ class FrontDoor:
         # perf_counter, not monotonic: these stamps feed emit_span,
         # which places spans by perf_counter deltas (common/trace.py)
         t_accept = time.perf_counter()
+        # elastic fence gate (regression: a queued-but-unaccepted job
+        # during a resize): wait out any pending resize BEFORE any
+        # verdict frame, so the accept below is stamped with the
+        # post-resize generation and the job provably runs on the mesh
+        # its accept named. No deadlock: this reader thread holds no
+        # scheduler state, and the resize completes on the dispatcher
+        # thread independently of it.
+        with self._fence_cv:
+            while self._fencing and not self._closed and not c.dead:
+                self._fence_cv.wait(0.1)
         if self._draining:
             self._reject(c, jid, "draining",
                          round(self.drain_timeout_s, 3),
@@ -453,9 +503,15 @@ class FrontDoor:
         with c.cv:
             c.inflight[jid] = fut
         # mode rides the accept so a client can decode items-mode
-        # chunks AS THEY ARRIVE instead of waiting for the done frame
-        c.enqueue(("accept", jid,
-                   {"mode": "items" if streaming else "blob"}))
+        # chunks AS THEY ARRIVE instead of waiting for the done frame;
+        # v2 clients also get the generation the job will run under
+        # (read AFTER the fence gate, so a concurrent resize can never
+        # invalidate it)
+        meta: Dict[str, Any] = {"mode": "items" if streaming
+                                else "blob"}
+        if c.proto >= 2:
+            meta["gen"] = int(getattr(self.ctx, "generation", 0))
+        c.enqueue(("accept", jid, meta))
         if tr is not None and tr.enabled:
             tr.emit_span("front_door", "admit", t_accept,
                          time.perf_counter(), tenant=c.tenant,
@@ -615,6 +671,25 @@ class FrontDoor:
             except (ConnectionError, OSError, ValueError) as e:
                 c.kill(f"client write failed: {e!r}")
                 return
+
+    # -- resize verdict gate --------------------------------------------
+    def begin_resize_fence(self) -> None:
+        """Called by ``Context.resize`` BEFORE it requests the
+        dispatcher fence: from here until :meth:`end_resize_fence`,
+        no admission verdict frame leaves the front door (readers
+        park at the gate in ``_handle_submit``). Re-entrant — nested
+        resizes each count."""
+        with self._fence_cv:
+            self._fencing += 1
+
+    def end_resize_fence(self) -> None:
+        """Open the gate after the fenced swap completed (or failed —
+        callers pair this in a ``finally``). Parked readers re-read
+        ``ctx.generation`` after waking, so their accept frames carry
+        the post-resize generation."""
+        with self._fence_cv:
+            self._fencing = max(0, self._fencing - 1)
+            self._fence_cv.notify_all()
 
     # -- drain / close --------------------------------------------------
     def drain(self, timeout_s: Optional[float] = None) -> bool:

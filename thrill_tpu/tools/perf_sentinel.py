@@ -87,6 +87,13 @@ COUNTERS = (
     "fd_conns_accepted", "fd_conns_dropped", "fd_jobs_submitted",
     "fd_jobs_rejected", "fd_chunks_sent", "fd_slow_clients",
     "fd_deadline_expired",
+    # supervised process elasticity (ISSUE 20): every workload here is
+    # a fixed-W run that never moves processes, so the process-move
+    # counter, the autoscaler's decision count and the orphan-run
+    # adoption count must be EXACTLY zero — the drain/seal/relaunch
+    # machinery, the scaling policy and the join-time run-store scan
+    # cost nothing on a run that never resizes.
+    "resizes_proc", "autoscale_decisions", "runs_adopted",
 )
 
 #: byte totals compared ratio-banded (pow2 capacity ratchets may move
@@ -129,7 +136,12 @@ _SCRUB = ("THRILL_TPU_PLAN_STORE", "THRILL_TPU_FAULTS",
           # SERVE_PORT would auto-bind a front door into EVERY
           # workload's Context, polluting their all-zero fd_* rows
           "THRILL_TPU_SERVE_RATE", "THRILL_TPU_SERVE_TENANT_QUEUE",
-          "THRILL_TPU_SERVE_PORT")
+          "THRILL_TPU_SERVE_PORT",
+          # a set autoscale tick would thread a live policy into every
+          # workload's Context; its decisions are wall-clock-timed, so
+          # the all-zero autoscale_decisions row is only contract-
+          # deterministic with the knob scrubbed
+          "THRILL_TPU_AUTOSCALE_S")
 
 VERSION = 1
 
